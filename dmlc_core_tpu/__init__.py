@@ -1,0 +1,46 @@
+"""dmlc_core_tpu — a TPU-native rebuild of the dmlc-core backbone library.
+
+The reference (tpboudreau/dmlc-core) is the common backbone of the DMLC
+ecosystem: a URI-dispatched stream/filesystem layer, distributed record-aligned
+input splitting, sparse text parsers (libsvm/csv/libfm) + RecordIO, threaded
+prefetch pipelines, and parameter/registry/config/serialization infrastructure,
+plus a Python distributed-job tracker (see /root/reference and SURVEY.md).
+
+This package re-designs those capabilities TPU-first:
+
+- A **C++ native core** (``cpp/``) implements the hot host-side path — streams,
+  filesystems, record-aligned InputSplit partitioning, RecordIO, and the
+  multithreaded libsvm/csv/libfm parsers — exposed through a C ABI bound with
+  ctypes (``dmlc_core_tpu.io.native``).
+- The **device bridge** (``dmlc_core_tpu.tpu``) lands parsed row blocks in HBM
+  as sharded ``jax.Array``s with static bucketed shapes, double-buffering
+  host parsing against XLA compute (the ThreadedIter contract of
+  reference ``include/dmlc/threadediter.h`` carried across the GIL).
+- The **parallel layer** (``dmlc_core_tpu.parallel``) replaces the socket-based
+  Rabit tree/ring allreduce brokering (reference ``tracker/dmlc_tracker/
+  tracker.py:185-252``) with XLA collectives over ICI/DCN under
+  ``jax.sharding.Mesh``; the rendezvous role maps to
+  ``jax.distributed.initialize``.
+- The **tracker** (``dmlc_core_tpu.tracker``) keeps the ``dmlc-submit``
+  launcher surface (local/ssh/mpi/sge/slurm cluster backends and the
+  rabit-compatible rendezvous wire protocol) and adds ``cluster=tpu-pod``.
+"""
+
+__version__ = "0.1.0"
+
+from dmlc_core_tpu.base import DMLCError, check, check_eq, get_env, set_env
+from dmlc_core_tpu.params import Parameter, field, ParamError
+from dmlc_core_tpu.registry import Registry
+
+__all__ = [
+    "DMLCError",
+    "check",
+    "check_eq",
+    "get_env",
+    "set_env",
+    "Parameter",
+    "field",
+    "ParamError",
+    "Registry",
+    "__version__",
+]

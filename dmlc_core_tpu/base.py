@@ -1,0 +1,99 @@
+"""Diagnostics and environment helpers.
+
+TPU-native equivalent of the reference's L0/L1 layers: ``include/dmlc/logging.h``
+(CHECK/LOG macro family, throw-on-fatal ``dmlc::Error``, logging.h:29,202-212)
+and the env accessors ``GetEnv/SetEnv`` (``include/dmlc/parameter.h:50-61``).
+In Python the CHECK family maps to raising :class:`DMLCError`; logging maps to
+the stdlib ``logging`` module with a date-stamped stderr handler, matching the
+reference's builtin backend (logging.h:280-338).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Any, Optional, Type, TypeVar
+
+T = TypeVar("T")
+
+
+class DMLCError(RuntimeError):
+    """Fatal-check failure. Equivalent of ``dmlc::Error`` (logging.h:29)."""
+
+
+_LOGGER = logging.getLogger("dmlc_core_tpu")
+if not _LOGGER.handlers:  # date-stamped stderr, reference logging.h:280-338
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter("[%(asctime)s] %(levelname)s %(message)s",
+                                      "%H:%M:%S"))
+    _LOGGER.addHandler(_h)
+    _LOGGER.setLevel(logging.INFO)
+
+
+def logger() -> logging.Logger:
+    return _LOGGER
+
+
+def log_info(msg: str, *args: Any) -> None:
+    _LOGGER.info(msg, *args)
+
+
+def log_warning(msg: str, *args: Any) -> None:
+    _LOGGER.warning(msg, *args)
+
+
+def check(cond: Any, msg: str = "") -> None:
+    """``CHECK(cond)`` — raise :class:`DMLCError` when ``cond`` is falsy."""
+    if not cond:
+        raise DMLCError(f"Check failed: {msg}")
+
+
+def check_eq(a: Any, b: Any, msg: str = "") -> None:
+    if a != b:
+        raise DMLCError(f"Check failed: {a!r} == {b!r} {msg}")
+
+
+def check_ne(a: Any, b: Any, msg: str = "") -> None:
+    if a == b:
+        raise DMLCError(f"Check failed: {a!r} != {b!r} {msg}")
+
+
+def check_lt(a: Any, b: Any, msg: str = "") -> None:
+    if not a < b:
+        raise DMLCError(f"Check failed: {a!r} < {b!r} {msg}")
+
+
+def check_le(a: Any, b: Any, msg: str = "") -> None:
+    if not a <= b:
+        raise DMLCError(f"Check failed: {a!r} <= {b!r} {msg}")
+
+
+def check_gt(a: Any, b: Any, msg: str = "") -> None:
+    if not a > b:
+        raise DMLCError(f"Check failed: {a!r} > {b!r} {msg}")
+
+
+def check_ge(a: Any, b: Any, msg: str = "") -> None:
+    if not a >= b:
+        raise DMLCError(f"Check failed: {a!r} >= {b!r} {msg}")
+
+
+def get_env(key: str, default: T, dtype: Optional[Type[T]] = None) -> T:
+    """Typed env lookup — reference ``dmlc::GetEnv`` (parameter.h:1122+).
+
+    Booleans accept 0/1/true/false (case-insensitive)."""
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    ty: Type = dtype if dtype is not None else type(default)
+    if ty is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")  # type: ignore[return-value]
+    return ty(raw)  # type: ignore[return-value]
+
+
+def set_env(key: str, value: Any) -> None:
+    """Reference ``dmlc::SetEnv`` (parameter.h:50-61)."""
+    if isinstance(value, bool):
+        value = int(value)
+    os.environ[key] = str(value)

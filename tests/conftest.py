@@ -1,0 +1,19 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is unavailable in CI, so sharding/collective tests run on
+``xla_force_host_platform_device_count=8`` CPU devices — the same simulation
+strategy the reference uses for distributed input splitting (instantiating the
+same URI with different (part_index, num_parts) in one process,
+test/unittest/unittest_inputsplit.cc:116-145).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,63 @@
+"""Config tests — mirrors reference test/unittest/unittest_config.cc."""
+
+import pytest
+
+from dmlc_core_tpu.config import Config, ConfigError
+
+
+def test_basic_parse():
+    cfg = Config("k1 = v1\nk2=v2\n  k3   =    v3  # trailing comment\n")
+    assert cfg.get_param("k1") == "v1"
+    assert cfg.get_param("k2") == "v2"
+    assert cfg.get_param("k3") == "v3"
+
+
+def test_quoted_strings_and_escapes():
+    cfg = Config('msg = "hello world"\nesc = "say \\"hi\\""\n')
+    assert cfg.get_param("msg") == "hello world"
+    assert cfg.get_param("esc") == 'say "hi"'
+
+
+def test_comments_and_blank_lines():
+    cfg = Config("# full comment line\n\nk = v\n# another\n")
+    assert cfg.get_param("k") == "v"
+    assert list(cfg.items()) == [("k", "v")]
+
+
+def test_single_value_mode_keeps_last():
+    cfg = Config("k = a\nk = b\n", multi_value=False)
+    assert cfg.get_param("k") == "b"
+    assert list(cfg.items()) == [("k", "b")]
+
+
+def test_multi_value_mode_keeps_all():
+    cfg = Config("k = a\nk = b\nj = c\n", multi_value=True)
+    assert list(cfg.items()) == [("k", "a"), ("k", "b"), ("j", "c")]
+    assert cfg.get_param("k") == "b"  # latest
+
+
+def test_unclosed_quote_raises():
+    with pytest.raises(ConfigError, match="not closed"):
+        Config('k = "oops\n')
+
+
+def test_bad_escape_raises():
+    with pytest.raises(ConfigError, match="escape"):
+        Config('k = "bad \\n escape"\n')
+
+
+def test_proto_string():
+    cfg = Config()
+    cfg.set_param("num_round", 10)
+    cfg.set_param("name", "model", is_string=True)
+    proto = cfg.to_proto_string()
+    assert "num_round : 10\n" in proto
+    assert 'name : "model"\n' in proto
+
+
+def test_set_param_overwrites_in_single_value():
+    cfg = Config()
+    cfg.set_param("k", 1)
+    cfg.set_param("k", 2)
+    assert cfg.get_param("k") == "2"
+    assert len(list(cfg.items())) == 1

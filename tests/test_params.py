@@ -1,0 +1,103 @@
+"""Parameter module tests — mirrors reference test/unittest/unittest_param.cc."""
+
+import pytest
+
+from dmlc_core_tpu.params import Parameter, ParamError, field
+
+
+class LearningParam(Parameter):
+    float_param = field(float, default=1.5, desc="a float", range=(-10.0, 10.0))
+    int_param = field(int, default=3, lower_bound=0)
+    name = field(str, default="hello")
+    flag = field(bool, default=False)
+    kind = field(str, default="a", enum=["a", "b", "c"])
+
+
+class RequiredParam(Parameter):
+    num_hidden = field(int, desc="no default — required")
+
+
+def test_defaults():
+    p = LearningParam()
+    assert p.float_param == 1.5
+    assert p.int_param == 3
+    assert p.name == "hello"
+    assert p.flag is False
+
+
+def test_init_from_strings():
+    # URI query args arrive as strings (reference csv_parser.h:230-236)
+    p = LearningParam()
+    unknown = p.init({"float_param": "2.5", "int_param": "7",
+                      "flag": "true", "unknown_key": "1"}, allow_unknown=True)
+    assert p.float_param == 2.5
+    assert p.int_param == 7
+    assert p.flag is True
+    assert unknown == {"unknown_key": "1"}
+
+
+def test_unknown_rejected():
+    p = LearningParam()
+    with pytest.raises(ParamError, match="Unknown parameter"):
+        p.init({"nope": 1})
+
+
+def test_range_check():
+    p = LearningParam()
+    with pytest.raises(ParamError, match="out of range"):
+        p.init({"float_param": 100.0})
+    with pytest.raises(ParamError, match="lower bound"):
+        p.init({"int_param": -1})
+
+
+def test_enum_check():
+    p = LearningParam()
+    p.init({"kind": "b"})
+    assert p.kind == "b"
+    with pytest.raises(ParamError, match="not in allowed set"):
+        p.init({"kind": "z"})
+
+
+def test_required_missing():
+    with pytest.raises(ParamError, match="Required parameters missing"):
+        RequiredParam().init({})
+    p = RequiredParam()
+    p.init({"num_hidden": 10})
+    assert p.num_hidden == 10
+
+
+def test_bad_type():
+    p = LearningParam()
+    with pytest.raises(ParamError):
+        p.init({"int_param": "abc"})
+
+
+def test_docstring_and_fields():
+    doc = LearningParam.docstring()
+    assert "float_param" in doc and "a float" in doc
+    names = [f.name for f in LearningParam.fields()]
+    assert names == ["float_param", "int_param", "name", "flag", "kind"]
+
+
+def test_json_roundtrip():
+    p = LearningParam()
+    p.init({"float_param": 2.0, "name": "world"})
+    s = p.save_json()
+    q = LearningParam()
+    q.load_json(s)
+    assert q.as_dict() == p.as_dict()
+
+
+def test_setattr_validates():
+    p = LearningParam()
+    with pytest.raises(ParamError):
+        p.kind = "bad"
+
+
+def test_aliases():
+    class AliasParam(Parameter):
+        learning_rate = field(float, default=0.1, aliases=["lr", "eta"])
+
+    p = AliasParam()
+    p.init({"eta": "0.5"})
+    assert p.learning_rate == 0.5

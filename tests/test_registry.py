@@ -1,0 +1,56 @@
+"""Registry tests — mirrors reference registry usage (test/registry_test.cc)."""
+
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.registry import Registry
+
+
+def test_register_and_find():
+    reg = Registry.get("test_tree")
+    reg.register("binary", lambda: "binary-tree").describe("a binary tree")
+    try:
+        entry = reg.find("binary")
+        assert entry is not None
+        assert entry() == "binary-tree"
+        assert entry.description == "a binary tree"
+        assert reg.find("missing") is None
+        with pytest.raises(DMLCError, match="unknown entry"):
+            reg.lookup("missing")
+    finally:
+        reg.remove("binary")
+
+
+def test_decorator_and_duplicate():
+    reg = Registry.get("test_tree2")
+
+    @reg.register("avl")
+    def make_avl():
+        return "avl"
+
+    try:
+        assert reg.lookup("avl")() == "avl"
+        with pytest.raises(DMLCError, match="already registered"):
+            reg.register("avl", lambda: None)
+        reg.register("avl", lambda: "avl2", override=True)
+        assert reg.lookup("avl")() == "avl2"
+    finally:
+        reg.remove("avl")
+
+
+def test_singleton_per_kind():
+    assert Registry.get("kind_a") is Registry.get("kind_a")
+    assert Registry.get("kind_a") is not Registry.get("kind_b")
+
+
+def test_entry_metadata():
+    reg = Registry.get("test_meta")
+    entry = (reg.register("e", lambda **kw: kw)
+             .describe("entry with args")
+             .add_argument("alpha", "float", "learning rate")
+             .set_return_type("dict"))
+    try:
+        assert entry.arguments == [("alpha", "float", "learning rate")]
+        assert reg.lookup("e")(alpha=1.0) == {"alpha": 1.0}
+    finally:
+        reg.remove("e")

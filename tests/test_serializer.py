@@ -1,0 +1,68 @@
+"""Serializer tests — mirrors reference test/unittest/unittest_serializer.cc.
+
+Cross-language wire compatibility with the C++ core is asserted in
+tests/test_native.py once the native library is present.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.serializer import BinaryReader, BinaryWriter
+
+
+def roundtrip(write_fn, read_fn):
+    buf = io.BytesIO()
+    write_fn(BinaryWriter(buf))
+    buf.seek(0)
+    return read_fn(BinaryReader(buf))
+
+
+def test_scalars():
+    for dtype, value in [("int32", -5), ("uint64", 2**40), ("float32", 1.5),
+                         ("float64", -2.25), ("uint8", 200), ("bool", True)]:
+        got = roundtrip(lambda w: w.write_scalar(value, dtype),
+                        lambda r: r.read_scalar(dtype))
+        assert got == value
+
+
+def test_string():
+    s = "héllo wörld ✓"
+    assert roundtrip(lambda w: w.write_string(s),
+                     lambda r: r.read_string()) == s
+
+
+def test_arrays():
+    for dtype in ["int32", "uint32", "int64", "uint64", "float32", "float64"]:
+        arr = (np.arange(100) * 3 - 50).astype(dtype)
+        got = roundtrip(lambda w: w.write_array(arr),
+                        lambda r: r.read_array(dtype))
+        np.testing.assert_array_equal(got, arr)
+
+
+def test_str_list_and_map():
+    items = ["a", "bb", ""]
+    assert roundtrip(lambda w: w.write_str_list(items),
+                     lambda r: r.read_str_list()) == items
+    d = {"x": "1", "y": ""}
+    assert roundtrip(lambda w: w.write_str_map(d),
+                     lambda r: r.read_str_map()) == d
+
+
+def test_little_endian_on_disk():
+    # wire format is LE regardless of host order (reference endian.h:39-51)
+    buf = io.BytesIO()
+    BinaryWriter(buf).write_scalar(1, "uint32")
+    assert buf.getvalue() == b"\x01\x00\x00\x00"
+    buf = io.BytesIO()
+    BinaryWriter(buf).write_array(np.array([258], dtype="uint16"))
+    assert buf.getvalue() == (
+        b"\x01\x00\x00\x00\x00\x00\x00\x00" + b"\x02\x01")
+
+
+def test_truncated_raises():
+    buf = io.BytesIO(b"\x01\x00")
+    with pytest.raises(DMLCError, match="truncated"):
+        BinaryReader(buf).read_scalar("uint32")
