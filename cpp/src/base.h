@@ -1,0 +1,62 @@
+// dmlc_core_tpu native core — diagnostics and common definitions.
+//
+// TPU-native counterpart of reference include/dmlc/base.h + logging.h:
+// the CHECK macro family throws dct::Error (the reference's throw-on-fatal
+// configuration, logging.h:202-212, base.h:21). No glog backend; errors cross
+// the C ABI as thread-local message strings (see capi.cc).
+#ifndef DCT_BASE_H_
+#define DCT_BASE_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+
+namespace dct {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+namespace detail {
+// Collects a message via operator<< and throws on destruction-by-value.
+class CheckFailStream {
+ public:
+  CheckFailStream(const char* expr, const char* file, int line) {
+    os_ << file << ":" << line << ": check failed: `" << expr << "` ";
+  }
+  template <typename T>
+  CheckFailStream& operator<<(const T& v) {
+    os_ << v;
+    return *this;
+  }
+  [[noreturn]] void Fire() const { throw Error(os_.str()); }
+
+ private:
+  std::ostringstream os_;
+};
+
+struct CheckFire {
+  [[noreturn]] void operator&(const CheckFailStream& s) { s.Fire(); }
+};
+}  // namespace detail
+
+}  // namespace dct
+
+#define DCT_CHECK(cond)                                       \
+  if (!(cond))                                                \
+  ::dct::detail::CheckFire() &                                \
+      ::dct::detail::CheckFailStream(#cond, __FILE__, __LINE__)
+
+#define DCT_CHECK_BINARY(a, b, op) DCT_CHECK((a)op(b))                     \
+      << "(" << (a) << " vs " << (b) << ") "
+#define DCT_CHECK_EQ(a, b) DCT_CHECK_BINARY(a, b, ==)
+#define DCT_CHECK_NE(a, b) DCT_CHECK_BINARY(a, b, !=)
+#define DCT_CHECK_LT(a, b) DCT_CHECK_BINARY(a, b, <)
+#define DCT_CHECK_LE(a, b) DCT_CHECK_BINARY(a, b, <=)
+#define DCT_CHECK_GT(a, b) DCT_CHECK_BINARY(a, b, >)
+#define DCT_CHECK_GE(a, b) DCT_CHECK_BINARY(a, b, >=)
+
+#endif  // DCT_BASE_H_

@@ -1,0 +1,333 @@
+// C ABI for ctypes binding (dmlc_core_tpu/io/native.py).
+//
+// The reference exposes C++ headers directly; a TPU-native rebuild needs a
+// stable C surface instead because the Python/JAX layer binds via ctypes
+// (pybind11 is not part of the toolchain — see repo README). Conventions:
+//   - every call returns 0 on success, -1 on error; dct_last_error() returns
+//     the thread-local message
+//   - handles are opaque pointers; *_free releases
+//   - blob/rowblock pointers remain valid until the next call on the same
+//     handle (matching reference DataIter Value() semantics, data.h:55-66)
+#include <cstring>
+#include <string>
+
+#include "filesys.h"
+#include "input_split.h"
+#include "parser.h"
+#include "recordio.h"
+#include "rowblock.h"
+#include "stream.h"
+
+namespace {
+thread_local std::string g_last_error;
+
+template <typename F>
+int Guard(F&& fn) {
+  try {
+    fn();
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  } catch (...) {
+    g_last_error = "unknown C++ exception";
+    return -1;
+  }
+}
+}  // namespace
+
+
+
+typedef struct {
+  uint64_t num_rows;
+  uint64_t nnz;
+  const uint64_t* offset;  // num_rows + 1
+  const float* label;      // num_rows
+  const float* weight;     // num_rows or NULL
+  const uint64_t* qid;     // num_rows or NULL
+  const uint32_t* field;   // nnz or NULL
+  const void* index;       // nnz entries, dtype per index_is_64
+  const float* value;      // nnz or NULL (implicit 1.0)
+  uint64_t max_index;
+  uint32_t max_field;
+  int32_t index_is_64;
+} dct_rowblock_t;
+
+namespace {
+struct ParserHandle {
+  dct::Parser<uint32_t>* p32 = nullptr;
+  dct::Parser<uint64_t>* p64 = nullptr;
+
+  ~ParserHandle() {
+    delete p32;
+    delete p64;
+  }
+
+  template <typename T>
+  static void FillBlock(const dct::RowBlockContainer<T>* b,
+                        dct_rowblock_t* out) {
+    out->num_rows = b->Size();
+    out->nnz = b->index.size();
+    out->offset = b->offset.data();
+    out->label = b->label.data();
+    out->weight = b->weight.empty() ? nullptr : b->weight.data();
+    out->qid = b->qid.empty() ? nullptr : b->qid.data();
+    out->field = b->field.empty() ? nullptr : b->field.data();
+    out->index = b->index.data();
+    out->value = b->value.empty() ? nullptr : b->value.data();
+    out->max_index = b->max_index;
+    out->max_field = b->max_field;
+    out->index_is_64 = sizeof(T) == 8 ? 1 : 0;
+  }
+};
+}  // namespace
+
+extern "C" {
+
+const char* dct_last_error() { return g_last_error.c_str(); }
+
+// ---------------------------------------------------------------- streams --
+typedef void* dct_stream_t;
+
+int dct_stream_create(const char* uri, const char* mode, dct_stream_t* out) {
+  return Guard([&] { *out = dct::Stream::Create(uri, mode); });
+}
+
+int dct_stream_read(dct_stream_t h, void* buf, size_t size, size_t* nread) {
+  return Guard(
+      [&] { *nread = static_cast<dct::Stream*>(h)->Read(buf, size); });
+}
+
+int dct_stream_write(dct_stream_t h, const void* buf, size_t size) {
+  return Guard([&] { static_cast<dct::Stream*>(h)->Write(buf, size); });
+}
+
+int dct_stream_free(dct_stream_t h) {
+  return Guard([&] { delete static_cast<dct::Stream*>(h); });
+}
+
+// ------------------------------------------------------------- filesystem --
+// Lists to a newline-separated "path\tsize\ttype" string (caller frees with
+// dct_str_free).
+int dct_fs_list(const char* uri, int recursive, char** out) {
+  return Guard([&] {
+    dct::URI u(uri);
+    dct::FileSystem* fs = dct::FileSystem::GetInstance(u);
+    std::vector<dct::FileInfo> infos;
+    if (recursive) {
+      fs->ListDirectoryRecursive(u, &infos);
+    } else {
+      fs->ListDirectory(u, &infos);
+    }
+    std::string s;
+    for (const auto& info : infos) {
+      s += info.path.Str();
+      s += '\t';
+      s += std::to_string(info.size);
+      s += '\t';
+      s += info.type == dct::FileType::kDirectory ? 'd' : 'f';
+      s += '\n';
+    }
+    char* buf = new char[s.size() + 1];
+    std::memcpy(buf, s.c_str(), s.size() + 1);
+    *out = buf;
+  });
+}
+
+int dct_fs_path_info(const char* uri, size_t* size, int* is_dir) {
+  return Guard([&] {
+    dct::URI u(uri);
+    dct::FileInfo info = dct::FileSystem::GetInstance(u)->GetPathInfo(u);
+    *size = info.size;
+    *is_dir = info.type == dct::FileType::kDirectory ? 1 : 0;
+  });
+}
+
+int dct_str_free(char* s) {
+  delete[] s;
+  return 0;
+}
+
+// ------------------------------------------------------------ input split --
+typedef void* dct_split_t;
+
+int dct_split_create(const char* uri, unsigned part, unsigned nsplit,
+                     const char* type, int threaded, dct_split_t* out) {
+  return Guard([&] {
+    *out = dct::InputSplit::Create(uri, part, nsplit, type, "", false, 0, 256,
+                                   false, threaded != 0);
+  });
+}
+
+int dct_split_next_record(dct_split_t h, const void** data, size_t* size,
+                          int* has) {
+  return Guard([&] {
+    dct::InputSplit::Blob blob;
+    *has = static_cast<dct::InputSplit*>(h)->NextRecord(&blob) ? 1 : 0;
+    *data = blob.dptr;
+    *size = blob.size;
+  });
+}
+
+int dct_split_next_chunk(dct_split_t h, const void** data, size_t* size,
+                         int* has) {
+  return Guard([&] {
+    dct::InputSplit::Blob blob;
+    *has = static_cast<dct::InputSplit*>(h)->NextChunk(&blob) ? 1 : 0;
+    *data = blob.dptr;
+    *size = blob.size;
+  });
+}
+
+int dct_split_before_first(dct_split_t h) {
+  return Guard([&] { static_cast<dct::InputSplit*>(h)->BeforeFirst(); });
+}
+
+int dct_split_reset_partition(dct_split_t h, unsigned part, unsigned nsplit) {
+  return Guard(
+      [&] { static_cast<dct::InputSplit*>(h)->ResetPartition(part, nsplit); });
+}
+
+int dct_split_total_size(dct_split_t h, size_t* out) {
+  return Guard(
+      [&] { *out = static_cast<dct::InputSplit*>(h)->GetTotalSize(); });
+}
+
+int dct_split_hint_chunk_size(dct_split_t h, size_t bytes) {
+  return Guard(
+      [&] { static_cast<dct::InputSplit*>(h)->HintChunkSize(bytes); });
+}
+
+int dct_split_free(dct_split_t h) {
+  return Guard([&] { delete static_cast<dct::InputSplit*>(h); });
+}
+
+// --------------------------------------------------------------- recordio --
+typedef void* dct_recordio_writer_t;
+typedef void* dct_recordio_reader_t;
+
+namespace {
+struct WriterHandle {
+  dct::Stream* stream;
+  dct::RecordIOWriter* writer;
+};
+struct ReaderHandle {
+  dct::Stream* stream;
+  dct::RecordIOReader* reader;
+  std::string buf;
+};
+}  // namespace
+
+int dct_recordio_writer_create(const char* uri, dct_recordio_writer_t* out) {
+  return Guard([&] {
+    auto* h = new WriterHandle();
+    h->stream = dct::Stream::Create(uri, "w");
+    h->writer = new dct::RecordIOWriter(h->stream);
+    *out = h;
+  });
+}
+
+int dct_recordio_write(dct_recordio_writer_t h, const void* data,
+                       size_t size) {
+  return Guard([&] {
+    static_cast<WriterHandle*>(h)->writer->WriteRecord(data, size);
+  });
+}
+
+int dct_recordio_writer_free(dct_recordio_writer_t h) {
+  return Guard([&] {
+    auto* wh = static_cast<WriterHandle*>(h);
+    delete wh->writer;
+    delete wh->stream;
+    delete wh;
+  });
+}
+
+int dct_recordio_reader_create(const char* uri, dct_recordio_reader_t* out) {
+  return Guard([&] {
+    auto* h = new ReaderHandle();
+    h->stream = dct::Stream::Create(uri, "r");
+    h->reader = new dct::RecordIOReader(h->stream);
+    *out = h;
+  });
+}
+
+int dct_recordio_read(dct_recordio_reader_t h, const void** data, size_t* size,
+                      int* has) {
+  return Guard([&] {
+    auto* rh = static_cast<ReaderHandle*>(h);
+    *has = rh->reader->NextRecord(&rh->buf) ? 1 : 0;
+    *data = rh->buf.data();
+    *size = rh->buf.size();
+  });
+}
+
+int dct_recordio_reader_free(dct_recordio_reader_t h) {
+  return Guard([&] {
+    auto* rh = static_cast<ReaderHandle*>(h);
+    delete rh->reader;
+    delete rh->stream;
+    delete rh;
+  });
+}
+
+// ----------------------------------------------------------------- parser --
+typedef void* dct_parser_t;
+
+
+
+
+int dct_parser_create(const char* uri, unsigned part, unsigned npart,
+                      const char* format, int nthread, int threaded,
+                      int index64, dct_parser_t* out) {
+  return Guard([&] {
+    auto* h = new ParserHandle();
+    if (index64 != 0) {
+      h->p64 = dct::Parser<uint64_t>::Create(uri, part, npart, format, nthread,
+                                             threaded != 0);
+    } else {
+      h->p32 = dct::Parser<uint32_t>::Create(uri, part, npart, format, nthread,
+                                             threaded != 0);
+    }
+    *out = h;
+  });
+}
+
+int dct_parser_next_block(dct_parser_t h, dct_rowblock_t* out, int* has) {
+  return Guard([&] {
+    auto* ph = static_cast<ParserHandle*>(h);
+    if (ph->p64 != nullptr) {
+      const auto* b = ph->p64->NextBlock();
+      *has = b != nullptr ? 1 : 0;
+      if (b != nullptr) ParserHandle::FillBlock(b, out);
+    } else {
+      const auto* b = ph->p32->NextBlock();
+      *has = b != nullptr ? 1 : 0;
+      if (b != nullptr) ParserHandle::FillBlock(b, out);
+    }
+  });
+}
+
+int dct_parser_before_first(dct_parser_t h) {
+  return Guard([&] {
+    auto* ph = static_cast<ParserHandle*>(h);
+    if (ph->p64 != nullptr) {
+      ph->p64->BeforeFirst();
+    } else {
+      ph->p32->BeforeFirst();
+    }
+  });
+}
+
+int dct_parser_bytes_read(dct_parser_t h, size_t* out) {
+  return Guard([&] {
+    auto* ph = static_cast<ParserHandle*>(h);
+    *out = ph->p64 != nullptr ? ph->p64->BytesRead() : ph->p32->BytesRead();
+  });
+}
+
+int dct_parser_free(dct_parser_t h) {
+  return Guard([&] { delete static_cast<ParserHandle*>(h); });
+}
+
+}  // extern "C"

@@ -1,0 +1,167 @@
+// Local filesystem + scheme dispatch implementation.
+// Counterpart of reference src/io/local_filesys.cc and src/io.cc:30-71.
+#include "filesys.h"
+
+#include <dirent.h>
+#include <sys/stat.h>
+
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <queue>
+
+namespace dct {
+
+namespace {
+
+// stdio-backed seekable stream (reference local_filesys.cc:27-67).
+class StdFileStream : public SeekStream {
+ public:
+  StdFileStream(std::FILE* fp, bool own) : fp_(fp), own_(own) {}
+  ~StdFileStream() override {
+    if (own_ && fp_ != nullptr) std::fclose(fp_);
+  }
+  size_t Read(void* ptr, size_t size) override {
+    return std::fread(ptr, 1, size, fp_);
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    size_t n = std::fwrite(ptr, 1, size, fp_);
+    DCT_CHECK_EQ(n, size) << "write failed (disk full?)";
+    return n;
+  }
+  void Seek(size_t pos) override {
+    DCT_CHECK(fseeko(fp_, static_cast<off_t>(pos), SEEK_SET) == 0)
+        << "seek failed";
+  }
+  size_t Tell() override { return static_cast<size_t>(ftello(fp_)); }
+
+ private:
+  std::FILE* fp_;
+  bool own_;
+};
+
+}  // namespace
+
+LocalFileSystem* LocalFileSystem::GetInstance() {
+  static LocalFileSystem inst;
+  return &inst;
+}
+
+FileInfo LocalFileSystem::GetPathInfo(const URI& path) {
+  struct stat sb;
+  DCT_CHECK(stat(path.path.c_str(), &sb) == 0)
+      << "LocalFileSystem.GetPathInfo: " << path.path << " does not exist";
+  FileInfo info;
+  info.path = path;
+  info.size = static_cast<size_t>(sb.st_size);
+  info.type = S_ISDIR(sb.st_mode) ? FileType::kDirectory : FileType::kFile;
+  return info;
+}
+
+void LocalFileSystem::ListDirectory(const URI& path,
+                                    std::vector<FileInfo>* out) {
+  DIR* dir = opendir(path.path.c_str());
+  DCT_CHECK(dir != nullptr) << "cannot open directory " << path.path;
+  std::string prefix = path.path;
+  if (prefix.empty() || prefix.back() != '/') prefix += '/';
+  struct dirent* ent;
+  while ((ent = readdir(dir)) != nullptr) {
+    std::string name = ent->d_name;
+    if (name == "." || name == "..") continue;
+    URI sub = path;
+    sub.path = prefix + name;
+    struct stat sb;
+    if (stat(sub.path.c_str(), &sb) != 0) continue;  // symlink-tolerant
+    FileInfo info;
+    info.path = sub;
+    info.size = static_cast<size_t>(sb.st_size);
+    info.type = S_ISDIR(sb.st_mode) ? FileType::kDirectory : FileType::kFile;
+    out->push_back(info);
+  }
+  closedir(dir);
+}
+
+Stream* LocalFileSystem::Open(const URI& path, const char* mode,
+                              bool allow_null) {
+  // stdin/stdout passthrough (reference local_filesys.cc, io.cc:94-96)
+  if (path.path == "stdin") return new StdFileStream(stdin, false);
+  if (path.path == "stdout") return new StdFileStream(stdout, false);
+  std::string m = mode;
+  if (m.find('b') == std::string::npos) m += 'b';
+  std::FILE* fp = std::fopen(path.path.c_str(), m.c_str());
+  if (fp == nullptr) {
+    DCT_CHECK(allow_null) << "cannot open file " << path.path << " mode "
+                          << mode;
+    return nullptr;
+  }
+  return new StdFileStream(fp, true);
+}
+
+SeekStream* LocalFileSystem::OpenForRead(const URI& path, bool allow_null) {
+  std::FILE* fp = std::fopen(path.path.c_str(), "rb");
+  if (fp == nullptr) {
+    DCT_CHECK(allow_null) << "cannot open file " << path.path;
+    return nullptr;
+  }
+  return new StdFileStream(fp, true);
+}
+
+void FileSystem::ListDirectoryRecursive(const URI& path,
+                                        std::vector<FileInfo>* out) {
+  std::queue<URI> pending;
+  pending.push(path);
+  while (!pending.empty()) {
+    URI dir = pending.front();
+    pending.pop();
+    std::vector<FileInfo> contents;
+    ListDirectory(dir, &contents);
+    for (const FileInfo& info : contents) {
+      if (info.type == FileType::kDirectory) {
+        pending.push(info.path);
+      } else {
+        out->push_back(info);
+      }
+    }
+  }
+}
+
+namespace {
+std::map<std::string, std::function<FileSystem*(const URI&)>>* SchemeTable() {
+  static std::map<std::string, std::function<FileSystem*(const URI&)>> table;
+  return &table;
+}
+std::mutex scheme_mutex;
+}  // namespace
+
+void FileSystem::RegisterScheme(
+    const std::string& scheme, std::function<FileSystem*(const URI&)> factory) {
+  std::lock_guard<std::mutex> lock(scheme_mutex);
+  (*SchemeTable())[scheme] = std::move(factory);
+}
+
+FileSystem* FileSystem::GetInstance(const URI& uri) {
+  if (uri.scheme.empty() || uri.scheme == "file") {
+    return LocalFileSystem::GetInstance();
+  }
+  std::lock_guard<std::mutex> lock(scheme_mutex);
+  auto it = SchemeTable()->find(uri.scheme);
+  DCT_CHECK(it != SchemeTable()->end())
+      << "unknown filesystem scheme `" << uri.scheme << "://`";
+  return it->second(uri);
+}
+
+Stream* Stream::Create(const std::string& uri, const char* mode,
+                       bool allow_null) {
+  if (uri == "stdin" || uri == "stdout") {
+    return LocalFileSystem::GetInstance()->Open(URI(uri), mode, allow_null);
+  }
+  URI u(uri);
+  return FileSystem::GetInstance(u)->Open(u, mode, allow_null);
+}
+
+SeekStream* SeekStream::CreateForRead(const std::string& uri, bool allow_null) {
+  URI u(uri);
+  return FileSystem::GetInstance(u)->OpenForRead(u, allow_null);
+}
+
+}  // namespace dct

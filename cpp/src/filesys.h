@@ -1,0 +1,62 @@
+// Filesystem interface with URI-scheme dispatch.
+//
+// Counterpart of reference include/dmlc/io.h:582-631 (FileSystem) and
+// src/io.cc:30-71 (protocol dispatch singleton table). LocalFileSystem
+// mirrors src/io/local_filesys.{h,cc}: stdio-backed streams, stat/dirent
+// listing, stdin/stdout passthrough. Remote filesystems register themselves
+// into the same dispatch table (s3 in s3_filesys.cc).
+#ifndef DCT_FILESYS_H_
+#define DCT_FILESYS_H_
+
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "stream.h"
+
+namespace dct {
+
+enum class FileType { kFile, kDirectory };
+
+struct FileInfo {
+  URI path;
+  size_t size = 0;
+  FileType type = FileType::kFile;
+};
+
+class FileSystem {
+ public:
+  virtual ~FileSystem() = default;
+  virtual FileInfo GetPathInfo(const URI& path) = 0;
+  virtual void ListDirectory(const URI& path, std::vector<FileInfo>* out) = 0;
+  virtual Stream* Open(const URI& path, const char* mode,
+                       bool allow_null = false) = 0;
+  virtual SeekStream* OpenForRead(const URI& path, bool allow_null = false) = 0;
+
+  // BFS recursive listing (reference src/io/filesys.cc:9-25).
+  void ListDirectoryRecursive(const URI& path, std::vector<FileInfo>* out);
+
+  // Scheme dispatch: ""/"file" -> local, registered schemes otherwise
+  // (reference src/io.cc:30-71).
+  static FileSystem* GetInstance(const URI& uri);
+  // Register a scheme -> singleton-factory (returns borrowed pointer).
+  static void RegisterScheme(const std::string& scheme,
+                             std::function<FileSystem*(const URI&)> factory);
+};
+
+class LocalFileSystem : public FileSystem {
+ public:
+  static LocalFileSystem* GetInstance();
+  FileInfo GetPathInfo(const URI& path) override;
+  void ListDirectory(const URI& path, std::vector<FileInfo>* out) override;
+  Stream* Open(const URI& path, const char* mode,
+               bool allow_null = false) override;
+  SeekStream* OpenForRead(const URI& path, bool allow_null = false) override;
+
+ private:
+  LocalFileSystem() = default;
+};
+
+}  // namespace dct
+
+#endif  // DCT_FILESYS_H_

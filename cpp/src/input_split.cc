@@ -1,0 +1,456 @@
+// InputSplit implementation. See input_split.h for the contract; the
+// partition-edge rules mirror reference src/io/input_split_base.cc:30-64
+// (aligned tiling + same-rule record-head fixup at both edges) and the
+// chunking mirrors :221-258 (overflow carry of the partial trailing record).
+#include "input_split.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "recordio.h"
+
+namespace dct {
+
+namespace {
+
+// Match a trailing-'*' glob or exact name.
+bool GlobMatch(const std::string& pattern, const std::string& name) {
+  size_t star = pattern.find('*');
+  if (star == std::string::npos) return pattern == name;
+  // prefix*suffix
+  std::string prefix = pattern.substr(0, star);
+  std::string suffix = pattern.substr(star + 1);
+  if (name.size() < prefix.size() + suffix.size()) return false;
+  return name.compare(0, prefix.size(), prefix) == 0 &&
+         name.compare(name.size() - suffix.size(), suffix.size(), suffix) == 0;
+}
+
+std::string BaseName(const std::string& path) {
+  size_t slash = path.find_last_of('/');
+  return slash == std::string::npos ? path : path.substr(slash + 1);
+}
+
+}  // namespace
+
+// --------------------------------------------------------------------------
+ByteSplit::ByteSplit(const std::string& uri, unsigned align_bytes,
+                     bool is_text, bool recurse_directories)
+    : chunk_size_(size_t(8) << 20),
+      align_bytes_(align_bytes),
+      is_text_(is_text) {
+  // Expand ';'-separated URIs; directories list their contents; a '*' in the
+  // last path component globs within its directory
+  // (reference input_split_base.cc:96-147 InitInputFileInfo).
+  for (const std::string& piece : StrSplit(uri, ';')) {
+    if (piece.empty()) continue;
+    URI u(piece);
+    FileSystem* fs = FileSystem::GetInstance(u);
+    if (fs_ == nullptr) fs_ = fs;
+    std::string base = BaseName(u.path);
+    if (base.find('*') != std::string::npos) {
+      URI dir = u;
+      size_t slash = u.path.find_last_of('/');
+      dir.path = slash == std::string::npos ? "." : u.path.substr(0, slash);
+      std::vector<FileInfo> listing;
+      fs->ListDirectory(dir, &listing);
+      std::sort(listing.begin(), listing.end(),
+                [](const FileInfo& a, const FileInfo& b) {
+                  return a.path.path < b.path.path;
+                });
+      for (const FileInfo& info : listing) {
+        if (info.type == FileType::kFile && info.size != 0 &&
+            GlobMatch(base, BaseName(info.path.path))) {
+          files_.push_back(info);
+        }
+      }
+      continue;
+    }
+    FileInfo info = fs->GetPathInfo(u);
+    if (info.type == FileType::kDirectory) {
+      std::vector<FileInfo> listing;
+      if (recurse_directories) {
+        fs->ListDirectoryRecursive(info.path, &listing);
+      } else {
+        fs->ListDirectory(info.path, &listing);
+      }
+      std::sort(listing.begin(), listing.end(),
+                [](const FileInfo& a, const FileInfo& b) {
+                  return a.path.path < b.path.path;
+                });
+      for (const FileInfo& f : listing) {
+        std::string name = BaseName(f.path.path);
+        if (f.type == FileType::kFile && f.size != 0 && !name.empty() &&
+            name[0] != '.' && name[0] != '_') {
+          files_.push_back(f);
+        }
+      }
+    } else if (info.size != 0) {
+      files_.push_back(info);
+    }
+  }
+  DCT_CHECK(!files_.empty()) << "no non-empty input files match uri: " << uri;
+  file_start_.resize(files_.size());
+  size_t acc = 0;
+  for (size_t i = 0; i < files_.size(); ++i) {
+    file_start_[i] = acc;
+    acc += files_[i].size;
+  }
+  total_size_ = acc;
+}
+
+void ByteSplit::ResetPartition(unsigned rank, unsigned nsplit) {
+  DCT_CHECK_LT(rank, nsplit) << "part index out of range";
+  rank_ = rank;
+  nsplit_ = nsplit;
+  size_t nstep = (total_size_ + nsplit - 1) / nsplit;
+  nstep = (nstep + align_bytes_ - 1) / align_bytes_ * align_bytes_;
+  size_t raw_begin = std::min(total_size_, nstep * rank);
+  size_t raw_end = std::min(total_size_, nstep * (rank + 1));
+  begin_ = GlobalBoundaryFixup(raw_begin);
+  end_ = GlobalBoundaryFixup(raw_end);
+  BeforeFirst();
+}
+
+size_t ByteSplit::GlobalBoundaryFixup(size_t ofs) {
+  if (ofs == 0 || ofs >= total_size_) return std::min(ofs, total_size_);
+  // file containing ofs
+  size_t k =
+      std::upper_bound(file_start_.begin(), file_start_.end(), ofs) -
+      file_start_.begin() - 1;
+  if (ofs == file_start_[k]) return ofs;  // a file start is a record head
+  size_t local = ofs - file_start_[k];
+  std::unique_ptr<SeekStream> s(
+      FileSystem::GetInstance(files_[k].path)->OpenForRead(files_[k].path));
+  s->Seek(local);
+  size_t consumed = SeekRecordHead(s.get(), local, files_[k].size);
+  return std::min(file_start_[k] + local + consumed,
+                  file_start_[k] + files_[k].size);
+}
+
+void ByteSplit::BeforeFirst() {
+  // position the read cursor at begin_
+  size_t k = files_.empty()
+                 ? 0
+                 : static_cast<size_t>(
+                       std::upper_bound(file_start_.begin(), file_start_.end(),
+                                        begin_) -
+                       file_start_.begin()) -
+                       1;
+  if (begin_ >= total_size_ && !files_.empty()) k = files_.size() - 1;
+  file_idx_ = k;
+  local_pos_ = begin_ - file_start_[k];
+  cur_stream_.reset();
+  prev_byte_ = '\n';
+  pending_newline_ = false;
+  overflow_.clear();
+  chunk_.clear();
+  cursor_ = 0;
+  exhausted_ = false;
+}
+
+size_t ByteSplit::ReadSpan(char* buf, size_t want) {
+  size_t got = 0;
+  while (got < want) {
+    if (pending_newline_) {
+      buf[got++] = '\n';
+      pending_newline_ = false;
+      continue;
+    }
+    size_t global = file_start_[file_idx_] + local_pos_;
+    if (global >= end_) break;
+    if (local_pos_ >= files_[file_idx_].size) {
+      // advance to next file; inject newline between text files when the
+      // previous file did not end with one (NOEOL rule,
+      // reference input_split_base.cc:195-199, dmlc PRs 385/452)
+      cur_stream_.reset();
+      bool more = file_idx_ + 1 < files_.size() &&
+                  file_start_[file_idx_ + 1] < end_;
+      if (is_text_ && prev_byte_ != '\n' && more) pending_newline_ = true;
+      if (!more) break;
+      ++file_idx_;
+      local_pos_ = 0;
+      prev_byte_ = '\n';
+      continue;
+    }
+    if (cur_stream_ == nullptr) {
+      cur_stream_.reset(FileSystem::GetInstance(files_[file_idx_].path)
+                            ->OpenForRead(files_[file_idx_].path));
+      cur_stream_->Seek(local_pos_);
+    }
+    size_t to_read = std::min(
+        {want - got, files_[file_idx_].size - local_pos_, end_ - global});
+    size_t n = cur_stream_->Read(buf + got, to_read);
+    DCT_CHECK_GT(n, size_t(0))
+        << "file " << files_[file_idx_].path.Str()
+        << " shorter than listed size";
+    local_pos_ += n;
+    got += n;
+    prev_byte_ = buf[got - 1];
+  }
+  return got;
+}
+
+bool ByteSplit::FillChunkBuffer(std::vector<char>* buf) {
+  if (exhausted_ && overflow_.empty()) return false;
+  buf->clear();
+  buf->swap(overflow_);  // carried partial record heads the new chunk
+  size_t target = buf->size() + chunk_size_;
+  while (true) {
+    size_t old = buf->size();
+    buf->resize(target);
+    size_t n = ReadSpan(buf->data() + old, target - old);
+    buf->resize(old + n);
+    if (n < target - old) exhausted_ = true;
+    if (buf->empty()) return false;
+    if (exhausted_) {
+      // partition end is a record head: everything left is whole records
+      break;
+    }
+    size_t boundary = FindLastRecordHead(buf->data(),
+                                         buf->data() + buf->size());
+    if (boundary == 0) {
+      // no record boundary in sight: grow the chunk
+      // (reference input_split_base.cc Chunk::Append)
+      target = buf->size() + chunk_size_;
+      continue;
+    }
+    overflow_.assign(buf->begin() + boundary, buf->end());
+    buf->resize(boundary);
+    break;
+  }
+  return true;
+}
+
+bool ByteSplit::NextChunk(Blob* out) {
+  if (!FillChunkBuffer(&chunk_)) return false;
+  out->dptr = chunk_.data();
+  out->size = chunk_.size();
+  cursor_ = chunk_.size();  // chunk handed out wholesale
+  return true;
+}
+
+bool ByteSplit::NextRecord(Blob* out) {
+  while (true) {
+    if (cursor_ < chunk_.size() &&
+        ExtractRecordAt(chunk_.data(), chunk_.size(), &cursor_, out)) {
+      return true;
+    }
+    if (!FillChunkBuffer(&chunk_)) return false;
+    cursor_ = 0;
+  }
+}
+
+// --------------------------------------------------------------------------
+LineSplit::LineSplit(const std::string& uri, unsigned part, unsigned nsplit,
+                     bool recurse_directories)
+    : ByteSplit(uri, /*align_bytes=*/1, /*is_text=*/true,
+                recurse_directories) {
+  ResetPartition(part, nsplit);
+}
+
+size_t LineSplit::SeekRecordHead(SeekStream* s, size_t local_pos,
+                                 size_t file_size) {
+  // consume bytes until just past the next '\n'; EOF counts as a head
+  char buf[1024];
+  size_t consumed = 0;
+  while (true) {
+    size_t n = s->Read(buf, sizeof(buf));
+    if (n == 0) return consumed;  // NOEOL tail: boundary at file end
+    const char* nl = static_cast<const char*>(std::memchr(buf, '\n', n));
+    if (nl != nullptr) {
+      return consumed + static_cast<size_t>(nl - buf) + 1;
+    }
+    consumed += n;
+  }
+}
+
+size_t LineSplit::FindLastRecordHead(const char* begin, const char* end) {
+  for (const char* p = end; p != begin;) {
+    --p;
+    if (*p == '\n') return static_cast<size_t>(p - begin) + 1;
+  }
+  return 0;
+}
+
+bool LineSplit::ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                                Blob* out) {
+  if (*cursor >= valid) return false;
+  char* line = data + *cursor;
+  size_t remain = valid - *cursor;
+  char* nl = static_cast<char*>(std::memchr(line, '\n', remain));
+  size_t len = (nl == nullptr) ? remain : static_cast<size_t>(nl - line);
+  *cursor += len + (nl == nullptr ? 0 : 1);
+  if (len > 0 && line[len - 1] == '\r') --len;  // CRLF
+  out->dptr = line;
+  out->size = len;
+  return true;
+}
+
+// --------------------------------------------------------------------------
+RecordIOSplit::RecordIOSplit(const std::string& uri, unsigned part,
+                             unsigned nsplit, bool recurse_directories)
+    : ByteSplit(uri, /*align_bytes=*/4, /*is_text=*/false,
+                recurse_directories) {
+  ResetPartition(part, nsplit);
+}
+
+size_t RecordIOSplit::SeekRecordHead(SeekStream* s, size_t local_pos,
+                                     size_t file_size) {
+  // scan forward from the next 4-aligned offset for magic + cflag in {0,1}
+  size_t aligned = recordio::AlignUp4(local_pos);
+  if (aligned + 8 > file_size) return file_size - local_pos;
+  s->Seek(aligned);
+  std::vector<char> buf(size_t(1) << 16);
+  size_t have = 0;       // valid bytes in buf
+  size_t base = aligned;  // absolute file offset of buf[0] (4-aligned)
+  while (true) {
+    size_t n = s->Read(buf.data() + have, buf.size() - have);
+    have += n;
+    for (size_t i = 0; i + 8 <= have; i += 4) {
+      if (recordio::IsRecordHead(buf.data() + i)) {
+        return base + i - local_pos;
+      }
+    }
+    if (n == 0) return file_size - local_pos;  // no head: file end
+    // retain the unverified tail (first aligned i with i + 8 > have)
+    size_t first_unchecked = have >= 8 ? recordio::AlignUp4(have - 7) : 0;
+    size_t keep = have - first_unchecked;
+    std::memmove(buf.data(), buf.data() + first_unchecked, keep);
+    base += first_unchecked;
+    have = keep;
+  }
+}
+
+size_t RecordIOSplit::FindLastRecordHead(const char* begin, const char* end) {
+  size_t size = static_cast<size_t>(end - begin) & ~size_t(3);
+  for (size_t ofs = size >= 8 ? size - 8 : 0;; ofs -= 4) {
+    if (ofs == 0) return 0;
+    if (recordio::IsRecordHead(begin + ofs)) return ofs;
+    if (ofs < 4) return 0;
+  }
+}
+
+bool RecordIOSplit::ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                                    Blob* out) {
+  if (*cursor + 8 > valid) {
+    *cursor = valid;
+    return false;
+  }
+  assembled_.clear();
+  bool multipart = false;
+  while (true) {
+    DCT_CHECK_LE(*cursor + 8, valid) << "truncated recordio chunk";
+    uint32_t magic = recordio::LoadWordLE(data + *cursor);
+    DCT_CHECK_EQ(magic, recordio::kMagic) << "bad recordio magic in chunk";
+    uint32_t lrec = recordio::LoadWordLE(data + *cursor + 4);
+    uint32_t cflag = recordio::HeaderFlag(lrec);
+    uint32_t len = recordio::HeaderLen(lrec);
+    size_t payload = *cursor + 8;
+    DCT_CHECK_LE(payload + recordio::AlignUp4(len), valid)
+        << "recordio record overruns chunk";
+    *cursor = payload + recordio::AlignUp4(len);
+    if (cflag == 0) {
+      DCT_CHECK(!multipart) << "unexpected cflag=0 inside multi-part record";
+      out->dptr = data + payload;
+      out->size = len;
+      return true;
+    }
+    if (cflag == 1) {
+      DCT_CHECK(!multipart) << "unexpected cflag=1 inside multi-part record";
+      multipart = true;
+      assembled_.assign(data + payload, len);
+    } else {
+      DCT_CHECK(multipart) << "continuation part without a head";
+      char magic_bytes[4];
+      uint32_t m = recordio::kMagic;
+      if (!serial::NativeIsLE()) m = serial::ByteSwap(m);
+      std::memcpy(magic_bytes, &m, 4);
+      assembled_.append(magic_bytes, 4);
+      assembled_.append(data + payload, len);
+      if (cflag == 3) {
+        out->dptr = assembled_.data();
+        out->size = assembled_.size();
+        return true;
+      }
+      DCT_CHECK_EQ(cflag, 2u) << "invalid recordio cflag";
+    }
+  }
+}
+
+// --------------------------------------------------------------------------
+PrefetchSplit::PrefetchSplit(ByteSplit* base, size_t capacity)
+    : base_(base), pipe_(capacity), capacity_(capacity) {}
+
+PrefetchSplit::~PrefetchSplit() {
+  if (current_ != nullptr) pipe_.Recycle(&current_);
+  pipe_.Shutdown();
+}
+
+void PrefetchSplit::EnsureStarted() {
+  if (started_) return;
+  pipe_.Init(
+      [this](Cell** cell) {
+        if (*cell == nullptr) *cell = new Cell();
+        (*cell)->cursor = 0;
+        return base_->FillChunkBuffer(&(*cell)->data);
+      },
+      [this] { base_->BeforeFirst(); });
+  started_ = true;
+}
+
+void PrefetchSplit::BeforeFirst() {
+  if (current_ != nullptr) pipe_.Recycle(&current_);
+  if (started_) pipe_.BeforeFirst();
+}
+
+bool PrefetchSplit::NextChunk(Blob* out) {
+  EnsureStarted();
+  if (current_ != nullptr) pipe_.Recycle(&current_);
+  if (!pipe_.Next(&current_)) return false;
+  out->dptr = current_->data.data();
+  out->size = current_->data.size();
+  current_->cursor = current_->data.size();
+  return true;
+}
+
+bool PrefetchSplit::NextRecord(Blob* out) {
+  EnsureStarted();
+  while (true) {
+    if (current_ != nullptr &&
+        base_->ExtractRecordAt(current_->data.data(), current_->data.size(),
+                               &current_->cursor, out)) {
+      return true;
+    }
+    if (current_ != nullptr) pipe_.Recycle(&current_);
+    if (!pipe_.Next(&current_)) return false;
+  }
+}
+
+void PrefetchSplit::ResetPartition(unsigned rank, unsigned nsplit) {
+  if (current_ != nullptr) pipe_.Recycle(&current_);
+  pipe_.Shutdown();
+  started_ = false;
+  base_->ResetPartition(rank, nsplit);
+}
+
+InputSplit* InputSplit::Create(const std::string& uri, unsigned part,
+                               unsigned nsplit, const std::string& type,
+                               const std::string& index_uri, bool shuffle,
+                               int seed, size_t batch_size,
+                               bool recurse_directories, bool threaded,
+                               const std::string& cache_file) {
+  ByteSplit* split = nullptr;
+  if (type == "text") {
+    split = new LineSplit(uri, part, nsplit, recurse_directories);
+  } else if (type == "recordio") {
+    split = new RecordIOSplit(uri, part, nsplit, recurse_directories);
+  } else {
+    throw Error("unknown input split type: " + type);
+  }
+  if (threaded) {
+    return new PrefetchSplit(split, 2);
+  }
+  return split;
+}
+
+}  // namespace dct

@@ -1,0 +1,199 @@
+// Record-aligned distributed input splitting.
+//
+// Counterpart of reference include/dmlc/io.h:155-302 (InputSplit) and
+// src/io/input_split_base.{h,cc} / line_split / recordio_split /
+// indexed_recordio_split / threaded_input_split / cached_input_split /
+// single_file_split. The distributed-read contract (SURVEY §3.2, reference
+// input_split_base.cc:30-64): the byte space of the expanded file list is
+// tiled into num_parts aligned ranges, and both edges of each range are moved
+// forward to the next record head with the *same* rule — so every record
+// belongs to exactly one part and the union of parts covers the dataset.
+//
+// Architecture here differs from the reference: one ByteSplit base owns a
+// (file cursor, chunk buffer, overflow carry) state machine, and format
+// policy objects supply three hooks: SeekRecordHead (stream resync),
+// FindLastRecordHead (chunk-tail truncation), and record extraction.
+#ifndef DCT_INPUT_SPLIT_H_
+#define DCT_INPUT_SPLIT_H_
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "filesys.h"
+#include "pipeline.h"
+#include "stream.h"
+
+namespace dct {
+
+class InputSplit {
+ public:
+  struct Blob {
+    void* dptr = nullptr;
+    size_t size = 0;
+  };
+
+  virtual ~InputSplit() = default;
+  // restart this part from its beginning (re-shuffles shuffled variants)
+  virtual void BeforeFirst() = 0;
+  // next single record; false at end of part
+  virtual bool NextRecord(Blob* out) = 0;
+  // next raw chunk of whole records; false at end of part
+  virtual bool NextChunk(Blob* out) = 0;
+  virtual void HintChunkSize(size_t bytes) {}
+  virtual size_t GetTotalSize() = 0;
+  // re-point this object at another (rank, nsplit) partition
+  virtual void ResetPartition(unsigned rank, unsigned nsplit) = 0;
+
+  // Factory (reference src/io.cc:81-130). type is "text" | "recordio" |
+  // "indexed_recordio". uri may be ';'-separated and may name directories
+  // or trailing-'*' globs. Threaded prefetch is layered on by default;
+  // cache_file enables write-through chunk caching for later epochs.
+  static InputSplit* Create(const std::string& uri, unsigned part,
+                            unsigned nsplit, const std::string& type,
+                            const std::string& index_uri = "",
+                            bool shuffle = false, int seed = 0,
+                            size_t batch_size = 256,
+                            bool recurse_directories = false,
+                            bool threaded = true,
+                            const std::string& cache_file = "");
+};
+
+// ---------------------------------------------------------------------------
+// Base byte-range splitter over an expanded file list.
+class ByteSplit : public InputSplit {
+ public:
+  ByteSplit(const std::string& uri, unsigned align_bytes, bool is_text,
+            bool recurse_directories);
+
+  void BeforeFirst() override;
+  bool NextRecord(Blob* out) override;
+  bool NextChunk(Blob* out) override;
+  void HintChunkSize(size_t bytes) override {
+    chunk_size_ = std::max(bytes, size_t(64));
+  }
+  size_t GetTotalSize() override { return total_size_; }
+  void ResetPartition(unsigned rank, unsigned nsplit) override;
+
+ public:
+  // --- format hooks (public so PrefetchSplit can extract from its cells) ---
+  // Advance `s` (positioned inside a record) to the next record head; return
+  // bytes consumed. `file_size` is the size of the current file.
+  virtual size_t SeekRecordHead(SeekStream* s, size_t local_pos,
+                                size_t file_size) = 0;
+  // Last record-head offset in [begin, end) strictly after `begin`, given
+  // that `begin` is a record head; bytes from there on are carried to the
+  // next chunk. Return 0 when no boundary found (chunk must grow).
+  virtual size_t FindLastRecordHead(const char* begin, const char* end) = 0;
+  // Extract the next record of `data[*cursor..valid)`, advancing *cursor.
+  // Only touches extraction state (safe to call concurrently with chunk
+  // filling from another thread).
+  virtual bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                               Blob* out) = 0;
+
+  // Fill `*buf` with whole records (overflow carry preserved across calls);
+  // false at end of partition. Single-caller-at-a-time (the fill state
+  // machine lives in this object).
+  bool FillChunkBuffer(std::vector<char>* buf);
+
+ protected:
+  // chunk data for unwrapped record iteration
+  std::vector<char> chunk_;
+  size_t cursor_ = 0;  // record-extraction position in chunk_
+
+ private:
+  size_t GlobalBoundaryFixup(size_t ofs);
+  void SeekToGlobal(size_t ofs);
+  // Read up to `want` bytes from the partition byte range, crossing file
+  // boundaries, injecting '\n' between text files lacking trailing newlines
+  // (the NOEOL rule, reference input_split_base.cc:195-199). Returns bytes
+  // written into buf.
+  size_t ReadSpan(char* buf, size_t want);
+
+  FileSystem* fs_ = nullptr;
+  std::vector<FileInfo> files_;
+  std::vector<size_t> file_start_;  // cumulative start offset of each file
+  size_t total_size_ = 0;
+
+  size_t begin_ = 0, end_ = 0;  // adjusted partition range (global bytes)
+  unsigned rank_ = 0, nsplit_ = 1;
+
+  // read cursor
+  size_t file_idx_ = 0;
+  size_t local_pos_ = 0;  // position within current file
+  std::unique_ptr<SeekStream> cur_stream_;
+  char prev_byte_ = '\n';  // last byte read from current file
+  bool pending_newline_ = false;
+
+  std::vector<char> overflow_;  // partial trailing record from last chunk
+  size_t chunk_size_;
+  bool exhausted_ = false;
+
+ protected:
+  unsigned align_bytes_;
+  bool is_text_;
+};
+
+// Text records delimited by '\n' (reference src/io/line_split.cc).
+class LineSplit : public ByteSplit {
+ public:
+  LineSplit(const std::string& uri, unsigned part, unsigned nsplit,
+            bool recurse_directories = false);
+
+ public:
+  size_t SeekRecordHead(SeekStream* s, size_t local_pos,
+                        size_t file_size) override;
+  size_t FindLastRecordHead(const char* begin, const char* end) override;
+  bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                       Blob* out) override;
+};
+
+// Binary recordio records (reference src/io/recordio_split.cc): resync by
+// scanning for an aligned magic word whose following header has cflag 0|1.
+class RecordIOSplit : public ByteSplit {
+ public:
+  RecordIOSplit(const std::string& uri, unsigned part, unsigned nsplit,
+                bool recurse_directories = false);
+
+ public:
+  size_t SeekRecordHead(SeekStream* s, size_t local_pos,
+                        size_t file_size) override;
+  size_t FindLastRecordHead(const char* begin, const char* end) override;
+  bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                       Blob* out) override;
+
+ private:
+  std::string assembled_;
+};
+
+// ---------------------------------------------------------------------------
+// Background prefetch wrapper (reference src/io/threaded_input_split.h):
+// a PipelineIter of chunk cells produced by base->NextChunk.
+class PrefetchSplit : public InputSplit {
+ public:
+  explicit PrefetchSplit(ByteSplit* base, size_t capacity = 2);
+  ~PrefetchSplit() override;
+
+  void BeforeFirst() override;
+  bool NextRecord(Blob* out) override;
+  bool NextChunk(Blob* out) override;
+  void HintChunkSize(size_t bytes) override { base_->HintChunkSize(bytes); }
+  size_t GetTotalSize() override { return base_->GetTotalSize(); }
+  void ResetPartition(unsigned rank, unsigned nsplit) override;
+
+ private:
+  struct Cell {
+    std::vector<char> data;
+    size_t cursor = 0;
+  };
+  std::unique_ptr<ByteSplit> base_;
+  PipelineIter<Cell> pipe_;
+  Cell* current_ = nullptr;
+  bool started_ = false;
+  size_t capacity_;
+  void EnsureStarted();
+};
+
+}  // namespace dct
+
+#endif  // DCT_INPUT_SPLIT_H_

@@ -1,0 +1,95 @@
+// Locale-free numeric parsing for the text parsers.
+//
+// Counterpart of reference include/dmlc/strtonum.h (737 L of hand-rolled
+// float parsing + ParsePair/ParseTriple). We instead build on C++17
+// std::from_chars — locale-free, bounds-checked (no NUL terminator needed,
+// unlike the strtof calls in reference csv_parser.h:100), and fast in
+// libstdc++ — and add the pair/triple helpers the parsers consume
+// (reference strtonum.h ParsePair semantics: returns how many of the
+// ':'-separated components were parsed).
+#ifndef DCT_NUMPARSE_H_
+#define DCT_NUMPARSE_H_
+
+#include <charconv>
+#include <cstdint>
+
+#include "base.h"
+
+namespace dct {
+
+inline bool IsBlankChar(char c) { return c == ' ' || c == '\t'; }
+inline bool IsDigitChar(char c) { return c >= '0' && c <= '9'; }
+
+// Parse one value of T from [p, end); advance *out past it.
+// Returns false (leaving *out == p) when no number starts at p.
+// Accepts an optional leading '+' (from_chars itself does not).
+template <typename T>
+inline bool ParseNum(const char* p, const char* end, const char** out, T* v) {
+  const char* q = p;
+  if (q != end && *q == '+') ++q;
+  std::from_chars_result r;
+  if constexpr (std::is_floating_point_v<T>) {
+    r = std::from_chars(q, end, *v, std::chars_format::general);
+  } else {
+    r = std::from_chars(q, end, *v);
+  }
+  if (r.ec != std::errc() || r.ptr == q) {
+    *out = p;
+    return false;
+  }
+  *out = r.ptr;
+  return true;
+}
+
+// Parse "a[:b]" starting at p (leading blanks skipped).
+// Returns 0 when the region is empty/blank, 1 when only `a` parsed,
+// 2 when "a:b" parsed. *out advances past what was consumed; on return 0 it
+// points at end (the reference ParsePair contract the libsvm parser relies
+// on, libsvm_parser.h:135-143).
+template <typename TA, typename TB>
+inline int ParsePair(const char* p, const char* end, const char** out,
+                     TA* a, TB* b) {
+  while (p != end && IsBlankChar(*p)) ++p;
+  if (p == end) {
+    *out = end;
+    return 0;
+  }
+  const char* q;
+  if (!ParseNum(p, end, &q, a)) {
+    *out = end;
+    return 0;
+  }
+  if (q == end || *q != ':') {
+    *out = q;
+    return 1;
+  }
+  const char* r;
+  if (!ParseNum(q + 1, end, &r, b)) {
+    *out = q;
+    return 1;
+  }
+  *out = r;
+  return 2;
+}
+
+// Parse "a:b:c" (libfm triples). Returns number of components parsed (0-3).
+template <typename TA, typename TB, typename TC>
+inline int ParseTriple(const char* p, const char* end, const char** out,
+                       TA* a, TB* b, TC* c) {
+  TA ta;
+  TB tb;
+  int n = ParsePair<TA, TB>(p, end, out, &ta, &tb);
+  if (n >= 1) *a = ta;
+  if (n >= 2) *b = tb;
+  if (n < 2) return n;
+  const char* q = *out;
+  if (q == end || *q != ':') return 2;
+  const char* r;
+  if (!ParseNum(q + 1, end, &r, c)) return 2;
+  *out = r;
+  return 3;
+}
+
+}  // namespace dct
+
+#endif  // DCT_NUMPARSE_H_

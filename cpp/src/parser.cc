@@ -1,0 +1,430 @@
+// Parser implementations. Parse-rule provenance is cited per function; the
+// threading/fan-out structure is original (see parser.h).
+#include "parser.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <exception>
+#include <limits>
+#include <thread>
+
+#include "numparse.h"
+
+namespace dct {
+
+namespace {
+
+// Skip blanks; a '#' means the rest of the line is a comment
+// (reference libsvm_parser.h IgnoreCommentAndBlank).
+inline const char* SkipBlankOrComment(const char* p, const char* end) {
+  while (p != end && IsBlankChar(*p)) ++p;
+  if (p != end && *p == '#') return end;
+  return p;
+}
+
+// Advance past one line; *line_end receives the end of the current line
+// (excluding terminators); returns the start of the next line.
+inline const char* LineSpan(const char* p, const char* end,
+                            const char** line_end) {
+  const char* q = p;
+  while (q != end && *q != '\n' && *q != '\r') ++q;
+  *line_end = q;
+  while (q != end && (*q == '\n' || *q == '\r')) ++q;
+  return q;
+}
+
+inline const char* SkipUTF8BOM(const char* p, const char* end) {
+  if (end - p >= 3 && static_cast<unsigned char>(p[0]) == 0xEF &&
+      static_cast<unsigned char>(p[1]) == 0xBB &&
+      static_cast<unsigned char>(p[2]) == 0xBF) {
+    return p + 3;
+  }
+  return p;
+}
+
+int DefaultThreads(int requested) {
+  // reference text_parser.h:28: nthread = min(arg, max(nprocs/2 - 4, 1))
+  unsigned hw = std::thread::hardware_concurrency();
+  int cap = std::max(static_cast<int>(hw / 2) - 4, 1);
+  if (requested <= 0) return cap;
+  return std::min(requested, cap);
+}
+
+std::string GetArg(const std::map<std::string, std::string>& args,
+                   const std::string& key, const std::string& dflt) {
+  auto it = args.find(key);
+  return it == args.end() ? dflt : it->second;
+}
+
+}  // namespace
+
+// --------------------------------------------------------------------------
+template <typename IndexType>
+TextParserBase<IndexType>::TextParserBase(InputSplit* source, int nthread)
+    : source_(source), nthread_(DefaultThreads(nthread)) {}
+
+template <typename IndexType>
+void TextParserBase<IndexType>::BeforeFirst() {
+  source_->BeforeFirst();
+  blocks_.clear();
+  block_idx_ = block_count_ = 0;
+}
+
+template <typename IndexType>
+bool TextParserBase<IndexType>::FillBlocks(
+    std::vector<RowBlockContainer<IndexType>>* blocks) {
+  InputSplit::Blob chunk;
+  if (!source_->NextChunk(&chunk)) return false;
+  bytes_read_ += chunk.size;
+  const char* begin = static_cast<const char*>(chunk.dptr);
+  const char* end = begin + chunk.size;
+  int nworker = nthread_;
+  if (chunk.size < (size_t(1) << 16)) nworker = 1;  // small chunk: no fan-out
+  blocks->resize(nworker);
+  if (nworker == 1) {
+    ParseBlock(begin, end, &(*blocks)[0]);
+    (*blocks)[0].UpdateMax();
+    return true;
+  }
+  // Tile the chunk into line-aligned slices: cut i starts at the first line
+  // head at/after i*size/n (reference text_parser.h BackFindEndLine tiles
+  // backward; forward tiling yields the same exact cover).
+  std::vector<const char*> cuts(nworker + 1);
+  cuts[0] = begin;
+  cuts[nworker] = end;
+  for (int i = 1; i < nworker; ++i) {
+    const char* raw = begin + chunk.size * i / nworker;
+    const char* nl =
+        static_cast<const char*>(memchr(raw, '\n', end - raw));
+    cuts[i] = nl == nullptr ? end : nl + 1;
+  }
+  for (int i = 1; i < nworker; ++i) {
+    if (cuts[i] < cuts[i - 1]) cuts[i] = cuts[i - 1];
+  }
+  std::vector<std::thread> workers;
+  std::vector<std::exception_ptr> errors(nworker);
+  for (int i = 0; i < nworker; ++i) {
+    workers.emplace_back([this, &cuts, blocks, &errors, i] {
+      try {
+        this->ParseBlock(cuts[i], cuts[i + 1], &(*blocks)[i]);
+        (*blocks)[i].UpdateMax();
+      } catch (...) {
+        errors[i] = std::current_exception();
+      }
+    });
+  }
+  for (auto& t : workers) t.join();
+  for (auto& e : errors) {
+    if (e != nullptr) std::rethrow_exception(e);  // reference OMPException
+  }
+  return true;
+}
+
+template <typename IndexType>
+const RowBlockContainer<IndexType>* TextParserBase<IndexType>::NextBlock() {
+  while (true) {
+    while (block_idx_ < block_count_) {
+      const RowBlockContainer<IndexType>* b = &blocks_[block_idx_++];
+      if (b->Size() != 0) return b;
+    }
+    if (!FillBlocks(&blocks_)) return nullptr;
+    block_count_ = blocks_.size();
+    block_idx_ = 0;
+  }
+}
+
+// --------------------------------------------------------------------------
+template <typename IndexType>
+LibSVMParser<IndexType>::LibSVMParser(
+    InputSplit* source, const std::map<std::string, std::string>& args,
+    int nthread)
+    : TextParserBase<IndexType>(source, nthread) {
+  std::string fmt = GetArg(args, "format", "libsvm");
+  DCT_CHECK_EQ(fmt, std::string("libsvm")) << "format mismatch";
+  indexing_mode_ = std::stoi(GetArg(args, "indexing_mode", "0"));
+}
+
+// reference src/data/libsvm_parser.h:87-169
+template <typename IndexType>
+void LibSVMParser<IndexType>::ParseBlock(const char* begin, const char* end,
+                                         RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  IndexType min_feat = std::numeric_limits<IndexType>::max();
+  const char* p = SkipUTF8BOM(begin, end);
+  while (p != end) {
+    const char* line_end;
+    const char* next = LineSpan(p, end, &line_end);
+    const char* cur = SkipBlankOrComment(p, line_end);
+    p = next;
+    // label[:weight]
+    float label, weight;
+    const char* after;
+    int r = ParsePair<float, float>(cur, line_end, &after, &label, &weight);
+    if (r < 1) continue;  // blank or comment-only line
+    if (r == 2) out->weight.push_back(weight);
+    out->label.push_back(label);
+    cur = after;
+    // optional qid:n
+    while (cur != line_end && *cur == ' ') ++cur;
+    if (line_end - cur > 4 && std::memcmp(cur, "qid:", 4) == 0) {
+      uint64_t qid = 0;
+      const char* qp;
+      if (ParseNum<uint64_t>(cur + 4, line_end, &qp, &qid)) {
+        out->qid.push_back(qid);
+        cur = qp;
+      }
+    }
+    // index[:value] tokens
+    while (cur != line_end) {
+      cur = SkipBlankOrComment(cur, line_end);
+      IndexType idx;
+      float value;
+      int rr =
+          ParsePair<IndexType, float>(cur, line_end, &after, &idx, &value);
+      cur = after;
+      if (rr < 1) continue;
+      out->index.push_back(idx);
+      min_feat = std::min(min_feat, idx);
+      if (rr == 2) out->value.push_back(value);
+    }
+    out->offset.push_back(out->index.size());
+  }
+  DCT_CHECK_EQ(out->label.size() + 1, out->offset.size());
+  // 0/1-based indexing heuristic (sklearn-compatible,
+  // reference libsvm_parser.h:155-168): >0 forces 1-based, <0 auto-detects
+  if (indexing_mode_ > 0 ||
+      (indexing_mode_ < 0 && !out->index.empty() && min_feat > 0)) {
+    for (IndexType& e : out->index) --e;
+  }
+}
+
+// --------------------------------------------------------------------------
+template <typename IndexType>
+CSVParser<IndexType>::CSVParser(InputSplit* source,
+                                const std::map<std::string, std::string>& args,
+                                int nthread)
+    : TextParserBase<IndexType>(source, nthread) {
+  std::string fmt = GetArg(args, "format", "csv");
+  DCT_CHECK_EQ(fmt, std::string("csv")) << "format mismatch";
+  label_column_ = std::stoi(GetArg(args, "label_column", "-1"));
+  weight_column_ = std::stoi(GetArg(args, "weight_column", "-1"));
+  std::string delim = GetArg(args, "delimiter", ",");
+  DCT_CHECK_EQ(delim.size(), size_t(1)) << "delimiter must be a single char";
+  delimiter_ = delim[0];
+  DCT_CHECK(label_column_ != weight_column_ || label_column_ < 0)
+      << "label and weight columns must differ";
+  std::string dtype = GetArg(args, "dtype", "float32");
+  DCT_CHECK_EQ(dtype, std::string("float32"))
+      << "only float32 csv values supported for now";
+}
+
+// reference src/data/csv_parser.h:76-147
+template <typename IndexType>
+void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
+                                      RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  const char* p = SkipUTF8BOM(begin, end);
+  while (p != end) {
+    const char* line_end;
+    const char* next = LineSpan(p, end, &line_end);
+    const char* cur = SkipUTF8BOM(p, line_end);
+    p = next;
+    if (cur == line_end) continue;  // empty line
+    int column = 0;
+    IndexType idx = 0;
+    float label = 0.0f;
+    float weight = std::numeric_limits<float>::quiet_NaN();
+    bool any_delim = false;
+    while (cur <= line_end) {
+      // cell = [cur, cell_end)
+      const char* cell_end = cur;
+      while (cell_end != line_end && *cell_end != delimiter_) ++cell_end;
+      const char* vp = cur;
+      while (vp != cell_end && IsBlankChar(*vp)) ++vp;
+      float v;
+      const char* after;
+      bool parsed = ParseNum<float>(vp, cell_end, &after, &v);
+      if (column == label_column_) {
+        if (parsed) label = v;
+      } else if (column == weight_column_) {
+        if (parsed) weight = v;
+      } else if (parsed) {
+        out->value.push_back(v);
+        out->index.push_back(idx++);
+      } else {
+        ++idx;  // missing value: skip but keep the column index
+      }
+      ++column;
+      if (cell_end == line_end) break;
+      any_delim = true;
+      cur = cell_end + 1;
+    }
+    DCT_CHECK(any_delim || column <= 1 || idx > 0)
+        << "delimiter '" << delimiter_ << "' not found in csv line";
+    out->label.push_back(label);
+    if (!std::isnan(weight)) out->weight.push_back(weight);
+    out->offset.push_back(out->index.size());
+  }
+  DCT_CHECK_EQ(out->label.size() + 1, out->offset.size());
+  DCT_CHECK(out->weight.empty() || out->weight.size() == out->label.size())
+      << "weight_column missing on some csv rows";
+}
+
+// --------------------------------------------------------------------------
+template <typename IndexType>
+LibFMParser<IndexType>::LibFMParser(
+    InputSplit* source, const std::map<std::string, std::string>& args,
+    int nthread)
+    : TextParserBase<IndexType>(source, nthread) {
+  std::string fmt = GetArg(args, "format", "libfm");
+  DCT_CHECK_EQ(fmt, std::string("libfm")) << "format mismatch";
+  indexing_mode_ = std::stoi(GetArg(args, "indexing_mode", "0"));
+}
+
+// reference src/data/libfm_parser.h:67-144
+template <typename IndexType>
+void LibFMParser<IndexType>::ParseBlock(const char* begin, const char* end,
+                                        RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  uint32_t min_field = std::numeric_limits<uint32_t>::max();
+  IndexType min_feat = std::numeric_limits<IndexType>::max();
+  const char* p = SkipUTF8BOM(begin, end);
+  while (p != end) {
+    const char* line_end;
+    const char* next = LineSpan(p, end, &line_end);
+    const char* cur = SkipBlankOrComment(p, line_end);
+    p = next;
+    float label, weight;
+    const char* after;
+    int r = ParsePair<float, float>(cur, line_end, &after, &label, &weight);
+    if (r < 1) continue;
+    if (r == 2) out->weight.push_back(weight);
+    out->label.push_back(label);
+    cur = after;
+    while (cur != line_end) {
+      cur = SkipBlankOrComment(cur, line_end);
+      uint32_t field;
+      IndexType feat;
+      float value;
+      int rr = ParseTriple<uint32_t, IndexType, float>(cur, line_end, &after,
+                                                       &field, &feat, &value);
+      cur = after;
+      if (rr <= 1) continue;
+      out->field.push_back(field);
+      out->index.push_back(feat);
+      min_field = std::min(min_field, field);
+      min_feat = std::min(min_feat, feat);
+      if (rr == 3) out->value.push_back(value);
+    }
+    out->offset.push_back(out->index.size());
+  }
+  DCT_CHECK_EQ(out->field.size(), out->index.size());
+  DCT_CHECK_EQ(out->label.size() + 1, out->offset.size());
+  // 1-based detection requires BOTH field and feature ids to exceed 0
+  // (reference libfm_parser.h:130-143)
+  if (indexing_mode_ > 0 ||
+      (indexing_mode_ < 0 && !out->index.empty() && min_feat > 0 &&
+       !out->field.empty() && min_field > 0)) {
+    for (IndexType& e : out->index) --e;
+    for (uint32_t& e : out->field) --e;
+  }
+}
+
+// --------------------------------------------------------------------------
+template <typename IndexType>
+ThreadedParser<IndexType>::ThreadedParser(TextParserBase<IndexType>* base,
+                                          size_t capacity)
+    : base_(base), pipe_(capacity) {}
+
+template <typename IndexType>
+ThreadedParser<IndexType>::~ThreadedParser() {
+  if (current_ != nullptr) pipe_.Recycle(&current_);
+  pipe_.Shutdown();
+}
+
+template <typename IndexType>
+void ThreadedParser<IndexType>::EnsureStarted() {
+  if (started_) return;
+  pipe_.Init(
+      [this](Cell** cell) {
+        if (*cell == nullptr) *cell = new Cell();
+        (*cell)->next = 0;
+        return base_->FillBlocks(&(*cell)->blocks);
+      },
+      [this] { base_->BeforeFirst(); });
+  started_ = true;
+}
+
+template <typename IndexType>
+void ThreadedParser<IndexType>::BeforeFirst() {
+  if (current_ != nullptr) pipe_.Recycle(&current_);
+  if (started_) pipe_.BeforeFirst();
+}
+
+template <typename IndexType>
+const RowBlockContainer<IndexType>* ThreadedParser<IndexType>::NextBlock() {
+  EnsureStarted();
+  while (true) {
+    if (current_ != nullptr) {
+      while (current_->next < current_->blocks.size()) {
+        const RowBlockContainer<IndexType>* b =
+            &current_->blocks[current_->next++];
+        if (b->Size() != 0) return b;
+      }
+      pipe_.Recycle(&current_);
+    }
+    if (!pipe_.Next(&current_)) return nullptr;
+  }
+}
+
+// --------------------------------------------------------------------------
+template <typename IndexType>
+Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
+                                             unsigned part, unsigned npart,
+                                             const std::string& format,
+                                             int nthread, bool threaded) {
+  URISpec spec(uri, part, npart);
+  std::string fmt = format;
+  if (fmt == "auto" || fmt.empty()) {
+    auto it = spec.args.find("format");
+    fmt = it == spec.args.end() ? "libsvm" : it->second;
+  }
+  std::map<std::string, std::string> args = spec.args;
+  args["format"] = fmt;
+  InputSplit* split = InputSplit::Create(spec.uri, part, npart, "text", "",
+                                         false, 0, 256, false,
+                                         /*threaded=*/true, spec.cache_file);
+  TextParserBase<IndexType>* parser;
+  if (fmt == "libsvm") {
+    parser = new LibSVMParser<IndexType>(split, args, nthread);
+  } else if (fmt == "csv") {
+    parser = new CSVParser<IndexType>(split, args, nthread);
+  } else if (fmt == "libfm") {
+    parser = new LibFMParser<IndexType>(split, args, nthread);
+  } else {
+    delete split;
+    throw Error("unknown data format: " + fmt);
+  }
+  if (threaded) {
+    return new ThreadedParser<IndexType>(parser, 8);
+  }
+  return parser;
+}
+
+// explicit instantiations (reference data.cc:224-256 registers
+// {uint32, uint64} index types)
+template class TextParserBase<uint32_t>;
+template class TextParserBase<uint64_t>;
+template class LibSVMParser<uint32_t>;
+template class LibSVMParser<uint64_t>;
+template class CSVParser<uint32_t>;
+template class CSVParser<uint64_t>;
+template class LibFMParser<uint32_t>;
+template class LibFMParser<uint64_t>;
+template class ThreadedParser<uint32_t>;
+template class ThreadedParser<uint64_t>;
+template class Parser<uint32_t>;
+template class Parser<uint64_t>;
+
+}  // namespace dct

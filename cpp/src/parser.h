@@ -1,0 +1,145 @@
+// Multithreaded text parsers producing CSR row blocks.
+//
+// Counterpart of reference src/data/parser.h (ParserImpl + ThreadedParser),
+// src/data/text_parser.h (chunk → N worker threads, each parsing a
+// line-aligned slice), and the format parsers libsvm_parser.h /
+// csv_parser.h / libfm_parser.h. Parse semantics (comment/blank skipping,
+// label[:weight], qid:, 0/1-based indexing heuristic, CSV missing values,
+// NOEOL/BOM/CRLF handling) match the reference; the worker fan-out is
+// restructured: slices are tiled forward to line heads and each worker fills
+// its own RowBlockContainer which is exposed zero-copy through the C ABI.
+#ifndef DCT_PARSER_H_
+#define DCT_PARSER_H_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "input_split.h"
+#include "rowblock.h"
+
+namespace dct {
+
+template <typename IndexType>
+class Parser {
+ public:
+  virtual ~Parser() = default;
+  virtual void BeforeFirst() = 0;
+  // Produce the next block of rows; nullptr at end of data. The returned
+  // container stays valid until the next call.
+  virtual const RowBlockContainer<IndexType>* NextBlock() = 0;
+  virtual size_t BytesRead() const = 0;
+
+  // Factory (reference src/data.cc:62-85 CreateParser_): format is
+  // "libsvm" | "csv" | "libfm" | "auto" (resolved from ?format= URI arg).
+  // `threaded` pipelines parsing against consumption (ThreadedParser).
+  static Parser* Create(const std::string& uri, unsigned part, unsigned npart,
+                        const std::string& format, int nthread = 0,
+                        bool threaded = true);
+};
+
+// --------------------------------------------------------------------------
+// Chunk-parallel text parser base.
+template <typename IndexType>
+class TextParserBase : public Parser<IndexType> {
+ public:
+  TextParserBase(InputSplit* source, int nthread);
+  ~TextParserBase() override = default;
+
+  void BeforeFirst() override;
+  const RowBlockContainer<IndexType>* NextBlock() override;
+  size_t BytesRead() const override { return bytes_read_; }
+
+  // Parse [begin, end) — whole lines — into *out. Public for testing.
+  virtual void ParseBlock(const char* begin, const char* end,
+                          RowBlockContainer<IndexType>* out) = 0;
+
+  // Fill `blocks` (resized to the worker count) from the next chunk;
+  // returns false at end of data. Used by the ThreadedParser producer.
+  bool FillBlocks(std::vector<RowBlockContainer<IndexType>>* blocks);
+
+ protected:
+  std::unique_ptr<InputSplit> source_;
+  int nthread_;
+  size_t bytes_read_ = 0;
+
+ private:
+  std::vector<RowBlockContainer<IndexType>> blocks_;
+  size_t block_idx_ = 0;
+  size_t block_count_ = 0;
+};
+
+// libsvm: `label[:weight] [qid:n] index[:value]...`, '#' comments
+// (reference src/data/libsvm_parser.h:87-169).
+template <typename IndexType>
+class LibSVMParser : public TextParserBase<IndexType> {
+ public:
+  LibSVMParser(InputSplit* source,
+               const std::map<std::string, std::string>& args, int nthread);
+  void ParseBlock(const char* begin, const char* end,
+                  RowBlockContainer<IndexType>* out) override;
+
+ private:
+  int indexing_mode_;  // >0: 1-based, 0: 0-based, <0: heuristic
+};
+
+// csv: dense rows, explicit column index per value, label/weight columns,
+// single-char delimiter, missing values skipped
+// (reference src/data/csv_parser.h:24-147).
+template <typename IndexType>
+class CSVParser : public TextParserBase<IndexType> {
+ public:
+  CSVParser(InputSplit* source, const std::map<std::string, std::string>& args,
+            int nthread);
+  void ParseBlock(const char* begin, const char* end,
+                  RowBlockContainer<IndexType>* out) override;
+
+ private:
+  int label_column_ = -1;
+  int weight_column_ = -1;
+  char delimiter_ = ',';
+};
+
+// libfm: `label[:weight] field:feature:value...`
+// (reference src/data/libfm_parser.h:24-144).
+template <typename IndexType>
+class LibFMParser : public TextParserBase<IndexType> {
+ public:
+  LibFMParser(InputSplit* source,
+              const std::map<std::string, std::string>& args, int nthread);
+  void ParseBlock(const char* begin, const char* end,
+                  RowBlockContainer<IndexType>* out) override;
+
+ private:
+  int indexing_mode_;
+};
+
+// --------------------------------------------------------------------------
+// Pipelined wrapper: parsing runs on a producer thread while the consumer
+// drains blocks (reference src/data/parser.h:70-126, capacity 8).
+template <typename IndexType>
+class ThreadedParser : public Parser<IndexType> {
+ public:
+  explicit ThreadedParser(TextParserBase<IndexType>* base, size_t capacity = 8);
+  ~ThreadedParser() override;
+
+  void BeforeFirst() override;
+  const RowBlockContainer<IndexType>* NextBlock() override;
+  size_t BytesRead() const override { return base_->BytesRead(); }
+
+ private:
+  struct Cell {
+    std::vector<RowBlockContainer<IndexType>> blocks;
+    size_t next = 0;
+  };
+  std::unique_ptr<TextParserBase<IndexType>> base_;
+  PipelineIter<Cell> pipe_;
+  Cell* current_ = nullptr;
+  bool started_ = false;
+  void EnsureStarted();
+};
+
+}  // namespace dct
+
+#endif  // DCT_PARSER_H_

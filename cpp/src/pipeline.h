@@ -1,0 +1,187 @@
+// Producer-thread pipeline with cell recycling.
+//
+// Counterpart of reference include/dmlc/threadediter.h:77-279: a single
+// producer thread fills a bounded queue of heap cells, the consumer takes
+// them with Next() and hands exhausted cells back with Recycle() so buffers
+// are reused (backpressure = capacity); producer-side exceptions are captured
+// and rethrown at the consumer (threadediter.h state machine :336-437).
+// Redesigned around std::function tasks + two cell lists guarded by one
+// mutex; semantics (including BeforeFirst restart) preserved.
+#ifndef DCT_PIPELINE_H_
+#define DCT_PIPELINE_H_
+
+#include <condition_variable>
+#include <deque>
+#include <exception>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "base.h"
+
+namespace dct {
+
+template <typename T>
+class PipelineIter {
+ public:
+  // next_fn fills the cell (allocating if *cell == nullptr); returns false at
+  // end of stream. reset_fn rewinds the underlying source for BeforeFirst.
+  using NextFn = std::function<bool(T** cell)>;
+  using ResetFn = std::function<void()>;
+
+  explicit PipelineIter(size_t capacity = 4) : capacity_(capacity) {}
+
+  ~PipelineIter() { Shutdown(); }
+
+  void Init(NextFn next_fn, ResetFn reset_fn = nullptr) {
+    next_fn_ = std::move(next_fn);
+    reset_fn_ = std::move(reset_fn);
+    worker_ = std::thread([this] { this->ProducerLoop(); });
+    started_ = true;
+  }
+
+  // Take the next ready cell; false at end of stream. Rethrows producer
+  // exceptions.
+  bool Next(T** out) {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_consumer_.wait(lock, [this] {
+      return !ready_.empty() || produced_all_ || error_ != nullptr;
+    });
+    RethrowIfError();
+    if (ready_.empty()) return false;
+    *out = ready_.front();
+    ready_.pop_front();
+    cv_producer_.notify_one();
+    return true;
+  }
+
+  // Hand a consumed cell back for reuse; sets *cell to nullptr.
+  void Recycle(T** cell) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      free_.push_back(*cell);
+      *cell = nullptr;
+    }
+    cv_producer_.notify_one();
+  }
+
+  // Restart iteration from the beginning (requires reset_fn).
+  void BeforeFirst() {
+    std::unique_lock<std::mutex> lock(mu_);
+    DCT_CHECK(reset_fn_ != nullptr) << "PipelineIter: no reset function";
+    reset_request_ = true;
+    cv_producer_.notify_one();
+    cv_consumer_.wait(lock,
+                      [this] { return !reset_request_ || error_ != nullptr; });
+    RethrowIfError();
+  }
+
+  void Shutdown() {
+    if (!started_) return;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      shutdown_ = true;
+    }
+    cv_producer_.notify_all();
+    if (worker_.joinable()) worker_.join();
+    started_ = false;
+    std::lock_guard<std::mutex> lock(mu_);
+    for (T* c : ready_) delete c;
+    for (T* c : free_) delete c;
+    ready_.clear();
+    free_.clear();
+    // leave the object reusable: Init() may be called again
+    total_cells_ = 0;
+    produced_all_ = false;
+    reset_request_ = false;
+    shutdown_ = false;
+    error_ = nullptr;
+  }
+
+ private:
+  void RethrowIfError() {
+    if (error_ != nullptr) {
+      std::exception_ptr e = error_;
+      error_ = nullptr;
+      shutdown_ = true;
+      std::rethrow_exception(e);
+    }
+  }
+
+  void ProducerLoop() {
+    try {
+      while (true) {
+        T* cell = nullptr;
+        {
+          std::unique_lock<std::mutex> lock(mu_);
+          cv_producer_.wait(lock, [this] {
+            return shutdown_ || reset_request_ ||
+                   (!produced_all_ && ready_.size() < capacity_ &&
+                    (!free_.empty() || total_cells_ < capacity_));
+          });
+          if (shutdown_) return;
+          if (reset_request_) {
+            // drop queued output, rewind source, resume producing
+            for (T* c : ready_) free_.push_back(c);
+            ready_.clear();
+            produced_all_ = false;
+            reset_fn_();
+            reset_request_ = false;
+            cv_consumer_.notify_all();
+            continue;
+          }
+          if (!free_.empty()) {
+            cell = free_.back();
+            free_.pop_back();
+          } else {
+            ++total_cells_;  // next_fn allocates into the null cell
+          }
+        }
+        bool more = next_fn_(&cell);
+        {
+          std::lock_guard<std::mutex> lock(mu_);
+          if (more) {
+            ready_.push_back(cell);
+          } else {
+            if (cell != nullptr) free_.push_back(cell);
+            produced_all_ = true;
+          }
+        }
+        cv_consumer_.notify_one();
+        if (!more) {
+          // wait for reset or shutdown
+          std::unique_lock<std::mutex> lock(mu_);
+          cv_producer_.wait(lock,
+                            [this] { return shutdown_ || reset_request_; });
+          if (shutdown_) return;
+        }
+      }
+    } catch (...) {
+      std::lock_guard<std::mutex> lock(mu_);
+      error_ = std::current_exception();
+      cv_consumer_.notify_all();
+    }
+  }
+
+  size_t capacity_;
+  NextFn next_fn_;
+  ResetFn reset_fn_;
+  std::thread worker_;
+  bool started_ = false;
+
+  std::mutex mu_;
+  std::condition_variable cv_producer_, cv_consumer_;
+  std::deque<T*> ready_;
+  std::vector<T*> free_;
+  size_t total_cells_ = 0;
+  bool produced_all_ = false;
+  bool reset_request_ = false;
+  bool shutdown_ = false;
+  std::exception_ptr error_ = nullptr;
+};
+
+}  // namespace dct
+
+#endif  // DCT_PIPELINE_H_

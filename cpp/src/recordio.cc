@@ -1,0 +1,169 @@
+// RecordIO implementation. Format spec: see recordio.h (byte-compatible with
+// reference include/dmlc/recordio.h; implementation is original).
+#include "recordio.h"
+
+#include <algorithm>
+#include <vector>
+
+namespace dct {
+
+using recordio::AlignUp4;
+using recordio::EncodeHeader;
+using recordio::HeaderFlag;
+using recordio::HeaderLen;
+using recordio::IsRecordHead;
+using recordio::kMagic;
+using recordio::LoadWordLE;
+
+namespace {
+
+inline void WriteWordLE(Stream* s, uint32_t w) {
+  if (!serial::NativeIsLE()) w = serial::ByteSwap(w);
+  s->Write(&w, 4);
+}
+
+// Find the next 4-aligned offset in [from, len) where the payload contains
+// the magic pattern; len is truncated to aligned length. Returns len if none.
+inline size_t NextEmbeddedMagic(const char* data, size_t from, size_t len) {
+  char magic_bytes[4];
+  uint32_t m = kMagic;
+  if (!serial::NativeIsLE()) m = serial::ByteSwap(m);
+  std::memcpy(magic_bytes, &m, 4);
+  size_t aligned_len = len & ~size_t(3);
+  for (size_t i = from; i + 4 <= aligned_len; i += 4) {
+    if (std::memcmp(data + i, magic_bytes, 4) == 0) return i;
+  }
+  return len;
+}
+
+}  // namespace
+
+void RecordIOWriter::WriteRecord(const void* buf, size_t size) {
+  DCT_CHECK_LT(size, size_t(1) << 29) << "RecordIO record must be < 2^29 B";
+  const char* data = static_cast<const char*>(buf);
+  // Split payload at embedded aligned magics. Each split elides the magic
+  // itself (readers re-insert it between parts).
+  size_t part_begin = 0;
+  bool is_first = true;
+  while (true) {
+    size_t cut = NextEmbeddedMagic(data, part_begin, size);
+    bool is_last = (cut == size);
+    uint32_t part_len = static_cast<uint32_t>(cut - part_begin);
+    uint32_t cflag;
+    if (is_first && is_last) {
+      cflag = 0;
+    } else if (is_first) {
+      cflag = 1;
+    } else if (is_last) {
+      cflag = 3;
+    } else {
+      cflag = 2;
+    }
+    WriteWordLE(stream_, kMagic);
+    WriteWordLE(stream_, EncodeHeader(cflag, part_len));
+    if (part_len != 0) stream_->Write(data + part_begin, part_len);
+    if (is_last) {
+      size_t pad = AlignUp4(part_len) - part_len;
+      if (pad != 0) {
+        const char zeros[4] = {0, 0, 0, 0};
+        stream_->Write(zeros, pad);
+      }
+      break;
+    }
+    ++escape_count_;
+    part_begin = cut + 4;  // skip the elided magic
+    is_first = false;
+  }
+}
+
+bool RecordIOReader::NextRecord(std::string* out) {
+  if (eof_) return false;
+  out->clear();
+  while (true) {
+    char header[8];
+    size_t n = stream_->Read(header, 8);
+    if (n == 0 && out->empty()) {
+      eof_ = true;
+      return false;
+    }
+    DCT_CHECK_EQ(n, size_t(8)) << "truncated recordio header";
+    DCT_CHECK_EQ(LoadWordLE(header), kMagic) << "bad recordio magic";
+    uint32_t lrec = LoadWordLE(header + 4);
+    uint32_t cflag = HeaderFlag(lrec);
+    uint32_t len = HeaderLen(lrec);
+    size_t padded = AlignUp4(len);
+    size_t old = out->size();
+    out->resize(old + padded);
+    if (padded != 0) {
+      stream_->ReadExact(&(*out)[old], padded);
+    }
+    out->resize(old + len);  // drop pad
+    if (cflag == 0 || cflag == 3) return true;
+    // re-insert the elided magic between parts
+    char magic_bytes[4];
+    uint32_t m = kMagic;
+    if (!serial::NativeIsLE()) m = serial::ByteSwap(m);
+    std::memcpy(magic_bytes, &m, 4);
+    out->append(magic_bytes, 4);
+  }
+}
+
+const char* FindRecordHead(const char* base, const char* begin,
+                           const char* end) {
+  // scan 4-aligned offsets relative to base
+  size_t ofs = AlignUp4(static_cast<size_t>(begin - base));
+  size_t limit = static_cast<size_t>(end - base);
+  for (; ofs + 8 <= limit; ofs += 4) {
+    if (IsRecordHead(base + ofs)) return base + ofs;
+  }
+  return end;
+}
+
+RecordIOChunkReader::RecordIOChunkReader(const char* begin, const char* end,
+                                         unsigned part_index,
+                                         unsigned num_parts) {
+  size_t size = static_cast<size_t>(end - begin);
+  size_t step = AlignUp4((size + num_parts - 1) / num_parts);
+  size_t lo = std::min(size, step * part_index);
+  size_t hi = std::min(size, step * (part_index + 1));
+  cur_ = FindRecordHead(begin, begin + lo, end);
+  end_ = FindRecordHead(begin, begin + hi, end);
+}
+
+bool RecordIOChunkReader::NextRecord(Blob* out) {
+  if (cur_ >= end_) return false;
+  DCT_CHECK_EQ(LoadWordLE(cur_), kMagic) << "bad recordio chunk";
+  uint32_t lrec = LoadWordLE(cur_ + 4);
+  uint32_t cflag = HeaderFlag(lrec);
+  uint32_t len = HeaderLen(lrec);
+  if (cflag == 0) {
+    out->dptr = cur_ + 8;
+    out->size = len;
+    cur_ += 8 + AlignUp4(len);
+    DCT_CHECK_LE(cur_, end_) << "recordio record overruns chunk";
+    return true;
+  }
+  DCT_CHECK_EQ(cflag, 1u) << "multi-part record must start with cflag=1";
+  assembled_.clear();
+  while (true) {
+    DCT_CHECK_LE(cur_ + 8, end_) << "truncated multi-part record";
+    DCT_CHECK_EQ(LoadWordLE(cur_), kMagic) << "bad recordio chunk";
+    lrec = LoadWordLE(cur_ + 4);
+    cflag = HeaderFlag(lrec);
+    len = HeaderLen(lrec);
+    assembled_.append(cur_ + 8, len);
+    cur_ += 8 + AlignUp4(len);
+    DCT_CHECK_LE(cur_, end_) << "recordio record overruns chunk";
+    if (cflag == 3) break;
+    char magic_bytes[4];
+    uint32_t m = kMagic;
+    if (!serial::NativeIsLE()) m = serial::ByteSwap(m);
+    std::memcpy(magic_bytes, &m, 4);
+    assembled_.append(magic_bytes, 4);
+  }
+  out->dptr = assembled_.data();
+  out->size = assembled_.size();
+  return true;
+}
+
+}  // namespace dct

@@ -1,0 +1,109 @@
+// RecordIO: splittable binary record format.
+//
+// On-disk format is byte-compatible with reference include/dmlc/recordio.h:
+//   [magic:u32le = 0xced7230a][lrecord:u32le][payload][pad to 4]
+//   lrecord = (cflag << 29) | payload_len,  cflag: 0=whole record,
+//   1=first part, 2=middle part, 3=last part.
+// A payload containing the 4-byte magic pattern at a 4-aligned offset is
+// split there into parts; the magic itself is elided on disk and re-inserted
+// between parts on read (reference src/recordio.cc:11-51 escape scheme).
+// This keeps every on-disk aligned magic word an unambiguous resync point,
+// which is what lets byte-range splitters start mid-file.
+//
+// Implementation is original: a part-iterator (NextPartBoundary) drives the
+// writer, and both readers share ReadParts.
+#ifndef DCT_RECORDIO_H_
+#define DCT_RECORDIO_H_
+
+#include <cstring>
+#include <string>
+
+#include "serializer.h"
+#include "stream.h"
+
+namespace dct {
+
+namespace recordio {
+constexpr uint32_t kMagic = 0xced7230a;
+// note (reference recordio.h:44): kMagic's top 3 bits decode to cflag > 3,
+// so an lrecord word can never equal kMagic.
+
+constexpr uint32_t EncodeHeader(uint32_t cflag, uint32_t len) {
+  return (cflag << 29) | len;
+}
+constexpr uint32_t HeaderFlag(uint32_t lrec) { return (lrec >> 29) & 7u; }
+constexpr uint32_t HeaderLen(uint32_t lrec) { return lrec & ((1u << 29) - 1); }
+constexpr size_t AlignUp4(size_t n) { return (n + 3) & ~size_t(3); }
+
+inline uint32_t LoadWordLE(const char* p) {
+  uint32_t w;
+  std::memcpy(&w, p, 4);
+  if (!serial::NativeIsLE()) w = serial::ByteSwap(w);
+  return w;
+}
+
+// True when [p, p+8) looks like a record head (magic + cflag 0|1) — the
+// resync predicate of reference src/recordio.cc FindNextRecordIOHead.
+inline bool IsRecordHead(const char* p) {
+  if (LoadWordLE(p) != kMagic) return false;
+  uint32_t flag = HeaderFlag(LoadWordLE(p + 4));
+  return flag == 0 || flag == 1;
+}
+}  // namespace recordio
+
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(Stream* stream) : stream_(stream) {}
+
+  // Write one record (< 2^29 bytes), escaping embedded aligned magics.
+  void WriteRecord(const void* buf, size_t size);
+  void WriteRecord(const std::string& s) { WriteRecord(s.data(), s.size()); }
+
+  // number of embedded-magic escapes performed (reference except_counter)
+  size_t escape_count() const { return escape_count_; }
+
+ private:
+  Stream* stream_;
+  size_t escape_count_ = 0;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(Stream* stream) : stream_(stream) {}
+  // Read the next record into *out; false at end of stream.
+  bool NextRecord(std::string* out);
+
+ private:
+  Stream* stream_;
+  bool eof_ = false;
+};
+
+// Sub-partitions an in-memory chunk of recordio bytes for multithreaded
+// parsing (reference recordio.h:166 RecordIOChunkReader): part boundaries are
+// byte ranges resynced forward to the next record head.
+class RecordIOChunkReader {
+ public:
+  struct Blob {
+    const void* dptr;
+    size_t size;
+  };
+  RecordIOChunkReader(const char* begin, const char* end, unsigned part_index,
+                      unsigned num_parts);
+  // out points into the chunk for single-part records, or into an internal
+  // buffer for reassembled multi-part records.
+  bool NextRecord(Blob* out);
+
+ private:
+  const char* cur_;
+  const char* end_;
+  std::string assembled_;
+};
+
+// Scan [begin, end) for the first record head at/after begin (4-aligned
+// offsets relative to `base`, which must be record-aligned).
+const char* FindRecordHead(const char* base, const char* begin,
+                           const char* end);
+
+}  // namespace dct
+
+#endif  // DCT_RECORDIO_H_

@@ -1,0 +1,116 @@
+// CSR row-block container.
+//
+// Counterpart of reference include/dmlc/data.h:174-236 (RowBlock CSR batch)
+// and src/data/row_block.h (owning growable container with Save/Load).
+// Layout decisions for the TPU bridge (see dmlc_core_tpu/tpu/):
+//   - offsets are uint64 (row starts into index/value arrays)
+//   - labels/weights/values are float32, qid uint64, field uint32
+//   - IndexType is uint32 by default (device-friendly; gathers/scatters on
+//     TPU want int32) with a uint64 instantiation for >4B-feature corpora.
+// The arrays are exactly the buffers handed zero-copy to numpy/JAX via the
+// C ABI (capi.cc) — no AoS Row objects on the hot path.
+#ifndef DCT_ROWBLOCK_H_
+#define DCT_ROWBLOCK_H_
+
+#include <algorithm>
+#include <vector>
+
+#include "serializer.h"
+
+namespace dct {
+
+template <typename IndexType>
+struct RowBlockContainer {
+  // offset[i]..offset[i+1] delimit row i in index/value; offset[0] == 0
+  std::vector<uint64_t> offset{0};
+  std::vector<float> label;
+  std::vector<float> weight;   // empty = uniform weights
+  std::vector<uint64_t> qid;   // empty = absent
+  std::vector<uint32_t> field; // empty = absent (libfm only)
+  std::vector<IndexType> index;
+  std::vector<float> value;    // empty = implicit 1.0 (binary features)
+  uint64_t max_index = 0;
+  uint32_t max_field = 0;
+
+  size_t Size() const { return label.size(); }
+
+  void Clear() {
+    offset.assign(1, 0);
+    label.clear();
+    weight.clear();
+    qid.clear();
+    field.clear();
+    index.clear();
+    value.clear();
+    max_index = 0;
+    max_field = 0;
+  }
+
+  void UpdateMax() {
+    for (IndexType v : index) max_index = std::max<uint64_t>(max_index, v);
+    for (uint32_t v : field) max_field = std::max(max_field, v);
+  }
+
+  size_t MemCostBytes() const {
+    return offset.size() * 8 + label.size() * 4 + weight.size() * 4 +
+           qid.size() * 8 + field.size() * 4 +
+           index.size() * sizeof(IndexType) + value.size() * 4;
+  }
+
+  // Append all rows of another container (reference row_block.h Push).
+  void Append(const RowBlockContainer& other) {
+    size_t base = index.size();
+    for (size_t i = 1; i < other.offset.size(); ++i) {
+      offset.push_back(other.offset[i] + base);
+    }
+    label.insert(label.end(), other.label.begin(), other.label.end());
+    weight.insert(weight.end(), other.weight.begin(), other.weight.end());
+    qid.insert(qid.end(), other.qid.begin(), other.qid.end());
+    field.insert(field.end(), other.field.begin(), other.field.end());
+    index.insert(index.end(), other.index.begin(), other.index.end());
+    value.insert(value.end(), other.value.begin(), other.value.end());
+    max_index = std::max(max_index, other.max_index);
+    max_field = std::max(max_field, other.max_field);
+  }
+
+  // Binary save/load in the shared cross-language wire format
+  // (dmlc_core_tpu/serializer.py reads this; reference row_block.h:189-215).
+  void Save(Stream* s) const {
+    serial::WriteVec(s, offset);
+    serial::WriteVec(s, label);
+    serial::WriteVec(s, weight);
+    serial::WriteVec(s, qid);
+    serial::WriteVec(s, field);
+    serial::WriteVec(s, index);
+    serial::WriteVec(s, value);
+    serial::WritePOD<uint64_t>(s, max_index);
+    serial::WritePOD<uint32_t>(s, max_field);
+  }
+
+  bool Load(Stream* s) {
+    // probe end-of-stream via the first vector length
+    uint64_t n;
+    if (s->Read(&n, 8) != 8) return false;
+    if (!serial::NativeIsLE()) n = serial::ByteSwap(n);
+    offset.resize(n);
+    if (n != 0) {
+      s->ReadExact(offset.data(), n * 8);
+      if (!serial::NativeIsLE()) {
+        for (auto& v : offset) v = serial::ByteSwap(v);
+      }
+    }
+    serial::ReadVec(s, &label);
+    serial::ReadVec(s, &weight);
+    serial::ReadVec(s, &qid);
+    serial::ReadVec(s, &field);
+    serial::ReadVec(s, &index);
+    serial::ReadVec(s, &value);
+    max_index = serial::ReadPOD<uint64_t>(s);
+    max_field = serial::ReadPOD<uint32_t>(s);
+    return true;
+  }
+};
+
+}  // namespace dct
+
+#endif  // DCT_ROWBLOCK_H_

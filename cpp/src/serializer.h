@@ -1,0 +1,90 @@
+// Little-endian binary serialization over Stream.
+//
+// Counterpart of reference include/dmlc/serializer.h + endian.h: PODs are
+// written fixed-width little-endian on disk regardless of host order
+// (the reference's DMLC_IO_NO_ENDIAN_SWAP scheme, endian.h:39-51); vectors
+// and strings are uint64 length + payload. The wire format is shared with
+// dmlc_core_tpu/serializer.py so containers round-trip across languages.
+#ifndef DCT_SERIALIZER_H_
+#define DCT_SERIALIZER_H_
+
+#include <cstring>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+#include "stream.h"
+
+namespace dct {
+
+namespace serial {
+
+inline bool NativeIsLE() {
+  const uint32_t probe = 1;
+  return *reinterpret_cast<const uint8_t*>(&probe) == 1;
+}
+
+template <typename T>
+inline T ByteSwap(T v) {
+  T out;
+  auto* src = reinterpret_cast<const uint8_t*>(&v);
+  auto* dst = reinterpret_cast<uint8_t*>(&out);
+  for (size_t i = 0; i < sizeof(T); ++i) dst[i] = src[sizeof(T) - 1 - i];
+  return out;
+}
+
+template <typename T>
+inline void WritePOD(Stream* s, T v) {
+  static_assert(std::is_arithmetic_v<T>);
+  if (!NativeIsLE()) v = ByteSwap(v);
+  s->Write(&v, sizeof(T));
+}
+
+template <typename T>
+inline T ReadPOD(Stream* s) {
+  static_assert(std::is_arithmetic_v<T>);
+  T v;
+  s->ReadExact(&v, sizeof(T));
+  if (!NativeIsLE()) v = ByteSwap(v);
+  return v;
+}
+
+template <typename T>
+inline void WriteVec(Stream* s, const std::vector<T>& v) {
+  WritePOD<uint64_t>(s, v.size());
+  if (v.empty()) return;
+  if (NativeIsLE() || sizeof(T) == 1) {
+    s->Write(v.data(), v.size() * sizeof(T));
+  } else {
+    for (const T& e : v) WritePOD(s, e);
+  }
+}
+
+template <typename T>
+inline void ReadVec(Stream* s, std::vector<T>* v) {
+  uint64_t n = ReadPOD<uint64_t>(s);
+  v->resize(n);
+  if (n == 0) return;
+  if (NativeIsLE() || sizeof(T) == 1) {
+    s->ReadExact(v->data(), n * sizeof(T));
+  } else {
+    for (uint64_t i = 0; i < n; ++i) (*v)[i] = ReadPOD<T>(s);
+  }
+}
+
+inline void WriteStr(Stream* s, const std::string& str) {
+  WritePOD<uint64_t>(s, str.size());
+  s->Write(str.data(), str.size());
+}
+
+inline std::string ReadStr(Stream* s) {
+  uint64_t n = ReadPOD<uint64_t>(s);
+  std::string str(n, '\0');
+  if (n != 0) s->ReadExact(&str[0], n);
+  return str;
+}
+
+}  // namespace serial
+}  // namespace dct
+
+#endif  // DCT_SERIALIZER_H_

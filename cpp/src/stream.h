@@ -1,0 +1,169 @@
+// Byte-stream abstraction and URI handling.
+//
+// Counterpart of reference include/dmlc/io.h:30-146 (Stream/SeekStream with
+// URI-dispatched factories), io.h:525-559 (io::URI), and
+// src/io/uri_spec.h:28-76 (URISpec `path?k=v#cachefile` sugar). The typed
+// endian-aware Write<T> entry points of the reference (io.h:450-457) live in
+// serializer.h here.
+#ifndef DCT_STREAM_H_
+#define DCT_STREAM_H_
+
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.h"
+
+namespace dct {
+
+// Abstract byte stream.
+class Stream {
+ public:
+  virtual ~Stream() = default;
+  // Read up to `size` bytes; returns bytes read (0 at EOF).
+  virtual size_t Read(void* ptr, size_t size) = 0;
+  // Write all `size` bytes or throw.
+  virtual size_t Write(const void* ptr, size_t size) = 0;
+  // Factory dispatching on URI scheme; mode is "r"/"w"/"a" (binary always).
+  // Returns nullptr when allow_null and the path does not exist.
+  static Stream* Create(const std::string& uri, const char* mode,
+                        bool allow_null = false);
+
+  void ReadExact(void* ptr, size_t size) {
+    size_t n = Read(ptr, size);
+    DCT_CHECK_EQ(n, size) << "unexpected end of stream";
+  }
+};
+
+// Seekable read stream.
+class SeekStream : public Stream {
+ public:
+  virtual void Seek(size_t pos) = 0;
+  virtual size_t Tell() = 0;
+  static SeekStream* CreateForRead(const std::string& uri,
+                                   bool allow_null = false);
+};
+
+// Growable in-memory stream over an owned buffer
+// (counterpart of reference memory_io.h MemoryStringStream).
+class MemoryStream : public SeekStream {
+ public:
+  MemoryStream() = default;
+  explicit MemoryStream(std::string data) : buf_(std::move(data)) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    size_t n = std::min(size, buf_.size() - std::min(pos_, buf_.size()));
+    if (n != 0) std::memcpy(ptr, buf_.data() + pos_, n);
+    pos_ += n;
+    return n;
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    if (pos_ + size > buf_.size()) buf_.resize(pos_ + size);
+    std::memcpy(&buf_[pos_], ptr, size);
+    pos_ += size;
+    return size;
+  }
+  void Seek(size_t pos) override { pos_ = pos; }
+  size_t Tell() override { return pos_; }
+  const std::string& data() const { return buf_; }
+  std::string&& MoveData() { return std::move(buf_); }
+
+ private:
+  std::string buf_;
+  size_t pos_ = 0;
+};
+
+// Parsed URI: scheme://host/path. Empty scheme means local path.
+struct URI {
+  std::string scheme;
+  std::string host;
+  std::string path;
+
+  URI() = default;
+  explicit URI(const std::string& uri) {
+    size_t p = uri.find("://");
+    if (p == std::string::npos) {
+      path = uri;
+      return;
+    }
+    scheme = uri.substr(0, p);
+    size_t body = p + 3;
+    size_t slash = uri.find('/', body);
+    if (slash == std::string::npos) {
+      host = uri.substr(body);
+    } else {
+      host = uri.substr(body, slash - body);
+      path = uri.substr(slash);
+    }
+  }
+
+  std::string Str() const {
+    if (scheme.empty()) return path;
+    return scheme + "://" + host + path;
+  }
+};
+
+// URI sugar: `realuri?key=value&...#cachefile` with per-part cache naming
+// (reference src/io/uri_spec.h:28-76).
+struct URISpec {
+  std::string uri;
+  std::map<std::string, std::string> args;
+  std::string cache_file;
+
+  URISpec(const std::string& raw, unsigned part_index, unsigned num_parts) {
+    std::string rest = raw;
+    size_t hash = rest.find('#');
+    if (hash != std::string::npos) {
+      cache_file = rest.substr(hash + 1);
+      DCT_CHECK(cache_file.find('#') == std::string::npos)
+          << "only one `#` allowed in uri: " << raw;
+      if (num_parts != 1) {
+        cache_file += ".split" + std::to_string(num_parts) + ".part" +
+                      std::to_string(part_index);
+      }
+      rest = rest.substr(0, hash);
+    }
+    size_t q = rest.find('?');
+    if (q != std::string::npos) {
+      std::string query = rest.substr(q + 1);
+      rest = rest.substr(0, q);
+      size_t start = 0;
+      while (start <= query.size()) {
+        size_t amp = query.find('&', start);
+        std::string kv = query.substr(
+            start, amp == std::string::npos ? std::string::npos : amp - start);
+        if (!kv.empty()) {
+          size_t eq = kv.find('=');
+          DCT_CHECK(eq != std::string::npos)
+              << "invalid uri argument `" << kv << "` in " << raw;
+          args[kv.substr(0, eq)] = kv.substr(eq + 1);
+        }
+        if (amp == std::string::npos) break;
+        start = amp + 1;
+      }
+    }
+    uri = rest;
+  }
+};
+
+// Split a string on a delimiter (reference common.h:23).
+inline std::vector<std::string> StrSplit(const std::string& s, char delim) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (start <= s.size()) {
+    size_t p = s.find(delim, start);
+    if (p == std::string::npos) {
+      out.push_back(s.substr(start));
+      break;
+    }
+    out.push_back(s.substr(start, p - start));
+    start = p + 1;
+  }
+  return out;
+}
+
+}  // namespace dct
+
+#endif  // DCT_STREAM_H_

@@ -84,14 +84,14 @@ class Config:
         toks = list(_tokenize(text))
         i = 0
         while i < len(toks):
-            if i + 2 >= len(toks) + 1 and False:
-                break
             if i + 2 > len(toks) - 1:
                 raise ConfigError(f"config: dangling tokens {toks[i:]}")
             key, key_is_str = toks[i]
-            eq, _ = toks[i + 1]
+            eq, eq_is_str = toks[i + 1]
             value, val_is_str = toks[i + 2]
-            if eq != "=" or key == "=" or value == "=":
+            # a quoted "=" is a string token, not the assignment operator
+            if (eq != "=" or eq_is_str or (key == "=" and not key_is_str)
+                    or (value == "=" and not val_is_str)):
                 raise ConfigError(
                     f"config: expected 'key = value' near {key!r}")
             self._insert(key, value, val_is_str)

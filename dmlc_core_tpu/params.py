@@ -24,7 +24,7 @@ parsers, csv_parser.h:230-236).
 from __future__ import annotations
 
 import json
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Type
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Type
 
 from dmlc_core_tpu.base import DMLCError
 
@@ -202,7 +202,7 @@ class Parameter(metaclass=ParameterMeta):
     def update_dict(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
         """Init + write back normalized values — reference UpdateDict."""
         unknown = self.init(dict(kwargs), allow_unknown=True)
-        kwargs.update({k: v for k, v in self.as_dict().items()})
+        kwargs.update(self.as_dict())
         return unknown
 
     # -- reflection -----------------------------------------------------------

@@ -100,7 +100,9 @@ class BinaryReader:
         n = self.read_scalar("uint64")
         np_dt = np.dtype(dtype).newbyteorder("<")
         raw = self._read_exact(n * np_dt.itemsize)
-        return np.frombuffer(raw, dtype=np_dt).astype(np.dtype(dtype), copy=False)
+        # always copy: frombuffer views are read-only, and callers get the
+        # mutable-container contract of the reference's Load
+        return np.frombuffer(raw, dtype=np_dt).astype(np.dtype(dtype))
 
     def read_str_list(self) -> List[str]:
         return [self.read_string() for _ in range(self.read_scalar("uint64"))]

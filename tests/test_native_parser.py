@@ -1,0 +1,207 @@
+"""Parser tests — mirrors reference test/unittest/unittest_parser.cc
+(BOM, NOEOL, delimiters, weight column, qid, indexing-mode heuristics)."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.native import NativeParser
+
+
+def parse_all(tmp_path, text, fmt="libsvm", name="data.txt", uri_args="",
+              **kw):
+    p = tmp_path / name
+    p.write_bytes(text if isinstance(text, bytes) else text.encode())
+    rows = []
+    with NativeParser(str(p) + uri_args, fmt=fmt, **kw) as parser:
+        for block in parser:
+            for i in range(block.num_rows):
+                lo, hi = block.offset[i], block.offset[i + 1]
+                row = {
+                    "label": float(block.label[i]),
+                    "index": block.index[lo:hi].tolist(),
+                }
+                if block.value is not None:
+                    row["value"] = block.value[lo:hi].tolist()
+                if block.weight is not None:
+                    row["weight"] = float(block.weight[i])
+                if block.qid is not None:
+                    row["qid"] = int(block.qid[i])
+                if block.field is not None:
+                    row["field"] = block.field[lo:hi].tolist()
+                rows.append(row)
+    return rows
+
+
+# -- libsvm -----------------------------------------------------------------
+def test_libsvm_basic(tmp_path):
+    rows = parse_all(tmp_path, "1 0:1.5 3:2.5\n0 1:3.5\n")
+    assert rows == [
+        {"label": 1.0, "index": [0, 3], "value": [1.5, 2.5]},
+        {"label": 0.0, "index": [1], "value": [3.5]},
+    ]
+
+
+def test_libsvm_weight_and_qid(tmp_path):
+    rows = parse_all(tmp_path, "1:0.5 qid:7 0:1 2:2\n")
+    assert rows == [{"label": 1.0, "weight": 0.5, "qid": 7,
+                     "index": [0, 2], "value": [1.0, 2.0]}]
+
+
+def test_libsvm_comments_and_blank_lines(tmp_path):
+    rows = parse_all(tmp_path, "# full comment\n1 0:1\n\n   \n0 1:2 # tail\n")
+    assert [r["label"] for r in rows] == [1.0, 0.0]
+    assert rows[1]["index"] == [1]
+
+
+def test_libsvm_noeol(tmp_path):
+    rows = parse_all(tmp_path, "1 0:1\n0 1:2")  # no trailing newline
+    assert len(rows) == 2
+
+
+def test_libsvm_bom(tmp_path):
+    rows = parse_all(tmp_path, b"\xef\xbb\xbf1 0:1\n")
+    assert rows == [{"label": 1.0, "index": [0], "value": [1.0]}]
+
+
+def test_libsvm_indexing_heuristic(tmp_path):
+    text = "1 1:1 3:3\n0 2:2\n"
+    # default mode 0: keep as-is
+    rows = parse_all(tmp_path, text)
+    assert rows[0]["index"] == [1, 3]
+    # forced 1-based: decrement
+    rows = parse_all(tmp_path, text, uri_args="?indexing_mode=1")
+    assert rows[0]["index"] == [0, 2]
+    # auto: all ids > 0 => 1-based detected
+    rows = parse_all(tmp_path, text, uri_args="?indexing_mode=-1")
+    assert rows[0]["index"] == [0, 2]
+    # auto with a zero id: keep 0-based
+    rows = parse_all(tmp_path, "1 0:1 3:3\n", uri_args="?indexing_mode=-1")
+    assert rows[0]["index"] == [0, 3]
+
+
+def test_libsvm_binary_features_no_values(tmp_path):
+    rows = parse_all(tmp_path, "1 3 5 7\n")
+    assert rows == [{"label": 1.0, "index": [3, 5, 7]}]
+
+
+def test_libsvm_scientific_notation(tmp_path):
+    rows = parse_all(tmp_path, "-1.5e-2 0:1e3 1:-2.5E-4\n")
+    assert rows[0]["label"] == pytest.approx(-0.015)
+    assert rows[0]["value"][0] == pytest.approx(1000.0)
+    assert rows[0]["value"][1] == pytest.approx(-2.5e-4)
+
+
+# -- csv --------------------------------------------------------------------
+def test_csv_basic(tmp_path):
+    rows = parse_all(tmp_path, "1.0,2.0,3.0\n4.0,5.0,6.0\n", fmt="csv")
+    assert rows == [
+        {"label": 0.0, "index": [0, 1, 2], "value": [1.0, 2.0, 3.0]},
+        {"label": 0.0, "index": [0, 1, 2], "value": [4.0, 5.0, 6.0]},
+    ]
+
+
+def test_csv_label_column(tmp_path):
+    rows = parse_all(tmp_path, "9,1.0,2.0\n8,3.0,4.0\n", fmt="csv",
+                     uri_args="?label_column=0")
+    assert rows == [
+        {"label": 9.0, "index": [0, 1], "value": [1.0, 2.0]},
+        {"label": 8.0, "index": [0, 1], "value": [3.0, 4.0]},
+    ]
+
+
+def test_csv_weight_column(tmp_path):
+    rows = parse_all(tmp_path, "1,0.5,2.0\n0,0.25,3.0\n", fmt="csv",
+                     uri_args="?label_column=0&weight_column=1")
+    assert rows == [
+        {"label": 1.0, "weight": 0.5, "index": [0], "value": [2.0]},
+        {"label": 0.0, "weight": 0.25, "index": [0], "value": [3.0]},
+    ]
+
+
+def test_csv_custom_delimiter(tmp_path):
+    rows = parse_all(tmp_path, "1\t2\t3\n", fmt="csv",
+                     uri_args="?delimiter=%09" if False else "?delimiter=\t")
+    assert rows[0]["value"] == [1.0, 2.0, 3.0]
+
+
+def test_csv_missing_values_skipped(tmp_path):
+    # reference csv_parser.h:119-124: unparseable cells keep their column
+    # index but emit no entry
+    rows = parse_all(tmp_path, "1.0,,3.0\n", fmt="csv")
+    assert rows == [{"label": 0.0, "index": [0, 2], "value": [1.0, 3.0]}]
+
+
+def test_csv_label_weight_conflict(tmp_path):
+    with pytest.raises(DMLCError, match="must differ"):
+        parse_all(tmp_path, "1,2\n", fmt="csv",
+                  uri_args="?label_column=1&weight_column=1")
+
+
+# -- libfm ------------------------------------------------------------------
+def test_libfm_basic(tmp_path):
+    rows = parse_all(tmp_path, "1 2:3:1.5 4:5:2.5\n", fmt="libfm")
+    assert rows == [{"label": 1.0, "field": [2, 4], "index": [3, 5],
+                     "value": [1.5, 2.5]}]
+
+
+def test_libfm_indexing_heuristic(tmp_path):
+    text = "1 1:1:0.5 2:3:1.5\n"
+    rows = parse_all(tmp_path, text, fmt="libfm", uri_args="?indexing_mode=-1")
+    assert rows[0]["field"] == [0, 1]
+    assert rows[0]["index"] == [0, 2]
+    rows = parse_all(tmp_path, text, fmt="libfm")
+    assert rows[0]["field"] == [1, 2]
+
+
+# -- infrastructure ---------------------------------------------------------
+def test_format_from_uri_arg(tmp_path):
+    rows = parse_all(tmp_path, "1,2\n", fmt="auto", uri_args="?format=csv")
+    assert rows[0]["value"] == [1.0, 2.0]
+
+
+def test_parser_distributed_exact_cover(tmp_path):
+    lines = [f"{i % 2} {i % 50}:{i}.5" for i in range(997)]
+    p = tmp_path / "big.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    for nsplit in (1, 3, 4):
+        labels = []
+        for part in range(nsplit):
+            with NativeParser(str(p), part=part, npart=nsplit,
+                              fmt="libsvm") as parser:
+                for b in parser:
+                    labels.extend(b.label.tolist())
+        assert len(labels) == 997, f"nsplit={nsplit}"
+
+
+def test_bytes_read_counter(tmp_path):
+    p = tmp_path / "x.libsvm"
+    p.write_text("1 0:1\n" * 100)
+    with NativeParser(str(p)) as parser:
+        for _ in parser:
+            pass
+        assert parser.bytes_read() == p.stat().st_size
+
+
+def test_before_first_restarts(tmp_path):
+    p = tmp_path / "y.libsvm"
+    p.write_text("1 0:1\n0 1:2\n")
+    with NativeParser(str(p)) as parser:
+        n1 = sum(b.num_rows for b in parser)
+        parser.before_first()
+        n2 = sum(b.num_rows for b in parser)
+    assert (n1, n2) == (2, 2)
+
+
+def test_index64(tmp_path):
+    big = 5_000_000_000
+    rows = parse_all(tmp_path, f"1 {big}:1.5\n", index64=True)
+    assert rows[0]["index"] == [big]
+
+
+def test_max_index_tracked(tmp_path):
+    p = tmp_path / "z.libsvm"
+    p.write_text("1 5:1 99:2\n0 42:1\n")
+    with NativeParser(str(p)) as parser:
+        blocks = list(parser)
+    assert max(b.max_index for b in blocks) == 99

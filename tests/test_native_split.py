@@ -1,0 +1,206 @@
+"""InputSplit tests — mirrors reference test/unittest/unittest_inputsplit.cc.
+
+The key property (reference test_split_libsvm_distributed,
+unittest_inputsplit.cc:116-145): instantiating the same URI with every
+(part_index, num_parts) must yield a disjoint union equal to the full record
+set, for any file layout.
+"""
+
+import os
+import random
+
+import pytest
+
+from dmlc_core_tpu.io.native import (NativeInputSplit, NativeRecordIOWriter,
+                                     NativeRecordIOReader)
+
+
+def write_files(tmp_path, contents):
+    paths = []
+    for i, data in enumerate(contents):
+        p = tmp_path / f"part-{i:03d}"
+        p.write_bytes(data)
+        paths.append(str(p))
+    return ";".join(paths)
+
+
+def collect_records(uri, part, nsplit, threaded=True):
+    with NativeInputSplit(uri, part, nsplit, "text", threaded=threaded) as s:
+        return list(s)
+
+
+def test_single_file_lines(tmp_path):
+    uri = write_files(tmp_path, [b"a\nbb\nccc\n"])
+    assert collect_records(uri, 0, 1) == [b"a", b"bb", b"ccc"]
+
+
+def test_noeol_last_line(tmp_path):
+    uri = write_files(tmp_path, [b"a\nbb\nccc"])  # no trailing newline
+    assert collect_records(uri, 0, 1) == [b"a", b"bb", b"ccc"]
+
+
+def test_crlf(tmp_path):
+    uri = write_files(tmp_path, [b"a\r\nbb\r\nccc\r\n"])
+    assert collect_records(uri, 0, 1) == [b"a", b"bb", b"ccc"]
+
+
+def test_noeol_newline_injection_between_files(tmp_path):
+    # second file must not merge with the NOEOL tail of the first
+    # (reference input_split_base.cc:195-199, dmlc PRs 385/452)
+    uri = write_files(tmp_path, [b"a\nb", b"c\nd\n"])
+    assert collect_records(uri, 0, 1) == [b"a", b"b", b"c", b"d"]
+
+
+def test_exact_cover_multifile(tmp_path):
+    lines = [f"line-{i}".encode() for i in range(1000)]
+    # spread over 5 files with uneven sizes, last file NOEOL
+    chunks = [lines[:100], lines[100:150], lines[150:600], lines[600:999],
+              lines[999:]]
+    contents = [b"\n".join(c) + b"\n" for c in chunks[:-1]]
+    contents.append(b"\n".join(chunks[-1]))  # NOEOL
+    uri = write_files(tmp_path, contents)
+    for nsplit in (1, 2, 3, 4, 7, 16):
+        got = []
+        for part in range(nsplit):
+            got.extend(collect_records(uri, part, nsplit))
+        assert got == lines, f"nsplit={nsplit}"
+
+
+def test_exact_cover_random_property(tmp_path):
+    rng = random.Random(42)
+    for trial in range(5):
+        nfiles = rng.randint(1, 4)
+        lines = []
+        contents = []
+        for _ in range(nfiles):
+            file_lines = [bytes(rng.choices(b"abcdefghij",
+                                            k=rng.randint(0, 30)))
+                          for _ in range(rng.randint(1, 200))]
+            # avoid empty trailing line ambiguity: always end with newline
+            contents.append(b"\n".join(file_lines) + b"\n")
+            lines.extend(file_lines)
+        d = tmp_path / f"trial{trial}"
+        d.mkdir()
+        uri = write_files(d, contents)
+        for nsplit in (1, 2, 3, 5):
+            got = []
+            for part in range(nsplit):
+                got.extend(collect_records(uri, part, nsplit))
+            assert got == lines, f"trial={trial} nsplit={nsplit}"
+
+
+def test_small_chunks_overflow_carry(tmp_path):
+    # tiny chunk size forces the overflow-carry path on every record
+    lines = [f"record-{i:04d}-{'x' * (i % 37)}".encode() for i in range(500)]
+    uri = write_files(tmp_path, [b"\n".join(lines) + b"\n"])
+    with NativeInputSplit(uri, 0, 1, "text", threaded=False) as s:
+        s.hint_chunk_size(64)
+        got = list(s)
+    assert got == lines
+
+
+def test_directory_listing(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "b.txt").write_bytes(b"2\n")
+    (d / "a.txt").write_bytes(b"1\n")
+    (d / ".hidden").write_bytes(b"no\n")
+    got = collect_records(str(d), 0, 1)
+    assert got == [b"1", b"2"]  # sorted, hidden skipped
+
+
+def test_glob(tmp_path):
+    (tmp_path / "train-0.txt").write_bytes(b"a\n")
+    (tmp_path / "train-1.txt").write_bytes(b"b\n")
+    (tmp_path / "test-0.txt").write_bytes(b"z\n")
+    got = collect_records(str(tmp_path / "train-*.txt"), 0, 1)
+    assert got == [b"a", b"b"]
+
+
+def test_before_first_rewinds(tmp_path):
+    uri = write_files(tmp_path, [b"a\nb\nc\n"])
+    with NativeInputSplit(uri, 0, 1, "text") as s:
+        assert list(s) == [b"a", b"b", b"c"]
+        s.before_first()
+        assert list(s) == [b"a", b"b", b"c"]
+
+
+def test_reset_partition(tmp_path):
+    lines = [f"{i}".encode() for i in range(100)]
+    uri = write_files(tmp_path, [b"\n".join(lines) + b"\n"])
+    with NativeInputSplit(uri, 0, 2, "text") as s:
+        first = list(s)
+        s.reset_partition(1, 2)
+        second = list(s)
+    assert first + second == lines
+
+
+def test_total_size(tmp_path):
+    uri = write_files(tmp_path, [b"abc\n", b"defg\n"])
+    with NativeInputSplit(uri, 0, 1, "text") as s:
+        assert s.total_size() == 9
+
+
+def test_missing_file_raises(tmp_path):
+    with pytest.raises(Exception, match="does not exist"):
+        NativeInputSplit(str(tmp_path / "nope"), 0, 1, "text")
+
+
+# -- recordio splitting -----------------------------------------------------
+def make_rec_file(path, records):
+    with NativeRecordIOWriter(str(path)) as w:
+        for r in records:
+            w.write_record(r)
+
+
+def test_recordio_roundtrip(tmp_path):
+    recs = [b"hello", b"", b"x" * 1000, b"yo"]
+    p = tmp_path / "a.rec"
+    make_rec_file(p, recs)
+    with NativeRecordIOReader(str(p)) as r:
+        assert list(r) == recs
+
+
+def test_recordio_magic_escape_roundtrip(tmp_path):
+    # payloads containing the magic pattern at aligned offsets must survive
+    # (reference recordio.h:16-37 multi-part cflag scheme)
+    import struct
+    magic = struct.pack("<I", 0xCED7230A)
+    recs = [magic, magic * 3, b"abcd" + magic + b"efgh",
+            b"ab" + magic + b"cd",  # unaligned magic: no escape needed
+            magic + b"x"]
+    p = tmp_path / "m.rec"
+    make_rec_file(p, recs)
+    with NativeRecordIOReader(str(p)) as r:
+        assert list(r) == recs
+
+
+def test_recordio_split_exact_cover(tmp_path):
+    rng = random.Random(7)
+    recs = [bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 300)))
+            for _ in range(400)]
+    p = tmp_path / "c.rec"
+    make_rec_file(p, recs)
+    for nsplit in (1, 2, 3, 5):
+        got = []
+        for part in range(nsplit):
+            with NativeInputSplit(str(p), part, nsplit, "recordio") as s:
+                got.extend(list(s))
+        assert got == recs, f"nsplit={nsplit}"
+
+
+def test_recordio_split_multifile(tmp_path):
+    all_recs = []
+    paths = []
+    for i in range(3):
+        recs = [f"file{i}-rec{j}".encode() * (j % 5 + 1) for j in range(50)]
+        p = tmp_path / f"f{i}.rec"
+        make_rec_file(p, recs)
+        paths.append(str(p))
+        all_recs.extend(recs)
+    uri = ";".join(paths)
+    got = []
+    for part in range(4):
+        with NativeInputSplit(uri, part, 4, "recordio") as s:
+            got.extend(list(s))
+    assert got == all_recs

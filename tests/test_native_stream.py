@@ -1,0 +1,61 @@
+"""Stream/FileSystem C-API tests — mirrors reference stream/filesys tests."""
+
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.native import (NativeStream, list_directory, path_info)
+
+
+def test_stream_write_read(tmp_path):
+    p = str(tmp_path / "f.bin")
+    with NativeStream(p, "w") as s:
+        s.write(b"hello ")
+        s.write(b"world")
+    with NativeStream(p, "r") as s:
+        assert s.read_all() == b"hello world"
+
+
+def test_stream_append(tmp_path):
+    p = str(tmp_path / "f.bin")
+    with NativeStream(p, "w") as s:
+        s.write(b"a")
+    with NativeStream(p, "a") as s:
+        s.write(b"b")
+    with NativeStream(p, "r") as s:
+        assert s.read_all() == b"ab"
+
+
+def test_stream_missing_raises(tmp_path):
+    with pytest.raises(DMLCError, match="cannot open"):
+        NativeStream(str(tmp_path / "missing"), "r")
+
+
+def test_file_scheme_uri(tmp_path):
+    p = tmp_path / "u.bin"
+    with NativeStream("file://" + str(p), "w") as s:
+        s.write(b"x")
+    assert p.read_bytes() == b"x"
+
+
+def test_list_directory(tmp_path):
+    (tmp_path / "a").write_bytes(b"123")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "b").write_bytes(b"4567")
+    flat = list_directory(str(tmp_path))
+    names = {e[0].split("/")[-1]: e for e in flat}
+    assert names["a"][1] == 3 and names["a"][2] == "f"
+    assert names["sub"][2] == "d"
+    rec = list_directory(str(tmp_path), recursive=True)
+    sizes = sorted(e[1] for e in rec)
+    assert sizes == [3, 4]  # directories excluded, recursed into
+
+
+def test_path_info(tmp_path):
+    (tmp_path / "a").write_bytes(b"12345")
+    assert path_info(str(tmp_path / "a")) == (5, False)
+    assert path_info(str(tmp_path))[1] is True
+
+
+def test_unknown_scheme():
+    with pytest.raises(DMLCError, match="unknown filesystem scheme"):
+        NativeStream("gopher://x/y", "r")
