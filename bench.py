@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Headline benchmark: HIGGS-like libsvm ingest -> HBM-resident sharded batches.
+
+Prints ONE JSON line:
+  {"metric": "higgs_libsvm_ingest_rows_per_sec", "value": N,
+   "unit": "rows/s", "vs_baseline": R}
+
+- value: end-to-end rows/sec through the full TPU-native pipeline
+  (native multithreaded parse -> static-shape padding -> device_put under a
+  mesh sharding -> a consuming jitted reduction on device, overlapped via the
+  double buffer).
+- vs_baseline: ratio against the reference C++ build's parse-to-host
+  throughput on the same dataset/machine (bench_baseline.json; the reference
+  publishes no numbers — BASELINE.md).
+
+Flags: --smoke (tiny dataset, CI), --rows N, --parse-only.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_cache")
+
+
+def ensure_dataset(rows: int) -> str:
+    import numpy as np
+    path = os.path.join(CACHE_DIR, f"higgs_{rows // 1000}k.libsvm")
+    if os.path.exists(path):
+        return path
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    rng = np.random.default_rng(7)
+    F = 28
+    step = min(rows, 10000)
+    with open(path + ".tmp", "w") as f:
+        for start in range(0, rows, step):
+            n = min(step, rows - start)
+            vals = rng.uniform(-3, 3, size=(n, F))
+            labels = rng.integers(0, 2, size=n)
+            lines = []
+            for i in range(n):
+                feats = " ".join(f"{j}:{vals[i, j]:.6f}" for j in range(F))
+                lines.append(f"{labels[i]} {feats}")
+            f.write("\n".join(lines) + "\n")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny quick run")
+    ap.add_argument("--rows", type=int, default=0)
+    ap.add_argument("--parse-only", action="store_true",
+                    help="skip device placement (host parse throughput)")
+    ap.add_argument("--batch-rows", type=int, default=32768)
+    args = ap.parse_args()
+
+    rows = args.rows or (20000 if args.smoke else 200000)
+    path = ensure_dataset(rows)
+    size_mb = os.path.getsize(path) / 1e6
+
+    from dmlc_core_tpu.io.native import NativeParser
+
+    # warm: build/load the native lib outside the timed region
+    with NativeParser(path) as p:
+        p.next_block()
+
+    if args.parse_only:
+        t0 = time.time()
+        got = 0
+        with NativeParser(path) as p:
+            for b in p:
+                got += b.num_rows
+        dt = time.time() - t0
+    else:
+        import jax
+        import jax.numpy as jnp
+        from dmlc_core_tpu.tpu.device_iter import DeviceRowBlockIter
+        from dmlc_core_tpu.tpu.sharding import data_mesh
+
+        mesh = data_mesh()
+        print(f"# devices: {jax.devices()}", file=sys.stderr)
+
+        @jax.jit
+        def consume(tree):
+            # touch every array so the batch is fully materialized in HBM
+            return sum(jnp.sum(v.astype(jnp.float32)) for v in tree.values())
+
+        # warm compile on a first batch shape
+        with DeviceRowBlockIter(path, batch_rows=args.batch_rows,
+                                mesh=mesh) as it:
+            for batch in it:
+                consume(batch.tree()).block_until_ready()
+                break
+
+        t0 = time.time()
+        got = 0
+        acc = None
+        with DeviceRowBlockIter(path, batch_rows=args.batch_rows,
+                                mesh=mesh) as it:
+            for batch in it:
+                got += batch.total_rows  # host-side count: no device sync
+                acc = consume(batch.tree())
+        if acc is not None:
+            acc.block_until_ready()
+        dt = time.time() - t0
+
+    assert got == rows, f"row count mismatch: {got} != {rows}"
+    rps = rows / dt
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_baseline.json")
+    vs = None
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        # scale: baseline measured on the 200k dataset; rows/s is size-stable
+        vs = round(rps / base["reference_rows_per_sec"], 3)
+
+    print(f"# {rows} rows ({size_mb:.1f} MB) in {dt:.3f}s = "
+          f"{size_mb / dt:.1f} MB/s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "higgs_libsvm_ingest_rows_per_sec",
+        "value": round(rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": vs,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,7 +45,6 @@ ByteSplit::ByteSplit(const std::string& uri, unsigned align_bytes,
     if (piece.empty()) continue;
     URI u(piece);
     FileSystem* fs = FileSystem::GetInstance(u);
-    if (fs_ == nullptr) fs_ = fs;
     std::string base = BaseName(u.path);
     if (base.find('*') != std::string::npos) {
       URI dir = u;
@@ -379,7 +378,7 @@ bool RecordIOSplit::ExtractRecordAt(char* data, size_t valid, size_t* cursor,
 
 // --------------------------------------------------------------------------
 PrefetchSplit::PrefetchSplit(ByteSplit* base, size_t capacity)
-    : base_(base), pipe_(capacity), capacity_(capacity) {}
+    : base_(base), pipe_(capacity) {}
 
 PrefetchSplit::~PrefetchSplit() {
   if (current_ != nullptr) pipe_.Recycle(&current_);
@@ -439,6 +438,11 @@ InputSplit* InputSplit::Create(const std::string& uri, unsigned part,
                                int seed, size_t batch_size,
                                bool recurse_directories, bool threaded,
                                const std::string& cache_file) {
+  DCT_CHECK(index_uri.empty() && !shuffle && cache_file.empty())
+      << "indexed/shuffled/cached input splits are not implemented yet "
+         "(type=" << type << ")";
+  (void)seed;
+  (void)batch_size;
   ByteSplit* split = nullptr;
   if (type == "text") {
     split = new LineSplit(uri, part, nsplit, recurse_directories);

@@ -110,7 +110,6 @@ class ByteSplit : public InputSplit {
   // written into buf.
   size_t ReadSpan(char* buf, size_t want);
 
-  FileSystem* fs_ = nullptr;
   std::vector<FileInfo> files_;
   std::vector<size_t> file_start_;  // cumulative start offset of each file
   size_t total_size_ = 0;
@@ -190,7 +189,6 @@ class PrefetchSplit : public InputSplit {
   PipelineIter<Cell> pipe_;
   Cell* current_ = nullptr;
   bool started_ = false;
-  size_t capacity_;
   void EnsureStarted();
 };
 

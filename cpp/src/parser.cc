@@ -71,12 +71,33 @@ void TextParserBase<IndexType>::BeforeFirst() {
   block_idx_ = block_count_ = 0;
 }
 
+namespace {
+// Optional per-row arrays must be absent or full-length: the C ABI exposes
+// them as dense parallel arrays, so ragged input (e.g. libsvm rows mixing
+// `idx:val` and bare `idx` features) must fail loudly, not misalign.
+template <typename IndexType>
+void ValidateBlock(const RowBlockContainer<IndexType>& b) {
+  DCT_CHECK(b.value.empty() || b.value.size() == b.index.size())
+      << "inconsistent input: some features have explicit values and some "
+         "do not (" << b.value.size() << " values for " << b.index.size()
+      << " features)";
+  DCT_CHECK(b.weight.empty() || b.weight.size() == b.label.size())
+      << "inconsistent input: only " << b.weight.size() << " of "
+      << b.label.size() << " rows carry a label:weight";
+  DCT_CHECK(b.qid.empty() || b.qid.size() == b.label.size())
+      << "inconsistent input: only " << b.qid.size() << " of "
+      << b.label.size() << " rows carry qid:";
+  DCT_CHECK(b.field.empty() || b.field.size() == b.index.size())
+      << "inconsistent libfm input: field count mismatch";
+}
+}  // namespace
+
 template <typename IndexType>
 bool TextParserBase<IndexType>::FillBlocks(
     std::vector<RowBlockContainer<IndexType>>* blocks) {
   InputSplit::Blob chunk;
   if (!source_->NextChunk(&chunk)) return false;
-  bytes_read_ += chunk.size;
+  bytes_read_.fetch_add(chunk.size, std::memory_order_relaxed);
   const char* begin = static_cast<const char*>(chunk.dptr);
   const char* end = begin + chunk.size;
   int nworker = nthread_;
@@ -84,6 +105,7 @@ bool TextParserBase<IndexType>::FillBlocks(
   blocks->resize(nworker);
   if (nworker == 1) {
     ParseBlock(begin, end, &(*blocks)[0]);
+    ValidateBlock((*blocks)[0]);
     (*blocks)[0].UpdateMax();
     return true;
   }
@@ -108,6 +130,7 @@ bool TextParserBase<IndexType>::FillBlocks(
     workers.emplace_back([this, &cuts, blocks, &errors, i] {
       try {
         this->ParseBlock(cuts[i], cuts[i + 1], &(*blocks)[i]);
+        ValidateBlock((*blocks)[i]);
         (*blocks)[i].UpdateMax();
       } catch (...) {
         errors[i] = std::current_exception();

@@ -11,6 +11,7 @@
 #ifndef DCT_PARSER_H_
 #define DCT_PARSER_H_
 
+#include <atomic>
 #include <map>
 #include <memory>
 #include <string>
@@ -49,7 +50,9 @@ class TextParserBase : public Parser<IndexType> {
 
   void BeforeFirst() override;
   const RowBlockContainer<IndexType>* NextBlock() override;
-  size_t BytesRead() const override { return bytes_read_; }
+  size_t BytesRead() const override {
+    return bytes_read_.load(std::memory_order_relaxed);
+  }
 
   // Parse [begin, end) — whole lines — into *out. Public for testing.
   virtual void ParseBlock(const char* begin, const char* end,
@@ -62,7 +65,8 @@ class TextParserBase : public Parser<IndexType> {
  protected:
   std::unique_ptr<InputSplit> source_;
   int nthread_;
-  size_t bytes_read_ = 0;
+  // read from the consumer thread while the ThreadedParser producer fills
+  std::atomic<size_t> bytes_read_{0};
 
  private:
   std::vector<RowBlockContainer<IndexType>> blocks_;

@@ -47,10 +47,11 @@ class PipelineIter {
   bool Next(T** out) {
     std::unique_lock<std::mutex> lock(mu_);
     cv_consumer_.wait(lock, [this] {
-      return !ready_.empty() || produced_all_ || error_ != nullptr;
+      return !ready_.empty() || produced_all_ || error_ != nullptr ||
+             shutdown_;
     });
     RethrowIfError();
-    if (ready_.empty()) return false;
+    if (shutdown_ || ready_.empty()) return false;
     *out = ready_.front();
     ready_.pop_front();
     cv_producer_.notify_one();
@@ -71,10 +72,13 @@ class PipelineIter {
   void BeforeFirst() {
     std::unique_lock<std::mutex> lock(mu_);
     DCT_CHECK(reset_fn_ != nullptr) << "PipelineIter: no reset function";
+    DCT_CHECK(!shutdown_)
+        << "PipelineIter: cannot restart after a producer error";
     reset_request_ = true;
     cv_producer_.notify_one();
-    cv_consumer_.wait(lock,
-                      [this] { return !reset_request_ || error_ != nullptr; });
+    cv_consumer_.wait(lock, [this] {
+      return !reset_request_ || error_ != nullptr || shutdown_;
+    });
     RethrowIfError();
   }
 

@@ -356,11 +356,17 @@ class RowBlock:
         self.weight = (np.ctypeslib.as_array(c.weight, (n,))
                        if c.weight else None)
         self.qid = np.ctypeslib.as_array(c.qid, (n,)) if c.qid else None
-        self.field = np.ctypeslib.as_array(c.field, (nnz,)) if c.field else None
-        idx_type = ctypes.c_uint64 if c.index_is_64 else ctypes.c_uint32
-        self.index = np.ctypeslib.as_array(
-            ctypes.cast(c.index, ctypes.POINTER(idx_type)), (nnz,))
-        self.value = np.ctypeslib.as_array(c.value, (nnz,)) if c.value else None
+        self.field = (np.ctypeslib.as_array(c.field, (nnz,))
+                      if (c.field and nnz) else None)
+        idx_dtype = np.uint64 if c.index_is_64 else np.uint32
+        if nnz == 0:  # empty vectors have NULL data()
+            self.index = np.empty(0, dtype=idx_dtype)
+        else:
+            idx_type = ctypes.c_uint64 if c.index_is_64 else ctypes.c_uint32
+            self.index = np.ctypeslib.as_array(
+                ctypes.cast(c.index, ctypes.POINTER(idx_type)), (nnz,))
+        self.value = (np.ctypeslib.as_array(c.value, (nnz,))
+                      if (c.value and nnz) else None)
         self.max_index = c.max_index
         self.max_field = c.max_field
 

@@ -1,0 +1,407 @@
+"""Device-resident row-block iteration: the heart of the TPU-native design.
+
+The reference pipeline ends at host CSR views (RowBlockIter, data.h:267);
+consumers then copy into their own matrices. Here the pipeline *ends in HBM*:
+
+  native parse threads → PaddedBatch (static shapes, numpy, pinned layout)
+        → background staging thread (double buffer)
+        → jax.device_put under a NamedSharding  → sharded jax.Array batch
+
+Static-shape strategy (XLA compiles one program per shape — SURVEY §7 hard
+part 1 "ragged → device"):
+- rows per batch is fixed (`batch_rows`); the final partial batch is padded
+  with zero-weight rows, so row count never varies.
+- nnz is bucketed to the next power of two of the batch's true nnz (floor
+  `min_nnz_bucket`), so the number of distinct compiled shapes is
+  O(log max_nnz).
+- CSR offsets become per-nonzero `row` segment ids (int32, TPU-friendly);
+  padding nonzeros point at row == rows_per_shard, a sacrificial segment
+  sliced off by the ops in dmlc_core_tpu.ops.sparse.
+
+Sharding strategy: arrays carry a leading device axis [D, ...] sharded over
+the mesh "data" axis; shard d holds rows [d*R, (d+1)*R) of the batch with
+*local* row ids — so segment ops never cross shard boundaries and DP
+gradients reduce with one psum (SURVEY §2.5).
+
+The double buffer is the ThreadedIter contract (threadediter.h:77-279)
+carried across the GIL: ctypes releases the GIL during native parsing, so the
+staging thread overlaps parse+pad with XLA compute on the main thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.base import DMLCError, log_info
+from dmlc_core_tpu.io.native import NativeParser
+from dmlc_core_tpu.tpu.sharding import batch_sharding, data_mesh
+
+__all__ = ["PaddedBatch", "DenseBatch", "DeviceRowBlockIter", "HostBatcher"]
+
+
+@dataclass
+class PaddedBatch:
+    """Static-shape CSR batch; all arrays lead with the device axis D.
+
+    row/col/val: [D, NNZ]  per-nonzero segment id (local), column, value
+    label/weight: [D, R]   weight 0 marks padding rows
+    nrows: [D]             true row count per shard
+    """
+    row: Any
+    col: Any
+    val: Any
+    label: Any
+    weight: Any
+    nrows: Any
+    # host-side true row count (not part of the device tree; avoids a
+    # device->host sync when consumers just need progress accounting)
+    total_rows: int = 0
+
+    @property
+    def rows_per_shard(self) -> int:
+        return self.label.shape[1]
+
+    @property
+    def nnz_bucket(self) -> int:
+        return self.row.shape[1]
+
+    def tree(self) -> Dict[str, Any]:
+        return {"row": self.row, "col": self.col, "val": self.val,
+                "label": self.label, "weight": self.weight,
+                "nrows": self.nrows}
+
+
+@dataclass
+class DenseBatch:
+    """Dense device layout for low-dimensional data (auto-chosen when
+    max_index is small): x is [D, R, F] — downstream matmuls tile straight
+    onto the MXU, and host->HBM transfer drops from 12 B/nnz (CSR triple) to
+    4 B/value (or 2 with bfloat16). Missing entries are 0 (the reference's
+    CSR semantics for absent features in a linear model)."""
+    x: Any
+    label: Any
+    weight: Any
+    nrows: Any
+    total_rows: int = 0
+
+    @property
+    def rows_per_shard(self) -> int:
+        return self.label.shape[1]
+
+    @property
+    def num_features(self) -> int:
+        return self.x.shape[2]
+
+    def tree(self) -> Dict[str, Any]:
+        return {"x": self.x, "label": self.label, "weight": self.weight,
+                "nrows": self.nrows}
+
+
+def _next_pow2(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+class HostBatcher:
+    """Accumulates native RowBlocks into fixed-row-count numpy batches.
+
+    Splitting/merging is needed because native blocks have arbitrary sizes
+    (one per parser worker per chunk) while the device wants `batch_rows`
+    exactly."""
+
+    def __init__(self, parser: NativeParser, batch_rows: int,
+                 num_shards: int, min_nnz_bucket: int = 4096,
+                 index64: bool = False, layout: str = "auto",
+                 dense_max_features: int = 512, dense_dtype=np.float32):
+        if batch_rows % num_shards != 0:
+            raise DMLCError(
+                f"batch_rows={batch_rows} must divide by shards={num_shards}")
+        if layout not in ("auto", "csr", "dense"):
+            raise DMLCError(f"unknown layout {layout!r}")
+        self.parser = parser
+        self.batch_rows = batch_rows
+        self.num_shards = num_shards
+        self.min_nnz_bucket = min_nnz_bucket
+        self.layout = layout
+        self.dense_max_features = dense_max_features
+        self.dense_dtype = dense_dtype
+        self._num_features: Optional[int] = None  # fixed once dense chosen
+        # leftover rows from the previous native block (numpy copies)
+        self._pending: list = []  # list of (label, weight, qid, lens, col, val)
+        self._pending_rows = 0
+        self._done = False
+
+    def _block_to_parts(self, b) -> tuple:
+        lens = np.diff(b.offset).astype(np.int32)
+        col = b.index.astype(np.int32, copy=True)
+        val = (b.value.astype(np.float32, copy=True) if b.value is not None
+               else np.ones(b.nnz, dtype=np.float32))
+        label = b.label.astype(np.float32, copy=True)
+        weight = (b.weight.astype(np.float32, copy=True)
+                  if b.weight is not None
+                  else np.ones(b.num_rows, dtype=np.float32))
+        return label, weight, lens, col, val
+
+    def next_batch(self) -> Optional[PaddedBatch]:
+        """Produce the next PaddedBatch of numpy arrays (None at end)."""
+        while self._pending_rows < self.batch_rows and not self._done:
+            b = self.parser.next_block()
+            if b is None:
+                self._done = True
+                break
+            self._pending.append(self._block_to_parts(b))
+            self._pending_rows += len(self._pending[-1][0])
+        if self._pending_rows == 0:
+            return None
+
+        take = min(self.batch_rows, self._pending_rows)
+        labels, weights, lens_list, cols, vals = [], [], [], [], []
+        got = 0
+        while got < take:
+            label, weight, lens, col, val = self._pending[0]
+            n = len(label)
+            if got + n <= take:
+                self._pending.pop(0)
+                labels.append(label)
+                weights.append(weight)
+                lens_list.append(lens)
+                cols.append(col)
+                vals.append(val)
+                got += n
+            else:
+                keep = take - got
+                nnz_keep = int(lens[:keep].sum())
+                labels.append(label[:keep])
+                weights.append(weight[:keep])
+                lens_list.append(lens[:keep])
+                cols.append(col[:nnz_keep])
+                vals.append(val[:nnz_keep])
+                self._pending[0] = (label[keep:], weight[keep:], lens[keep:],
+                                    col[nnz_keep:], val[nnz_keep:])
+                got = take
+        self._pending_rows -= take
+
+        label = np.concatenate(labels)
+        weight = np.concatenate(weights)
+        lens = np.concatenate(lens_list)
+        col = np.concatenate(cols)
+        val = np.concatenate(vals)
+
+        D = self.num_shards
+        R = self.batch_rows // D
+        # pad rows to full batch (weight 0 ⇒ no gradient contribution)
+        if take < self.batch_rows:
+            pad = self.batch_rows - take
+            label = np.concatenate([label, np.zeros(pad, np.float32)])
+            weight = np.concatenate([weight, np.zeros(pad, np.float32)])
+            lens = np.concatenate([lens, np.zeros(pad, np.int32)])
+
+        if self.layout == "auto":
+            # decide once, on the first batch: dense when the feature space
+            # is small (the MXU path); sticky so device shapes stay static
+            max_idx = int(col.max()) if len(col) else 0
+            self.layout = ("dense" if max_idx + 1 <= self.dense_max_features
+                           else "csr")
+        if self.layout == "dense":
+            return self._emit_dense(take, label, weight, lens, col, val)
+
+        # split nnz by shard; bucket to the max shard nnz
+        row_of = np.repeat(np.arange(self.batch_rows, dtype=np.int32), lens)
+        shard_starts = np.concatenate(
+            [[0], np.cumsum(lens.reshape(D, R).sum(axis=1))]).astype(np.int64)
+        shard_nnz = np.diff(shard_starts)
+        bucket = _next_pow2(int(shard_nnz.max()) if take else 1,
+                            self.min_nnz_bucket)
+
+        row = np.full((D, bucket), R, dtype=np.int32)  # R = padding segment
+        colp = np.zeros((D, bucket), dtype=np.int32)
+        valp = np.zeros((D, bucket), dtype=np.float32)
+        for d in range(D):
+            lo, hi = shard_starts[d], shard_starts[d + 1]
+            n = hi - lo
+            row[d, :n] = row_of[lo:hi] - d * R  # local row ids
+            colp[d, :n] = col[lo:hi]
+            valp[d, :n] = val[lo:hi]
+
+        nrows = np.minimum(
+            np.maximum(take - np.arange(D) * R, 0), R).astype(np.int32)
+        return PaddedBatch(
+            row=row, col=colp, val=valp,
+            label=label.reshape(D, R), weight=weight.reshape(D, R),
+            nrows=nrows, total_rows=int(take))
+
+    def _emit_dense(self, take, label, weight, lens, col, val):
+        D = self.num_shards
+        R = self.batch_rows // D
+        if self._num_features is None:
+            self._num_features = int(col.max()) + 1 if len(col) else 1
+        F = self._num_features
+        mx = int(col.max()) + 1 if len(col) else 1
+        if mx > F:
+            raise DMLCError(
+                f"dense layout fixed at {F} features but saw index {mx - 1}; "
+                f"pass layout='csr' or a larger dense_max_features")
+        x = np.zeros((self.batch_rows, F), dtype=self.dense_dtype)
+        row_of = np.repeat(np.arange(self.batch_rows, dtype=np.int64), lens)
+        x[row_of, col] = val
+        nrows = np.minimum(
+            np.maximum(take - np.arange(D) * R, 0), R).astype(np.int32)
+        return DenseBatch(
+            x=x.reshape(D, R, F),
+            label=label.reshape(D, R), weight=weight.reshape(D, R),
+            nrows=nrows, total_rows=int(take))
+
+    def reset(self) -> None:
+        self.parser.before_first()
+        self._pending.clear()
+        self._pending_rows = 0
+        self._done = False
+
+
+class DeviceRowBlockIter:
+    """HBM-resident row-block iterator (the TPU-native RowBlockIter).
+
+    reference RowBlockIter<I,D>::Create (data.h:267) parity surface: iterate
+    batches, before_first(), bytes_read(); plus device placement. A staging
+    thread runs parse+pad (double buffer, capacity `prefetch`); the consumer
+    thread issues device_put — by the time XLA finishes step k, batch k+1 is
+    staged or already on device.
+    """
+
+    def __init__(self, uri: str, part: int = 0, npart: int = 1,
+                 fmt: str = "auto", batch_rows: int = 65536,
+                 mesh=None, min_nnz_bucket: int = 4096,
+                 index64: bool = False, nthread: int = 0,
+                 prefetch: int = 2, to_device: bool = True,
+                 layout: str = "auto", dense_max_features: int = 512,
+                 dense_dtype=np.float32):
+        self.parser = NativeParser(uri, part=part, npart=npart, fmt=fmt,
+                                   nthread=nthread, index64=index64)
+        self.mesh = mesh
+        self.to_device = to_device
+        num_shards = 1 if mesh is None else int(mesh.devices.size)
+        self.batcher = HostBatcher(self.parser, batch_rows, num_shards,
+                                   min_nnz_bucket, index64, layout=layout,
+                                   dense_max_features=dense_max_features,
+                                   dense_dtype=dense_dtype)
+        self.sharding = None if mesh is None else batch_sharding(mesh)
+        self._prefetch = prefetch
+        # two-stage pipeline: parse+pad thread -> _host_q -> transfer thread
+        # -> _queue -> consumer. Parsing of batch k+1 overlaps the host->HBM
+        # transfer of batch k, which overlaps XLA compute of batch k-1.
+        self._host_q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self._thread: Optional[threading.Thread] = None
+        self._xfer_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- staging threads -----------------------------------------------------
+    def _parse_loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                batch = self.batcher.next_batch()
+                self._host_q.put(batch)  # None terminates
+                if batch is None:
+                    return
+        except BaseException as e:  # propagate through the transfer stage
+            self._host_q.put(e)
+
+    def _transfer_loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                item = self._host_q.get()
+                if isinstance(item, BaseException):
+                    self._queue.put(item)
+                    return
+                if item is not None:
+                    item = self._device_put(item)
+                self._queue.put(item)
+                if item is None:
+                    return
+        except BaseException as e:
+            self._queue.put(e)
+
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._parse_loop,
+                                            daemon=True)
+            self._xfer_thread = threading.Thread(target=self._transfer_loop,
+                                                 daemon=True)
+            self._thread.start()
+            self._xfer_thread.start()
+
+    def _device_put(self, batch: PaddedBatch) -> PaddedBatch:
+        if not self.to_device:
+            return batch
+        tree = batch.tree()
+        if self.sharding is not None:
+            tree = jax.device_put(tree, self.sharding)
+        else:
+            tree = jax.device_put(tree)
+        cls = type(batch)
+        return cls(total_rows=batch.total_rows, **tree)
+
+    def __iter__(self) -> Iterator[PaddedBatch]:
+        self._ensure_started()
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._thread = None
+                self._xfer_thread = None
+                return
+            if isinstance(item, BaseException):
+                self._thread = None
+                self._xfer_thread = None
+                raise item
+            yield item
+
+    def _join_threads(self) -> None:
+        self._stop.set()
+        for th, q in ((self._thread, self._host_q),
+                      (self._xfer_thread, self._queue)):
+            if th is None:
+                continue
+            while th.is_alive():
+                try:  # drain so a blocked put can finish
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                th.join(timeout=0.02)
+        self._thread = None
+        self._xfer_thread = None
+        for q in (self._host_q, self._queue):
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        self._stop.clear()
+
+    def before_first(self) -> None:
+        """Restart iteration (reference DataIter::BeforeFirst)."""
+        self._join_threads()
+        self.batcher.reset()
+
+    def bytes_read(self) -> int:
+        return self.parser.bytes_read()
+
+    def close(self) -> None:
+        self._join_threads()
+        self.parser.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

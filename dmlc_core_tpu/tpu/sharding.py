@@ -1,0 +1,62 @@
+"""Mesh and sharding helpers for the device-resident data path.
+
+This is the TPU-native replacement for the reference's rank math: where
+dmlc-core hands each worker a (part_index, num_parts) byte-range
+(reference io.h:261 InputSplit::Create + input_split_base.cc:30-64) and the
+Rabit tracker computes allreduce topologies over sockets
+(tracker.py:185-252), here the topology is the `jax.sharding.Mesh` and the
+collectives are XLA's (psum over ICI) — the tracker's tree/ring computation
+disappears into hardware routing (SURVEY §2.5, §5).
+
+Conventions:
+- mesh axis "data" shards the batch (DP): each chip consumes distinct rows.
+- the host-level shard is `jax.process_index()` of `jax.process_count()` —
+  composing the byte-range InputSplit (process level) with the mesh
+  (chip level) gives the full pod-slice sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["data_mesh", "batch_sharding", "replicated_sharding",
+           "process_part", "local_device_count"]
+
+
+def data_mesh(num_devices: Optional[int] = None,
+              axis_name: str = "data") -> Mesh:
+    """A 1-D mesh over (up to) all addressable devices for data parallelism."""
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    """Shard the leading (device) axis of a batch across the mesh."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated placement (model parameters under pure DP)."""
+    return NamedSharding(mesh, P())
+
+
+def process_part(num_parts_per_process: int = 1) -> Tuple[int, int]:
+    """(part_index, num_parts) for this host's InputSplit.
+
+    The multi-host composition: every process opens the same URI with its own
+    part of `process_count` parts — the exact-cover property of ByteSplit
+    guarantees global coverage (the contract reference workers rely on,
+    SURVEY §3.2)."""
+    return jax.process_index(), max(jax.process_count(), 1)
+
+
+def local_device_count(mesh: Optional[Mesh] = None) -> int:
+    if mesh is None:
+        return jax.local_device_count()
+    return mesh.devices.size

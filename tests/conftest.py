@@ -10,10 +10,16 @@ test/unittest/unittest_inputsplit.cc:116-145).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the axon site config pins JAX_PLATFORMS=axon; override via jax.config
+# (env vars alone are not honored under /root/.axon_site)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
