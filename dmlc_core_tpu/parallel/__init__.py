@@ -1,0 +1,7 @@
+"""Parallelism layer: mesh/sharding helpers + multi-host init/collectives."""
+
+from dmlc_core_tpu.parallel.distributed import (allreduce, broadcast,
+                                                init_from_env, rank,
+                                                world_size)
+
+__all__ = ["allreduce", "broadcast", "init_from_env", "rank", "world_size"]

@@ -1,0 +1,13 @@
+"""Distributed launch layer (reference tracker/dmlc_tracker).
+
+dmlc-submit CLI + cluster backends (local/ssh/mpi/sge/slurm/tpu-pod), the
+rabit-compatible rendezvous tracker, and a worker-side client.
+"""
+
+from dmlc_core_tpu.tracker.rendezvous import (PSTracker, RabitTracker,
+                                              run_job,
+                                              start_standalone_tracker)
+from dmlc_core_tpu.tracker.client import RendezvousClient
+
+__all__ = ["RabitTracker", "PSTracker", "run_job",
+           "start_standalone_tracker", "RendezvousClient"]

@@ -1,0 +1,128 @@
+"""Worker-side rendezvous client.
+
+The reference ships only the tracker half (the worker half lives in the
+separate Rabit C++ library). This client implements the worker side of the
+same wire protocol so that (a) the tracker is testable in-process with N
+fake workers — the single-process multi-"host" simulation strategy the
+reference applies to InputSplit (SURVEY §4) — and (b) Python workers can
+join a legacy Rabit rendezvous without the C++ library.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dmlc_core_tpu.tracker.wire import MAGIC, WireSocket
+
+
+@dataclass
+class TopologyAssignment:
+    rank: int
+    parent: int
+    world_size: int
+    tree_neighbors: List[int]
+    ring_prev: int
+    ring_next: int
+    # rank -> connected peer socket (tree + ring links)
+    links: Dict[int, WireSocket] = field(default_factory=dict)
+
+
+class RendezvousClient:
+    """Speaks the tracker protocol end-to-end, including peer-link setup."""
+
+    def __init__(self, tracker_host: str, tracker_port: int,
+                 jobid: str = "NULL"):
+        self.tracker_host = tracker_host
+        self.tracker_port = tracker_port
+        self.jobid = jobid
+
+    def _dial_tracker(self, cmd: str, rank: int = -1,
+                      world_size: int = -1) -> WireSocket:
+        sock = socket.create_connection(
+            (self.tracker_host, self.tracker_port))
+        ws = WireSocket(sock)
+        ws.send_int(MAGIC)
+        got = ws.recv_int()
+        assert got == MAGIC, f"bad tracker magic {got:#x}"
+        ws.send_int(rank)
+        ws.send_int(world_size)
+        ws.send_str(self.jobid)
+        ws.send_str(cmd)
+        return ws
+
+    def log(self, message: str) -> None:
+        """Route a message through the tracker log (cmd=print,
+        reference tracker.py:269-272)."""
+        ws = self._dial_tracker("print")
+        ws.send_str(message)
+        ws.close()
+
+    def shutdown(self, rank: int) -> None:
+        ws = self._dial_tracker("shutdown", rank=rank)
+        ws.close()
+
+    def start(self, rank: int = -1, world_size: int = -1,
+              recover: bool = False) -> TopologyAssignment:
+        """Join the rendezvous: receive topology, establish peer links."""
+        ws = self._dial_tracker("recover" if recover else "start",
+                                rank=rank, world_size=world_size)
+        my_rank = ws.recv_int()
+        parent = ws.recv_int()
+        world = ws.recv_int()
+        num_tree = ws.recv_int()
+        tree_neighbors = [ws.recv_int() for _ in range(num_tree)]
+        rprev = ws.recv_int()
+        rnext = ws.recv_int()
+        assign = TopologyAssignment(my_rank, parent, world, tree_neighbors,
+                                    rprev, rnext)
+        expected = set(tree_neighbors)
+        if rprev != -1:
+            expected.add(rprev)
+        if rnext != -1:
+            expected.add(rnext)
+
+        # listen for peers that will dial us
+        listener = socket.socket()
+        listener.bind(("", 0))  # all interfaces: peers dial our tracker-seen IP
+        listener.listen(16)
+        my_port = listener.getsockname()[1]
+
+        good: Dict[int, WireSocket] = {}
+        while True:
+            ws.send_int(len(good))
+            for r in good:
+                ws.send_int(r)
+            num_dial = ws.recv_int()
+            num_wait = ws.recv_int()
+            errors = 0
+            for _ in range(num_dial):
+                host = ws.recv_str()
+                port = ws.recv_int()
+                peer_rank = ws.recv_int()
+                try:
+                    ps = WireSocket(socket.create_connection((host, port),
+                                                             timeout=10))
+                    ps.send_int(assign.rank)  # identify ourselves
+                    good[peer_rank] = ps
+                except OSError:
+                    errors += 1
+            ws.send_int(errors)
+            if errors:
+                continue
+            ws.send_int(my_port)
+            break
+
+        # accept the peers the tracker told to dial us
+        for _ in range(num_wait):
+            fd, _ = listener.accept()
+            ps = WireSocket(fd)
+            peer_rank = ps.recv_int()
+            good[peer_rank] = ps
+        listener.close()
+        assert set(good) == expected, (set(good), expected)
+        assign.links = good
+        ws.close()
+        return assign

@@ -1,0 +1,356 @@
+"""Cluster launch backends for dmlc-submit.
+
+Rebuild of reference tracker/dmlc_tracker/{local,ssh,mpi,sge,slurm}.py plus
+the new TPU-native `tpu-pod` backend (SURVEY §7 step 6, BASELINE.md north
+star). Every backend is split into a pure command-builder (unit-testable
+without a cluster) and a `submit(args)` that wires it into the rendezvous
+tracker via run_job.
+
+Env-var protocol carried to every worker (the de-facto ABI, SURVEY §2.4):
+DMLC_TRACKER_URI/PORT, DMLC_NUM_WORKER/SERVER, DMLC_ROLE, DMLC_TASK_ID,
+DMLC_JOB_CLUSTER, DMLC_NUM_ATTEMPT, DMLC_PS_ROOT_URI/PORT, DMLC_NODE_HOST,
+DMLC_INTERFACE. The tpu-pod backend adds the JAX distributed trio
+(JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) so workers
+can `jax.distributed.initialize()` with no arguments.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dmlc_core_tpu.tracker import rendezvous
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+PASSTHROUGH_ENV_KEYS = [
+    # reference ssh.py get_env keys
+    "OMP_NUM_THREADS", "KMP_AFFINITY", "LD_LIBRARY_PATH",
+    "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY", "DMLC_INTERFACE",
+    # TPU additions
+    "JAX_PLATFORMS", "TPU_WORKER_ID", "PYTHONPATH",
+]
+
+
+def parse_host_file(path: str) -> List[Tuple[str, str]]:
+    """Parse a host file into (host, ssh_port) pairs. Accepts `ip`,
+    `ip:port`, and mpi-style `ip slots=N` lines (reference ssh.py:38-60)."""
+    hosts: List[Tuple[str, str]] = []
+    with open(path) as f:
+        for raw in f:
+            h = raw.strip()
+            if not h or h.startswith("#"):
+                continue
+            i = h.find("slots=")
+            if i != -1:
+                h = h[:i].strip()
+            port = "22"
+            if ":" in h:
+                h, port = h.rsplit(":", 1)
+            hosts.append((h, port))
+    if not hosts:
+        raise ValueError(f"host file {path} contains no hosts")
+    return hosts
+
+
+def export_prefix(envs: Dict[str, object],
+                  passthrough: Sequence[str] = PASSTHROUGH_ENV_KEYS) -> str:
+    """`export K=V; ...` shell prefix (reference ssh.py get_env)."""
+    parts = []
+    for k in passthrough:
+        v = os.getenv(k)
+        if v is not None:
+            parts.append(f"export {k}={v};")
+    for k, v in envs.items():
+        parts.append(f"export {k}={v};")
+    return " ".join(parts)
+
+
+def inline_env(envs: Dict[str, object]) -> str:
+    """`K=V K=V` command prefix (reference slurm.py get_mpi_env)."""
+    return " ".join(f"{k}={v}" for k, v in envs.items())
+
+
+# -- local -------------------------------------------------------------------
+def exec_with_retry(cmd: Sequence[str], num_attempt: int, role: str,
+                    task_id: int, pass_env: Dict[str, object]) -> None:
+    """Run one worker process with the retry loop honoring DMLC_NUM_ATTEMPT
+    (reference local.py:12-49 — the worker-level failure recovery path)."""
+    cmd = list(cmd)
+    if "/" not in cmd[0] and os.path.exists(cmd[0]):
+        cmd[0] = "./" + cmd[0]
+    env = os.environ.copy()
+    for k, v in pass_env.items():
+        env[k] = str(v)
+    env["DMLC_TASK_ID"] = str(task_id)
+    env["DMLC_ROLE"] = role
+    env.setdefault("DMLC_JOB_CLUSTER", "local")
+    retries = int(env.get("DMLC_NUM_ATTEMPT", num_attempt))
+    trial = 0
+    while True:
+        env["DMLC_NUM_ATTEMPT"] = str(trial)
+        ret = subprocess.call(" ".join(cmd), shell=True, executable="/bin/bash",
+                              env=env)
+        if ret == 0:
+            return
+        trial += 1
+        retries -= 1
+        if retries < 0:
+            raise RuntimeError(
+                f"task {task_id} ({role}) failed with code {ret} after "
+                f"{trial} attempts: {' '.join(cmd)}")
+        logger.warning("task %d failed (code %d); attempt %d", task_id, ret,
+                       trial)
+
+
+def submit_local(args) -> None:
+    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        for i in range(nworker + nserver):
+            role = "worker" if i < nworker else "server"
+            t = threading.Thread(
+                target=exec_with_retry,
+                args=(args.command, args.num_attempt, role, i, dict(envs)),
+                daemon=True)
+            t.start()
+
+    rendezvous.run_job(args.num_workers, args.num_servers, launch,
+                       host_ip=args.host_ip or "auto",
+                       ps_cmd=" ".join(args.command))
+
+
+# -- ssh ---------------------------------------------------------------------
+def build_ssh_commands(hosts: List[Tuple[str, str]], command: Sequence[str],
+                       nworker: int, nserver: int, envs: Dict[str, object],
+                       working_dir: str) -> List[str]:
+    cmds = []
+    for i in range(nworker + nserver):
+        e = dict(envs)
+        e["DMLC_ROLE"] = "server" if i < nserver else "worker"
+        node, port = hosts[i % len(hosts)]
+        e["DMLC_NODE_HOST"] = node
+        inner = (export_prefix(e) + f" cd {working_dir}; " +
+                 " ".join(command))
+        cmds.append("ssh -o StrictHostKeyChecking=no " + node +
+                    " -p " + port + " '" + inner + "'")
+    return cmds
+
+
+def submit_ssh(args) -> None:
+    hosts = parse_host_file(args.host_file)
+
+    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        local_dir = os.getcwd() + "/"
+        working_dir = local_dir
+        if args.sync_dst_dir not in (None, "None"):
+            working_dir = args.sync_dst_dir
+            for node, port in hosts:  # rsync workdir (reference sync_dir)
+                subprocess.check_call(
+                    f'rsync -az --rsh="ssh -o StrictHostKeyChecking=no '
+                    f'-p {port}" {local_dir} {node}:{working_dir}',
+                    shell=True)
+        for prog in build_ssh_commands(hosts, args.command, nworker, nserver,
+                                       envs, working_dir):
+            threading.Thread(
+                target=lambda p=prog: subprocess.check_call(p, shell=True),
+                daemon=True).start()
+
+    rendezvous.run_job(args.num_workers, args.num_servers, launch,
+                       host_ip=args.host_ip or "auto",
+                       ps_cmd=" ".join(args.command))
+
+
+# -- mpi ---------------------------------------------------------------------
+def mpi_env_flags(envs: Dict[str, object], mpi_version_text: str) -> str:
+    """-x K=V (OpenMPI) or -env K V (MPICH) flags (reference mpi.py:12-37)."""
+    if "Open MPI" in mpi_version_text:
+        return " ".join(f"-x {k}={v}" for k, v in envs.items())
+    if "mpich" in mpi_version_text.lower():
+        return " ".join(f"-env {k} {v}" for k, v in envs.items())
+    raise RuntimeError("Unknown MPI version: " + mpi_version_text[:80])
+
+
+def build_mpi_command(command: Sequence[str], n: int,
+                      envs: Dict[str, object], mpi_version_text: str,
+                      host_file: Optional[str] = None) -> str:
+    cmd = f"--hostfile {host_file} " if host_file else ""
+    return (f"mpirun -n {n} {mpi_env_flags(envs, mpi_version_text)} "
+            f"{cmd}{' '.join(command)}")
+
+
+def submit_mpi(args) -> None:
+    out, _ = subprocess.Popen(["mpirun", "--version"],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE).communicate()
+    version = out.decode(errors="replace")
+
+    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        envs = dict(envs, DMLC_JOB_CLUSTER="mpi")
+        for role, n in (("worker", nworker), ("server", nserver)):
+            if n == 0:
+                continue
+            prog = build_mpi_command(args.command, n,
+                                     dict(envs, DMLC_ROLE=role), version,
+                                     args.host_file)
+            threading.Thread(
+                target=lambda p=prog: subprocess.check_call(p, shell=True),
+                daemon=True).start()
+
+    rendezvous.run_job(args.num_workers, args.num_servers, launch,
+                       host_ip=args.host_ip or "auto",
+                       ps_cmd=" ".join(args.command))
+
+
+# -- sge ---------------------------------------------------------------------
+def build_sge_script() -> str:
+    return ("source ~/.bashrc\n"
+            "export DMLC_TASK_ID=${SGE_TASK_ID}\n"
+            "export DMLC_JOB_CLUSTER=sge\n"
+            '"$@"\n')
+
+
+def build_sge_command(args, ntask: int, envs: Dict[str, object],
+                      runscript: str) -> str:
+    env_arg = ",".join(f'{k}="{v}"' for k, v in envs.items())
+    cmd = f"qsub -cwd -t 1-{ntask} -S /bin/bash"
+    if args.queue != "default":
+        cmd += f" -q {args.queue}"
+    cmd += f" -N {args.jobname}"
+    cmd += f" -e {args.log_dir} -o {args.log_dir}"
+    cmd += f" -pe orte {args.vcores}"
+    cmd += f" -v {env_arg},PATH=${{PATH}}:."
+    cmd += f" {runscript} {' '.join(args.command)}"
+    return cmd
+
+
+def submit_sge(args) -> None:
+    if args.jobname is None:
+        args.jobname = (f"dmlc{args.num_workers}." +
+                        args.command[0].split("/")[-1])
+    os.makedirs(args.log_dir, exist_ok=True)
+    runscript = os.path.join(args.log_dir, "rundmlc.sh")
+    with open(runscript, "w") as f:
+        f.write(build_sge_script())
+
+    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        cmd = build_sge_command(args, nworker + nserver, envs, runscript)
+        logger.info("%s", cmd)
+        subprocess.check_call(cmd, shell=True)
+
+    rendezvous.run_job(args.num_workers, args.num_servers, launch,
+                       host_ip=args.host_ip or "auto",
+                       ps_cmd=" ".join(args.command))
+
+
+# -- slurm -------------------------------------------------------------------
+def build_slurm_command(command: Sequence[str], n: int, nodes: int,
+                        envs: Dict[str, object]) -> str:
+    return (f"{inline_env(envs)} srun --share --exclusive=user -N {nodes} "
+            f"-n {n} {' '.join(command)}")
+
+
+def submit_slurm(args) -> None:
+    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        envs = dict(envs, DMLC_JOB_CLUSTER="slurm")
+        for role, n, nodes in (
+                ("worker", nworker, args.slurm_worker_nodes or nworker),
+                ("server", nserver, args.slurm_server_nodes or nserver)):
+            if n == 0:
+                continue
+            prog = build_slurm_command(args.command, n, nodes,
+                                       dict(envs, DMLC_ROLE=role))
+            threading.Thread(
+                target=lambda p=prog: subprocess.check_call(p, shell=True),
+                daemon=True).start()
+
+    rendezvous.run_job(args.num_workers, args.num_servers, launch,
+                       host_ip=args.host_ip or "auto",
+                       ps_cmd=" ".join(args.command))
+
+
+# -- tpu-pod -----------------------------------------------------------------
+def build_tpu_pod_env(host_index: int, hosts: List[Tuple[str, str]],
+                      coordinator_port: int, envs: Dict[str, object]
+                      ) -> Dict[str, object]:
+    """Per-host env for a TPU pod slice: process_id = host index, coordinator
+    = host 0. Workers call jax.distributed.initialize() with no args (or
+    dmlc_core_tpu.parallel.init_from_env) and shard input with
+    InputSplit(part=JAX_PROCESS_ID, nsplit=JAX_NUM_PROCESSES) — the
+    TPU-native replacement for the Rabit socket rendezvous (SURVEY §5)."""
+    e = dict(envs)
+    e["DMLC_ROLE"] = "worker"
+    e["DMLC_TASK_ID"] = host_index
+    e["DMLC_JOB_CLUSTER"] = "tpu-pod"
+    e["DMLC_NODE_HOST"] = hosts[host_index][0]
+    e["JAX_COORDINATOR_ADDRESS"] = f"{hosts[0][0]}:{coordinator_port}"
+    e["JAX_NUM_PROCESSES"] = len(hosts)
+    e["JAX_PROCESS_ID"] = host_index
+    return e
+
+
+def build_tpu_pod_commands(hosts: List[Tuple[str, str]],
+                           command: Sequence[str],
+                           envs: Dict[str, object],
+                           coordinator_port: int = 8476,
+                           working_dir: str = ".") -> List[str]:
+    cmds = []
+    for i, (node, port) in enumerate(hosts):
+        e = build_tpu_pod_env(i, hosts, coordinator_port, envs)
+        inner = (export_prefix(e) + f" cd {working_dir}; " +
+                 " ".join(command))
+        if node in ("localhost", "127.0.0.1") and port == "local":
+            cmds.append(inner)
+        else:
+            cmds.append("ssh -o StrictHostKeyChecking=no " + node +
+                        " -p " + port + " '" + inner + "'")
+    return cmds
+
+
+def submit_tpu_pod(args) -> None:
+    """Launch one process per pod-slice host; no socket tracker is needed —
+    JAX's coordination service (host 0) is the rendezvous."""
+    if args.host_file:
+        hosts = parse_host_file(args.host_file)
+        if args.num_workers and args.num_workers != len(hosts):
+            raise SystemExit(
+                f"tpu-pod: --num-workers={args.num_workers} does not match "
+                f"{len(hosts)} hosts in {args.host_file} (one process per "
+                f"pod-slice host)")
+    else:
+        # single-host slice (or local simulation): spawn workers locally
+        hosts = [("localhost", "local")] * args.num_workers
+    working_dir = args.sync_dst_dir or os.getcwd()
+    if args.sync_dst_dir not in (None, "None") and args.host_file:
+        local_dir = os.getcwd() + "/"
+        for node, port in hosts:  # ship the workdir like submit_ssh
+            subprocess.check_call(
+                f'rsync -az --rsh="ssh -o StrictHostKeyChecking=no '
+                f'-p {port}" {local_dir} {node}:{working_dir}',
+                shell=True)
+    envs = {"DMLC_NUM_WORKER": len(hosts), "DMLC_NUM_SERVER": 0}
+    cmds = build_tpu_pod_commands(hosts, args.command, envs,
+                                  args.coordinator_port, working_dir)
+    threads = []
+    for i, prog in enumerate(cmds):
+        # localhost simulation needs per-process env rather than ssh export
+        t = threading.Thread(
+            target=lambda p=prog: subprocess.check_call(
+                p, shell=True, executable="/bin/bash"),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+
+
+BACKENDS = {
+    "local": submit_local,
+    "ssh": submit_ssh,
+    "mpi": submit_mpi,
+    "sge": submit_sge,
+    "slurm": submit_slurm,
+    "tpu-pod": submit_tpu_pod,
+}

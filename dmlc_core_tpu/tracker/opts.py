@@ -1,0 +1,60 @@
+"""Argument parsing for dmlc-submit (reference tracker/dmlc_tracker/opts.py).
+
+Cluster choices mirror opts.py:71-143 with `tpu-pod` added; the
+DMLC_SUBMIT_CLUSTER env default is preserved (opts.py:170-176).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dmlc-submit",
+        description="Submit a distributed dmlc_core_tpu job")
+    default_cluster = os.getenv("DMLC_SUBMIT_CLUSTER")
+    p.add_argument("--cluster", default=default_cluster,
+                   choices=["local", "ssh", "mpi", "sge", "slurm", "tpu-pod"],
+                   help="cluster backend (env default DMLC_SUBMIT_CLUSTER)")
+    p.add_argument("--num-workers", required=True, type=int,
+                   help="number of worker processes")
+    p.add_argument("--num-servers", default=0, type=int,
+                   help="number of parameter-server processes")
+    p.add_argument("--host-ip", default=None, type=str,
+                   help="tracker host IP override")
+    p.add_argument("--host-file", default=None, type=str,
+                   help="host list for ssh/mpi/tpu-pod backends")
+    p.add_argument("--jobname", default=None, type=str)
+    p.add_argument("--queue", default="default", type=str,
+                   help="sge queue")
+    p.add_argument("--vcores", default=1, type=int,
+                   help="cores requested per task (sge)")
+    p.add_argument("--log-dir", default="dmlc_logs", type=str)
+    p.add_argument("--log-level", default="INFO",
+                   choices=["INFO", "DEBUG"])
+    p.add_argument("--sync-dst-dir", default=None, type=str,
+                   help="remote working dir (ssh/tpu-pod rsync target)")
+    p.add_argument("--num-attempt", default=0, type=int,
+                   help="retry attempts per worker (local backend)")
+    p.add_argument("--slurm-worker-nodes", default=None, type=int)
+    p.add_argument("--slurm-server-nodes", default=None, type=int)
+    p.add_argument("--coordinator-port", default=8476, type=int,
+                   help="JAX coordination service port (tpu-pod)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every worker")
+    return p
+
+
+def get_opts(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    args = build_parser().parse_args(argv)
+    if args.cluster is None:
+        raise SystemExit(
+            "--cluster is required (or set DMLC_SUBMIT_CLUSTER)")
+    if not args.command:
+        raise SystemExit("no command given")
+    while args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    return args

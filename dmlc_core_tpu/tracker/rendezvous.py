@@ -1,0 +1,314 @@
+"""Rendezvous services: RabitTracker (tree/ring brokering) and PSTracker.
+
+Behavior-compatible rebuild of reference tracker/dmlc_tracker/tracker.py:
+- RabitTracker accepts worker connections, assigns ranks in host-sorted
+  batches, serves tree/parent/ring topology, and brokers peer (host, port)
+  handoffs until every link is up (tracker.py:254-320 accept loop,
+  :80-135 assign_rank); supports print/shutdown/start/recover commands —
+  `recover` re-links a restarted worker under its old rank (the failure-
+  recovery path, SURVEY §5).
+- PSTracker spawns the parameter-server scheduler process with
+  DMLC_ROLE=scheduler + DMLC_PS_ROOT_URI/PORT (tracker.py:336-386).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dmlc_core_tpu.tracker import topology
+from dmlc_core_tpu.tracker.wire import (MAGIC, WireSocket, bind_free_port,
+                                        guess_host_ip, resolve_ip)
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+class WorkerConn:
+    """One accepted worker connection (reference SlaveEntry)."""
+
+    def __init__(self, sock, addr):
+        self.sock = WireSocket(sock)
+        self.host = resolve_ip(addr[0])
+        magic = self.sock.recv_int()
+        if magic != MAGIC:
+            raise ConnectionError(
+                f"invalid magic {magic:#x} from {self.host}")
+        self.sock.send_int(MAGIC)
+        self.rank = self.sock.recv_int()
+        self.world_size = self.sock.recv_int()
+        self.jobid = self.sock.recv_str()
+        self.cmd = self.sock.recv_str()
+        self.wait_accept = 0
+        self.port: Optional[int] = None
+
+    def decide_rank(self, job_map: Dict[str, int]) -> int:
+        if self.rank >= 0:
+            return self.rank
+        if self.jobid != "NULL" and self.jobid in job_map:
+            return job_map[self.jobid]
+        return -1
+
+    def assign_rank(self, rank: int, wait_conn: Dict[int, "WorkerConn"],
+                    tree_map, parent_map, ring_map) -> List[int]:
+        """Send the topology assignment and broker peer connections.
+
+        Returns ranks whose pending-accept count dropped to zero."""
+        self.rank = rank
+        neighbors = set(tree_map[rank])
+        rprev, rnext = ring_map[rank]
+        out = self.sock
+        out.send_int(rank)
+        out.send_int(parent_map[rank])
+        out.send_int(len(tree_map))  # world size
+        out.send_int(len(neighbors))
+        for r in neighbors:
+            out.send_int(r)
+        for ring_peer in (rprev, rnext):
+            if ring_peer != -1 and ring_peer != rank:
+                neighbors.add(ring_peer)
+                out.send_int(ring_peer)
+            else:
+                out.send_int(-1)
+        while True:
+            ngood = out.recv_int()
+            good = {out.recv_int() for _ in range(ngood)}
+            assert good.issubset(neighbors), (good, neighbors)
+            bad = neighbors - good
+            # peers already listening that this worker should dial
+            dial = [r for r in bad if r in wait_conn]
+            out.send_int(len(dial))
+            out.send_int(len(bad) - len(dial))
+            for r in dial:
+                out.send_str(wait_conn[r].host)
+                out.send_int(wait_conn[r].port)
+                out.send_int(r)
+            nerr = out.recv_int()
+            if nerr != 0:
+                continue  # worker retries the handshake round
+            self.port = out.recv_int()
+            done = []
+            for r in dial:
+                wait_conn[r].wait_accept -= 1
+                if wait_conn[r].wait_accept == 0:
+                    done.append(r)
+            for r in done:
+                wait_conn.pop(r, None)
+            self.wait_accept = len(bad) - len(dial)
+            return done
+
+
+class RabitTracker:
+    """The rendezvous server legacy Rabit workers dial into."""
+
+    def __init__(self, host_ip: str, num_workers: int, port: int = 9091,
+                 port_end: int = 9999):
+        self.host_ip = host_ip
+        self.num_workers = num_workers
+        self.listener = bind_free_port(host_ip, port, port_end)
+        self.port = self.listener.getsockname()[1]
+        self.listener.listen(256)
+        self.thread: Optional[threading.Thread] = None
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        self.fatal_error: Optional[BaseException] = None
+        logger.info("tracker listening on %s:%d", host_ip, self.port)
+
+    def worker_envs(self) -> Dict[str, object]:
+        """Env vars every worker needs (reference slave_envs,
+        tracker.py:177-183)."""
+        return {"DMLC_TRACKER_URI": self.host_ip,
+                "DMLC_TRACKER_PORT": self.port}
+
+    def _serve(self, num_workers: int) -> None:
+        shutdown: Dict[int, WorkerConn] = {}
+        wait_conn: Dict[int, WorkerConn] = {}
+        job_map: Dict[str, int] = {}
+        pending: List[WorkerConn] = []
+        todo: List[int] = []
+        maps = None
+
+        while len(shutdown) != num_workers:
+            fd, addr = self.listener.accept()
+            try:
+                conn = WorkerConn(fd, addr)
+            except ConnectionError as e:
+                logger.warning("rejected connection: %s", e)
+                fd.close()
+                continue
+            if conn.cmd == "print":
+                logger.info("%s", conn.sock.recv_str().strip())
+                continue
+            if conn.cmd == "shutdown":
+                assert conn.rank >= 0 and conn.rank not in shutdown
+                shutdown[conn.rank] = conn
+                logger.debug("rank %d shut down", conn.rank)
+                continue
+            if conn.cmd not in ("start", "recover"):
+                logger.warning("unknown command %r from %s", conn.cmd,
+                               conn.host)
+                conn.sock.close()
+                continue
+            if maps is None:
+                assert conn.cmd == "start"
+                if conn.world_size > 0:
+                    num_workers = conn.world_size
+                maps = topology.build_link_maps(num_workers)
+                todo = list(range(num_workers))
+            else:
+                assert conn.world_size in (-1, num_workers)
+            if conn.cmd == "recover":
+                assert conn.rank >= 0
+
+            rank = conn.decide_rank(job_map)
+            if rank == -1:
+                todo_pending = len(todo)
+                pending.append(conn)
+                if len(pending) == todo_pending:
+                    # batch assignment sorted by host for locality
+                    # (reference tracker.py:292-304)
+                    pending.sort(key=lambda c: c.host)
+                    for c in pending:
+                        r = todo.pop(0)
+                        if c.jobid != "NULL":
+                            job_map[c.jobid] = r
+                        # a worker dying mid-handshake must not kill the
+                        # tracker: it can reconnect with cmd=recover
+                        try:
+                            c.assign_rank(r, wait_conn, *maps)
+                        except (ConnectionError, OSError) as e:
+                            logger.warning(
+                                "worker %s died during rank %d handshake: "
+                                "%s (awaiting recover)", c.host, r, e)
+                            continue
+                        if c.wait_accept > 0:
+                            wait_conn[r] = c
+                        logger.debug("assigned rank %d to %s", r, c.host)
+                    pending.clear()
+                if not todo:
+                    logger.info("@tracker all %d workers started",
+                                num_workers)
+                    self.start_time = time.time()
+            else:
+                try:
+                    conn.assign_rank(rank, wait_conn, *maps)
+                except (ConnectionError, OSError) as e:
+                    logger.warning(
+                        "worker %s died during %s of rank %d: %s",
+                        conn.host, conn.cmd, rank, e)
+                    continue
+                if conn.wait_accept > 0:
+                    wait_conn[rank] = conn
+                logger.debug("%s rank %d re-linked", conn.cmd, rank)
+        self.end_time = time.time()
+        logger.info("@tracker all workers finished")
+        if self.start_time is not None:
+            logger.info("@tracker %.3f secs between start and finish",
+                        self.end_time - self.start_time)
+
+    def start(self) -> None:
+        def guarded():
+            try:
+                self._serve(self.num_workers)
+            except BaseException as e:  # surfaced by join()
+                self.fatal_error = e
+                logger.error("tracker failed: %s", e)
+        self.thread = threading.Thread(target=guarded, daemon=True)
+        self.thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.thread is not None and self.thread.is_alive():
+            self.thread.join(0.1)
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError("tracker did not finish in time")
+        if self.fatal_error is not None:
+            raise RuntimeError("tracker serve loop failed") \
+                from self.fatal_error
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+
+class PSTracker:
+    """Launches the parameter-server scheduler (reference PSTracker)."""
+
+    def __init__(self, host_ip: str, cmd: Optional[str],
+                 port: int = 9091, port_end: int = 9999,
+                 envs: Optional[Dict[str, object]] = None):
+        self.cmd = cmd
+        self.host_ip = host_ip
+        self.thread: Optional[threading.Thread] = None
+        if cmd is None:
+            return
+        sock = bind_free_port("", port, port_end)
+        self.port = sock.getsockname()[1]
+        sock.close()  # scheduler process will re-bind it
+        env = os.environ.copy()
+        env["DMLC_ROLE"] = "scheduler"
+        env["DMLC_PS_ROOT_URI"] = str(host_ip)
+        env["DMLC_PS_ROOT_PORT"] = str(self.port)
+        for k, v in (envs or {}).items():
+            env[k] = str(v)
+        self.thread = threading.Thread(
+            target=lambda: subprocess.check_call(
+                self.cmd, env=env, shell=True, executable="/bin/bash"),
+            daemon=True)
+        self.thread.start()
+
+    def worker_envs(self) -> Dict[str, object]:
+        if self.cmd is None:
+            return {}
+        return {"DMLC_PS_ROOT_URI": self.host_ip,
+                "DMLC_PS_ROOT_PORT": self.port}
+
+    def join(self) -> None:
+        if self.thread is not None:
+            while self.thread.is_alive():
+                self.thread.join(0.1)
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+
+def run_job(num_workers: int, num_servers: int, launch_fn, host_ip="auto",
+            ps_cmd: Optional[str] = None) -> None:
+    """Start the right tracker and hand worker envs to a cluster launcher
+    (reference tracker.submit, tracker.py:410-433)."""
+    host_ip = guess_host_ip(host_ip)
+    envs = {"DMLC_NUM_WORKER": num_workers,
+            "DMLC_NUM_SERVER": num_servers}
+    if num_servers == 0:
+        tracker = RabitTracker(host_ip, num_workers)
+        envs.update(tracker.worker_envs())
+        tracker.start()
+        if tracker.alive():
+            launch_fn(num_workers, num_servers, envs)
+        tracker.join()
+    else:
+        ps = PSTracker(host_ip, ps_cmd, envs=envs)
+        envs.update(ps.worker_envs())
+        if ps.alive() or ps.cmd is None:
+            launch_fn(num_workers, num_servers, envs)
+        ps.join()
+
+
+def start_standalone_tracker(num_workers: int, num_servers: int = 0,
+                             host_ip=None) -> None:
+    """Print the env block and serve (reference start_rabit_tracker,
+    tracker.py:435-453)."""
+    import sys
+    envs = {"DMLC_NUM_WORKER": num_workers,
+            "DMLC_NUM_SERVER": num_servers}
+    tracker = RabitTracker(guess_host_ip(host_ip), num_workers)
+    envs.update(tracker.worker_envs())
+    tracker.start()
+    sys.stdout.write("DMLC_TRACKER_ENV_START\n")
+    for k, v in envs.items():
+        sys.stdout.write(f"{k}={v}\n")
+    sys.stdout.write("DMLC_TRACKER_ENV_END\n")
+    sys.stdout.flush()
+    tracker.join()

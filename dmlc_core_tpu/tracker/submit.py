@@ -1,0 +1,31 @@
+"""dmlc-submit entry point (reference tracker/dmlc_tracker/submit.py).
+
+Usage::
+
+    python -m dmlc_core_tpu.tracker.submit --cluster=local \
+        --num-workers=4 -- my_worker.py args...
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import List, Optional
+
+from dmlc_core_tpu.tracker.launchers import BACKENDS
+from dmlc_core_tpu.tracker.opts import get_opts
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = get_opts(argv)
+    logging.basicConfig(
+        format="%(asctime)s %(levelname)s %(message)s",
+        level=getattr(logging, args.log_level))
+    backend = BACKENDS.get(args.cluster)
+    if backend is None:
+        raise SystemExit(f"unknown cluster backend {args.cluster!r}")
+    backend(args)
+
+
+if __name__ == "__main__":
+    main()

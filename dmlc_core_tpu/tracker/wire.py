@@ -1,0 +1,94 @@
+"""Rabit tracker wire protocol primitives.
+
+Byte-compatible with the reference protocol (tracker/dmlc_tracker/
+tracker.py:24-50 ExSocket + kMagic handshake) so legacy Rabit workers can
+rendezvous against this tracker: native-endian 4-byte ints, length-prefixed
+UTF-8 strings, magic 0xff99 exchanged on connect.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+MAGIC = 0xFF99
+
+
+class WireSocket:
+    """Length-prefixed int/str framing over a TCP socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def recv_all(self, nbytes: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < nbytes:
+            chunk = self.sock.recv(min(nbytes - got, 4096))
+            if not chunk:
+                raise ConnectionError("peer closed during recv")
+            got += len(chunk)
+            chunks.append(chunk)
+        return b"".join(chunks)
+
+    def recv_int(self) -> int:
+        return struct.unpack("@i", self.recv_all(4))[0]
+
+    def send_int(self, v: int) -> None:
+        self.sock.sendall(struct.pack("@i", v))
+
+    def recv_str(self) -> str:
+        n = self.recv_int()
+        return self.recv_all(n).decode()
+
+    def send_str(self, s: str) -> None:
+        data = s.encode()
+        self.send_int(len(data))  # byte count, not character count
+        self.sock.sendall(data)
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+def resolve_ip(host: str) -> str:
+    return socket.getaddrinfo(host, None)[0][4][0]
+
+
+def addr_family(addr: str):
+    return socket.getaddrinfo(addr, None)[0][0]
+
+
+def guess_host_ip(host_ip=None) -> str:
+    """Best-effort routable IP (reference tracker.py get_host_ip)."""
+    if host_ip not in (None, "auto", "ip", "dns"):
+        return host_ip
+    if host_ip == "dns":
+        return socket.getfqdn()
+    try:
+        ip = socket.gethostbyname(socket.getfqdn())
+    except socket.gaierror:
+        ip = socket.gethostbyname(socket.gethostname())
+    if ip.startswith("127."):
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect(("10.255.255.255", 1))  # no traffic sent
+            ip = probe.getsockname()[0]
+        except OSError:
+            ip = "127.0.0.1"
+        finally:
+            probe.close()
+    return ip
+
+
+def bind_free_port(host: str, port_start: int = 9091, port_end: int = 9999
+                   ) -> socket.socket:
+    """Bind a listening socket on the first free port in the scan range
+    (reference tracker.py:141-153)."""
+    sock = socket.socket(addr_family(host), socket.SOCK_STREAM)
+    for port in range(port_start, port_end):
+        try:
+            sock.bind((host, port))
+            return sock
+        except OSError:
+            continue
+    raise OSError(f"no free port in [{port_start}, {port_end})")
