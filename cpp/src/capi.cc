@@ -159,6 +159,20 @@ int dct_split_create(const char* uri, unsigned part, unsigned nsplit,
   });
 }
 
+// full-option factory: indexed recordio, shuffle, caching, coarse shuffle
+int dct_split_create_ex(const char* uri, const char* index_uri, unsigned part,
+                        unsigned nsplit, const char* type, int threaded,
+                        int shuffle, int seed, size_t batch_size,
+                        const char* cache_file, unsigned shuffle_parts,
+                        int recurse, dct_split_t* out) {
+  return Guard([&] {
+    *out = dct::InputSplit::Create(
+        uri, part, nsplit, type, index_uri == nullptr ? "" : index_uri,
+        shuffle != 0, seed, batch_size, recurse != 0, threaded != 0,
+        cache_file == nullptr ? "" : cache_file, shuffle_parts);
+  });
+}
+
 int dct_split_next_record(dct_split_t h, const void** data, size_t* size,
                           int* has) {
   return Guard([&] {

@@ -5,8 +5,11 @@
 #include "input_split.h"
 
 #include <algorithm>
+#include <cstdio>
 #include <cstring>
+#include <random>
 
+#include "numparse.h"
 #include "recordio.h"
 
 namespace dct {
@@ -33,14 +36,12 @@ std::string BaseName(const std::string& path) {
 }  // namespace
 
 // --------------------------------------------------------------------------
-ByteSplit::ByteSplit(const std::string& uri, unsigned align_bytes,
-                     bool is_text, bool recurse_directories)
-    : chunk_size_(size_t(8) << 20),
-      align_bytes_(align_bytes),
-      is_text_(is_text) {
-  // Expand ';'-separated URIs; directories list their contents; a '*' in the
-  // last path component globs within its directory
-  // (reference input_split_base.cc:96-147 InitInputFileInfo).
+// Expand ';'-separated URIs; directories list their contents; a '*' in the
+// last path component globs within its directory
+// (reference input_split_base.cc:96-147 InitInputFileInfo).
+std::vector<FileInfo> ExpandFileList(const std::string& uri,
+                                     bool recurse_directories) {
+  std::vector<FileInfo> files_;
   for (const std::string& piece : StrSplit(uri, ';')) {
     if (piece.empty()) continue;
     URI u(piece);
@@ -88,6 +89,15 @@ ByteSplit::ByteSplit(const std::string& uri, unsigned align_bytes,
     }
   }
   DCT_CHECK(!files_.empty()) << "no non-empty input files match uri: " << uri;
+  return files_;
+}
+
+ByteSplit::ByteSplit(const std::string& uri, unsigned align_bytes,
+                     bool is_text, bool recurse_directories)
+    : chunk_size_(size_t(8) << 20),
+      align_bytes_(align_bytes),
+      is_text_(is_text) {
+  files_ = ExpandFileList(uri, recurse_directories);
   file_start_.resize(files_.size());
   size_t acc = 0;
   for (size_t i = 0; i < files_.size(); ++i) {
@@ -329,12 +339,14 @@ size_t RecordIOSplit::FindLastRecordHead(const char* begin, const char* end) {
   }
 }
 
-bool RecordIOSplit::ExtractRecordAt(char* data, size_t valid, size_t* cursor,
-                                    Blob* out) {
+// Shared recordio frame extraction (multi-part reassembly into *assembled).
+bool ExtractRecordIOFrame(char* data, size_t valid, size_t* cursor,
+                          InputSplit::Blob* out, std::string* assembled) {
   if (*cursor + 8 > valid) {
     *cursor = valid;
     return false;
   }
+  std::string& assembled_ = *assembled;
   assembled_.clear();
   bool multipart = false;
   while (true) {
@@ -376,9 +388,321 @@ bool RecordIOSplit::ExtractRecordAt(char* data, size_t valid, size_t* cursor,
   }
 }
 
+bool RecordIOSplit::ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                                    Blob* out) {
+  return ExtractRecordIOFrame(data, valid, cursor, out, &assembled_);
+}
+
 // --------------------------------------------------------------------------
-PrefetchSplit::PrefetchSplit(ByteSplit* base, size_t capacity)
-    : base_(base), pipe_(capacity) {}
+// IndexedRecordIOSplit
+IndexedRecordIOSplit::IndexedRecordIOSplit(
+    const std::string& uri, const std::string& index_uri, unsigned part,
+    unsigned nsplit, size_t batch_size, bool shuffle, int seed,
+    bool recurse_directories)
+    : batch_size_(std::max<size_t>(batch_size, 1)),
+      shuffle_(shuffle),
+      seed_(seed) {
+  files_ = ExpandFileList(uri, recurse_directories);
+  file_start_.resize(files_.size());
+  size_t acc = 0;
+  for (size_t i = 0; i < files_.size(); ++i) {
+    file_start_[i] = acc;
+    acc += files_[i].size;
+  }
+  total_size_ = acc;
+  // index file: text `record_index byte_offset` pairs; offsets sorted and
+  // differenced into (offset, size) records
+  // (reference indexed_recordio_split.cc:43-62)
+  std::vector<FileInfo> idx_files = ExpandFileList(index_uri, false);
+  DCT_CHECK_EQ(idx_files.size(), size_t(1))
+      << "indexed_recordio supports exactly one index file";
+  std::unique_ptr<SeekStream> fi(
+      FileSystem::GetInstance(idx_files[0].path)
+          ->OpenForRead(idx_files[0].path));
+  std::string text(idx_files[0].size, '\0');
+  fi->ReadExact(&text[0], text.size());
+  std::vector<size_t> offsets;
+  const char* p = text.data();
+  const char* end = p + text.size();
+  while (p < end) {
+    uint64_t idx_v, ofs_v;
+    while (p < end && (*p == ' ' || *p == '\n' || *p == '\r' || *p == '\t'))
+      ++p;
+    if (p >= end) break;
+    const char* q;
+    DCT_CHECK(ParseNum<uint64_t>(p, end, &q, &idx_v)) << "bad index file";
+    p = q;
+    while (p < end && (*p == ' ' || *p == '\t')) ++p;
+    DCT_CHECK(ParseNum<uint64_t>(p, end, &q, &ofs_v)) << "bad index file";
+    p = q;
+    offsets.push_back(ofs_v);
+  }
+  DCT_CHECK(!offsets.empty()) << "empty index file " << index_uri;
+  std::sort(offsets.begin(), offsets.end());
+  for (size_t j = 0; j + 1 < offsets.size(); ++j) {
+    index_.emplace_back(offsets[j], offsets[j + 1] - offsets[j]);
+  }
+  index_.emplace_back(offsets.back(), total_size_ - offsets.back());
+  ResetPartition(part, nsplit);
+}
+
+void IndexedRecordIOSplit::ResetPartition(unsigned rank, unsigned nsplit) {
+  DCT_CHECK_LT(rank, nsplit) << "part index out of range";
+  // partition BY RECORD COUNT, not bytes
+  // (reference indexed_recordio_split.cc:12-41)
+  size_t n = index_.size();
+  size_t step = (n + nsplit - 1) / nsplit;
+  lo_ = std::min(n, step * rank);
+  hi_ = std::min(n, step * (rank + 1));
+  epoch_ = 0;
+  BeforeFirst();
+}
+
+void IndexedRecordIOSplit::BeforeFirst() {
+  order_.resize(hi_ - lo_);
+  for (size_t i = 0; i < order_.size(); ++i) order_[i] = lo_ + i;
+  if (shuffle_) {
+    // fresh permutation every epoch (reference BeforeFirst reshuffle,
+    // kRandMagic = 111)
+    std::mt19937 rng(111 + seed_ + static_cast<int>(epoch_));
+    std::shuffle(order_.begin(), order_.end(), rng);
+    ++epoch_;
+  }
+  next_rec_ = 0;
+  chunk_.clear();
+  cursor_ = 0;
+}
+
+void IndexedRecordIOSplit::ReadSpanAt(size_t global_ofs, char* dst,
+                                      size_t size) {
+  size_t k =
+      std::upper_bound(file_start_.begin(), file_start_.end(), global_ofs) -
+      file_start_.begin() - 1;
+  size_t local = global_ofs - file_start_[k];
+  while (size != 0) {
+    DCT_CHECK_LT(k, files_.size()) << "record extends past data";
+    if (open_file_ != k || open_stream_ == nullptr) {
+      open_stream_.reset(FileSystem::GetInstance(files_[k].path)
+                             ->OpenForRead(files_[k].path));
+      open_file_ = k;
+    }
+    open_stream_->Seek(local);
+    size_t take = std::min(size, files_[k].size - local);
+    open_stream_->ReadExact(dst, take);
+    dst += take;
+    size -= take;
+    ++k;
+    local = 0;
+  }
+}
+
+bool IndexedRecordIOSplit::FillChunkBuffer(std::vector<char>* buf) {
+  if (next_rec_ >= order_.size()) return false;
+  buf->clear();
+  size_t end_rec = std::min(order_.size(), next_rec_ + batch_size_);
+  for (; next_rec_ < end_rec; ++next_rec_) {
+    const auto& rec = index_[order_[next_rec_]];
+    size_t old = buf->size();
+    buf->resize(old + rec.second);
+    ReadSpanAt(rec.first, buf->data() + old, rec.second);
+  }
+  return true;
+}
+
+bool IndexedRecordIOSplit::ExtractRecordAt(char* data, size_t valid,
+                                           size_t* cursor, Blob* out) {
+  return ExtractRecordIOFrame(data, valid, cursor, out, &assembled_);
+}
+
+bool IndexedRecordIOSplit::NextChunk(Blob* out) {
+  if (!FillChunkBuffer(&chunk_)) return false;
+  out->dptr = chunk_.data();
+  out->size = chunk_.size();
+  cursor_ = chunk_.size();
+  return true;
+}
+
+bool IndexedRecordIOSplit::NextRecord(Blob* out) {
+  while (true) {
+    if (cursor_ < chunk_.size() &&
+        ExtractRecordAt(chunk_.data(), chunk_.size(), &cursor_, out)) {
+      return true;
+    }
+    if (!FillChunkBuffer(&chunk_)) return false;
+    cursor_ = 0;
+  }
+}
+
+// --------------------------------------------------------------------------
+// CachedSplit
+CachedSplit::CachedSplit(InputSplit* base, RecordChunkSource* base_src,
+                         const std::string& cache_file)
+    : base_(base), base_src_(base_src), cache_file_(cache_file) {
+  // a completed cache from an earlier run is replayed immediately
+  std::unique_ptr<SeekStream> probe(
+      SeekStream::CreateForRead(cache_file_, /*allow_null=*/true));
+  if (probe != nullptr) {
+    cache_reader_ = std::move(probe);
+    replaying_ = true;
+  }
+}
+
+CachedSplit::~CachedSplit() = default;
+
+void CachedSplit::FinalizeCache() {
+  // publish ONLY a complete first pass; a partial .tmp would silently
+  // truncate the dataset for every later epoch and process
+  if (cache_writer_ == nullptr) return;
+  cache_writer_.reset();
+  std::string tmp = cache_file_ + ".tmp";
+  if (!write_complete_) {
+    std::remove(tmp.c_str());
+    return;
+  }
+  DCT_CHECK(std::rename(tmp.c_str(), cache_file_.c_str()) == 0)
+      << "cannot publish cache file " << cache_file_;
+}
+
+bool CachedSplit::FillChunkBuffer(std::vector<char>* buf) {
+  if (replaying_) {
+    uint64_t size;
+    size_t n = cache_reader_->Read(&size, 8);
+    if (n == 0) return false;
+    DCT_CHECK_EQ(n, size_t(8))
+        << "corrupt chunk cache (truncated header): " << cache_file_;
+    if (!serial::NativeIsLE()) size = serial::ByteSwap(size);
+    buf->resize(size);
+    cache_reader_->ReadExact(buf->data(), size);
+    return true;
+  }
+  if (!base_src_->FillChunkBuffer(buf)) {
+    write_complete_ = true;
+    FinalizeCache();
+    return false;
+  }
+  if (cache_writer_ == nullptr) {
+    cache_writer_.reset(Stream::Create(cache_file_ + ".tmp", "w"));
+  }
+  uint64_t size = buf->size();
+  if (!serial::NativeIsLE()) size = serial::ByteSwap(size);
+  cache_writer_->Write(&size, 8);
+  cache_writer_->Write(buf->data(), buf->size());
+  return true;
+}
+
+bool CachedSplit::ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                                  Blob* out) {
+  return base_src_->ExtractRecordAt(data, valid, cursor, out);
+}
+
+void CachedSplit::BeforeFirst() {
+  FinalizeCache();  // publishes only when the first pass completed
+  write_complete_ = false;
+  std::unique_ptr<SeekStream> probe(
+      SeekStream::CreateForRead(cache_file_, /*allow_null=*/true));
+  if (probe != nullptr) {
+    cache_reader_ = std::move(probe);
+    replaying_ = true;
+  } else {
+    base_->BeforeFirst();
+  }
+  chunk_.clear();
+  cursor_ = 0;
+}
+
+bool CachedSplit::NextChunk(Blob* out) {
+  if (!FillChunkBuffer(&chunk_)) return false;
+  out->dptr = chunk_.data();
+  out->size = chunk_.size();
+  cursor_ = chunk_.size();
+  return true;
+}
+
+bool CachedSplit::NextRecord(Blob* out) {
+  while (true) {
+    if (cursor_ < chunk_.size() &&
+        ExtractRecordAt(chunk_.data(), chunk_.size(), &cursor_, out)) {
+      return true;
+    }
+    if (!FillChunkBuffer(&chunk_)) return false;
+    cursor_ = 0;
+  }
+}
+
+void CachedSplit::ResetPartition(unsigned rank, unsigned nsplit) {
+  // the cache is partition-specific; drop it and start over
+  cache_writer_.reset();
+  cache_reader_.reset();
+  std::remove((cache_file_ + ".tmp").c_str());
+  std::remove(cache_file_.c_str());
+  replaying_ = false;
+  write_complete_ = false;
+  base_->ResetPartition(rank, nsplit);
+  chunk_.clear();
+  cursor_ = 0;
+}
+
+// --------------------------------------------------------------------------
+// ShuffleSplit
+ShuffleSplit::ShuffleSplit(InputSplit* base, unsigned part, unsigned nsplit,
+                           unsigned num_shuffle_parts, int seed)
+    : base_(base),
+      part_(part),
+      nsplit_(nsplit),
+      num_shuffle_parts_(std::max(num_shuffle_parts, 1u)),
+      seed_(seed) {
+  BeforeFirst();
+}
+
+void ShuffleSplit::BeforeFirst() {
+  order_.resize(num_shuffle_parts_);
+  for (unsigned i = 0; i < num_shuffle_parts_; ++i) order_[i] = i;
+  if (num_shuffle_parts_ > 1) {
+    std::mt19937 rng(111 + seed_ + static_cast<int>(part_) * 997 +
+                     static_cast<int>(epoch_));
+    std::shuffle(order_.begin(), order_.end(), rng);
+    ++epoch_;
+    cur_ = 0;
+    base_->ResetPartition(part_ * num_shuffle_parts_ + order_[0],
+                          nsplit_ * num_shuffle_parts_);
+  } else {
+    base_->BeforeFirst();
+  }
+}
+
+bool ShuffleSplit::AdvanceSubPart() {
+  if (num_shuffle_parts_ <= 1 || cur_ + 1 >= num_shuffle_parts_) return false;
+  ++cur_;
+  base_->ResetPartition(part_ * num_shuffle_parts_ + order_[cur_],
+                        nsplit_ * num_shuffle_parts_);
+  return true;
+}
+
+bool ShuffleSplit::NextRecord(Blob* out) {
+  while (!base_->NextRecord(out)) {
+    if (!AdvanceSubPart()) return false;
+  }
+  return true;
+}
+
+bool ShuffleSplit::NextChunk(Blob* out) {
+  while (!base_->NextChunk(out)) {
+    if (!AdvanceSubPart()) return false;
+  }
+  return true;
+}
+
+void ShuffleSplit::ResetPartition(unsigned rank, unsigned nsplit) {
+  part_ = rank;
+  nsplit_ = nsplit;
+  epoch_ = 0;
+  BeforeFirst();
+}
+
+// --------------------------------------------------------------------------
+PrefetchSplit::PrefetchSplit(InputSplit* base, RecordChunkSource* src,
+                             size_t capacity)
+    : base_(base), src_(src), pipe_(capacity) {}
 
 PrefetchSplit::~PrefetchSplit() {
   if (current_ != nullptr) pipe_.Recycle(&current_);
@@ -391,9 +715,9 @@ void PrefetchSplit::EnsureStarted() {
       [this](Cell** cell) {
         if (*cell == nullptr) *cell = new Cell();
         (*cell)->cursor = 0;
-        return base_->FillChunkBuffer(&(*cell)->data);
+        return src_->FillChunkBuffer(&(*cell)->data);
       },
-      [this] { base_->BeforeFirst(); });
+      [this] { src_->SourceBeforeFirst(); });
   started_ = true;
 }
 
@@ -416,8 +740,8 @@ bool PrefetchSplit::NextRecord(Blob* out) {
   EnsureStarted();
   while (true) {
     if (current_ != nullptr &&
-        base_->ExtractRecordAt(current_->data.data(), current_->data.size(),
-                               &current_->cursor, out)) {
+        src_->ExtractRecordAt(current_->data.data(), current_->data.size(),
+                              &current_->cursor, out)) {
       return true;
     }
     if (current_ != nullptr) pipe_.Recycle(&current_);
@@ -437,22 +761,45 @@ InputSplit* InputSplit::Create(const std::string& uri, unsigned part,
                                const std::string& index_uri, bool shuffle,
                                int seed, size_t batch_size,
                                bool recurse_directories, bool threaded,
-                               const std::string& cache_file) {
-  DCT_CHECK(index_uri.empty() && !shuffle && cache_file.empty())
-      << "indexed/shuffled/cached input splits are not implemented yet "
-         "(type=" << type << ")";
-  (void)seed;
-  (void)batch_size;
-  ByteSplit* split = nullptr;
+                               const std::string& cache_file,
+                               unsigned shuffle_parts) {
+  DCT_CHECK(shuffle == false || type == "indexed_recordio")
+      << "record shuffle requires type=indexed_recordio "
+         "(use shuffle_parts for coarse shuffling)";
+  DCT_CHECK(cache_file.empty() || shuffle_parts <= 1)
+      << "cache_file cannot be combined with shuffle_parts: sub-part resets "
+         "would invalidate the cache every epoch";
+  InputSplit* split;
+  RecordChunkSource* src;
   if (type == "text") {
-    split = new LineSplit(uri, part, nsplit, recurse_directories);
+    auto* b = new LineSplit(uri, part, nsplit, recurse_directories);
+    split = b;
+    src = b;
   } else if (type == "recordio") {
-    split = new RecordIOSplit(uri, part, nsplit, recurse_directories);
+    auto* b = new RecordIOSplit(uri, part, nsplit, recurse_directories);
+    split = b;
+    src = b;
+  } else if (type == "indexed_recordio") {
+    DCT_CHECK(!index_uri.empty())
+        << "indexed_recordio requires an index uri";
+    auto* b = new IndexedRecordIOSplit(uri, index_uri, part, nsplit,
+                                       batch_size, shuffle, seed,
+                                       recurse_directories);
+    split = b;
+    src = b;
   } else {
     throw Error("unknown input split type: " + type);
   }
+  if (!cache_file.empty()) {
+    auto* c = new CachedSplit(split, src, cache_file);
+    split = c;
+    src = c;
+  }
   if (threaded) {
-    return new PrefetchSplit(split, 2);
+    split = new PrefetchSplit(split, src, 2);
+  }
+  if (shuffle_parts > 1) {
+    split = new ShuffleSplit(split, part, nsplit, shuffle_parts, seed);
   }
   return split;
 }

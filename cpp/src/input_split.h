@@ -46,9 +46,10 @@ class InputSplit {
   virtual void ResetPartition(unsigned rank, unsigned nsplit) = 0;
 
   // Factory (reference src/io.cc:81-130). type is "text" | "recordio" |
-  // "indexed_recordio". uri may be ';'-separated and may name directories
-  // or trailing-'*' globs. Threaded prefetch is layered on by default;
-  // cache_file enables write-through chunk caching for later epochs.
+  // "indexed_recordio" (requires index_uri; honors shuffle/seed/batch_size).
+  // uri may be ';'-separated and may name directories or trailing-'*'
+  // globs. Composition order: base split -> CachedSplit (when cache_file)
+  // -> PrefetchSplit (threaded) -> ShuffleSplit (when shuffle_parts > 1).
   static InputSplit* Create(const std::string& uri, unsigned part,
                             unsigned nsplit, const std::string& type,
                             const std::string& index_uri = "",
@@ -56,12 +57,31 @@ class InputSplit {
                             size_t batch_size = 256,
                             bool recurse_directories = false,
                             bool threaded = true,
-                            const std::string& cache_file = "");
+                            const std::string& cache_file = "",
+                            unsigned shuffle_parts = 0);
 };
 
 // ---------------------------------------------------------------------------
+// Chunk-producer interface consumed by the prefetch/cache wrappers: fills a
+// caller buffer with whole records and extracts records from such buffers.
+class RecordChunkSource {
+ public:
+  virtual ~RecordChunkSource() = default;
+  virtual bool FillChunkBuffer(std::vector<char>* buf) = 0;
+  // Extraction must only touch extraction state (concurrent with filling).
+  virtual bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                               InputSplit::Blob* out) = 0;
+  virtual void SourceBeforeFirst() = 0;
+};
+
+// Expand a ';'-separated uri list (directories, trailing-'*' globs) into an
+// ordered file list (reference input_split_base.cc:96-147).
+std::vector<FileInfo> ExpandFileList(const std::string& uri,
+                                     bool recurse_directories);
+
+// ---------------------------------------------------------------------------
 // Base byte-range splitter over an expanded file list.
-class ByteSplit : public InputSplit {
+class ByteSplit : public InputSplit, public RecordChunkSource {
  public:
   ByteSplit(const std::string& uri, unsigned align_bytes, bool is_text,
             bool recurse_directories);
@@ -76,7 +96,7 @@ class ByteSplit : public InputSplit {
   void ResetPartition(unsigned rank, unsigned nsplit) override;
 
  public:
-  // --- format hooks (public so PrefetchSplit can extract from its cells) ---
+  // --- format hooks ---
   // Advance `s` (positioned inside a record) to the next record head; return
   // bytes consumed. `file_size` is the size of the current file.
   virtual size_t SeekRecordHead(SeekStream* s, size_t local_pos,
@@ -85,16 +105,11 @@ class ByteSplit : public InputSplit {
   // that `begin` is a record head; bytes from there on are carried to the
   // next chunk. Return 0 when no boundary found (chunk must grow).
   virtual size_t FindLastRecordHead(const char* begin, const char* end) = 0;
-  // Extract the next record of `data[*cursor..valid)`, advancing *cursor.
-  // Only touches extraction state (safe to call concurrently with chunk
-  // filling from another thread).
-  virtual bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
-                               Blob* out) = 0;
 
-  // Fill `*buf` with whole records (overflow carry preserved across calls);
-  // false at end of partition. Single-caller-at-a-time (the fill state
-  // machine lives in this object).
-  bool FillChunkBuffer(std::vector<char>* buf);
+  // RecordChunkSource: fill `*buf` with whole records (overflow carry
+  // preserved across calls); false at end of partition.
+  bool FillChunkBuffer(std::vector<char>* buf) override;
+  void SourceBeforeFirst() override { BeforeFirst(); }
 
  protected:
   // chunk data for unwrapped record iteration
@@ -166,11 +181,125 @@ class RecordIOSplit : public ByteSplit {
 };
 
 // ---------------------------------------------------------------------------
+// Record-exact partitioned split over an external index file of
+// `record_index byte_offset` text pairs (reference src/io/
+// indexed_recordio_split.{h,cc}): partitions BY RECORD COUNT, batches
+// batch_size records per chunk, optionally visiting records in a freshly
+// shuffled order each epoch (kRandMagic + seed mt19937, reshuffled in
+// BeforeFirst — reference :221-233).
+class IndexedRecordIOSplit : public InputSplit, public RecordChunkSource {
+ public:
+  IndexedRecordIOSplit(const std::string& uri, const std::string& index_uri,
+                       unsigned part, unsigned nsplit, size_t batch_size,
+                       bool shuffle, int seed, bool recurse_directories);
+
+  void BeforeFirst() override;
+  bool NextRecord(Blob* out) override;
+  bool NextChunk(Blob* out) override;
+  size_t GetTotalSize() override { return total_size_; }
+  void ResetPartition(unsigned rank, unsigned nsplit) override;
+
+  bool FillChunkBuffer(std::vector<char>* buf) override;
+  bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                       Blob* out) override;
+  void SourceBeforeFirst() override { BeforeFirst(); }
+
+ private:
+  void ReadSpanAt(size_t global_ofs, char* dst, size_t size);
+
+  std::vector<FileInfo> files_;
+  std::vector<size_t> file_start_;
+  size_t total_size_ = 0;
+  // (global byte offset, byte size) of every record, in file order
+  std::vector<std::pair<size_t, size_t>> index_;
+  size_t lo_ = 0, hi_ = 0;     // record range of this partition
+  std::vector<size_t> order_;  // visit order within [lo_, hi_)
+  size_t next_rec_ = 0;
+  size_t batch_size_;
+  bool shuffle_;
+  int seed_;
+  unsigned epoch_ = 0;
+  std::vector<char> chunk_;
+  size_t cursor_ = 0;
+  std::string assembled_;
+  std::unique_ptr<SeekStream> open_stream_;  // reused across records
+  size_t open_file_ = size_t(-1);
+};
+
+// ---------------------------------------------------------------------------
+// Write-through chunk cache (reference src/io/cached_input_split.h): the
+// first epoch streams [u64 size][bytes] frames of every chunk to a local
+// cache file while serving them; later epochs replay from the cache,
+// skipping the original (possibly remote) filesystem entirely.
+class CachedSplit : public InputSplit, public RecordChunkSource {
+ public:
+  // takes ownership of base (which must also be the extraction source)
+  CachedSplit(InputSplit* base, RecordChunkSource* base_src,
+              const std::string& cache_file);
+  ~CachedSplit() override;
+
+  void BeforeFirst() override;
+  bool NextRecord(Blob* out) override;
+  bool NextChunk(Blob* out) override;
+  void HintChunkSize(size_t bytes) override { base_->HintChunkSize(bytes); }
+  size_t GetTotalSize() override { return base_->GetTotalSize(); }
+  void ResetPartition(unsigned rank, unsigned nsplit) override;
+
+  bool FillChunkBuffer(std::vector<char>* buf) override;
+  bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
+                       Blob* out) override;
+  void SourceBeforeFirst() override { BeforeFirst(); }
+
+ private:
+  void FinalizeCache();
+
+  std::unique_ptr<InputSplit> base_;
+  RecordChunkSource* base_src_;  // borrowed view of base_
+  std::string cache_file_;
+  std::unique_ptr<Stream> cache_writer_;
+  std::unique_ptr<SeekStream> cache_reader_;
+  bool replaying_ = false;
+  bool write_complete_ = false;
+  std::vector<char> chunk_;
+  size_t cursor_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Coarse-grained global shuffle (reference include/dmlc/
+// input_split_shuffle.h): multiplies the partition count by
+// num_shuffle_parts and visits this part's sub-parts in a freshly shuffled
+// order each epoch.
+class ShuffleSplit : public InputSplit {
+ public:
+  ShuffleSplit(InputSplit* base, unsigned part, unsigned nsplit,
+               unsigned num_shuffle_parts, int seed);
+
+  void BeforeFirst() override;
+  bool NextRecord(Blob* out) override;
+  bool NextChunk(Blob* out) override;
+  void HintChunkSize(size_t bytes) override { base_->HintChunkSize(bytes); }
+  size_t GetTotalSize() override { return base_->GetTotalSize(); }
+  void ResetPartition(unsigned rank, unsigned nsplit) override;
+
+ private:
+  bool AdvanceSubPart();
+
+  std::unique_ptr<InputSplit> base_;
+  unsigned part_, nsplit_, num_shuffle_parts_;
+  int seed_;
+  unsigned epoch_ = 0;
+  std::vector<unsigned> order_;
+  size_t cur_ = 0;
+};
+
+// ---------------------------------------------------------------------------
 // Background prefetch wrapper (reference src/io/threaded_input_split.h):
-// a PipelineIter of chunk cells produced by base->NextChunk.
+// a PipelineIter of chunk cells produced by the wrapped source.
 class PrefetchSplit : public InputSplit {
  public:
-  explicit PrefetchSplit(ByteSplit* base, size_t capacity = 2);
+  // takes ownership of base; src must be the same object's chunk interface
+  PrefetchSplit(InputSplit* base, RecordChunkSource* src,
+                size_t capacity = 2);
   ~PrefetchSplit() override;
 
   void BeforeFirst() override;
@@ -185,7 +314,8 @@ class PrefetchSplit : public InputSplit {
     std::vector<char> data;
     size_t cursor = 0;
   };
-  std::unique_ptr<ByteSplit> base_;
+  std::unique_ptr<InputSplit> base_;
+  RecordChunkSource* src_;  // borrowed view of base_
   PipelineIter<Cell> pipe_;
   Cell* current_ = nullptr;
   bool started_ = false;

@@ -35,6 +35,8 @@ class Parser {
   // Factory (reference src/data.cc:62-85 CreateParser_): format is
   // "libsvm" | "csv" | "libfm" | "auto" (resolved from ?format= URI arg).
   // `threaded` pipelines parsing against consumption (ThreadedParser).
+  // `#cachefile` URI sugar enables DiskCacheParser row-block caching
+  // (reference uri_spec.h:42-57, src/data.cc:97-103).
   static Parser* Create(const std::string& uri, unsigned part, unsigned npart,
                         const std::string& format, int nthread = 0,
                         bool threaded = true);
@@ -117,6 +119,35 @@ class LibFMParser : public TextParserBase<IndexType> {
 
  private:
   int indexing_mode_;
+};
+
+// --------------------------------------------------------------------------
+// Disk row-block cache (reference src/data/disk_row_iter.h): the first
+// epoch serves parsed blocks while appending their binary serialization to
+// a cache file; later epochs replay the cache (skipping text parsing and
+// the original filesystem entirely), prefetched on a pipeline thread.
+template <typename IndexType>
+class DiskCacheParser : public Parser<IndexType> {
+ public:
+  // takes ownership of base
+  DiskCacheParser(Parser<IndexType>* base, const std::string& cache_file);
+  ~DiskCacheParser() override;
+
+  void BeforeFirst() override;
+  const RowBlockContainer<IndexType>* NextBlock() override;
+  size_t BytesRead() const override { return base_->BytesRead(); }
+
+ private:
+  void FinalizeCache();
+  bool TryOpenCache();
+
+  std::unique_ptr<Parser<IndexType>> base_;
+  std::string cache_file_;
+  std::unique_ptr<Stream> writer_;
+  std::unique_ptr<SeekStream> reader_;
+  bool replaying_ = false;
+  bool write_complete_ = false;
+  RowBlockContainer<IndexType> replay_block_;
 };
 
 // --------------------------------------------------------------------------

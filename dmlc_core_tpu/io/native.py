@@ -88,6 +88,8 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_fs_path_info": [c.c_char_p, c.POINTER(sz), c.POINTER(i)],
         "dct_str_free": [c.c_char_p],
         "dct_split_create": [c.c_char_p, u, u, c.c_char_p, i, c.POINTER(vp)],
+        "dct_split_create_ex": [c.c_char_p, c.c_char_p, u, u, c.c_char_p, i,
+                                i, i, sz, c.c_char_p, u, i, c.POINTER(vp)],
         "dct_split_next_record": [vp, c.POINTER(vp), c.POINTER(sz),
                                   c.POINTER(i)],
         "dct_split_next_chunk": [vp, c.POINTER(vp), c.POINTER(sz),
@@ -202,12 +204,23 @@ class NativeInputSplit:
     per-process loaders (SURVEY §2.5 DP)."""
 
     def __init__(self, uri: str, part: int = 0, nsplit: int = 1,
-                 split_type: str = "text", threaded: bool = True):
+                 split_type: str = "text", threaded: bool = True,
+                 index_uri: str = "", shuffle: bool = False, seed: int = 0,
+                 batch_size: int = 256, cache_file: str = "",
+                 shuffle_parts: int = 0, recurse: bool = False):
         self._h = ctypes.c_void_p()
-        _check(lib().dct_split_create(uri.encode(), part, nsplit,
-                                      split_type.encode(),
-                                      1 if threaded else 0,
-                                      ctypes.byref(self._h)))
+        if (index_uri or shuffle or cache_file or shuffle_parts or recurse
+                or split_type == "indexed_recordio"):
+            _check(lib().dct_split_create_ex(
+                uri.encode(), index_uri.encode(), part, nsplit,
+                split_type.encode(), 1 if threaded else 0,
+                1 if shuffle else 0, seed, batch_size, cache_file.encode(),
+                shuffle_parts, 1 if recurse else 0, ctypes.byref(self._h)))
+        else:
+            _check(lib().dct_split_create(uri.encode(), part, nsplit,
+                                          split_type.encode(),
+                                          1 if threaded else 0,
+                                          ctypes.byref(self._h)))
 
     def next_record(self) -> Optional[bytes]:
         data = ctypes.c_void_p()
