@@ -1,0 +1,182 @@
+// HTTP client implementation (see http.h).
+#include "http.h"
+
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+
+namespace dct {
+
+namespace {
+std::string Lower(std::string s) {
+  for (char& c : s) c = static_cast<char>(tolower(c));
+  return s;
+}
+}  // namespace
+
+HttpConnection::HttpConnection(const std::string& host, int port) {
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port);
+  int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
+  DCT_CHECK(rc == 0) << "cannot resolve host " << host << ": "
+                     << gai_strerror(rc);
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd_ < 0) continue;
+    if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd_);
+    fd_ = -1;
+  }
+  freeaddrinfo(res);
+  DCT_CHECK(fd_ >= 0) << "cannot connect to " << host << ":" << port;
+}
+
+HttpConnection::~HttpConnection() {
+  if (fd_ >= 0) close(fd_);
+}
+
+void HttpConnection::SendRequest(
+    const std::string& method, const std::string& path,
+    const std::map<std::string, std::string>& headers,
+    const std::string& body) {
+  std::string req = method + " " + path + " HTTP/1.1\r\n";
+  for (const auto& kv : headers) {
+    req += kv.first + ": " + kv.second + "\r\n";
+  }
+  if (headers.find("content-length") == headers.end() &&
+      headers.find("Content-Length") == headers.end() &&
+      (!body.empty() || method == "PUT" || method == "POST")) {
+    req += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  }
+  req += "Connection: close\r\n\r\n";
+  req += body;
+  size_t sent = 0;
+  while (sent < req.size()) {
+    ssize_t n = send(fd_, req.data() + sent, req.size() - sent, 0);
+    DCT_CHECK(n > 0) << "http send failed";
+    sent += static_cast<size_t>(n);
+  }
+}
+
+size_t HttpConnection::RawRead(void* buf, size_t size) {
+  if (rpos_ < rbuf_.size()) {
+    size_t n = std::min(size, rbuf_.size() - rpos_);
+    std::memcpy(buf, rbuf_.data() + rpos_, n);
+    rpos_ += n;
+    return n;
+  }
+  ssize_t n = recv(fd_, buf, size, 0);
+  DCT_CHECK(n >= 0) << "http recv failed";
+  return static_cast<size_t>(n);
+}
+
+bool HttpConnection::ReadLine(std::string* line) {
+  line->clear();
+  char c;
+  while (true) {
+    size_t n = RawRead(&c, 1);
+    if (n == 0) return !line->empty();
+    if (c == '\n') {
+      if (!line->empty() && line->back() == '\r') line->pop_back();
+      return true;
+    }
+    line->push_back(c);
+  }
+}
+
+void HttpConnection::ReadResponseHead(HttpResponse* out) {
+  std::string line;
+  DCT_CHECK(ReadLine(&line)) << "empty http response";
+  // "HTTP/1.1 200 OK"
+  size_t sp = line.find(' ');
+  DCT_CHECK(sp != std::string::npos) << "bad http status line: " << line;
+  out->status = std::atoi(line.c_str() + sp + 1);
+  while (ReadLine(&line) && !line.empty()) {
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = Lower(line.substr(0, colon));
+    size_t vstart = line.find_first_not_of(' ', colon + 1);
+    out->headers[key] =
+        vstart == std::string::npos ? "" : line.substr(vstart);
+  }
+  auto it = out->headers.find("content-length");
+  if (it != out->headers.end()) {
+    body_remaining_ = std::atoll(it->second.c_str());
+  }
+  auto te = out->headers.find("transfer-encoding");
+  chunked_ = te != out->headers.end() &&
+             Lower(te->second).find("chunked") != std::string::npos;
+}
+
+size_t HttpConnection::ReadBody(void* buf, size_t size) {
+  if (body_done_) return 0;
+  if (chunked_) {
+    if (chunk_remaining_ == 0) {
+      std::string line;
+      DCT_CHECK(ReadLine(&line)) << "truncated chunked body";
+      chunk_remaining_ = std::strtoll(line.c_str(), nullptr, 16);
+      if (chunk_remaining_ == 0) {
+        ReadLine(&line);  // trailing CRLF / trailers
+        body_done_ = true;
+        return 0;
+      }
+    }
+    size_t want = std::min<size_t>(size, chunk_remaining_);
+    size_t n = RawRead(buf, want);
+    DCT_CHECK(n > 0) << "truncated chunk";
+    chunk_remaining_ -= n;
+    if (chunk_remaining_ == 0) {
+      std::string line;
+      ReadLine(&line);  // chunk-terminating CRLF
+    }
+    return n;
+  }
+  if (body_remaining_ == 0) {
+    body_done_ = true;
+    return 0;
+  }
+  size_t want = size;
+  if (body_remaining_ > 0) {
+    want = std::min<size_t>(size, body_remaining_);
+  }
+  size_t n = RawRead(buf, want);
+  if (body_remaining_ > 0) {
+    body_remaining_ -= n;
+    if (n == 0) {
+      throw Error("http body shorter than content-length");
+    }
+  } else if (n == 0) {
+    body_done_ = true;  // read-to-close
+  }
+  return n;
+}
+
+void HttpConnection::ReadFullBody(HttpResponse* out) {
+  char buf[16384];
+  while (true) {
+    size_t n = ReadBody(buf, sizeof(buf));
+    if (n == 0) break;
+    out->body.append(buf, n);
+  }
+}
+
+HttpResponse HttpRequest(const std::string& host, int port,
+                         const std::string& method, const std::string& path,
+                         const std::map<std::string, std::string>& headers,
+                         const std::string& body) {
+  HttpConnection conn(host, port);
+  conn.SendRequest(method, path, headers, body);
+  HttpResponse resp;
+  conn.ReadResponseHead(&resp);
+  conn.ReadFullBody(&resp);
+  return resp;
+}
+
+}  // namespace dct

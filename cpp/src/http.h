@@ -1,0 +1,66 @@
+// Minimal HTTP/1.1 client over POSIX sockets.
+//
+// The reference drives S3 through libcurl (src/io/s3_filesys.cc:498-650
+// curl multi + select loops); this environment has no libcurl, so the small
+// subset S3 needs is implemented directly: one request per connection
+// (Connection: close), Content-Length and chunked responses, streaming body
+// reads. Plain http only — TLS is out of scope for the built-in client
+// (S3-compatible stores and the test harness speak http; see s3_filesys.h).
+#ifndef DCT_HTTP_H_
+#define DCT_HTTP_H_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "base.h"
+
+namespace dct {
+
+struct HttpResponse {
+  int status = 0;
+  std::map<std::string, std::string> headers;  // lower-cased keys
+  std::string body;
+};
+
+class HttpConnection {
+ public:
+  HttpConnection(const std::string& host, int port);
+  ~HttpConnection();
+  HttpConnection(const HttpConnection&) = delete;
+  HttpConnection& operator=(const HttpConnection&) = delete;
+
+  // Send a full request (path may include the query string).
+  void SendRequest(const std::string& method, const std::string& path,
+                   const std::map<std::string, std::string>& headers,
+                   const std::string& body);
+
+  // Read status line + headers; body is then streamed with ReadBody.
+  void ReadResponseHead(HttpResponse* out);
+  // Stream up to `size` body bytes; 0 at end of body.
+  size_t ReadBody(void* buf, size_t size);
+  // Convenience: read the entire remaining body into out->body.
+  void ReadFullBody(HttpResponse* out);
+
+ private:
+  size_t RawRead(void* buf, size_t size);
+  bool ReadLine(std::string* line);
+
+  int fd_ = -1;
+  std::string rbuf_;          // buffered unread bytes
+  size_t rpos_ = 0;
+  int64_t body_remaining_ = -1;  // -1: read-to-close
+  bool chunked_ = false;
+  int64_t chunk_remaining_ = 0;
+  bool body_done_ = false;
+};
+
+// One-shot request helper.
+HttpResponse HttpRequest(const std::string& host, int port,
+                         const std::string& method, const std::string& path,
+                         const std::map<std::string, std::string>& headers,
+                         const std::string& body);
+
+}  // namespace dct
+
+#endif  // DCT_HTTP_H_

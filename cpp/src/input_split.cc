@@ -535,15 +535,51 @@ bool IndexedRecordIOSplit::NextRecord(Blob* out) {
 
 // --------------------------------------------------------------------------
 // CachedSplit
+namespace {
+constexpr uint64_t kCacheMagic = 0x44435443414348; // "DCTCACH"
+
+uint64_t FingerprintHash(const std::string& s) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+void WriteU64(Stream* s, uint64_t v) {
+  if (!serial::NativeIsLE()) v = serial::ByteSwap(v);
+  s->Write(&v, 8);
+}
+
+bool ReadU64(Stream* s, uint64_t* v) {
+  if (s->Read(v, 8) != 8) return false;
+  if (!serial::NativeIsLE()) *v = serial::ByteSwap(*v);
+  return true;
+}
+}  // namespace
+
 CachedSplit::CachedSplit(InputSplit* base, RecordChunkSource* base_src,
-                         const std::string& cache_file)
-    : base_(base), base_src_(base_src), cache_file_(cache_file) {
-  // a completed cache from an earlier run is replayed immediately
+                         const std::string& cache_file,
+                         const std::string& fingerprint)
+    : base_(base),
+      base_src_(base_src),
+      cache_file_(cache_file),
+      fingerprint_(FingerprintHash(fingerprint)) {
+  // a completed cache from an earlier run is replayed only when its header
+  // matches this (uri, part, nsplit) — a stale cache for another partition
+  // must not silently serve the wrong shard
   std::unique_ptr<SeekStream> probe(
       SeekStream::CreateForRead(cache_file_, /*allow_null=*/true));
   if (probe != nullptr) {
-    cache_reader_ = std::move(probe);
-    replaying_ = true;
+    uint64_t magic = 0, fp = 0;
+    if (ReadU64(probe.get(), &magic) && magic == kCacheMagic &&
+        ReadU64(probe.get(), &fp) && fp == fingerprint_) {
+      cache_reader_ = std::move(probe);
+      replaying_ = true;
+    } else {
+      std::remove(cache_file_.c_str());  // stale or foreign cache
+    }
   }
 }
 
@@ -582,6 +618,8 @@ bool CachedSplit::FillChunkBuffer(std::vector<char>* buf) {
   }
   if (cache_writer_ == nullptr) {
     cache_writer_.reset(Stream::Create(cache_file_ + ".tmp", "w"));
+    WriteU64(cache_writer_.get(), kCacheMagic);
+    WriteU64(cache_writer_.get(), fingerprint_);
   }
   uint64_t size = buf->size();
   if (!serial::NativeIsLE()) size = serial::ByteSwap(size);
@@ -600,10 +638,15 @@ void CachedSplit::BeforeFirst() {
   write_complete_ = false;
   std::unique_ptr<SeekStream> probe(
       SeekStream::CreateForRead(cache_file_, /*allow_null=*/true));
-  if (probe != nullptr) {
+  uint64_t magic = 0, fp = 0;
+  if (probe != nullptr && ReadU64(probe.get(), &magic) &&
+      magic == kCacheMagic && ReadU64(probe.get(), &fp) &&
+      fp == fingerprint_) {
     cache_reader_ = std::move(probe);
     replaying_ = true;
   } else {
+    replaying_ = false;
+    cache_reader_.reset();
     base_->BeforeFirst();
   }
   chunk_.clear();
@@ -791,7 +834,16 @@ InputSplit* InputSplit::Create(const std::string& uri, unsigned part,
     throw Error("unknown input split type: " + type);
   }
   if (!cache_file.empty()) {
-    auto* c = new CachedSplit(split, src, cache_file);
+    // per-part cache naming for raw (non-URISpec) callers, matching the
+    // URISpec `.splitN.partK` convention (reference uri_spec.h:42-57)
+    std::string cf = cache_file;
+    if (nsplit != 1 && cf.find(".split") == std::string::npos) {
+      cf += ".split" + std::to_string(nsplit) + ".part" +
+            std::to_string(part);
+    }
+    std::string fingerprint = uri + "|" + std::to_string(part) + "|" +
+                              std::to_string(nsplit) + "|" + type;
+    auto* c = new CachedSplit(split, src, cf, fingerprint);
     split = c;
     src = c;
   }

@@ -233,9 +233,11 @@ class IndexedRecordIOSplit : public InputSplit, public RecordChunkSource {
 // skipping the original (possibly remote) filesystem entirely.
 class CachedSplit : public InputSplit, public RecordChunkSource {
  public:
-  // takes ownership of base (which must also be the extraction source)
+  // takes ownership of base (which must also be the extraction source).
+  // `fingerprint` identifies (uri, part, nsplit, type); a pre-existing cache
+  // written under a different fingerprint is ignored and rebuilt.
   CachedSplit(InputSplit* base, RecordChunkSource* base_src,
-              const std::string& cache_file);
+              const std::string& cache_file, const std::string& fingerprint);
   ~CachedSplit() override;
 
   void BeforeFirst() override;
@@ -256,6 +258,7 @@ class CachedSplit : public InputSplit, public RecordChunkSource {
   std::unique_ptr<InputSplit> base_;
   RecordChunkSource* base_src_;  // borrowed view of base_
   std::string cache_file_;
+  uint64_t fingerprint_ = 0;
   std::unique_ptr<Stream> cache_writer_;
   std::unique_ptr<SeekStream> cache_reader_;
   bool replaying_ = false;

@@ -356,23 +356,69 @@ void LibFMParser<IndexType>::ParseBlock(const char* begin, const char* end,
 }
 
 // --------------------------------------------------------------------------
+namespace {
+constexpr uint64_t kRowCacheMagic = 0x44435452424c4b; // "DCTRBLK"
+
+uint64_t FingerprintHash64(const std::string& s) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+}  // namespace
+
 template <typename IndexType>
 DiskCacheParser<IndexType>::DiskCacheParser(Parser<IndexType>* base,
-                                            const std::string& cache_file)
-    : base_(base), cache_file_(cache_file) {
+                                            const std::string& cache_file,
+                                            const std::string& fingerprint)
+    : base_(base),
+      cache_file_(cache_file),
+      fingerprint_(FingerprintHash64(fingerprint)) {
   replaying_ = TryOpenCache();
 }
 
 template <typename IndexType>
-DiskCacheParser<IndexType>::~DiskCacheParser() = default;
+DiskCacheParser<IndexType>::~DiskCacheParser() {
+  if (replay_cell_ != nullptr) replay_pipe_.Recycle(&replay_cell_);
+  replay_pipe_.Shutdown();
+}
 
 template <typename IndexType>
 bool DiskCacheParser<IndexType>::TryOpenCache() {
   std::unique_ptr<SeekStream> probe(
       SeekStream::CreateForRead(cache_file_, /*allow_null=*/true));
   if (probe == nullptr) return false;
+  uint64_t magic = 0, fp = 0;
+  if (probe->Read(&magic, 8) != 8 || probe->Read(&fp, 8) != 8) {
+    return false;
+  }
+  if (!serial::NativeIsLE()) {
+    magic = serial::ByteSwap(magic);
+    fp = serial::ByteSwap(fp);
+  }
+  if (magic != kRowCacheMagic || fp != fingerprint_) {
+    std::remove(cache_file_.c_str());  // stale/foreign cache: rebuild
+    return false;
+  }
   reader_ = std::move(probe);
   return true;
+}
+
+template <typename IndexType>
+void DiskCacheParser<IndexType>::StartReplayPipeline() {
+  if (replay_started_) return;
+  replay_pipe_.Init(
+      [this](RowBlockContainer<IndexType>** cell) {
+        if (*cell == nullptr) *cell = new RowBlockContainer<IndexType>();
+        return (*cell)->Load(reader_.get());
+      },
+      [this] {
+        // rewind past the header
+        reader_->Seek(16);
+      });
+  replay_started_ = true;
 }
 
 template <typename IndexType>
@@ -393,8 +439,10 @@ void DiskCacheParser<IndexType>::FinalizeCache() {
 template <typename IndexType>
 const RowBlockContainer<IndexType>* DiskCacheParser<IndexType>::NextBlock() {
   if (replaying_) {
-    if (!replay_block_.Load(reader_.get())) return nullptr;
-    return &replay_block_;
+    StartReplayPipeline();
+    if (replay_cell_ != nullptr) replay_pipe_.Recycle(&replay_cell_);
+    if (!replay_pipe_.Next(&replay_cell_)) return nullptr;
+    return replay_cell_;
   }
   const RowBlockContainer<IndexType>* b = base_->NextBlock();
   if (b == nullptr) {
@@ -404,6 +452,13 @@ const RowBlockContainer<IndexType>* DiskCacheParser<IndexType>::NextBlock() {
   }
   if (writer_ == nullptr) {
     writer_.reset(Stream::Create(cache_file_ + ".tmp", "w"));
+    uint64_t magic = kRowCacheMagic, fp = fingerprint_;
+    if (!serial::NativeIsLE()) {
+      magic = serial::ByteSwap(magic);
+      fp = serial::ByteSwap(fp);
+    }
+    writer_->Write(&magic, 8);
+    writer_->Write(&fp, 8);
   }
   b->Save(writer_.get());
   return b;
@@ -413,6 +468,11 @@ template <typename IndexType>
 void DiskCacheParser<IndexType>::BeforeFirst() {
   FinalizeCache();  // publishes only when the pass completed
   write_complete_ = false;
+  if (replay_started_) {
+    if (replay_cell_ != nullptr) replay_pipe_.Recycle(&replay_cell_);
+    replay_pipe_.Shutdown();
+    replay_started_ = false;
+  }
   if (TryOpenCache()) {
     replaying_ = true;
   } else {
@@ -505,7 +565,10 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
                      new ThreadedParser<IndexType>(parser, 8))
                : parser;
   if (!spec.cache_file.empty()) {
-    out = new DiskCacheParser<IndexType>(out, spec.cache_file + ".rowblock");
+    std::string fingerprint = spec.uri + "|" + std::to_string(part) + "|" +
+                              std::to_string(npart) + "|" + fmt;
+    out = new DiskCacheParser<IndexType>(out, spec.cache_file + ".rowblock",
+                                         fingerprint);
   }
   return out;
 }

@@ -129,8 +129,9 @@ class LibFMParser : public TextParserBase<IndexType> {
 template <typename IndexType>
 class DiskCacheParser : public Parser<IndexType> {
  public:
-  // takes ownership of base
-  DiskCacheParser(Parser<IndexType>* base, const std::string& cache_file);
+  // takes ownership of base; fingerprint identifies (uri, part, npart)
+  DiskCacheParser(Parser<IndexType>* base, const std::string& cache_file,
+                  const std::string& fingerprint);
   ~DiskCacheParser() override;
 
   void BeforeFirst() override;
@@ -140,14 +141,20 @@ class DiskCacheParser : public Parser<IndexType> {
  private:
   void FinalizeCache();
   bool TryOpenCache();
+  void StartReplayPipeline();
 
   std::unique_ptr<Parser<IndexType>> base_;
   std::string cache_file_;
+  uint64_t fingerprint_ = 0;
   std::unique_ptr<Stream> writer_;
   std::unique_ptr<SeekStream> reader_;
   bool replaying_ = false;
   bool write_complete_ = false;
-  RowBlockContainer<IndexType> replay_block_;
+  // replay is prefetched on a pipeline thread (reference DiskRowIter's
+  // ThreadedIter, disk_row_iter.h:96-108)
+  PipelineIter<RowBlockContainer<IndexType>> replay_pipe_{4};
+  RowBlockContainer<IndexType>* replay_cell_ = nullptr;
+  bool replay_started_ = false;
 };
 
 // --------------------------------------------------------------------------

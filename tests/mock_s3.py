@@ -1,0 +1,239 @@
+"""In-process mock S3 server for testing the native S3 client.
+
+Implements the slice of the S3 REST API the client uses — object GET with
+Range, PUT, multipart upload (create/part/complete), ListObjects — and
+**recomputes the AWS SIG4 signature for every request** with Python
+hashlib/hmac, rejecting mismatches with 403. This cross-validates the C++
+SHA-256/HMAC/signing implementation (cpp/src/sha256.h, s3_filesys.cc)
+against an independent one. The reference tests S3 only with manual soak
+scripts against real AWS (reference test/README.md:3-30).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+ACCESS_KEY = "TESTACCESSKEY"
+SECRET_KEY = "testSecretKey123"
+REGION = "us-test-1"
+
+
+def _sign(secret, date, region, string_to_sign):
+    k = hmac.new(("AWS4" + secret).encode(), date.encode(),
+                 hashlib.sha256).digest()
+    k = hmac.new(k, region.encode(), hashlib.sha256).digest()
+    k = hmac.new(k, b"s3", hashlib.sha256).digest()
+    k = hmac.new(k, b"aws4_request", hashlib.sha256).digest()
+    return hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+
+
+class MockS3State:
+    def __init__(self):
+        self.objects = {}        # (bucket, key) -> bytes
+        self.uploads = {}        # upload_id -> {num: bytes}
+        self.next_upload = [0]
+        self.fail_reads_after = None  # int: truncate GET bodies (retry test)
+        self.requests = []       # (method, path) log
+
+
+class MockS3Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: MockS3State = None  # set by serve()
+
+    def log_message(self, *args):
+        pass
+
+    # -- SIG4 verification --------------------------------------------------
+    def _verify_sig(self, body: bytes) -> bool:
+        auth = self.headers.get("Authorization", "")
+        m = re.match(
+            r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d{8})/([^/]+)/s3/"
+            r"aws4_request, SignedHeaders=([^,]+), Signature=([0-9a-f]+)",
+            auth)
+        if not m:
+            return False
+        access, date, region, signed_headers, signature = m.groups()
+        if access != ACCESS_KEY or region != REGION:
+            return False
+        amz_date = self.headers["x-amz-date"]
+        payload_hash = self.headers["x-amz-content-sha256"]
+        if payload_hash != "UNSIGNED-PAYLOAD":
+            if hashlib.sha256(body).hexdigest() != payload_hash:
+                return False
+        parsed = urllib.parse.urlsplit(self.path)
+        pairs = urllib.parse.parse_qsl(parsed.query,
+                                       keep_blank_values=True)
+        enc = lambda s: urllib.parse.quote(s, safe="-_.~")
+        cq = "&".join(f"{k}={v}" for k, v in
+                      sorted((enc(k), enc(v)) for k, v in pairs))
+        # reconstruct from the *declared* signed headers
+        ch = ""
+        for name in signed_headers.split(";"):
+            ch += f"{name}:{self.headers[name]}\n"
+        canonical = "\n".join([
+            self.command,
+            urllib.parse.quote(parsed.path, safe="/-_.~"),
+            cq, ch, signed_headers, payload_hash])
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date,
+            f"{date}/{region}/s3/aws4_request",
+            hashlib.sha256(canonical.encode()).hexdigest()])
+        expect = _sign(SECRET_KEY, date, region, string_to_sign)
+        return hmac.compare_digest(expect, signature)
+
+    def _reject(self, code, msg):
+        body = msg.encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(n) if n else b""
+
+    def _bucket_key(self):
+        path = urllib.parse.urlsplit(self.path).path
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key
+
+    # -- handlers -----------------------------------------------------------
+    def do_GET(self):
+        st = self.state
+        st.requests.append(("GET", self.path))
+        if not self._verify_sig(b""):
+            return self._reject(403, "SignatureDoesNotMatch")
+        bucket, key = self._bucket_key()
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlsplit(self.path).query, keep_blank_values=True))
+        if "prefix" in q or key == "":
+            return self._list(bucket, q)
+        data = st.objects.get((bucket, key))
+        if data is None:
+            return self._reject(404, "NoSuchKey")
+        rng = self.headers.get("Range")
+        status = 200
+        lo = 0
+        if rng:
+            m = re.match(r"bytes=(\d+)-(\d*)", rng)
+            lo = int(m.group(1))
+            hi = int(m.group(2)) + 1 if m.group(2) else len(data)
+            data = data[lo:hi]
+            status = 206
+        if st.fail_reads_after is not None and len(data) > st.fail_reads_after:
+            # simulate a flaky connection: send a truncated body
+            out = data[: st.fail_reads_after]
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(out)
+            self.close_connection = True
+            return
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _list(self, bucket, q):
+        st = self.state
+        prefix = q.get("prefix", "")
+        delim = q.get("delimiter", "")
+        marker = q.get("marker", "")
+        keys = sorted(k for (b, k) in st.objects if b == bucket
+                      and k.startswith(prefix) and k > marker)
+        contents, prefixes = [], []
+        for k in keys:
+            rest = k[len(prefix):]
+            if delim and delim in rest:
+                p = prefix + rest.split(delim)[0] + delim
+                if p not in prefixes:
+                    prefixes.append(p)
+            else:
+                contents.append(k)
+        xml = ["<?xml version='1.0'?><ListBucketResult>",
+               "<IsTruncated>false</IsTruncated>"]
+        for k in contents:
+            xml.append(f"<Contents><Key>{k}</Key>"
+                       f"<Size>{len(st.objects[(bucket, k)])}</Size>"
+                       f"</Contents>")
+        for p in prefixes:
+            xml.append(f"<CommonPrefixes><Prefix>{p}</Prefix>"
+                       f"</CommonPrefixes>")
+        xml.append("</ListBucketResult>")
+        body = "".join(xml).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        st = self.state
+        st.requests.append(("PUT", self.path))
+        body = self._read_body()
+        if not self._verify_sig(body):
+            return self._reject(403, "SignatureDoesNotMatch")
+        bucket, key = self._bucket_key()
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlsplit(self.path).query, keep_blank_values=True))
+        if "uploadId" in q:
+            st.uploads[q["uploadId"]][int(q["partNumber"])] = body
+            etag = hashlib.md5(body).hexdigest()
+            self.send_response(200)
+            self.send_header("ETag", f'"{etag}"')
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        st.objects[(bucket, key)] = body
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_POST(self):
+        st = self.state
+        st.requests.append(("POST", self.path))
+        body = self._read_body()
+        if not self._verify_sig(body):
+            return self._reject(403, "SignatureDoesNotMatch")
+        bucket, key = self._bucket_key()
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlsplit(self.path).query, keep_blank_values=True))
+        if "uploads" in q:
+            st.next_upload[0] += 1
+            uid = f"upload-{st.next_upload[0]}"
+            st.uploads[uid] = {}
+            xml = (f"<?xml version='1.0'?><InitiateMultipartUploadResult>"
+                   f"<UploadId>{uid}</UploadId>"
+                   f"</InitiateMultipartUploadResult>").encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(xml)))
+            self.end_headers()
+            self.wfile.write(xml)
+            return
+        if "uploadId" in q:
+            parts = st.uploads.pop(q["uploadId"])
+            st.objects[(bucket, key)] = b"".join(
+                parts[i] for i in sorted(parts))
+            xml = b"<?xml version='1.0'?><CompleteMultipartUploadResult/>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(xml)))
+            self.end_headers()
+            self.wfile.write(xml)
+            return
+        self._reject(400, "BadRequest")
+
+
+def serve():
+    """Start the mock server; returns (state, port, shutdown_fn)."""
+    state = MockS3State()
+    handler = type("Handler", (MockS3Handler,), {"state": state})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return state, server.server_address[1], server.shutdown

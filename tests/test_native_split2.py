@@ -203,6 +203,9 @@ def test_cross_language_rowblock_cache(tmp_path):
     assert native_rows == 2
     with open(str(cache) + ".rowblock", "rb") as f:
         r = BinaryReader(f)
+        magic = r.read_scalar("uint64")  # cache header: magic + fingerprint
+        assert magic == 0x44435452424C4B
+        r.read_scalar("uint64")
         offset = r.read_array("uint64")
         label = r.read_array("float32")
         weight = r.read_array("float32")
@@ -257,3 +260,23 @@ def test_cache_with_shuffle_parts_rejected(tmp_path):
         NativeInputSplit(str(data), 0, 1, "text",
                          cache_file=str(tmp_path / "j.cache"),
                          shuffle_parts=4)
+
+
+def test_cache_fingerprint_rejects_foreign_cache(tmp_path):
+    """Regression (review finding): a cache written for one partition must
+    not be replayed by another (uri, part, nsplit)."""
+    lines = [f"{i}".encode() for i in range(100)]
+    data = tmp_path / "k.txt"
+    data.write_bytes(b"\n".join(lines) + b"\n")
+    cache = str(tmp_path / "k.cache")
+    # full dataset cached under part 0/1
+    with NativeInputSplit(str(data), 0, 1, "text", cache_file=cache) as s:
+        assert len(list(s)) == 100
+    # part 0 of 2 with the SAME base cache name: per-part suffix + foreign
+    # fingerprint means it must NOT replay the full cache
+    got = []
+    for part in range(2):
+        with NativeInputSplit(str(data), part, 2, "text",
+                              cache_file=cache) as s:
+            got.extend(s)
+    assert got == lines  # exact cover, no duplication from stale cache
