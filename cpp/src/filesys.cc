@@ -3,7 +3,9 @@
 #include "filesys.h"
 
 #include <dirent.h>
+#include <stdlib.h>
 #include <sys/stat.h>
+#include <unistd.h>
 
 #include <cstdio>
 #include <map>
@@ -41,6 +43,46 @@ class StdFileStream : public SeekStream {
 };
 
 }  // namespace
+
+TemporaryDirectory::TemporaryDirectory(bool verbose) : verbose_(verbose) {
+  const char* base = getenv("TMPDIR");
+  std::string tmpl = std::string(base != nullptr ? base : "/tmp") +
+                     "/dct-tmpdir.XXXXXX";
+  std::vector<char> buf(tmpl.begin(), tmpl.end());
+  buf.push_back('\0');
+  DCT_CHECK(mkdtemp(buf.data()) != nullptr)
+      << "TemporaryDirectory: mkdtemp failed for " << tmpl;
+  path_ = buf.data();
+}
+
+void TemporaryDirectory::RecursiveDelete(const std::string& path) {
+  DIR* dir = opendir(path.c_str());
+  if (dir == nullptr) return;
+  struct dirent* ent;
+  while ((ent = readdir(dir)) != nullptr) {
+    std::string name = ent->d_name;
+    if (name == "." || name == "..") continue;
+    std::string sub = path + "/" + name;
+    struct stat sb;
+    // lstat so a symlink is never followed — delete the link itself
+    // (reference src/io/filesys.cc:29-58 refuses symlink traversal)
+    if (lstat(sub.c_str(), &sb) != 0) continue;
+    if (S_ISDIR(sb.st_mode) && !S_ISLNK(sb.st_mode)) {
+      RecursiveDelete(sub);
+    } else {
+      unlink(sub.c_str());
+    }
+  }
+  closedir(dir);
+  rmdir(path.c_str());
+}
+
+TemporaryDirectory::~TemporaryDirectory() {
+  if (verbose_) {
+    std::fprintf(stderr, "deleting temporary directory %s\n", path_.c_str());
+  }
+  RecursiveDelete(path_);
+}
 
 LocalFileSystem* LocalFileSystem::GetInstance() {
   static LocalFileSystem inst;

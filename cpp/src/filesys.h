@@ -44,6 +44,25 @@ class FileSystem {
                              std::function<FileSystem*(const URI&)> factory);
 };
 
+// Scoped mkdtemp-style temporary directory with recursive delete on
+// destruction; refuses to traverse symlinks while deleting (counterpart of
+// reference include/dmlc/filesystem.h:54 TemporaryDirectory +
+// src/io/filesys.cc:29-58).
+class TemporaryDirectory {
+ public:
+  explicit TemporaryDirectory(bool verbose = false);
+  ~TemporaryDirectory();
+  TemporaryDirectory(const TemporaryDirectory&) = delete;
+  TemporaryDirectory& operator=(const TemporaryDirectory&) = delete;
+
+  const std::string& path() const { return path_; }
+
+ private:
+  static void RecursiveDelete(const std::string& path);
+  std::string path_;
+  bool verbose_;
+};
+
 class LocalFileSystem : public FileSystem {
  public:
   static LocalFileSystem* GetInstance();

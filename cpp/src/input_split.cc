@@ -296,6 +296,87 @@ bool LineSplit::ExtractRecordAt(char* data, size_t valid, size_t* cursor,
 }
 
 // --------------------------------------------------------------------------
+SingleFileSplit::SingleFileSplit(const std::string& uri) : uri_(uri) {
+  stream_.reset(Stream::Create(uri, "r"));
+}
+
+void SingleFileSplit::BeforeFirst() {
+  DCT_CHECK(uri_ != "stdin" || (valid_ == 0 && exhausted_ == false))
+      << "stdin cannot be rewound";
+  if (uri_ != "stdin") stream_.reset(Stream::Create(uri_, "r"));
+  chunk_.clear();
+  valid_ = cursor_ = 0;
+  exhausted_ = false;
+}
+
+void SingleFileSplit::ResetPartition(unsigned rank, unsigned nsplit) {
+  DCT_CHECK(rank == 0 && nsplit == 1)
+      << "SingleFileSplit (stdin / single pipe) cannot be partitioned";
+  BeforeFirst();
+}
+
+size_t SingleFileSplit::GetTotalSize() {
+  if (uri_ == "stdin") return 0;  // unknowable on a pipe
+  URI u(uri_);
+  return FileSystem::GetInstance(u)->GetPathInfo(u).size;
+}
+
+bool SingleFileSplit::FillChunk() {
+  if (exhausted_) return false;
+  // carry bytes past `valid_` (a partial trailing line) to the front
+  chunk_.erase(chunk_.begin(), chunk_.begin() + valid_);
+  cursor_ = 0;
+  size_t have = chunk_.size();
+  chunk_.resize(have + chunk_size_);
+  size_t n = stream_->Read(chunk_.data() + have, chunk_size_);
+  chunk_.resize(have + n);
+  if (n < chunk_size_) {
+    exhausted_ = true;
+    if (!chunk_.empty() && chunk_.back() != '\n') chunk_.push_back('\n');
+    valid_ = chunk_.size();
+    return valid_ != 0;
+  }
+  // grow byte-by-byte until the chunk ends on a line boundary
+  while (!chunk_.empty() && chunk_.back() != '\n') {
+    char c;
+    if (stream_->Read(&c, 1) != 1) {
+      exhausted_ = true;
+      chunk_.push_back('\n');
+      break;
+    }
+    chunk_.push_back(c);
+  }
+  valid_ = chunk_.size();
+  return valid_ != 0;
+}
+
+bool SingleFileSplit::NextRecord(Blob* out) {
+  while (true) {
+    if (cursor_ < valid_) {
+      char* line = chunk_.data() + cursor_;
+      char* nl = static_cast<char*>(
+          std::memchr(line, '\n', valid_ - cursor_));
+      size_t len = (nl == nullptr) ? valid_ - cursor_
+                                   : static_cast<size_t>(nl - line);
+      cursor_ += len + (nl == nullptr ? 0 : 1);
+      if (len > 0 && line[len - 1] == '\r') --len;  // CRLF
+      out->dptr = line;
+      out->size = len;
+      return true;
+    }
+    if (!FillChunk()) return false;
+  }
+}
+
+bool SingleFileSplit::NextChunk(Blob* out) {
+  if (cursor_ >= valid_ && !FillChunk()) return false;
+  out->dptr = chunk_.data() + cursor_;
+  out->size = valid_ - cursor_;
+  cursor_ = valid_;
+  return true;
+}
+
+// --------------------------------------------------------------------------
 RecordIOSplit::RecordIOSplit(const std::string& uri, unsigned part,
                              unsigned nsplit, bool recurse_directories)
     : ByteSplit(uri, /*align_bytes=*/4, /*is_text=*/false,
@@ -812,6 +893,13 @@ InputSplit* InputSplit::Create(const std::string& uri, unsigned part,
   DCT_CHECK(cache_file.empty() || shuffle_parts <= 1)
       << "cache_file cannot be combined with shuffle_parts: sub-part resets "
          "would invalidate the cache every epoch";
+  if (uri == "stdin") {
+    // single-pipe fallback (reference src/io.cc:94-96): no partitioning,
+    // no cache, no prefetch wrapper
+    DCT_CHECK(type == "text") << "stdin input must be type=text";
+    DCT_CHECK(part == 0 && nsplit == 1) << "stdin cannot be partitioned";
+    return new SingleFileSplit(uri);
+  }
   InputSplit* split;
   RecordChunkSource* src;
   if (type == "text") {

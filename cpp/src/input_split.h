@@ -148,6 +148,36 @@ class ByteSplit : public InputSplit, public RecordChunkSource {
   bool is_text_;
 };
 
+// Sequential line split over one non-seekable stream — the stdin / single
+// local FILE fallback (reference src/io/single_file_split.h:32-179, selected
+// at src/io.cc:94-96 when uri=="stdin"). Partitioning is not possible on a
+// pipe, so part must be 0 of 1.
+class SingleFileSplit : public InputSplit {
+ public:
+  explicit SingleFileSplit(const std::string& uri);
+
+  void BeforeFirst() override;
+  bool NextRecord(Blob* out) override;
+  bool NextChunk(Blob* out) override;
+  void HintChunkSize(size_t bytes) override {
+    chunk_size_ = std::max(bytes, size_t(64));
+  }
+  size_t GetTotalSize() override;
+  void ResetPartition(unsigned rank, unsigned nsplit) override;
+
+ private:
+  // read chunk_size_ bytes + extend to the next '\n' (or EOF)
+  bool FillChunk();
+
+  std::string uri_;
+  std::unique_ptr<Stream> stream_;
+  std::vector<char> chunk_;
+  size_t valid_ = 0;   // bytes of chunk_ holding whole records
+  size_t cursor_ = 0;  // record-extraction position
+  size_t chunk_size_ = 16 << 20;
+  bool exhausted_ = false;
+};
+
 // Text records delimited by '\n' (reference src/io/line_split.cc).
 class LineSplit : public ByteSplit {
  public:

@@ -1,0 +1,134 @@
+// std::iostream bridge over Stream.
+//
+// Counterpart of reference include/dmlc/io.h:318-442 (dmlc::ostream /
+// dmlc::istream) and the streambuf impls at io.h:476-521: wrap any dct::Stream
+// as a buffered std::ostream / std::istream so code written against the
+// standard library can read/write URIs (local, s3, memory) transparently.
+// Byte counters mirror ostream::bytes_written / istream::bytes_read
+// (io.h:344,411) — the reference's only I/O telemetry hooks.
+#ifndef DCT_IOSTREAM_BRIDGE_H_
+#define DCT_IOSTREAM_BRIDGE_H_
+
+#include <cstring>
+#include <istream>
+#include <ostream>
+#include <streambuf>
+#include <vector>
+
+#include "base.h"
+#include "stream.h"
+
+namespace dct {
+
+// Output streambuf: buffers locally, flushes whole buffers to Stream::Write.
+class OutBuf : public std::streambuf {
+ public:
+  explicit OutBuf(Stream* stream, size_t buffer_size = 1 << 10)
+      : stream_(stream), buffer_(buffer_size) {
+    DCT_CHECK(buffer_size > 0);
+    setp(buffer_.data(), buffer_.data() + buffer_.size());
+  }
+  ~OutBuf() override { Flush(); }
+
+  void Reset(Stream* stream) {
+    Flush();
+    stream_ = stream;
+  }
+  size_t bytes_written() const { return bytes_out_; }
+
+ protected:
+  int_type overflow(int_type c) override {
+    Flush();
+    if (!traits_type::eq_int_type(c, traits_type::eof())) {
+      *pptr() = traits_type::to_char_type(c);
+      pbump(1);
+    }
+    return traits_type::not_eof(c);
+  }
+  int sync() override {
+    Flush();
+    return 0;
+  }
+
+ private:
+  void Flush() {
+    size_t n = static_cast<size_t>(pptr() - pbase());
+    if (n != 0 && stream_ != nullptr) {
+      stream_->Write(pbase(), n);
+      bytes_out_ += n;
+    }
+    setp(buffer_.data(), buffer_.data() + buffer_.size());
+  }
+  Stream* stream_;
+  std::vector<char> buffer_;
+  size_t bytes_out_ = 0;
+};
+
+// Input streambuf: refills from Stream::Read on underflow.
+class InBuf : public std::streambuf {
+ public:
+  explicit InBuf(Stream* stream, size_t buffer_size = 1 << 10)
+      : stream_(stream), buffer_(buffer_size) {
+    DCT_CHECK(buffer_size > 0);
+    setg(buffer_.data(), buffer_.data(), buffer_.data());
+  }
+
+  void Reset(Stream* stream) {
+    stream_ = stream;
+    setg(buffer_.data(), buffer_.data(), buffer_.data());
+  }
+  size_t bytes_read() const { return bytes_in_; }
+
+ protected:
+  int_type underflow() override {
+    if (gptr() == egptr()) {
+      if (stream_ == nullptr) return traits_type::eof();
+      size_t n = stream_->Read(buffer_.data(), buffer_.size());
+      bytes_in_ += n;
+      setg(buffer_.data(), buffer_.data(), buffer_.data() + n);
+      if (n == 0) return traits_type::eof();
+    }
+    return traits_type::to_int_type(*gptr());
+  }
+
+ private:
+  Stream* stream_;
+  std::vector<char> buffer_;
+  size_t bytes_in_ = 0;
+};
+
+// std::ostream over a Stream (reference dmlc::ostream, io.h:318-374).
+class ostream : public std::ostream {  // NOLINT(readability-identifier-naming)
+ public:
+  explicit ostream(Stream* stream, size_t buffer_size = 1 << 10)
+      : std::ostream(nullptr), buf_(stream, buffer_size) {
+    rdbuf(&buf_);
+  }
+  // re-point at another stream (flushes pending output first)
+  void set_stream(Stream* stream) { buf_.Reset(stream); }
+  size_t bytes_written() const { return buf_.bytes_written(); }
+
+ private:
+  OutBuf buf_;
+};
+
+// std::istream over a Stream (reference dmlc::istream, io.h:389-442).
+class istream : public std::istream {  // NOLINT(readability-identifier-naming)
+ public:
+  explicit istream(Stream* stream, size_t buffer_size = 1 << 10)
+      : std::istream(nullptr), buf_(stream, buffer_size) {
+    rdbuf(&buf_);
+  }
+  void set_stream(Stream* stream) {
+    buf_.Reset(stream);
+    clear();
+  }
+  size_t bytes_read() const { return buf_.bytes_read(); }
+
+ private:
+  InBuf buf_;
+};
+
+}  // namespace dct
+
+#endif  // DCT_IOSTREAM_BRIDGE_H_

@@ -75,6 +75,38 @@ class MemoryStream : public SeekStream {
   size_t pos_ = 0;
 };
 
+// Fixed-capacity in-memory stream over a caller-owned buffer
+// (counterpart of reference memory_io.h:21 MemoryFixedSizeStream).
+class MemoryFixedSizeStream : public SeekStream {
+ public:
+  MemoryFixedSizeStream(void* buffer, size_t capacity)
+      : buf_(static_cast<char*>(buffer)), cap_(capacity) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    size_t n = std::min(size, cap_ - std::min(pos_, cap_));
+    if (n != 0) std::memcpy(ptr, buf_ + pos_, n);
+    pos_ += n;
+    return n;
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    DCT_CHECK(pos_ + size <= cap_) << "MemoryFixedSizeStream overflow: pos "
+                                   << pos_ << " + " << size << " > " << cap_;
+    std::memcpy(buf_ + pos_, ptr, size);
+    pos_ += size;
+    return size;
+  }
+  void Seek(size_t pos) override {
+    DCT_CHECK(pos <= cap_);
+    pos_ = pos;
+  }
+  size_t Tell() override { return pos_; }
+
+ private:
+  char* buf_;
+  size_t cap_;
+  size_t pos_ = 0;
+};
+
 // Parsed URI: scheme://host/path. Empty scheme means local path.
 struct URI {
   std::string scheme;

@@ -1,0 +1,37 @@
+"""Run the C++-level unit test binary (cpp/test/test_core.cc).
+
+Covers surfaces the ctypes C API does not expose: the std::iostream bridge
+(reference io.h:318-442), MemoryFixedSizeStream (memory_io.h:21),
+TemporaryDirectory (filesystem.h:54), and the stdin SingleFileSplit
+(single_file_split.h).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTBIN = os.path.join(ROOT, "dmlc_core_tpu", "_native", "test_core")
+
+
+@pytest.fixture(scope="module")
+def testbin():
+    if not os.path.exists(TESTBIN):
+        r = subprocess.run(["make", "-C", os.path.join(ROOT, "cpp"),
+                            "testbin"], capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+    return TESTBIN
+
+
+def test_core_binary(testbin):
+    r = subprocess.run([testbin], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_stdin_split(testbin):
+    r = subprocess.run([testbin, "--stdin"], input="a\nbb\r\nccc",
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "STDIN:a|bb|ccc|" in r.stdout
