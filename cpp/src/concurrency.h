@@ -1,0 +1,236 @@
+// Concurrency primitives and thread lifecycle management.
+//
+// Counterpart of reference include/dmlc/concurrency.h (Spinlock
+// :25-57, ConcurrentBlockingQueue :61-250 with FIFO/priority modes and
+// SignalForKill) and include/dmlc/thread_group.h (ManualEvent :32-73,
+// ThreadGroup named-thread lifecycle, TimerThread periodic timer).
+// Redesigned on C++17: std::atomic_flag spin, one mutex + two CVs per queue,
+// shared_ptr-owned threads with a shutdown-request flag instead of the
+// reference's 800-line hierarchy.
+#ifndef DCT_CONCURRENCY_H_
+#define DCT_CONCURRENCY_H_
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+#include "base.h"
+
+namespace dct {
+
+// Test-and-set spinlock (reference concurrency.h:25-57).
+class Spinlock {
+ public:
+  void lock() noexcept {
+    while (flag_.test_and_set(std::memory_order_acquire)) {
+    }
+  }
+  void unlock() noexcept { flag_.clear(std::memory_order_release); }
+
+ private:
+  std::atomic_flag flag_ = ATOMIC_FLAG_INIT;
+};
+
+enum class QueueType { kFIFO, kPriority };
+
+// Blocking MPMC queue with a kill switch (reference concurrency.h:61-250).
+// Pop returns false only after SignalForKill; priority mode pops the
+// largest element first (Push takes an explicit priority).
+template <typename T, QueueType kType = QueueType::kFIFO>
+class ConcurrentBlockingQueue {
+ public:
+  void Push(T value, int priority = 0) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      if (kType == QueueType::kFIFO) {
+        fifo_.push_back(std::move(value));
+      } else {
+        heap_.push({priority, seq_++, std::move(value)});
+      }
+    }
+    cv_.notify_one();
+  }
+
+  // Blocks until an element or kill signal; false means killed+empty.
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_.wait(lock, [this] { return Size() != 0 || killed_; });
+    if (Size() == 0) return false;
+    if (kType == QueueType::kFIFO) {
+      *out = std::move(fifo_.front());
+      fifo_.pop_front();
+    } else {
+      *out = std::move(const_cast<Entry&>(heap_.top()).value);
+      heap_.pop();
+    }
+    return true;
+  }
+
+  // Wake every blocked popper; subsequent pops drain then return false.
+  void SignalForKill() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      killed_ = true;
+    }
+    cv_.notify_all();
+  }
+
+  size_t size() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return Size();
+  }
+
+ private:
+  struct Entry {
+    int priority;
+    uint64_t seq;  // FIFO among equal priorities
+    T value;
+    bool operator<(const Entry& o) const {
+      if (priority != o.priority) return priority < o.priority;
+      return seq > o.seq;
+    }
+  };
+  size_t Size() const {
+    return kType == QueueType::kFIFO ? fifo_.size() : heap_.size();
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<T> fifo_;
+  std::priority_queue<Entry> heap_;
+  uint64_t seq_ = 0;
+  bool killed_ = false;
+};
+
+// Manually-reset event gate (reference thread_group.h:32-73).
+class ManualEvent {
+ public:
+  void signal() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      set_ = true;
+    }
+    cv_.notify_all();
+  }
+  void reset() {
+    std::lock_guard<std::mutex> lock(mu_);
+    set_ = false;
+  }
+  void wait() {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_.wait(lock, [this] { return set_; });
+  }
+  template <typename Rep, typename Period>
+  bool wait_for(std::chrono::duration<Rep, Period> d) {
+    std::unique_lock<std::mutex> lock(mu_);
+    return cv_.wait_for(lock, d, [this] { return set_; });
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool set_ = false;
+};
+
+// Named-thread lifecycle manager (reference thread_group.h ThreadGroup):
+// launched threads receive a shutdown-request flag they should poll or wait
+// on; JoinAll requests shutdown and joins everything.
+class ThreadGroup {
+ public:
+  class Thread {
+   public:
+    Thread(std::string name, ThreadGroup* owner)
+        : name_(std::move(name)), owner_(owner) {}
+
+    const std::string& name() const { return name_; }
+    bool shutdown_requested() const {
+      return shutdown_.load(std::memory_order_acquire);
+    }
+    void request_shutdown() {
+      shutdown_.store(true, std::memory_order_release);
+      event_.signal();
+    }
+    // gate a worker loop: true -> shutdown was requested during the wait
+    template <typename Rep, typename Period>
+    bool wait_shutdown_for(std::chrono::duration<Rep, Period> d) {
+      event_.wait_for(d);
+      return shutdown_requested();
+    }
+
+   private:
+    friend class ThreadGroup;
+    std::string name_;
+    ThreadGroup* owner_;
+    std::atomic<bool> shutdown_{false};
+    ManualEvent event_;
+    std::thread impl_;
+  };
+
+  ~ThreadGroup() { JoinAll(); }
+
+  // Launch fn(thread*) under `name`; names must be unique while running.
+  std::shared_ptr<Thread> Start(const std::string& name,
+                                std::function<void(Thread*)> fn) {
+    auto t = std::make_shared<Thread>(name, this);
+    // publish and launch under one lock so JoinAll never observes a
+    // registered Thread whose impl_ is still being move-assigned
+    std::lock_guard<std::mutex> lock(mu_);
+    DCT_CHECK(threads_.count(name) == 0)
+        << "ThreadGroup: duplicate thread name `" << name << "`";
+    t->impl_ = std::thread([t, fn = std::move(fn)] { fn(t.get()); });
+    threads_[name] = t;
+    return t;
+  }
+
+  // Periodic timer thread (reference thread_group.h TimerThread): runs fn
+  // every `period` until shutdown; returns its handle.
+  template <typename Rep, typename Period>
+  std::shared_ptr<Thread> StartTimer(const std::string& name,
+                                     std::chrono::duration<Rep, Period> period,
+                                     std::function<void()> fn) {
+    return Start(name, [period, fn = std::move(fn)](Thread* self) {
+      while (!self->wait_shutdown_for(period)) fn();
+    });
+  }
+
+  std::shared_ptr<Thread> Get(const std::string& name) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = threads_.find(name);
+    return it == threads_.end() ? nullptr : it->second;
+  }
+
+  size_t size() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return threads_.size();
+  }
+
+  void JoinAll() {
+    std::map<std::string, std::shared_ptr<Thread>> taken;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      taken.swap(threads_);
+    }
+    for (auto& [name, t] : taken) t->request_shutdown();
+    for (auto& [name, t] : taken) {
+      if (t->impl_.joinable()) t->impl_.join();
+    }
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::string, std::shared_ptr<Thread>> threads_;
+};
+
+}  // namespace dct
+
+#endif  // DCT_CONCURRENCY_H_

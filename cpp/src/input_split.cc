@@ -898,6 +898,8 @@ InputSplit* InputSplit::Create(const std::string& uri, unsigned part,
     // no cache, no prefetch wrapper
     DCT_CHECK(type == "text") << "stdin input must be type=text";
     DCT_CHECK(part == 0 && nsplit == 1) << "stdin cannot be partitioned";
+    DCT_CHECK(cache_file.empty() && shuffle_parts <= 1)
+        << "stdin cannot be cached or shuffled (it cannot be rewound)";
     return new SingleFileSplit(uri);
   }
   InputSplit* split;

@@ -28,7 +28,14 @@ class OutBuf : public std::streambuf {
     DCT_CHECK(buffer_size > 0);
     setp(buffer_.data(), buffer_.data() + buffer_.size());
   }
-  ~OutBuf() override { Flush(); }
+  ~OutBuf() override {
+    // a throwing destructor would terminate the process; callers who need
+    // the error must flush explicitly (os.flush() / set_stream)
+    try {
+      Flush();
+    } catch (...) {
+    }
+  }
 
   void Reset(Stream* stream) {
     Flush();

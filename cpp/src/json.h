@@ -1,0 +1,420 @@
+// Streaming JSON reader/writer with declarative struct binding.
+//
+// Counterpart of reference include/dmlc/json.h: JSONReader (:44) /
+// JSONWriter (:190) event-style API (BeginObject/NextObjectItem,
+// BeginArray/NextArrayItem, Read/Write of scalars and STL containers) and
+// JSONObjectReadHelper (:312) declarative field binding with
+// required/optional fields. Redesigned on C++17: templates + if constexpr
+// replace the reference's Handler<T> trait hierarchy; input is any
+// std::istream (pair with iostream_bridge.h to parse straight off a
+// dct::Stream, the way the reference layers json.h over dmlc::istream).
+#ifndef DCT_JSON_H_
+#define DCT_JSON_H_
+
+#include <cctype>
+#include <cmath>
+#include <istream>
+#include <map>
+#include <ostream>
+#include <sstream>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+#include "base.h"
+
+namespace dct {
+
+class JSONReader;
+class JSONWriter;
+
+namespace json_detail {
+template <typename T, typename = void>
+struct IsMapLike : std::false_type {};
+template <typename T>
+struct IsMapLike<T, std::void_t<typename T::key_type, typename T::mapped_type>>
+    : std::true_type {};
+template <typename T, typename = void>
+struct IsVectorLike : std::false_type {};
+template <typename T>
+struct IsVectorLike<
+    T, std::void_t<typename T::value_type,
+                   decltype(std::declval<T>().push_back(
+                       std::declval<typename T::value_type>()))>>
+    : std::true_type {};
+}  // namespace json_detail
+
+// Event-pull JSON parser (reference json.h:44-188).
+class JSONReader {
+ public:
+  explicit JSONReader(std::istream* is) : is_(is) {}
+
+  void BeginObject() { Expect('{'); scope_counter_.push_back(0); }
+  void BeginArray() { Expect('['); scope_counter_.push_back(0); }
+
+  // Advance to the next "key": value member; false at object end.
+  bool NextObjectItem(std::string* out_key) {
+    if (!NextScopeItem('}')) return false;
+    ReadString(out_key);
+    Expect(':');
+    return true;
+  }
+  // Advance to the next array element; false at array end.
+  bool NextArrayItem() { return NextScopeItem(']'); }
+
+  void ReadString(std::string* out) {
+    Expect('"');
+    out->clear();
+    while (true) {
+      int c = is_->get();
+      DCT_CHECK(c != EOF) << "json: unterminated string" << Where();
+      if (c == '"') return;
+      if (c == '\\') {
+        int e = is_->get();
+        switch (e) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {  // \uXXXX -> UTF-8 (BMP only, like the reference)
+            unsigned code = 0;
+            for (int i = 0; i < 4; ++i) {
+              int h = is_->get();
+              DCT_CHECK(std::isxdigit(h)) << "json: bad \\u escape" << Where();
+              code = code * 16 +
+                     (std::isdigit(h) ? h - '0' : std::tolower(h) - 'a' + 10);
+            }
+            if (code < 0x80) {
+              out->push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default:
+            throw Error("json: unknown escape" + Where());
+        }
+      } else {
+        out->push_back(static_cast<char>(c));
+        if (c == '\n') ++line_;
+      }
+    }
+  }
+
+  template <typename T>
+  void ReadNumber(T* out) {
+    static_assert(std::is_arithmetic_v<T>);
+    SkipSpace();
+    // parse via the widest type then narrow — matches reference behavior of
+    // istream >> extraction per numeric type
+    if constexpr (std::is_floating_point_v<T>) {
+      double v;
+      DCT_CHECK(static_cast<bool>(*is_ >> v)) << "json: bad number" << Where();
+      *out = static_cast<T>(v);
+    } else if constexpr (std::is_signed_v<T>) {
+      long long v;  // NOLINT(runtime/int)
+      DCT_CHECK(static_cast<bool>(*is_ >> v)) << "json: bad number" << Where();
+      *out = static_cast<T>(v);
+    } else {
+      unsigned long long v;  // NOLINT(runtime/int)
+      DCT_CHECK(static_cast<bool>(*is_ >> v)) << "json: bad number" << Where();
+      *out = static_cast<T>(v);
+    }
+  }
+
+  void ReadBool(bool* out) {
+    SkipSpace();
+    std::string word;
+    while (std::isalpha(is_->peek())) word.push_back(is_->get());
+    if (word == "true") { *out = true; return; }
+    if (word == "false") { *out = false; return; }
+    throw Error("json: expected true/false, got `" + word + "`" + Where());
+  }
+
+  // Generic dispatch: scalars, strings, vector-likes, map-likes, pairs, and
+  // classes exposing Load(JSONReader*).
+  template <typename T>
+  void Read(T* out) {
+    if constexpr (std::is_same_v<T, std::string>) {
+      ReadString(out);
+    } else if constexpr (std::is_same_v<T, bool>) {
+      ReadBool(out);
+    } else if constexpr (std::is_arithmetic_v<T>) {
+      ReadNumber(out);
+    } else if constexpr (json_detail::IsMapLike<T>::value) {
+      static_assert(
+          std::is_same_v<typename T::key_type, std::string>,
+          "json object keys must be strings");
+      out->clear();
+      BeginObject();
+      std::string key;
+      while (NextObjectItem(&key)) {
+        typename T::mapped_type v{};
+        Read(&v);
+        out->emplace(key, std::move(v));
+      }
+    } else if constexpr (json_detail::IsVectorLike<T>::value) {
+      out->clear();
+      BeginArray();
+      while (NextArrayItem()) {
+        typename T::value_type v{};
+        Read(&v);
+        out->push_back(std::move(v));
+      }
+    } else {
+      out->Load(this);
+    }
+  }
+  template <typename A, typename B>
+  void Read(std::pair<A, B>* out) {
+    BeginArray();
+    DCT_CHECK(NextArrayItem()) << "json: pair needs 2 elements" << Where();
+    Read(&out->first);
+    DCT_CHECK(NextArrayItem()) << "json: pair needs 2 elements" << Where();
+    Read(&out->second);
+    DCT_CHECK(!NextArrayItem()) << "json: pair has >2 elements" << Where();
+  }
+
+  // Skip one complete value of any type (for ignoring unknown keys).
+  void SkipValue() {
+    SkipSpace();
+    int c = is_->peek();
+    if (c == '{') {
+      BeginObject();
+      std::string k;
+      while (NextObjectItem(&k)) SkipValue();
+    } else if (c == '[') {
+      BeginArray();
+      while (NextArrayItem()) SkipValue();
+    } else if (c == '"') {
+      std::string s;
+      ReadString(&s);
+    } else {
+      while (c != EOF && c != ',' && c != '}' && c != ']' &&
+             !std::isspace(c)) {
+        is_->get();
+        c = is_->peek();
+      }
+    }
+  }
+
+ private:
+  void SkipSpace() {
+    while (std::isspace(is_->peek())) {
+      if (is_->get() == '\n') ++line_;
+    }
+  }
+  void Expect(char want) {
+    SkipSpace();
+    int c = is_->get();
+    DCT_CHECK(c == want) << "json: expected `" << want << "` got `"
+                         << static_cast<char>(c) << "`" << Where();
+  }
+  bool NextScopeItem(char closer) {
+    DCT_CHECK(!scope_counter_.empty()) << "json: Next*Item outside scope";
+    SkipSpace();
+    if (scope_counter_.back() != 0) {
+      int c = is_->get();
+      if (c == closer) { scope_counter_.pop_back(); return false; }
+      DCT_CHECK(c == ',') << "json: expected `,`" << Where();
+      SkipSpace();
+    } else if (is_->peek() == closer) {
+      is_->get();
+      scope_counter_.pop_back();
+      return false;
+    }
+    ++scope_counter_.back();
+    return true;
+  }
+  std::string Where() const { return " at line " + std::to_string(line_); }
+
+  std::istream* is_;
+  std::vector<size_t> scope_counter_;
+  size_t line_ = 1;
+};
+
+// Event-push JSON emitter (reference json.h:190-310).
+class JSONWriter {
+ public:
+  explicit JSONWriter(std::ostream* os) : os_(os) {}
+
+  void BeginObject(bool multi_line = true) {
+    *os_ << '{';
+    scope_counter_.push_back(0);
+    scope_multi_line_.push_back(multi_line);
+  }
+  void EndObject() { CloseScope('}'); }
+  void BeginArray(bool multi_line = false) {
+    *os_ << '[';
+    scope_counter_.push_back(0);
+    scope_multi_line_.push_back(multi_line);
+  }
+  void EndArray() { CloseScope(']'); }
+
+  template <typename T>
+  void WriteObjectKeyValue(const std::string& key, const T& value) {
+    Separator(scope_counter_.back()++ != 0);
+    WriteString(key);
+    *os_ << ": ";
+    Write(value);
+  }
+  template <typename T>
+  void WriteArrayItem(const T& value) {
+    Separator(scope_counter_.back()++ != 0);
+    Write(value);
+  }
+
+  void WriteString(const std::string& s) {
+    *os_ << '"';
+    for (char ch : s) {
+      switch (ch) {
+        case '"': *os_ << "\\\""; break;
+        case '\\': *os_ << "\\\\"; break;
+        case '\b': *os_ << "\\b"; break;
+        case '\f': *os_ << "\\f"; break;
+        case '\n': *os_ << "\\n"; break;
+        case '\r': *os_ << "\\r"; break;
+        case '\t': *os_ << "\\t"; break;
+        default: *os_ << ch;
+      }
+    }
+    *os_ << '"';
+  }
+
+  template <typename T>
+  void Write(const T& value) {
+    if constexpr (std::is_same_v<T, std::string>) {
+      WriteString(value);
+    } else if constexpr (std::is_same_v<T, bool>) {
+      *os_ << (value ? "true" : "false");
+    } else if constexpr (std::is_floating_point_v<T>) {
+      // round-trip precision (reference uses max_digits10 too)
+      auto old = os_->precision(std::numeric_limits<T>::max_digits10);
+      DCT_CHECK(std::isfinite(value)) << "json cannot encode non-finite";
+      *os_ << value;
+      os_->precision(old);
+    } else if constexpr (std::is_arithmetic_v<T>) {
+      *os_ << +value;  // promote char-sized ints to numbers
+    } else if constexpr (json_detail::IsMapLike<T>::value) {
+      BeginObject(false);
+      for (const auto& [k, v] : value) WriteObjectKeyValue(k, v);
+      EndObject();
+    } else if constexpr (json_detail::IsVectorLike<T>::value) {
+      BeginArray(false);
+      for (const auto& v : value) WriteArrayItem(v);
+      EndArray();
+    } else {
+      value.Save(this);
+    }
+  }
+  template <typename A, typename B>
+  void Write(const std::pair<A, B>& value) {
+    BeginArray(false);
+    WriteArrayItem(value.first);
+    WriteArrayItem(value.second);
+    EndArray();
+  }
+  void Write(const char* value) { WriteString(value); }
+
+ private:
+  void Separator(bool need_comma) {
+    if (need_comma) *os_ << ", ";
+    if (scope_multi_line_.back()) {
+      *os_ << '\n' << std::string(scope_counter_.size() * 2, ' ');
+    }
+  }
+  void CloseScope(char closer) {
+    bool multi = scope_multi_line_.back();
+    bool had_items = scope_counter_.back() != 0;
+    scope_counter_.pop_back();
+    scope_multi_line_.pop_back();
+    if (multi && had_items) {
+      *os_ << '\n' << std::string(scope_counter_.size() * 2, ' ');
+    }
+    *os_ << closer;
+  }
+
+  std::ostream* os_;
+  std::vector<size_t> scope_counter_;
+  std::vector<bool> scope_multi_line_;
+};
+
+// Declarative object binding (reference json.h:312-370): declare typed
+// fields once, then ReadAllFields enforces required fields and (optionally)
+// rejects unknown keys.
+class JSONObjectReadHelper {
+ public:
+  template <typename T>
+  void DeclareField(const std::string& key, T* addr) {
+    Declare(key, addr, /*optional=*/false);
+  }
+  template <typename T>
+  void DeclareOptionalField(const std::string& key, T* addr) {
+    Declare(key, addr, /*optional=*/true);
+  }
+
+  void ReadAllFields(JSONReader* reader, bool allow_unknown = false) {
+    for (auto& [key, entry] : fields_) entry.seen = false;
+    reader->BeginObject();
+    std::string key;
+    while (reader->NextObjectItem(&key)) {
+      auto it = fields_.find(key);
+      if (it == fields_.end()) {
+        DCT_CHECK(allow_unknown) << "json: unknown field `" << key << "`";
+        reader->SkipValue();
+        continue;
+      }
+      it->second.read(reader);
+      it->second.seen = true;
+    }
+    for (auto& [k, entry] : fields_) {
+      DCT_CHECK(entry.seen || entry.optional)
+          << "json: required field `" << k << "` missing";
+    }
+  }
+
+ private:
+  template <typename T>
+  void Declare(const std::string& key, T* addr, bool optional) {
+    DCT_CHECK(fields_.count(key) == 0)
+        << "json: field `" << key << "` declared twice";
+    fields_[key] = {[addr](JSONReader* r) { r->Read(addr); }, optional,
+                    false};
+  }
+  struct Entry {
+    std::function<void(JSONReader*)> read;
+    bool optional = false;
+    bool seen = false;
+  };
+  std::map<std::string, Entry> fields_;
+};
+
+// Convenience round-trips.
+template <typename T>
+std::string ToJSONString(const T& value) {
+  std::ostringstream os;
+  JSONWriter writer(&os);
+  writer.Write(value);
+  return os.str();
+}
+
+template <typename T>
+void FromJSONString(const std::string& text, T* out) {
+  std::istringstream is(text);
+  JSONReader reader(&is);
+  reader.Read(out);
+}
+
+}  // namespace dct
+
+#endif  // DCT_JSON_H_

@@ -11,9 +11,16 @@
 #include <string>
 #include <sys/stat.h>
 
+#include <atomic>
+#include <map>
+#include <thread>
+#include <vector>
+
+#include "../src/concurrency.h"
 #include "../src/filesys.h"
 #include "../src/input_split.h"
 #include "../src/iostream_bridge.h"
+#include "../src/json.h"
 #include "../src/serializer.h"
 #include "../src/stream.h"
 
@@ -133,6 +140,134 @@ void TestSingleFileSplit() {
   EXPECT(threw);
 }
 
+struct JPoint {
+  int x = 0;
+  std::vector<double> ys;
+  void Save(dct::JSONWriter* w) const {
+    w->BeginObject(false);
+    w->WriteObjectKeyValue("x", x);
+    w->WriteObjectKeyValue("ys", ys);
+    w->EndObject();
+  }
+  void Load(dct::JSONReader* r) {
+    dct::JSONObjectReadHelper helper;
+    helper.DeclareField("x", &x);
+    helper.DeclareOptionalField("ys", &ys);
+    helper.ReadAllFields(r);
+  }
+};
+
+void TestJSON() {
+  // scalar / container round-trips (reference unittest_json.cc coverage)
+  std::map<std::string, std::vector<int>> m{{"a", {1, 2}}, {"b", {}}};
+  std::string text = dct::ToJSONString(m);
+  std::map<std::string, std::vector<int>> back;
+  dct::FromJSONString(text, &back);
+  EXPECT(back == m);
+
+  std::vector<std::pair<std::string, double>> pairs{{"pi", 3.25}};
+  std::vector<std::pair<std::string, double>> pback;
+  dct::FromJSONString(dct::ToJSONString(pairs), &pback);
+  EXPECT(pback == pairs);
+
+  // struct Save/Load with helper: unknown key rejected unless allowed,
+  // missing required field throws, escapes survive
+  JPoint p;
+  p.x = -7;
+  p.ys = {0.5, 1.5};
+  JPoint q;
+  dct::FromJSONString(dct::ToJSONString(p), &q);
+  EXPECT(q.x == -7 && q.ys == p.ys);
+
+  JPoint r;
+  bool threw = false;
+  try {
+    dct::FromJSONString("{\"ys\": []}", &r);  // x required
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  EXPECT(threw);
+
+  std::string esc;
+  dct::FromJSONString("\"a\\n\\\"b\\u0041\"", &esc);
+  EXPECT(esc == "a\n\"bA");
+
+  bool flag = false;
+  dct::FromJSONString(" true ", &flag);
+  EXPECT(flag);
+}
+
+void TestConcurrentQueue() {
+  // FIFO: N producers push, consumers drain, kill unblocks
+  dct::ConcurrentBlockingQueue<int> q;
+  std::atomic<long> sum{0};
+  std::vector<std::thread> producers, consumers;
+  for (int p = 0; p < 4; ++p) {
+    producers.emplace_back([&q, p] {
+      for (int i = 0; i < 1000; ++i) q.Push(p * 1000 + i);
+    });
+  }
+  for (int c = 0; c < 4; ++c) {
+    consumers.emplace_back([&q, &sum] {
+      int v;
+      while (q.Pop(&v)) sum += v;
+    });
+  }
+  for (auto& t : producers) t.join();
+  q.SignalForKill();
+  for (auto& t : consumers) t.join();
+  long expect = 0;
+  for (int p = 0; p < 4; ++p)
+    for (int i = 0; i < 1000; ++i) expect += p * 1000 + i;
+  EXPECT(sum == expect);
+
+  // priority mode: highest priority first, FIFO among equals
+  dct::ConcurrentBlockingQueue<std::string, dct::QueueType::kPriority> pq;
+  pq.Push("low", 1);
+  pq.Push("hi-a", 9);
+  pq.Push("hi-b", 9);
+  std::string s;
+  EXPECT(pq.Pop(&s) && s == "hi-a");
+  EXPECT(pq.Pop(&s) && s == "hi-b");
+  EXPECT(pq.Pop(&s) && s == "low");
+}
+
+void TestThreadGroup() {
+  dct::ThreadGroup group;
+  std::atomic<int> ticks{0};
+  std::atomic<bool> worker_saw_shutdown{false};
+  group.StartTimer("timer", std::chrono::milliseconds(5),
+                   [&ticks] { ++ticks; });
+  group.Start("worker", [&worker_saw_shutdown](dct::ThreadGroup::Thread* t) {
+    while (!t->wait_shutdown_for(std::chrono::milliseconds(5))) {
+    }
+    worker_saw_shutdown = true;
+  });
+  EXPECT(group.size() == 2);
+  EXPECT(group.Get("worker") != nullptr);
+  EXPECT(group.Get("nope") == nullptr);
+  std::this_thread::sleep_for(std::chrono::milliseconds(40));
+  group.JoinAll();
+  EXPECT(ticks.load() >= 2);
+  EXPECT(worker_saw_shutdown.load());
+  EXPECT(group.size() == 0);
+
+  // spinlock under contention
+  dct::Spinlock lock;
+  int counter = 0;
+  std::vector<std::thread> ts;
+  for (int i = 0; i < 4; ++i) {
+    ts.emplace_back([&lock, &counter] {
+      for (int j = 0; j < 10000; ++j) {
+        std::lock_guard<dct::Spinlock> g(lock);
+        ++counter;
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  EXPECT(counter == 40000);
+}
+
 void TestStdinSplit() {
   // only run when the harness pipes data in (argv gate in main)
   dct::SingleFileSplit split("stdin");
@@ -156,6 +291,9 @@ int main(int argc, char** argv) {
   TestIostreamBridge();
   TestTemporaryDirectory();
   TestSingleFileSplit();
+  TestJSON();
+  TestConcurrentQueue();
+  TestThreadGroup();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;
