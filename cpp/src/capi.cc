@@ -51,6 +51,11 @@ typedef struct {
   uint64_t max_index;
   uint32_t max_field;
   int32_t index_is_64;
+  // typed csv values (value_dtype 0=f32/1=i32/2=i64); for non-zero dtypes
+  // `value` is NULL and the matching typed pointer holds nnz entries
+  const int32_t* value_i32;
+  const int64_t* value_i64;
+  int32_t value_dtype;
 } dct_rowblock_t;
 
 namespace {
@@ -78,6 +83,9 @@ struct ParserHandle {
     out->max_index = b->max_index;
     out->max_field = b->max_field;
     out->index_is_64 = sizeof(T) == 8 ? 1 : 0;
+    out->value_i32 = b->value_i32.empty() ? nullptr : b->value_i32.data();
+    out->value_i64 = b->value_i64.empty() ? nullptr : b->value_i64.data();
+    out->value_dtype = b->value_dtype;
   }
 };
 }  // namespace

@@ -78,9 +78,9 @@ namespace {
 // `idx:val` and bare `idx` features) must fail loudly, not misalign.
 template <typename IndexType>
 void ValidateBlock(const RowBlockContainer<IndexType>& b) {
-  DCT_CHECK(b.value.empty() || b.value.size() == b.index.size())
+  DCT_CHECK(b.ValueCount() == 0 || b.ValueCount() == b.index.size())
       << "inconsistent input: some features have explicit values and some "
-         "do not (" << b.value.size() << " values for " << b.index.size()
+         "do not (" << b.ValueCount() << " values for " << b.index.size()
       << " features)";
   DCT_CHECK(b.weight.empty() || b.weight.size() == b.label.size())
       << "inconsistent input: only " << b.weight.size() << " of "
@@ -239,15 +239,36 @@ CSVParser<IndexType>::CSVParser(InputSplit* source,
   DCT_CHECK(label_column_ != weight_column_ || label_column_ < 0)
       << "label and weight columns must differ";
   std::string dtype = GetArg(args, "dtype", "float32");
-  DCT_CHECK_EQ(dtype, std::string("float32"))
-      << "only float32 csv values supported for now";
+  // typed values (reference csv_parser.h:24-147 DType float32/int32/int64)
+  if (dtype == "float32") {
+    value_dtype_ = 0;
+  } else if (dtype == "int32") {
+    value_dtype_ = 1;
+  } else if (dtype == "int64") {
+    value_dtype_ = 2;
+  } else {
+    throw Error("csv dtype must be float32|int32|int64, got " + dtype);
+  }
 }
+
+namespace {
+// value-cell sink per csv dtype: parses [vp, cell_end) into `values`
+template <typename VT>
+bool ParseCell(const char* vp, const char* cell_end, std::vector<VT>* values) {
+  VT v;
+  const char* after;
+  if (!ParseNum<VT>(vp, cell_end, &after, &v)) return false;
+  values->push_back(v);
+  return true;
+}
+}  // namespace
 
 // reference src/data/csv_parser.h:76-147
 template <typename IndexType>
 void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
                                       RowBlockContainer<IndexType>* out) {
   out->Clear();
+  out->value_dtype = value_dtype_;
   const char* p = SkipUTF8BOM(begin, end);
   while (p != end) {
     const char* line_end;
@@ -266,18 +287,22 @@ void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
       while (cell_end != line_end && *cell_end != delimiter_) ++cell_end;
       const char* vp = cur;
       while (vp != cell_end && IsBlankChar(*vp)) ++vp;
-      float v;
-      const char* after;
-      bool parsed = ParseNum<float>(vp, cell_end, &after, &v);
-      if (column == label_column_) {
-        if (parsed) label = v;
-      } else if (column == weight_column_) {
-        if (parsed) weight = v;
-      } else if (parsed) {
-        out->value.push_back(v);
-        out->index.push_back(idx++);
+      if (column == label_column_ || column == weight_column_) {
+        float v;
+        const char* after;
+        if (ParseNum<float>(vp, cell_end, &after, &v)) {
+          (column == label_column_ ? label : weight) = v;
+        }
       } else {
-        ++idx;  // missing value: skip but keep the column index
+        bool parsed =
+            value_dtype_ == 0 ? ParseCell(vp, cell_end, &out->value)
+            : value_dtype_ == 1 ? ParseCell(vp, cell_end, &out->value_i32)
+                                : ParseCell(vp, cell_end, &out->value_i64);
+        if (parsed) {
+          out->index.push_back(idx++);
+        } else {
+          ++idx;  // missing value: skip but keep the column index
+        }
       }
       ++column;
       if (cell_end == line_end) break;
@@ -357,7 +382,10 @@ void LibFMParser<IndexType>::ParseBlock(const char* begin, const char* end,
 
 // --------------------------------------------------------------------------
 namespace {
-constexpr uint64_t kRowCacheMagic = 0x44435452424c4b; // "DCTRBLK"
+// "DCTRBL2" — bumped when the RowBlockContainer wire format changes (v2
+// added typed csv value arrays); a stale v1 cache fails the magic check and
+// is rebuilt transparently
+constexpr uint64_t kRowCacheMagic = 0x44435452424c32;
 
 uint64_t FingerprintHash64(const std::string& s) {
   uint64_t h = 1469598103934665603ull;  // FNV-1a
@@ -566,7 +594,8 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
                : parser;
   if (!spec.cache_file.empty()) {
     std::string fingerprint = spec.uri + "|" + std::to_string(part) + "|" +
-                              std::to_string(npart) + "|" + fmt;
+                              std::to_string(npart) + "|" + fmt + "|dtype=" +
+                              GetArg(spec.args, "dtype", "float32");
     out = new DiskCacheParser<IndexType>(out, spec.cache_file + ".rowblock",
                                          fingerprint);
   }

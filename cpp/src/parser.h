@@ -105,6 +105,7 @@ class CSVParser : public TextParserBase<IndexType> {
   int label_column_ = -1;
   int weight_column_ = -1;
   char delimiter_ = ',';
+  int value_dtype_ = 0;  // 0=float32, 1=int32, 2=int64
 };
 
 // libfm: `label[:weight] field:feature:value...`

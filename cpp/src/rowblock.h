@@ -29,10 +29,18 @@ struct RowBlockContainer {
   std::vector<uint32_t> field; // empty = absent (libfm only)
   std::vector<IndexType> index;
   std::vector<float> value;    // empty = implicit 1.0 (binary features)
+  // typed csv values (reference csv_parser.h DType float32/int32/int64):
+  // exactly one of value/value_i32/value_i64 is populated per value_dtype
+  std::vector<int32_t> value_i32;
+  std::vector<int64_t> value_i64;
+  int32_t value_dtype = 0;  // 0=float32, 1=int32, 2=int64
   uint64_t max_index = 0;
   uint32_t max_field = 0;
 
   size_t Size() const { return label.size(); }
+  size_t ValueCount() const {
+    return value.size() + value_i32.size() + value_i64.size();
+  }
 
   void Clear() {
     offset.assign(1, 0);
@@ -42,6 +50,9 @@ struct RowBlockContainer {
     field.clear();
     index.clear();
     value.clear();
+    value_i32.clear();
+    value_i64.clear();
+    value_dtype = 0;
     max_index = 0;
     max_field = 0;
   }
@@ -54,11 +65,20 @@ struct RowBlockContainer {
   size_t MemCostBytes() const {
     return offset.size() * 8 + label.size() * 4 + weight.size() * 4 +
            qid.size() * 8 + field.size() * 4 +
-           index.size() * sizeof(IndexType) + value.size() * 4;
+           index.size() * sizeof(IndexType) + value.size() * 4 +
+           value_i32.size() * 4 + value_i64.size() * 8;
   }
 
   // Append all rows of another container (reference row_block.h Push).
   void Append(const RowBlockContainer& other) {
+    // dtype reconciliation up front, before any mutation: adopt the other
+    // side's dtype only when it actually carries typed values
+    DCT_CHECK(value_dtype == other.value_dtype || ValueCount() == 0 ||
+              other.ValueCount() == 0)
+        << "cannot append row blocks of different value dtypes";
+    if (other.value_dtype != 0 && other.ValueCount() != 0) {
+      value_dtype = other.value_dtype;
+    }
     size_t base = index.size();
     for (size_t i = 1; i < other.offset.size(); ++i) {
       offset.push_back(other.offset[i] + base);
@@ -69,6 +89,10 @@ struct RowBlockContainer {
     field.insert(field.end(), other.field.begin(), other.field.end());
     index.insert(index.end(), other.index.begin(), other.index.end());
     value.insert(value.end(), other.value.begin(), other.value.end());
+    value_i32.insert(value_i32.end(), other.value_i32.begin(),
+                     other.value_i32.end());
+    value_i64.insert(value_i64.end(), other.value_i64.begin(),
+                     other.value_i64.end());
     max_index = std::max(max_index, other.max_index);
     max_field = std::max(max_field, other.max_field);
   }
@@ -83,6 +107,9 @@ struct RowBlockContainer {
     serial::WriteVec(s, field);
     serial::WriteVec(s, index);
     serial::WriteVec(s, value);
+    serial::WriteVec(s, value_i32);
+    serial::WriteVec(s, value_i64);
+    serial::WritePOD<int32_t>(s, value_dtype);
     serial::WritePOD<uint64_t>(s, max_index);
     serial::WritePOD<uint32_t>(s, max_field);
   }
@@ -105,6 +132,9 @@ struct RowBlockContainer {
     serial::ReadVec(s, &field);
     serial::ReadVec(s, &index);
     serial::ReadVec(s, &value);
+    serial::ReadVec(s, &value_i32);
+    serial::ReadVec(s, &value_i64);
+    value_dtype = serial::ReadPOD<int32_t>(s);
     max_index = serial::ReadPOD<uint64_t>(s);
     max_field = serial::ReadPOD<uint32_t>(s);
     return true;

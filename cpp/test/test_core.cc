@@ -17,6 +17,7 @@
 #include <vector>
 
 #include "../src/concurrency.h"
+#include "../src/pipeline.h"
 #include "../src/filesys.h"
 #include "../src/input_split.h"
 #include "../src/iostream_bridge.h"
@@ -268,6 +269,59 @@ void TestThreadGroup() {
   EXPECT(counter == 40000);
 }
 
+void TestPipelineExceptionPropagation() {
+  // producer-side exceptions must surface at the consumer (reference
+  // unittest_threaditer_exc_handling.cc; threadediter.h state machine)
+  dct::PipelineIter<int> pipe(2);
+  int produced = 0;
+  pipe.Init([&produced](int** cell) {
+    if (*cell == nullptr) *cell = new int;
+    if (produced == 3) throw dct::Error("producer boom");
+    **cell = produced++;
+    return true;
+  });
+  int sum = 0;
+  bool threw = false;
+  try {
+    int* c = nullptr;
+    while (pipe.Next(&c)) {
+      sum += *c;
+      pipe.Recycle(&c);
+    }
+  } catch (const dct::Error& e) {
+    threw = std::string(e.what()).find("boom") != std::string::npos;
+  }
+  EXPECT(threw);
+  // the error may overtake cells still in the queue (rethrow happens at the
+  // top of Next, as in the reference), so the consumed prefix varies
+  EXPECT(sum == 0 || sum == 1 || sum == 3);
+
+  // BeforeFirst restart semantics survive normal (non-error) exhaustion
+  dct::PipelineIter<int> pipe2(2);
+  int epoch_val = 0;
+  int emitted = 0;
+  pipe2.Init(
+      [&](int** cell) {
+        if (*cell == nullptr) *cell = new int;
+        if (emitted == 2) return false;
+        **cell = epoch_val * 10 + emitted++;
+        return true;
+      },
+      [&] { emitted = 0; ++epoch_val; });
+  std::vector<int> got;
+  int* c = nullptr;
+  while (pipe2.Next(&c)) {
+    got.push_back(*c);
+    pipe2.Recycle(&c);
+  }
+  pipe2.BeforeFirst();
+  while (pipe2.Next(&c)) {
+    got.push_back(*c);
+    pipe2.Recycle(&c);
+  }
+  EXPECT((got == std::vector<int>{0, 1, 10, 11}));
+}
+
 void TestStdinSplit() {
   // only run when the harness pipes data in (argv gate in main)
   dct::SingleFileSplit split("stdin");
@@ -294,6 +348,7 @@ int main(int argc, char** argv) {
   TestJSON();
   TestConcurrentQueue();
   TestThreadGroup();
+  TestPipelineExceptionPropagation();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;

@@ -43,6 +43,9 @@ class RowBlockC(ctypes.Structure):
         ("max_index", ctypes.c_uint64),
         ("max_field", ctypes.c_uint32),
         ("index_is_64", ctypes.c_int32),
+        ("value_i32", ctypes.POINTER(ctypes.c_int32)),
+        ("value_i64", ctypes.POINTER(ctypes.c_int64)),
+        ("value_dtype", ctypes.c_int32),
     ]
 
 
@@ -378,8 +381,16 @@ class RowBlock:
             idx_type = ctypes.c_uint64 if c.index_is_64 else ctypes.c_uint32
             self.index = np.ctypeslib.as_array(
                 ctypes.cast(c.index, ctypes.POINTER(idx_type)), (nnz,))
-        self.value = (np.ctypeslib.as_array(c.value, (nnz,))
-                      if (c.value and nnz) else None)
+        # typed csv values: value_dtype 0=float32, 1=int32, 2=int64
+        # (reference csv_parser.h DType); exactly one array is populated
+        if c.value_dtype == 1:
+            vptr, vnnz = c.value_i32, nnz
+        elif c.value_dtype == 2:
+            vptr, vnnz = c.value_i64, nnz
+        else:
+            vptr, vnnz = c.value, nnz
+        self.value = (np.ctypeslib.as_array(vptr, (vnnz,))
+                      if (vptr and vnnz) else None)
         self.max_index = c.max_index
         self.max_field = c.max_field
 

@@ -346,6 +346,215 @@ def submit_tpu_pod(args) -> None:
         t.join()
 
 
+# -- kubernetes --------------------------------------------------------------
+def build_kube_manifest(args, role: str, count: int,
+                        envs: Dict[str, object]) -> Dict[str, object]:
+    """One indexed Job per role (reference kubernetes.py submits a
+    manifest-template job per role). Emitted as a JSON-compatible dict —
+    kubectl accepts JSON manifests, so no yaml dependency is needed. The
+    DMLC_TASK_ID comes from the pod's completion index; TPU pods add
+    google.com/tpu resources + the GKE tpu nodeSelector pair."""
+    image = (args.kube_worker_image if role == "worker"
+             else args.kube_server_image)
+    mem = (args.worker_memory_mb if role == "worker"
+           else args.server_memory_mb)
+    cores = args.worker_cores if role == "worker" else args.server_cores
+    env_list = [{"name": k, "value": str(v)} for k, v in envs.items()]
+    env_list += [
+        {"name": "DMLC_ROLE", "value": role},
+        {"name": "DMLC_JOB_CLUSTER", "value": "kubernetes"},
+        {"name": "DMLC_TASK_ID",
+         "valueFrom": {"fieldRef": {
+             "fieldPath":
+                 "metadata.annotations['batch.kubernetes.io/job-completion-index']"}}},
+    ]
+    resources: Dict[str, object] = {
+        "requests": {"memory": f"{mem}Mi", "cpu": str(cores)},
+        "limits": {"memory": f"{mem}Mi"},
+    }
+    spec: Dict[str, object] = {
+        "containers": [{
+            "name": f"dmlc-{role}",
+            "image": image,
+            "command": list(args.command),
+            "env": env_list,
+            "resources": resources,
+        }],
+        "restartPolicy": "Never",
+    }
+    if args.kube_tpu_type:
+        # chip count is independent of the cpu request: explicit flag, else
+        # the product of the topology dims (2x4 -> 8)
+        chips = args.kube_tpu_chips
+        if chips is None and args.kube_tpu_topology:
+            dims = args.kube_tpu_topology.lower().split("x")
+            chips = 1
+            for d in dims:
+                chips *= int(d)
+        if chips is None:
+            raise SystemExit(
+                "kubernetes: pass --kube-tpu-chips or --kube-tpu-topology "
+                "with --kube-tpu-type")
+        resources["limits"] = dict(resources["limits"],
+                                   **{"google.com/tpu": str(chips)})
+        resources["requests"] = dict(resources["requests"],
+                                     **{"google.com/tpu": str(chips)})
+        selector = {"cloud.google.com/gke-tpu-accelerator": args.kube_tpu_type}
+        if args.kube_tpu_topology:
+            selector["cloud.google.com/gke-tpu-topology"] = \
+                args.kube_tpu_topology
+        spec["nodeSelector"] = selector
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": f"{args.jobname}-{role}",
+            "namespace": args.kube_namespace,
+            "labels": {"app": "dmlc", "dmlc-job": args.jobname},
+        },
+        "spec": {
+            "completions": count,
+            "parallelism": count,
+            "completionMode": "Indexed",
+            "backoffLimit": max(int(args.num_attempt), 0) * count,
+            "template": {
+                "metadata": {"labels": {"app": "dmlc",
+                                        "dmlc-job": args.jobname,
+                                        "dmlc-role": role}},
+                "spec": spec,
+            },
+        },
+    }
+
+
+def submit_kubernetes(args) -> None:
+    """Reference tracker/dmlc_tracker/kubernetes.py: template a Job per role
+    and submit; the rendezvous tracker runs here and pods dial back via
+    DMLC_TRACKER_URI (which must be reachable from the cluster — pass
+    --host-ip)."""
+    import json
+
+    if args.jobname is None:
+        args.jobname = f"dmlc-{args.command[0].split('/')[-1]}"
+    args.jobname = args.jobname.replace("_", "-").replace(".", "-").lower()
+
+    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        manifests = []
+        if nserver:
+            manifests.append(build_kube_manifest(args, "server", nserver,
+                                                 envs))
+        if nworker:
+            manifests.append(build_kube_manifest(args, "worker", nworker,
+                                                 envs))
+        payload = json.dumps({"apiVersion": "v1", "kind": "List",
+                              "items": manifests}, indent=2)
+        if args.kube_dry_run:
+            print(payload)
+            return
+        subprocess.run(["kubectl", "apply", "-f", "-"], input=payload,
+                       text=True, check=True)
+
+    if args.kube_dry_run:
+        # no tracker: render manifests with placeholder rendezvous env and
+        # return immediately (nothing listens, nothing leaks)
+        launch(args.num_workers, args.num_servers, {
+            "DMLC_TRACKER_URI": args.host_ip or "<tracker-host>",
+            "DMLC_TRACKER_PORT": 9091,
+            "DMLC_NUM_WORKER": args.num_workers,
+            "DMLC_NUM_SERVER": args.num_servers,
+        })
+        return
+
+    rendezvous.run_job(args.num_workers, args.num_servers, launch,
+                       host_ip=args.host_ip or "auto",
+                       ps_cmd=" ".join(args.command))
+
+
+# -- yarn --------------------------------------------------------------------
+def build_yarn_command(args, role: str, n: int,
+                       envs: Dict[str, object]) -> List[str]:
+    """Reference yarn.py ships a Java AppMaster jar (tracker/yarn/) that
+    allocates one container per task and restarts failed tasks. This build
+    has no Java component; the same contract is expressed as one `yarn jar
+    <distributed-shell>` submission *per role* (like the mpi/slurm backends)
+    carrying the DMLC_* env protocol, with container count/memory/cores
+    mapped onto -num_containers/-container_*."""
+    e = dict(envs)
+    e["DMLC_ROLE"] = role
+    e["DMLC_JOB_CLUSTER"] = "yarn"
+    shell_env = []
+    for k, v in e.items():
+        shell_env += ["-shell_env", f"{k}={v}"]
+    mem = args.worker_memory_mb if role == "worker" else args.server_memory_mb
+    cores = args.worker_cores if role == "worker" else args.server_cores
+    jar = os.getenv("DMLC_YARN_SHELL_JAR",
+                    "hadoop-yarn-applications-distributedshell.jar")
+    cmd = ["yarn", "jar", jar,
+           "-jar", jar,
+           "-appname", f"{args.jobname or 'dmlc-job'}-{role}",
+           "-num_containers", str(n),
+           "-container_memory", str(mem),
+           "-container_vcores", str(cores)]
+    cmd += shell_env
+    cmd += ["-shell_command", " ".join(args.command)]
+    return cmd
+
+
+def submit_yarn(args) -> None:
+    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        for role, n in (("server", nserver), ("worker", nworker)):
+            if n == 0:
+                continue
+            cmd = build_yarn_command(args, role, n, envs)
+            logger.info("%s", " ".join(cmd))
+            threading.Thread(
+                target=lambda c=list(cmd): subprocess.check_call(c),
+                daemon=True).start()
+
+    rendezvous.run_job(args.num_workers, args.num_servers, launch,
+                       host_ip=args.host_ip or "auto",
+                       ps_cmd=" ".join(args.command))
+
+
+# -- mesos -------------------------------------------------------------------
+def build_mesos_command(args, role: str, n: int,
+                        envs: Dict[str, object]) -> List[str]:
+    """Reference mesos.py registers a framework that launches one task per
+    worker/server; expressed here as `mesos-execute` task groups against
+    --mesos-master with the env protocol inlined."""
+    e = dict(envs)
+    e["DMLC_ROLE"] = role
+    e["DMLC_JOB_CLUSTER"] = "mesos"
+    mem = args.worker_memory_mb if role == "worker" else args.server_memory_mb
+    cores = args.worker_cores if role == "worker" else args.server_cores
+    master = args.mesos_master or os.getenv("MESOS_MASTER")
+    if not master:
+        raise SystemExit("mesos: pass --mesos-master or set MESOS_MASTER")
+    return ["mesos-execute",
+            f"--master={master}",
+            f"--name=dmlc-{role}",
+            f"--instances={n}",
+            f"--resources=cpus:{cores};mem:{mem}",
+            "--command=" + inline_env(e) + " " + " ".join(args.command)]
+
+
+def submit_mesos(args) -> None:
+    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        for role, n in (("server", args.num_servers),
+                        ("worker", args.num_workers)):
+            if n == 0:
+                continue
+            cmd = build_mesos_command(args, role, n, envs)
+            logger.info("%s", " ".join(cmd))
+            threading.Thread(
+                target=lambda c=list(cmd): subprocess.check_call(c),
+                daemon=True).start()
+
+    rendezvous.run_job(args.num_workers, args.num_servers, launch,
+                       host_ip=args.host_ip or "auto",
+                       ps_cmd=" ".join(args.command))
+
+
 BACKENDS = {
     "local": submit_local,
     "ssh": submit_ssh,
@@ -353,4 +562,7 @@ BACKENDS = {
     "sge": submit_sge,
     "slurm": submit_slurm,
     "tpu-pod": submit_tpu_pod,
+    "kubernetes": submit_kubernetes,
+    "yarn": submit_yarn,
+    "mesos": submit_mesos,
 }

@@ -17,7 +17,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="Submit a distributed dmlc_core_tpu job")
     default_cluster = os.getenv("DMLC_SUBMIT_CLUSTER")
     p.add_argument("--cluster", default=default_cluster,
-                   choices=["local", "ssh", "mpi", "sge", "slurm", "tpu-pod"],
+                   choices=["local", "ssh", "mpi", "sge", "slurm", "tpu-pod",
+                            "kubernetes", "yarn", "mesos"],
                    help="cluster backend (env default DMLC_SUBMIT_CLUSTER)")
     p.add_argument("--num-workers", required=True, type=int,
                    help="number of worker processes")
@@ -41,6 +42,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="retry attempts per worker (local backend)")
     p.add_argument("--slurm-worker-nodes", default=None, type=int)
     p.add_argument("--slurm-server-nodes", default=None, type=int)
+    p.add_argument("--worker-memory-mb", default=1024, type=int,
+                   help="memory request per worker (yarn/mesos/kubernetes)")
+    p.add_argument("--worker-cores", default=1, type=int,
+                   help="cpu request per worker (yarn/mesos/kubernetes)")
+    p.add_argument("--server-memory-mb", default=1024, type=int,
+                   help="memory request per server (yarn/mesos/kubernetes)")
+    p.add_argument("--server-cores", default=1, type=int,
+                   help="cpu request per server (yarn/mesos/kubernetes)")
+    p.add_argument("--kube-namespace", default="default", type=str,
+                   help="kubernetes namespace for the job resources")
+    p.add_argument("--kube-worker-image", default="dmlc/base", type=str,
+                   help="container image for kubernetes workers")
+    p.add_argument("--kube-server-image", default="dmlc/base", type=str,
+                   help="container image for kubernetes servers")
+    p.add_argument("--kube-tpu-type", default=None, type=str,
+                   help="TPU accelerator selector (e.g. tpu-v5-lite-podslice);"
+                        " adds google.com/tpu resources + nodeSelector")
+    p.add_argument("--kube-tpu-topology", default=None, type=str,
+                   help="TPU slice topology (e.g. 2x4) for the nodeSelector")
+    p.add_argument("--kube-tpu-chips", default=None, type=int,
+                   help="google.com/tpu chips per pod (defaults to the chip "
+                        "count implied by --kube-tpu-topology, e.g. 2x4 -> 8)")
+    p.add_argument("--kube-dry-run", action="store_true",
+                   help="print the generated manifests instead of kubectl "
+                        "apply")
+    p.add_argument("--mesos-master", default=None, type=str,
+                   help="mesos master address host:port")
     p.add_argument("--coordinator-port", default=8476, type=int,
                    help="JAX coordination service port (tpu-pod)")
     p.add_argument("command", nargs=argparse.REMAINDER,

@@ -205,3 +205,77 @@ def test_max_index_tracked(tmp_path):
     with NativeParser(str(p)) as parser:
         blocks = list(parser)
     assert max(b.max_index for b in blocks) == 99
+
+
+def test_csv_dtype_int32(tmp_path):
+    """Typed csv values (reference csv_parser.h DType int32): exact integer
+    round-trip with no float32 mantissa loss."""
+    import numpy as np
+    p = tmp_path / "i.csv"
+    p.write_text("2147483647,-5\n16777217,9\n")
+    with NativeParser(str(p) + "?dtype=int32", fmt="csv") as parser:
+        # blocks are zero-copy views valid only until the next next_block():
+        # copy each before advancing
+        v = np.concatenate([b.value.copy() for b in parser])
+    assert v.dtype == np.int32
+    # 16777217 = 2^24+1 is NOT representable in float32 — exactness proof
+    assert v.tolist() == [2147483647, -5, 16777217, 9]
+
+
+def test_csv_dtype_int64(tmp_path):
+    import numpy as np
+    p = tmp_path / "l.csv"
+    p.write_text("9007199254740993,1\n")  # 2^53+1: not exact in float64
+    with NativeParser(str(p) + "?dtype=int64", fmt="csv") as parser:
+        v = np.concatenate([b.value.copy() for b in parser])
+    assert v.dtype == np.int64
+    assert v.tolist() == [9007199254740993, 1]
+
+
+def test_csv_dtype_int_missing_values(tmp_path):
+    p = tmp_path / "m.csv"
+    p.write_text("1,,3\n")
+    with NativeParser(str(p) + "?dtype=int32", fmt="csv") as parser:
+        b = next(iter(parser))
+        assert b.index.tolist() == [0, 2]
+        assert b.value.tolist() == [1, 3]
+
+
+def test_csv_dtype_bad_rejected(tmp_path):
+    p = tmp_path / "b.csv"
+    p.write_text("1,2\n")
+    with pytest.raises(Exception, match="dtype"):
+        with NativeParser(str(p) + "?dtype=float16", fmt="csv") as parser:
+            list(parser)
+
+
+def test_csv_dtype_int_cache_roundtrip(tmp_path):
+    """Typed values survive the disk row-block cache (wire format v2) and a
+    float32 cache is not replayed for an int32 request (dtype fingerprint)."""
+    import numpy as np
+    p = tmp_path / "c.csv"
+    p.write_text("100,200\n300,400\n")
+    cache = tmp_path / "c.cache"
+    uri = f"{p}?dtype=int32#{cache}"
+    for epoch in range(2):  # epoch 0 builds the cache, epoch 1 replays it
+        with NativeParser(uri, fmt="csv") as parser:
+            v = np.concatenate([b.value.copy() for b in parser])
+        assert v.dtype == np.int32 and v.tolist() == [100, 200, 300, 400]
+    # same path, different dtype -> fingerprint mismatch -> reparse not replay
+    with NativeParser(f"{p}?dtype=int64#{cache}", fmt="csv") as parser:
+        v = np.concatenate([b.value.copy() for b in parser])
+    assert v.dtype == np.int64 and v.tolist() == [100, 200, 300, 400]
+
+
+def test_threaded_parser_exception_propagates(tmp_path):
+    """Producer-side parse errors surface at the Python consumer (reference
+    unittest_threaditer_exc_handling.cc: ThreadedIter rethrows the captured
+    producer exception at Next())."""
+    p = tmp_path / "ragged.libsvm"
+    # mixing explicit idx:val and bare idx makes the value array ragged,
+    # which ValidateBlock rejects on the parse worker thread
+    p.write_text("1 0:1.5 2\n" * 50)
+    with pytest.raises(Exception, match="inconsistent"):
+        with NativeParser(str(p), fmt="libsvm") as parser:
+            for _ in parser:
+                pass

@@ -204,7 +204,7 @@ def test_cross_language_rowblock_cache(tmp_path):
     with open(str(cache) + ".rowblock", "rb") as f:
         r = BinaryReader(f)
         magic = r.read_scalar("uint64")  # cache header: magic + fingerprint
-        assert magic == 0x44435452424C4B
+        assert magic == 0x44435452424C32  # "DCTRBL2" (v2: typed csv values)
         r.read_scalar("uint64")
         offset = r.read_array("uint64")
         label = r.read_array("float32")
@@ -213,8 +213,13 @@ def test_cross_language_rowblock_cache(tmp_path):
         field = r.read_array("uint32")
         index = r.read_array("uint32")
         value = r.read_array("float32")
+        value_i32 = r.read_array("int32")
+        value_i64 = r.read_array("int64")
+        value_dtype = r.read_scalar("int32")
         max_index = r.read_scalar("uint64")
         max_field = r.read_scalar("uint32")
+        assert len(value_i32) == 0 and len(value_i64) == 0
+        assert value_dtype == 0
     assert offset.tolist() == [0, 2, 4]
     assert label.tolist() == [1.0, 0.0]
     assert index.tolist() == [0, 2, 1, 3]

@@ -285,3 +285,101 @@ def test_recover_relinks_restarted_worker():
     for r in (0, 1):
         RendezvousClient("127.0.0.1", tracker.port).shutdown(r)
     tracker.join(timeout=20)
+
+
+# -- kubernetes / yarn / mesos builders -------------------------------------
+def test_kube_manifest():
+    from dmlc_core_tpu.tracker.launchers import build_kube_manifest
+    args = get_opts(["--cluster=kubernetes", "--num-workers=4",
+                     "--jobname=myjob", "--worker-memory-mb=2048",
+                     "--worker-cores=2", "--kube-worker-image=img:1",
+                     "--", "python", "train.py"])
+    m = build_kube_manifest(args, "worker", 4, {"DMLC_TRACKER_URI": "1.2.3.4",
+                                                "DMLC_TRACKER_PORT": 9091})
+    assert m["kind"] == "Job"
+    assert m["metadata"]["name"] == "myjob-worker"
+    assert m["spec"]["completions"] == 4
+    assert m["spec"]["parallelism"] == 4
+    assert m["spec"]["completionMode"] == "Indexed"
+    c = m["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "img:1"
+    assert c["command"] == ["python", "train.py"]
+    assert c["resources"]["requests"] == {"memory": "2048Mi", "cpu": "2"}
+    env = {e["name"]: e for e in c["env"]}
+    assert env["DMLC_TRACKER_URI"]["value"] == "1.2.3.4"
+    assert env["DMLC_ROLE"]["value"] == "worker"
+    assert "job-completion-index" in str(env["DMLC_TASK_ID"])
+
+
+def test_kube_manifest_tpu_selector():
+    from dmlc_core_tpu.tracker.launchers import build_kube_manifest
+    args = get_opts(["--cluster=kubernetes", "--num-workers=2",
+                     "--jobname=tj", "--worker-cores=4",
+                     "--kube-tpu-type=tpu-v5-lite-podslice",
+                     "--kube-tpu-topology=2x4", "--", "./t"])
+    m = build_kube_manifest(args, "worker", 2, {})
+    spec = m["spec"]["template"]["spec"]
+    assert spec["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4"}
+    res = spec["containers"][0]["resources"]
+    # chip count derives from topology (2x4 -> 8), NOT from --worker-cores
+    assert res["limits"]["google.com/tpu"] == "8"
+    assert res["requests"]["cpu"] == "4"
+
+    args2 = get_opts(["--cluster=kubernetes", "--num-workers=2",
+                      "--jobname=tj", "--kube-tpu-type=x", "--kube-tpu-chips=4",
+                      "--", "./t"])
+    m2 = build_kube_manifest(args2, "worker", 2, {})
+    res2 = m2["spec"]["template"]["spec"]["containers"][0]["resources"]
+    assert res2["limits"]["google.com/tpu"] == "4"
+
+
+def test_kube_dry_run_submit(capsys):
+    # dry-run renders manifests with placeholder rendezvous env and starts
+    # no tracker (returns immediately, no listening socket left behind)
+    from dmlc_core_tpu.tracker.launchers import submit_kubernetes
+    args = get_opts(["--cluster=kubernetes", "--num-workers=1",
+                     "--jobname=dr", "--kube-dry-run", "--host-ip=127.0.0.1",
+                     "--", "echo", "hi"])
+    submit_kubernetes(args)
+    out = capsys.readouterr().out
+    assert '"kind": "List"' in out
+    assert '"dr-worker"' in out
+    assert "127.0.0.1" in out
+
+
+def test_yarn_command():
+    from dmlc_core_tpu.tracker.launchers import build_yarn_command
+    args = get_opts(["--cluster=yarn", "--num-workers=3", "--jobname=yj",
+                     "--worker-memory-mb=512", "--worker-cores=2",
+                     "--", "./t"])
+    cmd = build_yarn_command(args, "worker", 3, {"DMLC_TRACKER_PORT": 9091})
+    assert cmd[:2] == ["yarn", "jar"]
+    assert "-num_containers" in cmd and cmd[cmd.index("-num_containers") + 1] == "3"
+    assert "DMLC_TRACKER_PORT=9091" in cmd
+    assert "DMLC_JOB_CLUSTER=yarn" in cmd
+    assert "DMLC_ROLE=worker" in cmd  # per-role submission, like mpi/slurm
+    assert cmd[cmd.index("-container_memory") + 1] == "512"
+    assert cmd[-1] == "./t"
+
+
+def test_mesos_command():
+    from dmlc_core_tpu.tracker.launchers import build_mesos_command
+    args = get_opts(["--cluster=mesos", "--num-workers=2",
+                     "--mesos-master=m:5050", "--worker-memory-mb=256",
+                     "--", "./t"])
+    cmd = build_mesos_command(args, "worker", 2, {"A": 1})
+    assert cmd[0] == "mesos-execute"
+    assert "--master=m:5050" in cmd
+    assert "--instances=2" in cmd
+    assert "--resources=cpus:1;mem:256" in cmd
+    assert cmd[-1].endswith("./t")
+
+
+def test_mesos_requires_master(monkeypatch):
+    from dmlc_core_tpu.tracker.launchers import build_mesos_command
+    monkeypatch.delenv("MESOS_MASTER", raising=False)
+    args = get_opts(["--cluster=mesos", "--num-workers=1", "--", "./t"])
+    with pytest.raises(SystemExit):
+        build_mesos_command(args, "worker", 1, {})
