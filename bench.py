@@ -57,7 +57,7 @@ def main() -> None:
     ap.add_argument("--rows", type=int, default=0)
     ap.add_argument("--parse-only", action="store_true",
                     help="skip device placement (host parse throughput)")
-    ap.add_argument("--batch-rows", type=int, default=32768)
+    ap.add_argument("--batch-rows", type=int, default=65536)
     args = ap.parse_args()
 
     rows = args.rows or (20000 if args.smoke else 200000)

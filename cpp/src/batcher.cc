@@ -1,0 +1,192 @@
+#include "batcher.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "base.h"
+
+namespace dct {
+
+PaddedBatcher::PaddedBatcher(Parser<uint32_t>* parser, uint64_t batch_rows,
+                             uint32_t num_shards, uint64_t min_nnz_bucket)
+    : parser_(parser),
+      batch_rows_(batch_rows),
+      num_shards_(num_shards),
+      min_bucket_(std::max<uint64_t>(min_nnz_bucket, 1)) {
+  DCT_CHECK(num_shards_ > 0) << "num_shards must be positive";
+  DCT_CHECK(batch_rows_ > 0 && batch_rows_ % num_shards_ == 0)
+      << "batch_rows=" << batch_rows_ << " must divide by shards="
+      << num_shards_;
+}
+
+void PaddedBatcher::Accumulate() {
+  while (AvailRows() < batch_rows_ && !done_) {
+    const RowBlockContainer<uint32_t>* b = parser_->NextBlock();
+    if (b == nullptr) {
+      done_ = true;
+      break;
+    }
+    const size_t n = b->Size();
+    const size_t nnz = b->offset.back();
+    label_.insert(label_.end(), b->label.begin(), b->label.end());
+    if (b->weight.empty()) {
+      weight_.insert(weight_.end(), n, 1.0f);
+    } else {
+      weight_.insert(weight_.end(), b->weight.begin(), b->weight.end());
+    }
+    lens_.reserve(lens_.size() + n);
+    for (size_t i = 0; i < n; ++i) {
+      lens_.push_back(static_cast<int32_t>(b->offset[i + 1] - b->offset[i]));
+    }
+    col_.reserve(col_.size() + nnz);
+    for (size_t i = 0; i < nnz; ++i) {
+      col_.push_back(static_cast<int32_t>(b->index[i]));
+    }
+    val_.reserve(val_.size() + nnz);
+    if (b->value_dtype == 1) {
+      for (int32_t v : b->value_i32) val_.push_back(static_cast<float>(v));
+    } else if (b->value_dtype == 2) {
+      for (int64_t v : b->value_i64) val_.push_back(static_cast<float>(v));
+    } else if (b->value.empty()) {
+      val_.insert(val_.end(), nnz, 1.0f);  // implicit 1.0 (binary features)
+    } else {
+      val_.insert(val_.end(), b->value.begin(), b->value.end());
+    }
+    max_index_ = std::max(max_index_, b->max_index);
+  }
+}
+
+bool PaddedBatcher::NextMeta(uint64_t* take, uint64_t* bucket,
+                             uint64_t* max_index) {
+  DCT_CHECK(!staged_) << "NextMeta called with an unconsumed staged batch";
+  Accumulate();
+  const uint64_t avail = AvailRows();
+  if (avail == 0) return false;
+  take_ = std::min<uint64_t>(batch_rows_, avail);
+
+  // per-shard nnz -> bucket = next pow2 of the max, floored at min_bucket_
+  const uint64_t R = batch_rows_ / num_shards_;
+  uint64_t max_shard = 0;
+  for (uint32_t d = 0; d < num_shards_; ++d) {
+    uint64_t shard_nnz = 0;
+    const uint64_t lo = d * R;
+    const uint64_t hi = std::min<uint64_t>((d + 1) * R, take_);
+    for (uint64_t r = lo; r < hi; ++r) {
+      shard_nnz += static_cast<uint64_t>(lens_[row_pos_ + r]);
+    }
+    max_shard = std::max(max_shard, shard_nnz);
+  }
+  uint64_t b = min_bucket_;
+  while (b < max_shard) b <<= 1;
+
+  bucket_ = b;
+  staged_ = true;
+  *take = take_;
+  *bucket = bucket_;
+  *max_index = max_index_;
+  return true;
+}
+
+void PaddedBatcher::FillRowArrays(float* label, float* weight,
+                                  int32_t* nrows) {
+  std::memcpy(label, label_.data() + row_pos_, take_ * sizeof(float));
+  std::memcpy(weight, weight_.data() + row_pos_, take_ * sizeof(float));
+  if (take_ < batch_rows_) {  // weight 0 ⇒ padding rows drop out of the loss
+    std::memset(label + take_, 0, (batch_rows_ - take_) * sizeof(float));
+    std::memset(weight + take_, 0, (batch_rows_ - take_) * sizeof(float));
+  }
+  const uint64_t R = batch_rows_ / num_shards_;
+  for (uint32_t d = 0; d < num_shards_; ++d) {
+    const int64_t left = static_cast<int64_t>(take_) - d * R;
+    nrows[d] = static_cast<int32_t>(
+        std::max<int64_t>(0, std::min<int64_t>(left, R)));
+  }
+}
+
+void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
+                            float* label, float* weight, int32_t* nrows) {
+  DCT_CHECK(staged_) << "FillCSR without a staged batch (call NextMeta)";
+  const uint64_t R = batch_rows_ / num_shards_;
+  size_t p = nnz_pos_;
+  for (uint32_t d = 0; d < num_shards_; ++d) {
+    int32_t* rowd = row + d * bucket_;
+    int32_t* cold = col + d * bucket_;
+    float* vald = val + d * bucket_;
+    uint64_t written = 0;
+    const uint64_t lo = d * R;
+    const uint64_t hi = std::min<uint64_t>((d + 1) * R, take_);
+    for (uint64_t r = lo; r < hi; ++r) {
+      const uint64_t l = static_cast<uint64_t>(lens_[row_pos_ + r]);
+      const int32_t local = static_cast<int32_t>(r - lo);
+      for (uint64_t k = 0; k < l; ++k) rowd[written + k] = local;
+      std::memcpy(cold + written, col_.data() + p, l * sizeof(int32_t));
+      std::memcpy(vald + written, val_.data() + p, l * sizeof(float));
+      p += l;
+      written += l;
+    }
+    // padding nonzeros land in the sacrificial segment id R, sliced off by
+    // the segment ops (dmlc_core_tpu/ops/sparse.py)
+    for (uint64_t k = written; k < bucket_; ++k) rowd[k] = R;
+    std::memset(cold + written, 0, (bucket_ - written) * sizeof(int32_t));
+    std::memset(vald + written, 0, (bucket_ - written) * sizeof(float));
+  }
+  FillRowArrays(label, weight, nrows);
+  Consume();
+}
+
+void PaddedBatcher::FillDense(float* x, uint64_t num_features, float* label,
+                              float* weight, int32_t* nrows) {
+  DCT_CHECK(staged_) << "FillDense without a staged batch (call NextMeta)";
+  std::memset(x, 0, batch_rows_ * num_features * sizeof(float));
+  size_t p = nnz_pos_;
+  for (uint64_t r = 0; r < take_; ++r) {
+    float* xr = x + r * num_features;
+    const uint64_t l = static_cast<uint64_t>(lens_[row_pos_ + r]);
+    for (uint64_t k = 0; k < l; ++k) {
+      const int32_t c = col_[p + k];
+      DCT_CHECK(static_cast<uint64_t>(c) < num_features)
+          << "dense layout fixed at " << num_features
+          << " features but saw index " << c
+          << "; pass layout='csr' or a larger dense_max_features";
+      xr[c] = val_[p + k];
+    }
+    p += l;
+  }
+  FillRowArrays(label, weight, nrows);
+  Consume();
+}
+
+void PaddedBatcher::Consume() {
+  for (uint64_t r = 0; r < take_; ++r) {
+    nnz_pos_ += static_cast<size_t>(lens_[row_pos_ + r]);
+  }
+  row_pos_ += take_;
+  staged_ = false;
+  // compact once the dead prefix outweighs the live tail
+  if (row_pos_ > lens_.size() - row_pos_) {
+    label_.erase(label_.begin(), label_.begin() + row_pos_);
+    weight_.erase(weight_.begin(), weight_.begin() + row_pos_);
+    lens_.erase(lens_.begin(), lens_.begin() + row_pos_);
+    col_.erase(col_.begin(), col_.begin() + nnz_pos_);
+    val_.erase(val_.begin(), val_.begin() + nnz_pos_);
+    row_pos_ = 0;
+    nnz_pos_ = 0;
+  }
+}
+
+void PaddedBatcher::BeforeFirst() {
+  parser_->BeforeFirst();
+  label_.clear();
+  weight_.clear();
+  val_.clear();
+  lens_.clear();
+  col_.clear();
+  row_pos_ = 0;
+  nnz_pos_ = 0;
+  done_ = false;
+  staged_ = false;
+  // max_index_ deliberately survives reset: the dense/csr layout choice must
+  // stay sticky across epochs so device shapes remain static
+}
+
+}  // namespace dct

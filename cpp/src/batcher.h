@@ -1,0 +1,81 @@
+// Native padded-batch assembly for the TPU device bridge.
+//
+// The reference pipeline ends at host CSR views (RowBlockIter, reference
+// include/dmlc/data.h:267); the TPU-native pipeline must emit *static-shape*
+// batches (fixed rows per batch, power-of-two nnz buckets) so XLA compiles a
+// bounded set of programs (SURVEY §7 hard part 1, "ragged → device").
+//
+// This module does that reshaping in C++ on the parser side of the ctypes
+// boundary: Python asks for the next batch's metadata (row count, nnz
+// bucket), allocates numpy arrays of exactly that shape, and the Fill* call
+// writes them in one pass — no per-block numpy concatenation, padding, or
+// fancy indexing on the (GIL-holding) Python thread.
+//
+// Layouts match dmlc_core_tpu/tpu/device_iter.py:
+//   CSR:   row/col/val [D, bucket]; per-nonzero local row segment ids with a
+//          sacrificial padding segment id == R; label/weight [D*R] with
+//          weight 0 marking padding rows; nrows [D].
+//   Dense: x [D*R, F] zero-filled then scattered (the MXU on-ramp for
+//          low-dimensional data, e.g. HIGGS's 28 columns).
+#ifndef DCT_BATCHER_H_
+#define DCT_BATCHER_H_
+
+#include <cstdint>
+#include <memory>
+#include <vector>
+
+#include "parser.h"
+
+namespace dct {
+
+class PaddedBatcher {
+ public:
+  // Takes ownership of parser. batch_rows must divide by num_shards.
+  PaddedBatcher(Parser<uint32_t>* parser, uint64_t batch_rows,
+                uint32_t num_shards, uint64_t min_nnz_bucket);
+
+  // Stage the next batch. Returns false at end of data. On success:
+  //   *take      true (unpadded) row count, <= batch_rows
+  //   *bucket    per-shard nnz capacity (next pow2 of max shard nnz)
+  //   *max_index running max feature id (drives the dense/csr auto choice)
+  bool NextMeta(uint64_t* take, uint64_t* bucket, uint64_t* max_index);
+
+  // Consume the staged batch into caller buffers (shapes per header comment).
+  void FillCSR(int32_t* row, int32_t* col, float* val, float* label,
+               float* weight, int32_t* nrows);
+  // x is [batch_rows, num_features], zeroed here before scatter.
+  void FillDense(float* x, uint64_t num_features, float* label, float* weight,
+                 int32_t* nrows);
+
+  void BeforeFirst();
+  size_t BytesRead() const { return parser_->BytesRead(); }
+
+ private:
+  void Accumulate();           // pull parser blocks until a batch is pending
+  void FillRowArrays(float* label, float* weight, int32_t* nrows);
+  void Consume();              // advance past the staged batch + compact
+  uint64_t AvailRows() const { return lens_.size() - row_pos_; }
+
+  std::unique_ptr<Parser<uint32_t>> parser_;
+  const uint64_t batch_rows_;
+  const uint32_t num_shards_;
+  const uint64_t min_bucket_;
+
+  // pending rows in arrival order; a consumed prefix [0, row_pos_) /
+  // [0, nnz_pos_) is compacted away once it outgrows the live tail
+  std::vector<float> label_, weight_, val_;
+  std::vector<int32_t> lens_, col_;
+  size_t row_pos_ = 0;
+  size_t nnz_pos_ = 0;
+  bool done_ = false;
+  uint64_t max_index_ = 0;
+
+  // staged by NextMeta for the following Fill* call
+  uint64_t take_ = 0;
+  uint64_t bucket_ = 0;
+  bool staged_ = false;
+};
+
+}  // namespace dct
+
+#endif  // DCT_BATCHER_H_

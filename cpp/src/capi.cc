@@ -11,6 +11,7 @@
 #include <cstring>
 #include <string>
 
+#include "batcher.h"
 #include "filesys.h"
 #include "input_split.h"
 #include "parser.h"
@@ -350,6 +351,63 @@ int dct_parser_bytes_read(dct_parser_t h, size_t* out) {
 
 int dct_parser_free(dct_parser_t h) {
   return Guard([&] { delete static_cast<ParserHandle*>(h); });
+}
+
+// ---------------------------------------------------------------- batcher --
+// Native static-shape batch assembly (batcher.h): Python asks for the next
+// batch's shape via next_meta, allocates numpy arrays, and fill_* writes
+// them in one GIL-free pass.
+typedef void* dct_batcher_t;
+
+int dct_batcher_create(const char* uri, unsigned part, unsigned npart,
+                       const char* format, int nthread, int threaded,
+                       uint64_t batch_rows, uint32_t num_shards,
+                       uint64_t min_nnz_bucket, dct_batcher_t* out) {
+  return Guard([&] {
+    auto* p = dct::Parser<uint32_t>::Create(uri, part, npart, format, nthread,
+                                            threaded != 0);
+    *out = new dct::PaddedBatcher(p, batch_rows, num_shards, min_nnz_bucket);
+  });
+}
+
+int dct_batcher_next_meta(dct_batcher_t h, uint64_t* take, uint64_t* bucket,
+                          uint64_t* max_index, int* has) {
+  return Guard([&] {
+    *has = static_cast<dct::PaddedBatcher*>(h)->NextMeta(take, bucket,
+                                                         max_index)
+               ? 1
+               : 0;
+  });
+}
+
+int dct_batcher_fill_csr(dct_batcher_t h, int32_t* row, int32_t* col,
+                         float* val, float* label, float* weight,
+                         int32_t* nrows) {
+  return Guard([&] {
+    static_cast<dct::PaddedBatcher*>(h)->FillCSR(row, col, val, label, weight,
+                                                 nrows);
+  });
+}
+
+int dct_batcher_fill_dense(dct_batcher_t h, float* x, uint64_t num_features,
+                           float* label, float* weight, int32_t* nrows) {
+  return Guard([&] {
+    static_cast<dct::PaddedBatcher*>(h)->FillDense(x, num_features, label,
+                                                   weight, nrows);
+  });
+}
+
+int dct_batcher_before_first(dct_batcher_t h) {
+  return Guard([&] { static_cast<dct::PaddedBatcher*>(h)->BeforeFirst(); });
+}
+
+int dct_batcher_bytes_read(dct_batcher_t h, size_t* out) {
+  return Guard(
+      [&] { *out = static_cast<dct::PaddedBatcher*>(h)->BytesRead(); });
+}
+
+int dct_batcher_free(dct_batcher_t h) {
+  return Guard([&] { delete static_cast<dct::PaddedBatcher*>(h); });
 }
 
 }  // extern "C"

@@ -20,11 +20,99 @@ namespace dct {
 inline bool IsBlankChar(char c) { return c == ' ' || c == '\t'; }
 inline bool IsDigitChar(char c) { return c >= '0' && c <= '9'; }
 
+namespace detail {
+
+// 10^0 .. 10^22 are exactly representable as doubles.
+inline constexpr double kPow10[] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10, 1e11,
+    1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// Fast decimal float scan for the dominant ML-data shape ("-3.141593",
+// "1e-4"): when the mantissa fits 15 significant digits (< 2^53) and the
+// scale is within 10^±22, mant * 10^e is a single correctly-rounded double
+// operation (float targets take one extra narrowing round). Returns
+// false (without consuming) for anything outside that envelope (long
+// mantissas, inf/nan, hex, trailing-dot corner cases) so the caller can
+// delegate to std::from_chars.
+template <typename T>
+inline bool ParseFloatFast(const char* p, const char* end, const char** out,
+                           T* v) {
+  const char* q = p;
+  bool neg = false;
+  if (q != end && (*q == '-' || *q == '+')) {
+    neg = *q == '-';
+    ++q;
+  }
+  uint64_t mant = 0;
+  int digits = 0;   // significant digits accumulated into mant
+  int exp10 = 0;
+  bool any = false;
+  while (q != end && IsDigitChar(*q)) {
+    any = true;
+    if (digits < 15) {
+      mant = mant * 10 + static_cast<uint64_t>(*q - '0');
+      if (mant != 0) ++digits;
+    } else {
+      ++exp10;  // extra integer digits shift the scale
+    }
+    ++q;
+  }
+  if (q != end && *q == '.') {
+    const char* dot = q;
+    ++q;
+    if (q == end || !IsDigitChar(*q)) {
+      // "5." / "." — consumption semantics differ across implementations;
+      // let from_chars decide
+      (void)dot;
+      return false;
+    }
+    while (q != end && IsDigitChar(*q)) {
+      any = true;
+      if (digits < 15) {
+        mant = mant * 10 + static_cast<uint64_t>(*q - '0');
+        if (mant != 0) ++digits;
+        --exp10;
+      }
+      ++q;
+    }
+  }
+  if (!any) return false;
+  if (digits >= 15) return false;  // mantissa may not be exact: delegate
+  if (q != end && (*q == 'e' || *q == 'E')) {
+    const char* e = q + 1;
+    bool eneg = false;
+    if (e != end && (*e == '-' || *e == '+')) {
+      eneg = *e == '-';
+      ++e;
+    }
+    if (e == end || !IsDigitChar(*e)) return false;
+    int ev = 0;
+    while (e != end && IsDigitChar(*e)) {
+      ev = ev * 10 + (*e - '0');
+      if (ev > 400) return false;  // out of double range: delegate
+      ++e;
+    }
+    exp10 += eneg ? -ev : ev;
+    q = e;
+  }
+  if (exp10 < -22 || exp10 > 22) return false;
+  double d = static_cast<double>(mant);
+  d = exp10 < 0 ? d / kPow10[-exp10] : d * kPow10[exp10];
+  *v = static_cast<T>(neg ? -d : d);
+  *out = q;
+  return true;
+}
+
+}  // namespace detail
+
 // Parse one value of T from [p, end); advance *out past it.
 // Returns false (leaving *out == p) when no number starts at p.
 // Accepts an optional leading '+' (from_chars itself does not).
 template <typename T>
 inline bool ParseNum(const char* p, const char* end, const char** out, T* v) {
+  if constexpr (std::is_floating_point_v<T>) {
+    if (detail::ParseFloatFast(p, end, out, v)) return true;
+  }
   const char* q = p;
   if (q != end && *q == '+') ++q;
   std::from_chars_result r;

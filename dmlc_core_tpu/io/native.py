@@ -114,6 +114,17 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_parser_before_first": [vp],
         "dct_parser_bytes_read": [vp, c.POINTER(sz)],
         "dct_parser_free": [vp],
+        "dct_batcher_create": [c.c_char_p, u, u, c.c_char_p, i, i,
+                               c.c_uint64, c.c_uint32, c.c_uint64,
+                               c.POINTER(vp)],
+        "dct_batcher_next_meta": [vp, c.POINTER(c.c_uint64),
+                                  c.POINTER(c.c_uint64),
+                                  c.POINTER(c.c_uint64), c.POINTER(i)],
+        "dct_batcher_fill_csr": [vp, vp, vp, vp, vp, vp, vp],
+        "dct_batcher_fill_dense": [vp, vp, c.c_uint64, vp, vp, vp],
+        "dct_batcher_before_first": [vp],
+        "dct_batcher_bytes_read": [vp, c.POINTER(sz)],
+        "dct_batcher_free": [vp],
     }
     for name, argtypes in sigs.items():
         fn = getattr(cdll, name)
@@ -447,6 +458,90 @@ class NativeParser:
     def close(self) -> None:
         if self._h:
             _check(lib().dct_parser_free(self._h))
+            self._h = ctypes.c_void_p()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# -- batcher ----------------------------------------------------------------
+class NativeBatcher:
+    """Static-shape padded-batch assembly in C++ (cpp/src/batcher.h).
+
+    Two-phase protocol: next_meta() stages a batch and returns its shape
+    (take, nnz bucket, running max feature index); the caller allocates numpy
+    arrays of exactly that shape and fill_csr()/fill_dense() writes them in
+    one native pass — ctypes drops the GIL, so a staging thread's fill
+    overlaps consumer-side work even though no numpy ops run here."""
+
+    def __init__(self, uri: str, part: int = 0, npart: int = 1,
+                 fmt: str = "auto", nthread: int = 0, threaded: bool = True,
+                 batch_rows: int = 65536, num_shards: int = 1,
+                 min_nnz_bucket: int = 4096):
+        self._h = ctypes.c_void_p()
+        _check(lib().dct_batcher_create(
+            uri.encode(), part, npart, fmt.encode(), nthread,
+            1 if threaded else 0, batch_rows, num_shards, min_nnz_bucket,
+            ctypes.byref(self._h)))
+
+    def next_meta(self):
+        """(take, bucket, max_index) for the staged batch, or None at end."""
+        take = ctypes.c_uint64()
+        bucket = ctypes.c_uint64()
+        max_index = ctypes.c_uint64()
+        has = ctypes.c_int()
+        _check(lib().dct_batcher_next_meta(
+            self._h, ctypes.byref(take), ctypes.byref(bucket),
+            ctypes.byref(max_index), ctypes.byref(has)))
+        if not has.value:
+            return None
+        return take.value, bucket.value, max_index.value
+
+    @staticmethod
+    def _ptr(arr: np.ndarray, dtype) -> ctypes.c_void_p:
+        # hard check (not assert): the native side bulk-writes through this
+        # pointer, so a wrong dtype/layout would corrupt memory under -O
+        if arr.dtype != dtype or not arr.flags["C_CONTIGUOUS"]:
+            raise DMLCError(
+                f"fill buffer must be C-contiguous {np.dtype(dtype).name}, "
+                f"got {arr.dtype.name} contiguous={arr.flags['C_CONTIGUOUS']}")
+        return ctypes.c_void_p(arr.ctypes.data)
+
+    def fill_csr(self, row: np.ndarray, col: np.ndarray, val: np.ndarray,
+                 label: np.ndarray, weight: np.ndarray,
+                 nrows: np.ndarray) -> None:
+        _check(lib().dct_batcher_fill_csr(
+            self._h, self._ptr(row, np.int32), self._ptr(col, np.int32),
+            self._ptr(val, np.float32), self._ptr(label, np.float32),
+            self._ptr(weight, np.float32), self._ptr(nrows, np.int32)))
+
+    def fill_dense(self, x: np.ndarray, label: np.ndarray,
+                   weight: np.ndarray, nrows: np.ndarray) -> None:
+        _check(lib().dct_batcher_fill_dense(
+            self._h, self._ptr(x, np.float32), x.shape[-1],
+            self._ptr(label, np.float32), self._ptr(weight, np.float32),
+            self._ptr(nrows, np.int32)))
+
+    def before_first(self) -> None:
+        _check(lib().dct_batcher_before_first(self._h))
+
+    def bytes_read(self) -> int:
+        out = ctypes.c_size_t()
+        _check(lib().dct_batcher_bytes_read(self._h, ctypes.byref(out)))
+        return out.value
+
+    def close(self) -> None:
+        if self._h:
+            _check(lib().dct_batcher_free(self._h))
             self._h = ctypes.c_void_p()
 
     def __enter__(self):

@@ -540,8 +540,7 @@ def build_mesos_command(args, role: str, n: int,
 
 def submit_mesos(args) -> None:
     def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
-        for role, n in (("server", args.num_servers),
-                        ("worker", args.num_workers)):
+        for role, n in (("server", nserver), ("worker", nworker)):
             if n == 0:
                 continue
             cmd = build_mesos_command(args, role, n, envs)

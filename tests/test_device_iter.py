@@ -250,3 +250,79 @@ def test_dense_feature_overflow_raises(tmp_path):
     hb.next_batch()
     with pytest.raises(DMLCError, match="dense layout fixed"):
         hb.next_batch()
+
+
+# -- native batcher (cpp/src/batcher.cc) -------------------------------------
+def _drain(batcher):
+    out = []
+    while True:
+        b = batcher.next_batch()
+        if b is None:
+            return out
+        out.append(b)
+
+
+def test_native_batcher_matches_python_csr(tmp_path):
+    """The C++ PaddedBatcher and the numpy HostBatcher must emit identical
+    batches (same shapes, same contents) for the same input and params."""
+    from dmlc_core_tpu.tpu.device_iter import NativeHostBatcher
+    p = write_libsvm(tmp_path / "eq.libsvm", rows=777, features=8)
+    py = HostBatcher(NativeParser(str(p)), batch_rows=256, num_shards=4,
+                     min_nnz_bucket=64, layout="csr")
+    nat = NativeHostBatcher(str(p), batch_rows=256, num_shards=4,
+                            min_nnz_bucket=64, layout="csr")
+    pb, nb = _drain(py), _drain(nat)
+    assert len(pb) == len(nb) == 4
+    for a, b in zip(pb, nb):
+        assert a.total_rows == b.total_rows
+        for k in ("row", "col", "val", "label", "weight", "nrows"):
+            va, vb = getattr(a, k), getattr(b, k)
+            assert va.shape == vb.shape, k
+            np.testing.assert_array_equal(va, vb, err_msg=k)
+
+
+def test_native_batcher_matches_python_dense(tmp_path):
+    from dmlc_core_tpu.tpu.device_iter import NativeHostBatcher
+    p = write_libsvm(tmp_path / "eqd.libsvm", rows=300, features=6)
+    py = HostBatcher(NativeParser(str(p)), batch_rows=128, num_shards=2,
+                     layout="auto", dense_max_features=512)
+    nat = NativeHostBatcher(str(p), batch_rows=128, num_shards=2,
+                            layout="auto", dense_max_features=512)
+    pb, nb = _drain(py), _drain(nat)
+    assert len(pb) == len(nb) == 3
+    for a, b in zip(pb, nb):
+        assert a.x.shape == b.x.shape
+        np.testing.assert_array_equal(a.x, b.x)
+        np.testing.assert_array_equal(a.label, b.label)
+        np.testing.assert_array_equal(a.weight, b.weight)
+        np.testing.assert_array_equal(a.nrows, b.nrows)
+
+
+def test_native_batcher_reset_epoch(tmp_path):
+    from dmlc_core_tpu.tpu.device_iter import NativeHostBatcher
+    p = write_libsvm(tmp_path / "ep.libsvm", rows=100, features=4)
+    nat = NativeHostBatcher(str(p), batch_rows=64, num_shards=1,
+                            layout="csr")
+    first = _drain(nat)
+    nat.reset()
+    second = _drain(nat)
+    assert len(first) == len(second) == 2
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a.val, b.val)
+        np.testing.assert_array_equal(a.label, b.label)
+
+
+def test_native_batcher_auto_layout_sees_accumulated_max(tmp_path):
+    """The native batcher accumulates a full batch before the sticky layout
+    choice, so a large feature index anywhere in the accumulated window
+    steers 'auto' to csr (HostBatcher only saw the first batch's columns —
+    this is strictly safer)."""
+    from dmlc_core_tpu.tpu.device_iter import NativeHostBatcher
+    lines = ["1 0:1.0 3:2.0"] * 40 + ["0 900:1.5"] * 4
+    f = tmp_path / "ov.libsvm"
+    f.write_text("\n".join(lines) + "\n")
+    nat = NativeHostBatcher(str(f), batch_rows=16, num_shards=1,
+                            layout="auto", dense_max_features=512)
+    batches = _drain(nat)
+    assert nat.layout == "csr"
+    assert sum(b.total_rows for b in batches) == 44

@@ -279,3 +279,25 @@ def test_threaded_parser_exception_propagates(tmp_path):
         with NativeParser(str(p), fmt="libsvm") as parser:
             for _ in parser:
                 pass
+
+
+def test_float_fast_path_precision(tmp_path):
+    """The fast decimal scan in numparse.h must agree with Python's
+    correctly-rounded float() across notations (fixed, exponent, long
+    mantissas that fall back to from_chars)."""
+    from dmlc_core_tpu.io.native import NativeParser
+    vals = ["0.1", "-0.1", "3.141592653589793", "1e-4", "-2.5E3",
+            "6.02214076e23", "1e-30", "123456789.123456789",
+            "0.000001", "42", "-7", "+3.5", "3.4028234e38",
+            "9007199254740993.0", "1.1754944e-38"]
+    f = tmp_path / "prec.libsvm"
+    f.write_text("\n".join(
+        f"1 {i}:{v}" for i, v in enumerate(vals)) + "\n")
+    with NativeParser(str(f)) as p:
+        got = []
+        for b in p:
+            got.extend(zip(b.index.tolist(), b.value.tolist()))
+    assert len(got) == len(vals)
+    for (idx, parsed), want in zip(sorted(got), vals):
+        expect = np.float32(float(want))
+        assert parsed == expect, (want, parsed, float(expect))
