@@ -1,0 +1,412 @@
+"""Host-side data layer: the reference L5 API surface.
+
+TPU-native counterpart of reference ``include/dmlc/data.h`` (Row / RowBlock /
+RowBlockIter / Parser, data.h:74-312) and ``src/data/row_block.h``
+(RowBlockContainer). The *device* path is ``dmlc_core_tpu.tpu.
+DeviceRowBlockIter`` (batches end HBM-resident); this module is the host
+surface downstream learners use when they want CSR views on the host —
+feature engineering, sketching, or feeding a non-JAX consumer.
+
+Differences from the reference are deliberate:
+- Rows are numpy slices of struct-of-arrays storage, not AoS ``Row`` objects;
+  ``Row.sdot`` is a vectorized dot (the reference's scalar loop,
+  data.h:124-136, is hostile to everything).
+- ``RowBlockContainer.save/load`` uses the shared little-endian wire format
+  written by the C++ core (cpp/src/rowblock.h Save/Load), so caches
+  round-trip across languages.
+- Custom formats register with ``@register_parser`` (reference
+  DMLC_REGISTER_DATA_PARSER, data.h:358); the built-in libsvm/csv/libfm
+  formats dispatch to the multithreaded native parsers.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.native import NativeParser, RowBlock
+from dmlc_core_tpu.registry import Registry
+from dmlc_core_tpu.serializer import BinaryReader, BinaryWriter
+
+__all__ = ["Row", "RowBlock", "RowBlockContainer", "Parser", "RowBlockIter",
+           "register_parser", "PARSER_REGISTRY"]
+
+
+class Row:
+    """One CSR row view (reference Row, data.h:74-162)."""
+
+    __slots__ = ("label", "weight", "qid", "index", "value", "field")
+
+    def __init__(self, label, weight, qid, index, value, field):
+        self.label = label
+        self.weight = weight
+        self.qid = qid
+        self.index = index
+        self.value = value
+        self.field = field
+
+    @property
+    def length(self) -> int:
+        return len(self.index)
+
+    def get_value(self, i: int) -> float:
+        """value of the i-th nonzero (implicit 1.0 when values absent)."""
+        return 1.0 if self.value is None else float(self.value[i])
+
+    def sdot(self, weights: np.ndarray) -> float:
+        """Sparse dot with a dense weight vector (reference Row::SDot,
+        data.h:124-136) — vectorized, not the reference's scalar loop."""
+        w = weights[self.index]
+        return float(w.sum() if self.value is None
+                     else np.dot(w, self.value.astype(np.float64)))
+
+
+class RowBlockContainer:
+    """Owning, growable CSR block (reference src/data/row_block.h:26-215).
+
+    Struct-of-arrays numpy storage; the wire format of save/load matches
+    cpp/src/rowblock.h Save/Load byte for byte."""
+
+    def __init__(self, index64: bool = False):
+        self.offset = np.zeros(1, dtype=np.uint64)
+        self.label = np.empty(0, dtype=np.float32)
+        self.weight = np.empty(0, dtype=np.float32)
+        self.qid = np.empty(0, dtype=np.uint64)
+        self.field = np.empty(0, dtype=np.uint32)
+        self.index = np.empty(0, dtype=np.uint64 if index64 else np.uint32)
+        self.value = np.empty(0, dtype=np.float32)
+        self.value_i32 = np.empty(0, dtype=np.int32)
+        self.value_i64 = np.empty(0, dtype=np.int64)
+        self.value_dtype = 0  # 0=float32, 1=int32, 2=int64
+        self.max_index = 0
+        self.max_field = 0
+
+    # -- size/introspection ---------------------------------------------------
+    @property
+    def size(self) -> int:
+        return len(self.label)
+
+    @property
+    def nnz(self) -> int:
+        return len(self.index)
+
+    @property
+    def num_col(self) -> int:
+        """max feature index + 1 (reference RowBlockIter::NumCol)."""
+        return int(self.max_index) + 1 if self.nnz else 0
+
+    def mem_cost_bytes(self) -> int:
+        """reference RowBlock::MemCostBytes (data.h:198-214)."""
+        return sum(a.nbytes for a in (
+            self.offset, self.label, self.weight, self.qid, self.field,
+            self.index, self.value, self.value_i32, self.value_i64))
+
+    def _values_view(self) -> Optional[np.ndarray]:
+        if self.value_dtype == 1:
+            return self.value_i32
+        if self.value_dtype == 2:
+            return self.value_i64
+        return self.value if len(self.value) else None
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, i: int) -> Row:
+        """Row view (reference RowBlock::operator[], data.h:364-394)."""
+        if not 0 <= i < self.size:
+            raise IndexError(i)
+        lo, hi = int(self.offset[i]), int(self.offset[i + 1])
+        vals = self._values_view()
+        return Row(
+            label=float(self.label[i]),
+            weight=float(self.weight[i]) if len(self.weight) else 1.0,
+            qid=int(self.qid[i]) if len(self.qid) else None,
+            index=self.index[lo:hi],
+            value=None if vals is None else vals[lo:hi],
+            field=self.field[lo:hi] if len(self.field) else None)
+
+    def __iter__(self) -> Iterator[Row]:
+        for i in range(self.size):
+            yield self[i]
+
+    def slice(self, begin: int, end: int) -> "RowBlockContainer":
+        """Copy rows [begin, end) (reference RowBlock::Slice, data.h:216)."""
+        if not 0 <= begin <= end <= self.size:
+            raise DMLCError(f"bad slice [{begin}, {end}) of {self.size}")
+        out = RowBlockContainer()
+        lo, hi = int(self.offset[begin]), int(self.offset[end])
+        out.offset = (self.offset[begin:end + 1] - lo).astype(np.uint64)
+        out.label = self.label[begin:end].copy()
+        if len(self.weight):
+            out.weight = self.weight[begin:end].copy()
+        if len(self.qid):
+            out.qid = self.qid[begin:end].copy()
+        if len(self.field):
+            out.field = self.field[lo:hi].copy()
+        out.index = self.index[lo:hi].copy()
+        for name in ("value", "value_i32", "value_i64"):
+            arr = getattr(self, name)
+            if len(arr):
+                setattr(out, name, arr[lo:hi].copy())
+        out.value_dtype = self.value_dtype
+        if out.nnz:
+            out.max_index = int(out.index.max())
+            if len(out.field):
+                out.max_field = int(out.field.max())
+        return out
+
+    # -- growth ---------------------------------------------------------------
+    @classmethod
+    def from_blocks(cls, blocks, index64: bool = False
+                    ) -> "RowBlockContainer":
+        """Build one container from RowBlock views / containers in a single
+        pass (one concatenate per array — the eager-load path is O(n), not
+        the O(n²) of repeated appends).
+
+        Presence is reconciled across blocks: when only some blocks carry
+        weights/values/qids/fields, the absent ones are filled with their
+        implicit defaults (weight 1, value 1, qid 0, field 0) so all arrays
+        stay aligned with offset/index."""
+        parts = []       # (n, off_u64, nnz, label, w|None, q|None, f|None,
+                         #  idx, v|None)
+        any_w = any_q = any_f = any_v = False
+        vdt: Optional[int] = None
+        for b in blocks:
+            n = b.num_rows if hasattr(b, "num_rows") else b.size
+            off = np.asarray(b.offset, dtype=np.uint64)
+            nnz = int(off[-1])
+
+            def opt(arr):
+                return arr if arr is not None and len(arr) else None
+
+            w = opt(getattr(b, "weight", None))
+            q = opt(getattr(b, "qid", None))
+            f = opt(getattr(b, "field", None))
+            if isinstance(b, RowBlockContainer):
+                v = opt(b._values_view())
+            else:
+                v = opt(getattr(b, "value", None))
+            if v is not None:
+                dt = {np.dtype(np.int32): 1, np.dtype(np.int64): 2}.get(
+                    np.asarray(v).dtype, 0)
+                if vdt is None:
+                    vdt = dt
+                elif vdt != dt:
+                    raise DMLCError(
+                        "cannot merge row blocks of different value dtypes")
+            any_w |= w is not None
+            any_q |= q is not None
+            any_f |= f is not None
+            any_v |= v is not None
+            parts.append((n, off, nnz, np.asarray(b.label, np.float32),
+                          w, q, f, np.asarray(b.index), v))
+        c = cls(index64)
+        if not parts:
+            return c
+        offs = [c.offset]
+        base = 0
+        for n, off, nnz, *_ in parts:
+            offs.append(off[1:] + base)
+            base += nnz
+        c.offset = np.concatenate(offs).astype(np.uint64)
+        c.label = np.concatenate([p[3] for p in parts])
+        if any_w:
+            c.weight = np.concatenate([
+                p[4] if p[4] is not None else np.ones(p[0], np.float32)
+                for p in parts]).astype(np.float32)
+        if any_q:
+            c.qid = np.concatenate([
+                p[5] if p[5] is not None else np.zeros(p[0], np.uint64)
+                for p in parts]).astype(np.uint64)
+        if any_f:
+            c.field = np.concatenate([
+                p[6] if p[6] is not None else np.zeros(p[2], np.uint32)
+                for p in parts]).astype(np.uint32)
+        c.index = np.concatenate(
+            [p[7] for p in parts]).astype(c.index.dtype)
+        if any_v:
+            c.value_dtype = vdt or 0
+            name = {0: "value", 1: "value_i32", 2: "value_i64"}[c.value_dtype]
+            dtype = {0: np.float32, 1: np.int32, 2: np.int64}[c.value_dtype]
+            setattr(c, name, np.concatenate([
+                p[8] if p[8] is not None else np.ones(p[2], dtype)
+                for p in parts]).astype(dtype))
+        if c.nnz:
+            c.max_index = int(c.index.max())
+        if len(c.field):
+            c.max_field = int(c.field.max())
+        return c
+
+    def append_block(self, b) -> None:
+        """Append all rows of a RowBlock view or another container
+        (reference Push(RowBlock), row_block.h). For many blocks prefer
+        from_blocks (single concatenate)."""
+        merged = RowBlockContainer.from_blocks(
+            [self, b], index64=self.index.dtype == np.uint64)
+        self.__dict__.update(merged.__dict__)
+
+    # -- binary io (cross-language wire format) -------------------------------
+    def save(self, stream: BinaryIO) -> None:
+        w = BinaryWriter(stream)
+        w.write_array(self.offset)
+        w.write_array(self.label)
+        w.write_array(self.weight)
+        w.write_array(self.qid)
+        w.write_array(self.field)
+        w.write_array(self.index)
+        w.write_array(self.value)
+        w.write_array(self.value_i32)
+        w.write_array(self.value_i64)
+        w.write_scalar(self.value_dtype, "int32")
+        w.write_scalar(self.max_index, "uint64")
+        w.write_scalar(self.max_field, "uint32")
+
+    def load(self, stream: BinaryIO) -> bool:
+        """Read one block; False at a clean end of stream."""
+        head = stream.read(8)
+        if len(head) < 8:
+            return False
+        r = BinaryReader(stream)
+        n = int(np.frombuffer(head, "<u8")[0])
+        raw = stream.read(8 * n)
+        if len(raw) != 8 * n:  # checked like BinaryReader._read_exact
+            raise DMLCError(
+                f"truncated stream: wanted {8 * n} bytes, got {len(raw)}")
+        self.offset = np.frombuffer(raw, "<u8").copy()
+        self.label = r.read_array("float32")
+        self.weight = r.read_array("float32")
+        self.qid = r.read_array("uint64")
+        self.field = r.read_array("uint32")
+        self.index = r.read_array(
+            "uint64" if self.index.dtype == np.uint64 else "uint32")
+        self.value = r.read_array("float32")
+        self.value_i32 = r.read_array("int32")
+        self.value_i64 = r.read_array("int64")
+        self.value_dtype = int(r.read_scalar("int32"))
+        self.max_index = int(r.read_scalar("uint64"))
+        self.max_field = int(r.read_scalar("uint32"))
+        return True
+
+
+# -- parser factory -----------------------------------------------------------
+# reference DMLC_REGISTER_DATA_PARSER (data.h:358) + CreateParser_
+# (src/data.cc:62-85). Builtin formats dispatch to the native multithreaded
+# parsers; Python callables can register additional formats.
+PARSER_REGISTRY: Registry = Registry.get("data_parser")
+
+_NATIVE_FORMATS = ("libsvm", "csv", "libfm")
+
+
+def register_parser(name: str) -> Callable:
+    """Register a custom format: factory(uri, part, npart, **kwargs) ->
+    parser with next_block()/before_first()/bytes_read()."""
+    return PARSER_REGISTRY.register(name)
+
+
+class Parser:
+    """Format-dispatched parser factory (reference Parser<I,D>::Create,
+    data.h:307). Iterating the result yields RowBlock views."""
+
+    @staticmethod
+    def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
+               nthread: int = 0, index64: bool = False, **kwargs):
+        base = uri.split("#", 1)[0]
+        args: Dict[str, str] = {}
+        if "?" in base:
+            for kv in base.split("?", 1)[1].split("&"):
+                if kv:
+                    k, _, v = kv.partition("=")
+                    args[k] = v
+        resolved = args.get("format", "libsvm") if fmt == "auto" else fmt
+        if resolved in _NATIVE_FORMATS:
+            if kwargs:
+                # native parser options travel as ?k=v URI args (reference
+                # URISpec → param_.Init); don't silently drop kwargs
+                raise DMLCError(
+                    f"native format {resolved!r} takes options as URI args "
+                    f"(e.g. ?label_column=0), got kwargs {sorted(kwargs)}")
+            return NativeParser(uri, part=part, npart=npart, fmt=fmt,
+                                nthread=nthread, index64=index64)
+        entry = PARSER_REGISTRY.find(resolved)
+        if entry is None:
+            raise DMLCError(
+                f"unknown data format {resolved!r}; known: "
+                f"{list(_NATIVE_FORMATS) + PARSER_REGISTRY.list_names()}")
+        return entry(uri, part, npart, **kwargs)
+
+
+class RowBlockIter:
+    """Host row-block iterator (reference RowBlockIter<I,D>::Create,
+    data.h:267).
+
+    Without a ``#cachefile`` URI suffix this is the BasicRowIter shape: the
+    whole split is loaded eagerly into ONE RowBlockContainer and iteration
+    yields that single block (reference src/data/basic_row_iter.h). With
+    ``#cachefile`` the native DiskCacheParser serves blocks from its binary
+    cache and iteration is page-at-a-time (reference disk_row_iter.h).
+    For the TPU path use dmlc_core_tpu.tpu.DeviceRowBlockIter instead."""
+
+    def __init__(self, parser, eager: bool):
+        self._parser = parser
+        self._eager = eager
+        self._block: Optional[RowBlockContainer] = None
+
+    @staticmethod
+    def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
+               nthread: int = 0, index64: bool = False) -> "RowBlockIter":
+        parser = Parser.create(uri, part, npart, fmt, nthread=nthread,
+                               index64=index64)
+        return RowBlockIter(parser, eager="#" not in uri)
+
+    def _load_eager(self) -> RowBlockContainer:
+        if self._block is None:
+            # native block views are only valid until the next next_block()
+            # call, so snapshot each into a single-block container, then
+            # merge once (O(n) total)
+            blocks = []
+            while True:
+                b = self._parser.next_block()
+                if b is None:
+                    break
+                blocks.append(RowBlockContainer.from_blocks([b]))
+            self._block = RowBlockContainer.from_blocks(blocks)
+        return self._block
+
+    def __iter__(self) -> Iterator[RowBlockContainer]:
+        if self._eager:
+            yield self._load_eager()
+            return
+        self._parser.before_first()
+        while True:
+            b = self._parser.next_block()
+            if b is None:
+                return
+            yield RowBlockContainer.from_blocks([b])
+
+    def before_first(self) -> None:
+        if not self._eager:
+            self._parser.before_first()
+
+    @property
+    def num_col(self) -> int:
+        """reference RowBlockIter::NumCol (data.h:276) — eager mode loads
+        on demand."""
+        if self._eager:
+            return self._load_eager().num_col
+        raise DMLCError("num_col requires eager (non-cached) mode")
+
+    def bytes_read(self) -> int:
+        return self._parser.bytes_read()
+
+    def close(self) -> None:
+        close = getattr(self._parser, "close", None)
+        if close is not None:
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

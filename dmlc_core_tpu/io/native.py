@@ -488,6 +488,9 @@ class NativeBatcher:
                  batch_rows: int = 65536, num_shards: int = 1,
                  min_nnz_bucket: int = 4096):
         self._h = ctypes.c_void_p()
+        self._batch_rows = batch_rows
+        self._num_shards = num_shards
+        self._bucket = 0  # staged by next_meta; sizes the fill buffers
         _check(lib().dct_batcher_create(
             uri.encode(), part, npart, fmt.encode(), nthread,
             1 if threaded else 0, batch_rows, num_shards, min_nnz_bucket,
@@ -504,32 +507,40 @@ class NativeBatcher:
             ctypes.byref(max_index), ctypes.byref(has)))
         if not has.value:
             return None
+        self._bucket = bucket.value
         return take.value, bucket.value, max_index.value
 
     @staticmethod
-    def _ptr(arr: np.ndarray, dtype) -> ctypes.c_void_p:
-        # hard check (not assert): the native side bulk-writes through this
-        # pointer, so a wrong dtype/layout would corrupt memory under -O
-        if arr.dtype != dtype or not arr.flags["C_CONTIGUOUS"]:
+    def _ptr(arr: np.ndarray, dtype, size: int) -> ctypes.c_void_p:
+        # hard checks (not assert): the native side bulk-writes through this
+        # pointer, so a wrong dtype/layout/size would corrupt memory
+        if (arr.dtype != dtype or not arr.flags["C_CONTIGUOUS"]
+                or arr.size != size):
             raise DMLCError(
-                f"fill buffer must be C-contiguous {np.dtype(dtype).name}, "
-                f"got {arr.dtype.name} contiguous={arr.flags['C_CONTIGUOUS']}")
+                f"fill buffer must be C-contiguous {np.dtype(dtype).name} "
+                f"of {size} elements, got {arr.dtype.name} size={arr.size} "
+                f"contiguous={arr.flags['C_CONTIGUOUS']}")
         return ctypes.c_void_p(arr.ctypes.data)
 
     def fill_csr(self, row: np.ndarray, col: np.ndarray, val: np.ndarray,
                  label: np.ndarray, weight: np.ndarray,
                  nrows: np.ndarray) -> None:
+        nz = self._num_shards * self._bucket
         _check(lib().dct_batcher_fill_csr(
-            self._h, self._ptr(row, np.int32), self._ptr(col, np.int32),
-            self._ptr(val, np.float32), self._ptr(label, np.float32),
-            self._ptr(weight, np.float32), self._ptr(nrows, np.int32)))
+            self._h, self._ptr(row, np.int32, nz),
+            self._ptr(col, np.int32, nz), self._ptr(val, np.float32, nz),
+            self._ptr(label, np.float32, self._batch_rows),
+            self._ptr(weight, np.float32, self._batch_rows),
+            self._ptr(nrows, np.int32, self._num_shards)))
 
     def fill_dense(self, x: np.ndarray, label: np.ndarray,
                    weight: np.ndarray, nrows: np.ndarray) -> None:
+        F = x.shape[-1]
         _check(lib().dct_batcher_fill_dense(
-            self._h, self._ptr(x, np.float32), x.shape[-1],
-            self._ptr(label, np.float32), self._ptr(weight, np.float32),
-            self._ptr(nrows, np.int32)))
+            self._h, self._ptr(x, np.float32, self._batch_rows * F), F,
+            self._ptr(label, np.float32, self._batch_rows),
+            self._ptr(weight, np.float32, self._batch_rows),
+            self._ptr(nrows, np.int32, self._num_shards)))
 
     def before_first(self) -> None:
         _check(lib().dct_batcher_before_first(self._h))

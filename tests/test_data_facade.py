@@ -1,0 +1,203 @@
+"""dmlc_core_tpu.data facade tests: RowBlockContainer semantics (slice,
+append, mem cost, row views, sdot), Parser/RowBlockIter factories, custom
+format registration, and the cross-language binary wire format (Python
+save/load vs the C++ DiskCacheParser's serialized blocks)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.data import (PARSER_REGISTRY, Parser, Row,
+                                RowBlockContainer, RowBlockIter,
+                                register_parser)
+from dmlc_core_tpu.io.native import NativeParser
+
+
+def write_libsvm(path, rows=50, features=6, seed=3):
+    import random
+    rng = random.Random(seed)
+    lines = []
+    for i in range(rows):
+        feats = " ".join(
+            f"{j}:{rng.uniform(-2, 2):.4f}" for j in range(features))
+        lines.append(f"{i % 2} {feats}")
+    path.write_text("\n".join(lines) + "\n")
+    return path
+
+
+def load_container(path, **kw):
+    c = RowBlockContainer()
+    with NativeParser(str(path), **kw) as p:
+        while True:
+            b = p.next_block()
+            if b is None:
+                return c
+            c.append_block(b)
+
+
+def test_container_basics(tmp_path):
+    p = write_libsvm(tmp_path / "a.libsvm", rows=50, features=6)
+    c = load_container(p)
+    assert c.size == 50
+    assert c.nnz == 300
+    assert c.num_col == 6
+    assert c.mem_cost_bytes() > 300 * 8
+    row = c[7]
+    assert isinstance(row, Row)
+    assert row.length == 6
+    assert row.label in (0.0, 1.0)
+    w = np.arange(6, dtype=np.float64)
+    assert row.sdot(w) == pytest.approx(
+        float(np.dot(w[row.index], row.value)), rel=1e-6)
+    assert len(list(c)) == 50
+
+
+def test_container_slice(tmp_path):
+    p = write_libsvm(tmp_path / "b.libsvm", rows=30, features=4)
+    c = load_container(p)
+    s = c.slice(10, 20)
+    assert s.size == 10
+    assert s.nnz == 40
+    np.testing.assert_array_equal(s.label, c.label[10:20])
+    np.testing.assert_array_equal(
+        s.value, c.value[int(c.offset[10]):int(c.offset[20])])
+    assert int(s.offset[0]) == 0
+    with pytest.raises(DMLCError):
+        c.slice(20, 10)
+
+
+def test_container_save_load_roundtrip(tmp_path):
+    p = write_libsvm(tmp_path / "c.libsvm", rows=25, features=5)
+    c = load_container(p)
+    f = tmp_path / "blk.bin"
+    with open(f, "wb") as fh:
+        c.save(fh)
+        c.save(fh)  # two blocks back to back
+    got = []
+    with open(f, "rb") as fh:
+        while True:
+            d = RowBlockContainer()
+            if not d.load(fh):
+                break
+            got.append(d)
+    assert len(got) == 2
+    for d in got:
+        assert d.size == c.size
+        np.testing.assert_array_equal(d.offset, c.offset)
+        np.testing.assert_array_equal(d.value, c.value)
+        np.testing.assert_array_equal(d.index, c.index)
+        assert d.max_index == c.max_index
+
+
+def test_cross_language_cache_format(tmp_path):
+    """Python RowBlockContainer.load reads the blocks the C++
+    DiskCacheParser serialized (cpp/src/rowblock.h Save) — same wire
+    format across languages."""
+    p = write_libsvm(tmp_path / "d.libsvm", rows=40, features=5)
+    cache = tmp_path / "d.cache"
+    # first pass writes the cache via the native DiskCacheParser
+    with NativeParser(f"{p}#{cache}") as np_:
+        rows = sum(b.num_rows for b in np_)
+    assert rows == 40
+    cache_file = str(cache) + ".rowblock"  # DiskCacheParser naming
+    direct = load_container(p)
+    with open(cache_file, "rb") as fh:
+        magic, fp = struct.unpack("<QQ", fh.read(16))  # header: magic+fprint
+        assert magic != 0 and fp != 0
+        total, values = 0, []
+        while True:
+            d = RowBlockContainer()
+            if not d.load(fh):
+                break
+            total += d.size
+            values.append(d.value)
+    assert total == 40
+    np.testing.assert_array_equal(np.concatenate(values), direct.value)
+
+
+def test_parser_factory_format_resolution(tmp_path):
+    f = tmp_path / "e.csv"
+    f.write_text("1.0,2.0,0\n3.5,4.5,1\n")
+    with Parser.create(f"{f}?format=csv&label_column=2") as p:
+        blocks = [b for b in p]
+    assert sum(b.num_rows for b in blocks) == 2
+    with pytest.raises(DMLCError, match="unknown data format"):
+        Parser.create(str(f), fmt="parquet")
+
+
+def test_custom_parser_registration(tmp_path):
+    calls = []
+
+    @register_parser("toyfmt")
+    def make_toy(uri, part, npart, **kw):
+        calls.append((uri, part, npart))
+        return "toy-parser"
+
+    try:
+        got = Parser.create("whatever.toy", 1, 4, fmt="toyfmt")
+        assert got == "toy-parser"
+        assert calls == [("whatever.toy", 1, 4)]
+    finally:
+        PARSER_REGISTRY.remove("toyfmt")
+
+
+def test_rowblockiter_eager(tmp_path):
+    p = write_libsvm(tmp_path / "f.libsvm", rows=60, features=3)
+    with RowBlockIter.create(str(p)) as it:
+        assert it.num_col == 3
+        blocks = list(it)
+    assert len(blocks) == 1  # BasicRowIter shape: one consolidated block
+    assert blocks[0].size == 60
+    # re-iteration yields the same cached block
+    with RowBlockIter.create(str(p)) as it:
+        b1 = list(it)[0]
+        b2 = list(it)[0]
+        assert b1 is b2
+
+
+def test_rowblockiter_cached_pages(tmp_path):
+    p = write_libsvm(tmp_path / "g.libsvm", rows=60, features=3)
+    cache = tmp_path / "g.cache"
+    with RowBlockIter.create(f"{p}#{cache}") as it:
+        total1 = sum(c.size for c in it)
+        total2 = sum(c.size for c in it)  # second epoch replays the cache
+    assert total1 == total2 == 60
+    assert (tmp_path / "g.cache.rowblock").exists()
+
+
+def test_merge_mixed_value_presence(tmp_path):
+    """Blocks mixing implicit (binary) and explicit values must stay
+    aligned: absent values fill with 1.0, absent weights with 1.0."""
+    a = tmp_path / "bin.libsvm"
+    a.write_text("1 0 2\n0 1\n")            # binary rows: no values
+    b = tmp_path / "val.libsvm"
+    b.write_text("1 0:2.5 1:3.5\n")          # explicit values
+    with NativeParser(str(a)) as p:
+        ba = RowBlockContainer.from_blocks([RowBlockContainer.from_blocks([x])
+                                            for x in iter(p.next_block, None)])
+    with NativeParser(str(b)) as p:
+        bb = RowBlockContainer.from_blocks([RowBlockContainer.from_blocks([x])
+                                            for x in iter(p.next_block, None)])
+    merged = RowBlockContainer.from_blocks([ba, bb])
+    assert merged.size == 3
+    assert merged.nnz == 5
+    # every row's value slice has the right length
+    vals = merged._values_view()
+    assert vals is not None and len(vals) == 5
+    np.testing.assert_allclose(vals[:3], 1.0)      # implicit rows filled
+    np.testing.assert_allclose(vals[3:], [2.5, 3.5])
+    r = merged[2]
+    assert r.length == 2 and r.get_value(0) == 2.5
+
+
+def test_append_block_incremental_still_correct(tmp_path):
+    p = write_libsvm(tmp_path / "inc.libsvm", rows=20, features=3)
+    whole = load_container(p)
+    half = RowBlockContainer()
+    half.append_block(whole.slice(0, 10))
+    half.append_block(whole.slice(10, 20))
+    np.testing.assert_array_equal(half.offset, whole.offset)
+    np.testing.assert_array_equal(half.value, whole.value)
+    np.testing.assert_array_equal(half.label, whole.label)
