@@ -1,0 +1,179 @@
+#include "config.h"
+
+#include <cctype>
+#include <sstream>
+
+#include "base.h"
+
+namespace dct {
+namespace {
+
+// Unescape the body of a quoted value: \" \\ \n \t (reference config.cc's
+// TransformTokenToReal).
+std::string Unescape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '\\' && i + 1 < s.size()) {
+      ++i;
+      switch (s[i]) {
+        case 'n': out += '\n'; break;
+        case 't': out += '\t'; break;
+        case '"': out += '"'; break;
+        case '\\': out += '\\'; break;
+        default: out += '\\'; out += s[i];
+      }
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+std::string Escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
+std::string Strip(const std::string& s) {
+  size_t b = 0;
+  size_t e = s.size();
+  while (b < e && std::isspace(static_cast<unsigned char>(s[b]))) ++b;
+  while (e > b && std::isspace(static_cast<unsigned char>(s[e - 1]))) --e;
+  return s.substr(b, e - b);
+}
+
+}  // namespace
+
+Config::Config(bool multi_value) : multi_value_(multi_value) {}
+
+Config::Config(std::istream& is, bool multi_value) : multi_value_(multi_value) {
+  LoadFromStream(is);
+}
+
+void Config::Clear() {
+  order_.clear();
+  index_.clear();
+  is_string_.clear();
+}
+
+void Config::LoadFromText(const std::string& text) {
+  std::istringstream is(text);
+  LoadFromStream(is);
+}
+
+void Config::LoadFromStream(std::istream& is) {
+  std::string line;
+  size_t lineno = 0;
+  while (std::getline(is, line)) {
+    ++lineno;
+    // strip comments outside quotes; a backslash escapes exactly the next
+    // char inside quotes (so \\" is a literal backslash + closing quote)
+    bool in_quote = false;
+    bool esc = false;
+    for (size_t i = 0; i < line.size(); ++i) {
+      if (esc) {
+        esc = false;
+      } else if (in_quote && line[i] == '\\') {
+        esc = true;
+      } else if (line[i] == '"') {
+        in_quote = !in_quote;
+      } else if (line[i] == '#' && !in_quote) {
+        line.resize(i);
+        break;
+      }
+    }
+    std::string t = Strip(line);
+    if (t.empty()) continue;
+    size_t eq = std::string::npos;
+    in_quote = false;
+    esc = false;
+    for (size_t i = 0; i < t.size(); ++i) {
+      if (esc) esc = false;
+      else if (in_quote && t[i] == '\\') esc = true;
+      else if (t[i] == '"') in_quote = !in_quote;
+      else if (t[i] == '=' && !in_quote) { eq = i; break; }
+    }
+    DCT_CHECK(eq != std::string::npos)
+        << "config line " << lineno << ": expected `key = value`, got: " << t;
+    std::string key = Strip(t.substr(0, eq));
+    std::string val = Strip(t.substr(eq + 1));
+    DCT_CHECK(!key.empty()) << "config line " << lineno << ": empty key";
+    bool is_str = false;
+    if (val.size() >= 2 && val.front() == '"' && val.back() == '"') {
+      val = Unescape(val.substr(1, val.size() - 2));
+      is_str = true;
+    }
+    Insert(key, val, is_str);
+  }
+}
+
+void Config::SetParam(const std::string& key, const std::string& value,
+                      bool is_string) {
+  Insert(key, value, is_string);
+}
+
+void Config::Insert(const std::string& key, const std::string& value,
+                    bool is_string) {
+  auto it = index_.find(key);
+  if (it != index_.end() && !multi_value_) {
+    size_t slot = it->second.back();
+    order_[slot].second = value;  // later wins
+    entry_is_string_[slot] = is_string;
+    is_string_[key] = is_string;
+    return;
+  }
+  index_[key].push_back(order_.size());
+  order_.emplace_back(key, value);
+  entry_is_string_.push_back(is_string);
+  is_string_[key] = is_string;
+}
+
+const std::string& Config::GetParam(const std::string& key) const {
+  auto it = index_.find(key);
+  DCT_CHECK(it != index_.end()) << "config: key " << key << " not found";
+  return order_[it->second.back()].second;
+}
+
+bool Config::Contains(const std::string& key) const {
+  return index_.count(key) != 0;
+}
+
+std::vector<std::string> Config::GetAll(const std::string& key) const {
+  std::vector<std::string> out;
+  auto it = index_.find(key);
+  if (it == index_.end()) return out;
+  for (size_t slot : it->second) out.push_back(order_[slot].second);
+  return out;
+}
+
+bool Config::IsString(const std::string& key) const {
+  auto it = is_string_.find(key);
+  return it != is_string_.end() && it->second;
+}
+
+std::string Config::ToProtoString() const {
+  std::ostringstream os;
+  for (size_t i = 0; i < order_.size(); ++i) {
+    os << order_[i].first << " : ";
+    if (entry_is_string_[i]) {  // per-occurrence, not per-key
+      os << '"' << Escape(order_[i].second) << '"';
+    } else {
+      os << order_[i].second;
+    }
+    os << '\n';
+  }
+  return os.str();
+}
+
+}  // namespace dct

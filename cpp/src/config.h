@@ -1,0 +1,71 @@
+// INI-style text configuration.
+//
+// Counterpart of reference include/dmlc/config.h + src/config.cc (465 L):
+// `key = value` lines with '#' comments, quoted values with escapes,
+// optional multi-value mode (duplicate keys preserved in order), iteration
+// in insertion order, and proto-text rendering (ToProtoString). Used by
+// downstream jobs to carry learner settings; the tracker's Python side has
+// an equivalent reader (dmlc_core_tpu/config.py) for the same files.
+#ifndef DCT_CONFIG_H_
+#define DCT_CONFIG_H_
+
+#include <istream>
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace dct {
+
+class Config {
+ public:
+  using ConfigEntry = std::pair<std::string, std::string>;
+
+  // multi_value: keep every occurrence of a repeated key (reference
+  // config.h:40-56); otherwise later wins.
+  explicit Config(bool multi_value = false);
+  Config(std::istream& is, bool multi_value = false);  // NOLINT(runtime/references)
+
+  void Clear();
+  void LoadFromStream(std::istream& is);  // NOLINT(runtime/references)
+  void LoadFromText(const std::string& text);
+
+  void SetParam(const std::string& key, const std::string& value,
+                bool is_string = false);
+
+  // value of key (last occurrence in multi-value mode); throws Error when
+  // absent (reference GetParam).
+  const std::string& GetParam(const std::string& key) const;
+  bool Contains(const std::string& key) const;
+  std::vector<std::string> GetAll(const std::string& key) const;
+
+  // whether the value was written as a quoted string (drives proto quoting)
+  bool IsString(const std::string& key) const;
+
+  // proto-text rendering: `key : value` / `key : "string"` lines
+  // (reference ToProtoString, config.h:88).
+  std::string ToProtoString() const;
+
+  // iteration in insertion order
+  const std::vector<ConfigEntry>& items() const { return order_; }
+  std::vector<ConfigEntry>::const_iterator begin() const {
+    return order_.begin();
+  }
+  std::vector<ConfigEntry>::const_iterator end() const {
+    return order_.end();
+  }
+
+ private:
+  void Insert(const std::string& key, const std::string& value,
+              bool is_string);
+
+  bool multi_value_;
+  std::vector<ConfigEntry> order_;
+  std::vector<bool> entry_is_string_;  // parallel to order_ (per occurrence)
+  std::map<std::string, std::vector<size_t>> index_;  // key → order slots
+  std::map<std::string, bool> is_string_;  // last occurrence per key
+};
+
+}  // namespace dct
+
+#endif  // DCT_CONFIG_H_

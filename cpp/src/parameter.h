@@ -1,0 +1,424 @@
+// Reflection-based parameter structs.
+//
+// Counterpart of reference include/dmlc/parameter.h (1153 L): plain C++
+// structs gain keyword initialization, validation (range / enum), default
+// handling, docstring generation, dict export, and JSON save/load through a
+// once-built per-type ParamManager (reference __MANAGER__, parameter.h:
+// 248-257,311-319). The macro surface is kept — DCT_DECLARE_PARAMETER /
+// DCT_DECLARE_FIELD / alias / range / enum — because downstream code keys on
+// that idiom; the implementation is C++17 (std::function setters bound to
+// member offsets, from_chars parsing via numparse.h) rather than the
+// reference's hand-rolled type lattice.
+#ifndef DCT_PARAMETER_H_
+#define DCT_PARAMETER_H_
+
+#include <cstdlib>
+#include <limits>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "base.h"
+#include "json.h"
+#include "numparse.h"
+
+namespace dct {
+
+// Field metadata surfaced by __FIELDS__ / docstrings and the registry
+// (reference ParamFieldInfo, parameter.h:85-100).
+struct ParamFieldInfo {
+  std::string name;
+  std::string type;            // e.g. "int", "float", "string"
+  std::string type_info_str;   // type + default/range/enum rendering
+  std::string description;
+};
+
+// Init matching policy (reference parameter.h:77-84).
+enum class ParamInitOption {
+  kAllowUnknown,  // ignore unknown keys
+  kAllMatch,      // every key must match a declared field
+  kAllowHidden,   // unknown keys allowed only when prefixed with '_'
+};
+
+class ParamError : public Error {
+ public:
+  using Error::Error;
+};
+
+namespace param {
+
+template <typename T>
+inline const char* TypeName();
+template <> inline const char* TypeName<int>() { return "int"; }
+template <> inline const char* TypeName<unsigned>() { return "unsigned"; }
+template <> inline const char* TypeName<int64_t>() { return "int64"; }
+template <> inline const char* TypeName<uint64_t>() { return "uint64"; }
+template <> inline const char* TypeName<float>() { return "float"; }
+template <> inline const char* TypeName<double>() { return "double"; }
+template <> inline const char* TypeName<bool>() { return "boolean"; }
+template <> inline const char* TypeName<std::string>() { return "string"; }
+
+class FieldAccessEntry {
+ public:
+  virtual ~FieldAccessEntry() = default;
+  virtual void Set(void* head, const std::string& value) const = 0;
+  virtual std::string GetStringValue(const void* head) const = 0;
+  virtual void SetDefault(void* head) const = 0;
+  bool has_default() const { return has_default_; }
+  const std::string& key() const { return key_; }
+  virtual ParamFieldInfo GetFieldInfo() const = 0;
+
+ protected:
+  friend class ParamManager;
+  std::string key_;
+  std::string description_;
+  bool has_default_ = false;
+};
+
+template <typename T>
+class FieldEntry : public FieldAccessEntry {
+ public:
+  // -- chainable declaration surface (reference FieldEntry, parameter.h
+  //    :775-880) --
+  FieldEntry& set_default(const T& v) {
+    default_ = v;
+    has_default_ = true;
+    return *this;
+  }
+  FieldEntry& describe(const std::string& d) {
+    description_ = d;
+    return *this;
+  }
+  FieldEntry& set_range(T lo, T hi) {
+    lo_ = lo;
+    hi_ = hi;
+    has_range_ = true;
+    return *this;
+  }
+  FieldEntry& set_lower_bound(T lo) {
+    lo_ = lo;
+    has_range_ = true;
+    return *this;
+  }
+  // string aliases for values (reference add_enum, int fields)
+  FieldEntry& add_enum(const std::string& name, T v) {
+    enum_.emplace_back(name, v);
+    return *this;
+  }
+
+  void Set(void* head, const std::string& value) const override {
+    T* ref = reinterpret_cast<T*>(static_cast<char*>(head) + offset_);
+    T parsed{};
+    if (!ParseValue(value, &parsed)) {
+      throw ParamError("parameter " + key_ + ": cannot parse value \"" +
+                       value + "\" as " + TypeName<T>());
+    }
+    if (has_range_ && (parsed < lo_ || parsed > hi_)) {
+      std::ostringstream os;
+      os << "parameter " << key_ << ": value " << value
+         << " out of range " << RangeString();
+      throw ParamError(os.str());
+    }
+    *ref = parsed;
+  }
+
+  std::string GetStringValue(const void* head) const override {
+    const T& v = *reinterpret_cast<const T*>(
+        static_cast<const char*>(head) + offset_);
+    for (const auto& kv : enum_) {
+      if (kv.second == v) return kv.first;
+    }
+    return ToString(v);
+  }
+
+  void SetDefault(void* head) const override {
+    *reinterpret_cast<T*>(static_cast<char*>(head) + offset_) = default_;
+  }
+
+  ParamFieldInfo GetFieldInfo() const override {
+    ParamFieldInfo info;
+    info.name = key_;
+    info.type = TypeName<T>();
+    std::ostringstream os;
+    os << info.type;
+    if (!enum_.empty()) {
+      os << ", {";
+      for (size_t i = 0; i < enum_.size(); ++i) {
+        os << (i ? ", " : "") << '\'' << enum_[i].first << '\'';
+      }
+      os << '}';
+    } else if (has_range_) {
+      os << ", " << RangeString();
+    }
+    if (has_default_) {
+      os << ", default=" << ToString(default_);
+    } else {
+      os << ", required";
+    }
+    info.type_info_str = os.str();
+    info.description = description_;
+    return info;
+  }
+
+ private:
+  friend class ParamManager;
+
+  bool ParseValue(const std::string& s, T* out) const {
+    for (const auto& kv : enum_) {
+      if (kv.first == s) {
+        *out = kv.second;
+        return true;
+      }
+    }
+    if constexpr (std::is_same_v<T, std::string>) {
+      *out = s;
+      return true;
+    } else if constexpr (std::is_same_v<T, bool>) {
+      if (s == "true" || s == "True" || s == "1") { *out = true; return true; }
+      if (s == "false" || s == "False" || s == "0") {
+        *out = false;
+        return true;
+      }
+      return false;
+    } else {
+      const char* p = s.data();
+      const char* end = p + s.size();
+      const char* q = p;
+      T v{};
+      if (!ParseNum(p, end, &q, &v) || q != end) return false;
+      *out = v;
+      return true;
+    }
+  }
+
+  static std::string ToString(const T& v) {
+    if constexpr (std::is_same_v<T, std::string>) {
+      return v;
+    } else if constexpr (std::is_same_v<T, bool>) {
+      return v ? "true" : "false";
+    } else {
+      std::ostringstream os;
+      if constexpr (std::is_floating_point_v<T>) {
+        // full round-trip precision: __DICT__/JSON Save→Load must not
+        // perturb float fields
+        os.precision(std::numeric_limits<T>::max_digits10);
+      }
+      os << v;
+      return os.str();
+    }
+  }
+
+  std::string RangeString() const {
+    std::ostringstream os;
+    os << "[" << ToString(lo_) << ", ";
+    if (hi_ == std::numeric_limits<T>::max()) {
+      os << "inf";
+    } else {
+      os << ToString(hi_);
+    }
+    os << "]";
+    return os.str();
+  }
+
+  size_t offset_ = 0;
+  T default_{};
+  T lo_{};
+  T hi_ = std::numeric_limits<T>::max();
+  bool has_range_ = false;
+  std::vector<std::pair<std::string, T>> enum_;
+};
+
+class ParamManager {
+ public:
+  template <typename T>
+  FieldEntry<T>& Declare(void* head, const std::string& key, T& ref) {
+    auto entry = std::make_unique<FieldEntry<T>>();
+    entry->key_ = key;
+    entry->offset_ = reinterpret_cast<char*>(&ref) -
+                     reinterpret_cast<char*>(head);
+    FieldEntry<T>* raw = entry.get();
+    fmap_[key] = raw;
+    entries_.push_back(std::move(entry));
+    return *raw;
+  }
+
+  // alias → canonical key (reference DMLC_DECLARE_ALIAS, parameter.h:330)
+  void AddAlias(const std::string& field, const std::string& alias) {
+    auto it = fmap_.find(field);
+    DCT_CHECK(it != fmap_.end()) << "alias of undeclared field " << field;
+    fmap_[alias] = it->second;
+  }
+
+  void set_name(const std::string& name) { name_ = name; }
+  const std::string& name() const { return name_; }
+
+  // Initialize fields of *head from kwargs; returns keys that matched no
+  // field (empty unless kAllowUnknown/kAllowHidden). Missing fields take
+  // defaults; missing required fields throw listing the docstring
+  // (reference RunInit, parameter.h:429-482).
+  std::vector<std::pair<std::string, std::string>> RunInit(
+      void* head, const std::map<std::string, std::string>& kwargs,
+      ParamInitOption option) const {
+    std::vector<std::pair<std::string, std::string>> unknown;
+    std::map<std::string, bool> set_flags;
+    for (const auto& kv : kwargs) {
+      auto it = fmap_.find(kv.first);
+      if (it == fmap_.end()) {
+        switch (option) {
+          case ParamInitOption::kAllMatch:
+            throw ParamError("unknown parameter " + kv.first + " for " +
+                             name_ + "\n" + DocString());
+          case ParamInitOption::kAllowHidden:
+            if (kv.first.empty() || kv.first[0] != '_') {
+              throw ParamError("unknown parameter " + kv.first + " for " +
+                               name_ + "\n" + DocString());
+            }
+            [[fallthrough]];
+          case ParamInitOption::kAllowUnknown:
+            unknown.push_back(kv);
+            continue;
+        }
+      }
+      it->second->Set(head, kv.second);
+      set_flags[it->second->key()] = true;
+    }
+    for (const auto& e : entries_) {
+      if (set_flags.count(e->key())) continue;
+      if (e->has_default()) {
+        e->SetDefault(head);
+      } else {
+        throw ParamError("required parameter " + e->key() + " of " + name_ +
+                         " is not set\n" + DocString());
+      }
+    }
+    return unknown;
+  }
+
+  std::vector<ParamFieldInfo> GetFieldInfo() const {
+    std::vector<ParamFieldInfo> out;
+    for (const auto& e : entries_) out.push_back(e->GetFieldInfo());
+    return out;
+  }
+
+  std::map<std::string, std::string> GetDict(const void* head) const {
+    std::map<std::string, std::string> out;
+    for (const auto& e : entries_) {
+      out[e->key()] = e->GetStringValue(head);
+    }
+    return out;
+  }
+
+  // reference PrintDocString (parameter.h:541)
+  std::string DocString() const {
+    std::ostringstream os;
+    for (const auto& e : entries_) {
+      ParamFieldInfo info = e->GetFieldInfo();
+      os << info.name << " : " << info.type_info_str << "\n";
+      if (!info.description.empty()) {
+        os << "    " << info.description << "\n";
+      }
+    }
+    return os.str();
+  }
+
+ private:
+  std::string name_;
+  std::vector<std::unique_ptr<FieldAccessEntry>> entries_;
+  std::map<std::string, FieldAccessEntry*> fmap_;  // includes aliases
+};
+
+// Builds the manager once per PType by running __DECLARE__ on a scratch
+// instance (field offsets are recorded relative to it) — reference
+// ParamManagerSingleton, parameter.h:248-257.
+template <typename PType>
+struct ParamManagerSingleton {
+  ParamManager manager;
+  explicit ParamManagerSingleton(const std::string& param_name) {
+    PType param;
+    manager.set_name(param_name);
+    param.__DECLARE__(&manager, &param);
+  }
+};
+
+}  // namespace param
+
+// CRTP base (reference Parameter<PType>, parameter.h:140-223).
+template <typename PType>
+struct Parameter {
+  // Initialize from kwargs; throws ParamError on parse/range/missing
+  // violations. Returns unmatched keys under kAllowUnknown/kAllowHidden.
+  std::vector<std::pair<std::string, std::string>> Init(
+      const std::map<std::string, std::string>& kwargs,
+      ParamInitOption option = ParamInitOption::kAllowUnknown) {
+    return PType::__MANAGER__()->RunInit(static_cast<PType*>(this), kwargs,
+                                         option);
+  }
+
+  std::map<std::string, std::string> __DICT__() const {
+    return PType::__MANAGER__()->GetDict(static_cast<const PType*>(this));
+  }
+
+  static std::vector<ParamFieldInfo> __FIELDS__() {
+    return PType::__MANAGER__()->GetFieldInfo();
+  }
+
+  static std::string __DOC__() {
+    return PType::__MANAGER__()->DocString();
+  }
+
+  // JSON save/load as a {"key": "value"} object (reference parameter.h
+  // :211-223).
+  void Save(JSONWriter* writer) const {
+    writer->Write(__DICT__());
+  }
+
+  void Load(JSONReader* reader) {
+    std::map<std::string, std::string> kwargs;
+    reader->Read(&kwargs);
+    Init(kwargs, ParamInitOption::kAllMatch);
+  }
+};
+
+// Environment access with typed defaults (reference GetEnv/SetEnv,
+// parameter.h:50-61,1122+).
+template <typename T>
+inline T GetEnv(const char* key, T default_value) {
+  const char* v = std::getenv(key);
+  if (v == nullptr || *v == '\0') return default_value;
+  if constexpr (std::is_same_v<T, std::string>) {
+    return std::string(v);
+  } else if constexpr (std::is_same_v<T, bool>) {
+    std::string s(v);
+    return s == "1" || s == "true" || s == "True";
+  } else {
+    const char* end = v + std::char_traits<char>::length(v);
+    const char* q = v;
+    T out{};
+    if (!ParseNum(v, end, &q, &out) || q != end) return default_value;
+    return out;
+  }
+}
+
+inline void SetEnv(const char* key, const std::string& value) {
+  ::setenv(key, value.c_str(), 1);
+}
+
+#define DCT_DECLARE_PARAMETER(PType)                                      \
+  static dct::param::ParamManager* __MANAGER__() {                        \
+    static dct::param::ParamManagerSingleton<PType> inst(#PType);         \
+    return &inst.manager;                                                 \
+  }                                                                       \
+  void __DECLARE__(dct::param::ParamManager* mgr_, PType* self_)
+
+#define DCT_DECLARE_FIELD(FieldName) \
+  mgr_->Declare(self_, #FieldName, self_->FieldName)
+
+#define DCT_DECLARE_ALIAS(FieldName, AliasName) \
+  mgr_->AddAlias(#FieldName, #AliasName)
+
+}  // namespace dct
+
+#endif  // DCT_PARAMETER_H_

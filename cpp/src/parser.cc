@@ -11,6 +11,8 @@
 #include <thread>
 
 #include "numparse.h"
+#include "parameter.h"
+#include "registry.h"
 
 namespace dct {
 
@@ -59,6 +61,69 @@ std::string GetArg(const std::map<std::string, std::string>& args,
 }
 
 }  // namespace
+
+// -- parser parameters (reflection structs, reference LibSVMParserParam
+//    libsvm_parser.h:24-39 / CSVParserParam csv_parser.h:24-55 /
+//    LibFMParserParam libfm_parser.h:24-40) --------------------------------
+struct LibSVMParserParam : public Parameter<LibSVMParserParam> {
+  std::string format;
+  int indexing_mode;
+  DCT_DECLARE_PARAMETER(LibSVMParserParam) {
+    DCT_DECLARE_FIELD(format).set_default("libsvm");
+    DCT_DECLARE_FIELD(indexing_mode)
+        .set_default(0)
+        .add_enum("auto", -1)
+        .add_enum("zero_based", 0)
+        .add_enum("one_based", 1)
+        .describe("0: indices start at 0; 1: start at 1 (converted); "
+                  "-1: heuristic (sklearn-compatible, reference "
+                  "libsvm_parser.h:24-39)");
+  }
+};
+
+struct CSVParserParam : public Parameter<CSVParserParam> {
+  std::string format;
+  int label_column;
+  int weight_column;
+  std::string delimiter;
+  int dtype;
+  DCT_DECLARE_PARAMETER(CSVParserParam) {
+    DCT_DECLARE_FIELD(format).set_default("csv");
+    DCT_DECLARE_FIELD(label_column)
+        .set_default(-1)
+        .set_lower_bound(-1)
+        .describe("column holding the label; -1: no label column");
+    DCT_DECLARE_FIELD(weight_column)
+        .set_default(-1)
+        .set_lower_bound(-1)
+        .describe("column holding the row weight; -1: none");
+    DCT_DECLARE_FIELD(delimiter)
+        .set_default(",")
+        .describe("single-character field delimiter");
+    DCT_DECLARE_FIELD(dtype)
+        .set_default(0)
+        .set_range(0, 2)
+        .add_enum("float32", 0)
+        .add_enum("int32", 1)
+        .add_enum("int64", 2)
+        .describe("value dtype (reference csv_parser.h DType)");
+  }
+};
+
+struct LibFMParserParam : public Parameter<LibFMParserParam> {
+  std::string format;
+  int indexing_mode;
+  DCT_DECLARE_PARAMETER(LibFMParserParam) {
+    DCT_DECLARE_FIELD(format).set_default("libfm");
+    DCT_DECLARE_FIELD(indexing_mode)
+        .set_default(0)
+        .add_enum("auto", -1)
+        .add_enum("zero_based", 0)
+        .add_enum("one_based", 1)
+        .describe("indexing heuristic over field and feature ids "
+                  "(reference libfm_parser.h:24-40)");
+  }
+};
 
 // --------------------------------------------------------------------------
 template <typename IndexType>
@@ -164,9 +229,10 @@ LibSVMParser<IndexType>::LibSVMParser(
     InputSplit* source, const std::map<std::string, std::string>& args,
     int nthread)
     : TextParserBase<IndexType>(source, nthread) {
-  std::string fmt = GetArg(args, "format", "libsvm");
-  DCT_CHECK_EQ(fmt, std::string("libsvm")) << "format mismatch";
-  indexing_mode_ = std::stoi(GetArg(args, "indexing_mode", "0"));
+  LibSVMParserParam param;
+  param.Init(args, ParamInitOption::kAllowUnknown);
+  DCT_CHECK_EQ(param.format, std::string("libsvm")) << "format mismatch";
+  indexing_mode_ = param.indexing_mode;
 }
 
 // reference src/data/libsvm_parser.h:87-169
@@ -229,26 +295,19 @@ CSVParser<IndexType>::CSVParser(InputSplit* source,
                                 const std::map<std::string, std::string>& args,
                                 int nthread)
     : TextParserBase<IndexType>(source, nthread) {
-  std::string fmt = GetArg(args, "format", "csv");
-  DCT_CHECK_EQ(fmt, std::string("csv")) << "format mismatch";
-  label_column_ = std::stoi(GetArg(args, "label_column", "-1"));
-  weight_column_ = std::stoi(GetArg(args, "weight_column", "-1"));
-  std::string delim = GetArg(args, "delimiter", ",");
-  DCT_CHECK_EQ(delim.size(), size_t(1)) << "delimiter must be a single char";
-  delimiter_ = delim[0];
+  CSVParserParam param;
+  param.Init(args, ParamInitOption::kAllowUnknown);
+  DCT_CHECK_EQ(param.format, std::string("csv")) << "format mismatch";
+  label_column_ = param.label_column;
+  weight_column_ = param.weight_column;
+  DCT_CHECK_EQ(param.delimiter.size(), size_t(1))
+      << "delimiter must be a single char";
+  delimiter_ = param.delimiter[0];
   DCT_CHECK(label_column_ != weight_column_ || label_column_ < 0)
       << "label and weight columns must differ";
-  std::string dtype = GetArg(args, "dtype", "float32");
-  // typed values (reference csv_parser.h:24-147 DType float32/int32/int64)
-  if (dtype == "float32") {
-    value_dtype_ = 0;
-  } else if (dtype == "int32") {
-    value_dtype_ = 1;
-  } else if (dtype == "int64") {
-    value_dtype_ = 2;
-  } else {
-    throw Error("csv dtype must be float32|int32|int64, got " + dtype);
-  }
+  // typed values (reference csv_parser.h:24-147 DType float32/int32/int64);
+  // the enum mapping (string -> code) happens in CSVParserParam::Init
+  value_dtype_ = param.dtype;
 }
 
 namespace {
@@ -326,9 +385,10 @@ LibFMParser<IndexType>::LibFMParser(
     InputSplit* source, const std::map<std::string, std::string>& args,
     int nthread)
     : TextParserBase<IndexType>(source, nthread) {
-  std::string fmt = GetArg(args, "format", "libfm");
-  DCT_CHECK_EQ(fmt, std::string("libfm")) << "format mismatch";
-  indexing_mode_ = std::stoi(GetArg(args, "indexing_mode", "0"));
+  LibFMParserParam param;
+  param.Init(args, ParamInitOption::kAllowUnknown);
+  DCT_CHECK_EQ(param.format, std::string("libfm")) << "format mismatch";
+  indexing_mode_ = param.indexing_mode;
 }
 
 // reference src/data/libfm_parser.h:67-144
@@ -574,20 +634,17 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
   // DiskCacheParser below caches the *parsed* data, and double-caching
   // would write the dataset to disk twice (reference disk_row_iter caches
   // only row blocks too)
+  ParserFactoryReg<IndexType>* entry =
+      Registry<ParserFactoryReg<IndexType>>::Get()->Find(fmt);
+  if (entry == nullptr) {
+    throw Error("unknown data format: " + fmt);
+  }
   InputSplit* split = InputSplit::Create(spec.uri, part, npart, "text", "",
                                          false, 0, 256, false,
                                          /*threaded=*/true, "");
-  TextParserBase<IndexType>* parser;
-  if (fmt == "libsvm") {
-    parser = new LibSVMParser<IndexType>(split, args, nthread);
-  } else if (fmt == "csv") {
-    parser = new CSVParser<IndexType>(split, args, nthread);
-  } else if (fmt == "libfm") {
-    parser = new LibFMParser<IndexType>(split, args, nthread);
-  } else {
-    delete split;
-    throw Error("unknown data format: " + fmt);
-  }
+  // ownership of split passes into the parser's base immediately; a throwing
+  // constructor body unwinds through the already-built base, which frees it
+  TextParserBase<IndexType>* parser = entry->body(split, args, nthread);
   Parser<IndexType>* out =
       threaded ? static_cast<Parser<IndexType>*>(
                      new ThreadedParser<IndexType>(parser, 8))
@@ -618,5 +675,42 @@ template class DiskCacheParser<uint32_t>;
 template class DiskCacheParser<uint64_t>;
 template class Parser<uint32_t>;
 template class Parser<uint64_t>;
+
+// -- format registrations (reference DMLC_REGISTER_DATA_PARSER instantiated
+//    for both index widths, data.cc:224-256) ------------------------------
+namespace {
+
+template <typename IndexType>
+void RegisterBuiltinParsers() {
+  using Map = std::map<std::string, std::string>;
+  auto* reg = Registry<ParserFactoryReg<IndexType>>::Get();
+  reg->__REGISTER__("libsvm")
+      .describe("sparse `label[:weight] [qid:n] index[:value]...` text rows")
+      .add_arguments(LibSVMParserParam::__FIELDS__())
+      .set_body([](InputSplit* s, const Map& args, int nthread) {
+        return new LibSVMParser<IndexType>(s, args, nthread);
+      });
+  reg->__REGISTER__("csv")
+      .describe("dense delimited rows; label/weight columns, typed values")
+      .add_arguments(CSVParserParam::__FIELDS__())
+      .set_body([](InputSplit* s, const Map& args, int nthread) {
+        return new CSVParser<IndexType>(s, args, nthread);
+      });
+  reg->__REGISTER__("libfm")
+      .describe("`label[:weight] field:feature:value...` factorization rows")
+      .add_arguments(LibFMParserParam::__FIELDS__())
+      .set_body([](InputSplit* s, const Map& args, int nthread) {
+        return new LibFMParser<IndexType>(s, args, nthread);
+      });
+}
+
+struct BuiltinParserRegistrar {
+  BuiltinParserRegistrar() {
+    RegisterBuiltinParsers<uint32_t>();
+    RegisterBuiltinParsers<uint64_t>();
+  }
+} builtin_parser_registrar;
+
+}  // namespace
 
 }  // namespace dct

@@ -18,9 +18,25 @@
 #include <vector>
 
 #include "input_split.h"
+#include "registry.h"
 #include "rowblock.h"
 
 namespace dct {
+
+template <typename IndexType>
+class TextParserBase;
+
+// Parser factory registry entry (reference ParserFactoryReg +
+// DMLC_REGISTER_DATA_PARSER, data.h:330-358): formats resolve by name
+// through Registry<ParserFactoryReg<I>> so downstream code can register
+// additional native formats.
+template <typename IndexType>
+struct ParserFactoryReg
+    : public FunctionRegEntryBase<
+          ParserFactoryReg<IndexType>,
+          std::function<TextParserBase<IndexType>*(
+              InputSplit*, const std::map<std::string, std::string>&, int)>> {
+};
 
 template <typename IndexType>
 class Parser {

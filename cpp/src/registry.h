@@ -1,0 +1,113 @@
+// Name → factory-entry singleton registries.
+//
+// Counterpart of reference include/dmlc/registry.h (310 L): a per-EntryType
+// global map with __REGISTER__/Find/ListAllNames, and FunctionRegEntryBase
+// carrying description + typed argument metadata (ParamFieldInfo). The
+// reference's DMLC_REGISTRY_FILE_TAG/LINK_TAG static-link rescue machinery
+// is dropped: this core always builds as one shared object, so registration
+// order is a non-problem.
+#ifndef DCT_REGISTRY_H_
+#define DCT_REGISTRY_H_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.h"
+#include "parameter.h"
+
+namespace dct {
+
+template <typename EntryType>
+class Registry {
+ public:
+  static Registry* Get() {
+    static Registry inst;
+    return &inst;
+  }
+
+  // Register (or fetch for further chaining) the entry under `name`
+  // (reference __REGISTER__, registry.h:78).
+  EntryType& __REGISTER__(const std::string& name) {
+    auto it = entries_.find(name);
+    DCT_CHECK(it == entries_.end())
+        << "registry entry " << name << " already registered";
+    auto e = std::make_unique<EntryType>();
+    e->name = name;
+    EntryType* raw = e.get();
+    entries_[name] = std::move(e);
+    names_.push_back(name);
+    return *raw;
+  }
+
+  EntryType& __REGISTER_OR_GET__(const std::string& name) {
+    auto it = entries_.find(name);
+    if (it != entries_.end()) return *it->second;
+    return __REGISTER__(name);
+  }
+
+  // reference Registry::Find (registry.h:48-56) — nullptr when absent.
+  EntryType* Find(const std::string& name) const {
+    auto it = entries_.find(name);
+    return it == entries_.end() ? nullptr : it->second.get();
+  }
+
+  std::vector<std::string> ListAllNames() const { return names_; }
+
+ private:
+  Registry() = default;
+  std::map<std::string, std::unique_ptr<EntryType>> entries_;
+  std::vector<std::string> names_;  // registration order
+};
+
+// Common base for function-style registry entries (reference
+// FunctionRegEntryBase, registry.h:150-226).
+template <typename EntryType, typename FunctionType>
+struct FunctionRegEntryBase {
+  std::string name;
+  std::string description;
+  std::vector<ParamFieldInfo> arguments;
+  FunctionType body;
+  std::string return_type;
+
+  EntryType& set_body(FunctionType f) {
+    body = f;
+    return Self();
+  }
+  EntryType& describe(const std::string& d) {
+    description = d;
+    return Self();
+  }
+  EntryType& add_argument(const std::string& aname, const std::string& type,
+                          const std::string& desc) {
+    ParamFieldInfo info;
+    info.name = aname;
+    info.type = type;
+    info.type_info_str = type;
+    info.description = desc;
+    arguments.push_back(info);
+    return Self();
+  }
+  EntryType& add_arguments(const std::vector<ParamFieldInfo>& args) {
+    arguments.insert(arguments.end(), args.begin(), args.end());
+    return Self();
+  }
+  EntryType& set_return_type(const std::string& t) {
+    return_type = t;
+    return Self();
+  }
+
+ protected:
+  EntryType& Self() { return *static_cast<EntryType*>(this); }
+};
+
+// Static-registration helper (reference DMLC_REGISTRY_REGISTER):
+//   DCT_REGISTRY_REGISTER(ParserFactoryReg, parser, libsvm).set_body(...);
+#define DCT_REGISTRY_REGISTER(EntryType, TypeName, Name)                  \
+  static EntryType& __make_##TypeName##_##Name##__ [[maybe_unused]] =     \
+      ::dct::Registry<EntryType>::Get()->__REGISTER__(#Name)
+
+}  // namespace dct
+
+#endif  // DCT_REGISTRY_H_

@@ -17,11 +17,15 @@
 #include <vector>
 
 #include "../src/concurrency.h"
+#include "../src/config.h"
 #include "../src/pipeline.h"
 #include "../src/filesys.h"
 #include "../src/input_split.h"
 #include "../src/iostream_bridge.h"
 #include "../src/json.h"
+#include "../src/parameter.h"
+#include "../src/parser.h"
+#include "../src/registry.h"
 #include "../src/serializer.h"
 #include "../src/stream.h"
 
@@ -322,6 +326,196 @@ void TestPipelineExceptionPropagation() {
   EXPECT((got == std::vector<int>{0, 1, 10, 11}));
 }
 
+// -- parameter / registry / config (reference parameter.h, registry.h,
+//    config.h; gtest counterparts unittest_param.cc, registry_test.cc,
+//    unittest_config.cc) ----------------------------------------------------
+struct TestParam : public dct::Parameter<TestParam> {
+  int num_hidden;
+  float learning_rate;
+  std::string name;
+  bool shuffle;
+  int act;
+  DCT_DECLARE_PARAMETER(TestParam) {
+    DCT_DECLARE_FIELD(num_hidden).set_range(0, 1000)
+        .describe("hidden units");
+    DCT_DECLARE_FIELD(learning_rate).set_default(0.01f)
+        .set_lower_bound(0.0f);
+    DCT_DECLARE_FIELD(name).set_default("mlp");
+    DCT_DECLARE_FIELD(shuffle).set_default(false);
+    DCT_DECLARE_FIELD(act).set_default(0)
+        .add_enum("relu", 0).add_enum("tanh", 1);
+    DCT_DECLARE_ALIAS(num_hidden, nhid);
+  }
+};
+
+void TestParameter() {
+  TestParam p;
+  // keyword init + defaults + alias
+  auto rest = p.Init({{"nhid", "64"}, {"act", "tanh"}, {"extra", "x"}});
+  EXPECT(p.num_hidden == 64);
+  EXPECT(p.act == 1);
+  EXPECT(p.learning_rate == 0.01f);
+  EXPECT(p.name == "mlp");
+  EXPECT(!p.shuffle);
+  EXPECT(rest.size() == 1 && rest[0].first == "extra");
+  // bools and enum render-back in __DICT__
+  auto d = p.__DICT__();
+  EXPECT(d.at("act") == "tanh");
+  EXPECT(d.at("shuffle") == "false");
+  EXPECT(d.at("num_hidden") == "64");
+  // required missing
+  bool threw = false;
+  try {
+    TestParam q;
+    q.Init({});
+  } catch (const dct::ParamError& e) {
+    threw = std::string(e.what()).find("num_hidden") != std::string::npos;
+  }
+  EXPECT(threw);
+  // range violation
+  threw = false;
+  try {
+    TestParam q;
+    q.Init({{"num_hidden", "5000"}});
+  } catch (const dct::ParamError&) {
+    threw = true;
+  }
+  EXPECT(threw);
+  // bad enum string
+  threw = false;
+  try {
+    TestParam q;
+    q.Init({{"num_hidden", "1"}, {"act", "gelu"}});
+  } catch (const dct::ParamError&) {
+    threw = true;
+  }
+  EXPECT(threw);
+  // kAllMatch rejects unknown keys
+  threw = false;
+  try {
+    TestParam q;
+    q.Init({{"num_hidden", "1"}, {"mystery", "1"}},
+           dct::ParamInitOption::kAllMatch);
+  } catch (const dct::ParamError&) {
+    threw = true;
+  }
+  EXPECT(threw);
+  // kAllowHidden: underscore keys pass, others throw
+  TestParam h;
+  h.Init({{"num_hidden", "1"}, {"_hidden", "1"}},
+         dct::ParamInitOption::kAllowHidden);
+  // docstring mentions fields and ranges
+  std::string doc = TestParam::__DOC__();
+  EXPECT(doc.find("num_hidden") != std::string::npos);
+  EXPECT(doc.find("required") != std::string::npos);
+  EXPECT(doc.find("'relu'") != std::string::npos);
+  // JSON round trip
+  std::ostringstream os;
+  dct::JSONWriter w(&os);
+  p.Save(&w);
+  TestParam r;
+  std::istringstream is(os.str());
+  dct::JSONReader jr(&is);
+  r.Load(&jr);
+  EXPECT(r.num_hidden == 64 && r.act == 1 && r.name == "mlp");
+  // GetEnv typed defaults
+  ::setenv("DCT_TEST_ENV_INT", "42", 1);
+  EXPECT(dct::GetEnv("DCT_TEST_ENV_INT", 7) == 42);
+  EXPECT(dct::GetEnv("DCT_TEST_ENV_ABSENT", 7) == 7);
+  EXPECT(dct::GetEnv<std::string>("DCT_TEST_ENV_ABSENT", "d") == "d");
+}
+
+struct ToyReg
+    : public dct::FunctionRegEntryBase<ToyReg, std::function<int(int)>> {};
+
+void TestRegistry() {
+  auto* reg = dct::Registry<ToyReg>::Get();
+  reg->__REGISTER__("double")
+      .describe("doubles the input")
+      .add_argument("x", "int", "the input")
+      .set_body([](int x) { return 2 * x; });
+  reg->__REGISTER_OR_GET__("double");  // no duplicate
+  const ToyReg* e = reg->Find("double");
+  EXPECT(e != nullptr);
+  EXPECT(e->body(21) == 42);
+  EXPECT(e->description == "doubles the input");
+  EXPECT(e->arguments.size() == 1 && e->arguments[0].name == "x");
+  EXPECT(reg->Find("absent") == nullptr);
+  EXPECT(reg->ListAllNames().size() == 1);
+  // the built-in parsers registered themselves (libsvm/csv/libfm)
+  auto* preg = dct::Registry<dct::ParserFactoryReg<uint32_t>>::Get();
+  EXPECT(preg->Find("libsvm") != nullptr);
+  EXPECT(preg->Find("csv") != nullptr);
+  EXPECT(preg->Find("libfm") != nullptr);
+  EXPECT(!preg->Find("csv")->arguments.empty());
+}
+
+void TestConfig() {
+  std::string text =
+      "# a comment\n"
+      "learning_rate = 0.1\n"
+      "name = \"quoted # not comment\"\n"
+      "layers = 2  # trailing comment\n"
+      "layers = 3\n"
+      "msg = \"line\\nbreak\\t\\\"q\\\"\"\n";
+  dct::Config cfg;
+  cfg.LoadFromText(text);
+  EXPECT(cfg.GetParam("learning_rate") == "0.1");
+  EXPECT(cfg.GetParam("name") == "quoted # not comment");
+  EXPECT(cfg.GetParam("layers") == "3");  // later wins
+  EXPECT(cfg.GetParam("msg") == "line\nbreak\t\"q\"");
+  EXPECT(cfg.IsString("name"));
+  EXPECT(!cfg.IsString("layers"));
+  EXPECT(cfg.Contains("name") && !cfg.Contains("ghost"));
+  bool threw = false;
+  try {
+    cfg.GetParam("ghost");
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  EXPECT(threw);
+  // multi-value mode keeps duplicates in order
+  dct::Config multi(true);
+  multi.LoadFromText("k = 1\nk = 2\nother = x\n");
+  auto all = multi.GetAll("k");
+  EXPECT(all.size() == 2 && all[0] == "1" && all[1] == "2");
+  EXPECT(multi.items().size() == 3);
+  // proto rendering quotes strings and escapes
+  std::string proto = cfg.ToProtoString();
+  EXPECT(proto.find("learning_rate : 0.1") != std::string::npos);
+  EXPECT(proto.find("name : \"quoted # not comment\"") != std::string::npos);
+  EXPECT(proto.find("\\n") != std::string::npos);
+  // round trip: proto-ish `key = value` reload
+  dct::Config cfg2;
+  cfg2.LoadFromText("a = 1\nb = \"two\"\n");
+  EXPECT(cfg2.GetParam("b") == "two");
+  // trailing literal backslash before the closing quote (\\") must close
+  // the quote, and the comment after it must be stripped
+  dct::Config cfg3;
+  cfg3.LoadFromText("msg = \"a\\\\\" # comment\n");
+  EXPECT(cfg3.GetParam("msg") == "a\\");
+  EXPECT(cfg3.IsString("msg"));
+  // multi-value proto rendering quotes per occurrence, not per key
+  dct::Config multi2(true);
+  multi2.LoadFromText("k = 1\nk = \"two\"\n");
+  std::string p2 = multi2.ToProtoString();
+  EXPECT(p2.find("k : 1\n") != std::string::npos);
+  EXPECT(p2.find("k : \"two\"\n") != std::string::npos);
+}
+
+struct FloatParam : public dct::Parameter<FloatParam> {
+  float lr;
+  DCT_DECLARE_PARAMETER(FloatParam) { DCT_DECLARE_FIELD(lr); }
+};
+
+void TestParameterFloatRoundTrip() {
+  FloatParam p;
+  p.Init({{"lr", "1.0000001"}});
+  FloatParam q;
+  q.Init(p.__DICT__());
+  EXPECT(q.lr == p.lr);  // full max_digits10 precision in __DICT__
+}
+
 void TestStdinSplit() {
   // only run when the harness pipes data in (argv gate in main)
   dct::SingleFileSplit split("stdin");
@@ -349,6 +543,10 @@ int main(int argc, char** argv) {
   TestConcurrentQueue();
   TestThreadGroup();
   TestPipelineExceptionPropagation();
+  TestParameter();
+  TestParameterFloatRoundTrip();
+  TestRegistry();
+  TestConfig();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;
