@@ -112,7 +112,14 @@ int dct_stream_write(dct_stream_t h, const void* buf, size_t size) {
 }
 
 int dct_stream_free(dct_stream_t h) {
-  return Guard([&] { delete static_cast<dct::Stream*>(h); });
+  // Finish() first so buffered-write failures reach the caller; the
+  // destructor's own Finish is a no-op afterwards (finished_ latch), so the
+  // handle is freed even on error.
+  auto* s = static_cast<dct::Stream*>(h);
+  if (s == nullptr) return 0;
+  int rc = Guard([&] { s->Finish(); });
+  delete s;
+  return rc;
 }
 
 // ------------------------------------------------------------- filesystem --

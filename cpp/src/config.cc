@@ -65,6 +65,7 @@ void Config::Clear() {
   order_.clear();
   index_.clear();
   is_string_.clear();
+  entry_is_string_.clear();
 }
 
 void Config::LoadFromText(const std::string& text) {

@@ -1,0 +1,449 @@
+// WebHDFS filesystem implementation (see hdfs_filesys.h for provenance).
+#include "hdfs_filesys.h"
+
+#include <unistd.h>
+
+#include <cstdlib>
+#include <memory>
+#include <sstream>
+
+#include "http.h"
+#include "json.h"
+#include "s3_filesys.h"  // s3::UriEncode (RFC 3986 percent-encoding)
+
+namespace dct {
+namespace webhdfs {
+
+// "host", "host:port", or "[v6literal]:port" -> (host, port). A bare IPv6
+// literal (more than one ':' and no brackets) is never split; the bracketed
+// form carries the port after the closing ']'.
+void SplitHostPort(const std::string& s, std::string* host, int* port,
+                   int default_port) {
+  *host = s;
+  *port = default_port;
+  if (!s.empty() && s.front() == '[') {
+    size_t close = s.find(']');
+    DCT_CHECK(close != std::string::npos) << "unterminated [v6] host: " << s;
+    *host = s.substr(1, close - 1);
+    if (close + 1 < s.size() && s[close + 1] == ':') {
+      *port = std::atoi(s.c_str() + close + 2);
+    }
+    return;
+  }
+  size_t colon = s.find(':');
+  if (colon == std::string::npos || s.rfind(':') != colon) {
+    return;  // no port, or bare IPv6 literal
+  }
+  bool digits = colon + 1 < s.size();
+  for (size_t i = colon + 1; i < s.size(); ++i) {
+    if (!isdigit(static_cast<unsigned char>(s[i]))) digits = false;
+  }
+  if (digits) {
+    *host = s.substr(0, colon);
+    *port = std::atoi(s.c_str() + colon + 1);
+  }
+}
+
+HttpUrl ParseHttpUrl(const std::string& url) {
+  HttpUrl out;
+  size_t scheme = url.find("://");
+  DCT_CHECK(scheme != std::string::npos && url.compare(0, scheme, "http") == 0)
+      << "webhdfs redirect must be an http url, got " << url;
+  size_t body = scheme + 3;
+  size_t slash = url.find('/', body);
+  std::string hostport =
+      slash == std::string::npos ? url.substr(body)
+                                 : url.substr(body, slash - body);
+  out.path_query = slash == std::string::npos ? "/" : url.substr(slash);
+  SplitHostPort(hostport, &out.host, &out.port, 80);
+  return out;
+}
+
+namespace {
+
+// A definitive HTTP status (4xx) — retrying cannot help, unlike transport
+// drops or 5xx, so the read loop rethrows these immediately.
+struct PermanentError : Error {
+  using Error::Error;
+};
+
+struct Target {
+  std::string host;
+  int port;
+};
+
+// Resolve namenode from URI host ("hdfs://host:port/...") falling back to
+// the configured default (reference hdfs_filesys GetInstance namenode arg).
+Target ResolveTarget(const WebHdfsConfig& cfg, const URI& uri) {
+  Target t{cfg.namenode_host, cfg.namenode_port};
+  if (!uri.host.empty()) {
+    webhdfs::SplitHostPort(uri.host, &t.host, &t.port, cfg.namenode_port);
+  }
+  DCT_CHECK(!t.host.empty())
+      << "hdfs uri has no host and WEBHDFS_NAMENODE is unset: " << uri.Str();
+  return t;
+}
+
+// /webhdfs/v1<path>?op=<OP>&user.name=<u>&<extra...>
+std::string OpPath(const WebHdfsConfig& cfg, const std::string& path,
+                   const std::string& op, const std::string& extra) {
+  std::string p = path.empty() ? "/" : path;
+  std::string out = "/webhdfs/v1" + s3::UriEncode(p, true) + "?op=" + op;
+  if (!cfg.user.empty()) out += "&user.name=" + s3::UriEncode(cfg.user, false);
+  if (!extra.empty()) out += "&" + extra;
+  return out;
+}
+
+// One FileStatus JSON object -> FileInfo (caller fixes .path for listings).
+void ReadFileStatus(JSONReader* reader, FileInfo* info,
+                    std::string* path_suffix) {
+  std::string key;
+  reader->BeginObject();
+  while (reader->NextObjectItem(&key)) {
+    if (key == "length") {
+      double v = 0;
+      reader->ReadNumber(&v);
+      info->size = static_cast<size_t>(v);
+    } else if (key == "type") {
+      std::string t;
+      reader->ReadString(&t);
+      info->type = t == "DIRECTORY" ? FileType::kDirectory : FileType::kFile;
+    } else if (key == "pathSuffix") {
+      reader->ReadString(path_suffix);
+    } else {
+      reader->SkipValue();
+    }
+  }
+}
+
+// Raise a readable error from a non-2xx WebHDFS response (RemoteException
+// JSON body when present).
+void CheckStatus(const HttpResponse& resp, int expect, const char* what,
+                 const URI& uri) {
+  if (resp.status == expect) return;
+  throw Error(std::string("webhdfs ") + what + " " + uri.Str() +
+              " failed with status " + std::to_string(resp.status) + ": " +
+              resp.body);
+}
+
+// ---------------------------------------------------------------- reading --
+// Ranged reader: each (re)connect issues OPEN with the current offset; the
+// namenode 307-redirects to a datanode which streams the rest of the file.
+// Reconnect-at-offset on failure mirrors the S3 read retry loop (and the
+// reference's s3_filesys.cc:522-546 semantics; libhdfs hdfsSeek maps to the
+// offset= parameter here).
+class WebHdfsReadStream : public SeekStream {
+ public:
+  WebHdfsReadStream(const WebHdfsConfig& cfg, const Target& target,
+                    const URI& uri, size_t file_size)
+      : cfg_(cfg), target_(target), uri_(uri), file_size_(file_size) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    if (pos_ >= file_size_ || size == 0) return 0;
+    int attempts = 0;
+    while (true) {
+      try {
+        if (conn_ == nullptr) Connect();
+        size_t n = conn_->ReadBody(ptr, size);
+        if (n == 0 && pos_ < file_size_) {
+          throw Error("short read from webhdfs stream");
+        }
+        pos_ += n;
+        return n;
+      } catch (const PermanentError&) {
+        conn_.reset();
+        throw;
+      } catch (const Error&) {
+        conn_.reset();
+        if (++attempts > cfg_.max_retry) throw;
+        usleep(cfg_.retry_sleep_ms * 1000);
+      }
+    }
+  }
+
+  size_t Write(const void*, size_t) override {
+    throw Error("WebHdfsReadStream is read-only");
+  }
+
+  void Seek(size_t pos) override {
+    if (pos != pos_) {
+      conn_.reset();
+      pos_ = pos;
+    }
+  }
+
+  size_t Tell() override { return pos_; }
+
+ private:
+  void Connect() {
+    std::string path =
+        OpPath(cfg_, uri_.path, "OPEN", "offset=" + std::to_string(pos_));
+    std::string host = target_.host;
+    int port = target_.port;
+    // follow namenode -> datanode redirects (bounded; gateways may serve
+    // the body directly with 200)
+    for (int hop = 0; hop < 5; ++hop) {
+      conn_.reset(new HttpConnection(host, port));
+      conn_->SendRequest("GET", path, {}, "");
+      HttpResponse head;
+      conn_->ReadResponseHead(&head);
+      if (head.status == 200 || head.status == 206) return;
+      if (head.status == 307 || head.status == 302) {
+        auto it = head.headers.find("location");
+        DCT_CHECK(it != head.headers.end())
+            << "webhdfs redirect without Location header";
+        conn_->ReadFullBody(&head);  // drain before reconnecting
+        webhdfs::HttpUrl next = webhdfs::ParseHttpUrl(it->second);
+        host = next.host;
+        port = next.port;
+        path = next.path_query;
+        continue;
+      }
+      conn_->ReadFullBody(&head);
+      conn_.reset();
+      std::string msg = "webhdfs OPEN " + uri_.Str() +
+                        " failed with status " +
+                        std::to_string(head.status) + ": " + head.body;
+      // 4xx is definitive, except request-timeout/throttling which the
+      // reconnect budget exists for
+      if (head.status >= 400 && head.status < 500 && head.status != 408 &&
+          head.status != 429) {
+        throw PermanentError(msg);
+      }
+      throw Error(msg);
+    }
+    throw Error("webhdfs OPEN " + uri_.Str() + ": too many redirects");
+  }
+
+  WebHdfsConfig cfg_;
+  Target target_;
+  URI uri_;
+  size_t file_size_;
+  size_t pos_ = 0;
+  std::unique_ptr<HttpConnection> conn_;
+};
+
+// ---------------------------------------------------------------- writing --
+// Buffered writer: first flush CREATEs the file (overwrite), later flushes
+// APPEND; both follow the namenode's redirect to a datanode. The libhdfs
+// write path the reference wraps is likewise create-then-stream. Mode "a"
+// starts in APPEND when the file already exists (`append_to_existing`).
+class WebHdfsWriteStream : public Stream {
+ public:
+  static constexpr size_t kFlushSize = 8 << 20;
+
+  WebHdfsWriteStream(const WebHdfsConfig& cfg, const Target& target,
+                     const URI& uri, bool append_to_existing = false)
+      : cfg_(cfg), target_(target), uri_(uri),
+        created_(append_to_existing) {}
+
+  ~WebHdfsWriteStream() override {
+    try {
+      Finish();
+    } catch (...) {
+      // destructor must not throw; errors surface on explicit Finish
+    }
+  }
+
+  size_t Read(void*, size_t) override {
+    throw Error("WebHdfsWriteStream is write-only");
+  }
+
+  size_t Write(const void* ptr, size_t size) override {
+    buffer_.append(static_cast<const char*>(ptr), size);
+    while (buffer_.size() >= kFlushSize) Flush(kFlushSize);
+    return size;
+  }
+
+  void Finish() override {
+    if (finished_) return;
+    finished_ = true;
+    if (!buffer_.empty() || !created_) Flush(buffer_.size());
+  }
+
+ private:
+  void Flush(size_t size) {
+    std::string part;
+    if (size == buffer_.size()) {
+      part.swap(buffer_);
+    } else {
+      part = buffer_.substr(0, size);
+      buffer_.erase(0, size);
+    }
+    const char* method = created_ ? "POST" : "PUT";
+    std::string op_extra = created_ ? std::string("APPEND")
+                                    : std::string("CREATE");
+    std::string extra = created_ ? "" : "overwrite=true";
+    std::string path = OpPath(cfg_, uri_.path, op_extra, extra);
+    // step 1: namenode; expect redirect to a datanode (send no body, per
+    // the WebHDFS two-step protocol)
+    HttpResponse head = HttpRequest(target_.host, target_.port, method, path,
+                                    {}, "");
+    if (head.status == 307 || head.status == 302) {
+      auto it = head.headers.find("location");
+      DCT_CHECK(it != head.headers.end())
+          << "webhdfs redirect without Location header";
+      webhdfs::HttpUrl next = webhdfs::ParseHttpUrl(it->second);
+      head = HttpRequest(next.host, next.port, method, next.path_query, {},
+                         part);
+    } else if (head.status >= 200 && head.status < 300 && !part.empty()) {
+      // One-step gateway (HttpFS style): the empty step-1 request was
+      // accepted directly, so the payload was never transmitted. Re-send
+      // with the body: CREATE&overwrite=true is idempotent and the empty
+      // APPEND appended nothing, so exactly one copy of `part` lands.
+      head = HttpRequest(target_.host, target_.port, method, path, {}, part);
+    }
+    CheckStatus(head, created_ ? 200 : 201,
+                created_ ? "APPEND" : "CREATE", uri_);
+    created_ = true;
+  }
+
+  WebHdfsConfig cfg_;
+  Target target_;
+  URI uri_;
+  std::string buffer_;
+  bool created_ = false;
+  bool finished_ = false;
+};
+
+}  // namespace
+}  // namespace webhdfs
+
+// ----------------------------------------------------------------- config --
+WebHdfsConfig WebHdfsConfig::FromEnv() {
+  WebHdfsConfig cfg;
+  const char* nn = std::getenv("WEBHDFS_NAMENODE");
+  if (nn != nullptr && *nn != '\0') {
+    std::string s = nn;
+    size_t scheme = s.find("://");
+    if (scheme != std::string::npos) s = s.substr(scheme + 3);
+    webhdfs::SplitHostPort(s, &cfg.namenode_host, &cfg.namenode_port,
+                           cfg.namenode_port);
+  }
+  const char* user = std::getenv("HADOOP_USER_NAME");
+  if (user == nullptr || *user == '\0') user = std::getenv("USER");
+  if (user != nullptr) cfg.user = user;
+  return cfg;
+}
+
+WebHdfsFileSystem* WebHdfsFileSystem::GetInstance() {
+  static WebHdfsFileSystem inst(WebHdfsConfig::FromEnv());
+  return &inst;
+}
+
+FileInfo WebHdfsFileSystem::GetPathInfo(const URI& path) {
+  webhdfs::Target t = webhdfs::ResolveTarget(config_, path);
+  std::string p = webhdfs::OpPath(config_, path.path, "GETFILESTATUS", "");
+  HttpResponse resp = HttpRequest(t.host, t.port, "GET", p, {}, "");
+  webhdfs::CheckStatus(resp, 200, "GETFILESTATUS", path);
+  FileInfo info;
+  info.path = path;
+  std::istringstream body(resp.body);
+  JSONReader reader(&body);
+  std::string key, suffix;
+  reader.BeginObject();
+  while (reader.NextObjectItem(&key)) {
+    if (key == "FileStatus") {
+      webhdfs::ReadFileStatus(&reader, &info, &suffix);
+    } else {
+      reader.SkipValue();
+    }
+  }
+  return info;
+}
+
+void WebHdfsFileSystem::ListDirectory(const URI& path,
+                                      std::vector<FileInfo>* out) {
+  webhdfs::Target t = webhdfs::ResolveTarget(config_, path);
+  std::string p = webhdfs::OpPath(config_, path.path, "LISTSTATUS", "");
+  HttpResponse resp = HttpRequest(t.host, t.port, "GET", p, {}, "");
+  webhdfs::CheckStatus(resp, 200, "LISTSTATUS", path);
+  std::string dir = path.path.empty() ? "/" : path.path;
+  if (dir.back() != '/') dir += '/';
+  std::istringstream body(resp.body);
+  JSONReader reader(&body);
+  std::string key;
+  reader.BeginObject();
+  while (reader.NextObjectItem(&key)) {
+    if (key != "FileStatuses") {
+      reader.SkipValue();
+      continue;
+    }
+    reader.BeginObject();
+    while (reader.NextObjectItem(&key)) {
+      if (key != "FileStatus") {
+        reader.SkipValue();
+        continue;
+      }
+      reader.BeginArray();
+      while (reader.NextArrayItem()) {
+        FileInfo info;
+        std::string suffix;
+        webhdfs::ReadFileStatus(&reader, &info, &suffix);
+        info.path = path;
+        // LISTSTATUS of a *file* returns one entry with empty pathSuffix
+        // meaning the path itself — no trailing slash in that case
+        info.path.path = suffix.empty()
+                             ? (path.path.empty() ? "/" : path.path)
+                             : dir + suffix;
+        out->push_back(info);
+      }
+    }
+  }
+}
+
+SeekStream* WebHdfsFileSystem::OpenForRead(const URI& path, bool allow_null) {
+  try {
+    FileInfo info = GetPathInfo(path);
+    DCT_CHECK(info.type == FileType::kFile)
+        << "cannot open hdfs directory for read: " << path.Str();
+    webhdfs::Target t = webhdfs::ResolveTarget(config_, path);
+    return new webhdfs::WebHdfsReadStream(config_, t, path, info.size);
+  } catch (const Error&) {
+    if (allow_null) return nullptr;
+    throw;
+  }
+}
+
+Stream* WebHdfsFileSystem::Open(const URI& path, const char* mode,
+                                bool allow_null) {
+  std::string m = mode;
+  if (m.find('r') != std::string::npos) return OpenForRead(path, allow_null);
+  bool append = m.find('a') != std::string::npos;
+  DCT_CHECK(m.find('w') != std::string::npos || append)
+      << "hdfs supports modes r|w|a, got " << mode;
+  webhdfs::Target t = webhdfs::ResolveTarget(config_, path);
+  if (append) {
+    // append to an existing file; fall back to CREATE only when the
+    // namenode definitively says 404 — any other failure must propagate,
+    // or a transient error would turn append into a destructive overwrite
+    bool exists = true;
+    try {
+      exists = GetPathInfo(path).type == FileType::kFile;
+    } catch (const Error& e) {
+      if (std::string(e.what()).find("status 404") == std::string::npos) {
+        throw;
+      }
+      exists = false;
+    }
+    return new webhdfs::WebHdfsWriteStream(config_, t, path, exists);
+  }
+  return new webhdfs::WebHdfsWriteStream(config_, t, path);
+}
+
+namespace {
+// hdfs:// and viewfs:// dispatch (reference src/io.cc:38-52 routes both to
+// HDFSFileSystem; viewfs resolution is the namenode's job over WebHDFS).
+struct WebHdfsRegistrar {
+  WebHdfsRegistrar() {
+    FileSystem::RegisterScheme("hdfs", [](const URI&) -> FileSystem* {
+      return WebHdfsFileSystem::GetInstance();
+    });
+    FileSystem::RegisterScheme("viewfs", [](const URI&) -> FileSystem* {
+      return WebHdfsFileSystem::GetInstance();
+    });
+  }
+} webhdfs_registrar;
+}  // namespace
+
+}  // namespace dct

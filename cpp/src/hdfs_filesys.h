@@ -1,0 +1,73 @@
+// HDFS filesystem over the WebHDFS REST gateway.
+//
+// Counterpart of reference src/io/hdfs_filesys.{h,cc} (284 L), which binds
+// libhdfs through JNI and is gated behind a build flag (reference
+// CMakeLists.txt:71-83). libhdfs/JVM is not part of this toolchain, so the
+// same URI surface (hdfs:// and viewfs://, namenode singleton with env
+// fallback, hdfs_filesys.h:58-66) is served through HDFS's standard WebHDFS
+// HTTP API instead: GETFILESTATUS/LISTSTATUS for metadata, OPEN with
+// offset + namenode->datanode redirect for ranged reads (giving the same
+// Seek/Tell semantics the libhdfs client exposes), CREATE/APPEND redirects
+// for writes. Transport is the built-in POSIX HTTP client (http.h).
+#ifndef DCT_HDFS_FILESYS_H_
+#define DCT_HDFS_FILESYS_H_
+
+#include <string>
+#include <vector>
+
+#include "filesys.h"
+
+namespace dct {
+
+struct WebHdfsConfig {
+  std::string namenode_host;  // default namenode when the URI has no host
+  int namenode_port = 9870;   // WebHDFS default REST port
+  std::string user;           // appended as user.name= when non-empty
+  int max_retry = 50;         // read reconnect attempts (reference S3 parity)
+  int retry_sleep_ms = 100;
+
+  // Env chain: WEBHDFS_NAMENODE ("host[:port]"), then HADOOP_USER_NAME /
+  // USER for the identity (the reference reads the namenode from the URI or
+  // hdfs-site defaults via libhdfs; env is this build's equivalent knob).
+  static WebHdfsConfig FromEnv();
+};
+
+class WebHdfsFileSystem : public FileSystem {
+ public:
+  explicit WebHdfsFileSystem(const WebHdfsConfig& config) : config_(config) {}
+  // Singleton with env config (reference HDFSFileSystem::GetInstance
+  // namenode singleton, hdfs_filesys.h:58-66).
+  static WebHdfsFileSystem* GetInstance();
+
+  FileInfo GetPathInfo(const URI& path) override;
+  void ListDirectory(const URI& path, std::vector<FileInfo>* out) override;
+  Stream* Open(const URI& path, const char* mode,
+               bool allow_null = false) override;
+  SeekStream* OpenForRead(const URI& path, bool allow_null = false) override;
+
+  const WebHdfsConfig& config() const { return config_; }
+
+ private:
+  WebHdfsConfig config_;
+};
+
+namespace webhdfs {
+
+// Parsed "http://host:port/path?query" (datanode redirect Location).
+struct HttpUrl {
+  std::string host;
+  int port = 80;
+  std::string path_query;  // path + query, ready for the request line
+};
+HttpUrl ParseHttpUrl(const std::string& url);
+
+// "host", "host:port", or "[v6]:port" -> (host, port); splits only when the
+// suffix after the final ':' is numeric, so IPv6 literals stay whole.
+void SplitHostPort(const std::string& s, std::string* host, int* port,
+                   int default_port);
+
+}  // namespace webhdfs
+
+}  // namespace dct
+
+#endif  // DCT_HDFS_FILESYS_H_

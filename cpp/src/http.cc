@@ -17,7 +17,9 @@ std::string Lower(std::string s) {
 }
 }  // namespace
 
-HttpConnection::HttpConnection(const std::string& host, int port) {
+HttpConnection::HttpConnection(const std::string& host, int port)
+    : default_host_header_(port == 80 ? host
+                                      : host + ":" + std::to_string(port)) {
   struct addrinfo hints;
   std::memset(&hints, 0, sizeof(hints));
   hints.ai_family = AF_UNSPEC;
@@ -49,6 +51,12 @@ void HttpConnection::SendRequest(
   std::string req = method + " " + path + " HTTP/1.1\r\n";
   for (const auto& kv : headers) {
     req += kv.first + ": " + kv.second + "\r\n";
+  }
+  // HTTP/1.1 requires Host (RFC 7230); inject it when the caller did not
+  // set one explicitly (signed clients like S3 pass their own).
+  if (headers.find("Host") == headers.end() &&
+      headers.find("host") == headers.end()) {
+    req += "Host: " + default_host_header_ + "\r\n";
   }
   if (headers.find("content-length") == headers.end() &&
       headers.find("Content-Length") == headers.end() &&

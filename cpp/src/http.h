@@ -47,6 +47,7 @@ class HttpConnection {
   bool ReadLine(std::string* line);
 
   int fd_ = -1;
+  std::string default_host_header_;  // injected when caller sets no Host
   std::string rbuf_;          // buffered unread bytes
   size_t rpos_ = 0;
   int64_t body_remaining_ = -1;  // -1: read-to-close

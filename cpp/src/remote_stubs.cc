@@ -1,10 +1,7 @@
 // Gated remote filesystem schemes.
 //
 // Reference parity notes:
-// - HDFS (reference src/io/hdfs_filesys.{h,cc}) binds libhdfs via JNI and is
-//   enabled by a build flag (reference CMakeLists.txt:71-83). libhdfs is not
-//   part of this toolchain, so the scheme registers an informative error;
-//   the URI surface (hdfs:// and viewfs://) is reserved and dispatched.
+// - HDFS now has a real implementation over WebHDFS (hdfs_filesys.cc).
 // - Azure (reference src/io/azure_filesys.{h,cc}) is a partial stub in the
 //   reference itself: only ListDirectory is implemented and Open/OpenForRead
 //   return NULL (azure_filesys.h:26-32). Matching surface here, explicit.
@@ -20,15 +17,6 @@ FileSystem* Unavailable(const char* scheme, const char* detail) {
 
 struct RemoteStubRegistrar {
   RemoteStubRegistrar() {
-    FileSystem::RegisterScheme("hdfs", [](const URI&) {
-      return Unavailable("hdfs",
-                         "requires libhdfs (reference gates it behind a "
-                         "build flag too, CMakeLists.txt:71-83); stage data "
-                         "through s3:// or file:// instead");
-    });
-    FileSystem::RegisterScheme("viewfs", [](const URI&) {
-      return Unavailable("viewfs", "requires libhdfs (see hdfs://)");
-    });
     FileSystem::RegisterScheme("azure", [](const URI&) {
       return Unavailable("azure",
                          "the reference implementation is itself a partial "

@@ -278,7 +278,7 @@ class S3WriteStream : public Stream {
     return size;
   }
 
-  void Finish() {
+  void Finish() override {
     if (finished_) return;
     finished_ = true;
     if (upload_id_.empty()) {

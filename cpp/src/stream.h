@@ -26,6 +26,11 @@ class Stream {
   virtual size_t Read(void* ptr, size_t size) = 0;
   // Write all `size` bytes or throw.
   virtual size_t Write(const void* ptr, size_t size) = 0;
+  // Flush buffered writes and surface any error. Buffered writers (S3
+  // multipart, WebHDFS create/append) override this; destructors call it
+  // best-effort but swallow exceptions, so an explicit close path must call
+  // Finish to observe failures (dct_stream_free does).
+  virtual void Finish() {}
   // Factory dispatching on URI scheme; mode is "r"/"w"/"a" (binary always).
   // Returns nullptr when allow_null and the path does not exist.
   static Stream* Create(const std::string& uri, const char* mode,

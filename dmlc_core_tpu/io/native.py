@@ -166,8 +166,10 @@ class NativeStream:
 
     def close(self) -> None:
         if self._h:
-            _check(lib().dct_stream_free(self._h))
-            self._h = ctypes.c_void_p()
+            # the handle is freed even when Finish fails; drop it before
+            # raising so a later close/__del__ cannot double-free
+            h, self._h = self._h, ctypes.c_void_p()
+            _check(lib().dct_stream_free(h))
 
     def __enter__(self) -> "NativeStream":
         return self

@@ -1,0 +1,188 @@
+"""In-process mock WebHDFS server for testing the native hdfs:// client.
+
+Implements the slice of the WebHDFS REST API the client uses —
+GETFILESTATUS / LISTSTATUS JSON metadata, OPEN with offset and the
+namenode -> datanode 307 redirect dance, CREATE / APPEND two-step writes —
+so the C++ WebHDFS filesystem (cpp/src/hdfs_filesys.cc) is exercised
+end-to-end including redirect following and reconnect-at-offset retries.
+The reference tests HDFS only against a live cluster via libhdfs.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MockHdfsState:
+    def __init__(self):
+        self.files = {}          # absolute path -> bytes
+        self.fail_reads_after = None  # int: truncate OPEN bodies (retry test)
+        self.requests = []       # (method, path) log
+        self.port = None         # filled by serve(); used for redirect URLs
+        self.one_step_writes = False  # HttpFS-style: no redirect on writes
+
+
+class MockHdfsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: MockHdfsState = None  # set by serve()
+
+    def log_message(self, *args):
+        pass
+
+    # -- helpers ------------------------------------------------------------
+    def _require_host(self) -> bool:
+        # real namenodes (Jetty) reject HTTP/1.1 requests without Host
+        if not self.headers.get("Host"):
+            self._remote_exc(400, "missing Host header")
+            return False
+        return True
+
+    def _parse(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        assert parsed.path.startswith("/webhdfs/v1"), parsed.path
+        return urllib.parse.unquote(parsed.path[len("/webhdfs/v1"):]) or "/", q
+
+    def _json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _remote_exc(self, status, msg):
+        self._json({"RemoteException": {"exception": "IOException",
+                                        "message": msg}}, status=status)
+
+    def _redirect(self, extra=""):
+        # bounce back to this same server on a "datanode" flavored URL
+        loc = (f"http://127.0.0.1:{self.state.port}{self.path}"
+               f"&datanode=true{extra}")
+        self.send_response(307)
+        self.send_header("Location", loc)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _status_obj(self, path):
+        data = self.state.files.get(path)
+        if data is not None:
+            return {"length": len(data), "type": "FILE",
+                    "pathSuffix": "", "permission": "644"}
+        prefix = path.rstrip("/") + "/"
+        if any(p.startswith(prefix) for p in self.state.files):
+            return {"length": 0, "type": "DIRECTORY",
+                    "pathSuffix": "", "permission": "755"}
+        return None
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(n) if n else b""
+
+    # -- handlers -----------------------------------------------------------
+    def do_GET(self):
+        st = self.state
+        st.requests.append(("GET", self.path))
+        if not self._require_host():
+            return
+        path, q = self._parse()
+        op = q.get("op", "").upper()
+        if op == "GETFILESTATUS":
+            status = self._status_obj(path)
+            if status is None:
+                return self._remote_exc(404, f"File does not exist: {path}")
+            return self._json({"FileStatus": status})
+        if op == "LISTSTATUS":
+            if path in st.files:
+                # LISTSTATUS of a file: one entry, empty pathSuffix
+                return self._json({"FileStatuses": {"FileStatus": [
+                    {"length": len(st.files[path]), "type": "FILE",
+                     "pathSuffix": ""}]}})
+            prefix = path.rstrip("/") + "/"
+            entries = {}
+            for p, data in sorted(st.files.items()):
+                if not p.startswith(prefix):
+                    continue
+                rest = p[len(prefix):]
+                if "/" in rest:  # only the immediate child dir
+                    name = rest.split("/")[0]
+                    entries[name] = {"length": 0, "type": "DIRECTORY",
+                                     "pathSuffix": name}
+                else:
+                    entries[rest] = {"length": len(data), "type": "FILE",
+                                     "pathSuffix": rest}
+            if not entries and path.rstrip("/") not in ("",):
+                if self._status_obj(path) is None:
+                    return self._remote_exc(404,
+                                            f"File does not exist: {path}")
+            return self._json(
+                {"FileStatuses": {"FileStatus": list(entries.values())}})
+        if op == "OPEN":
+            if "datanode" not in q:
+                return self._redirect()
+            data = st.files.get(path)
+            if data is None:
+                return self._remote_exc(404, f"File does not exist: {path}")
+            off = int(q.get("offset", "0"))
+            data = data[off:]
+            if (st.fail_reads_after is not None
+                    and len(data) > st.fail_reads_after):
+                out = data[: st.fail_reads_after]
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(out)  # truncated on purpose
+                self.close_connection = True
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        self._remote_exc(400, f"unsupported GET op {op}")
+
+    def do_PUT(self):
+        st = self.state
+        st.requests.append(("PUT", self.path))
+        path, q = self._parse()
+        body = self._read_body()
+        if q.get("op", "").upper() != "CREATE":
+            return self._remote_exc(400, "unsupported PUT op")
+        if "datanode" not in q and not st.one_step_writes:
+            assert body == b"", "namenode step must carry no body"
+            return self._redirect()
+        st.files[path] = body
+        self.send_response(201)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_POST(self):
+        st = self.state
+        st.requests.append(("POST", self.path))
+        path, q = self._parse()
+        body = self._read_body()
+        if q.get("op", "").upper() != "APPEND":
+            return self._remote_exc(400, "unsupported POST op")
+        if "datanode" not in q and not st.one_step_writes:
+            assert body == b"", "namenode step must carry no body"
+            return self._redirect()
+        if path not in st.files:
+            return self._remote_exc(404, f"File does not exist: {path}")
+        st.files[path] += body
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+def serve():
+    """Start the mock server; returns (state, port, shutdown_fn)."""
+    state = MockHdfsState()
+    handler = type("Handler", (MockHdfsHandler,), {"state": state})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    state.port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return state, state.port, server.shutdown
