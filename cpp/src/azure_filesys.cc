@@ -1,0 +1,475 @@
+// Azure Blob filesystem implementation (see azure_filesys.h for provenance).
+#include "azure_filesys.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdlib>
+#include <ctime>
+#include <memory>
+#include <sstream>
+
+#include "http.h"
+#include "http_stream.h"
+#include "s3_filesys.h"  // s3::UriEncode / s3::XmlNextField
+#include "sha256.h"
+
+namespace dct {
+namespace azure {
+
+namespace {
+
+constexpr const char* kApiVersion = "2019-12-12";
+
+// RFC 1123 date the Blob service requires in x-ms-date. Built from fixed
+// English name tables — strftime %a/%b follow LC_TIME, and a host process
+// under e.g. de_DE would emit names the service rejects as malformed.
+std::string RfcDateNow() {
+  static const char* kDays[] = {"Sun", "Mon", "Tue", "Wed",
+                                "Thu", "Fri", "Sat"};
+  static const char* kMonths[] = {"Jan", "Feb", "Mar", "Apr", "May", "Jun",
+                                  "Jul", "Aug", "Sep", "Oct", "Nov", "Dec"};
+  std::time_t now = std::time(nullptr);
+  std::tm tm_utc;
+  gmtime_r(&now, &tm_utc);
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%s, %02d %s %04d %02d:%02d:%02d GMT",
+                kDays[tm_utc.tm_wday], tm_utc.tm_mday,
+                kMonths[tm_utc.tm_mon], tm_utc.tm_year + 1900,
+                tm_utc.tm_hour, tm_utc.tm_min, tm_utc.tm_sec);
+  return buf;
+}
+
+}  // namespace
+
+// Azure SharedKey (public spec: "Authorize with Shared Key", 2015-02-21+
+// string-to-sign shape). Signature = base64(HMAC-SHA256(base64dec(key),
+// StringToSign)).
+std::string BuildSharedKey(const AzureConfig& cfg, const std::string& method,
+                           const std::string& resource_path,
+                           const std::map<std::string, std::string>& query,
+                           std::map<std::string, std::string>* headers,
+                           size_t content_length) {
+  (*headers)["x-ms-date"] = RfcDateNow();
+  (*headers)["x-ms-version"] = kApiVersion;
+
+  // canonicalized x-ms-* headers: sorted, "name:value\n"
+  std::string canonical_headers;
+  for (const auto& kv : *headers) {  // std::map is already sorted
+    if (kv.first.compare(0, 5, "x-ms-") == 0) {
+      canonical_headers += kv.first + ":" + kv.second + "\n";
+    }
+  }
+
+  // canonicalized resource: /account/<path> then sorted query as
+  // "\nkey:value" (lowercase keys)
+  std::string canonical_resource = "/" + cfg.account + resource_path;
+  for (const auto& kv : query) {  // sorted by map
+    canonical_resource += "\n" + kv.first + ":" + kv.second;
+  }
+
+  std::string range;
+  auto rit = headers->find("Range");
+  if (rit != headers->end()) range = rit->second;
+
+  // 2015-02-21+: empty Content-Length line when zero
+  std::string len =
+      content_length == 0 ? "" : std::to_string(content_length);
+
+  std::string content_type;
+  auto cit = headers->find("Content-Type");
+  if (cit != headers->end()) content_type = cit->second;
+
+  std::string string_to_sign = method + "\n" +
+                               /* Content-Encoding */ "\n" +
+                               /* Content-Language */ "\n" +
+                               len + "\n" +
+                               /* Content-MD5 */ "\n" +
+                               content_type + "\n" +
+                               /* Date (x-ms-date used) */ "\n" +
+                               /* If-Modified-Since */ "\n" +
+                               /* If-Match */ "\n" +
+                               /* If-None-Match */ "\n" +
+                               /* If-Unmodified-Since */ "\n" +
+                               range + "\n" +
+                               canonical_headers + canonical_resource;
+
+  std::string sig = crypto::Base64Encode(crypto::HmacSha256(
+      crypto::Base64Decode(cfg.key_base64), string_to_sign));
+  return "SharedKey " + cfg.account + ":" + sig;
+}
+
+namespace {
+
+struct Target {
+  std::string host;
+  int port;
+};
+
+Target ResolveTarget(const AzureConfig& cfg) {
+  if (!cfg.endpoint_host.empty()) {
+    return {cfg.endpoint_host, cfg.endpoint_port};
+  }
+  return {cfg.account + ".blob.core.windows.net", 80};
+}
+
+// azure://container/blob-path -> ("/container", "/blob/path")
+void SplitContainerBlob(const URI& uri, std::string* container,
+                        std::string* blob) {
+  DCT_CHECK(!uri.host.empty())
+      << "container name not specified in azure uri: " << uri.Str();
+  *container = uri.host;
+  *blob = uri.path.empty() ? "/" : uri.path;
+}
+
+std::map<std::string, std::string> SignedHeaders(
+    const AzureConfig& cfg, const std::string& method,
+    const std::string& resource_path,
+    const std::map<std::string, std::string>& query, size_t content_length,
+    std::map<std::string, std::string> headers = {}) {
+  headers["Authorization"] = BuildSharedKey(cfg, method, resource_path, query,
+                                            &headers, content_length);
+  return headers;
+}
+
+std::string QueryString(const std::map<std::string, std::string>& query) {
+  std::string out;
+  for (const auto& kv : query) {
+    out += out.empty() ? "?" : "&";
+    out += s3::UriEncode(kv.first, false) + "=" +
+           s3::UriEncode(kv.second, false);
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------- reading --
+class AzureReadStream : public RetryingHttpReadStream {
+ public:
+  AzureReadStream(const AzureConfig& cfg, const URI& uri, size_t file_size)
+      : RetryingHttpReadStream("azure", file_size, cfg.max_retry,
+                               cfg.retry_sleep_ms),
+        cfg_(cfg), uri_(uri) {
+    SplitContainerBlob(uri, &container_, &blob_);
+    target_ = ResolveTarget(cfg_);
+  }
+
+ private:
+  void Connect() override {
+    std::string resource = "/" + container_ + blob_;
+    std::map<std::string, std::string> extra = {
+        {"Range", "bytes=" + std::to_string(pos_) + "-"}};
+    auto headers = SignedHeaders(cfg_, "GET", resource, {}, 0, extra);
+    conn_.reset(new HttpConnection(target_.host, target_.port));
+    conn_->SendRequest("GET", s3::UriEncode(resource, true), headers, "");
+    HttpResponse head;
+    conn_->ReadResponseHead(&head);
+    if (head.status != 200 && head.status != 206) {
+      conn_->ReadFullBody(&head);
+      int status = head.status;
+      conn_.reset();
+      throw HttpStatusError("azure GET " + uri_.Str() +
+                                " failed with status " +
+                                std::to_string(status) + ": " + head.body,
+                            status);
+    }
+  }
+
+  AzureConfig cfg_;
+  URI uri_;
+  std::string container_, blob_;
+  Target target_;
+};
+
+// ---------------------------------------------------------------- writing --
+// Block-blob writer: small objects in a single Put Blob; larger ones as
+// Put Block parts committed by Put Block List on Finish.
+class AzureWriteStream : public Stream {
+ public:
+  static constexpr size_t kBlockSize = 4 << 20;
+
+  AzureWriteStream(const AzureConfig& cfg, const URI& uri) : cfg_(cfg) {
+    SplitContainerBlob(uri, &container_, &blob_);
+    target_ = ResolveTarget(cfg_);
+    uri_ = uri;
+  }
+
+  ~AzureWriteStream() override {
+    try {
+      Finish();
+    } catch (...) {
+      // destructor must not throw; errors surface via Stream::Finish
+    }
+  }
+
+  size_t Read(void*, size_t) override {
+    throw Error("AzureWriteStream is write-only");
+  }
+
+  size_t Write(const void* ptr, size_t size) override {
+    buffer_.append(static_cast<const char*>(ptr), size);
+    while (buffer_.size() >= kBlockSize) PutBlock(kBlockSize);
+    return size;
+  }
+
+  void Finish() override {
+    if (finished_) return;
+    finished_ = true;
+    std::string resource = "/" + container_ + blob_;
+    if (block_ids_.empty()) {
+      // single-shot Put Blob
+      auto headers =
+          SignedHeaders(cfg_, "PUT", resource, {}, buffer_.size(),
+                        {{"x-ms-blob-type", "BlockBlob"}});
+      HttpResponse resp =
+          HttpRequest(target_.host, target_.port, "PUT",
+                      s3::UriEncode(resource, true), headers, buffer_);
+      DCT_CHECK(resp.status == 201)
+          << "azure Put Blob failed: " << resp.status << " " << resp.body;
+      return;
+    }
+    if (!buffer_.empty()) PutBlock(buffer_.size());
+    std::ostringstream xml;
+    xml << "<?xml version=\"1.0\" encoding=\"utf-8\"?><BlockList>";
+    for (const auto& id : block_ids_) xml << "<Latest>" << id << "</Latest>";
+    xml << "</BlockList>";
+    std::string body = xml.str();
+    std::map<std::string, std::string> q = {{"comp", "blocklist"}};
+    auto headers = SignedHeaders(cfg_, "PUT", resource, q, body.size());
+    HttpResponse resp = HttpRequest(
+        target_.host, target_.port, "PUT",
+        s3::UriEncode(resource, true) + QueryString(q), headers, body);
+    DCT_CHECK(resp.status == 201)
+        << "azure Put Block List failed: " << resp.status << " " << resp.body;
+  }
+
+ private:
+  void PutBlock(size_t size) {
+    std::string part;
+    if (size == buffer_.size()) {
+      part.swap(buffer_);
+    } else {
+      part = buffer_.substr(0, size);
+      buffer_.erase(0, size);
+    }
+    // fixed-width ids: all ids in a blob must have equal encoded length
+    char idbuf[16];
+    std::snprintf(idbuf, sizeof(idbuf), "block-%08zu", block_ids_.size());
+    std::string id = crypto::Base64Encode(idbuf);
+    std::string resource = "/" + container_ + blob_;
+    std::map<std::string, std::string> q = {{"blockid", id},
+                                            {"comp", "block"}};
+    auto headers = SignedHeaders(cfg_, "PUT", resource, q, part.size());
+    HttpResponse resp = HttpRequest(
+        target_.host, target_.port, "PUT",
+        s3::UriEncode(resource, true) + QueryString(q), headers, part);
+    DCT_CHECK(resp.status == 201)
+        << "azure Put Block failed: " << resp.status << " " << resp.body;
+    block_ids_.push_back(id);
+  }
+
+  AzureConfig cfg_;
+  URI uri_;
+  std::string container_, blob_;
+  Target target_;
+  std::string buffer_;
+  std::vector<std::string> block_ids_;
+  bool finished_ = false;
+};
+
+}  // namespace
+}  // namespace azure
+
+// ----------------------------------------------------------------- config --
+AzureConfig AzureConfig::FromEnv() {
+  AzureConfig cfg;
+  const char* account = std::getenv("AZURE_STORAGE_ACCOUNT");
+  const char* key = std::getenv("AZURE_STORAGE_ACCESS_KEY");
+  if (account != nullptr) cfg.account = account;
+  if (key != nullptr) cfg.key_base64 = key;
+  const char* endpoint = std::getenv("AZURE_ENDPOINT");
+  if (endpoint != nullptr && *endpoint != '\0') {
+    std::string s = endpoint;
+    size_t scheme = s.find("://");
+    if (scheme != std::string::npos) {
+      DCT_CHECK(s.compare(0, scheme, "http") == 0)
+          << "built-in azure client supports http endpoints only, got " << s;
+      s = s.substr(scheme + 3);
+    }
+    SplitHostPort(s, &cfg.endpoint_host, &cfg.endpoint_port,
+                  cfg.endpoint_port);
+  }
+  return cfg;
+}
+
+AzureFileSystem* AzureFileSystem::GetInstance() {
+  static AzureFileSystem inst(AzureConfig::FromEnv());
+  DCT_CHECK(!inst.config().account.empty() &&
+            !inst.config().key_base64.empty())
+      << "need AZURE_STORAGE_ACCOUNT and AZURE_STORAGE_ACCESS_KEY to use "
+         "azure:// (reference azure_filesys.cc:31-39)";
+  return &inst;
+}
+
+// List Blobs with delimiter (flat listing of one virtual directory level).
+void AzureFileSystem::ListDirectory(const URI& path,
+                                    std::vector<FileInfo>* out) {
+  std::string container, blob;
+  azure::SplitContainerBlob(path, &container, &blob);
+  azure::Target t = azure::ResolveTarget(config_);
+  std::string prefix = blob.substr(1);
+  if (!prefix.empty() && prefix.back() != '/') prefix += '/';
+  std::string marker;
+  while (true) {
+    std::map<std::string, std::string> q = {{"comp", "list"},
+                                            {"delimiter", "/"},
+                                            {"restype", "container"}};
+    if (!prefix.empty()) q["prefix"] = prefix;
+    if (!marker.empty()) q["marker"] = marker;
+    std::string resource = "/" + container;
+    auto headers = azure::SignedHeaders(config_, "GET", resource, q, 0);
+    HttpResponse resp = HttpRequest(
+        t.host, t.port, "GET",
+        s3::UriEncode(resource, true) + azure::QueryString(q), headers, "");
+    DCT_CHECK(resp.status == 200)
+        << "azure List Blobs failed: " << resp.status << " " << resp.body;
+    size_t pos = 0;
+    std::string chunk;
+    while (s3::XmlNextField(resp.body, &pos, "Blob", &chunk)) {
+      size_t cp = 0;
+      std::string name, sz;
+      if (!s3::XmlNextField(chunk, &cp, "Name", &name)) continue;
+      s3::XmlNextField(chunk, &cp, "Content-Length", &sz);
+      if (name == prefix) continue;
+      FileInfo info;
+      info.path = URI("azure://" + container + "/" + name);
+      info.size = static_cast<size_t>(std::atoll(sz.c_str()));
+      info.type = FileType::kFile;
+      out->push_back(info);
+    }
+    pos = 0;
+    while (s3::XmlNextField(resp.body, &pos, "BlobPrefix", &chunk)) {
+      size_t cp = 0;
+      std::string name;
+      if (!s3::XmlNextField(chunk, &cp, "Name", &name)) continue;
+      if (!name.empty() && name.back() == '/') name.pop_back();
+      FileInfo info;
+      info.path = URI("azure://" + container + "/" + name);
+      info.size = 0;
+      info.type = FileType::kDirectory;
+      out->push_back(info);
+    }
+    std::string next;
+    pos = 0;
+    s3::XmlNextField(resp.body, &pos, "NextMarker", &next);
+    if (next.empty()) break;
+    marker = next;
+  }
+}
+
+FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
+  // exact-prefix List Blobs (mirrors the S3 TryGetPathInfo approach; avoids
+  // HEAD, which the built-in client's body-framing doesn't model)
+  std::string container, blob;
+  azure::SplitContainerBlob(path, &container, &blob);
+  azure::Target t = azure::ResolveTarget(config_);
+  std::string prefix = blob.substr(1);
+  std::map<std::string, std::string> q = {{"comp", "list"},
+                                          {"delimiter", "/"},
+                                          {"prefix", prefix},
+                                          {"restype", "container"}};
+  std::string resource = "/" + container;
+  auto headers = azure::SignedHeaders(config_, "GET", resource, q, 0);
+  HttpResponse resp = HttpRequest(
+      t.host, t.port, "GET",
+      s3::UriEncode(resource, true) + azure::QueryString(q), headers, "");
+  DCT_CHECK(resp.status == 200)
+      << "azure List Blobs failed: " << resp.status << " " << resp.body;
+  size_t pos = 0;
+  std::string chunk;
+  bool is_dir = false;
+  // empty prefix = container/bucket root: any content makes it a directory
+  std::string dir_prefix =
+      (prefix.empty() || prefix.back() == '/') ? prefix : prefix + "/";
+  while (s3::XmlNextField(resp.body, &pos, "Blob", &chunk)) {
+    size_t cp = 0;
+    std::string name, sz;
+    if (!s3::XmlNextField(chunk, &cp, "Name", &name)) continue;
+    s3::XmlNextField(chunk, &cp, "Content-Length", &sz);
+    if (name == prefix) {
+      FileInfo info;
+      info.path = path;
+      info.size = static_cast<size_t>(std::atoll(sz.c_str()));
+      info.type = FileType::kFile;
+      return info;
+    }
+    // only children under "<name>/" make it a directory — a blob that
+    // merely shares the name as a string prefix (data vs database.csv)
+    // must not
+    if (name.compare(0, dir_prefix.size(), dir_prefix) == 0) is_dir = true;
+  }
+  pos = 0;
+  while (s3::XmlNextField(resp.body, &pos, "BlobPrefix", &chunk)) {
+    size_t cp = 0;
+    std::string name;
+    if (s3::XmlNextField(chunk, &cp, "Name", &name) && name == dir_prefix) {
+      is_dir = true;
+    }
+  }
+  if (!is_dir && dir_prefix != prefix) {
+    // first page may have been truncated by sibling blobs sorting before
+    // '/'; probe under "<prefix>/" directly (see the S3 counterpart)
+    std::map<std::string, std::string> q2 = {{"comp", "list"},
+                                             {"delimiter", "/"},
+                                             {"prefix", dir_prefix},
+                                             {"restype", "container"}};
+    auto h2 = azure::SignedHeaders(config_, "GET", resource, q2, 0);
+    HttpResponse r2 = HttpRequest(
+        t.host, t.port, "GET",
+        s3::UriEncode(resource, true) + azure::QueryString(q2), h2, "");
+    DCT_CHECK(r2.status == 200)
+        << "azure List Blobs failed: " << r2.status << " " << r2.body;
+    is_dir = r2.body.find("<Blob>") != std::string::npos ||
+             r2.body.find("<BlobPrefix>") != std::string::npos;
+  }
+  if (is_dir) {
+    FileInfo info;
+    info.path = path;
+    info.size = 0;
+    info.type = FileType::kDirectory;
+    return info;
+  }
+  throw Error("azure path does not exist: " + path.Str());
+}
+
+SeekStream* AzureFileSystem::OpenForRead(const URI& path, bool allow_null) {
+  try {
+    FileInfo info = GetPathInfo(path);
+    DCT_CHECK(info.type == FileType::kFile)
+        << "cannot open azure directory for read: " << path.Str();
+    return new azure::AzureReadStream(config_, path, info.size);
+  } catch (const Error&) {
+    if (allow_null) return nullptr;
+    throw;
+  }
+}
+
+Stream* AzureFileSystem::Open(const URI& path, const char* mode,
+                              bool allow_null) {
+  std::string m = mode;
+  if (m.find('r') != std::string::npos) return OpenForRead(path, allow_null);
+  DCT_CHECK(m.find('w') != std::string::npos)
+      << "azure supports modes r|w, got " << mode;
+  return new azure::AzureWriteStream(config_, path);
+}
+
+namespace {
+struct AzureRegistrar {
+  AzureRegistrar() {
+    FileSystem::RegisterScheme("azure", [](const URI&) -> FileSystem* {
+      return AzureFileSystem::GetInstance();
+    });
+  }
+} azure_registrar;
+}  // namespace
+
+}  // namespace dct

@@ -1,0 +1,69 @@
+// Azure Blob Storage filesystem over the Blob service REST API.
+//
+// Counterpart of reference src/io/azure_filesys.{h,cc}, which is a partial
+// stub: only ListDirectory is implemented (against the wastorage SDK) and
+// Open/OpenForRead return NULL (azure_filesys.h:22-32). This implementation
+// exceeds that surface: SharedKey-signed List Blobs, ranged blob reads with
+// reconnect-at-offset retry, and block-blob writes (Put Blob for small
+// objects, Put Block + Put Block List for large ones). Same URI form
+// (azure://container/path) and env credentials (AZURE_STORAGE_ACCOUNT /
+// AZURE_STORAGE_ACCESS_KEY, reference azure_filesys.cc:31-39). Transport is
+// the built-in http client, so it targets http endpoints (Azurite-style
+// emulators, gateways) — like the S3 client (s3_filesys.h).
+#ifndef DCT_AZURE_FILESYS_H_
+#define DCT_AZURE_FILESYS_H_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "filesys.h"
+
+namespace dct {
+
+struct AzureConfig {
+  std::string account;
+  std::string key_base64;     // SharedKey account key (base64)
+  std::string endpoint_host;  // empty => <account>.blob.core.windows.net
+  int endpoint_port = 80;
+  int max_retry = 50;
+  int retry_sleep_ms = 100;
+
+  // AZURE_STORAGE_ACCOUNT / AZURE_STORAGE_ACCESS_KEY (reference
+  // azure_filesys.cc:31-39) + AZURE_ENDPOINT ("host[:port]") for
+  // emulators/gateways.
+  static AzureConfig FromEnv();
+};
+
+class AzureFileSystem : public FileSystem {
+ public:
+  explicit AzureFileSystem(const AzureConfig& config) : config_(config) {}
+  static AzureFileSystem* GetInstance();
+
+  FileInfo GetPathInfo(const URI& path) override;
+  void ListDirectory(const URI& path, std::vector<FileInfo>* out) override;
+  Stream* Open(const URI& path, const char* mode,
+               bool allow_null = false) override;
+  SeekStream* OpenForRead(const URI& path, bool allow_null = false) override;
+
+  const AzureConfig& config() const { return config_; }
+
+ private:
+  AzureConfig config_;
+};
+
+namespace azure {
+
+// SharedKey authorization (exposed for tests): returns the Authorization
+// header value and fills x-ms-date / x-ms-version into headers.
+std::string BuildSharedKey(const AzureConfig& cfg, const std::string& method,
+                           const std::string& resource_path,
+                           const std::map<std::string, std::string>& query,
+                           std::map<std::string, std::string>* headers,
+                           size_t content_length);
+
+}  // namespace azure
+
+}  // namespace dct
+
+#endif  // DCT_AZURE_FILESYS_H_

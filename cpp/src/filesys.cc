@@ -31,6 +31,14 @@ class StdFileStream : public SeekStream {
     DCT_CHECK_EQ(n, size) << "write failed (disk full?)";
     return n;
   }
+  void Finish() override {
+    // surface deferred stdio write errors (ENOSPC etc.) at explicit close,
+    // matching the buffered remote writers (stream.h Finish contract)
+    if (fp_ != nullptr) {
+      DCT_CHECK(std::fflush(fp_) == 0 && std::ferror(fp_) == 0)
+          << "flush failed (disk full?)";
+    }
+  }
   void Seek(size_t pos) override {
     DCT_CHECK(fseeko(fp_, static_cast<off_t>(pos), SEEK_SET) == 0)
         << "seek failed";

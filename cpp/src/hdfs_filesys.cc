@@ -8,41 +8,12 @@
 #include <sstream>
 
 #include "http.h"
+#include "http_stream.h"
 #include "json.h"
 #include "s3_filesys.h"  // s3::UriEncode (RFC 3986 percent-encoding)
 
 namespace dct {
 namespace webhdfs {
-
-// "host", "host:port", or "[v6literal]:port" -> (host, port). A bare IPv6
-// literal (more than one ':' and no brackets) is never split; the bracketed
-// form carries the port after the closing ']'.
-void SplitHostPort(const std::string& s, std::string* host, int* port,
-                   int default_port) {
-  *host = s;
-  *port = default_port;
-  if (!s.empty() && s.front() == '[') {
-    size_t close = s.find(']');
-    DCT_CHECK(close != std::string::npos) << "unterminated [v6] host: " << s;
-    *host = s.substr(1, close - 1);
-    if (close + 1 < s.size() && s[close + 1] == ':') {
-      *port = std::atoi(s.c_str() + close + 2);
-    }
-    return;
-  }
-  size_t colon = s.find(':');
-  if (colon == std::string::npos || s.rfind(':') != colon) {
-    return;  // no port, or bare IPv6 literal
-  }
-  bool digits = colon + 1 < s.size();
-  for (size_t i = colon + 1; i < s.size(); ++i) {
-    if (!isdigit(static_cast<unsigned char>(s[i]))) digits = false;
-  }
-  if (digits) {
-    *host = s.substr(0, colon);
-    *port = std::atoi(s.c_str() + colon + 1);
-  }
-}
 
 HttpUrl ParseHttpUrl(const std::string& url) {
   HttpUrl out;
@@ -61,12 +32,6 @@ HttpUrl ParseHttpUrl(const std::string& url) {
 
 namespace {
 
-// A definitive HTTP status (4xx) — retrying cannot help, unlike transport
-// drops or 5xx, so the read loop rethrows these immediately.
-struct PermanentError : Error {
-  using Error::Error;
-};
-
 struct Target {
   std::string host;
   int port;
@@ -77,7 +42,7 @@ struct Target {
 Target ResolveTarget(const WebHdfsConfig& cfg, const URI& uri) {
   Target t{cfg.namenode_host, cfg.namenode_port};
   if (!uri.host.empty()) {
-    webhdfs::SplitHostPort(uri.host, &t.host, &t.port, cfg.namenode_port);
+    SplitHostPort(uri.host, &t.host, &t.port, cfg.namenode_port);
   }
   DCT_CHECK(!t.host.empty())
       << "hdfs uri has no host and WEBHDFS_NAMENODE is unset: " << uri.Str();
@@ -116,66 +81,32 @@ void ReadFileStatus(JSONReader* reader, FileInfo* info,
   }
 }
 
-// Raise a readable error from a non-2xx WebHDFS response (RemoteException
-// JSON body when present).
+// Raise a readable, status-typed error from a non-2xx WebHDFS response
+// (RemoteException JSON body when present).
 void CheckStatus(const HttpResponse& resp, int expect, const char* what,
                  const URI& uri) {
   if (resp.status == expect) return;
-  throw Error(std::string("webhdfs ") + what + " " + uri.Str() +
-              " failed with status " + std::to_string(resp.status) + ": " +
-              resp.body);
+  throw HttpStatusError(std::string("webhdfs ") + what + " " + uri.Str() +
+                            " failed with status " +
+                            std::to_string(resp.status) + ": " + resp.body,
+                        resp.status);
 }
 
 // ---------------------------------------------------------------- reading --
 // Ranged reader: each (re)connect issues OPEN with the current offset; the
-// namenode 307-redirects to a datanode which streams the rest of the file.
-// Reconnect-at-offset on failure mirrors the S3 read retry loop (and the
-// reference's s3_filesys.cc:522-546 semantics; libhdfs hdfsSeek maps to the
-// offset= parameter here).
-class WebHdfsReadStream : public SeekStream {
+// namenode 307-redirects to a datanode which streams the rest of the file
+// (libhdfs hdfsSeek maps to the offset= parameter; reconnect-at-offset
+// retry scaffolding shared via RetryingHttpReadStream).
+class WebHdfsReadStream : public RetryingHttpReadStream {
  public:
   WebHdfsReadStream(const WebHdfsConfig& cfg, const Target& target,
                     const URI& uri, size_t file_size)
-      : cfg_(cfg), target_(target), uri_(uri), file_size_(file_size) {}
-
-  size_t Read(void* ptr, size_t size) override {
-    if (pos_ >= file_size_ || size == 0) return 0;
-    int attempts = 0;
-    while (true) {
-      try {
-        if (conn_ == nullptr) Connect();
-        size_t n = conn_->ReadBody(ptr, size);
-        if (n == 0 && pos_ < file_size_) {
-          throw Error("short read from webhdfs stream");
-        }
-        pos_ += n;
-        return n;
-      } catch (const PermanentError&) {
-        conn_.reset();
-        throw;
-      } catch (const Error&) {
-        conn_.reset();
-        if (++attempts > cfg_.max_retry) throw;
-        usleep(cfg_.retry_sleep_ms * 1000);
-      }
-    }
-  }
-
-  size_t Write(const void*, size_t) override {
-    throw Error("WebHdfsReadStream is read-only");
-  }
-
-  void Seek(size_t pos) override {
-    if (pos != pos_) {
-      conn_.reset();
-      pos_ = pos;
-    }
-  }
-
-  size_t Tell() override { return pos_; }
+      : RetryingHttpReadStream("webhdfs", file_size, cfg.max_retry,
+                               cfg.retry_sleep_ms),
+        cfg_(cfg), target_(target), uri_(uri) {}
 
  private:
-  void Connect() {
+  void Connect() override {
     std::string path =
         OpPath(cfg_, uri_.path, "OPEN", "offset=" + std::to_string(pos_));
     std::string host = target_.host;
@@ -200,17 +131,12 @@ class WebHdfsReadStream : public SeekStream {
         continue;
       }
       conn_->ReadFullBody(&head);
+      int status = head.status;
       conn_.reset();
-      std::string msg = "webhdfs OPEN " + uri_.Str() +
-                        " failed with status " +
-                        std::to_string(head.status) + ": " + head.body;
-      // 4xx is definitive, except request-timeout/throttling which the
-      // reconnect budget exists for
-      if (head.status >= 400 && head.status < 500 && head.status != 408 &&
-          head.status != 429) {
-        throw PermanentError(msg);
-      }
-      throw Error(msg);
+      throw HttpStatusError("webhdfs OPEN " + uri_.Str() +
+                                " failed with status " +
+                                std::to_string(status) + ": " + head.body,
+                            status);
     }
     throw Error("webhdfs OPEN " + uri_.Str() + ": too many redirects");
   }
@@ -218,9 +144,6 @@ class WebHdfsReadStream : public SeekStream {
   WebHdfsConfig cfg_;
   Target target_;
   URI uri_;
-  size_t file_size_;
-  size_t pos_ = 0;
-  std::unique_ptr<HttpConnection> conn_;
 };
 
 // ---------------------------------------------------------------- writing --
@@ -317,7 +240,7 @@ WebHdfsConfig WebHdfsConfig::FromEnv() {
     std::string s = nn;
     size_t scheme = s.find("://");
     if (scheme != std::string::npos) s = s.substr(scheme + 3);
-    webhdfs::SplitHostPort(s, &cfg.namenode_host, &cfg.namenode_port,
+    SplitHostPort(s, &cfg.namenode_host, &cfg.namenode_port,
                            cfg.namenode_port);
   }
   const char* user = std::getenv("HADOOP_USER_NAME");
@@ -420,10 +343,8 @@ Stream* WebHdfsFileSystem::Open(const URI& path, const char* mode,
     bool exists = true;
     try {
       exists = GetPathInfo(path).type == FileType::kFile;
-    } catch (const Error& e) {
-      if (std::string(e.what()).find("status 404") == std::string::npos) {
-        throw;
-      }
+    } catch (const HttpStatusError& e) {
+      if (e.status != 404) throw;
       exists = false;
     }
     return new webhdfs::WebHdfsWriteStream(config_, t, path, exists);

@@ -59,12 +59,8 @@ struct HttpUrl {
   int port = 80;
   std::string path_query;  // path + query, ready for the request line
 };
-HttpUrl ParseHttpUrl(const std::string& url);
-
-// "host", "host:port", or "[v6]:port" -> (host, port); splits only when the
-// suffix after the final ':' is numeric, so IPv6 literals stay whole.
-void SplitHostPort(const std::string& s, std::string* host, int* port,
-                   int default_port);
+HttpUrl ParseHttpUrl(const std::string& url);  // host:port via SplitHostPort
+                                               // (http.h)
 
 }  // namespace webhdfs
 

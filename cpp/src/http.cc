@@ -6,6 +6,8 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cctype>
+#include <cstdlib>
 #include <cstring>
 
 namespace dct {
@@ -172,6 +174,33 @@ void HttpConnection::ReadFullBody(HttpResponse* out) {
     size_t n = ReadBody(buf, sizeof(buf));
     if (n == 0) break;
     out->body.append(buf, n);
+  }
+}
+
+void SplitHostPort(const std::string& s, std::string* host, int* port,
+                   int default_port) {
+  *host = s;
+  *port = default_port;
+  if (!s.empty() && s.front() == '[') {
+    size_t close = s.find(']');
+    DCT_CHECK(close != std::string::npos) << "unterminated [v6] host: " << s;
+    *host = s.substr(1, close - 1);
+    if (close + 1 < s.size() && s[close + 1] == ':') {
+      *port = std::atoi(s.c_str() + close + 2);
+    }
+    return;
+  }
+  size_t colon = s.find(':');
+  if (colon == std::string::npos || s.rfind(':') != colon) {
+    return;  // no port, or bare IPv6 literal
+  }
+  bool digits = colon + 1 < s.size();
+  for (size_t i = colon + 1; i < s.size(); ++i) {
+    if (!isdigit(static_cast<unsigned char>(s[i]))) digits = false;
+  }
+  if (digits) {
+    *host = s.substr(0, colon);
+    *port = std::atoi(s.c_str() + colon + 1);
   }
 }
 

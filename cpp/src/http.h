@@ -23,6 +23,21 @@ struct HttpResponse {
   std::string body;
 };
 
+// An HTTP error response with its status carried as data, so callers can
+// classify (404 probe, retryability) without parsing the message text.
+class HttpStatusError : public Error {
+ public:
+  HttpStatusError(const std::string& what, int status_code)
+      : Error(what), status(status_code) {}
+  int status;
+};
+
+// Retry can help: transport-level timeouts/throttling and server errors.
+// Other 4xx are definitive and must fail fast.
+inline bool RetryableHttpStatus(int status) {
+  return status == 408 || status == 429 || status >= 500;
+}
+
 class HttpConnection {
  public:
   HttpConnection(const std::string& host, int port);
@@ -61,6 +76,12 @@ HttpResponse HttpRequest(const std::string& host, int port,
                          const std::string& method, const std::string& path,
                          const std::map<std::string, std::string>& headers,
                          const std::string& body);
+
+// "host", "host:port", or "[v6literal]:port" -> (host, port). A bare IPv6
+// literal (more than one ':' and no brackets) is never split; the bracketed
+// form carries the port after the closing ']'.
+void SplitHostPort(const std::string& s, std::string* host, int* port,
+                   int default_port);
 
 }  // namespace dct
 

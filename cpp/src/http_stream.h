@@ -1,0 +1,84 @@
+// Shared ranged-read scaffolding for HTTP-backed filesystems.
+//
+// S3, WebHDFS, and Azure readers all follow the same shape: a SeekStream
+// whose Connect() opens a ranged GET at the current offset, with
+// reconnect-at-offset retries on transport drops (the reference's S3 retry
+// loop, s3_filesys.cc:522-546, <=50 attempts at 100 ms) and fail-fast on
+// definitive HTTP statuses. Only Connect() differs per backend, so the
+// loop lives here once.
+#ifndef DCT_HTTP_STREAM_H_
+#define DCT_HTTP_STREAM_H_
+
+#include <unistd.h>
+
+#include <memory>
+#include <string>
+
+#include "http.h"
+#include "stream.h"
+
+namespace dct {
+
+class RetryingHttpReadStream : public SeekStream {
+ public:
+  RetryingHttpReadStream(const char* backend, size_t file_size, int max_retry,
+                         int retry_sleep_ms)
+      : backend_(backend), file_size_(file_size), max_retry_(max_retry),
+        retry_sleep_ms_(retry_sleep_ms) {}
+
+  size_t Read(void* ptr, size_t size) override {
+    if (pos_ >= file_size_ || size == 0) return 0;
+    int attempts = 0;
+    while (true) {
+      try {
+        if (conn_ == nullptr) Connect();
+        size_t n = conn_->ReadBody(ptr, size);
+        if (n == 0 && pos_ < file_size_) {
+          throw Error(std::string("short read from ") + backend_ +
+                      " stream");
+        }
+        pos_ += n;
+        return n;
+      } catch (const HttpStatusError& e) {
+        conn_.reset();
+        if (!RetryableHttpStatus(e.status)) throw;
+        if (++attempts > max_retry_) throw;
+        usleep(retry_sleep_ms_ * 1000);
+      } catch (const Error&) {
+        conn_.reset();
+        if (++attempts > max_retry_) throw;
+        usleep(retry_sleep_ms_ * 1000);
+      }
+    }
+  }
+
+  size_t Write(const void*, size_t) override {
+    throw Error(std::string(backend_) + " read stream is read-only");
+  }
+
+  void Seek(size_t pos) override {
+    if (pos != pos_) {
+      conn_.reset();
+      pos_ = pos;
+    }
+  }
+
+  size_t Tell() override { return pos_; }
+
+ protected:
+  // Establish conn_ streaming the body from offset pos_. Must throw
+  // HttpStatusError on a non-success HTTP status (retryability is decided
+  // here by RetryableHttpStatus), plain Error on transport problems.
+  virtual void Connect() = 0;
+
+  const char* backend_;
+  size_t file_size_;
+  int max_retry_;
+  int retry_sleep_ms_;
+  size_t pos_ = 0;
+  std::unique_ptr<HttpConnection> conn_;
+};
+
+}  // namespace dct
+
+#endif  // DCT_HTTP_STREAM_H_

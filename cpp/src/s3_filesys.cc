@@ -11,6 +11,7 @@
 #include <sstream>
 
 #include "http.h"
+#include "http_stream.h"
 #include "sha256.h"
 
 namespace dct {
@@ -178,51 +179,18 @@ void SplitBucketKey(const URI& uri, std::string* bucket, std::string* key) {
 }
 
 // ---------------------------------------------------------------- reading --
-class S3ReadStream : public SeekStream {
+class S3ReadStream : public RetryingHttpReadStream {
  public:
   S3ReadStream(const S3Config& cfg, const URI& uri, size_t file_size)
-      : cfg_(cfg), uri_(uri), file_size_(file_size) {
+      : RetryingHttpReadStream("s3", file_size, cfg.max_retry,
+                               cfg.retry_sleep_ms),
+        cfg_(cfg), uri_(uri) {
     SplitBucketKey(uri, &bucket_, &key_);
     target_ = ResolveTarget(cfg_, bucket_);
   }
 
-  size_t Read(void* ptr, size_t size) override {
-    if (pos_ >= file_size_ || size == 0) return 0;
-    int attempts = 0;
-    while (true) {
-      try {
-        if (conn_ == nullptr) Connect();
-        size_t n = conn_->ReadBody(ptr, size);
-        if (n == 0 && pos_ < file_size_) {
-          throw Error("short read from s3 stream");
-        }
-        pos_ += n;
-        return n;
-      } catch (const Error&) {
-        // reconnect at the current offset (reference retry loop,
-        // s3_filesys.cc:522-546)
-        conn_.reset();
-        if (++attempts > cfg_.max_retry) throw;
-        usleep(cfg_.retry_sleep_ms * 1000);
-      }
-    }
-  }
-
-  size_t Write(const void*, size_t) override {
-    throw Error("S3ReadStream is read-only");
-  }
-
-  void Seek(size_t pos) override {
-    if (pos != pos_) {
-      conn_.reset();
-      pos_ = pos;
-    }
-  }
-
-  size_t Tell() override { return pos_; }
-
  private:
-  void Connect() {
+  void Connect() override {
     std::string path = target_.base_path + key_;
     auto headers = SignedHeaders(cfg_, target_, "GET", path, {}, kUnsigned);
     headers["Range"] = "bytes=" + std::to_string(pos_) + "-";
@@ -233,9 +201,12 @@ class S3ReadStream : public SeekStream {
     conn_->ReadResponseHead(&head);
     if (head.status != 200 && head.status != 206) {
       conn_->ReadFullBody(&head);
+      int status = head.status;
       conn_.reset();
-      throw Error("s3 GET " + uri_.Str() + " failed with status " +
-                  std::to_string(head.status) + ": " + head.body);
+      throw HttpStatusError("s3 GET " + uri_.Str() +
+                                " failed with status " +
+                                std::to_string(status) + ": " + head.body,
+                            status);
     }
   }
 
@@ -243,9 +214,6 @@ class S3ReadStream : public SeekStream {
   URI uri_;
   std::string bucket_, key_;
   Target target_;
-  size_t file_size_;
-  size_t pos_ = 0;
-  std::unique_ptr<HttpConnection> conn_;
 };
 
 // ---------------------------------------------------------------- writing --
@@ -393,12 +361,8 @@ S3Config S3Config::FromEnv() {
           << endpoint;
       endpoint = endpoint.substr(scheme + 3);
     }
-    size_t colon = endpoint.rfind(':');
-    if (colon != std::string::npos) {
-      cfg.endpoint_port = std::atoi(endpoint.c_str() + colon + 1);
-      endpoint = endpoint.substr(0, colon);
-    }
-    cfg.endpoint_host = endpoint;
+    SplitHostPort(endpoint, &cfg.endpoint_host, &cfg.endpoint_port,
+                  cfg.endpoint_port);
     cfg.path_style = true;  // custom endpoints default to path-style
   }
   const char* vs = std::getenv("S3_PATH_STYLE");
@@ -506,6 +470,10 @@ FileInfo S3FileSystem::GetPathInfo(const URI& path) {
       << "s3 ListObjects failed: " << resp.status << " " << resp.body;
   size_t pos = 0;
   std::string chunk;
+  bool is_dir = false;
+  // empty prefix = container/bucket root: any content makes it a directory
+  std::string dir_prefix =
+      (prefix.empty() || prefix.back() == '/') ? prefix : prefix + "/";
   while (s3::XmlNextField(resp.body, &pos, "Contents", &chunk)) {
     size_t cp = 0;
     std::string k, sz;
@@ -518,12 +486,37 @@ FileInfo S3FileSystem::GetPathInfo(const URI& path) {
       info.type = FileType::kFile;
       return info;
     }
+    // only keys under "<prefix>/" make it a directory — a key that merely
+    // shares the string prefix (data vs database.csv) must not
+    if (k.compare(0, dir_prefix.size(), dir_prefix) == 0) is_dir = true;
   }
-  // fall back: a prefix with children is a directory
   size_t cpos = 0;
-  std::string tmp;
-  if (s3::XmlNextField(resp.body, &cpos, "CommonPrefixes", &tmp) ||
-      resp.body.find("<Contents>") != std::string::npos) {
+  while (s3::XmlNextField(resp.body, &cpos, "CommonPrefixes", &chunk)) {
+    size_t cp = 0;
+    std::string p;
+    if (s3::XmlNextField(chunk, &cp, "Prefix", &p) && p == dir_prefix) {
+      is_dir = true;
+    }
+  }
+  if (!is_dir && dir_prefix != prefix) {
+    // The first page was scoped to `prefix` and may have been truncated by
+    // sibling keys sorting before '/' (e.g. 1000+ "data-*" keys hiding
+    // "data/..."). Probe under "<prefix>/" directly — any result means the
+    // directory exists.
+    std::vector<std::pair<std::string, std::string>> q2 = {
+        {"delimiter", "/"}, {"prefix", dir_prefix}};
+    std::sort(q2.begin(), q2.end());
+    auto h2 =
+        s3::SignedHeaders(config_, t, "GET", base, q2, crypto::Sha256Hex(""));
+    HttpResponse r2 =
+        HttpRequest(t.host, t.port, "GET",
+                    s3::UriEncode(base, true) + s3::QueryString(q2), h2, "");
+    DCT_CHECK(r2.status == 200)
+        << "s3 ListObjects failed: " << r2.status << " " << r2.body;
+    is_dir = r2.body.find("<Contents>") != std::string::npos ||
+             r2.body.find("<CommonPrefixes>") != std::string::npos;
+  }
+  if (is_dir) {
     FileInfo info;
     info.path = path;
     info.size = 0;

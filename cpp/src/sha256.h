@@ -176,6 +176,66 @@ inline std::string HexEncode(const std::string& raw) {
   return out;
 }
 
+// RFC 4648 base64 (Azure SharedKey uses base64 account keys/signatures).
+inline std::string Base64Encode(const std::string& raw) {
+  static const char* tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve((raw.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 3 <= raw.size()) {
+    uint32_t v = (static_cast<uint8_t>(raw[i]) << 16) |
+                 (static_cast<uint8_t>(raw[i + 1]) << 8) |
+                 static_cast<uint8_t>(raw[i + 2]);
+    out.push_back(tbl[(v >> 18) & 63]);
+    out.push_back(tbl[(v >> 12) & 63]);
+    out.push_back(tbl[(v >> 6) & 63]);
+    out.push_back(tbl[v & 63]);
+    i += 3;
+  }
+  size_t rem = raw.size() - i;
+  if (rem == 1) {
+    uint32_t v = static_cast<uint8_t>(raw[i]) << 16;
+    out.push_back(tbl[(v >> 18) & 63]);
+    out.push_back(tbl[(v >> 12) & 63]);
+    out += "==";
+  } else if (rem == 2) {
+    uint32_t v = (static_cast<uint8_t>(raw[i]) << 16) |
+                 (static_cast<uint8_t>(raw[i + 1]) << 8);
+    out.push_back(tbl[(v >> 18) & 63]);
+    out.push_back(tbl[(v >> 12) & 63]);
+    out.push_back(tbl[(v >> 6) & 63]);
+    out += "=";
+  }
+  return out;
+}
+
+inline std::string Base64Decode(const std::string& enc) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;  // padding or invalid
+  };
+  std::string out;
+  out.reserve(enc.size() / 4 * 3);
+  uint32_t acc = 0;
+  int bits = 0;
+  for (char c : enc) {
+    int v = val(c);
+    if (v < 0) continue;  // skip '=', whitespace
+    acc = (acc << 6) | static_cast<uint32_t>(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out.push_back(static_cast<char>((acc >> bits) & 0xFF));
+    }
+  }
+  return out;
+}
+
 }  // namespace crypto
 }  // namespace dct
 

@@ -1,0 +1,197 @@
+"""In-process mock Azure Blob server for testing the native azure:// client.
+
+Implements the slice of the Blob service REST API the client uses — blob GET
+with Range, Put Blob, Put Block / Put Block List, List Blobs XML — and
+**recomputes the SharedKey signature for every request** with Python
+hmac/hashlib/base64, rejecting mismatches with 403. This cross-validates the
+C++ SharedKey string-to-sign construction (cpp/src/azure_filesys.cc) against
+an independent implementation. The reference's Azure module is a stub with
+no tests at all (reference src/io/azure_filesys.h:22-32).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+ACCOUNT = "testaccount"
+KEY_B64 = base64.b64encode(b"super-secret-azure-key-0123456789").decode()
+
+
+class MockAzureState:
+    def __init__(self):
+        self.blobs = {}          # (container, name) -> bytes
+        self.blocks = {}         # (container, name) -> {block_id: bytes}
+        self.fail_reads_after = None
+        self.reject_writes = False    # 403 every PUT (close-error test)
+        self.requests = []       # (method, path) log
+
+
+class MockAzureHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: MockAzureState = None  # set by serve()
+
+    def log_message(self, *args):
+        pass
+
+    # -- SharedKey verification --------------------------------------------
+    def _verify_sig(self, body: bytes) -> bool:
+        auth = self.headers.get("Authorization", "")
+        m = re.match(r"SharedKey ([^:]+):(.+)", auth)
+        if not m:
+            return False
+        account, signature = m.groups()
+        if account != ACCOUNT:
+            return False
+        parsed = urllib.parse.urlsplit(self.path)
+        query = sorted(urllib.parse.parse_qsl(parsed.query,
+                                              keep_blank_values=True))
+        xms = sorted((k.lower(), v) for k, v in self.headers.items()
+                     if k.lower().startswith("x-ms-"))
+        canonical_headers = "".join(f"{k}:{v}\n" for k, v in xms)
+        canonical_resource = f"/{ACCOUNT}{urllib.parse.unquote(parsed.path)}"
+        for k, v in query:
+            canonical_resource += f"\n{k.lower()}:{v}"
+        length = str(len(body)) if body else ""
+        string_to_sign = "\n".join([
+            self.command,
+            "",                                  # Content-Encoding
+            "",                                  # Content-Language
+            length,                              # Content-Length ("" if 0)
+            "",                                  # Content-MD5
+            self.headers.get("Content-Type", ""),
+            "",                                  # Date (x-ms-date in use)
+            "", "", "", "",                      # If-* conditionals
+            self.headers.get("Range", ""),
+        ]) + "\n" + canonical_headers + canonical_resource
+        expect = base64.b64encode(
+            hmac.new(base64.b64decode(KEY_B64), string_to_sign.encode(),
+                     hashlib.sha256).digest()).decode()
+        return hmac.compare_digest(expect, signature)
+
+    def _reject(self, code, msg):
+        body = msg.encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(n) if n else b""
+
+    def _container_blob(self):
+        path = urllib.parse.unquote(urllib.parse.urlsplit(self.path).path)
+        parts = path.lstrip("/").split("/", 1)
+        return parts[0], parts[1] if len(parts) > 1 else ""
+
+    # -- handlers -----------------------------------------------------------
+    def do_GET(self):
+        st = self.state
+        st.requests.append(("GET", self.path))
+        if not self._verify_sig(b""):
+            return self._reject(403, "AuthenticationFailed")
+        container, name = self._container_blob()
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlsplit(self.path).query, keep_blank_values=True))
+        if q.get("comp") == "list":
+            return self._list(container, q)
+        data = st.blobs.get((container, name))
+        if data is None:
+            return self._reject(404, "BlobNotFound")
+        rng = self.headers.get("Range")
+        status = 200
+        if rng:
+            m = re.match(r"bytes=(\d+)-(\d*)", rng)
+            lo = int(m.group(1))
+            hi = int(m.group(2)) + 1 if m.group(2) else len(data)
+            data = data[lo:hi]
+            status = 206
+        if st.fail_reads_after is not None and len(data) > st.fail_reads_after:
+            out = data[: st.fail_reads_after]
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(out)  # truncated on purpose
+            self.close_connection = True
+            return
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _list(self, container, q):
+        st = self.state
+        prefix = q.get("prefix", "")
+        delim = q.get("delimiter", "")
+        names = sorted(n for (c, n) in st.blobs if c == container
+                       and n.startswith(prefix))
+        blobs, prefixes = [], []
+        for n in names:
+            rest = n[len(prefix):]
+            if delim and delim in rest:
+                p = prefix + rest.split(delim)[0] + delim
+                if p not in prefixes:
+                    prefixes.append(p)
+            else:
+                blobs.append(n)
+        xml = ["<?xml version='1.0'?><EnumerationResults><Blobs>"]
+        for n in blobs:
+            xml.append(f"<Blob><Name>{n}</Name><Properties>"
+                       f"<Content-Length>{len(st.blobs[(container, n)])}"
+                       f"</Content-Length></Properties></Blob>")
+        for p in prefixes:
+            xml.append(f"<BlobPrefix><Name>{p}</Name></BlobPrefix>")
+        xml.append("</Blobs><NextMarker/></EnumerationResults>")
+        body = "".join(xml).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        st = self.state
+        st.requests.append(("PUT", self.path))
+        body = self._read_body()
+        if st.reject_writes:
+            return self._reject(403, "InsufficientAccountPermissions")
+        if not self._verify_sig(body):
+            return self._reject(403, "AuthenticationFailed")
+        container, name = self._container_blob()
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlsplit(self.path).query, keep_blank_values=True))
+        if q.get("comp") == "block":
+            st.blocks.setdefault((container, name), {})[q["blockid"]] = body
+            self.send_response(201)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if q.get("comp") == "blocklist":
+            ids = re.findall(r"<Latest>([^<]+)</Latest>", body.decode())
+            parts = st.blocks.pop((container, name), {})
+            st.blobs[(container, name)] = b"".join(parts[i] for i in ids)
+            self.send_response(201)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if self.headers.get("x-ms-blob-type") != "BlockBlob":
+            return self._reject(400, "MissingRequiredHeader x-ms-blob-type")
+        st.blobs[(container, name)] = body
+        self.send_response(201)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+def serve():
+    """Start the mock server; returns (state, port, shutdown_fn)."""
+    state = MockAzureState()
+    handler = type("Handler", (MockAzureHandler,), {"state": state})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return state, server.server_address[1], server.shutdown

@@ -112,6 +112,14 @@ def test_path_info():
         path_info("s3://bkt/missing/file")
 
 
+def test_path_info_prefix_collision_is_not_a_directory():
+    # a key that shares the name as a string prefix must not make the
+    # shorter name look like an existing directory
+    put("database.csv", b"rows")
+    with pytest.raises(DMLCError, match="does not exist"):
+        path_info("s3://bkt/data")
+
+
 def test_read_retry_on_short_reads():
     # server sends truncated bodies; client must reconnect at offset and
     # finish (reference retry loop, s3_filesys.cc:522-546)
