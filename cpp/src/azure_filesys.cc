@@ -11,7 +11,8 @@
 
 #include "http.h"
 #include "http_stream.h"
-#include "s3_filesys.h"  // s3::UriEncode / s3::XmlNextField
+#include "listing.h"
+#include "s3_filesys.h"  // s3::UriEncode / s3::XmlNextField / XmlUnescape
 #include "sha256.h"
 
 namespace dct {
@@ -61,9 +62,11 @@ std::string BuildSharedKey(const AzureConfig& cfg, const std::string& method,
     }
   }
 
-  // canonicalized resource: /account/<path> then sorted query as
-  // "\nkey:value" (lowercase keys)
-  std::string canonical_resource = "/" + cfg.account + resource_path;
+  // canonicalized resource: /account/<encoded path> then sorted query as
+  // "\nkey:value" (lowercase keys). The spec signs the path exactly as it
+  // appears (percent-encoded) on the request line.
+  std::string canonical_resource =
+      "/" + cfg.account + s3::UriEncode(resource_path, true);
   for (const auto& kv : query) {  // sorted by map
     canonical_resource += "\n" + kv.first + ":" + kv.second;
   }
@@ -339,6 +342,7 @@ void AzureFileSystem::ListDirectory(const URI& path,
       std::string name, sz;
       if (!s3::XmlNextField(chunk, &cp, "Name", &name)) continue;
       s3::XmlNextField(chunk, &cp, "Content-Length", &sz);
+      name = s3::XmlUnescape(name);
       if (name == prefix) continue;
       FileInfo info;
       info.path = URI("azure://" + container + "/" + name);
@@ -351,6 +355,7 @@ void AzureFileSystem::ListDirectory(const URI& path,
       size_t cp = 0;
       std::string name;
       if (!s3::XmlNextField(chunk, &cp, "Name", &name)) continue;
+      name = s3::XmlUnescape(name);
       if (!name.empty() && name.back() == '/') name.pop_back();
       FileInfo info;
       info.path = URI("azure://" + container + "/" + name);
@@ -362,83 +367,51 @@ void AzureFileSystem::ListDirectory(const URI& path,
     pos = 0;
     s3::XmlNextField(resp.body, &pos, "NextMarker", &next);
     if (next.empty()) break;
-    marker = next;
+    marker = s3::XmlUnescape(next);
   }
 }
 
 FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
   // exact-prefix List Blobs (mirrors the S3 TryGetPathInfo approach; avoids
-  // HEAD, which the built-in client's body-framing doesn't model)
+  // HEAD, which the built-in client's body-framing doesn't model);
+  // file-vs-directory resolution is the shared ProbePathInfo (listing.h)
   std::string container, blob;
   azure::SplitContainerBlob(path, &container, &blob);
   azure::Target t = azure::ResolveTarget(config_);
-  std::string prefix = blob.substr(1);
-  std::map<std::string, std::string> q = {{"comp", "list"},
-                                          {"delimiter", "/"},
-                                          {"prefix", prefix},
-                                          {"restype", "container"}};
   std::string resource = "/" + container;
-  auto headers = azure::SignedHeaders(config_, "GET", resource, q, 0);
-  HttpResponse resp = HttpRequest(
-      t.host, t.port, "GET",
-      s3::UriEncode(resource, true) + azure::QueryString(q), headers, "");
-  DCT_CHECK(resp.status == 200)
-      << "azure List Blobs failed: " << resp.status << " " << resp.body;
-  size_t pos = 0;
-  std::string chunk;
-  bool is_dir = false;
-  // empty prefix = container/bucket root: any content makes it a directory
-  std::string dir_prefix =
-      (prefix.empty() || prefix.back() == '/') ? prefix : prefix + "/";
-  while (s3::XmlNextField(resp.body, &pos, "Blob", &chunk)) {
-    size_t cp = 0;
-    std::string name, sz;
-    if (!s3::XmlNextField(chunk, &cp, "Name", &name)) continue;
-    s3::XmlNextField(chunk, &cp, "Content-Length", &sz);
-    if (name == prefix) {
-      FileInfo info;
-      info.path = path;
-      info.size = static_cast<size_t>(std::atoll(sz.c_str()));
-      info.type = FileType::kFile;
-      return info;
-    }
-    // only children under "<name>/" make it a directory — a blob that
-    // merely shares the name as a string prefix (data vs database.csv)
-    // must not
-    if (name.compare(0, dir_prefix.size(), dir_prefix) == 0) is_dir = true;
-  }
-  pos = 0;
-  while (s3::XmlNextField(resp.body, &pos, "BlobPrefix", &chunk)) {
-    size_t cp = 0;
-    std::string name;
-    if (s3::XmlNextField(chunk, &cp, "Name", &name) && name == dir_prefix) {
-      is_dir = true;
-    }
-  }
-  if (!is_dir && dir_prefix != prefix) {
-    // first page may have been truncated by sibling blobs sorting before
-    // '/'; probe under "<prefix>/" directly (see the S3 counterpart)
-    std::map<std::string, std::string> q2 = {{"comp", "list"},
-                                             {"delimiter", "/"},
-                                             {"prefix", dir_prefix},
-                                             {"restype", "container"}};
-    auto h2 = azure::SignedHeaders(config_, "GET", resource, q2, 0);
-    HttpResponse r2 = HttpRequest(
+  auto list_page = [&](const std::string& pfx) {
+    std::map<std::string, std::string> q = {{"comp", "list"},
+                                            {"delimiter", "/"},
+                                            {"prefix", pfx},
+                                            {"restype", "container"}};
+    auto headers = azure::SignedHeaders(config_, "GET", resource, q, 0);
+    HttpResponse resp = HttpRequest(
         t.host, t.port, "GET",
-        s3::UriEncode(resource, true) + azure::QueryString(q2), h2, "");
-    DCT_CHECK(r2.status == 200)
-        << "azure List Blobs failed: " << r2.status << " " << r2.body;
-    is_dir = r2.body.find("<Blob>") != std::string::npos ||
-             r2.body.find("<BlobPrefix>") != std::string::npos;
-  }
-  if (is_dir) {
-    FileInfo info;
-    info.path = path;
-    info.size = 0;
-    info.type = FileType::kDirectory;
-    return info;
-  }
-  throw Error("azure path does not exist: " + path.Str());
+        s3::UriEncode(resource, true) + azure::QueryString(q), headers, "");
+    DCT_CHECK(resp.status == 200)
+        << "azure List Blobs failed: " << resp.status << " " << resp.body;
+    ListedPage page;
+    size_t pos = 0;
+    std::string chunk;
+    while (s3::XmlNextField(resp.body, &pos, "Blob", &chunk)) {
+      size_t cp = 0;
+      std::string name, sz;
+      if (!s3::XmlNextField(chunk, &cp, "Name", &name)) continue;
+      s3::XmlNextField(chunk, &cp, "Content-Length", &sz);
+      page.objects.push_back({s3::XmlUnescape(name),
+                              static_cast<size_t>(std::atoll(sz.c_str()))});
+    }
+    pos = 0;
+    while (s3::XmlNextField(resp.body, &pos, "BlobPrefix", &chunk)) {
+      size_t cp = 0;
+      std::string name;
+      if (s3::XmlNextField(chunk, &cp, "Name", &name)) {
+        page.prefixes.push_back(s3::XmlUnescape(name));
+      }
+    }
+    return page;
+  };
+  return ProbePathInfo(path, blob.substr(1), list_page, "azure");
 }
 
 SeekStream* AzureFileSystem::OpenForRead(const URI& path, bool allow_null) {

@@ -1,0 +1,80 @@
+// Shared object-store listing structures and the file-vs-directory probe.
+//
+// S3 and Azure both expose flat key namespaces where "directories" are an
+// illusion over a delimiter; deciding whether a path is a file, a virtual
+// directory, or absent requires the same careful probe in both (exact-key
+// match, then children strictly under "<name>/" — a key that merely shares
+// the string prefix must not count — with a second scoped list when the
+// first page may have been truncated by sibling keys). The algorithm lives
+// here once, parameterized on the backend's one-page list call.
+#ifndef DCT_LISTING_H_
+#define DCT_LISTING_H_
+
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "filesys.h"
+
+namespace dct {
+
+struct ListedObject {
+  std::string name;  // full key/blob name, XML-unescaped
+  size_t size = 0;
+};
+
+struct ListedPage {
+  std::vector<ListedObject> objects;   // delimiter-terminal entries
+  std::vector<std::string> prefixes;   // common prefixes (with trailing '/')
+};
+
+// One delimiter="/" list request scoped to `prefix` (first page only).
+using ListPageFn = std::function<ListedPage(const std::string& prefix)>;
+
+// Resolve `path` (whose key/blob name is `name`, no leading '/') to a
+// FileInfo via the backend's list call; throws Error("<backend> path does
+// not exist: ...") when neither a file nor a virtual directory.
+inline FileInfo ProbePathInfo(const URI& path, const std::string& name,
+                              const ListPageFn& list_page,
+                              const char* backend) {
+  ListedPage page = list_page(name);
+  // empty name = container/bucket root: any content makes it a directory
+  std::string dir_prefix =
+      (name.empty() || name.back() == '/') ? name : name + "/";
+  bool is_dir = false;
+  for (const ListedObject& obj : page.objects) {
+    if (obj.name == name) {
+      FileInfo info;
+      info.path = path;
+      info.size = obj.size;
+      info.type = FileType::kFile;
+      return info;
+    }
+    if (obj.name.compare(0, dir_prefix.size(), dir_prefix) == 0) {
+      is_dir = true;
+    }
+  }
+  for (const std::string& p : page.prefixes) {
+    if (p == dir_prefix) is_dir = true;
+  }
+  if (!is_dir && dir_prefix != name) {
+    // The first page was scoped to `name` and may have been truncated by
+    // sibling keys sorting before '/' (e.g. 1000+ "data-*" keys hiding
+    // "data/..."). Probe under "<name>/" directly — any result means the
+    // directory exists.
+    ListedPage deep = list_page(dir_prefix);
+    is_dir = !deep.objects.empty() || !deep.prefixes.empty();
+  }
+  if (is_dir) {
+    FileInfo info;
+    info.path = path;
+    info.size = 0;
+    info.type = FileType::kDirectory;
+    return info;
+  }
+  throw Error(std::string(backend) + " path does not exist: " + path.Str());
+}
+
+}  // namespace dct
+
+#endif  // DCT_LISTING_H_

@@ -12,6 +12,7 @@
 
 #include "http.h"
 #include "http_stream.h"
+#include "listing.h"
 #include "sha256.h"
 
 namespace dct {
@@ -100,6 +101,50 @@ std::string BuildAuthorization(
   return "AWS4-HMAC-SHA256 Credential=" + cfg.access_key + "/" + scope +
          ", SignedHeaders=" + signed_header_names +
          ", Signature=" + signature;
+}
+
+std::string XmlUnescape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  size_t i = 0;
+  while (i < s.size()) {
+    if (s[i] != '&') {
+      out.push_back(s[i++]);
+      continue;
+    }
+    size_t semi = s.find(';', i);
+    if (semi == std::string::npos || semi - i > 10) {
+      out.push_back(s[i++]);
+      continue;
+    }
+    std::string ent = s.substr(i + 1, semi - i - 1);
+    if (ent == "amp") out.push_back('&');
+    else if (ent == "lt") out.push_back('<');
+    else if (ent == "gt") out.push_back('>');
+    else if (ent == "quot") out.push_back('"');
+    else if (ent == "apos") out.push_back('\'');
+    else if (!ent.empty() && ent[0] == '#') {
+      long code = ent[1] == 'x' || ent[1] == 'X'
+                      ? std::strtol(ent.c_str() + 2, nullptr, 16)
+                      : std::strtol(ent.c_str() + 1, nullptr, 10);
+      if (code > 0 && code < 128) {
+        out.push_back(static_cast<char>(code));
+      } else {  // non-ASCII codepoint -> UTF-8
+        if (code < 0x800) {
+          out.push_back(static_cast<char>(0xC0 | (code >> 6)));
+          out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+        } else {
+          out.push_back(static_cast<char>(0xE0 | (code >> 12)));
+          out.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+          out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+        }
+      }
+    } else {
+      out.append(s, i, semi - i + 1);  // unknown entity: keep literally
+    }
+    i = semi + 1;
+  }
+  return out;
 }
 
 bool XmlNextField(const std::string& xml, size_t* pos, const std::string& tag,
@@ -405,6 +450,7 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
       std::string k, sz;
       if (!s3::XmlNextField(chunk, &cp, "Key", &k)) continue;
       s3::XmlNextField(chunk, &cp, "Size", &sz);
+      k = s3::XmlUnescape(k);
       if (k == prefix) continue;  // the directory placeholder itself
       FileInfo info;
       info.path = URI("s3://" + bucket + "/" + k);
@@ -419,7 +465,7 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
       std::string p;
       if (!s3::XmlNextField(chunk, &cp, "Prefix", &p)) continue;
       FileInfo info;
-      std::string dir = p;
+      std::string dir = s3::XmlUnescape(p);
       if (!dir.empty() && dir.back() == '/') dir.pop_back();
       info.path = URI("s3://" + bucket + "/" + dir);
       info.size = 0;
@@ -430,15 +476,16 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
     while (s3::XmlNextField(resp.body, &pos, "CommonPrefixes", &chunk)) {
       size_t cp = 0;
       std::string p;
-      if (s3::XmlNextField(chunk, &cp, "Prefix", &p) && p > marker) {
-        marker = p;  // prefixes also advance the page marker
+      if (s3::XmlNextField(chunk, &cp, "Prefix", &p) &&
+          s3::XmlUnescape(p) > marker) {
+        marker = s3::XmlUnescape(p);  // prefixes also advance the marker
       }
     }
     std::string next_marker;
     pos = 0;
     if (s3::XmlNextField(resp.body, &pos, "NextMarker", &next_marker) &&
         !next_marker.empty()) {
-      marker = next_marker;  // authoritative when the server provides it
+      marker = s3::XmlUnescape(next_marker);  // authoritative when present
     }
     std::string truncated;
     pos = 0;
@@ -451,79 +498,45 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
 
 FileInfo S3FileSystem::GetPathInfo(const URI& path) {
   // TryGetPathInfo via ListObjects with the exact key as prefix
-  // (reference s3_filesys.cc:1221-1239)
+  // (reference s3_filesys.cc:1221-1239); file-vs-directory resolution is
+  // the shared ProbePathInfo (listing.h)
   std::string bucket, key;
   s3::SplitBucketKey(path, &bucket, &key);
   s3::Target t = s3::ResolveTarget(config_, bucket);
-  std::string prefix = key.substr(1);
-  std::vector<std::pair<std::string, std::string>> q = {
-      {"delimiter", "/"}, {"prefix", prefix}};
-  std::sort(q.begin(), q.end());
   std::string base = t.base_path.empty() ? "/" : t.base_path;
-  auto headers =
-      s3::SignedHeaders(config_, t, "GET", base, q, crypto::Sha256Hex(""));
-  HttpResponse resp =
-      HttpRequest(t.host, t.port, "GET",
-                  s3::UriEncode(base, true) + s3::QueryString(q), headers,
-                  "");
-  DCT_CHECK(resp.status == 200)
-      << "s3 ListObjects failed: " << resp.status << " " << resp.body;
-  size_t pos = 0;
-  std::string chunk;
-  bool is_dir = false;
-  // empty prefix = container/bucket root: any content makes it a directory
-  std::string dir_prefix =
-      (prefix.empty() || prefix.back() == '/') ? prefix : prefix + "/";
-  while (s3::XmlNextField(resp.body, &pos, "Contents", &chunk)) {
-    size_t cp = 0;
-    std::string k, sz;
-    if (!s3::XmlNextField(chunk, &cp, "Key", &k)) continue;
-    s3::XmlNextField(chunk, &cp, "Size", &sz);
-    if (k == prefix) {
-      FileInfo info;
-      info.path = path;
-      info.size = static_cast<size_t>(std::atoll(sz.c_str()));
-      info.type = FileType::kFile;
-      return info;
-    }
-    // only keys under "<prefix>/" make it a directory — a key that merely
-    // shares the string prefix (data vs database.csv) must not
-    if (k.compare(0, dir_prefix.size(), dir_prefix) == 0) is_dir = true;
-  }
-  size_t cpos = 0;
-  while (s3::XmlNextField(resp.body, &cpos, "CommonPrefixes", &chunk)) {
-    size_t cp = 0;
-    std::string p;
-    if (s3::XmlNextField(chunk, &cp, "Prefix", &p) && p == dir_prefix) {
-      is_dir = true;
-    }
-  }
-  if (!is_dir && dir_prefix != prefix) {
-    // The first page was scoped to `prefix` and may have been truncated by
-    // sibling keys sorting before '/' (e.g. 1000+ "data-*" keys hiding
-    // "data/..."). Probe under "<prefix>/" directly — any result means the
-    // directory exists.
-    std::vector<std::pair<std::string, std::string>> q2 = {
-        {"delimiter", "/"}, {"prefix", dir_prefix}};
-    std::sort(q2.begin(), q2.end());
-    auto h2 =
-        s3::SignedHeaders(config_, t, "GET", base, q2, crypto::Sha256Hex(""));
-    HttpResponse r2 =
+  auto list_page = [&](const std::string& pfx) {
+    std::vector<std::pair<std::string, std::string>> q = {
+        {"delimiter", "/"}, {"prefix", pfx}};
+    auto headers =
+        s3::SignedHeaders(config_, t, "GET", base, q, crypto::Sha256Hex(""));
+    HttpResponse resp =
         HttpRequest(t.host, t.port, "GET",
-                    s3::UriEncode(base, true) + s3::QueryString(q2), h2, "");
-    DCT_CHECK(r2.status == 200)
-        << "s3 ListObjects failed: " << r2.status << " " << r2.body;
-    is_dir = r2.body.find("<Contents>") != std::string::npos ||
-             r2.body.find("<CommonPrefixes>") != std::string::npos;
-  }
-  if (is_dir) {
-    FileInfo info;
-    info.path = path;
-    info.size = 0;
-    info.type = FileType::kDirectory;
-    return info;
-  }
-  throw Error("s3 path does not exist: " + path.Str());
+                    s3::UriEncode(base, true) + s3::QueryString(q), headers,
+                    "");
+    DCT_CHECK(resp.status == 200)
+        << "s3 ListObjects failed: " << resp.status << " " << resp.body;
+    ListedPage page;
+    size_t pos = 0;
+    std::string chunk;
+    while (s3::XmlNextField(resp.body, &pos, "Contents", &chunk)) {
+      size_t cp = 0;
+      std::string k, sz;
+      if (!s3::XmlNextField(chunk, &cp, "Key", &k)) continue;
+      s3::XmlNextField(chunk, &cp, "Size", &sz);
+      page.objects.push_back({s3::XmlUnescape(k),
+                              static_cast<size_t>(std::atoll(sz.c_str()))});
+    }
+    pos = 0;
+    while (s3::XmlNextField(resp.body, &pos, "CommonPrefixes", &chunk)) {
+      size_t cp = 0;
+      std::string p;
+      if (s3::XmlNextField(chunk, &cp, "Prefix", &p)) {
+        page.prefixes.push_back(s3::XmlUnescape(p));
+      }
+    }
+    return page;
+  };
+  return ProbePathInfo(path, key.substr(1), list_page, "s3");
 }
 
 SeekStream* S3FileSystem::OpenForRead(const URI& path, bool allow_null) {

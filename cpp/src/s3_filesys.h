@@ -82,6 +82,10 @@ std::string AmzDateNow();
 bool XmlNextField(const std::string& xml, size_t* pos,
                   const std::string& tag, std::string* out);
 
+// Decode XML character entities (&amp; &lt; &gt; &quot; &apos; &#NN;
+// &#xNN;) — object names come back entity-escaped in list XML.
+std::string XmlUnescape(const std::string& s);
+
 }  // namespace s3
 
 }  // namespace dct

@@ -1,0 +1,93 @@
+"""Dense multi-head attention — the single-device reference the ring path
+is checked against, plus a blockwise (flash-style) local variant.
+
+The reference library has no attention (no model compute at all); these ops
+exist so the sequence-parallel ring (parallel/ring.py) has an exact dense
+oracle and single-chip consumers have an MXU-friendly attention primitive:
+one fused [L, S] score matmul per head batch, bfloat16-safe accumulation in
+float32, static shapes throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["mha_reference", "blockwise_attention"]
+
+_NEG_INF = -1e30
+
+
+def mha_reference(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  causal: bool = False,
+                  scale: Optional[float] = None) -> jnp.ndarray:
+    """Exact softmax attention. q [B, L, H, D], k/v [B, S, H, D]."""
+    D = q.shape[-1]
+    if scale is None:
+        scale = D ** -0.5
+    scores = jnp.einsum("blhd,bshd->blhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        L, S = q.shape[1], k.shape[1]
+        mask = jnp.arange(L)[:, None] >= jnp.arange(S)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores, _NEG_INF)
+    w = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    w = w / jnp.maximum(w.sum(axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("blhs,bshd->blhd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        block_size: int = 512, causal: bool = False,
+                        scale: Optional[float] = None) -> jnp.ndarray:
+    """Single-device online-softmax attention over key blocks.
+
+    Identical math to mha_reference but never materializes the full [L, S]
+    score matrix — the HBM-friendly form for long single-chip sequences
+    (the in-chip analogue of the ring's per-device accumulator).
+    """
+    B, L, H, D = q.shape
+    S = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    nblk = -(-S // block_size)
+    pad = nblk * block_size - S
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = kp.reshape(B, nblk, block_size, H, D)
+    vb = vp.reshape(B, nblk, block_size, H, D)
+    qf = q.astype(jnp.float32) * scale
+    q_pos = jnp.arange(L)
+
+    m0 = jnp.full((B, L, H), _NEG_INF, jnp.float32)
+    s0 = jnp.zeros((B, L, H), jnp.float32)
+    o0 = jnp.zeros((B, L, H, D), jnp.float32)
+
+    def step(carry, blk):
+        m, s, o = carry
+        k_blk, v_blk, bidx = blk
+        scores = jnp.einsum("blhd,bmhd->blhm", qf,
+                            k_blk.astype(jnp.float32))
+        k_pos = bidx * block_size + jnp.arange(block_size)
+        valid = k_pos < S
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (L, block_size))
+        scores = jnp.where(valid[None, :, None, :], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        shift = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
+        pij = jnp.exp(scores - shift[..., None])
+        pij = jnp.where(valid[None, :, None, :], pij, 0.0)
+        alpha = jnp.exp(jnp.where(m <= _NEG_INF, _NEG_INF, m - shift))
+        s = s * alpha + pij.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "blhm,bmhd->blhd", pij, v_blk.astype(jnp.float32))
+        return (m_new, s, o), None
+
+    blocks = (jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0),
+              jnp.arange(nblk))
+    (m, s, o), _ = lax.scan(step, (m0, s0, o0), blocks)
+    return (o / jnp.maximum(s, 1e-30)[..., None]).astype(q.dtype)

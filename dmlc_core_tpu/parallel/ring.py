@@ -1,0 +1,177 @@
+"""Ring collectives and ring attention over a mesh axis.
+
+The reference's tracker *computes* a ring topology and brokers the TCP
+links for Rabit's ring allreduce (reference tracker.py:193-252
+find_share_ring/get_ring + assign_rank handing each worker its ring
+prev/next). On TPU the ring is the hardware: ICI neighbors under a
+`jax.sharding.Mesh` axis. This module provides the two ring algorithms that
+make long-context and multi-chip training first-class:
+
+- :func:`ring_allreduce` — the classic reduce-scatter + all-gather ring
+  (what Rabit runs over the tracker's ring_map), written with
+  `lax.ppermute` so each step moves one chunk to the ring neighbor. It is
+  numerically equivalent to `lax.psum`; `psum` is what production code
+  should call (XLA already routes it over ICI rings) — this explicit form
+  exists for Rabit-semantics parity and as the shard_map collective
+  template.
+- :func:`ring_attention` — blockwise attention over a sequence-sharded
+  axis (sequence/context parallelism): K/V blocks rotate around the ring
+  while each device keeps a flash-style online-softmax accumulator for its
+  local queries, so attention over a sequence of length P*L needs only
+  O(L) memory per device. No counterpart exists in the reference (SURVEY
+  §5: sequence parallelism ABSENT) — this is the TPU-native capability the
+  framework adds for long-context workloads.
+
+All functions here are *per-shard* code meant to run inside
+`jax.shard_map` over the relevant mesh axis; `sequence_parallel_attention`
+is the mesh-level wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_allreduce", "ring_attention",
+           "sequence_parallel_attention"]
+
+_NEG_INF = -1e30
+
+
+def _axis_size(axis_name: str) -> int:
+    return lax.psum(1, axis_name)
+
+
+def ring_allreduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Sum `x` across `axis_name` with an explicit 2(P-1)-step ring.
+
+    Per-shard function (call inside shard_map). Equivalent to
+    `lax.psum(x, axis_name)`; see module docstring for why both exist.
+    """
+    p = _axis_size(axis_name)
+    if p == 1:
+        return x
+    me = lax.axis_index(axis_name)
+    shape = x.shape
+    flat = x.reshape(-1)
+    # pad to P equal chunks
+    chunk = -(-flat.size // p)
+    flat = jnp.pad(flat, (0, chunk * p - flat.size))
+    chunks = flat.reshape(p, chunk)
+    fwd = [(i, (i + 1) % p) for i in range(p)]
+
+    # reduce-scatter: after P-1 steps, device d owns the full sum of chunk
+    # (d+1) mod P. Each step: send the chunk we just accumulated, add the
+    # incoming one.
+    def rs_step(s, chunks):
+        # send chunk index (me - s) mod p, receive (me - s - 1) mod p
+        send_idx = jnp.mod(me - s, p)
+        buf = lax.dynamic_index_in_dim(chunks, send_idx, axis=0,
+                                       keepdims=False)
+        got = lax.ppermute(buf, axis_name, fwd)
+        recv_idx = jnp.mod(me - s - 1, p)
+        recv = lax.dynamic_index_in_dim(chunks, recv_idx, axis=0,
+                                        keepdims=False)
+        return lax.dynamic_update_index_in_dim(chunks, recv + got, recv_idx,
+                                               axis=0)
+
+    chunks = lax.fori_loop(0, p - 1, rs_step, chunks)
+
+    # all-gather: rotate the completed chunks around the ring
+    def ag_step(s, chunks):
+        send_idx = jnp.mod(me + 1 - s, p)
+        buf = lax.dynamic_index_in_dim(chunks, send_idx, axis=0,
+                                       keepdims=False)
+        got = lax.ppermute(buf, axis_name, fwd)
+        recv_idx = jnp.mod(me - s, p)
+        return lax.dynamic_update_index_in_dim(chunks, got, recv_idx, axis=0)
+
+    chunks = lax.fori_loop(0, p - 1, ag_step, chunks)
+    return chunks.reshape(-1)[: x.size].reshape(shape)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Blockwise ring attention for sequence-sharded q/k/v.
+
+    Per-shard function (call inside shard_map over `axis_name`). Shapes are
+    local: q [B, L, H, D], k/v [B, L, H, D] — the global sequence is P*L
+    with this device holding block `axis_index`. K/V blocks travel the ring
+    (P ppermute steps) while a running (max, denominator, numerator)
+    accumulator applies the online-softmax rescaling, so the full [L, P*L]
+    score matrix never materializes.
+
+    causal=True masks by *global* positions: query i attends key j iff
+    global_i >= global_j, reproducing dense causal attention exactly.
+    """
+    p = _axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, L, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    fwd = [(i, (i + 1) % p) for i in range(p)]
+    q_pos = me * L + jnp.arange(L)  # global query positions
+
+    # derive the accumulator initializers from q so they carry the same
+    # device-varying axes as the data — scan requires the carry's varying
+    # set to be invariant, and q is varying over every enclosing shard_map
+    # axis (not just `axis_name` when nested in a larger mesh)
+    zero = qf[..., 0] * 0.0                      # [B, L, H] float32
+    m0 = zero + _NEG_INF
+    s0 = zero
+    o0 = qf * 0.0
+
+    def step(carry, _):
+        m, s, o, k_blk, v_blk, src = carry
+        scores = jnp.einsum("blhd,bmhd->blhm", qf,
+                            k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = src * L + jnp.arange(L)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [L, M]
+            scores = jnp.where(mask[None, :, None, :], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # guard fully-masked rows: exp(-inf - -inf) -> use stable shift
+        shift = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
+        pij = jnp.exp(scores - shift[..., None])
+        if causal:
+            pij = jnp.where(mask[None, :, None, :], pij, 0.0)
+        alpha = jnp.exp(jnp.where(m <= _NEG_INF, _NEG_INF, m - shift))
+        s = s * alpha + pij.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "blhm,bmhd->blhd", pij, v_blk.astype(jnp.float32))
+        # rotate k/v to the next device; we now hold block (src - 1) mod p
+        k_blk = lax.ppermute(k_blk, axis_name, fwd)
+        v_blk = lax.ppermute(v_blk, axis_name, fwd)
+        src = jnp.mod(src - 1, p)
+        return (m_new, s, o, k_blk, v_blk, src), None
+
+    (m, s, o, _, _, _), _ = lax.scan(step, (m0, s0, o0, k, v, me),
+                                     None, length=p)
+    out = o / jnp.maximum(s, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def sequence_parallel_attention(q: jnp.ndarray, k: jnp.ndarray,
+                                v: jnp.ndarray, mesh: Mesh,
+                                axis_name: str = "seq",
+                                causal: bool = False) -> jnp.ndarray:
+    """Mesh-level ring attention: shard the sequence axis, run the ring.
+
+    q/k/v are *global* arrays [B, S, H, D] with S divisible by the mesh
+    axis size; returns the attention output with the same sharding.
+    """
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal)
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec)
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
+    return mapped(q, k, v)

@@ -54,7 +54,8 @@ class MockAzureHandler(BaseHTTPRequestHandler):
         xms = sorted((k.lower(), v) for k, v in self.headers.items()
                      if k.lower().startswith("x-ms-"))
         canonical_headers = "".join(f"{k}:{v}\n" for k, v in xms)
-        canonical_resource = f"/{ACCOUNT}{urllib.parse.unquote(parsed.path)}"
+        # the spec signs the path exactly as sent (percent-encoded)
+        canonical_resource = f"/{ACCOUNT}{parsed.path}"
         for k, v in query:
             canonical_resource += f"\n{k.lower()}:{v}"
         length = str(len(body)) if body else ""
@@ -140,13 +141,14 @@ class MockAzureHandler(BaseHTTPRequestHandler):
                     prefixes.append(p)
             else:
                 blobs.append(n)
+        from xml.sax.saxutils import escape
         xml = ["<?xml version='1.0'?><EnumerationResults><Blobs>"]
         for n in blobs:
-            xml.append(f"<Blob><Name>{n}</Name><Properties>"
+            xml.append(f"<Blob><Name>{escape(n)}</Name><Properties>"
                        f"<Content-Length>{len(st.blobs[(container, n)])}"
                        f"</Content-Length></Properties></Blob>")
         for p in prefixes:
-            xml.append(f"<BlobPrefix><Name>{p}</Name></BlobPrefix>")
+            xml.append(f"<BlobPrefix><Name>{escape(p)}</Name></BlobPrefix>")
         xml.append("</Blobs><NextMarker/></EnumerationResults>")
         body = "".join(xml).encode()
         self.send_response(200)

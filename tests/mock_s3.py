@@ -157,14 +157,15 @@ class MockS3Handler(BaseHTTPRequestHandler):
                     prefixes.append(p)
             else:
                 contents.append(k)
+        from xml.sax.saxutils import escape
         xml = ["<?xml version='1.0'?><ListBucketResult>",
                "<IsTruncated>false</IsTruncated>"]
         for k in contents:
-            xml.append(f"<Contents><Key>{k}</Key>"
+            xml.append(f"<Contents><Key>{escape(k)}</Key>"
                        f"<Size>{len(st.objects[(bucket, k)])}</Size>"
                        f"</Contents>")
         for p in prefixes:
-            xml.append(f"<CommonPrefixes><Prefix>{p}</Prefix>"
+            xml.append(f"<CommonPrefixes><Prefix>{escape(p)}</Prefix>"
                        f"</CommonPrefixes>")
         xml.append("</ListBucketResult>")
         body = "".join(xml).encode()

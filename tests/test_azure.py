@@ -84,6 +84,22 @@ def test_path_info_prefix_collision_is_not_a_directory():
         path_info("azure://ctr/data")
 
 
+def test_blob_name_needing_percent_encoding():
+    # the wire path is percent-encoded and SharedKey signs the encoded
+    # form; a space would break a client signing the decoded path
+    put("dir/my file.txt", b"spaced out")
+    with NativeStream("azure://ctr/dir/my file.txt", "r") as s:
+        assert s.read_all() == b"spaced out"
+    assert path_info("azure://ctr/dir/my file.txt") == (10, False)
+
+
+def test_blob_name_with_xml_entities():
+    put("data/a&b.txt", b"ampersand")
+    entries = list_directory("azure://ctr/data")
+    assert entries == [("azure://ctr/data/a&b.txt", 9, "f")]
+    assert path_info("azure://ctr/data/a&b.txt") == (9, False)
+
+
 def test_write_small_single_put_blob():
     with NativeStream("azure://ctr/out/small.txt", "w") as s:
         s.write(b"tiny payload")

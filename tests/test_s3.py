@@ -120,6 +120,13 @@ def test_path_info_prefix_collision_is_not_a_directory():
         path_info("s3://bkt/data")
 
 
+def test_key_with_xml_entities():
+    put("data/a&b.txt", b"ampersand")
+    entries = list_directory("s3://bkt/data")
+    assert entries == [("s3://bkt/data/a&b.txt", 9, "f")]
+    assert path_info("s3://bkt/data/a&b.txt") == (9, False)
+
+
 def test_read_retry_on_short_reads():
     # server sends truncated bodies; client must reconnect at offset and
     # finish (reference retry loop, s3_filesys.cc:522-546)
