@@ -1,0 +1,120 @@
+// Bounded lock-free multi-producer/multi-consumer queue.
+//
+// Counterpart of the reference's vendored moodycamel concurrentqueue
+// (third-party, 4.7 kLoC, used by its unittest_lockfree.cc and available to
+// downstream consumers). This is an original implementation of the classic
+// bounded-array MPMC design (per-cell sequence counters, as published by
+// D. Vyukov): each cell carries an atomic sequence number that encodes
+// whether it is ready for the next enqueue or dequeue, so producers and
+// consumers only contend on their own head/tail counter plus the target
+// cell — no locks, no CAS loops over shared state beyond the counters.
+//
+// Semantics: TryPush/TryPop never block (return false on full/empty);
+// capacity is rounded up to a power of two. Elements are moved in/out.
+#ifndef DCT_LOCKFREE_H_
+#define DCT_LOCKFREE_H_
+
+#include <atomic>
+#include <cstddef>
+#include <memory>
+#include <utility>
+
+#include "base.h"
+
+namespace dct {
+
+template <typename T>
+class LockFreeQueue {
+ public:
+  explicit LockFreeQueue(size_t capacity) {
+    cap_ = 1;
+    while (cap_ < capacity) cap_ <<= 1;
+    mask_ = cap_ - 1;
+    cells_.reset(new Cell[cap_]);
+    for (size_t i = 0; i < cap_; ++i) {
+      cells_[i].seq.store(i, std::memory_order_relaxed);
+    }
+    head_.store(0, std::memory_order_relaxed);
+    tail_.store(0, std::memory_order_relaxed);
+  }
+
+  LockFreeQueue(const LockFreeQueue&) = delete;
+  LockFreeQueue& operator=(const LockFreeQueue&) = delete;
+
+  // Non-blocking enqueue; false when the queue is full.
+  bool TryPush(T value) {
+    Cell* cell;
+    size_t pos = tail_.load(std::memory_order_relaxed);
+    while (true) {
+      cell = &cells_[pos & mask_];
+      size_t seq = cell->seq.load(std::memory_order_acquire);
+      intptr_t dif = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos);
+      if (dif == 0) {
+        // cell free for this ticket; claim it
+        if (tail_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed)) {
+          break;
+        }
+      } else if (dif < 0) {
+        return false;  // full: cell still holds an unconsumed element
+      } else {
+        pos = tail_.load(std::memory_order_relaxed);  // lost the race
+      }
+    }
+    cell->value = std::move(value);
+    cell->seq.store(pos + 1, std::memory_order_release);
+    return true;
+  }
+
+  // Non-blocking dequeue; false when the queue is empty.
+  bool TryPop(T* out) {
+    Cell* cell;
+    size_t pos = head_.load(std::memory_order_relaxed);
+    while (true) {
+      cell = &cells_[pos & mask_];
+      size_t seq = cell->seq.load(std::memory_order_acquire);
+      intptr_t dif =
+          static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
+      if (dif == 0) {
+        if (head_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed)) {
+          break;
+        }
+      } else if (dif < 0) {
+        return false;  // empty: producer hasn't published this cell yet
+      } else {
+        pos = head_.load(std::memory_order_relaxed);
+      }
+    }
+    *out = std::move(cell->value);
+    // free the cell for the producer one lap ahead
+    cell->seq.store(pos + cap_, std::memory_order_release);
+    return true;
+  }
+
+  // Approximate (racy) size — diagnostics only.
+  size_t SizeApprox() const {
+    size_t t = tail_.load(std::memory_order_relaxed);
+    size_t h = head_.load(std::memory_order_relaxed);
+    return t >= h ? t - h : 0;
+  }
+
+  size_t capacity() const { return cap_; }
+
+ private:
+  // pad to separate the hot atomics from each other and the cells
+  struct Cell {
+    std::atomic<size_t> seq;
+    T value;
+  };
+
+  std::unique_ptr<Cell[]> cells_;
+  size_t cap_ = 0;
+  size_t mask_ = 0;
+  alignas(64) std::atomic<size_t> tail_;  // next enqueue ticket
+  alignas(64) std::atomic<size_t> head_;  // next dequeue ticket
+};
+
+}  // namespace dct
+
+#endif  // DCT_LOCKFREE_H_

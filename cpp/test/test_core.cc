@@ -18,6 +18,7 @@
 
 #include "../src/concurrency.h"
 #include "../src/config.h"
+#include "../src/lockfree.h"
 #include "../src/pipeline.h"
 #include "../src/filesys.h"
 #include "../src/input_split.h"
@@ -235,6 +236,69 @@ void TestConcurrentQueue() {
   EXPECT(pq.Pop(&s) && s == "hi-a");
   EXPECT(pq.Pop(&s) && s == "hi-b");
   EXPECT(pq.Pop(&s) && s == "low");
+}
+
+void TestLockFreeQueue() {
+  // single-threaded semantics: FIFO, full/empty edges, power-of-two cap
+  dct::LockFreeQueue<int> small(3);
+  EXPECT(small.capacity() == 4);
+  int v = -1;
+  EXPECT(!small.TryPop(&v));
+  for (int i = 0; i < 4; ++i) EXPECT(small.TryPush(i));
+  EXPECT(!small.TryPush(99));  // full
+  for (int i = 0; i < 4; ++i) {
+    EXPECT(small.TryPop(&v) && v == i);
+  }
+  EXPECT(!small.TryPop(&v));  // empty again
+  // wrap-around across several laps
+  for (int lap = 0; lap < 10; ++lap) {
+    EXPECT(small.TryPush(lap));
+    EXPECT(small.TryPop(&v) && v == lap);
+  }
+
+  // MPMC stress (counterpart of reference unittest_lockfree.cc): 4
+  // producers x 4 consumers, spin on full/empty, checksum must balance
+  dct::LockFreeQueue<long> q(256);
+  constexpr int kProducers = 4, kConsumers = 4, kPerProducer = 20000;
+  std::atomic<long> sum{0};
+  std::atomic<int> done_producers{0};
+  std::vector<std::thread> threads;
+  for (int p = 0; p < kProducers; ++p) {
+    threads.emplace_back([&q, &done_producers, p] {
+      for (int i = 0; i < kPerProducer; ++i) {
+        long item = static_cast<long>(p) * kPerProducer + i;
+        while (!q.TryPush(item)) std::this_thread::yield();
+      }
+      ++done_producers;
+    });
+  }
+  for (int c = 0; c < kConsumers; ++c) {
+    threads.emplace_back([&q, &sum, &done_producers] {
+      long item;
+      while (true) {
+        if (q.TryPop(&item)) {
+          sum += item;
+        } else if (done_producers.load() == kProducers) {
+          if (!q.TryPop(&item)) break;  // drained after producers finished
+          sum += item;
+        } else {
+          std::this_thread::yield();
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  long expect = 0;
+  for (int p = 0; p < kProducers; ++p)
+    for (int i = 0; i < kPerProducer; ++i)
+      expect += static_cast<long>(p) * kPerProducer + i;
+  EXPECT(sum == expect);
+
+  // move-only payloads
+  dct::LockFreeQueue<std::unique_ptr<int>> mq(8);
+  EXPECT(mq.TryPush(std::unique_ptr<int>(new int(42))));
+  std::unique_ptr<int> got;
+  EXPECT(mq.TryPop(&got) && got != nullptr && *got == 42);
 }
 
 void TestThreadGroup() {
@@ -541,6 +605,7 @@ int main(int argc, char** argv) {
   TestSingleFileSplit();
   TestJSON();
   TestConcurrentQueue();
+  TestLockFreeQueue();
   TestThreadGroup();
   TestPipelineExceptionPropagation();
   TestParameter();
