@@ -38,9 +38,14 @@ void PaddedBatcher::Accumulate() {
     for (size_t i = 0; i < n; ++i) {
       lens_.push_back(static_cast<int32_t>(b->offset[i + 1] - b->offset[i]));
     }
-    col_.reserve(col_.size() + nnz);
-    for (size_t i = 0; i < nnz; ++i) {
-      col_.push_back(static_cast<int32_t>(b->index[i]));
+    // uint32 -> int32 is bit-identical (ids >= 2^31 wrap negative either
+    // way and cannot be represented in the int32 device layout): bulk copy.
+    // Guard nnz == 0: data() may be null then and memcpy is nonnull-UB.
+    if (nnz != 0) {
+      const size_t col_old = col_.size();
+      col_.resize(col_old + nnz);
+      std::memcpy(col_.data() + col_old, b->index.data(),
+                  nnz * sizeof(int32_t));
     }
     val_.reserve(val_.size() + nnz);
     if (b->value_dtype == 1) {

@@ -27,14 +27,20 @@ inline const char* SkipBlankOrComment(const char* p, const char* end) {
 }
 
 // Advance past one line; *line_end receives the end of the current line
-// (excluding terminators); returns the start of the next line.
+// (excluding the terminator); returns the start of the next line. Both
+// '\n' and bare '\r' terminate a line (reference text_parser.h semantics);
+// memchr keeps the scans vectorized. "\r\n" and blank lines yield empty
+// lines which every parser skips.
 inline const char* LineSpan(const char* p, const char* end,
                             const char** line_end) {
-  const char* q = p;
-  while (q != end && *q != '\n' && *q != '\r') ++q;
-  *line_end = q;
-  while (q != end && (*q == '\n' || *q == '\r')) ++q;
-  return q;
+  const char* nl =
+      static_cast<const char*>(memchr(p, '\n', static_cast<size_t>(end - p)));
+  const char* limit = nl == nullptr ? end : nl;
+  const char* cr =
+      static_cast<const char*>(memchr(p, '\r', static_cast<size_t>(limit - p)));
+  const char* term = cr == nullptr ? limit : cr;
+  *line_end = term;
+  return term == end ? end : term + 1;
 }
 
 inline const char* SkipUTF8BOM(const char* p, const char* end) {

@@ -59,6 +59,21 @@ def test_libsvm_noeol(tmp_path):
     assert len(rows) == 2
 
 
+def test_libsvm_line_endings(tmp_path):
+    # \r\n, lone \r (classic Mac), and \n must all terminate rows
+    for text in ("1 0:1\r\n0 1:2\r\n", "1 0:1\r0 1:2\r", "1 0:1\r0 1:2\n"):
+        rows = parse_all(tmp_path, text)
+        assert [r["label"] for r in rows] == [1.0, 0.0], repr(text)
+        assert rows[1]["index"] == [1], repr(text)
+
+
+def test_libsvm_label_only_rows(tmp_path):
+    # rows with no features at all (nnz == 0 blocks through the batcher)
+    rows = parse_all(tmp_path, "0\n1\n0\n")
+    assert [r["label"] for r in rows] == [0.0, 1.0, 0.0]
+    assert all(r["index"] == [] for r in rows)
+
+
 def test_libsvm_bom(tmp_path):
     rows = parse_all(tmp_path, b"\xef\xbb\xbf1 0:1\n")
     assert rows == [{"label": 1.0, "index": [0], "value": [1.0]}]
