@@ -1,0 +1,120 @@
+// Fixed-size memory pooling utilities.
+//
+// Counterpart of reference include/dmlc/memory.h (MemoryPool page+free-list
+// allocator, ThreadlocalAllocator) and include/dmlc/thread_local.h
+// (ThreadLocalStore). The reference targets pre-C++11 thread_local
+// portability; here C++17 `thread_local` is a given so ThreadLocalStore is
+// a thin function-local singleton, and the pool keeps the same design:
+// pages of N objects carved sequentially, frees pushed on an intrusive
+// free list, everything released when the pool dies.
+#ifndef DCT_MEMORY_H_
+#define DCT_MEMORY_H_
+
+#include <cstddef>
+#include <memory>
+#include <type_traits>
+#include <vector>
+
+#include "base.h"
+
+namespace dct {
+
+// Thread-local singleton of T (reference thread_local.h:35 ThreadLocalStore).
+template <typename T>
+class ThreadLocalStore {
+ public:
+  static T* Get() {
+    static thread_local T inst;
+    return &inst;
+  }
+};
+
+// Pool of fixed-size, fixed-alignment allocations (reference memory.h:24-78
+// MemoryPool): O(1) allocate/deallocate, no per-object malloc.
+template <size_t kSize, size_t kAlign>
+class MemoryPool {
+ public:
+  MemoryPool() {
+    static_assert(kAlign % alignof(FreeNode) == 0,
+                  "alignment must fit the free-list node");
+    curr_page_.reset(new Page());
+  }
+
+  void* allocate() {
+    if (head_ != nullptr) {
+      FreeNode* ret = head_;
+      head_ = head_->next;
+      return ret;
+    }
+    if (page_pos_ < kPageLen) {
+      return &curr_page_->data[page_pos_++];
+    }
+    full_pages_.push_back(std::move(curr_page_));
+    curr_page_.reset(new Page());
+    page_pos_ = 1;
+    return &curr_page_->data[0];
+  }
+
+  void deallocate(void* p) {
+    FreeNode* node = static_cast<FreeNode*>(p);
+    node->next = head_;
+    head_ = node;
+  }
+
+ private:
+  // ~4 MB pages, at least one object each
+  static constexpr size_t kPageLen =
+      (1 << 22) / kSize > 0 ? (1 << 22) / kSize : 1;
+  struct Page {
+    typename std::aligned_storage<kSize, kAlign>::type data[kPageLen];
+  };
+  struct FreeNode {
+    FreeNode* next = nullptr;
+  };
+
+  FreeNode* head_ = nullptr;
+  std::unique_ptr<Page> curr_page_;
+  size_t page_pos_ = 0;
+  std::vector<std::unique_ptr<Page>> full_pages_;
+};
+
+// STL-compatible single-object allocator backed by a thread-local pool
+// (reference memory.h:80-144 ThreadlocalAllocator): for containers like
+// std::list/std::map whose nodes never cross threads.
+template <typename T>
+class ThreadlocalAllocator {
+ public:
+  using pointer = T*;
+  using const_pointer = const T*;
+  using value_type = T;
+
+  ThreadlocalAllocator() = default;
+  template <typename U>
+  ThreadlocalAllocator(const ThreadlocalAllocator<U>&) {}  // NOLINT
+
+  T* allocate(size_t n) {
+    DCT_CHECK_EQ(n, size_t(1))
+        << "ThreadlocalAllocator serves single-object nodes only";
+    using Store = ThreadLocalStore<MemoryPool<sizeof(T), alignof(T)>>;
+    return static_cast<T*>(Store::Get()->allocate());
+  }
+
+  void deallocate(T* p, size_t n) {
+    DCT_CHECK_EQ(n, size_t(1));
+    using Store = ThreadLocalStore<MemoryPool<sizeof(T), alignof(T)>>;
+    Store::Get()->deallocate(p);
+  }
+
+  template <typename U>
+  bool operator==(const ThreadlocalAllocator<U>&) const {
+    return true;
+  }
+  template <typename U>
+  bool operator!=(const ThreadlocalAllocator<U>&) const {
+    return false;
+  }
+};
+
+}  // namespace dct
+
+#endif  // DCT_MEMORY_H_

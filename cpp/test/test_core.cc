@@ -12,6 +12,7 @@
 #include <sys/stat.h>
 
 #include <atomic>
+#include <list>
 #include <map>
 #include <thread>
 #include <vector>
@@ -19,6 +20,7 @@
 #include "../src/concurrency.h"
 #include "../src/config.h"
 #include "../src/lockfree.h"
+#include "../src/memory.h"
 #include "../src/pipeline.h"
 #include "../src/filesys.h"
 #include "../src/input_split.h"
@@ -236,6 +238,43 @@ void TestConcurrentQueue() {
   EXPECT(pq.Pop(&s) && s == "hi-a");
   EXPECT(pq.Pop(&s) && s == "hi-b");
   EXPECT(pq.Pop(&s) && s == "low");
+}
+
+void TestMemoryPool() {
+  // sequential carve, free-list reuse, page rollover
+  dct::MemoryPool<64, 8> pool;
+  void* a = pool.allocate();
+  void* b = pool.allocate();
+  EXPECT(a != b);
+  pool.deallocate(a);
+  EXPECT(pool.allocate() == a);  // LIFO free-list reuse
+  // churn past one 4 MB page (65536 objects of 64 B)
+  std::vector<void*> ptrs;
+  for (int i = 0; i < 70000; ++i) ptrs.push_back(pool.allocate());
+  for (void* p : ptrs) pool.deallocate(p);
+
+  // STL container on the thread-local allocator; per-thread singletons
+  std::vector<std::thread> ts;
+  std::atomic<int> ok{0};
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([&ok] {
+      std::list<int, dct::ThreadlocalAllocator<int>> l;
+      for (int i = 0; i < 1000; ++i) l.push_back(i);
+      long sum = 0;
+      for (int v : l) sum += v;
+      if (sum == 999 * 1000 / 2) ++ok;
+    });
+  }
+  for (auto& t : ts) t.join();
+  EXPECT(ok == 4);
+
+  // ThreadLocalStore yields distinct instances per thread
+  int* main_inst = dct::ThreadLocalStore<int>::Get();
+  int* other_inst = nullptr;
+  std::thread([&other_inst] {
+    other_inst = dct::ThreadLocalStore<int>::Get();
+  }).join();
+  EXPECT(main_inst != other_inst);
 }
 
 void TestLockFreeQueue() {
@@ -605,6 +644,7 @@ int main(int argc, char** argv) {
   TestSingleFileSplit();
   TestJSON();
   TestConcurrentQueue();
+  TestMemoryPool();
   TestLockFreeQueue();
   TestThreadGroup();
   TestPipelineExceptionPropagation();

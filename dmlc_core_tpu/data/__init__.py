@@ -25,7 +25,7 @@ from typing import BinaryIO, Callable, Dict, Iterator, Optional
 
 import numpy as np
 
-from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.base import DMLCError, log_info
 from dmlc_core_tpu.io.native import NativeParser, RowBlock
 from dmlc_core_tpu.registry import Registry
 from dmlc_core_tpu.serializer import BinaryReader, BinaryWriter
@@ -365,12 +365,21 @@ class RowBlockIter:
             # native block views are only valid until the next next_block()
             # call, so snapshot each into a single-block container, then
             # merge once (O(n) total)
+            import time
             blocks = []
+            t0 = time.time()
+            next_log = 10 << 20  # MB/s every 10 MB (basic_row_iter.h:70-73)
             while True:
                 b = self._parser.next_block()
                 if b is None:
                     break
                 blocks.append(RowBlockContainer.from_blocks([b]))
+                nread = self._parser.bytes_read()
+                if nread >= next_log:
+                    dt = max(time.time() - t0, 1e-9)
+                    log_info("%.0f MB read, %.2f MB/sec",
+                             nread / 1e6, nread / 1e6 / dt)
+                    next_log += 10 << 20
             self._block = RowBlockContainer.from_blocks(blocks)
         return self._block
 

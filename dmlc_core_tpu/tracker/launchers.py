@@ -206,10 +206,12 @@ def submit_mpi(args) -> None:
 
 # -- sge ---------------------------------------------------------------------
 def build_sge_script() -> str:
+    # the in-container bootstrap derives DMLC_ROLE from DMLC_TASK_ID for
+    # array jobs (reference launcher.py:44-49) before exec'ing the command
     return ("source ~/.bashrc\n"
             "export DMLC_TASK_ID=${SGE_TASK_ID}\n"
             "export DMLC_JOB_CLUSTER=sge\n"
-            '"$@"\n')
+            'python3 -m dmlc_core_tpu.tracker.bootstrap "$@"\n')
 
 
 def build_sge_command(args, ntask: int, envs: Dict[str, object],
@@ -496,7 +498,11 @@ def build_yarn_command(args, role: str, n: int,
            "-container_memory", str(mem),
            "-container_vcores", str(cores)]
     cmd += shell_env
-    cmd += ["-shell_command", " ".join(args.command)]
+    # bootstrap extends LD_LIBRARY_PATH/CLASSPATH from HADOOP_HOME and
+    # unpacks DMLC_JOB_ARCHIVES inside the container (reference launcher.py)
+    cmd += ["-shell_command",
+            "python3 -m dmlc_core_tpu.tracker.bootstrap " +
+            " ".join(args.command)]
     return cmd
 
 

@@ -1,0 +1,73 @@
+"""In-container bootstrap tests (reference launcher.py behaviors)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dmlc_core_tpu.tracker import bootstrap
+
+
+def test_requires_job_cluster():
+    with pytest.raises(RuntimeError, match="DMLC_JOB_CLUSTER"):
+        bootstrap.build_env({})
+
+
+def test_sge_role_derivation():
+    base = {"DMLC_JOB_CLUSTER": "sge", "DMLC_NUM_WORKER": "2",
+            "DMLC_TASK_ID": "1"}
+    assert bootstrap.build_env(base)["DMLC_ROLE"] == "worker"
+    base["DMLC_TASK_ID"] = "2"
+    assert bootstrap.build_env(base)["DMLC_ROLE"] == "server"
+
+
+def test_hadoop_paths_and_classpath(tmp_path):
+    jar = tmp_path / "a.jar"
+    jar.write_bytes(b"")
+    base = {"DMLC_JOB_CLUSTER": "yarn",
+            "HADOOP_HOME": "/opt/hadoop",
+            "HADOOP_HDFS_HOME": "/opt/hdfs",
+            "JAVA_HOME": "/opt/java",
+            "LD_LIBRARY_PATH": "/pre"}
+    env = bootstrap.build_env(
+        base, classpath_runner=lambda cmd: str(tmp_path / "*.jar"))
+    assert env["CLASSPATH"] == str(jar)
+    assert env["LD_LIBRARY_PATH"].startswith("/pre:")
+    assert "/opt/hdfs/lib/native" in env["LD_LIBRARY_PATH"]
+    assert "/opt/java/jre/lib/amd64/server" in env["LD_LIBRARY_PATH"]
+    assert env["LIBHDFS_OPTS"] == "--Xmx128m"
+
+
+def test_hdfs_opts_passthrough():
+    env = bootstrap.build_env({"DMLC_JOB_CLUSTER": "local",
+                               "DMLC_HDFS_OPTS": "--Xmx1g"})
+    assert env["LIBHDFS_OPTS"] == "--Xmx1g"
+
+
+def test_unzip_archives_dispatch(tmp_path):
+    (tmp_path / "a.zip").write_bytes(b"")
+    (tmp_path / "b.tar.gz").write_bytes(b"")
+    calls = []
+    bootstrap.unzip_archives(
+        [str(tmp_path / "a.zip"), str(tmp_path / "b.tar.gz"),
+         str(tmp_path / "missing.zip")],
+        env={}, runner=lambda args, env: calls.append(args))
+    assert calls[0][0] == "unzip"
+    assert calls[1][0] == "tar"
+    assert len(calls) == 2  # missing file skipped
+
+
+def test_main_execs_command(tmp_path):
+    marker = tmp_path / "ran.txt"
+    env = dict(os.environ)
+    env["DMLC_JOB_CLUSTER"] = "local"
+    r = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.tracker.bootstrap",
+         sys.executable, "-c",
+         f"import pathlib; pathlib.Path(r'{marker}').write_text('ok')"],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(bootstrap.__file__))) + "/..",
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert marker.read_text() == "ok"

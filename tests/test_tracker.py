@@ -361,7 +361,8 @@ def test_yarn_command():
     assert "DMLC_JOB_CLUSTER=yarn" in cmd
     assert "DMLC_ROLE=worker" in cmd  # per-role submission, like mpi/slurm
     assert cmd[cmd.index("-container_memory") + 1] == "512"
-    assert cmd[-1] == "./t"
+    # user command is wrapped by the in-container bootstrap
+    assert cmd[-1] == "python3 -m dmlc_core_tpu.tracker.bootstrap ./t"
 
 
 def test_mesos_command():
