@@ -1,0 +1,113 @@
+"""Pallas TPU kernels for the hot device-side batch transforms.
+
+The one on-device transform SURVEY §7 calls out: CSR -> padded-dense batch
+formatting. Scatter is hostile to the TPU's vector/matrix units (no fast
+random writes across lanes), so the kernel reformulates it as matmuls —
+the TPU-native move:
+
+    col_mix[K, F] = val * onehot(col)        (VPU elementwise build)
+    dense[R, F]  += onehot(rows)[R, K] @ col_mix[K, F]   (MXU)
+
+The grid walks the nonzeros in K-sized chunks; TPU grid steps execute
+sequentially over the same output block, so the accumulation across steps
+is well-defined (zero-init at step 0). Padding entries carry row == R and
+val == 0 (the PaddedBatch layout contract, tpu/device_iter.py), so they
+fall out of the one-hots naturally.
+
+On CPU (tests, virtual meshes) the kernel runs in interpret mode; the
+public wrapper picks automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["csr_to_dense_pallas"]
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _csr_scatter_kernel(row_ref, col_ref, val_ref, out_ref, *, chunk: int):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    r = row_ref[:]                      # [chunk] int32
+    c = col_ref[:]
+    v = val_ref[:].astype(jnp.float32)
+    R, F = out_ref.shape
+
+    # scatter-as-matmul: one-hot membership built on the VPU, accumulated
+    # through one MXU matmul per chunk
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (chunk, F), 1)
+    col_mix = jnp.where(col_ids == c[:, None], v[:, None], 0.0)  # [K, F]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (R, chunk), 0)
+    row_oh = (row_ids == r[None, :]).astype(jnp.float32)         # [R, K]
+    # Precision.HIGHEST: the MXU's default bf16 multiply would round the
+    # values on their way through the one-hot (row_oh entries are exact
+    # 0/1, but col_mix carries the data)
+    out_ref[:] += jnp.dot(row_oh, col_mix,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_rows", "num_features", "chunk",
+                                    "interpret"))
+def _csr_to_dense_call(row, col, val, num_rows: int, num_features: int,
+                       chunk: int, interpret: bool):
+    # pad to TPU-friendly shapes: rows to the f32 sublane multiple, features
+    # to the lane width, nnz to whole chunks. nnz pads carry row ==
+    # num_rows (the sacrificial row, sliced away below) and val == 0.
+    R_pad = max(_round_up(num_rows + 1, 8), 8)
+    F_pad = max(_round_up(num_features, 128), 128)
+    nnz = row.shape[0]
+    nnz_pad = max(_round_up(nnz, chunk), chunk)
+    if nnz_pad != nnz:
+        pad = nnz_pad - nnz
+        row = jnp.pad(row, (0, pad), constant_values=num_rows)
+        col = jnp.pad(col, (0, pad))
+        val = jnp.pad(val, (0, pad))
+
+    grid = nnz_pad // chunk
+    out = pl.pallas_call(
+        functools.partial(_csr_scatter_kernel, chunk=chunk),
+        out_shape=jax.ShapeDtypeStruct((R_pad, F_pad), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((chunk,), lambda i: (i,)),
+            pl.BlockSpec((chunk,), lambda i: (i,)),
+            pl.BlockSpec((chunk,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((R_pad, F_pad), lambda i: (0, 0)),
+        interpret=interpret,
+    )(row, col, val)
+    return out[:num_rows, :num_features]
+
+
+def csr_to_dense_pallas(row: jnp.ndarray, col: jnp.ndarray,
+                        val: jnp.ndarray, num_rows: int, num_features: int,
+                        chunk: int = 1024,
+                        interpret: bool | None = None) -> jnp.ndarray:
+    """Pallas CSR -> dense [num_rows, num_features] (ops.sparse.csr_to_dense
+    semantics: padding rows == num_rows dropped, duplicate (r, c) summed).
+
+    interpret=None auto-selects interpret mode off-TPU so the same tests
+    run on the virtual CPU mesh. On real TPUs `chunk` must be a multiple
+    of 1024 — the XLA layout tile for 1-D int32 operands that Mosaic
+    requires block shapes to align with (smaller chunks are fine in
+    interpret mode).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _csr_to_dense_call(row, col, jnp.asarray(val, jnp.float32),
+                              int(num_rows), int(num_features), int(chunk),
+                              bool(interpret))

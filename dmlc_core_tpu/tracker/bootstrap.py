@@ -57,9 +57,12 @@ def build_env(base: Dict[str, str],
 
     library_path = ["./"]
     class_path: List[str] = []
-    if hadoop_home and hdfs_home:
+    if hdfs_home:
         library_path.append(f"{hdfs_home}/lib/native")
         library_path.append(f"{hdfs_home}/lib")
+    if hadoop_home:
+        # classpath expansion needs only the hadoop CLI (reference
+        # launcher.py gates it on HADOOP_HOME alone)
         if classpath_runner is None:
             def classpath_runner(cmd):  # pragma: no cover - needs hadoop
                 return subprocess.run(cmd, shell=True, capture_output=True,

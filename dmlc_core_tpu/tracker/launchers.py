@@ -207,9 +207,11 @@ def submit_mpi(args) -> None:
 # -- sge ---------------------------------------------------------------------
 def build_sge_script() -> str:
     # the in-container bootstrap derives DMLC_ROLE from DMLC_TASK_ID for
-    # array jobs (reference launcher.py:44-49) before exec'ing the command
+    # array jobs (reference launcher.py:44-49) before exec'ing the command.
+    # SGE_TASK_ID is 1-based (qsub -t 1-N); DMLC_TASK_ID is 0-based
+    # everywhere else in this tracker, so shift here.
     return ("source ~/.bashrc\n"
-            "export DMLC_TASK_ID=${SGE_TASK_ID}\n"
+            "export DMLC_TASK_ID=$((SGE_TASK_ID - 1))\n"
             "export DMLC_JOB_CLUSTER=sge\n"
             'python3 -m dmlc_core_tpu.tracker.bootstrap "$@"\n')
 
@@ -484,6 +486,8 @@ def build_yarn_command(args, role: str, n: int,
     e = dict(envs)
     e["DMLC_ROLE"] = role
     e["DMLC_JOB_CLUSTER"] = "yarn"
+    if getattr(args, "archives", None):
+        e["DMLC_JOB_ARCHIVES"] = ":".join(args.archives)
     shell_env = []
     for k, v in e.items():
         shell_env += ["-shell_env", f"{k}={v}"]

@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="remote working dir (ssh/tpu-pod rsync target)")
     p.add_argument("--num-attempt", default=0, type=int,
                    help="retry attempts per worker (local backend)")
+    p.add_argument("--archives", default=[], action="append",
+                   help="archive (.zip/.tar*) the in-container bootstrap "
+                        "unpacks before exec (reference opts.py archives); "
+                        "repeatable")
     p.add_argument("--slurm-worker-nodes", default=None, type=int)
     p.add_argument("--slurm-server-nodes", default=None, type=int)
     p.add_argument("--worker-memory-mb", default=1024, type=int,

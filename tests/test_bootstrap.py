@@ -39,6 +39,33 @@ def test_hadoop_paths_and_classpath(tmp_path):
     assert env["LIBHDFS_OPTS"] == "--Xmx128m"
 
 
+def test_classpath_needs_only_hadoop_home(tmp_path):
+    jar = tmp_path / "b.jar"
+    jar.write_bytes(b"")
+    env = bootstrap.build_env(
+        {"DMLC_JOB_CLUSTER": "yarn", "HADOOP_HOME": "/opt/hadoop"},
+        classpath_runner=lambda cmd: str(tmp_path / "*.jar"))
+    assert env["CLASSPATH"] == str(jar)
+    assert "lib/native" not in env["LD_LIBRARY_PATH"]  # needs HDFS_HOME
+
+
+def test_sge_script_zero_bases_task_id():
+    from dmlc_core_tpu.tracker.launchers import build_sge_script
+    # SGE_TASK_ID is 1-based; the exported DMLC_TASK_ID must be 0-based so
+    # `task_id < num_worker` and process-id consumers line up
+    assert "$((SGE_TASK_ID - 1))" in build_sge_script()
+
+
+def test_yarn_exports_archives():
+    from dmlc_core_tpu.tracker.launchers import build_yarn_command
+    from tests.test_tracker import get_opts
+    args = get_opts(["--cluster=yarn", "--num-workers=1",
+                     "--archives=deps.zip", "--archives=data.tar.gz",
+                     "--", "./t"])
+    cmd = build_yarn_command(args, "worker", 1, {})
+    assert "DMLC_JOB_ARCHIVES=deps.zip:data.tar.gz" in cmd
+
+
 def test_hdfs_opts_passthrough():
     env = bootstrap.build_env({"DMLC_JOB_CLUSTER": "local",
                                "DMLC_HDFS_OPTS": "--Xmx1g"})
