@@ -1,0 +1,12 @@
+"""Utility layer: timing/trace spans and URI-stream checkpointing."""
+
+from dmlc_core_tpu.utils.checkpoint import (fast_forward,  # noqa: F401
+                                            restore_checkpoint,
+                                            save_checkpoint)
+from dmlc_core_tpu.utils.timer import (Timer, get_time,  # noqa: F401
+                                       reset_span_totals, span_totals,
+                                       trace_span)
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "fast_forward",
+           "Timer", "get_time", "trace_span", "span_totals",
+           "reset_span_totals"]

@@ -1,0 +1,93 @@
+"""Timing + trace-span utilities.
+
+Counterpart of reference include/dmlc/timer.h (`GetTime`, timer.h:27) plus
+the greenfield span API SURVEY §5 notes the reference lacks: lightweight
+named spans that aggregate wall time and, when requested, forward to
+`jax.profiler.TraceAnnotation` so host-side pipeline stages line up with
+device traces in the profiler UI.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+__all__ = ["get_time", "Timer", "trace_span", "span_totals",
+           "reset_span_totals"]
+
+
+def get_time() -> float:
+    """Seconds from a monotonic high-resolution clock (reference
+    timer.h:27 GetTime)."""
+    return time.monotonic()
+
+
+class Timer:
+    """Accumulating stopwatch: start/stop many times, read the total."""
+
+    def __init__(self) -> None:
+        self._total = 0.0
+        self._started: Optional[float] = None
+
+    def start(self) -> "Timer":
+        self._started = get_time()
+        return self
+
+    def stop(self) -> float:
+        if self._started is not None:
+            self._total += get_time() - self._started
+            self._started = None
+        return self._total
+
+    @property
+    def total(self) -> float:
+        running = (get_time() - self._started
+                   if self._started is not None else 0.0)
+        return self._total + running
+
+    def __enter__(self) -> "Timer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+_totals: Dict[str, float] = {}
+_counts: Dict[str, int] = {}
+_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def trace_span(name: str, profiler: bool = False) -> Iterator[None]:
+    """Named span: aggregates into span_totals(); with profiler=True the
+    span also appears in `jax.profiler` traces (host rows)."""
+    ctx = contextlib.nullcontext()
+    if profiler:
+        import jax.profiler
+        ctx = jax.profiler.TraceAnnotation(name)
+    t0 = get_time()
+    try:
+        with ctx:
+            yield
+    finally:
+        # attribute time even when the body raises — a failing stage still
+        # spent the time
+        dt = get_time() - t0
+        with _lock:
+            _totals[name] = _totals.get(name, 0.0) + dt
+            _counts[name] = _counts.get(name, 0) + 1
+
+
+def span_totals() -> Dict[str, Dict[str, float]]:
+    """{name: {"total_s": ..., "count": ...}} aggregated across threads."""
+    with _lock:
+        return {k: {"total_s": _totals[k], "count": _counts[k]}
+                for k in _totals}
+
+
+def reset_span_totals() -> None:
+    with _lock:
+        _totals.clear()
+        _counts.clear()

@@ -1,0 +1,172 @@
+"""Checkpoint/resume and timer/trace utility tests."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.utils.checkpoint import (fast_forward, restore_checkpoint,
+                                            save_checkpoint)
+from dmlc_core_tpu.utils.timer import (Timer, get_time, reset_span_totals,
+                                       span_totals, trace_span)
+
+
+def params_tree():
+    return {"w": jnp.arange(12.0).reshape(3, 4),
+            "layers": [{"b": jnp.ones((5,))},
+                       {"b": jnp.zeros((5,))}],
+            "step_scale": np.float32(0.5)}
+
+
+def test_checkpoint_roundtrip_local(tmp_path):
+    uri = str(tmp_path / "ckpt.bin")
+    p = params_tree()
+    save_checkpoint(uri, p, step=42, extra={"note": "hello"})
+    restored, step, extra = restore_checkpoint(uri, like=p)
+    assert step == 42 and extra == {"note": "hello"}
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_without_template_returns_dict(tmp_path):
+    uri = str(tmp_path / "ckpt.bin")
+    save_checkpoint(uri, {"x": np.arange(3)}, step=1)
+    flat, step, _ = restore_checkpoint(uri)
+    assert step == 1
+    (key, arr), = flat.items()
+    np.testing.assert_array_equal(arr, np.arange(3))
+
+
+def test_checkpoint_restores_sharding(tmp_path):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    sharded = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P("data")))
+    uri = str(tmp_path / "ckpt.bin")
+    save_checkpoint(uri, {"v": sharded})
+    restored, _, _ = restore_checkpoint(uri, like={"v": sharded})
+    assert restored["v"].sharding == sharded.sharding
+    np.testing.assert_array_equal(np.asarray(restored["v"]), np.arange(8.0))
+
+
+def test_restored_arrays_are_mutable(tmp_path):
+    uri = str(tmp_path / "ckpt.bin")
+    save_checkpoint(uri, {"w": np.arange(4.0)})
+    flat, _, _ = restore_checkpoint(uri)
+    flat["$['w']" if "$['w']" in flat else list(flat)[0]] += 1.0  # no raise
+
+
+def test_checkpoint_dtype_mismatch_rejected(tmp_path):
+    uri = str(tmp_path / "ckpt.bin")
+    save_checkpoint(uri, {"w": np.zeros(3, np.float64)})
+    with pytest.raises(DMLCError, match="dtype mismatch"):
+        restore_checkpoint(uri, like={"w": np.zeros(3, np.float32)})
+
+
+def test_trace_span_counts_failing_bodies():
+    reset_span_totals()
+    with pytest.raises(ValueError):
+        with trace_span("stage.fails"):
+            time.sleep(0.002)
+            raise ValueError("boom")
+    totals = span_totals()
+    assert totals["stage.fails"]["count"] == 1
+    assert totals["stage.fails"]["total_s"] >= 0.002
+
+
+def test_checkpoint_shape_mismatch_rejected(tmp_path):
+    uri = str(tmp_path / "ckpt.bin")
+    save_checkpoint(uri, {"w": np.zeros((2, 2))})
+    with pytest.raises(DMLCError, match="shape mismatch"):
+        restore_checkpoint(uri, like={"w": np.zeros((3, 3))})
+
+
+def test_checkpoint_tree_mismatch_rejected(tmp_path):
+    uri = str(tmp_path / "ckpt.bin")
+    save_checkpoint(uri, {"w": np.zeros(2)})
+    with pytest.raises(DMLCError, match="does not match template"):
+        restore_checkpoint(uri, like={"different": np.zeros(2)})
+
+
+def test_checkpoint_bad_magic(tmp_path):
+    path = tmp_path / "junk.bin"
+    path.write_bytes(b"\x00" * 64)
+    with pytest.raises(DMLCError):
+        restore_checkpoint(str(path))
+
+
+def test_checkpoint_over_remote_stream():
+    # checkpoints ride the same URI-dispatched filesystems as the data
+    import tests.mock_webhdfs as m
+    state, port, shutdown = m.serve()
+    try:
+        uri = f"hdfs://127.0.0.1:{port}/ckpt/model.bin"
+        p = params_tree()
+        save_checkpoint(uri, p, step=7)
+        restored, step, _ = restore_checkpoint(uri, like=p)
+        assert step == 7
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(p["w"]))
+    finally:
+        shutdown()
+
+
+def test_checkpoint_resume_training_equivalence(tmp_path):
+    # save at step 2, restore, continue: must match uninterrupted training
+    from dmlc_core_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+    from jax.sharding import Mesh
+    cfg = TransformerConfig(vocab=11, max_seq=8, embed=16, heads=2, layers=1)
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    model = TransformerLM(cfg, Mesh(devs, ("data", "seq")),
+                          learning_rate=0.2)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 11, size=(2, 9), dtype=np.int64)
+    t, l = toks[:, :-1].astype(np.int32), toks[:, 1:].astype(np.int32)
+
+    p = model.init(seed=3)
+    for _ in range(2):
+        p, _ = model.step(p, t, l)
+    uri = str(tmp_path / "resume.bin")
+    save_checkpoint(uri, p, step=2)
+    for _ in range(2):
+        p, _ = model.step(p, t, l)          # uninterrupted: 4 steps total
+
+    q, step, _ = restore_checkpoint(uri, like=model.init(seed=3))
+    assert step == 2
+    for _ in range(2):
+        q, _ = model.step(q, t, l)          # resumed: 2 + 2 steps
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(q)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_fast_forward():
+    it = fast_forward(iter(range(10)), 4)
+    assert next(it) == 4
+
+
+def test_timer_accumulates():
+    t = Timer()
+    with t:
+        time.sleep(0.01)
+    with t:
+        time.sleep(0.01)
+    assert t.total >= 0.02
+    assert get_time() > 0
+
+
+def test_trace_spans_aggregate():
+    reset_span_totals()
+    for _ in range(3):
+        with trace_span("stage.parse"):
+            time.sleep(0.002)
+    with trace_span("stage.pad", profiler=True):
+        time.sleep(0.002)
+    totals = span_totals()
+    assert totals["stage.parse"]["count"] == 3
+    assert totals["stage.parse"]["total_s"] >= 0.006
+    assert totals["stage.pad"]["count"] == 1
