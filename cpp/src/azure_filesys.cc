@@ -110,10 +110,16 @@ struct Target {
 };
 
 Target ResolveTarget(const AzureConfig& cfg) {
-  if (!cfg.endpoint_host.empty()) {
-    return {cfg.endpoint_host, cfg.endpoint_port};
-  }
-  return {cfg.account + ".blob.core.windows.net", 80};
+  // The built-in client speaks plain HTTP only. Real Azure accounts enforce
+  // secure transfer and would reject (or worse, silently downgrade) port-80
+  // traffic, so refuse to guess a public endpoint: require AZURE_ENDPOINT to
+  // name an emulator/TLS-terminating gateway explicitly.
+  DCT_CHECK(!cfg.endpoint_host.empty())
+      << "AZURE_ENDPOINT is not set; the built-in azure client is http-only "
+      << "and will not talk to " << cfg.account
+      << ".blob.core.windows.net directly. Point AZURE_ENDPOINT at an "
+      << "Azurite emulator or an https-terminating local gateway.";
+  return {cfg.endpoint_host, cfg.endpoint_port};
 }
 
 // azure://container/blob-path -> ("/container", "/blob/path")

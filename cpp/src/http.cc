@@ -177,6 +177,24 @@ void HttpConnection::ReadFullBody(HttpResponse* out) {
   }
 }
 
+namespace {
+
+int ParsePortOrDie(const std::string& where, const std::string& text) {
+  DCT_CHECK(!text.empty() && text.size() <= 5)
+      << "invalid port '" << text << "' in '" << where << "'";
+  long v = 0;
+  for (char c : text) {
+    DCT_CHECK(isdigit(static_cast<unsigned char>(c)))
+        << "invalid port '" << text << "' in '" << where << "'";
+    v = v * 10 + (c - '0');
+  }
+  DCT_CHECK(v >= 1 && v <= 65535)
+      << "port " << v << " out of range (1-65535) in '" << where << "'";
+  return static_cast<int>(v);
+}
+
+}  // namespace
+
 void SplitHostPort(const std::string& s, std::string* host, int* port,
                    int default_port) {
   *host = s;
@@ -185,8 +203,10 @@ void SplitHostPort(const std::string& s, std::string* host, int* port,
     size_t close = s.find(']');
     DCT_CHECK(close != std::string::npos) << "unterminated [v6] host: " << s;
     *host = s.substr(1, close - 1);
-    if (close + 1 < s.size() && s[close + 1] == ':') {
-      *port = std::atoi(s.c_str() + close + 2);
+    if (close + 1 < s.size()) {
+      DCT_CHECK(s[close + 1] == ':')
+          << "unexpected trailing text after [v6] host: " << s;
+      *port = ParsePortOrDie(s, s.substr(close + 2));
     }
     return;
   }
@@ -194,14 +214,8 @@ void SplitHostPort(const std::string& s, std::string* host, int* port,
   if (colon == std::string::npos || s.rfind(':') != colon) {
     return;  // no port, or bare IPv6 literal
   }
-  bool digits = colon + 1 < s.size();
-  for (size_t i = colon + 1; i < s.size(); ++i) {
-    if (!isdigit(static_cast<unsigned char>(s[i]))) digits = false;
-  }
-  if (digits) {
-    *host = s.substr(0, colon);
-    *port = std::atoi(s.c_str() + colon + 1);
-  }
+  *host = s.substr(0, colon);
+  *port = ParsePortOrDie(s, s.substr(colon + 1));
 }
 
 HttpResponse HttpRequest(const std::string& host, int port,

@@ -123,21 +123,28 @@ std::string XmlUnescape(const std::string& s) {
     else if (ent == "gt") out.push_back('>');
     else if (ent == "quot") out.push_back('"');
     else if (ent == "apos") out.push_back('\'');
-    else if (!ent.empty() && ent[0] == '#') {
+    else if (ent.size() > 1 && ent[0] == '#') {
+      char* end = nullptr;
       long code = ent[1] == 'x' || ent[1] == 'X'
-                      ? std::strtol(ent.c_str() + 2, nullptr, 16)
-                      : std::strtol(ent.c_str() + 1, nullptr, 10);
-      if (code > 0 && code < 128) {
+                      ? std::strtol(ent.c_str() + 2, &end, 16)
+                      : std::strtol(ent.c_str() + 1, &end, 10);
+      if (end == nullptr || *end != '\0' || code <= 0 || code > 0x10FFFF ||
+          (code >= 0xD800 && code <= 0xDFFF)) {  // UTF-16 surrogates
+        out.append(s, i, semi - i + 1);  // malformed/out-of-range: keep literal
+      } else if (code < 128) {
         out.push_back(static_cast<char>(code));
-      } else {  // non-ASCII codepoint -> UTF-8
-        if (code < 0x800) {
-          out.push_back(static_cast<char>(0xC0 | (code >> 6)));
-          out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
-        } else {
-          out.push_back(static_cast<char>(0xE0 | (code >> 12)));
-          out.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
-          out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
-        }
+      } else if (code < 0x800) {
+        out.push_back(static_cast<char>(0xC0 | (code >> 6)));
+        out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+      } else if (code < 0x10000) {
+        out.push_back(static_cast<char>(0xE0 | (code >> 12)));
+        out.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+        out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+      } else {  // supplementary plane needs 4 bytes
+        out.push_back(static_cast<char>(0xF0 | (code >> 18)));
+        out.push_back(static_cast<char>(0x80 | ((code >> 12) & 0x3F)));
+        out.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+        out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
       }
     } else {
       out.append(s, i, semi - i + 1);  // unknown entity: keep literally

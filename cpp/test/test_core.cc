@@ -28,7 +28,9 @@
 #include "../src/json.h"
 #include "../src/parameter.h"
 #include "../src/parser.h"
+#include "../src/http.h"
 #include "../src/registry.h"
+#include "../src/s3_filesys.h"
 #include "../src/serializer.h"
 #include "../src/stream.h"
 
@@ -631,6 +633,51 @@ void TestStdinSplit() {
   std::printf("STDIN:%s\n", all.c_str());
 }
 
+void TestXmlUnescape() {
+  using dct::s3::XmlUnescape;
+  EXPECT(XmlUnescape("a&amp;b&lt;c&gt;d") == "a&b<c>d");
+  EXPECT(XmlUnescape("&#65;&#x42;") == "AB");
+  // 2- and 3-byte UTF-8
+  EXPECT(XmlUnescape("&#233;") == "\xC3\xA9");          // é
+  EXPECT(XmlUnescape("&#x20AC;") == "\xE2\x82\xAC");    // €
+  // supplementary plane needs a 4-byte sequence (U+1F600)
+  EXPECT(XmlUnescape("&#x1F600;") == "\xF0\x9F\x98\x80");
+  EXPECT(XmlUnescape("&#128512;") == "\xF0\x9F\x98\x80");
+  // malformed / out-of-range entities stay literal
+  EXPECT(XmlUnescape("&#;") == "&#;");
+  EXPECT(XmlUnescape("&#x;") == "&#x;");
+  EXPECT(XmlUnescape("&#xZZ;") == "&#xZZ;");
+  EXPECT(XmlUnescape("&#1114112;") == "&#1114112;");  // > U+10FFFF
+  EXPECT(XmlUnescape("&#xD800;") == "&#xD800;");      // UTF-16 surrogate
+  EXPECT(XmlUnescape("&#65a;") == "&#65a;");          // trailing junk
+  EXPECT(XmlUnescape("&bogus;") == "&bogus;");
+}
+
+void TestSplitHostPort() {
+  std::string host;
+  int port = 0;
+  dct::SplitHostPort("example.com:8443", &host, &port, 80);
+  EXPECT(host == "example.com" && port == 8443);
+  dct::SplitHostPort("example.com", &host, &port, 80);
+  EXPECT(host == "example.com" && port == 80);
+  dct::SplitHostPort("[::1]:9000", &host, &port, 80);
+  EXPECT(host == "::1" && port == 9000);
+  dct::SplitHostPort("::1", &host, &port, 80);  // bare v6: no port split
+  EXPECT(host == "::1" && port == 80);
+  // invalid port suffixes must fail loudly, not leak 'host:junk' to DNS
+  const char* bad[] = {"host:", "host:80a", "host:0", "host:65536",
+                       "host:123456", "[::1]:x"};
+  for (const char* s : bad) {
+    bool threw = false;
+    try {
+      dct::SplitHostPort(s, &host, &port, 80);
+    } catch (const dct::Error&) {
+      threw = true;
+    }
+    EXPECT(threw);
+  }
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -652,6 +699,8 @@ int main(int argc, char** argv) {
   TestParameterFloatRoundTrip();
   TestRegistry();
   TestConfig();
+  TestXmlUnescape();
+  TestSplitHostPort();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;

@@ -153,7 +153,10 @@ class TransformerLM:
                                 tree)
         if hasattr(lax, "pvary"):
             return jax.tree.map(lambda t: lax.pvary(t, axes), tree)
-        return tree
+        raise RuntimeError(
+            "this JAX version has neither lax.pcast nor lax.pvary; "
+            "falling back to untyped params would make the explicit psum "
+            "double-count gradients by the mesh axis size")
 
     def _shard_step(self, params: Params, tokens: jnp.ndarray,
                     labels: jnp.ndarray):

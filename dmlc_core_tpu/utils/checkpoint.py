@@ -132,8 +132,18 @@ def restore_checkpoint(uri: str, like: Any = None
 
 def fast_forward(iterator: Iterable, n_batches: int) -> Iterable:
     """Skip `n_batches` from a (deterministic-order) batch iterator —
-    mid-epoch data resume; returns the advanced iterator."""
+    mid-epoch data resume; returns the advanced iterator.
+
+    Raises DMLCError if the iterator runs dry before `n_batches` were
+    skipped: a resume point past end-of-data means the checkpoint step
+    and the data stream disagree, and silently yielding zero batches
+    would mask it."""
     it = iter(iterator)
-    for _ in range(n_batches):
-        next(it, None)
+    sentinel = object()
+    for skipped in range(n_batches):
+        if next(it, sentinel) is sentinel:
+            raise DMLCError(
+                f"fast_forward: iterator exhausted after {skipped} of "
+                f"{n_batches} batches; checkpoint resume point is past "
+                f"end-of-data")
     return it

@@ -86,3 +86,16 @@ def test_params_stay_replicated():
     params, _ = model.step(params, toks, labels)
     emb = params["embed"]
     assert emb.sharding.is_fully_replicated
+
+
+def test_mark_varying_unsupported_jax_raises(monkeypatch):
+    # Neither lax.pcast nor lax.pvary: silently skipping the varying cast
+    # would double-count gradients (ADVICE r1); must raise instead.
+    import dmlc_core_tpu.models.transformer as tmod
+
+    class _BareLax:  # stands in for a JAX version lacking both APIs
+        pass
+
+    monkeypatch.setattr(tmod, "lax", _BareLax())
+    with pytest.raises(RuntimeError, match="pcast nor lax.pvary"):
+        TransformerLM._mark_varying({"w": jnp.ones(2)}, ("data",))

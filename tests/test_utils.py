@@ -149,6 +149,11 @@ def test_fast_forward():
     assert next(it) == 4
 
 
+def test_fast_forward_past_end_raises():
+    with pytest.raises(Exception, match="exhausted after 3 of 5"):
+        fast_forward(iter(range(3)), 5)
+
+
 def test_timer_accumulates():
     t = Timer()
     with t:
