@@ -139,6 +139,14 @@ class LinearLearner:
         if self._step_fn is None:
             self._step_fn = {}
         tree = batch.tree()
+        D = tree["label"].shape[0]
+        n_dev = 1 if self.mesh is None else int(self.mesh.devices.size)
+        if D != n_dev:
+            # the step reads shard block[0] only — a mismatch would
+            # silently train on 1/D of the rows
+            raise ValueError(
+                f"batch device axis D={D} != mesh size {n_dev}; "
+                f"build the batch with num_shards={n_dev}")
         shape_sig = tuple((k, tuple(v.shape)) for k, v in sorted(tree.items()))
         fn = self._step_fn.get(shape_sig)
         if fn is None:

@@ -326,3 +326,18 @@ def test_native_batcher_auto_layout_sees_accumulated_max(tmp_path):
     batches = _drain(nat)
     assert nat.layout == "csr"
     assert sum(b.total_rows for b in batches) == 44
+
+
+def test_step_rejects_batch_mesh_mismatch(tmp_path):
+    # a batch built for D shards fed to a smaller mesh would silently drop
+    # rows (shard_map block[0] indexing); the step must refuse instead
+    from dmlc_core_tpu.tpu.device_iter import NativeHostBatcher
+    p = write_libsvm(tmp_path / "m.libsvm", rows=64, features=8)
+    b = NativeHostBatcher(str(p), layout="csr", batch_rows=64, num_shards=4,
+                          min_nnz_bucket=64)
+    batch = b.next_batch()
+    b.close()
+    mesh = data_mesh(num_devices=2)
+    learner = LinearLearner(8, mesh=mesh)
+    with pytest.raises(ValueError, match="num_shards=2"):
+        learner.step(learner.init(), batch)
