@@ -28,6 +28,8 @@ void PaddedBatcher::Accumulate() {
     }
     const size_t n = b->Size();
     const size_t nnz = b->offset.back();
+    const size_t prev_rows = label_.size();  // pre-block counts for the
+    const size_t prev_nnz = val_.size();     // lazy qid_/field_ backfill
     label_.insert(label_.end(), b->label.begin(), b->label.end());
     if (b->weight.empty()) {
       weight_.insert(weight_.end(), n, 1.0f);
@@ -37,6 +39,37 @@ void PaddedBatcher::Accumulate() {
     lens_.reserve(lens_.size() + n);
     for (size_t i = 0; i < n; ++i) {
       lens_.push_back(static_cast<int32_t>(b->offset[i + 1] - b->offset[i]));
+    }
+    // qid/field ride along in the int32 device layout. The side arrays stay
+    // EMPTY until the stream first carries the column (keeping the headline
+    // qid/field-free ingest path free of their fill+compact traffic); on
+    // first appearance earlier rows are backfilled with the sentinel.
+    // Rows from qid-less blocks get -1 (a value the uint64 parse can never
+    // produce) so they can't merge with a legitimate qid:0 group.
+    if (!b->qid.empty()) {
+      DCT_CHECK(b->qid.size() == n) << "ragged qid column in block";
+      have_qid_ = true;
+      qid_.resize(prev_rows, -1);  // no-op except on first appearance
+      qid_.reserve(prev_rows + n);
+      for (uint64_t q : b->qid) {
+        DCT_CHECK(q <= 0x7fffffffULL)
+            << "qid " << q << " exceeds the int32 device layout";
+        qid_.push_back(static_cast<int32_t>(q));
+      }
+    } else if (have_qid_) {
+      qid_.insert(qid_.end(), n, -1);
+    }
+    if (!b->field.empty()) {
+      DCT_CHECK(b->field.size() == nnz) << "ragged field column in block";
+      have_field_ = true;
+      field_.resize(prev_nnz, 0);  // no-op except on first appearance
+      // uint32 -> int32 bit-identical (same rationale as col above)
+      const size_t old = field_.size();
+      field_.resize(old + nnz);
+      std::memcpy(field_.data() + old, b->field.data(),
+                  nnz * sizeof(int32_t));
+    } else if (have_field_) {
+      field_.insert(field_.end(), nnz, 0);
     }
     // uint32 -> int32 is bit-identical (ids >= 2^31 wrap negative either
     // way and cannot be represented in the int32 device layout): bulk copy.
@@ -62,7 +95,8 @@ void PaddedBatcher::Accumulate() {
 }
 
 bool PaddedBatcher::NextMeta(uint64_t* take, uint64_t* bucket,
-                             uint64_t* max_index) {
+                             uint64_t* max_index, int* has_qid,
+                             int* has_field) {
   DCT_CHECK(!staged_) << "NextMeta called with an unconsumed staged batch";
   Accumulate();
   const uint64_t avail = AvailRows();
@@ -89,6 +123,8 @@ bool PaddedBatcher::NextMeta(uint64_t* take, uint64_t* bucket,
   *take = take_;
   *bucket = bucket_;
   *max_index = max_index_;
+  if (has_qid != nullptr) *has_qid = have_qid_ ? 1 : 0;
+  if (has_field != nullptr) *has_field = have_field_ ? 1 : 0;
   return true;
 }
 
@@ -109,7 +145,8 @@ void PaddedBatcher::FillRowArrays(float* label, float* weight,
 }
 
 void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
-                            float* label, float* weight, int32_t* nrows) {
+                            float* label, float* weight, int32_t* nrows,
+                            int32_t* qid, int32_t* field) {
   DCT_CHECK(staged_) << "FillCSR without a staged batch (call NextMeta)";
   const uint64_t R = batch_rows_ / num_shards_;
   size_t p = nnz_pos_;
@@ -117,6 +154,7 @@ void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
     int32_t* rowd = row + d * bucket_;
     int32_t* cold = col + d * bucket_;
     float* vald = val + d * bucket_;
+    int32_t* fieldd = field == nullptr ? nullptr : field + d * bucket_;
     uint64_t written = 0;
     const uint64_t lo = d * R;
     const uint64_t hi = std::min<uint64_t>((d + 1) * R, take_);
@@ -126,6 +164,9 @@ void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
       for (uint64_t k = 0; k < l; ++k) rowd[written + k] = local;
       std::memcpy(cold + written, col_.data() + p, l * sizeof(int32_t));
       std::memcpy(vald + written, val_.data() + p, l * sizeof(float));
+      if (fieldd != nullptr) {
+        std::memcpy(fieldd + written, field_.data() + p, l * sizeof(int32_t));
+      }
       p += l;
       written += l;
     }
@@ -134,14 +175,27 @@ void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
     for (uint64_t k = written; k < bucket_; ++k) rowd[k] = R;
     std::memset(cold + written, 0, (bucket_ - written) * sizeof(int32_t));
     std::memset(vald + written, 0, (bucket_ - written) * sizeof(float));
+    if (fieldd != nullptr) {
+      std::memset(fieldd + written, 0, (bucket_ - written) * sizeof(int32_t));
+    }
+  }
+  if (qid != nullptr) {
+    std::memcpy(qid, qid_.data() + row_pos_, take_ * sizeof(int32_t));
+    // padding rows get the -1 sentinel too (weight 0 already excludes them;
+    // -1 keeps them out of any qid grouping regardless)
+    std::fill(qid + take_, qid + batch_rows_, -1);
   }
   FillRowArrays(label, weight, nrows);
   Consume();
 }
 
 void PaddedBatcher::FillDense(float* x, uint64_t num_features, float* label,
-                              float* weight, int32_t* nrows) {
+                              float* weight, int32_t* nrows, int32_t* qid) {
   DCT_CHECK(staged_) << "FillDense without a staged batch (call NextMeta)";
+  if (qid != nullptr) {
+    std::memcpy(qid, qid_.data() + row_pos_, take_ * sizeof(int32_t));
+    std::fill(qid + take_, qid + batch_rows_, -1);
+  }
   std::memset(x, 0, batch_rows_ * num_features * sizeof(float));
   size_t p = nnz_pos_;
   for (uint64_t r = 0; r < take_; ++r) {
@@ -172,8 +226,14 @@ void PaddedBatcher::Consume() {
     label_.erase(label_.begin(), label_.begin() + row_pos_);
     weight_.erase(weight_.begin(), weight_.begin() + row_pos_);
     lens_.erase(lens_.begin(), lens_.begin() + row_pos_);
+    if (!qid_.empty()) {
+      qid_.erase(qid_.begin(), qid_.begin() + row_pos_);
+    }
     col_.erase(col_.begin(), col_.begin() + nnz_pos_);
     val_.erase(val_.begin(), val_.begin() + nnz_pos_);
+    if (!field_.empty()) {
+      field_.erase(field_.begin(), field_.begin() + nnz_pos_);
+    }
     row_pos_ = 0;
     nnz_pos_ = 0;
   }
@@ -186,6 +246,8 @@ void PaddedBatcher::BeforeFirst() {
   val_.clear();
   lens_.clear();
   col_.clear();
+  qid_.clear();
+  field_.clear();
   row_pos_ = 0;
   nnz_pos_ = 0;
   done_ = false;

@@ -38,14 +38,24 @@ class PaddedBatcher {
   //   *take      true (unpadded) row count, <= batch_rows
   //   *bucket    per-shard nnz capacity (next pow2 of max shard nnz)
   //   *max_index running max feature id (drives the dense/csr auto choice)
-  bool NextMeta(uint64_t* take, uint64_t* bucket, uint64_t* max_index);
+  //   *has_qid   1 when any parsed block carried query/group ids
+  //   *has_field 1 when any parsed block carried per-nonzero field ids
+  bool NextMeta(uint64_t* take, uint64_t* bucket, uint64_t* max_index,
+                int* has_qid, int* has_field);
 
-  // Consume the staged batch into caller buffers (shapes per header comment).
+  // Consume the staged batch into caller buffers (shapes per header
+  // comment). qid is [batch_rows] int32 group ids (-1 on padding rows and
+  // rows from qid-less blocks — the sentinel can't collide with a real
+  // qid:0) and field is [D, bucket] int32 per-nonzero field ids (0 on
+  // padding nonzeros); either may be null to skip (reference data.h:174-236
+  // carries both on RowBlock — this is their device-layout continuation).
   void FillCSR(int32_t* row, int32_t* col, float* val, float* label,
-               float* weight, int32_t* nrows);
-  // x is [batch_rows, num_features], zeroed here before scatter.
+               float* weight, int32_t* nrows, int32_t* qid = nullptr,
+               int32_t* field = nullptr);
+  // x is [batch_rows, num_features], zeroed here before scatter. Field ids
+  // have no dense representation; use the CSR layout for field-aware models.
   void FillDense(float* x, uint64_t num_features, float* label, float* weight,
-                 int32_t* nrows);
+                 int32_t* nrows, int32_t* qid = nullptr);
 
   void BeforeFirst();
   size_t BytesRead() const { return parser_->BytesRead(); }
@@ -64,10 +74,12 @@ class PaddedBatcher {
   // pending rows in arrival order; a consumed prefix [0, row_pos_) /
   // [0, nnz_pos_) is compacted away once it outgrows the live tail
   std::vector<float> label_, weight_, val_;
-  std::vector<int32_t> lens_, col_;
+  std::vector<int32_t> lens_, col_, qid_, field_;
   size_t row_pos_ = 0;
   size_t nnz_pos_ = 0;
   bool done_ = false;
+  bool have_qid_ = false;
+  bool have_field_ = false;
   uint64_t max_index_ = 0;
 
   // staged by NextMeta for the following Fill* call

@@ -378,29 +378,33 @@ int dct_batcher_create(const char* uri, unsigned part, unsigned npart,
 }
 
 int dct_batcher_next_meta(dct_batcher_t h, uint64_t* take, uint64_t* bucket,
-                          uint64_t* max_index, int* has) {
+                          uint64_t* max_index, int* has_qid, int* has_field,
+                          int* has) {
   return Guard([&] {
-    *has = static_cast<dct::PaddedBatcher*>(h)->NextMeta(take, bucket,
-                                                         max_index)
+    *has = static_cast<dct::PaddedBatcher*>(h)->NextMeta(
+               take, bucket, max_index, has_qid, has_field)
                ? 1
                : 0;
   });
 }
 
+// qid/field may be NULL to skip (reference RowBlock carries both,
+// data.h:174-236; here they continue into the device layout)
 int dct_batcher_fill_csr(dct_batcher_t h, int32_t* row, int32_t* col,
                          float* val, float* label, float* weight,
-                         int32_t* nrows) {
+                         int32_t* nrows, int32_t* qid, int32_t* field) {
   return Guard([&] {
     static_cast<dct::PaddedBatcher*>(h)->FillCSR(row, col, val, label, weight,
-                                                 nrows);
+                                                 nrows, qid, field);
   });
 }
 
 int dct_batcher_fill_dense(dct_batcher_t h, float* x, uint64_t num_features,
-                           float* label, float* weight, int32_t* nrows) {
+                           float* label, float* weight, int32_t* nrows,
+                           int32_t* qid) {
   return Guard([&] {
     static_cast<dct::PaddedBatcher*>(h)->FillDense(x, num_features, label,
-                                                   weight, nrows);
+                                                   weight, nrows, qid);
   });
 }
 

@@ -119,9 +119,10 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
                                c.POINTER(vp)],
         "dct_batcher_next_meta": [vp, c.POINTER(c.c_uint64),
                                   c.POINTER(c.c_uint64),
-                                  c.POINTER(c.c_uint64), c.POINTER(i)],
-        "dct_batcher_fill_csr": [vp, vp, vp, vp, vp, vp, vp],
-        "dct_batcher_fill_dense": [vp, vp, c.c_uint64, vp, vp, vp],
+                                  c.POINTER(c.c_uint64), c.POINTER(i),
+                                  c.POINTER(i), c.POINTER(i)],
+        "dct_batcher_fill_csr": [vp, vp, vp, vp, vp, vp, vp, vp, vp],
+        "dct_batcher_fill_dense": [vp, vp, c.c_uint64, vp, vp, vp, vp],
         "dct_batcher_before_first": [vp],
         "dct_batcher_bytes_read": [vp, c.POINTER(sz)],
         "dct_batcher_free": [vp],
@@ -499,18 +500,23 @@ class NativeBatcher:
             ctypes.byref(self._h)))
 
     def next_meta(self):
-        """(take, bucket, max_index) for the staged batch, or None at end."""
+        """(take, bucket, max_index, has_qid, has_field) for the staged
+        batch, or None at end."""
         take = ctypes.c_uint64()
         bucket = ctypes.c_uint64()
         max_index = ctypes.c_uint64()
+        has_qid = ctypes.c_int()
+        has_field = ctypes.c_int()
         has = ctypes.c_int()
         _check(lib().dct_batcher_next_meta(
             self._h, ctypes.byref(take), ctypes.byref(bucket),
-            ctypes.byref(max_index), ctypes.byref(has)))
+            ctypes.byref(max_index), ctypes.byref(has_qid),
+            ctypes.byref(has_field), ctypes.byref(has)))
         if not has.value:
             return None
         self._bucket = bucket.value
-        return take.value, bucket.value, max_index.value
+        return (take.value, bucket.value, max_index.value,
+                bool(has_qid.value), bool(has_field.value))
 
     @staticmethod
     def _ptr(arr: np.ndarray, dtype, size: int) -> ctypes.c_void_p:
@@ -525,24 +531,31 @@ class NativeBatcher:
         return ctypes.c_void_p(arr.ctypes.data)
 
     def fill_csr(self, row: np.ndarray, col: np.ndarray, val: np.ndarray,
-                 label: np.ndarray, weight: np.ndarray,
-                 nrows: np.ndarray) -> None:
+                 label: np.ndarray, weight: np.ndarray, nrows: np.ndarray,
+                 qid: Optional[np.ndarray] = None,
+                 field: Optional[np.ndarray] = None) -> None:
         nz = self._num_shards * self._bucket
         _check(lib().dct_batcher_fill_csr(
             self._h, self._ptr(row, np.int32, nz),
             self._ptr(col, np.int32, nz), self._ptr(val, np.float32, nz),
             self._ptr(label, np.float32, self._batch_rows),
             self._ptr(weight, np.float32, self._batch_rows),
-            self._ptr(nrows, np.int32, self._num_shards)))
+            self._ptr(nrows, np.int32, self._num_shards),
+            None if qid is None
+            else self._ptr(qid, np.int32, self._batch_rows),
+            None if field is None else self._ptr(field, np.int32, nz)))
 
     def fill_dense(self, x: np.ndarray, label: np.ndarray,
-                   weight: np.ndarray, nrows: np.ndarray) -> None:
+                   weight: np.ndarray, nrows: np.ndarray,
+                   qid: Optional[np.ndarray] = None) -> None:
         F = x.shape[-1]
         _check(lib().dct_batcher_fill_dense(
             self._h, self._ptr(x, np.float32, self._batch_rows * F), F,
             self._ptr(label, np.float32, self._batch_rows),
             self._ptr(weight, np.float32, self._batch_rows),
-            self._ptr(nrows, np.int32, self._num_shards)))
+            self._ptr(nrows, np.int32, self._num_shards),
+            None if qid is None
+            else self._ptr(qid, np.int32, self._batch_rows)))
 
     def before_first(self) -> None:
         _check(lib().dct_batcher_before_first(self._h))

@@ -1,0 +1,51 @@
+"""Ranking ops over qid-grouped PaddedBatch shards.
+
+The reference carries per-row query ids on RowBlock (reference
+include/dmlc/data.h:174-236 `qid`; parsed by the libsvm parser's `qid:n`
+syntax, src/data/libsvm_parser.h:87-169) so downstream rankers (LambdaMART
+lineage) can form in-query pairs. Here the device layout carries qid as a
+[D, R] int32 plane and the pairwise loss is expressed as one masked [R, R]
+broadcast — static shapes, no data-dependent control flow, XLA-fusable —
+rather than the reference consumers' per-query host loops.
+
+All functions operate on ONE shard (no leading device axis), like
+dmlc_core_tpu.ops.sparse: under shard_map each device evaluates its local
+rows, and because the batcher never splits a row across shards, pairs only
+ever form within a shard when group ids arrive grouped (the libsvm qid
+contract: rows of a query are contiguous).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["pairwise_logistic_loss"]
+
+
+def pairwise_logistic_loss(margin: jnp.ndarray, label: jnp.ndarray,
+                           qid: jnp.ndarray, weight: jnp.ndarray
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """RankNet-style pairwise loss for one shard.
+
+    margin/label/qid/weight: [R]. Pairs (i, j) count when qid_i == qid_j,
+    label_i > label_j, and both rows are real (weight > 0; padding rows have
+    weight 0). Rows with qid < 0 (the batcher's absent-qid/padding sentinel,
+    cpp/src/batcher.cc) never pair — qid-less rows must not merge into one
+    pseudo-query. Returns (loss_sum, pair_count) — callers psum both across
+    the mesh and divide.
+
+    loss(i, j) = log1p(exp(-(margin_i - margin_j))), the standard smooth
+    upper bound on pairwise misorder.
+    """
+    same_q = qid[:, None] == qid[None, :]
+    ordered = label[:, None] > label[None, :]
+    real = (weight > 0) & (qid >= 0)
+    valid = same_q & ordered & real[:, None] & real[None, :]
+    diff = margin[:, None] - margin[None, :]
+    # stable log1p(exp(-diff)); masked entries contribute 0
+    per_pair = jnp.maximum(-diff, 0.0) + jnp.log1p(
+        jnp.exp(-jnp.abs(diff)))
+    per_pair = jnp.where(valid, per_pair, 0.0)
+    return per_pair.sum(), valid.sum().astype(jnp.float32)

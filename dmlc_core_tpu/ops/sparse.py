@@ -19,7 +19,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["csr_matvec", "csr_matmul_dense", "csr_to_dense", "row_sdot"]
+__all__ = ["csr_matvec", "csr_matmul_dense", "csr_to_dense", "row_sdot",
+           "field_aware_matvec"]
 
 
 def csr_matvec(row: jnp.ndarray, col: jnp.ndarray, val: jnp.ndarray,
@@ -59,3 +60,20 @@ def row_sdot(row: jnp.ndarray, col: jnp.ndarray, val: jnp.ndarray,
              w: jnp.ndarray, num_rows: int) -> jnp.ndarray:
     """Alias with reference naming (Row::SDot, data.h:124-136)."""
     return csr_matvec(row, col, val, w, num_rows)
+
+
+def field_aware_matvec(row: jnp.ndarray, col: jnp.ndarray,
+                       field: jnp.ndarray, val: jnp.ndarray,
+                       W: jnp.ndarray, num_rows: int) -> jnp.ndarray:
+    """y[r] = Σ_{nz in row r} val · W[field, col] — the field-aware linear
+    margin consuming the PaddedBatch `field` plane (the device continuation
+    of the reference libfm parser's per-nonzero field ids,
+    src/data/libfm_parser.h:69-144).
+
+    row/col/field/val: [NNZ]; W: [num_fields, num_features]. Padding
+    nonzeros (val == 0, field == 0) contribute nothing. Returns [num_rows].
+    """
+    wij = W[field, col]  # [NNZ] gather
+    y = jax.ops.segment_sum(val * wij, row, num_segments=num_rows + 1,
+                            indices_are_sorted=True)
+    return y[:num_rows]

@@ -55,6 +55,13 @@ class PaddedBatch:
     row/col/val: [D, NNZ]  per-nonzero segment id (local), column, value
     label/weight: [D, R]   weight 0 marks padding rows
     nrows: [D]             true row count per shard
+    qid: [D, R] int32      optional query/group ids (ranking); -1 on padding
+                           rows and rows from qid-less blocks (sentinel —
+                           cannot collide with a real qid:0)
+    field: [D, NNZ] int32  optional per-nonzero field ids (FM/FFM), 0 on pad
+
+    qid/field continue the reference RowBlock's optional columns
+    (data.h:174-236) into the device layout.
     """
     row: Any
     col: Any
@@ -65,6 +72,8 @@ class PaddedBatch:
     # host-side true row count (not part of the device tree; avoids a
     # device->host sync when consumers just need progress accounting)
     total_rows: int = 0
+    qid: Any = None
+    field: Any = None
 
     @property
     def rows_per_shard(self) -> int:
@@ -75,9 +84,14 @@ class PaddedBatch:
         return self.row.shape[1]
 
     def tree(self) -> Dict[str, Any]:
-        return {"row": self.row, "col": self.col, "val": self.val,
-                "label": self.label, "weight": self.weight,
-                "nrows": self.nrows}
+        t = {"row": self.row, "col": self.col, "val": self.val,
+             "label": self.label, "weight": self.weight,
+             "nrows": self.nrows}
+        if self.qid is not None:
+            t["qid"] = self.qid
+        if self.field is not None:
+            t["field"] = self.field
+        return t
 
 
 @dataclass
@@ -92,6 +106,7 @@ class DenseBatch:
     weight: Any
     nrows: Any
     total_rows: int = 0
+    qid: Any = None  # [D, R] int32 group ids (field has no dense layout)
 
     @property
     def rows_per_shard(self) -> int:
@@ -102,8 +117,11 @@ class DenseBatch:
         return self.x.shape[2]
 
     def tree(self) -> Dict[str, Any]:
-        return {"x": self.x, "label": self.label, "weight": self.weight,
-                "nrows": self.nrows}
+        t = {"x": self.x, "label": self.label, "weight": self.weight,
+             "nrows": self.nrows}
+        if self.qid is not None:
+            t["qid"] = self.qid
+        return t
 
 
 def _next_pow2(n: int, floor: int) -> int:
@@ -138,9 +156,11 @@ class HostBatcher:
         self.dense_dtype = dense_dtype
         self._num_features: Optional[int] = None  # fixed once dense chosen
         # leftover rows from the previous native block (numpy copies)
-        self._pending: list = []  # list of (label, weight, qid, lens, col, val)
+        self._pending: list = []  # (label, weight, lens, col, val, qid, fld)
         self._pending_rows = 0
         self._done = False
+        self._has_qid = False    # sticky, like the layout choice
+        self._has_field = False
 
     def _block_to_parts(self, b) -> tuple:
         lens = np.diff(b.offset).astype(np.int32)
@@ -151,7 +171,22 @@ class HostBatcher:
         weight = (b.weight.astype(np.float32, copy=True)
                   if b.weight is not None
                   else np.ones(b.num_rows, dtype=np.float32))
-        return label, weight, lens, col, val
+        if b.qid is not None:
+            self._has_qid = True
+            if b.qid.max(initial=0) > np.iinfo(np.int32).max:
+                raise DMLCError(
+                    f"qid {int(b.qid.max())} exceeds the int32 device "
+                    f"layout")  # native path enforces the same (batcher.cc)
+            qid = b.qid.astype(np.int32)
+        else:
+            # -1 sentinel: absent rows must not merge with a real qid:0
+            qid = np.full(b.num_rows, -1, np.int32)
+        if b.field is not None:
+            self._has_field = True
+            fld = b.field.astype(np.int32)
+        else:
+            fld = np.zeros(b.nnz, np.int32)
+        return label, weight, lens, col, val, qid, fld
 
     def next_batch(self) -> Optional[PaddedBatch]:
         """Produce the next PaddedBatch of numpy arrays (None at end)."""
@@ -166,37 +201,29 @@ class HostBatcher:
             return None
 
         take = min(self.batch_rows, self._pending_rows)
-        labels, weights, lens_list, cols, vals = [], [], [], [], []
+        parts = []  # per-piece tuples, same layout as _pending entries
         got = 0
         while got < take:
-            label, weight, lens, col, val = self._pending[0]
+            label, weight, lens, col, val, qid, fld = self._pending[0]
             n = len(label)
             if got + n <= take:
                 self._pending.pop(0)
-                labels.append(label)
-                weights.append(weight)
-                lens_list.append(lens)
-                cols.append(col)
-                vals.append(val)
+                parts.append((label, weight, lens, col, val, qid, fld))
                 got += n
             else:
                 keep = take - got
                 nnz_keep = int(lens[:keep].sum())
-                labels.append(label[:keep])
-                weights.append(weight[:keep])
-                lens_list.append(lens[:keep])
-                cols.append(col[:nnz_keep])
-                vals.append(val[:nnz_keep])
+                parts.append((label[:keep], weight[:keep], lens[:keep],
+                              col[:nnz_keep], val[:nnz_keep], qid[:keep],
+                              fld[:nnz_keep]))
                 self._pending[0] = (label[keep:], weight[keep:], lens[keep:],
-                                    col[nnz_keep:], val[nnz_keep:])
+                                    col[nnz_keep:], val[nnz_keep:],
+                                    qid[keep:], fld[nnz_keep:])
                 got = take
         self._pending_rows -= take
 
-        label = np.concatenate(labels)
-        weight = np.concatenate(weights)
-        lens = np.concatenate(lens_list)
-        col = np.concatenate(cols)
-        val = np.concatenate(vals)
+        label, weight, lens, col, val, qid, fld = (
+            np.concatenate([p[i] for p in parts]) for i in range(7))
 
         D = self.num_shards
         R = self.batch_rows // D
@@ -206,15 +233,22 @@ class HostBatcher:
             label = np.concatenate([label, np.zeros(pad, np.float32)])
             weight = np.concatenate([weight, np.zeros(pad, np.float32)])
             lens = np.concatenate([lens, np.zeros(pad, np.int32)])
+            qid = np.concatenate([qid, np.full(pad, -1, np.int32)])
 
         if self.layout == "auto":
             # decide once, on the first batch: dense when the feature space
-            # is small (the MXU path); sticky so device shapes stay static
+            # is small (the MXU path); sticky so device shapes stay static.
+            # field-aware data always stays CSR (no dense field plane)
             max_idx = int(col.max()) if len(col) else 0
-            self.layout = ("dense" if max_idx + 1 <= self.dense_max_features
+            self.layout = ("dense" if not self._has_field
+                           and max_idx + 1 <= self.dense_max_features
                            else "csr")
         if self.layout == "dense":
-            return self._emit_dense(take, label, weight, lens, col, val)
+            if self._has_field:
+                raise DMLCError(
+                    "field ids have no dense layout; pass layout='csr' for "
+                    "field-aware (libfm) data")
+            return self._emit_dense(take, label, weight, lens, col, val, qid)
 
         # split nnz by shard; bucket to the max shard nnz
         row_of = np.repeat(np.arange(self.batch_rows, dtype=np.int32), lens)
@@ -227,21 +261,27 @@ class HostBatcher:
         row = np.full((D, bucket), R, dtype=np.int32)  # R = padding segment
         colp = np.zeros((D, bucket), dtype=np.int32)
         valp = np.zeros((D, bucket), dtype=np.float32)
+        fldp = (np.zeros((D, bucket), dtype=np.int32)
+                if self._has_field else None)
         for d in range(D):
             lo, hi = shard_starts[d], shard_starts[d + 1]
             n = hi - lo
             row[d, :n] = row_of[lo:hi] - d * R  # local row ids
             colp[d, :n] = col[lo:hi]
             valp[d, :n] = val[lo:hi]
+            if fldp is not None:
+                fldp[d, :n] = fld[lo:hi]
 
         nrows = np.minimum(
             np.maximum(take - np.arange(D) * R, 0), R).astype(np.int32)
         return PaddedBatch(
             row=row, col=colp, val=valp,
             label=label.reshape(D, R), weight=weight.reshape(D, R),
-            nrows=nrows, total_rows=int(take))
+            nrows=nrows, total_rows=int(take),
+            qid=qid.reshape(D, R) if self._has_qid else None,
+            field=fldp)
 
-    def _emit_dense(self, take, label, weight, lens, col, val):
+    def _emit_dense(self, take, label, weight, lens, col, val, qid):
         D = self.num_shards
         R = self.batch_rows // D
         if self._num_features is None:
@@ -260,7 +300,8 @@ class HostBatcher:
         return DenseBatch(
             x=x.reshape(D, R, F),
             label=label.reshape(D, R), weight=weight.reshape(D, R),
-            nrows=nrows, total_rows=int(take))
+            nrows=nrows, total_rows=int(take),
+            qid=qid.reshape(D, R) if self._has_qid else None)
 
     def reset(self) -> None:
         self.parser.before_first()
@@ -303,37 +344,51 @@ class NativeHostBatcher:
         meta = self._b.next_meta()
         if meta is None:
             return None
-        take, bucket, max_index = meta
+        take, bucket, max_index, has_qid, has_field = meta
         D = self.num_shards
         R = self.batch_rows // D
         if self.layout == "auto":
-            # decide once, on the first batch; sticky so shapes stay static
+            # decide once, on the first batch; sticky so shapes stay static.
+            # field ids have no dense representation, so field-aware data
+            # always takes the CSR layout (batcher.h contract)
             self.layout = ("dense"
-                           if max_index + 1 <= self.dense_max_features
+                           if not has_field
+                           and max_index + 1 <= self.dense_max_features
                            else "csr")
+        elif self.layout == "dense" and has_field:
+            raise DMLCError(
+                "field ids have no dense layout; pass layout='csr' for "
+                "field-aware (libfm) data")
         label = np.empty(self.batch_rows, np.float32)
         weight = np.empty(self.batch_rows, np.float32)
         nrows = np.empty(D, np.int32)
+        qid = np.empty(self.batch_rows, np.int32) if has_qid else None
         if self.layout == "dense":
             if self._num_features is None:
                 self._num_features = max(int(max_index) + 1, 1)
             F = self._num_features
             x = np.empty((self.batch_rows, F), np.float32)
-            self._b.fill_dense(x, label, weight, nrows)
+            self._b.fill_dense(x, label, weight, nrows, qid=qid)
             x = x.reshape(D, R, F)
             if self.dense_dtype != np.float32:
                 x = x.astype(self.dense_dtype)
             return DenseBatch(x=x, label=label.reshape(D, R),
                               weight=weight.reshape(D, R), nrows=nrows,
-                              total_rows=int(take))
+                              total_rows=int(take),
+                              qid=None if qid is None
+                              else qid.reshape(D, R))
         row = np.empty((D, bucket), np.int32)
         col = np.empty((D, bucket), np.int32)
         val = np.empty((D, bucket), np.float32)
-        self._b.fill_csr(row, col, val, label, weight, nrows)
+        field = np.empty((D, bucket), np.int32) if has_field else None
+        self._b.fill_csr(row, col, val, label, weight, nrows, qid=qid,
+                         field=field)
         return PaddedBatch(row=row, col=col, val=val,
                            label=label.reshape(D, R),
                            weight=weight.reshape(D, R), nrows=nrows,
-                           total_rows=int(take))
+                           total_rows=int(take),
+                           qid=None if qid is None else qid.reshape(D, R),
+                           field=field)
 
     def reset(self) -> None:
         self._b.before_first()
