@@ -1,0 +1,32 @@
+# Top-level QA lanes (reference runs lint + gtest + cmake + TSan + s390x-BE
+# on every push: .github/workflows/githubci.yml, scripts/test_script.sh).
+# `make ci` runs every lane; each lane is also callable alone.
+
+.PHONY: ci lint native-test tsan-test pytest bench-smoke dryrun clean
+
+ci: lint native-test tsan-test pytest dryrun
+	@echo "== all CI lanes green =="
+
+lint:
+	python3 scripts/lint.py
+
+# builds + runs the C++ unit binary (includes the big-endian golden-byte
+# serializer tests -- the QEMU-free equivalent of the reference s390x lane)
+native-test:
+	$(MAKE) -C cpp testbin
+	./dmlc_core_tpu/_native/test_core
+
+tsan-test:
+	$(MAKE) -C cpp tsan-test
+
+pytest:
+	python3 -m pytest tests/ -q
+
+dryrun:
+	python3 -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench-smoke:
+	python3 bench.py --smoke
+
+clean:
+	$(MAKE) -C cpp clean

@@ -154,7 +154,14 @@ void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
     int32_t* rowd = row + d * bucket_;
     int32_t* cold = col + d * bucket_;
     float* vald = val + d * bucket_;
-    int32_t* fieldd = field == nullptr ? nullptr : field + d * bucket_;
+    // fields may be requested for a stream that never carried them (field_
+    // stays empty then); emit all-zero planes instead of reading off-end
+    int32_t* fieldd = (field == nullptr || field_.empty())
+                          ? nullptr
+                          : field + d * bucket_;
+    if (field != nullptr && field_.empty()) {
+      std::memset(field + d * bucket_, 0, bucket_ * sizeof(int32_t));
+    }
     uint64_t written = 0;
     const uint64_t lo = d * R;
     const uint64_t hi = std::min<uint64_t>((d + 1) * R, take_);
@@ -180,21 +187,30 @@ void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
     }
   }
   if (qid != nullptr) {
-    std::memcpy(qid, qid_.data() + row_pos_, take_ * sizeof(int32_t));
-    // padding rows get the -1 sentinel too (weight 0 already excludes them;
-    // -1 keeps them out of any qid grouping regardless)
-    std::fill(qid + take_, qid + batch_rows_, -1);
+    FillQid(qid);
   }
   FillRowArrays(label, weight, nrows);
   Consume();
+}
+
+void PaddedBatcher::FillQid(int32_t* qid) {
+  // a caller may pass a buffer even when the stream never carried qid
+  // (qid_ stays empty then — the lazy scheme in Accumulate); emit the -1
+  // sentinel rather than memcpy from an empty vector. Padding rows get -1
+  // too (weight 0 already excludes them; -1 keeps them out of any grouping).
+  if (qid_.empty()) {
+    std::fill(qid, qid + batch_rows_, -1);
+    return;
+  }
+  std::memcpy(qid, qid_.data() + row_pos_, take_ * sizeof(int32_t));
+  std::fill(qid + take_, qid + batch_rows_, -1);
 }
 
 void PaddedBatcher::FillDense(float* x, uint64_t num_features, float* label,
                               float* weight, int32_t* nrows, int32_t* qid) {
   DCT_CHECK(staged_) << "FillDense without a staged batch (call NextMeta)";
   if (qid != nullptr) {
-    std::memcpy(qid, qid_.data() + row_pos_, take_ * sizeof(int32_t));
-    std::fill(qid + take_, qid + batch_rows_, -1);
+    FillQid(qid);
   }
   std::memset(x, 0, batch_rows_ * num_features * sizeof(float));
   size_t p = nnz_pos_;

@@ -62,6 +62,7 @@ class PaddedBatcher {
 
  private:
   void Accumulate();           // pull parser blocks until a batch is pending
+  void FillQid(int32_t* qid);  // staged qid column (or the -1 sentinel)
   void FillRowArrays(float* label, float* weight, int32_t* nrows);
   void Consume();              // advance past the staged batch + compact
   uint64_t AvailRows() const { return lens_.size() - row_pos_; }

@@ -88,7 +88,8 @@ def get_env(key: str, default: T, dtype: Optional[Type[T]] = None) -> T:
         return default
     ty: Type = dtype if dtype is not None else type(default)
     if ty is bool:
-        return raw.strip().lower() in ("1", "true", "yes", "on")  # type: ignore[return-value]
+        on = raw.strip().lower() in ("1", "true", "yes", "on")
+        return on  # type: ignore[return-value]
     return ty(raw)  # type: ignore[return-value]
 
 

@@ -10,9 +10,12 @@ rather than the reference consumers' per-query host loops.
 
 All functions operate on ONE shard (no leading device axis), like
 dmlc_core_tpu.ops.sparse: under shard_map each device evaluates its local
-rows, and because the batcher never splits a row across shards, pairs only
-ever form within a shard when group ids arrive grouped (the libsvm qid
-contract: rows of a query are contiguous).
+rows. Pairs form only WITHIN a shard: a query whose rows straddle a shard
+(or batch) boundary contributes its cross-boundary pairs to neither side,
+so loss_sum/pair_count are a within-shard subsample of the all-pairs
+objective. This is the standard distributed-ranking trade (per-device pair
+mining); to make it exact, size batch_rows/num_shards so R is a multiple of
+the query group size, or run ranking with num_shards=1.
 """
 
 from __future__ import annotations

@@ -161,6 +161,10 @@ class HostBatcher:
         self._done = False
         self._has_qid = False    # sticky, like the layout choice
         self._has_field = False
+        # plane presence pins on the first batch (static pytree structure
+        # for jitted consumers; same contract as NativeHostBatcher)
+        self._emit_qid: Optional[bool] = None
+        self._emit_field: Optional[bool] = None
 
     def _block_to_parts(self, b) -> tuple:
         lens = np.diff(b.offset).astype(np.int32)
@@ -171,6 +175,10 @@ class HostBatcher:
         weight = (b.weight.astype(np.float32, copy=True)
                   if b.weight is not None
                   else np.ones(b.num_rows, dtype=np.float32))
+        # qid/field stay None for blocks without them (no sentinel traffic
+        # on the common qid/field-free path); sentinels materialize at batch
+        # assembly only when the stream carries the column somewhere
+        qid = fld = None
         if b.qid is not None:
             self._has_qid = True
             if b.qid.max(initial=0) > np.iinfo(np.int32).max:
@@ -178,14 +186,9 @@ class HostBatcher:
                     f"qid {int(b.qid.max())} exceeds the int32 device "
                     f"layout")  # native path enforces the same (batcher.cc)
             qid = b.qid.astype(np.int32)
-        else:
-            # -1 sentinel: absent rows must not merge with a real qid:0
-            qid = np.full(b.num_rows, -1, np.int32)
         if b.field is not None:
             self._has_field = True
             fld = b.field.astype(np.int32)
-        else:
-            fld = np.zeros(b.nnz, np.int32)
         return label, weight, lens, col, val, qid, fld
 
     def next_batch(self) -> Optional[PaddedBatch]:
@@ -200,9 +203,24 @@ class HostBatcher:
         if self._pending_rows == 0:
             return None
 
+        if self._emit_qid is None:
+            self._emit_qid, self._emit_field = self._has_qid, self._has_field
+        elif (self._has_qid and not self._emit_qid) or (
+                self._has_field and not self._emit_field):
+            raise DMLCError(
+                "qid/field column appeared mid-stream after the batch "
+                "structure was pinned without it; order the inputs so the "
+                "first batch carries the column")
+
         take = min(self.batch_rows, self._pending_rows)
         parts = []  # per-piece tuples, same layout as _pending entries
         got = 0
+
+        def sl(arr, stop=None, start=None):
+            if arr is None:
+                return None
+            return arr[start:] if start is not None else arr[:stop]
+
         while got < take:
             label, weight, lens, col, val, qid, fld = self._pending[0]
             n = len(label)
@@ -214,16 +232,26 @@ class HostBatcher:
                 keep = take - got
                 nnz_keep = int(lens[:keep].sum())
                 parts.append((label[:keep], weight[:keep], lens[:keep],
-                              col[:nnz_keep], val[:nnz_keep], qid[:keep],
-                              fld[:nnz_keep]))
+                              col[:nnz_keep], val[:nnz_keep], sl(qid, keep),
+                              sl(fld, nnz_keep)))
                 self._pending[0] = (label[keep:], weight[keep:], lens[keep:],
                                     col[nnz_keep:], val[nnz_keep:],
-                                    qid[keep:], fld[nnz_keep:])
+                                    sl(qid, start=keep),
+                                    sl(fld, start=nnz_keep))
                 got = take
         self._pending_rows -= take
 
-        label, weight, lens, col, val, qid, fld = (
-            np.concatenate([p[i] for p in parts]) for i in range(7))
+        label, weight, lens, col, val = (
+            np.concatenate([p[i] for p in parts]) for i in range(5))
+        # sentinel backfill only when the stream carries the column at all
+        qid = (np.concatenate(
+            [p[5] if p[5] is not None else np.full(len(p[0]), -1, np.int32)
+             for p in parts]) if self._emit_qid
+            else np.empty(0, np.int32))
+        fld = (np.concatenate(
+            [p[6] if p[6] is not None else np.zeros(len(p[3]), np.int32)
+             for p in parts]) if self._emit_field
+            else np.empty(0, np.int32))
 
         D = self.num_shards
         R = self.batch_rows // D
@@ -233,18 +261,19 @@ class HostBatcher:
             label = np.concatenate([label, np.zeros(pad, np.float32)])
             weight = np.concatenate([weight, np.zeros(pad, np.float32)])
             lens = np.concatenate([lens, np.zeros(pad, np.int32)])
-            qid = np.concatenate([qid, np.full(pad, -1, np.int32)])
+            if self._emit_qid:
+                qid = np.concatenate([qid, np.full(pad, -1, np.int32)])
 
         if self.layout == "auto":
             # decide once, on the first batch: dense when the feature space
             # is small (the MXU path); sticky so device shapes stay static.
             # field-aware data always stays CSR (no dense field plane)
             max_idx = int(col.max()) if len(col) else 0
-            self.layout = ("dense" if not self._has_field
+            self.layout = ("dense" if not self._emit_field
                            and max_idx + 1 <= self.dense_max_features
                            else "csr")
         if self.layout == "dense":
-            if self._has_field:
+            if self._emit_field:
                 raise DMLCError(
                     "field ids have no dense layout; pass layout='csr' for "
                     "field-aware (libfm) data")
@@ -262,7 +291,7 @@ class HostBatcher:
         colp = np.zeros((D, bucket), dtype=np.int32)
         valp = np.zeros((D, bucket), dtype=np.float32)
         fldp = (np.zeros((D, bucket), dtype=np.int32)
-                if self._has_field else None)
+                if self._emit_field else None)
         for d in range(D):
             lo, hi = shard_starts[d], shard_starts[d + 1]
             n = hi - lo
@@ -278,7 +307,7 @@ class HostBatcher:
             row=row, col=colp, val=valp,
             label=label.reshape(D, R), weight=weight.reshape(D, R),
             nrows=nrows, total_rows=int(take),
-            qid=qid.reshape(D, R) if self._has_qid else None,
+            qid=qid.reshape(D, R) if self._emit_qid else None,
             field=fldp)
 
     def _emit_dense(self, take, label, weight, lens, col, val, qid):
@@ -301,7 +330,7 @@ class HostBatcher:
             x=x.reshape(D, R, F),
             label=label.reshape(D, R), weight=weight.reshape(D, R),
             nrows=nrows, total_rows=int(take),
-            qid=qid.reshape(D, R) if self._has_qid else None)
+            qid=qid.reshape(D, R) if self._emit_qid else None)
 
     def reset(self) -> None:
         self.parser.before_first()
@@ -339,12 +368,25 @@ class NativeHostBatcher:
         self.dense_max_features = dense_max_features
         self.dense_dtype = dense_dtype
         self._num_features: Optional[int] = None
+        # plane presence pins on the first batch so the emitted pytree
+        # structure (and therefore jitted consumers' traces) stays static
+        self._emit_qid: Optional[bool] = None
+        self._emit_field: Optional[bool] = None
 
     def next_batch(self):
         meta = self._b.next_meta()
         if meta is None:
             return None
         take, bucket, max_index, has_qid, has_field = meta
+        if self._emit_qid is None:
+            self._emit_qid, self._emit_field = has_qid, has_field
+        elif (has_qid and not self._emit_qid) or (
+                has_field and not self._emit_field):
+            raise DMLCError(
+                "qid/field column appeared mid-stream after the batch "
+                "structure was pinned without it; order the inputs so the "
+                "first batch carries the column")
+        has_qid, has_field = self._emit_qid, self._emit_field
         D = self.num_shards
         R = self.batch_rows // D
         if self.layout == "auto":

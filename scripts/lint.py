@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""Repo lint lane (reference scripts/lint.py runs cpplint/pylint on every
+push, .github/workflows/githubci.yml:1-38; no third-party linters ship in
+this image, so this is a self-contained checker enforcing the rules the
+codebase actually follows).
+
+Checks, per file class:
+  all sources   no tabs, no trailing whitespace, newline at EOF,
+                no CRLF line endings
+  *.py          parses (ast.parse), line length <= 88
+  *.cc / *.h    line length <= 90; headers carry an include guard
+
+Exit code is the number of offending files (0 = clean).
+"""
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SKIP_DIRS = {".git", ".bench_cache", "_native", "__pycache__",
+             ".pytest_cache", ".claude", "doc"}
+PY_MAX = 88
+CC_MAX = 90
+
+
+def iter_sources():
+    for root, dirs, files in os.walk(REPO):
+        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+        for f in sorted(files):
+            if f.endswith((".py", ".cc", ".h")):
+                yield os.path.join(root, f)
+
+
+def lint_file(path: str) -> list:
+    errs = []
+    rel = os.path.relpath(path, REPO)
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    if b"\r\n" in raw:
+        errs.append(f"{rel}: CRLF line endings")
+    if raw and not raw.endswith(b"\n"):
+        errs.append(f"{rel}: missing newline at EOF")
+    text = raw.decode("utf-8", errors="replace")
+    limit = PY_MAX if path.endswith(".py") else CC_MAX
+    for i, line in enumerate(text.split("\n")):
+        if "\t" in line:
+            errs.append(f"{rel}:{i + 1}: tab character")
+        if line != line.rstrip():
+            errs.append(f"{rel}:{i + 1}: trailing whitespace")
+        if len(line) > limit:
+            errs.append(f"{rel}:{i + 1}: line too long "
+                        f"({len(line)} > {limit})")
+    if path.endswith(".py"):
+        try:
+            ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            errs.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+    elif path.endswith(".h"):
+        if not re.search(r"#ifndef \w+_H_\n#define \w+_H_", text):
+            errs.append(f"{rel}: missing DCT-style include guard")
+    return errs
+
+
+def main() -> int:
+    bad_files = 0
+    for path in iter_sources():
+        errs = lint_file(path)
+        if errs:
+            bad_files += 1
+            for e in errs:
+                print(e)
+    total = sum(1 for _ in iter_sources())
+    print(f"lint: {total} files checked, {bad_files} with problems")
+    return bad_files
+
+
+if __name__ == "__main__":
+    sys.exit(main())

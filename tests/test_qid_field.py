@@ -235,3 +235,63 @@ def test_ranking_loss_ignores_sentinel_qid():
     assert int(pairs) == 1  # only the qid=7 pair (2 > 0)
     assert float(loss) == pytest.approx(float(np.log1p(np.exp(-0.4))),
                                         rel=1e-5)
+
+
+def test_fill_buffers_safe_without_columns(tmp_path):
+    # a C-API consumer may pass qid/field buffers even when the stream never
+    # carried the columns; the fill must emit sentinels, not read off-end
+    from dmlc_core_tpu.io.native import NativeBatcher
+    p = tmp_path / "plain.libsvm"
+    p.write_text("1 0:1.0 3:2.0\n0 1:0.5\n1 2:0.25\n")
+    nb = NativeBatcher(str(p), batch_rows=8, num_shards=2, min_nnz_bucket=16)
+    meta = nb.next_meta()
+    assert meta is not None and meta[3] is False and meta[4] is False
+    take, bucket = meta[0], meta[1]
+    row = np.empty((2, bucket), np.int32)
+    col = np.empty((2, bucket), np.int32)
+    val = np.empty((2, bucket), np.float32)
+    label = np.empty(8, np.float32)
+    weight = np.empty(8, np.float32)
+    nrows = np.empty(2, np.int32)
+    qid = np.empty(8, np.int32)
+    field = np.empty((2, bucket), np.int32)
+    nb.fill_csr(row, col, val, label, weight, nrows, qid=qid, field=field)
+    nb.close()
+    assert (qid == -1).all()      # sentinel everywhere
+    assert (field == 0).all()     # zero plane
+
+
+def test_structure_pins_on_first_batch():
+    # qid appearing after the pytree structure pinned without it must raise
+    # (silent mid-stream structure change would break jitted consumers).
+    # Blocks come from a stub parser: within one chunk the native parser
+    # already rejects ragged qid (parser.cc:164), so the mid-stream case
+    # only arises at block boundaries.
+    class Block:
+        def __init__(self, n, with_qid):
+            self.offset = np.arange(n + 1, dtype=np.int64)
+            self.index = np.zeros(n, np.uint32)
+            self.value = np.ones(n, np.float32)
+            self.label = np.zeros(n, np.float32)
+            self.weight = None
+            self.qid = (np.arange(n, dtype=np.uint64) if with_qid else None)
+            self.field = None
+            self.num_rows = n
+            self.nnz = n
+
+    class StubParser:
+        def __init__(self):
+            self.blocks = [Block(8, False), Block(8, True)]
+
+        def next_block(self):
+            return self.blocks.pop(0) if self.blocks else None
+
+        def before_first(self):
+            pass
+
+    hb = HostBatcher(StubParser(), batch_rows=8, num_shards=1,
+                     min_nnz_bucket=16, layout="csr")
+    first = hb.next_batch()
+    assert first is not None and first.qid is None
+    with pytest.raises(Exception, match="pinned"):
+        hb.next_batch()
