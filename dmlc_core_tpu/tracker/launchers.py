@@ -76,46 +76,22 @@ def inline_env(envs: Dict[str, object]) -> str:
 
 
 # -- local -------------------------------------------------------------------
-def exec_with_retry(cmd: Sequence[str], num_attempt: int, role: str,
-                    task_id: int, pass_env: Dict[str, object]) -> None:
-    """Run one worker process with the retry loop honoring DMLC_NUM_ATTEMPT
-    (reference local.py:12-49 — the worker-level failure recovery path)."""
-    cmd = list(cmd)
-    if "/" not in cmd[0] and os.path.exists(cmd[0]):
-        cmd[0] = "./" + cmd[0]
-    env = os.environ.copy()
-    for k, v in pass_env.items():
-        env[k] = str(v)
-    env["DMLC_TASK_ID"] = str(task_id)
-    env["DMLC_ROLE"] = role
-    env.setdefault("DMLC_JOB_CLUSTER", "local")
-    retries = int(env.get("DMLC_NUM_ATTEMPT", num_attempt))
-    trial = 0
-    while True:
-        env["DMLC_NUM_ATTEMPT"] = str(trial)
-        ret = subprocess.call(" ".join(cmd), shell=True, executable="/bin/bash",
-                              env=env)
-        if ret == 0:
-            return
-        trial += 1
-        retries -= 1
-        if retries < 0:
-            raise RuntimeError(
-                f"task {task_id} ({role}) failed with code {ret} after "
-                f"{trial} attempts: {' '.join(cmd)}")
-        logger.warning("task %d failed (code %d); attempt %d", task_id, ret,
-                       trial)
-
-
 def submit_local(args) -> None:
+    """Local backend under WorkerSupervisor: worker exit is detected and
+    the task relaunched under its old id (the restarted worker rejoins the
+    tracker with cmd=recover) — AppMaster-style supervision instead of the
+    reference's in-line retry loop (local.py:12-49)."""
+    from dmlc_core_tpu.tracker.supervisor import (WorkerSupervisor,
+                                                  popen_start_fn)
+
     def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        sup = WorkerSupervisor(max_attempts=args.num_attempt)
         for i in range(nworker + nserver):
             role = "worker" if i < nworker else "server"
-            t = threading.Thread(
-                target=exec_with_retry,
-                args=(args.command, args.num_attempt, role, i, dict(envs)),
-                daemon=True)
-            t.start()
+            sup.add(i, role, popen_start_fn(args.command, role, i,
+                                            dict(envs)))
+        sup.launch()  # spawn errors raise here, in the submitting caller
+        sup.watch_in_thread()
 
     rendezvous.run_job(args.num_workers, args.num_servers, launch,
                        host_ip=args.host_ip or "auto",
@@ -455,8 +431,45 @@ def submit_kubernetes(args) -> None:
         if args.kube_dry_run:
             print(payload)
             return
-        subprocess.run(["kubectl", "apply", "-f", "-"], input=payload,
-                       text=True, check=True)
+        # supervised submission (AppMaster parity): each role Job is a
+        # CommandTask — failed Jobs are deleted + re-applied up to
+        # --num-attempt times; restarted pods rejoin via cmd=recover
+        from dmlc_core_tpu.tracker.supervisor import (CommandTask,
+                                                      WorkerSupervisor)
+        kubectl = getattr(args, "kubectl", None) or "kubectl"
+        # CLI-polled supervision: each poll execs `kubectl get` against the
+        # API server, and Job state changes on minute timescales — poll
+        # seconds apart, not the local-Popen default
+        sup = WorkerSupervisor(max_attempts=args.num_attempt,
+                               poll_interval=5.0)
+        for i, m in enumerate(manifests):
+            name = m["metadata"]["name"]
+            one = json.dumps(m, indent=2)
+            # emit every condition as "Type=Status" — Complete/Failed may
+            # not be conditions[0] (k8s appends SuccessCriteriaMet /
+            # FailureTarget first on recent versions)
+            status_path = ("jsonpath={range .status.conditions[*]}"
+                           "{.type}={.status} {end}")
+
+            def start(attempt, one=one, name=name):
+                if attempt > 0:  # tear down the failed incarnation first
+                    subprocess.run([kubectl, "delete", "job", name,
+                                    "--ignore-not-found=true"],
+                                   capture_output=True)
+                return CommandTask(
+                    submit_cmd=[kubectl, "apply", "-f", "-"],
+                    submit_input=one,
+                    status_cmd=[kubectl, "get", "job", name, "-o",
+                                status_path],
+                    succeeded_text="Complete=True",
+                    failed_text="Failed=True",
+                    delete_cmd=[kubectl, "delete", "job", name,
+                                "--ignore-not-found=true"])
+
+            role = m["spec"]["template"]["metadata"]["labels"]["dmlc-role"]
+            sup.add(i, role, start)
+        sup.launch()  # submission errors (RBAC, kubeconfig) raise here
+        sup.watch_in_thread()
 
     if args.kube_dry_run:
         # no tracker: render manifests with placeholder rendezvous env and

@@ -73,7 +73,7 @@ def main() -> int:
                 print(e)
     total = sum(1 for _ in iter_sources())
     print(f"lint: {total} files checked, {bad_files} with problems")
-    return bad_files
+    return 1 if bad_files else 0  # exit status wraps mod 256 — keep it 0/1
 
 
 if __name__ == "__main__":
