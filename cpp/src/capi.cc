@@ -13,6 +13,7 @@
 
 #include "batcher.h"
 #include "filesys.h"
+#include "hdfs_filesys.h"
 #include "input_split.h"
 #include "parser.h"
 #include "recordio.h"
@@ -94,6 +95,15 @@ struct ParserHandle {
 extern "C" {
 
 const char* dct_last_error() { return g_last_error.c_str(); }
+
+// Rotate the WebHDFS delegation token at runtime (long-running jobs renew
+// Hadoop tokens mid-flight); empty string reverts to user.name auth.
+int dct_webhdfs_set_delegation_token(const char* token) {
+  return Guard([&] {
+    dct::WebHdfsFileSystem::GetInstance()->set_delegation_token(
+        token == nullptr ? "" : token);
+  });
+}
 
 // ---------------------------------------------------------------- streams --
 typedef void* dct_stream_t;

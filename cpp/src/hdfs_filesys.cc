@@ -49,12 +49,17 @@ Target ResolveTarget(const WebHdfsConfig& cfg, const URI& uri) {
   return t;
 }
 
-// /webhdfs/v1<path>?op=<OP>&user.name=<u>&<extra...>
+// /webhdfs/v1<path>?op=<OP>&delegation=<t>|user.name=<u>&<extra...>
 std::string OpPath(const WebHdfsConfig& cfg, const std::string& path,
                    const std::string& op, const std::string& extra) {
   std::string p = path.empty() ? "/" : path;
   std::string out = "/webhdfs/v1" + s3::UriEncode(p, true) + "?op=" + op;
-  if (!cfg.user.empty()) out += "&user.name=" + s3::UriEncode(cfg.user, false);
+  if (!cfg.delegation_token.empty()) {
+    // token auth: user.name must NOT accompany delegation (WebHDFS spec)
+    out += "&delegation=" + s3::UriEncode(cfg.delegation_token, false);
+  } else if (!cfg.user.empty()) {
+    out += "&user.name=" + s3::UriEncode(cfg.user, false);
+  }
   if (!extra.empty()) out += "&" + extra;
   return out;
 }
@@ -246,6 +251,12 @@ WebHdfsConfig WebHdfsConfig::FromEnv() {
   const char* user = std::getenv("HADOOP_USER_NAME");
   if (user == nullptr || *user == '\0') user = std::getenv("USER");
   if (user != nullptr) cfg.user = user;
+  const char* tok = std::getenv("WEBHDFS_DELEGATION_TOKEN");
+  if (tok != nullptr && *tok != '\0') cfg.delegation_token = tok;
+  const char* mr = std::getenv("WEBHDFS_MAX_RETRY");
+  if (mr != nullptr && *mr != '\0') cfg.max_retry = std::atoi(mr);
+  const char* rs = std::getenv("WEBHDFS_RETRY_SLEEP_MS");
+  if (rs != nullptr && *rs != '\0') cfg.retry_sleep_ms = std::atoi(rs);
   return cfg;
 }
 
@@ -255,8 +266,9 @@ WebHdfsFileSystem* WebHdfsFileSystem::GetInstance() {
 }
 
 FileInfo WebHdfsFileSystem::GetPathInfo(const URI& path) {
-  webhdfs::Target t = webhdfs::ResolveTarget(config_, path);
-  std::string p = webhdfs::OpPath(config_, path.path, "GETFILESTATUS", "");
+  const WebHdfsConfig cfg = config_copy();
+  webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
+  std::string p = webhdfs::OpPath(cfg, path.path, "GETFILESTATUS", "");
   HttpResponse resp = HttpRequest(t.host, t.port, "GET", p, {}, "");
   webhdfs::CheckStatus(resp, 200, "GETFILESTATUS", path);
   FileInfo info;
@@ -277,8 +289,9 @@ FileInfo WebHdfsFileSystem::GetPathInfo(const URI& path) {
 
 void WebHdfsFileSystem::ListDirectory(const URI& path,
                                       std::vector<FileInfo>* out) {
-  webhdfs::Target t = webhdfs::ResolveTarget(config_, path);
-  std::string p = webhdfs::OpPath(config_, path.path, "LISTSTATUS", "");
+  const WebHdfsConfig cfg = config_copy();
+  webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
+  std::string p = webhdfs::OpPath(cfg, path.path, "LISTSTATUS", "");
   HttpResponse resp = HttpRequest(t.host, t.port, "GET", p, {}, "");
   webhdfs::CheckStatus(resp, 200, "LISTSTATUS", path);
   std::string dir = path.path.empty() ? "/" : path.path;
@@ -320,8 +333,9 @@ SeekStream* WebHdfsFileSystem::OpenForRead(const URI& path, bool allow_null) {
     FileInfo info = GetPathInfo(path);
     DCT_CHECK(info.type == FileType::kFile)
         << "cannot open hdfs directory for read: " << path.Str();
-    webhdfs::Target t = webhdfs::ResolveTarget(config_, path);
-    return new webhdfs::WebHdfsReadStream(config_, t, path, info.size);
+    const WebHdfsConfig cfg = config_copy();
+    webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
+    return new webhdfs::WebHdfsReadStream(cfg, t, path, info.size);
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;
@@ -335,7 +349,8 @@ Stream* WebHdfsFileSystem::Open(const URI& path, const char* mode,
   bool append = m.find('a') != std::string::npos;
   DCT_CHECK(m.find('w') != std::string::npos || append)
       << "hdfs supports modes r|w|a, got " << mode;
-  webhdfs::Target t = webhdfs::ResolveTarget(config_, path);
+  const WebHdfsConfig cfg = config_copy();
+  webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
   if (append) {
     // append to an existing file; fall back to CREATE only when the
     // namenode definitively says 404 — any other failure must propagate,
@@ -347,9 +362,9 @@ Stream* WebHdfsFileSystem::Open(const URI& path, const char* mode,
       if (e.status != 404) throw;
       exists = false;
     }
-    return new webhdfs::WebHdfsWriteStream(config_, t, path, exists);
+    return new webhdfs::WebHdfsWriteStream(cfg, t, path, exists);
   }
-  return new webhdfs::WebHdfsWriteStream(config_, t, path);
+  return new webhdfs::WebHdfsWriteStream(cfg, t, path);
 }
 
 namespace {

@@ -12,6 +12,7 @@
 #ifndef DCT_HDFS_FILESYS_H_
 #define DCT_HDFS_FILESYS_H_
 
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -23,10 +24,16 @@ struct WebHdfsConfig {
   std::string namenode_host;  // default namenode when the URI has no host
   int namenode_port = 9870;   // WebHDFS default REST port
   std::string user;           // appended as user.name= when non-empty
+  // Hadoop delegation token: when non-empty every op carries
+  // `delegation=<token>` and user.name is omitted (the WebHDFS REST
+  // contract for token auth — the secure-cluster path the reference
+  // inherits from libhdfs/Hadoop auth, src/io/hdfs_filesys.cc).
+  std::string delegation_token;
   int max_retry = 50;         // read reconnect attempts (reference S3 parity)
   int retry_sleep_ms = 100;
 
-  // Env chain: WEBHDFS_NAMENODE ("host[:port]"), then HADOOP_USER_NAME /
+  // Env chain: WEBHDFS_NAMENODE ("host[:port]"), then
+  // WEBHDFS_DELEGATION_TOKEN for token auth, then HADOOP_USER_NAME /
   // USER for the identity (the reference reads the namenode from the URI or
   // hdfs-site defaults via libhdfs; env is this build's equivalent knob).
   static WebHdfsConfig FromEnv();
@@ -47,8 +54,22 @@ class WebHdfsFileSystem : public FileSystem {
 
   const WebHdfsConfig& config() const { return config_; }
 
+  // Runtime token rotation: long-running jobs renew Hadoop delegation
+  // tokens mid-flight; streams opened after the call use the new token
+  // (already-open streams keep the config they copied at creation).
+  void set_delegation_token(const std::string& token) {
+    std::lock_guard<std::mutex> lock(config_mutex_);
+    config_.delegation_token = token;
+  }
+
+  WebHdfsConfig config_copy() const {
+    std::lock_guard<std::mutex> lock(config_mutex_);
+    return config_;
+  }
+
  private:
   WebHdfsConfig config_;
+  mutable std::mutex config_mutex_;
 };
 
 namespace webhdfs {

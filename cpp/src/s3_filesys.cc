@@ -337,10 +337,27 @@ class S3WriteStream : public Stream {
       const std::string& method, const std::string& path,
       const std::vector<std::pair<std::string, std::string>>& query,
       std::map<std::string, std::string> headers, const std::string& body) {
-    // wire path percent-encoded to match the signed canonical form
-    return HttpRequest(target_.host, target_.port, method,
-                       s3::UriEncode(path, true) + QueryString(query),
-                       headers, body);
+    // write-side retry: 5xx/429 and transport drops are retried like the
+    // read path (RetryingHttpReadStream); request signing is
+    // deterministic, so a resend is byte-identical and parts are idempotent
+    // by partNumber
+    int attempts = 0;
+    while (true) {
+      try {
+        HttpResponse resp = HttpRequest(
+            target_.host, target_.port, method,
+            s3::UriEncode(path, true) + QueryString(query), headers, body);
+        if (RetryableHttpStatus(resp.status) && attempts < cfg_.max_retry) {
+          ++attempts;
+          usleep(cfg_.retry_sleep_ms * 1000);
+          continue;
+        }
+        return resp;
+      } catch (const Error&) {
+        if (++attempts > cfg_.max_retry) throw;
+        usleep(cfg_.retry_sleep_ms * 1000);
+      }
+    }
   }
 
   void StartMultipart() {
@@ -419,6 +436,12 @@ S3Config S3Config::FromEnv() {
   }
   const char* vs = std::getenv("S3_PATH_STYLE");
   if (vs != nullptr) cfg.path_style = std::atoi(vs) != 0;
+  // fault-tolerance knobs (defaults mirror the reference's <=50 x 100 ms
+  // read-retry loop, s3_filesys.cc:522-546)
+  const char* mr = std::getenv("S3_MAX_RETRY");
+  if (mr != nullptr && *mr != '\0') cfg.max_retry = std::atoi(mr);
+  const char* rs = std::getenv("S3_RETRY_SLEEP_MS");
+  if (rs != nullptr && *rs != '\0') cfg.retry_sleep_ms = std::atoi(rs);
   return cfg;
 }
 

@@ -114,6 +114,7 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_parser_before_first": [vp],
         "dct_parser_bytes_read": [vp, c.POINTER(sz)],
         "dct_parser_free": [vp],
+        "dct_webhdfs_set_delegation_token": [c.c_char_p],
         "dct_batcher_create": [c.c_char_p, u, u, c.c_char_p, i, i,
                                c.c_uint64, c.c_uint32, c.c_uint64,
                                c.POINTER(vp)],
@@ -210,6 +211,14 @@ def path_info(uri: str) -> Tuple[int, bool]:
     _check(lib().dct_fs_path_info(uri.encode(), ctypes.byref(size),
                                   ctypes.byref(is_dir)))
     return size.value, bool(is_dir.value)
+
+
+def set_webhdfs_delegation_token(token: str) -> None:
+    """Rotate the hdfs:// delegation token at runtime: subsequent WebHDFS
+    ops carry `delegation=<token>` (and omit user.name) — the secure-HDFS
+    auth path; empty string reverts to user.name auth. Initial value comes
+    from WEBHDFS_DELEGATION_TOKEN (cpp/src/hdfs_filesys.cc FromEnv)."""
+    _check(lib().dct_webhdfs_set_delegation_token(token.encode()))
 
 
 # -- input split ------------------------------------------------------------

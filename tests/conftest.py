@@ -20,6 +20,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# fast retry loops for the fault-injection suites (the S3 config singleton
+# reads these once, at first native S3 use — set them before any test runs)
+os.environ.setdefault("S3_MAX_RETRY", "10")
+os.environ.setdefault("S3_RETRY_SLEEP_MS", "5")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -39,6 +39,21 @@ class MockS3State:
         self.next_upload = [0]
         self.fail_reads_after = None  # int: truncate GET bodies (retry test)
         self.requests = []       # (method, path) log
+        # -- fault-injection plan (the automated md5 soak, reference
+        #    test/README.md:3-30; faults apply AFTER signature checks) --
+        self.get_truncate_every = 0   # every Nth GET: body cut mid-stream
+        self.get_500_every = 0        # every Nth GET: 500 before body
+        self.part_500_every = 0       # every Nth part PUT: 500
+        self.complete_truncate_once = False  # one truncated Complete XML
+        self.lock = threading.Lock()
+        self._counters = {"get500": 0, "gettrunc": 0, "part": 0}
+
+    def _tick(self, kind, every):
+        if not every:
+            return False
+        with self.lock:
+            self._counters[kind] += 1
+            return self._counters[kind] % every == 0
 
 
 class MockS3Handler(BaseHTTPRequestHandler):
@@ -127,6 +142,17 @@ class MockS3Handler(BaseHTTPRequestHandler):
             hi = int(m.group(2)) + 1 if m.group(2) else len(data)
             data = data[lo:hi]
             status = 206
+        if st._tick("get500", st.get_500_every):
+            return self._reject(500, "InternalError")
+        if st._tick("gettrunc", st.get_truncate_every):
+            # mid-stream drop: declared length, half the body, connection cut
+            out = data[: max(len(data) // 2, 1)]
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(out)
+            self.close_connection = True
+            return
         if st.fail_reads_after is not None and len(data) > st.fail_reads_after:
             # simulate a flaky connection: send a truncated body
             out = data[: st.fail_reads_after]
@@ -184,6 +210,8 @@ class MockS3Handler(BaseHTTPRequestHandler):
         q = dict(urllib.parse.parse_qsl(
             urllib.parse.urlsplit(self.path).query, keep_blank_values=True))
         if "uploadId" in q:
+            if st._tick("part", st.part_500_every):
+                return self._reject(500, "InternalError")
             st.uploads[q["uploadId"]][int(q["partNumber"])] = body
             etag = hashlib.md5(body).hexdigest()
             self.send_response(200)
@@ -218,10 +246,20 @@ class MockS3Handler(BaseHTTPRequestHandler):
             self.wfile.write(xml)
             return
         if "uploadId" in q:
+            xml = b"<?xml version='1.0'?><CompleteMultipartUploadResult/>"
+            if st.complete_truncate_once:
+                # truncated response mid-stream; parts stay staged so the
+                # client's retried Complete succeeds
+                st.complete_truncate_once = False
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(xml)))
+                self.end_headers()
+                self.wfile.write(xml[: len(xml) // 2])
+                self.close_connection = True
+                return
             parts = st.uploads.pop(q["uploadId"])
             st.objects[(bucket, key)] = b"".join(
                 parts[i] for i in sorted(parts))
-            xml = b"<?xml version='1.0'?><CompleteMultipartUploadResult/>"
             self.send_response(200)
             self.send_header("Content-Length", str(len(xml)))
             self.end_headers()

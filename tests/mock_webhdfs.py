@@ -23,6 +23,20 @@ class MockHdfsState:
         self.requests = []       # (method, path) log
         self.port = None         # filled by serve(); used for redirect URLs
         self.one_step_writes = False  # HttpFS-style: no redirect on writes
+        # secure-cluster mode: every op must carry delegation=<this> and no
+        # user.name (the WebHDFS token-auth contract)
+        self.require_delegation = None
+        # fault injection (VERDICT r1 item 6): every Nth GET 500s
+        self.get_500_every = 0
+        self._get_count = 0
+        self._lock = threading.Lock()
+
+    def tick_500(self) -> bool:
+        if not self.get_500_every:
+            return False
+        with self._lock:
+            self._get_count += 1
+            return self._get_count % self.get_500_every == 0
 
 
 class MockHdfsHandler(BaseHTTPRequestHandler):
@@ -83,13 +97,35 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
         return self.rfile.read(n) if n else b""
 
     # -- handlers -----------------------------------------------------------
+    def _check_auth(self, q) -> bool:
+        """Token-auth contract: delegation=<token> present and user.name
+        absent on every request (including datanode hops)."""
+        st = self.state
+        if st.require_delegation is None:
+            return True
+        if q.get("delegation") != st.require_delegation:
+            self._remote_exc(
+                401, "delegation token missing or invalid")
+            return False
+        if "user.name" in q:
+            self._remote_exc(
+                400, "user.name must not accompany delegation")
+            return False
+        return True
+
     def do_GET(self):
         st = self.state
         st.requests.append(("GET", self.path))
         if not self._require_host():
             return
         path, q = self._parse()
+        if not self._check_auth(q):
+            return
         op = q.get("op", "").upper()
+        # inject 5xx only on the (retried) OPEN data path; metadata ops are
+        # deliberately one-shot in the client
+        if op == "OPEN" and st.tick_500():
+            return self._remote_exc(500, "Internal Server Error")
         if op == "GETFILESTATUS":
             status = self._status_obj(path)
             if status is None:
@@ -149,6 +185,8 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
         st.requests.append(("PUT", self.path))
         path, q = self._parse()
         body = self._read_body()
+        if not self._check_auth(q):
+            return
         if q.get("op", "").upper() != "CREATE":
             return self._remote_exc(400, "unsupported PUT op")
         if "datanode" not in q and not st.one_step_writes:

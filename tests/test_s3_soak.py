@@ -1,0 +1,95 @@
+"""Fault-injection soak for the S3 client (VERDICT r1 item 6): the
+reference's manual md5 soak (test/README.md:3-30 — 10 parallel `filesys_test
+cat s3://...` with md5 verification) automated against the mock server with
+short reads, 5xx mid-stream, part-upload failures, and a truncated
+CompleteMultipartUpload response injected."""
+
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+# reuses test_s3's mock server + env (one S3 endpoint per process — the
+# native config is a singleton). Imported under pytest's top-level module
+# name so both files share ONE server; `tests.test_s3` would be a second
+# import -> second server -> whichever registered its endpoint first wins.
+from test_s3 import _STATE, put
+from dmlc_core_tpu.io.native import NativeStream
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    _STATE.objects.clear()
+    _STATE.uploads.clear()
+    _STATE.fail_reads_after = None
+    _STATE.get_truncate_every = 0
+    _STATE.get_500_every = 0
+    _STATE.part_500_every = 0
+    _STATE.complete_truncate_once = False
+    _STATE.requests.clear()
+    yield
+    _STATE.get_truncate_every = 0
+    _STATE.get_500_every = 0
+    _STATE.part_500_every = 0
+    _STATE.complete_truncate_once = False
+
+
+def pseudo_bytes(n: int, seed: int = 0) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+@pytest.mark.slow
+def test_parallel_read_md5_soak_under_faults():
+    """Multi-MB object, parallel readers, truncations + 5xx injected —
+    every reader must still see the exact bytes (md5-verified)."""
+    data = pseudo_bytes(4 << 20)
+    want = hashlib.md5(data).hexdigest()
+    put("soak/blob.bin", data)
+    _STATE.get_truncate_every = 3   # every 3rd GET drops mid-body
+    _STATE.get_500_every = 7        # every 7th GET 500s before the body
+
+    results = {}
+
+    def reader(i):
+        got = []
+        for _ in range(2):  # two passes per reader, like the soak loop
+            with NativeStream("s3://bkt/soak/blob.bin", "r") as s:
+                got.append(hashlib.md5(s.read_all()).hexdigest())
+        results[i] = got
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert sorted(results) == [0, 1, 2, 3]
+    for i, digests in results.items():
+        assert digests == [want, want], f"reader {i} corrupted"
+    # the soak only proves something if faults actually fired
+    assert len(_STATE.requests) > 8
+
+
+@pytest.mark.slow
+def test_multipart_upload_retries_part_500s():
+    """Part PUTs 500 on a schedule; the write path must retry each part and
+    the assembled object must be bit-exact."""
+    data = pseudo_bytes(12 << 20, seed=1)  # 2 full 5 MB parts + remainder
+    _STATE.part_500_every = 2  # every 2nd part PUT fails
+    with NativeStream("s3://bkt/soak/up.bin", "w") as s:
+        s.write(data)
+    got = _STATE.objects[("bkt", "soak/up.bin")]
+    assert hashlib.md5(got).hexdigest() == hashlib.md5(data).hexdigest()
+
+
+@pytest.mark.slow
+def test_complete_multipart_truncated_response_retried():
+    """A truncated CompleteMultipartUpload response (connection cut
+    mid-XML) is a transport error; the retried Complete must land."""
+    data = pseudo_bytes(6 << 20, seed=2)
+    _STATE.complete_truncate_once = True
+    with NativeStream("s3://bkt/soak/trunc.bin", "w") as s:
+        s.write(data)
+    got = _STATE.objects[("bkt", "soak/trunc.bin")]
+    assert hashlib.md5(got).hexdigest() == hashlib.md5(data).hexdigest()

@@ -168,3 +168,75 @@ def test_viewfs_scheme_dispatches_same_fs():
     _STATE.files["/v/file.txt"] = b"via viewfs"
     with NativeStream(f"viewfs://127.0.0.1:{_PORT}/v/file.txt", "r") as s:
         assert s.read_all() == b"via viewfs"
+
+
+def test_delegation_token_flows_on_read_and_write():
+    """Secure-cluster auth (VERDICT r1 item 8): with a delegation token set,
+    every WebHDFS op carries delegation=<token> and omits user.name; the
+    mock enforces both (401/400 otherwise)."""
+    from dmlc_core_tpu.io.native import set_webhdfs_delegation_token
+    _STATE.files["/sec/data.txt"] = b"secret payload"
+    _STATE.require_delegation = "tokABC123"
+    set_webhdfs_delegation_token("tokABC123")
+    try:
+        with NativeStream(uri("/sec/data.txt"), "r") as s:
+            assert s.read_all() == b"secret payload"
+        with NativeStream(uri("/sec/out.txt"), "w") as s:
+            s.write(b"written under token auth")
+        assert _STATE.files["/sec/out.txt"] == b"written under token auth"
+        ops = [p for _, p in _STATE.requests]
+        assert any("op=OPEN" in p and "delegation=tokABC123" in p
+                   for p in ops)
+        assert any("op=CREATE" in p and "delegation=tokABC123" in p
+                   for p in ops)
+        assert not any("user.name=" in p for p in ops)
+    finally:
+        set_webhdfs_delegation_token("")
+        _STATE.require_delegation = None
+
+
+def test_wrong_delegation_token_rejected():
+    from dmlc_core_tpu.io.native import set_webhdfs_delegation_token
+    _STATE.files["/sec/data.txt"] = b"x"
+    _STATE.require_delegation = "good"
+    set_webhdfs_delegation_token("bad")
+    try:
+        with pytest.raises(DMLCError, match="401|delegation"):
+            with NativeStream(uri("/sec/data.txt"), "r") as s:
+                s.read_all()
+    finally:
+        set_webhdfs_delegation_token("")
+        _STATE.require_delegation = None
+
+
+@pytest.mark.slow
+def test_webhdfs_md5_soak_under_faults():
+    """Fault soak (VERDICT r1 item 6): 5xx on the OPEN path + truncated
+    bodies; parallel readers must still see exact bytes."""
+    import hashlib
+    import threading
+
+    import numpy as np
+    data = np.random.default_rng(5).integers(
+        0, 256, size=2 << 20, dtype=np.uint8).tobytes()
+    want = hashlib.md5(data).hexdigest()
+    _STATE.files["/soak/blob.bin"] = data
+    _STATE.get_500_every = 4
+    _STATE.fail_reads_after = 300_000  # every body truncated at 300 kB
+    try:
+        results = {}
+
+        def reader(i):
+            with NativeStream(uri("/soak/blob.bin"), "r") as s:
+                results[i] = hashlib.md5(s.read_all()).hexdigest()
+
+        threads = [threading.Thread(target=reader, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert results == {0: want, 1: want, 2: want}
+    finally:
+        _STATE.get_500_every = 0
+        _STATE.fail_reads_after = None
