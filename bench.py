@@ -5,27 +5,38 @@ Prints ONE JSON line:
   {"metric": "higgs_libsvm_ingest_rows_per_sec", "value": N,
    "unit": "rows/s", "vs_baseline": R, "extras": {...}}
 
-- value: end-to-end rows/sec through the full TPU-native pipeline
-  (native multithreaded parse -> static-shape padding -> device_put under a
-  mesh sharding -> a consuming jitted reduction on device, overlapped via the
-  double buffer).
+- value: MEDIAN of --reps (default 5) end-to-end passes through the full
+  TPU-native pipeline (native multithreaded parse -> static-shape padding
+  with native bf16 dense emission -> device_put under a mesh sharding -> a
+  consuming jitted reduction on device, overlapped via the double buffer).
+  The spread (min/max) rides in extras.e2e_spread_rows_per_sec so the
+  number is reproducible, not a lucky draw (VERDICT r2 item 8).
 - vs_baseline: ratio against the reference C++ build's parse-to-host
   throughput on the same dataset/machine (bench_baseline.json; the reference
   publishes no numbers — BASELINE.md).
-- extras.hbm_ingest_bw_util: (device bytes landed / wall time) divided by the
-  measured attainable device_put bandwidth on the same chip+sharding — the
-  BASELINE.md north-star metric. extras.bottleneck names the binding stage.
-- extras.thread_scaling: host-parse rows/s at 1/2/4 parse workers
-  (VERDICT r1 item 1: the reference's nprocs/2-4 cap is gone; parse workers
-  now default to all cores and scale with --threads).
+- extras.hbm_ingest_bw_util: (device bytes landed / wall time) divided by
+  the attainable device_put bandwidth measured for the SAME pytree the
+  pipeline lands per batch — the BASELINE.md north-star metric. The
+  contiguous single-buffer ceiling is also reported
+  (attainable_contiguous_bytes_per_sec) so both denominators are visible
+  (VERDICT r2 weak 7). extras.bottleneck names the binding stage.
+- extras.thread_scaling: host-parse rows/s at 1/2/4 parse workers.
+- --format=rec: binary-ingest lane — the dataset is converted once to
+  RecordIO-framed row blocks (rows_to_recordio) and ingested through the
+  native "rec" parser, isolating the north star from the text-parse
+  ceiling (VERDICT r2 item 2). The default JSON line stays the libsvm
+  headline; extras.rec_lane carries the rec lane's numbers unless
+  --no-rec-lane is given.
 
 Flags: --smoke (tiny dataset, CI), --rows N, --parse-only, --threads N,
---no-scaling-table.
+--reps N, --format {libsvm,rec}, --dense-dtype {bf16,f32},
+--no-scaling-table, --no-rec-lane.
 """
 
 import argparse
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -58,13 +69,27 @@ def ensure_dataset(rows: int) -> str:
     return path
 
 
-def parse_rows_per_sec(path: str, rows: int, nthread: int
+def ensure_rec_dataset(rows: int) -> str:
+    """Binary lane: the libsvm dataset converted once to RecordIO-framed
+    row blocks (the pre-parsed ingest format, reference recordio.h:166
+    ChunkReader rationale — binary ingest can feed what text parse cannot)."""
+    from dmlc_core_tpu.io.convert import rows_to_recordio
+    src = ensure_dataset(rows)
+    path = os.path.join(CACHE_DIR, f"higgs_{rows}.rec")
+    if os.path.exists(path):
+        return path
+    rows_to_recordio(src, path + ".tmp", fmt="libsvm")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto"
                        ) -> "tuple[float, float]":
     """(rows/s, seconds) host-parse throughput at a given worker count."""
     from dmlc_core_tpu.io.native import NativeParser
     t0 = time.time()
     got = 0
-    with NativeParser(path, nthread=nthread) as p:
+    with NativeParser(path, nthread=nthread, fmt=fmt) as p:
         for b in p:
             got += b.num_rows
     dt = time.time() - t0
@@ -72,9 +97,9 @@ def parse_rows_per_sec(path: str, rows: int, nthread: int
     return rows / dt, dt
 
 
-def attainable_device_put_bw(sharding, nbytes: int) -> float:
-    """Best host->device bandwidth (B/s) for a buffer of ~nbytes under the
-    same sharding the pipeline uses: the denominator of the north star."""
+def attainable_contiguous_bw(sharding, nbytes: int) -> float:
+    """Best host->device bandwidth (B/s) for one large contiguous buffer
+    under the pipeline's sharding: the optimistic ceiling."""
     import numpy as np
     import jax
     n = max(nbytes // 4, 1 << 20)
@@ -91,8 +116,93 @@ def attainable_device_put_bw(sharding, nbytes: int) -> float:
     return best
 
 
+def attainable_pytree_bw(host_tree, sharding) -> float:
+    """Best host->device bandwidth (B/s) for the SAME pytree of arrays the
+    pipeline lands per batch — the honest denominator for bw-util (the
+    per-array dispatch overhead is part of what a real batch pays)."""
+    import jax
+    nbytes = sum(int(v.nbytes) for v in host_tree.values())
+    best = 0.0
+    for _ in range(3):
+        t0 = time.time()
+        tree = (jax.device_put(host_tree, sharding) if sharding is not None
+                else jax.device_put(host_tree))
+        jax.block_until_ready(list(tree.values()))
+        dt = time.time() - t0
+        best = max(best, nbytes / dt)
+        del tree
+    return best
+
+
 def tree_nbytes(batch) -> int:
     return sum(int(v.nbytes) for v in batch.tree().values())
+
+
+def run_e2e_epoch(it, rows, consume):
+    """One timed end-to-end pass over a (restarted) iterator; returns
+    (seconds, device_bytes)."""
+    import time as _t
+    t0 = _t.time()
+    got = 0
+    device_bytes = 0
+    acc = None
+    for batch in it:
+        got += batch.total_rows  # host-side count: no device sync
+        device_bytes += tree_nbytes(batch)
+        acc = consume(batch.tree())
+    if acc is not None:
+        acc.block_until_ready()
+    dt = _t.time() - t0
+    assert got == rows, f"row count mismatch: {got} != {rows}"
+    return dt, device_bytes
+
+
+def run_lane(path, rows, fmt, args, mesh, consume):
+    """Median-of-reps e2e lane; returns a metrics dict."""
+    import numpy as np
+    from dmlc_core_tpu.tpu.device_iter import DeviceRowBlockIter
+
+    # grab one HOST batch for the pytree ceiling
+    host_tree = None
+    with DeviceRowBlockIter(path, fmt=fmt, batch_rows=args.batch_rows,
+                            mesh=mesh, nthread=args.threads,
+                            dense_dtype=args.dense_dtype,
+                            to_device=False) as hit:
+        for batch in hit:
+            host_tree = {k: np.asarray(v) for k, v in batch.tree().items()}
+            break
+    # ONE iterator for warm + timed reps: the warm epoch compiles every
+    # batch shape, faults the page cache, and primes the recycle pool that
+    # lives in the batcher — reps then measure steady state
+    with DeviceRowBlockIter(path, fmt=fmt, batch_rows=args.batch_rows,
+                            mesh=mesh, nthread=args.threads,
+                            dense_dtype=args.dense_dtype) as it:
+        for batch in it:
+            consume(batch.tree()).block_until_ready()
+        sharding = it.sharding
+        runs = []
+        for _ in range(args.reps):
+            it.before_first()
+            runs.append(run_e2e_epoch(it, rows, consume))
+    dts = sorted(dt for dt, _ in runs)
+    device_bytes = runs[0][1]
+    dt = statistics.median(dts)
+
+    landed_bw = device_bytes / dt
+    attain_pytree = attainable_pytree_bw(host_tree, sharding)
+    attain_contig = attainable_contiguous_bw(
+        sharding, min(device_bytes, 256 << 20))
+    util = landed_bw / attain_pytree if attain_pytree > 0 else 0.0
+    return {
+        "dt": dt,
+        "rows_per_sec": rows / dt,
+        "spread_rows_per_sec": [round(rows / dts[-1], 1),
+                                round(rows / dts[0], 1)],
+        "hbm_ingest_bw_util": round(util, 4),
+        "device_bytes_per_sec": round(landed_bw, 1),
+        "attainable_pytree_bytes_per_sec": round(attain_pytree, 1),
+        "attainable_contiguous_bytes_per_sec": round(attain_contig, 1),
+    }
 
 
 def main() -> None:
@@ -102,14 +212,28 @@ def main() -> None:
     ap.add_argument("--parse-only", action="store_true",
                     help="skip device placement (host parse throughput)")
     ap.add_argument("--batch-rows", type=int, default=65536)
-    ap.add_argument("--threads", type=int, default=0,
-                    help="parse workers (0 = one per core)")
+    ap.add_argument("--threads", type=int, default=4,
+                    help="parse workers (default 4: I/O-stalled workers "
+                         "overlap even on small hosts; 0 = one per core)")
+    ap.add_argument("--reps", type=int, default=5,
+                    help="timed e2e repetitions; the median is reported")
+    ap.add_argument("--format", choices=("libsvm", "rec"), default="libsvm",
+                    help="headline lane: text parse or binary RecordIO")
+    ap.add_argument("--dense-dtype", choices=("bf16", "f32"), default="bf16",
+                    help="dense device dtype (bf16 halves host+HBM bytes)")
     ap.add_argument("--no-scaling-table", action="store_true")
+    ap.add_argument("--no-rec-lane", action="store_true",
+                    help="skip the secondary binary-ingest lane")
     args = ap.parse_args()
+    args.dense_dtype = "bfloat16" if args.dense_dtype == "bf16" else "float32"
 
     rows = args.rows or (20000 if args.smoke else 200000)
     path = ensure_dataset(rows)
-    size_mb = os.path.getsize(path) / 1e6
+    # the headline lane's own file: text for libsvm, converted for rec —
+    # every reported number (rows/s, MB/s, parse probe) uses this file
+    lane_fmt = args.format
+    lane_path = path if lane_fmt == "libsvm" else ensure_rec_dataset(rows)
+    size_mb = os.path.getsize(lane_path) / 1e6
 
     from dmlc_core_tpu.io.native import NativeParser
 
@@ -120,16 +244,16 @@ def main() -> None:
     extras = {}
     if not args.no_scaling_table:
         extras["thread_scaling"] = {
-            str(t): round(parse_rows_per_sec(path, rows, t)[0], 1)
+            str(t): round(parse_rows_per_sec(lane_path, rows, t,
+                                             fmt=lane_fmt)[0], 1)
             for t in (1, 2, 4)}
 
     if args.parse_only:
-        _, dt = parse_rows_per_sec(path, rows, args.threads)
-        got = rows
+        rps, dt = parse_rows_per_sec(lane_path, rows, args.threads,
+                                     fmt=lane_fmt)
     else:
         import jax
         import jax.numpy as jnp
-        from dmlc_core_tpu.tpu.device_iter import DeviceRowBlockIter
         from dmlc_core_tpu.tpu.sharding import data_mesh
 
         mesh = data_mesh()
@@ -140,77 +264,72 @@ def main() -> None:
             # touch every array so the batch is fully materialized in HBM
             return sum(jnp.sum(v.astype(jnp.float32)) for v in tree.values())
 
-        # warm compile on a first batch shape
-        sharding = None
-        with DeviceRowBlockIter(path, batch_rows=args.batch_rows,
-                                mesh=mesh, nthread=args.threads) as it:
-            for batch in it:
-                consume(batch.tree()).block_until_ready()
-                break
-            sharding = it.sharding
-
-        t0 = time.time()
-        got = 0
-        device_bytes = 0
-        acc = None
-        with DeviceRowBlockIter(path, batch_rows=args.batch_rows,
-                                mesh=mesh, nthread=args.threads) as it:
-            for batch in it:
-                got += batch.total_rows  # host-side count: no device sync
-                device_bytes += tree_nbytes(batch)
-                acc = consume(batch.tree())
-        if acc is not None:
-            acc.block_until_ready()
-        dt = time.time() - t0
-
-        # -- north star: HBM ingest bandwidth utilization -------------------
-        landed_bw = device_bytes / dt
-        attainable = attainable_device_put_bw(
-            sharding, min(device_bytes, 256 << 20))
-        util = landed_bw / attainable if attainable > 0 else 0.0
+        lane = run_lane(lane_path, rows, lane_fmt, args, mesh, consume)
+        dt = lane["dt"]
+        rps = lane["rows_per_sec"]
         extras.update({
-            "hbm_ingest_bw_util": round(util, 4),
-            "device_bytes_per_sec": round(landed_bw, 1),
-            "attainable_device_put_bytes_per_sec": round(attainable, 1),
+            "hbm_ingest_bw_util": lane["hbm_ingest_bw_util"],
+            "device_bytes_per_sec": lane["device_bytes_per_sec"],
+            "attainable_pytree_bytes_per_sec":
+                lane["attainable_pytree_bytes_per_sec"],
+            "attainable_contiguous_bytes_per_sec":
+                lane["attainable_contiguous_bytes_per_sec"],
+            "e2e_spread_rows_per_sec": lane["spread_rows_per_sec"],
+            "reps": args.reps,
             "ncores": os.cpu_count(),
         })
         # name the binding stage: with one host core the pipeline stages
-        # (parse workers, batch fill, device_put dispatch) cannot overlap and
-        # serialize on the CPU; with cores to spare, compare e2e against the
-        # host-parse-only rate to tell parse-bound from transfer-bound
-        if util < 0.9:
-            e2e_rps = rows / dt
+        # (parse workers, batch fill, device_put dispatch) cannot overlap
+        # and serialize on the CPU; with cores to spare, compare e2e against
+        # the host-parse-only rate to tell parse-bound from transfer-bound
+        if lane["hbm_ingest_bw_util"] < 0.9:
             if (os.cpu_count() or 1) <= 1:
                 extras["bottleneck"] = "host_cpu_serialized_single_core"
             else:
-                # baseline at the SAME worker count as the e2e run, so the
-                # comparison isolates the device stages
-                parse_rps, _ = parse_rows_per_sec(path, rows, args.threads)
-                if e2e_rps >= 0.75 * parse_rps:
-                    extras["bottleneck"] = "host_text_parse"
-                else:
-                    extras["bottleneck"] = "host_to_hbm_transfer"
-            print(f"# bw-util {util:.1%}: landed {landed_bw / 1e6:.0f} MB/s "
-                  f"vs attainable {attainable / 1e6:.0f} MB/s -> "
-                  f"{extras['bottleneck']} on {os.cpu_count()} core(s)",
-                  file=sys.stderr)
+                parse_rps, _ = parse_rows_per_sec(lane_path, rows,
+                                                  args.threads, fmt=lane_fmt)
+                extras["bottleneck"] = ("host_parse"
+                                        if rps >= 0.75 * parse_rps
+                                        else "host_to_hbm_transfer")
+            print(f"# bw-util {lane['hbm_ingest_bw_util']:.1%}: landed "
+                  f"{lane['device_bytes_per_sec'] / 1e6:.0f} MB/s vs "
+                  f"pytree-attainable "
+                  f"{lane['attainable_pytree_bytes_per_sec'] / 1e6:.0f} MB/s"
+                  f" (contiguous "
+                  f"{lane['attainable_contiguous_bytes_per_sec'] / 1e6:.0f}"
+                  f" MB/s) -> {extras['bottleneck']} on "
+                  f"{os.cpu_count()} core(s)", file=sys.stderr)
 
-    assert got == rows, f"row count mismatch: {got} != {rows}"
-    rps = rows / dt
+        # secondary lane: binary RecordIO ingest (north-star isolation)
+        if args.format == "libsvm" and not args.no_rec_lane:
+            rec_path = ensure_rec_dataset(rows)
+            rec = run_lane(rec_path, rows, "rec", args, mesh, consume)
+            extras["rec_lane"] = {
+                "rows_per_sec": round(rec["rows_per_sec"], 1),
+                "hbm_ingest_bw_util": rec["hbm_ingest_bw_util"],
+                "device_bytes_per_sec": rec["device_bytes_per_sec"],
+                "attainable_pytree_bytes_per_sec":
+                    rec["attainable_pytree_bytes_per_sec"],
+                "e2e_spread_rows_per_sec": rec["spread_rows_per_sec"],
+            }
+            print(f"# rec lane: {rec['rows_per_sec']:.0f} rows/s, bw-util "
+                  f"{rec['hbm_ingest_bw_util']:.1%}", file=sys.stderr)
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")
     vs = None
-    if os.path.exists(baseline_path):
+    if os.path.exists(baseline_path) and lane_fmt == "libsvm":
+        # the recorded baseline is the reference's TEXT parse-to-host rate;
+        # the rec lane has no reference analog, so it reports no ratio
         with open(baseline_path) as f:
             base = json.load(f)
         # scale: baseline measured on the 200k dataset; rows/s is size-stable
         vs = round(rps / base["reference_rows_per_sec"], 3)
 
-    print(f"# {rows} rows ({size_mb:.1f} MB) in {dt:.3f}s = "
-          f"{size_mb / dt:.1f} MB/s", file=sys.stderr)
+    print(f"# {rows} rows ({size_mb:.1f} MB {lane_fmt}) in {dt:.3f}s = "
+          f"{size_mb / dt:.1f} MB/s (median of {args.reps})", file=sys.stderr)
     print(json.dumps({
-        "metric": "higgs_libsvm_ingest_rows_per_sec",
+        "metric": f"higgs_{lane_fmt}_ingest_rows_per_sec",
         "value": round(rps, 1),
         "unit": "rows/s",
         "vs_baseline": vs,

@@ -28,6 +28,16 @@ void PaddedBatcher::Accumulate() {
     }
     const size_t n = b->Size();
     const size_t nnz = b->offset.back();
+    // The device layout is int32: a feature id >= 2^31 would wrap negative
+    // in the bulk copy below and scatter to a wrong column — refuse loudly
+    // instead of corrupting silently (reference data.h:26-32 makes index
+    // width a first-class contract; the Python HostBatcher mirrors this).
+    // Checked BEFORE any insert so a caught error leaves the pending
+    // arrays consistent.
+    DCT_CHECK(b->max_index <= 0x7fffffffULL)
+        << "feature index " << b->max_index
+        << " exceeds the int32 device layout (max 2147483647); remap "
+           "feature ids below 2^31 for the TPU batch layout";
     const size_t prev_rows = label_.size();  // pre-block counts for the
     const size_t prev_nnz = val_.size();     // lazy qid_/field_ backfill
     label_.insert(label_.end(), b->label.begin(), b->label.end());
@@ -71,8 +81,8 @@ void PaddedBatcher::Accumulate() {
     } else if (have_field_) {
       field_.insert(field_.end(), nnz, 0);
     }
-    // uint32 -> int32 is bit-identical (ids >= 2^31 wrap negative either
-    // way and cannot be represented in the int32 device layout): bulk copy.
+    // uint32 -> int32 is bit-identical for ids < 2^31 (guarded at the top
+    // of this loop): bulk copy.
     // Guard nnz == 0: data() may be null then and memcpy is nonnull-UB.
     if (nnz != 0) {
       const size_t col_old = col_.size();
@@ -206,16 +216,33 @@ void PaddedBatcher::FillQid(int32_t* qid) {
   std::fill(qid + take_, qid + batch_rows_, -1);
 }
 
-void PaddedBatcher::FillDense(float* x, uint64_t num_features, float* label,
-                              float* weight, int32_t* nrows, int32_t* qid) {
-  DCT_CHECK(staged_) << "FillDense without a staged batch (call NextMeta)";
-  if (qid != nullptr) {
-    FillQid(qid);
+namespace {
+
+// float -> bfloat16 storage bits, round-to-nearest-even (the XLA/MXU
+// convention); NaN is quieted with the sign preserved.
+inline uint16_t Bf16Bits(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, sizeof(u));
+  if ((u & 0x7fffffffu) > 0x7f800000u) {
+    return static_cast<uint16_t>((u >> 16) | 0x0040u);
   }
-  std::memset(x, 0, batch_rows_ * num_features * sizeof(float));
+  u += 0x7fffu + ((u >> 16) & 1u);
+  return static_cast<uint16_t>(u >> 16);
+}
+
+inline void StoreDense(float* xr, int32_t c, float v) { xr[c] = v; }
+inline void StoreDense(uint16_t* xr, int32_t c, float v) {
+  xr[c] = Bf16Bits(v);
+}
+
+}  // namespace
+
+template <typename T>
+void PaddedBatcher::FillDenseT(T* x, uint64_t num_features) {
+  std::memset(x, 0, batch_rows_ * num_features * sizeof(T));
   size_t p = nnz_pos_;
   for (uint64_t r = 0; r < take_; ++r) {
-    float* xr = x + r * num_features;
+    T* xr = x + r * num_features;
     const uint64_t l = static_cast<uint64_t>(lens_[row_pos_ + r]);
     for (uint64_t k = 0; k < l; ++k) {
       const int32_t c = col_[p + k];
@@ -223,9 +250,25 @@ void PaddedBatcher::FillDense(float* x, uint64_t num_features, float* label,
           << "dense layout fixed at " << num_features
           << " features but saw index " << c
           << "; pass layout='csr' or a larger dense_max_features";
-      xr[c] = val_[p + k];
+      StoreDense(xr, c, val_[p + k]);
     }
     p += l;
+  }
+}
+
+void PaddedBatcher::FillDense(void* x, int x_dtype, uint64_t num_features,
+                              float* label, float* weight, int32_t* nrows,
+                              int32_t* qid) {
+  DCT_CHECK(staged_) << "FillDense without a staged batch (call NextMeta)";
+  DCT_CHECK(x_dtype == 0 || x_dtype == 1)
+      << "dense x dtype must be 0 (float32) or 1 (bfloat16), got " << x_dtype;
+  if (qid != nullptr) {
+    FillQid(qid);
+  }
+  if (x_dtype == 1) {
+    FillDenseT(static_cast<uint16_t*>(x), num_features);
+  } else {
+    FillDenseT(static_cast<float*>(x), num_features);
   }
   FillRowArrays(label, weight, nrows);
   Consume();

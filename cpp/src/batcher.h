@@ -52,16 +52,22 @@ class PaddedBatcher {
   void FillCSR(int32_t* row, int32_t* col, float* val, float* label,
                float* weight, int32_t* nrows, int32_t* qid = nullptr,
                int32_t* field = nullptr);
-  // x is [batch_rows, num_features], zeroed here before scatter. Field ids
-  // have no dense representation; use the CSR layout for field-aware models.
-  void FillDense(float* x, uint64_t num_features, float* label, float* weight,
-                 int32_t* nrows, int32_t* qid = nullptr);
+  // x is [batch_rows, num_features], zeroed here before scatter. x_dtype
+  // selects the element store: 0 = float32, 1 = bfloat16 (uint16 storage,
+  // round-to-nearest-even) — the MXU-native dtype; emitting bf16 here halves
+  // both the host fill bytes and the host->HBM transfer bytes and removes
+  // the numpy astype copy from the Python side. Field ids have no dense
+  // representation; use the CSR layout for field-aware models.
+  void FillDense(void* x, int x_dtype, uint64_t num_features, float* label,
+                 float* weight, int32_t* nrows, int32_t* qid = nullptr);
 
   void BeforeFirst();
   size_t BytesRead() const { return parser_->BytesRead(); }
 
  private:
   void Accumulate();           // pull parser blocks until a batch is pending
+  template <typename T>
+  void FillDenseT(T* x, uint64_t num_features);  // zero + scatter, typed
   void FillQid(int32_t* qid);  // staged qid column (or the -1 sentinel)
   void FillRowArrays(float* label, float* weight, int32_t* nrows);
   void Consume();              // advance past the staged batch + compact

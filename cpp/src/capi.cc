@@ -409,12 +409,14 @@ int dct_batcher_fill_csr(dct_batcher_t h, int32_t* row, int32_t* col,
   });
 }
 
-int dct_batcher_fill_dense(dct_batcher_t h, float* x, uint64_t num_features,
-                           float* label, float* weight, int32_t* nrows,
-                           int32_t* qid) {
+// x_dtype: 0 = float32, 1 = bfloat16 (uint16 storage) — bf16 emission halves
+// host fill and host->HBM transfer bytes for the dense (MXU) layout
+int dct_batcher_fill_dense(dct_batcher_t h, void* x, int32_t x_dtype,
+                           uint64_t num_features, float* label, float* weight,
+                           int32_t* nrows, int32_t* qid) {
   return Guard([&] {
-    static_cast<dct::PaddedBatcher*>(h)->FillDense(x, num_features, label,
-                                                   weight, nrows, qid);
+    static_cast<dct::PaddedBatcher*>(h)->FillDense(x, x_dtype, num_features,
+                                                   label, weight, nrows, qid);
   });
 }
 

@@ -12,6 +12,7 @@
 
 #include "numparse.h"
 #include "parameter.h"
+#include "recordio.h"
 #include "registry.h"
 
 namespace dct {
@@ -186,17 +187,15 @@ bool TextParserBase<IndexType>::FillBlocks(
     (*blocks)[0].UpdateMax();
     return true;
   }
-  // Tile the chunk into line-aligned slices: cut i starts at the first line
-  // head at/after i*size/n (reference text_parser.h BackFindEndLine tiles
-  // backward; forward tiling yields the same exact cover).
+  // Tile the chunk into unit-aligned slices: cut i starts at the first
+  // parse-unit head at/after i*size/n — line heads for text formats,
+  // RecordIO magics for binary (FindUnitBoundary; the reference tiles text
+  // backward via BackFindEndLine — forward tiling yields the same cover).
   std::vector<const char*> cuts(nworker + 1);
   cuts[0] = begin;
   cuts[nworker] = end;
   for (int i = 1; i < nworker; ++i) {
-    const char* raw = begin + chunk.size * i / nworker;
-    const char* nl =
-        static_cast<const char*>(memchr(raw, '\n', end - raw));
-    cuts[i] = nl == nullptr ? end : nl + 1;
+    cuts[i] = FindUnitBoundary(begin, begin + chunk.size * i / nworker, end);
   }
   for (int i = 1; i < nworker; ++i) {
     if (cuts[i] < cuts[i - 1]) cuts[i] = cuts[i - 1];
@@ -219,6 +218,16 @@ bool TextParserBase<IndexType>::FillBlocks(
     if (e != nullptr) std::rethrow_exception(e);  // reference OMPException
   }
   return true;
+}
+
+template <typename IndexType>
+const char* TextParserBase<IndexType>::FindUnitBoundary(const char* base,
+                                                        const char* hint,
+                                                        const char* end) {
+  (void)base;
+  const char* nl = static_cast<const char*>(
+      memchr(hint, '\n', static_cast<size_t>(end - hint)));
+  return nl == nullptr ? end : nl + 1;
 }
 
 template <typename IndexType>
@@ -452,6 +461,53 @@ void LibFMParser<IndexType>::ParseBlock(const char* begin, const char* end,
 }
 
 // --------------------------------------------------------------------------
+// rec: binary RecordIO-framed row blocks (parser.h RecParser). Each record
+// is [magic 'DRB1' u32le][flags u32le: bit0 = uint64 indices] followed by
+// the rowblock.h wire format; deserialization is bulk memcpy.
+namespace {
+constexpr uint32_t kRecRowBlockMagic = 0x44524231;  // 'DRB1' (LE word '1BRD')
+}  // namespace
+
+template <typename IndexType>
+RecParser<IndexType>::RecParser(InputSplit* source,
+                                const std::map<std::string, std::string>& args,
+                                int nthread)
+    : TextParserBase<IndexType>(source, nthread) {
+  (void)args;
+}
+
+template <typename IndexType>
+const char* RecParser<IndexType>::FindUnitBoundary(const char* base,
+                                                   const char* hint,
+                                                   const char* end) {
+  return FindRecordHead(base, hint, end);
+}
+
+template <typename IndexType>
+void RecParser<IndexType>::ParseBlock(const char* begin, const char* end,
+                                      RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  RecordIOChunkReader reader(begin, end, 0, 1);
+  RecordIOChunkReader::Blob rec;
+  while (reader.NextRecord(&rec)) {
+    DCT_CHECK(rec.size >= 8) << "rec record too short for a row-block header";
+    const char* p = static_cast<const char*>(rec.dptr);
+    DCT_CHECK(recordio::LoadWordLE(p) == kRecRowBlockMagic)
+        << "not a row-block record (bad payload magic); rec files are "
+           "written by rows_to_recordio (dmlc_core_tpu/io/convert.py)";
+    const bool is64 = (recordio::LoadWordLE(p + 4) & 1u) != 0;
+    DCT_CHECK(is64 == (sizeof(IndexType) == 8))
+        << "rec index width mismatch: payload has "
+        << (is64 ? "uint64" : "uint32") << " feature ids but the parser "
+        << "was created with index64=" << (sizeof(IndexType) == 8);
+    MemoryFixedSizeStream ms(const_cast<char*>(p) + 8, rec.size - 8);
+    // append-deserialize straight into the output container: one memcpy
+    // per array from the mapped chunk, no intermediate container
+    DCT_CHECK(out->LoadAppend(&ms)) << "truncated row-block record";
+  }
+}
+
+// --------------------------------------------------------------------------
 namespace {
 // "DCTRBL2" — bumped when the RowBlockContainer wire format changes (v2
 // added typed csv value arrays); a stale v1 cache fails the magic check and
@@ -637,7 +693,14 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
   std::string fmt = format;
   if (fmt == "auto" || fmt.empty()) {
     auto it = spec.args.find("format");
-    fmt = it == spec.args.end() ? "libsvm" : it->second;
+    if (it != spec.args.end()) {
+      fmt = it->second;
+    } else if (spec.uri.size() >= 4 &&
+               spec.uri.compare(spec.uri.size() - 4, 4, ".rec") == 0) {
+      fmt = "rec";  // binary row-block files are self-identifying by suffix
+    } else {
+      fmt = "libsvm";
+    }
   }
   std::map<std::string, std::string> args = spec.args;
   args["format"] = fmt;
@@ -650,8 +713,10 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
   if (entry == nullptr) {
     throw Error("unknown data format: " + fmt);
   }
-  InputSplit* split = InputSplit::Create(spec.uri, part, npart, "text", "",
-                                         false, 0, 256, false,
+  // binary row-block files partition on RecordIO magics, text on newlines
+  const char* split_type = fmt == "rec" ? "recordio" : "text";
+  InputSplit* split = InputSplit::Create(spec.uri, part, npart, split_type,
+                                         "", false, 0, 256, false,
                                          /*threaded=*/true, "");
   // ownership of split passes into the parser's base immediately; a throwing
   // constructor body unwinds through the already-built base, which frees it
@@ -680,6 +745,8 @@ template class CSVParser<uint32_t>;
 template class CSVParser<uint64_t>;
 template class LibFMParser<uint32_t>;
 template class LibFMParser<uint64_t>;
+template class RecParser<uint32_t>;
+template class RecParser<uint64_t>;
 template class ThreadedParser<uint32_t>;
 template class ThreadedParser<uint64_t>;
 template class DiskCacheParser<uint32_t>;
@@ -712,6 +779,11 @@ void RegisterBuiltinParsers() {
       .add_arguments(LibFMParserParam::__FIELDS__())
       .set_body([](InputSplit* s, const Map& args, int nthread) {
         return new LibFMParser<IndexType>(s, args, nthread);
+      });
+  reg->__REGISTER__("rec")
+      .describe("binary RecordIO-framed row blocks (rows_to_recordio)")
+      .set_body([](InputSplit* s, const Map& args, int nthread) {
+        return new RecParser<IndexType>(s, args, nthread);
       });
 }
 

@@ -81,6 +81,14 @@ class TextParserBase : public Parser<IndexType> {
   bool FillBlocks(std::vector<RowBlockContainer<IndexType>>* blocks);
 
  protected:
+  // Worker-tiling resync: the first parse-unit head at/after `hint` in
+  // [base, end). Text formats resync at line heads (default); binary
+  // formats override (RecParser resyncs at RecordIO magics — the reference
+  // splits text by BackFindEndLine and recordio by magic scan,
+  // src/recordio.cc FindNextRecordIOHead).
+  virtual const char* FindUnitBoundary(const char* base, const char* hint,
+                                       const char* end);
+
   std::unique_ptr<InputSplit> source_;
   int nthread_;
   // read from the consumer thread while the ThreadedParser producer fills
@@ -136,6 +144,26 @@ class LibFMParser : public TextParserBase<IndexType> {
 
  private:
   int indexing_mode_;
+};
+
+// rec: binary ingest — RecordIO records whose payloads are serialized
+// RowBlockContainers (8-byte header: 'DRB1' magic + flags, then the
+// rowblock.h wire format). Deserialization is bulk memcpy, so this lane
+// can feed the host->HBM path at rates text parsing cannot (the binary
+// counterpart of the reference's pre-parsed .rec datasets; chunk-parallel
+// via RecordIOChunkReader, reference recordio.h:166). Written by
+// dmlc_core_tpu/io/convert.py rows_to_recordio.
+template <typename IndexType>
+class RecParser : public TextParserBase<IndexType> {
+ public:
+  RecParser(InputSplit* source, const std::map<std::string, std::string>& args,
+            int nthread);
+  void ParseBlock(const char* begin, const char* end,
+                  RowBlockContainer<IndexType>* out) override;
+
+ protected:
+  const char* FindUnitBoundary(const char* base, const char* hint,
+                               const char* end) override;
 };
 
 // --------------------------------------------------------------------------

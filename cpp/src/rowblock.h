@@ -114,6 +114,58 @@ struct RowBlockContainer {
     serial::WritePOD<uint32_t>(s, max_field);
   }
 
+  // Append-deserialize another container's wire image onto this one —
+  // Load + Append fused without the intermediate container copy (the rec
+  // binary ingest hot path, parser.cc RecParser::ParseBlock). Returns
+  // false when the stream is exhausted before the first field.
+  bool LoadAppend(Stream* s) {
+    uint64_t n;
+    if (s->Read(&n, 8) != 8) return false;
+    if (!serial::NativeIsLE()) n = serial::ByteSwap(n);
+    // Offsets: the wire image carries n absolute offsets starting with a 0;
+    // appended rows rebase onto the current nnz tail and the leading 0 is
+    // dropped. Read all n into the grown tail, then shift-rebase in place
+    // (forward shift reads slot i+1 before iteration i+1 overwrites it).
+    const uint64_t nnz_base = offset.back();
+    if (n != 0) {
+      const size_t old = offset.size();
+      offset.resize(old + n - 1);
+      s->ReadExact(offset.data() + old, (n - 1) * 8);
+      uint64_t last;
+      s->ReadExact(&last, 8);
+      if (!serial::NativeIsLE()) {
+        for (size_t i = old; i < offset.size(); ++i) {
+          offset[i] = serial::ByteSwap(offset[i]);
+        }
+        last = serial::ByteSwap(last);
+      }
+      for (size_t i = old; i + 1 < offset.size(); ++i) {
+        offset[i] = offset[i + 1] + nnz_base;
+      }
+      if (offset.size() > old) {
+        offset.back() = last + nnz_base;
+      }
+    }
+    const size_t pre_values = ValueCount();
+    serial::ReadVecAppend(s, &label);
+    serial::ReadVecAppend(s, &weight);
+    serial::ReadVecAppend(s, &qid);
+    serial::ReadVecAppend(s, &field);
+    serial::ReadVecAppend(s, &index);
+    uint64_t added = serial::ReadVecAppend(s, &value);
+    added += serial::ReadVecAppend(s, &value_i32);
+    added += serial::ReadVecAppend(s, &value_i64);
+    const int32_t dt = serial::ReadPOD<int32_t>(s);
+    // same dtype reconciliation as Append: adopt the incoming dtype only
+    // when this container had no values yet and the image carries some
+    DCT_CHECK(value_dtype == dt || pre_values == 0 || added == 0)
+        << "cannot append row blocks of different value dtypes";
+    if (dt != 0 && added != 0) value_dtype = dt;
+    max_index = std::max(max_index, serial::ReadPOD<uint64_t>(s));
+    max_field = std::max(max_field, serial::ReadPOD<uint32_t>(s));
+    return true;
+  }
+
   bool Load(Stream* s) {
     // probe end-of-stream via the first vector length
     uint64_t n;

@@ -76,16 +76,27 @@ inline void WriteVec(Stream* s, const std::vector<T>& v) {
   }
 }
 
+// Append-read: deserialize a vector onto the tail of *v (no intermediate
+// copy — the zero-copy discipline of the rec ingest lane, parser.cc
+// RecParser). Returns the number of elements appended.
+template <typename T>
+inline uint64_t ReadVecAppend(Stream* s, std::vector<T>* v) {
+  uint64_t n = ReadPOD<uint64_t>(s);
+  if (n == 0) return 0;
+  size_t old = v->size();
+  v->resize(old + n);
+  if (NativeIsLE() || sizeof(T) == 1) {
+    s->ReadExact(v->data() + old, n * sizeof(T));
+  } else {
+    for (uint64_t i = 0; i < n; ++i) (*v)[old + i] = ReadPOD<T>(s);
+  }
+  return n;
+}
+
 template <typename T>
 inline void ReadVec(Stream* s, std::vector<T>* v) {
-  uint64_t n = ReadPOD<uint64_t>(s);
-  v->resize(n);
-  if (n == 0) return;
-  if (NativeIsLE() || sizeof(T) == 1) {
-    s->ReadExact(v->data(), n * sizeof(T));
-  } else {
-    for (uint64_t i = 0; i < n; ++i) (*v)[i] = ReadPOD<T>(s);
-  }
+  v->clear();
+  ReadVecAppend(s, v);
 }
 
 inline void WriteStr(Stream* s, const std::string& str) {

@@ -1,0 +1,92 @@
+"""Dataset conversion: text formats -> binary RecordIO-framed row blocks.
+
+The "rec" binary lane is the TPU-native answer to the reference's pre-parsed
+.rec datasets (reference recordio.h:166 RecordIOChunkReader exists precisely
+to make binary ingest parallel): text is parsed ONCE here, then every later
+epoch ingests serialized row blocks whose deserialization is bulk memcpy —
+the lane that can feed the host->HBM transfer at rates text parsing cannot.
+
+Record layout (cpp/src/parser.cc RecParser):
+  [u32le 'DRB1' magic][u32le flags: bit0 = uint64 feature ids]
+  [RowBlockContainer wire format, rowblock.h Save: 9 length-prefixed
+   vectors + value_dtype i32 + max_index u64 + max_field u32]
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.native import NativeParser, NativeRecordIOWriter
+
+__all__ = ["rows_to_recordio"]
+
+_REC_MAGIC = 0x44524231  # 'DRB1'
+
+
+def _vec(arr, dtype) -> bytes:
+    """Length-prefixed little-endian vector (serializer.h WriteVec)."""
+    if arr is None:
+        return struct.pack("<Q", 0)
+    a = np.ascontiguousarray(arr, dtype=np.dtype(dtype).newbyteorder("<"))
+    return struct.pack("<Q", a.size) + a.tobytes()
+
+
+def _serialize_rows(block, r0: int, r1: int, index64: bool) -> bytes:
+    """Wire-format payload for rows [r0, r1) of a parsed RowBlock."""
+    o = block.offset
+    lo, hi = int(o[r0]), int(o[r1])
+    sub_offset = o[r0:r1 + 1] - lo
+    index = block.index[lo:hi]
+    value = block.value[lo:hi] if block.value is not None else None
+    # typed csv values route to the matching wire vector (rowblock.h)
+    val_f32 = val_i32 = val_i64 = None
+    value_dtype = 0
+    if value is not None:
+        if value.dtype == np.int32:
+            val_i32, value_dtype = value, 1
+        elif value.dtype == np.int64:
+            val_i64, value_dtype = value, 2
+        else:
+            val_f32 = value.astype(np.float32, copy=False)
+    max_index = int(index.max()) if index.size else 0
+    field = block.field[lo:hi] if block.field is not None else None
+    max_field = int(field.max()) if field is not None and field.size else 0
+    parts = [
+        struct.pack("<II", _REC_MAGIC, 1 if index64 else 0),
+        _vec(sub_offset, np.uint64),
+        _vec(block.label[r0:r1], np.float32),
+        _vec(block.weight[r0:r1] if block.weight is not None else None,
+             np.float32),
+        _vec(block.qid[r0:r1] if block.qid is not None else None, np.uint64),
+        _vec(field, np.uint32),
+        _vec(index, np.uint64 if index64 else np.uint32),
+        _vec(val_f32, np.float32),
+        _vec(val_i32, np.int32),
+        _vec(val_i64, np.int64),
+        struct.pack("<iQI", value_dtype, max_index, max_field),
+    ]
+    return b"".join(parts)
+
+
+def rows_to_recordio(src_uri: str, dst_uri: str, fmt: str = "auto",
+                     rows_per_record: int = 4096, index64: bool = False,
+                     part: int = 0, npart: int = 1, nthread: int = 0) -> int:
+    """Parse `src_uri` (libsvm/csv/libfm) and write binary row-block records
+    to `dst_uri`; returns the number of rows converted. The output ingests
+    via format "rec" (auto-detected for a .rec suffix)."""
+    if rows_per_record <= 0:
+        raise DMLCError("rows_per_record must be positive")
+    total = 0
+    with NativeParser(src_uri, part=part, npart=npart, fmt=fmt,
+                      nthread=nthread, index64=index64) as p, \
+            NativeRecordIOWriter(dst_uri) as w:
+        for block in p:
+            n = block.num_rows
+            for r0 in range(0, n, rows_per_record):
+                r1 = min(r0 + rows_per_record, n)
+                w.write_record(_serialize_rows(block, r0, r1, index64))
+            total += n
+    return total

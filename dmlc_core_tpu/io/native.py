@@ -28,6 +28,12 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+def _bf16_dtype():
+    """The bfloat16 numpy dtype (ml_dtypes ships with jax)."""
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+
+
 class RowBlockC(ctypes.Structure):
     """Mirror of dct_rowblock_t in cpp/src/capi.cc."""
     _fields_ = [
@@ -123,7 +129,8 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
                                   c.POINTER(c.c_uint64), c.POINTER(i),
                                   c.POINTER(i), c.POINTER(i)],
         "dct_batcher_fill_csr": [vp, vp, vp, vp, vp, vp, vp, vp, vp],
-        "dct_batcher_fill_dense": [vp, vp, c.c_uint64, vp, vp, vp, vp],
+        "dct_batcher_fill_dense": [vp, vp, c.c_int32, c.c_uint64, vp, vp, vp,
+                                   vp],
         "dct_batcher_before_first": [vp],
         "dct_batcher_bytes_read": [vp, c.POINTER(sz)],
         "dct_batcher_free": [vp],
@@ -557,9 +564,20 @@ class NativeBatcher:
     def fill_dense(self, x: np.ndarray, label: np.ndarray,
                    weight: np.ndarray, nrows: np.ndarray,
                    qid: Optional[np.ndarray] = None) -> None:
+        # the native side writes float32 or bfloat16 storage bits directly
+        # (batcher.h FillDense x_dtype) — bf16 emission halves host fill and
+        # host->HBM transfer bytes and skips the numpy astype copy
+        if x.dtype == np.float32:
+            x_dtype = 0
+        elif x.dtype == _bf16_dtype():
+            x_dtype = 1
+        else:
+            raise DMLCError(
+                f"dense fill dtype must be float32 or bfloat16, "
+                f"got {x.dtype}")
         F = x.shape[-1]
         _check(lib().dct_batcher_fill_dense(
-            self._h, self._ptr(x, np.float32, self._batch_rows * F), F,
+            self._h, self._ptr(x, x.dtype, self._batch_rows * F), x_dtype, F,
             self._ptr(label, np.float32, self._batch_rows),
             self._ptr(weight, np.float32, self._batch_rows),
             self._ptr(nrows, np.int32, self._num_shards),

@@ -41,8 +41,20 @@ import jax
 import jax.numpy as jnp
 
 from dmlc_core_tpu.base import DMLCError, log_info
-from dmlc_core_tpu.io.native import NativeBatcher, NativeParser
+from dmlc_core_tpu.io.native import NativeBatcher, NativeParser, _bf16_dtype
 from dmlc_core_tpu.tpu.sharding import batch_sharding, data_mesh
+
+
+def _dense_dtype_of(d) -> np.dtype:
+    """Normalize the dense x dtype: float32 or bfloat16 (the MXU dtypes the
+    native FillDense can emit; batcher.h x_dtype)."""
+    if isinstance(d, str) and d in ("bf16", "bfloat16"):
+        return _bf16_dtype()
+    dt = np.dtype(d)
+    if dt != np.dtype(np.float32) and dt != _bf16_dtype():
+        raise DMLCError(
+            f"dense_dtype must be float32 or bfloat16, got {dt}")
+    return dt
 
 __all__ = ["PaddedBatch", "DenseBatch", "DeviceRowBlockIter", "HostBatcher",
            "NativeHostBatcher"]
@@ -153,7 +165,7 @@ class HostBatcher:
         self.min_nnz_bucket = min_nnz_bucket
         self.layout = layout
         self.dense_max_features = dense_max_features
-        self.dense_dtype = dense_dtype
+        self.dense_dtype = _dense_dtype_of(dense_dtype)
         self._num_features: Optional[int] = None  # fixed once dense chosen
         # leftover rows from the previous native block (numpy copies)
         self._pending: list = []  # (label, weight, lens, col, val, qid, fld)
@@ -168,6 +180,18 @@ class HostBatcher:
 
     def _block_to_parts(self, b) -> tuple:
         lens = np.diff(b.offset).astype(np.int32)
+        # the device layout is int32; a feature id >= 2^31 would wrap
+        # negative in the astype below and scatter to a wrong column —
+        # refuse loudly instead (same contract as qid below; the native
+        # batcher enforces this in PaddedBatcher::Accumulate). Reference
+        # data.h:26-32 makes index width a first-class contract.
+        if b.nnz:
+            mx = int(getattr(b, "max_index", 0)) or int(b.index.max())
+            if mx > np.iinfo(np.int32).max:
+                raise DMLCError(
+                    f"feature index {mx} exceeds the int32 device layout "
+                    f"(max {np.iinfo(np.int32).max}); remap feature ids "
+                    f"below 2^31 for the TPU batch layout")
         col = b.index.astype(np.int32, copy=True)
         val = (b.value.astype(np.float32, copy=True) if b.value is not None
                else np.ones(b.nnz, dtype=np.float32))
@@ -366,12 +390,19 @@ class NativeHostBatcher:
         self.num_shards = num_shards
         self.layout = layout
         self.dense_max_features = dense_max_features
-        self.dense_dtype = dense_dtype
+        self.dense_dtype = _dense_dtype_of(dense_dtype)
         self._num_features: Optional[int] = None
         # plane presence pins on the first batch so the emitted pytree
         # structure (and therefore jitted consumers' traces) stays static
         self._emit_qid: Optional[bool] = None
         self._emit_field: Optional[bool] = None
+        # recycled host buffers, keyed by batch shape: avoids the per-batch
+        # allocate + page-fault churn on the staging thread. Buffers come
+        # back via recycle() once the host->HBM copy has completed
+        # (DeviceRowBlockIter's transfer thread) — never while the device
+        # could still read them.
+        self._pool: Dict[Any, list] = {}
+        self._pool_lock = threading.Lock()
 
     def next_batch(self):
         meta = self._b.next_meta()
@@ -401,28 +432,41 @@ class NativeHostBatcher:
             raise DMLCError(
                 "field ids have no dense layout; pass layout='csr' for "
                 "field-aware (libfm) data")
-        label = np.empty(self.batch_rows, np.float32)
-        weight = np.empty(self.batch_rows, np.float32)
-        nrows = np.empty(D, np.int32)
-        qid = np.empty(self.batch_rows, np.int32) if has_qid else None
         if self.layout == "dense":
             if self._num_features is None:
                 self._num_features = max(int(max_index) + 1, 1)
             F = self._num_features
-            x = np.empty((self.batch_rows, F), np.float32)
+            pooled = self._pool_pop(("dense", F))
+            if pooled is not None:
+                x, label, weight, nrows, qid = pooled
+            else:
+                # the native fill writes float32 or bf16 storage directly
+                # (batcher.h x_dtype) — no astype copy on this thread
+                x = np.empty((self.batch_rows, F), self.dense_dtype)
+                label = np.empty(self.batch_rows, np.float32)
+                weight = np.empty(self.batch_rows, np.float32)
+                nrows = np.empty(D, np.int32)
+                qid = (np.empty(self.batch_rows, np.int32)
+                       if has_qid else None)
             self._b.fill_dense(x, label, weight, nrows, qid=qid)
-            x = x.reshape(D, R, F)
-            if self.dense_dtype != np.float32:
-                x = x.astype(self.dense_dtype)
-            return DenseBatch(x=x, label=label.reshape(D, R),
+            return DenseBatch(x=x.reshape(D, R, F),
+                              label=label.reshape(D, R),
                               weight=weight.reshape(D, R), nrows=nrows,
                               total_rows=int(take),
                               qid=None if qid is None
                               else qid.reshape(D, R))
-        row = np.empty((D, bucket), np.int32)
-        col = np.empty((D, bucket), np.int32)
-        val = np.empty((D, bucket), np.float32)
-        field = np.empty((D, bucket), np.int32) if has_field else None
+        pooled = self._pool_pop(("csr", bucket))
+        if pooled is not None:
+            row, col, val, label, weight, nrows, qid, field = pooled
+        else:
+            label = np.empty(self.batch_rows, np.float32)
+            weight = np.empty(self.batch_rows, np.float32)
+            nrows = np.empty(D, np.int32)
+            qid = np.empty(self.batch_rows, np.int32) if has_qid else None
+            row = np.empty((D, bucket), np.int32)
+            col = np.empty((D, bucket), np.int32)
+            val = np.empty((D, bucket), np.float32)
+            field = np.empty((D, bucket), np.int32) if has_field else None
         self._b.fill_csr(row, col, val, label, weight, nrows, qid=qid,
                          field=field)
         return PaddedBatch(row=row, col=col, val=val,
@@ -431,6 +475,40 @@ class NativeHostBatcher:
                            total_rows=int(take),
                            qid=None if qid is None else qid.reshape(D, R),
                            field=field)
+
+    # -- host-buffer recycling ---------------------------------------------
+    _POOL_CAP = 4  # per shape key; bounds idle memory, covers the prefetch
+
+    def _pool_pop(self, key):
+        with self._pool_lock:
+            lst = self._pool.get(key)
+            return lst.pop() if lst else None
+
+    def recycle(self, batch) -> None:
+        """Return a consumed host batch's buffers for reuse.
+
+        Callers must guarantee the host->device copy has finished (e.g.
+        block_until_ready on the device arrays) and that the device arrays
+        do not alias host memory (true on TPU; NOT on the CPU backend,
+        where the caller must skip recycling)."""
+        if isinstance(batch, DenseBatch):
+            if batch.x.dtype != self.dense_dtype:
+                return  # foreign buffer set; drop it
+            key = ("dense", batch.x.shape[-1])
+            arrs = (batch.x.reshape(self.batch_rows, -1),
+                    batch.label.reshape(-1), batch.weight.reshape(-1),
+                    batch.nrows, None if batch.qid is None
+                    else batch.qid.reshape(-1))
+        else:
+            key = ("csr", batch.row.shape[-1])
+            arrs = (batch.row, batch.col, batch.val,
+                    batch.label.reshape(-1), batch.weight.reshape(-1),
+                    batch.nrows, None if batch.qid is None
+                    else batch.qid.reshape(-1), batch.field)
+        with self._pool_lock:
+            lst = self._pool.setdefault(key, [])
+            if len(lst) < self._POOL_CAP:
+                lst.append(arrs)
 
     def reset(self) -> None:
         self._b.before_first()
@@ -463,8 +541,9 @@ class DeviceRowBlockIter:
         self.to_device = to_device
         num_shards = 1 if mesh is None else int(mesh.devices.size)
         if index64:
-            # 64-bit feature ids don't fit the int32 device layout the native
-            # batcher emits; keep the numpy path (it truncates explicitly)
+            # 64-bit parse width; the int32 device layout is still the hard
+            # contract — the numpy batcher raises on any id >= 2^31
+            # (_block_to_parts guard) instead of wrapping silently
             self.parser = NativeParser(uri, part=part, npart=npart, fmt=fmt,
                                        nthread=nthread, index64=True)
             self.batcher = HostBatcher(self.parser, batch_rows, num_shards,
@@ -491,30 +570,74 @@ class DeviceRowBlockIter:
         self._stop = threading.Event()
 
     # -- staging threads -----------------------------------------------------
+    # Queue ops are stop-aware: a blocking put/get could otherwise race the
+    # close-time drain in _join_threads (the drain can steal the very item
+    # that would unblock a peer, leaving it waiting forever on an empty
+    # queue — the ThreadedIter shutdown hazard, pipeline.h Shutdown).
+    _SHUTDOWN = object()
+
+    def _put_stop(self, q: "queue.Queue", item) -> bool:
+        """Put unless the iterator is stopping; False when dropped."""
+        while True:
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                if self._stop.is_set():
+                    return False
+
+    def _get_stop(self, q: "queue.Queue"):
+        """Get, or _SHUTDOWN once the iterator is stopping and the queue
+        has drained."""
+        while True:
+            try:
+                return q.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return self._SHUTDOWN
+
     def _parse_loop(self) -> None:
         try:
             while not self._stop.is_set():
                 batch = self.batcher.next_batch()
-                self._host_q.put(batch)  # None terminates
+                if not self._put_stop(self._host_q, batch):  # None terminates
+                    return
                 if batch is None:
                     return
         except BaseException as e:  # propagate through the transfer stage
-            self._host_q.put(e)
+            self._put_stop(self._host_q, e)
 
     def _transfer_loop(self) -> None:
         try:
+            # host buffers may be recycled only when device arrays cannot
+            # alias host memory: on the CPU backend jax.device_put can be
+            # zero-copy, so recycling there would corrupt consumer data
+            recycle_ok = (self.to_device
+                          and hasattr(self.batcher, "recycle")
+                          and jax.default_backend() != "cpu")
+            pending = None  # (host, dev) whose DMA may still be in flight
             while not self._stop.is_set():
-                item = self._host_q.get()
-                if isinstance(item, BaseException):
-                    self._queue.put(item)
+                item = self._get_stop(self._host_q)
+                if item is self._SHUTDOWN:
                     return
-                if item is not None:
-                    item = self._device_put(item)
-                self._queue.put(item)
-                if item is None:
+                if isinstance(item, BaseException) or item is None:
+                    self._put_stop(self._queue, item)
                     return
+                host = item
+                item = self._device_put(host)
+                if not self._put_stop(self._queue, item):
+                    return
+                if recycle_ok and item is not host:
+                    # recycle lags one batch so successive device_puts stay
+                    # back-to-back: dispatch batch k, then wait on batch
+                    # k-1's DMA and hand its host buffers back
+                    if pending is not None:
+                        jax.block_until_ready(
+                            list(pending[1].tree().values()))
+                        self.batcher.recycle(pending[0])
+                    pending = (host, item)
         except BaseException as e:
-            self._queue.put(e)
+            self._put_stop(self._queue, e)
 
     def _ensure_started(self) -> None:
         if self._thread is None:
