@@ -2,13 +2,19 @@
 # on every push: .github/workflows/githubci.yml, scripts/test_script.sh).
 # `make ci` runs every lane; each lane is also callable alone.
 
-.PHONY: ci lint native-test tsan-test pytest bench-smoke dryrun clean
+.PHONY: ci lint native-test tsan-test pytest bench-smoke dryrun doc clean
 
-ci: lint native-test tsan-test pytest dryrun
+ci: lint native-test tsan-test pytest dryrun doc
 	@echo "== all CI lanes green =="
 
 lint:
 	python3 scripts/lint.py
+
+# regenerates doc/api.md + doc/parameters.md from the live package; any
+# undocumented public symbol fails the lane (the reference promotes doxygen
+# warnings to errors, Makefile:93-97)
+doc:
+	python3 scripts/gendoc.py
 
 # builds + runs the C++ unit binary (includes the big-endian golden-byte
 # serializer tests -- the QEMU-free equivalent of the reference s390x lane)

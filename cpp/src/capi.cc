@@ -105,6 +105,16 @@ int dct_webhdfs_set_delegation_token(const char* token) {
   });
 }
 
+// Inject/rotate the verbatim Authorization header for WebHDFS (the SPNEGO
+// hook: an external kinit-based helper supplies "Negotiate <token>");
+// empty string reverts to user.name / delegation auth.
+int dct_webhdfs_set_auth_header(const char* header) {
+  return Guard([&] {
+    dct::WebHdfsFileSystem::GetInstance()->set_auth_header(
+        header == nullptr ? "" : header);
+  });
+}
+
 // ---------------------------------------------------------------- streams --
 typedef void* dct_stream_t;
 
@@ -368,6 +378,32 @@ int dct_parser_bytes_read(dct_parser_t h, size_t* out) {
 
 int dct_parser_free(dct_parser_t h) {
   return Guard([&] { delete static_cast<ParserHandle*>(h); });
+}
+
+// Render the native parser-format registry as markdown (name, description,
+// argument tables from each format's reflection params) — the doc lane's
+// source of truth (scripts/gendoc.py; reference doc/parameter.md documents
+// the same surface by hand).
+int dct_parser_formats_doc(char** out) {
+  return Guard([&] {
+    auto* reg = dct::Registry<dct::ParserFactoryReg<uint32_t>>::Get();
+    std::string s;
+    for (const std::string& name : reg->ListAllNames()) {
+      const auto* e = reg->Find(name);
+      s += "## format `" + e->name + "`\n\n" + e->description + "\n\n";
+      if (!e->arguments.empty()) {
+        s += "| argument | type | description |\n|---|---|---|\n";
+        for (const auto& a : e->arguments) {
+          s += "| `" + a.name + "` | " + a.type_info_str + " | " +
+               a.description + " |\n";
+        }
+        s += "\n";
+      }
+    }
+    char* buf = new char[s.size() + 1];
+    std::memcpy(buf, s.c_str(), s.size() + 1);
+    *out = buf;
+  });
 }
 
 // ---------------------------------------------------------------- batcher --

@@ -57,11 +57,23 @@ std::string OpPath(const WebHdfsConfig& cfg, const std::string& path,
   if (!cfg.delegation_token.empty()) {
     // token auth: user.name must NOT accompany delegation (WebHDFS spec)
     out += "&delegation=" + s3::UriEncode(cfg.delegation_token, false);
+  } else if (!cfg.auth_header.empty()) {
+    // header auth (SPNEGO/Knox): identity comes from the credential;
+    // user.name must not override it
   } else if (!cfg.user.empty()) {
     out += "&user.name=" + s3::UriEncode(cfg.user, false);
   }
   if (!extra.empty()) out += "&" + extra;
   return out;
+}
+
+// Per-request headers: the verbatim Authorization credential when set
+// (SPNEGO "Negotiate ...", Knox "Basic ..." — the auth hook; datanode
+// redirects carry it too, matching curl --negotiate behavior).
+std::map<std::string, std::string> AuthHeaders(const WebHdfsConfig& cfg) {
+  std::map<std::string, std::string> h;
+  if (!cfg.auth_header.empty()) h["authorization"] = cfg.auth_header;
+  return h;
 }
 
 // One FileStatus JSON object -> FileInfo (caller fixes .path for listings).
@@ -120,7 +132,7 @@ class WebHdfsReadStream : public RetryingHttpReadStream {
     // the body directly with 200)
     for (int hop = 0; hop < 5; ++hop) {
       conn_.reset(new HttpConnection(host, port));
-      conn_->SendRequest("GET", path, {}, "");
+      conn_->SendRequest("GET", path, AuthHeaders(cfg_), "");
       HttpResponse head;
       conn_->ReadResponseHead(&head);
       if (head.status == 200 || head.status == 206) return;
@@ -206,20 +218,21 @@ class WebHdfsWriteStream : public Stream {
     // step 1: namenode; expect redirect to a datanode (send no body, per
     // the WebHDFS two-step protocol)
     HttpResponse head = HttpRequest(target_.host, target_.port, method, path,
-                                    {}, "");
+                                    AuthHeaders(cfg_), "");
     if (head.status == 307 || head.status == 302) {
       auto it = head.headers.find("location");
       DCT_CHECK(it != head.headers.end())
           << "webhdfs redirect without Location header";
       webhdfs::HttpUrl next = webhdfs::ParseHttpUrl(it->second);
-      head = HttpRequest(next.host, next.port, method, next.path_query, {},
-                         part);
+      head = HttpRequest(next.host, next.port, method, next.path_query,
+                         AuthHeaders(cfg_), part);
     } else if (head.status >= 200 && head.status < 300 && !part.empty()) {
       // One-step gateway (HttpFS style): the empty step-1 request was
       // accepted directly, so the payload was never transmitted. Re-send
       // with the body: CREATE&overwrite=true is idempotent and the empty
       // APPEND appended nothing, so exactly one copy of `part` lands.
-      head = HttpRequest(target_.host, target_.port, method, path, {}, part);
+      head = HttpRequest(target_.host, target_.port, method, path,
+                         AuthHeaders(cfg_), part);
     }
     CheckStatus(head, created_ ? 200 : 201,
                 created_ ? "APPEND" : "CREATE", uri_);
@@ -253,6 +266,8 @@ WebHdfsConfig WebHdfsConfig::FromEnv() {
   if (user != nullptr) cfg.user = user;
   const char* tok = std::getenv("WEBHDFS_DELEGATION_TOKEN");
   if (tok != nullptr && *tok != '\0') cfg.delegation_token = tok;
+  const char* ah = std::getenv("WEBHDFS_AUTH_HEADER");
+  if (ah != nullptr && *ah != '\0') cfg.auth_header = ah;
   const char* mr = std::getenv("WEBHDFS_MAX_RETRY");
   if (mr != nullptr && *mr != '\0') cfg.max_retry = std::atoi(mr);
   const char* rs = std::getenv("WEBHDFS_RETRY_SLEEP_MS");
@@ -269,7 +284,8 @@ FileInfo WebHdfsFileSystem::GetPathInfo(const URI& path) {
   const WebHdfsConfig cfg = config_copy();
   webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
   std::string p = webhdfs::OpPath(cfg, path.path, "GETFILESTATUS", "");
-  HttpResponse resp = HttpRequest(t.host, t.port, "GET", p, {}, "");
+  HttpResponse resp = HttpRequest(t.host, t.port, "GET", p,
+                                  webhdfs::AuthHeaders(cfg), "");
   webhdfs::CheckStatus(resp, 200, "GETFILESTATUS", path);
   FileInfo info;
   info.path = path;
@@ -292,7 +308,8 @@ void WebHdfsFileSystem::ListDirectory(const URI& path,
   const WebHdfsConfig cfg = config_copy();
   webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
   std::string p = webhdfs::OpPath(cfg, path.path, "LISTSTATUS", "");
-  HttpResponse resp = HttpRequest(t.host, t.port, "GET", p, {}, "");
+  HttpResponse resp = HttpRequest(t.host, t.port, "GET", p,
+                                  webhdfs::AuthHeaders(cfg), "");
   webhdfs::CheckStatus(resp, 200, "LISTSTATUS", path);
   std::string dir = path.path.empty() ? "/" : path.path;
   if (dir.back() != '/') dir += '/';

@@ -29,6 +29,14 @@ struct WebHdfsConfig {
   // contract for token auth — the secure-cluster path the reference
   // inherits from libhdfs/Hadoop auth, src/io/hdfs_filesys.cc).
   std::string delegation_token;
+  // Verbatim Authorization header (e.g. "Negotiate <b64-gss-token>" from an
+  // external kinit-based helper, or a Knox "Basic ..."): when non-empty it
+  // rides on every WebHDFS request and user.name is omitted (the server
+  // derives identity from the credential). This is the SPNEGO hook — the
+  // GSSAPI negotiation loop itself stays outside the library by design
+  // (scope decision in PARITY.md; the reference gets Kerberos via the JVM's
+  // org.apache.hadoop.security stack, CMakeLists.txt:71-83).
+  std::string auth_header;
   int max_retry = 50;         // read reconnect attempts (reference S3 parity)
   int retry_sleep_ms = 100;
 
@@ -60,6 +68,14 @@ class WebHdfsFileSystem : public FileSystem {
   void set_delegation_token(const std::string& token) {
     std::lock_guard<std::mutex> lock(config_mutex_);
     config_.delegation_token = token;
+  }
+
+  // Runtime rotation of the verbatim Authorization header (SPNEGO tickets
+  // expire; an external helper renews and re-injects). Empty reverts to
+  // user.name / delegation auth.
+  void set_auth_header(const std::string& header) {
+    std::lock_guard<std::mutex> lock(config_mutex_);
+    config_.auth_header = header;
   }
 
   WebHdfsConfig config_copy() const {

@@ -4,8 +4,10 @@
 // (test/unittest/*.cc) with a dependency-free assert harness; run by
 // tests/test_native_core.py via subprocess.
 #include <cassert>
+#include <cmath>
 #include <cstdio>
 #include <cstring>
+#include <memory>
 #include <fstream>
 #include <sstream>
 #include <string>
@@ -28,6 +30,7 @@
 #include "../src/json.h"
 #include "../src/parameter.h"
 #include "../src/parser.h"
+#include "../src/recordio.h"
 #include "../src/http.h"
 #include "../src/registry.h"
 #include "../src/s3_filesys.h"
@@ -746,6 +749,105 @@ void TestEndianGoldenBytes() {
   EXPECT(dct::serial::ReadPOD<uint64_t>(&ms) == magic);
 }
 
+// Threaded text-parse fan-out under the race detector: the ParseBlock
+// worker tiling + ThreadedParser/PipelineIter hand-off are the riskiest
+// threaded code in the library (VERDICT r2 item 5b); this drive puts them
+// under `make tsan-test`. Determinism contract: any worker count must
+// produce the identical multiset of rows (verified via order-insensitive
+// aggregates; reference proves the same with nthread sweeps,
+// test/unittest/unittest_parser.cc).
+struct ParseSummary {
+  size_t rows = 0;
+  size_t nnz = 0;
+  double label_sum = 0;
+  double value_sum = 0;
+  double weighted_index = 0;  // order-insensitive content fingerprint
+};
+
+ParseSummary SummarizeParse(const std::string& uri, const char* fmt,
+                            int nthread, bool threaded, int epochs) {
+  std::unique_ptr<dct::Parser<uint32_t>> p(
+      dct::Parser<uint32_t>::Create(uri, 0, 1, fmt, nthread, threaded));
+  ParseSummary s;
+  for (int e = 0; e < epochs; ++e) {
+    const dct::RowBlockContainer<uint32_t>* b;
+    while ((b = p->NextBlock()) != nullptr) {
+      s.rows += b->Size();
+      s.nnz += b->index.size();
+      for (float l : b->label) s.label_sum += l;
+      for (float v : b->value) s.value_sum += v;
+      for (size_t k = 0; k < b->index.size(); ++k) {
+        s.weighted_index += static_cast<double>(b->index[k]) *
+                            static_cast<double>(b->value[k]);
+      }
+    }
+    p->BeforeFirst();
+  }
+  return s;
+}
+
+void ExpectSummariesMatch(const ParseSummary& a, const ParseSummary& b) {
+  EXPECT(a.rows == b.rows);
+  EXPECT(a.nnz == b.nnz);
+  EXPECT(std::abs(a.label_sum - b.label_sum) < 1e-3);
+  EXPECT(std::abs(a.value_sum - b.value_sum) < 1e-3);
+  EXPECT(std::abs(a.weighted_index - b.weighted_index) < 1e-2);
+}
+
+void TestThreadedTextParse() {
+  dct::TemporaryDirectory tmp;
+  std::string path = tmp.path() + "/big.libsvm";
+  {
+    std::ofstream f(path);
+    for (int i = 0; i < 60000; ++i) {
+      f << (i % 2);
+      for (int j = 0; j < 8; ++j) {
+        f << ' ' << j << ':' << (((i * 31 + j) % 97) * 0.01);
+      }
+      f << '\n';
+    }
+  }
+  ParseSummary serial = SummarizeParse(path, "libsvm", 1, false, 2);
+  EXPECT(serial.rows == 2u * 60000);
+  EXPECT(serial.nnz == 2u * 60000 * 8);
+  ParseSummary fanout = SummarizeParse(path, "libsvm", 4, true, 2);
+  ExpectSummariesMatch(serial, fanout);
+}
+
+void TestThreadedRecParse() {
+  dct::TemporaryDirectory tmp;
+  std::string path = tmp.path() + "/blocks.rec";
+  size_t want_rows = 0, want_nnz = 0;
+  {
+    std::unique_ptr<dct::Stream> out(dct::Stream::Create(path, "w"));
+    dct::RecordIOWriter w(out.get());
+    for (int r = 0; r < 400; ++r) {
+      dct::RowBlockContainer<uint32_t> c;
+      for (int i = 0; i < 50; ++i) {
+        c.label.push_back(static_cast<float>((r + i) % 3));
+        for (uint32_t j = 0; j < 5; ++j) {
+          c.index.push_back(j);
+          c.value.push_back(0.5f * static_cast<float>(j + r % 7));
+        }
+        c.offset.push_back(c.index.size());
+      }
+      c.UpdateMax();
+      want_rows += c.Size();
+      want_nnz += c.index.size();
+      dct::MemoryStream ms;
+      dct::serial::WritePOD<uint32_t>(&ms, 0x44524231u);  // 'DRB1'
+      dct::serial::WritePOD<uint32_t>(&ms, 0u);           // uint32 ids
+      c.Save(&ms);
+      w.WriteRecord(ms.data());
+    }
+  }
+  ParseSummary serial = SummarizeParse(path, "rec", 1, false, 2);
+  EXPECT(serial.rows == 2 * want_rows);
+  EXPECT(serial.nnz == 2 * want_nnz);
+  ParseSummary fanout = SummarizeParse(path, "rec", 4, true, 2);
+  ExpectSummariesMatch(serial, fanout);
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -770,6 +872,8 @@ int main(int argc, char** argv) {
   TestXmlUnescape();
   TestSplitHostPort();
   TestEndianGoldenBytes();
+  TestThreadedTextParse();
+  TestThreadedRecParse();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;

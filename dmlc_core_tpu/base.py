@@ -32,14 +32,17 @@ if not _LOGGER.handlers:  # date-stamped stderr, reference logging.h:280-338
 
 
 def logger() -> logging.Logger:
+    """Return the package-wide logger instance."""
     return _LOGGER
 
 
 def log_info(msg: str, *args: Any) -> None:
+    """Log at INFO through the package logger (reference LOG(INFO))."""
     _LOGGER.info(msg, *args)
 
 
 def log_warning(msg: str, *args: Any) -> None:
+    """Log at WARNING through the package logger (reference LOG(WARNING))."""
     _LOGGER.warning(msg, *args)
 
 
@@ -50,31 +53,37 @@ def check(cond: Any, msg: str = "") -> None:
 
 
 def check_eq(a: Any, b: Any, msg: str = "") -> None:
+    """Raise DMLCError unless a == b (reference CHECK_EQ, base.h)."""
     if a != b:
         raise DMLCError(f"Check failed: {a!r} == {b!r} {msg}")
 
 
 def check_ne(a: Any, b: Any, msg: str = "") -> None:
+    """Raise DMLCError unless a != b (reference CHECK_NE)."""
     if a == b:
         raise DMLCError(f"Check failed: {a!r} != {b!r} {msg}")
 
 
 def check_lt(a: Any, b: Any, msg: str = "") -> None:
+    """Raise DMLCError unless a < b (reference CHECK_LT)."""
     if not a < b:
         raise DMLCError(f"Check failed: {a!r} < {b!r} {msg}")
 
 
 def check_le(a: Any, b: Any, msg: str = "") -> None:
+    """Raise DMLCError unless a <= b (reference CHECK_LE)."""
     if not a <= b:
         raise DMLCError(f"Check failed: {a!r} <= {b!r} {msg}")
 
 
 def check_gt(a: Any, b: Any, msg: str = "") -> None:
+    """Raise DMLCError unless a > b (reference CHECK_GT)."""
     if not a > b:
         raise DMLCError(f"Check failed: {a!r} > {b!r} {msg}")
 
 
 def check_ge(a: Any, b: Any, msg: str = "") -> None:
+    """Raise DMLCError unless a >= b (reference CHECK_GE)."""
     if not a >= b:
         raise DMLCError(f"Check failed: {a!r} >= {b!r} {msg}")
 

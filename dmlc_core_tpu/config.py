@@ -19,6 +19,7 @@ __all__ = ["Config", "ConfigError"]
 
 
 class ConfigError(DMLCError):
+    """Malformed config input (reference Config parse errors)."""
     pass
 
 
@@ -75,6 +76,7 @@ class Config:
             self.load(source)
 
     def clear(self) -> None:
+        """Drop every stored entry (multi-value keys included)."""
         self._order.clear()
         self._values.clear()
         self._index.clear()

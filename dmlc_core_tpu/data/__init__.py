@@ -249,6 +249,8 @@ class RowBlockContainer:
 
     # -- binary io (cross-language wire format) -------------------------------
     def save(self, stream: BinaryIO) -> None:
+        """Serialize to the cross-language wire format (reference row_block.h
+        Save)."""
         w = BinaryWriter(stream)
         w.write_array(self.offset)
         w.write_array(self.label)
@@ -312,6 +314,8 @@ class Parser:
     @staticmethod
     def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
                nthread: int = 0, index64: bool = False, **kwargs):
+        """Instantiate a parser for `uri` by format name via the registry
+        (reference Parser<I>::Create, data.h:307)."""
         base = uri.split("#", 1)[0]
         args: Dict[str, str] = {}
         if "?" in base:
@@ -356,6 +360,7 @@ class RowBlockIter:
     @staticmethod
     def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
                nthread: int = 0, index64: bool = False) -> "RowBlockIter":
+        """Factory matching reference RowBlockIter<I>::Create (data.h:267)."""
         parser = Parser.create(uri, part, npart, fmt, nthread=nthread,
                                index64=index64)
         return RowBlockIter(parser, eager="#" not in uri)
@@ -395,6 +400,8 @@ class RowBlockIter:
             yield RowBlockContainer.from_blocks([b])
 
     def before_first(self) -> None:
+        """Restart iteration from the first row block (reference
+        DataIter::BeforeFirst)."""
         if not self._eager:
             self._parser.before_first()
 
@@ -407,9 +414,12 @@ class RowBlockIter:
         raise DMLCError("num_col requires eager (non-cached) mode")
 
     def bytes_read(self) -> int:
+        """Bytes consumed from the underlying source so far (reference
+        Parser::BytesRead)."""
         return self._parser.bytes_read()
 
     def close(self) -> None:
+        """Release the native parser handle (idempotent)."""
         close = getattr(self._parser, "close", None)
         if close is not None:
             close()

@@ -121,6 +121,8 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_parser_bytes_read": [vp, c.POINTER(sz)],
         "dct_parser_free": [vp],
         "dct_webhdfs_set_delegation_token": [c.c_char_p],
+        "dct_webhdfs_set_auth_header": [c.c_char_p],
+        "dct_parser_formats_doc": [c.POINTER(c.c_char_p)],
         "dct_batcher_create": [c.c_char_p, u, u, c.c_char_p, i, i,
                                c.c_uint64, c.c_uint32, c.c_uint64,
                                c.POINTER(vp)],
@@ -156,12 +158,14 @@ class NativeStream:
                                        ctypes.byref(self._h)))
 
     def read(self, size: int = 1 << 20) -> bytes:
+        """Read up to `size` bytes (empty bytes at end of stream)."""
         buf = ctypes.create_string_buffer(size)
         nread = ctypes.c_size_t()
         _check(lib().dct_stream_read(self._h, buf, size, ctypes.byref(nread)))
         return buf.raw[: nread.value]
 
     def read_all(self) -> bytes:
+        """Read the remainder of the stream into one bytes object."""
         chunks = []
         while True:
             c = self.read()
@@ -171,9 +175,12 @@ class NativeStream:
         return b"".join(chunks)
 
     def write(self, data: bytes) -> None:
+        """Write all of `data` to the stream."""
         _check(lib().dct_stream_write(self._h, data, len(data)))
 
     def close(self) -> None:
+        """Finish and free the native stream (idempotent; raises if the final
+        flush fails)."""
         if self._h:
             # the handle is freed even when Finish fails; drop it before
             # raising so a later close/__del__ cannot double-free
@@ -220,12 +227,36 @@ def path_info(uri: str) -> Tuple[int, bool]:
     return size.value, bool(is_dir.value)
 
 
+def parser_formats_doc() -> str:
+    """Markdown documentation of every registered native data format and
+    its reflection parameters (the doc lane's source of truth; reference
+    doc/parameter.md covers the same surface)."""
+    out = ctypes.c_char_p()
+    _check(lib().dct_parser_formats_doc(ctypes.byref(out)))
+    try:
+        return ctypes.string_at(out).decode()
+    finally:
+        lib().dct_str_free(out)
+
+
 def set_webhdfs_delegation_token(token: str) -> None:
     """Rotate the hdfs:// delegation token at runtime: subsequent WebHDFS
     ops carry `delegation=<token>` (and omit user.name) — the secure-HDFS
     auth path; empty string reverts to user.name auth. Initial value comes
     from WEBHDFS_DELEGATION_TOKEN (cpp/src/hdfs_filesys.cc FromEnv)."""
     _check(lib().dct_webhdfs_set_delegation_token(token.encode()))
+
+
+def set_webhdfs_auth_header(header: str) -> None:
+    """Inject/rotate a verbatim Authorization header for hdfs:// ops — the
+    SPNEGO/Kerberos hook: an external kinit-based helper (or a Knox
+    gateway credential) supplies e.g. "Negotiate <b64-gss-token>", which
+    rides on every WebHDFS request (user.name is then omitted; the server
+    derives identity from the credential). Empty string reverts to
+    user.name / delegation auth. Initial value comes from
+    WEBHDFS_AUTH_HEADER. The GSSAPI negotiation loop itself is out of
+    scope by design (PARITY.md)."""
+    _check(lib().dct_webhdfs_set_auth_header(header.encode()))
 
 
 # -- input split ------------------------------------------------------------
@@ -256,6 +287,8 @@ class NativeInputSplit:
                                           ctypes.byref(self._h)))
 
     def next_record(self) -> Optional[bytes]:
+        """Next whole record, or None at end (reference
+        InputSplit::NextRecord)."""
         data = ctypes.c_void_p()
         size = ctypes.c_size_t()
         has = ctypes.c_int()
@@ -269,6 +302,8 @@ class NativeInputSplit:
         return ctypes.string_at(data, size.value)
 
     def next_chunk(self) -> Optional[bytes]:
+        """Next record-aligned chunk of raw bytes, or None at end (reference
+        InputSplit::NextChunk)."""
         data = ctypes.c_void_p()
         size = ctypes.c_size_t()
         has = ctypes.c_int()
@@ -287,20 +322,27 @@ class NativeInputSplit:
             yield rec
 
     def before_first(self) -> None:
+        """Restart this partition from its first record."""
         _check(lib().dct_split_before_first(self._h))
 
     def reset_partition(self, part: int, nsplit: int) -> None:
+        """Re-point this split at a different (part, nsplit) without reopening
+        (reference ResetPartition)."""
         _check(lib().dct_split_reset_partition(self._h, part, nsplit))
 
     def total_size(self) -> int:
+        """Total byte size of the underlying source across all partitions."""
         out = ctypes.c_size_t()
         _check(lib().dct_split_total_size(self._h, ctypes.byref(out)))
         return out.value
 
     def hint_chunk_size(self, nbytes: int) -> None:
+        """Suggest the chunk granularity for next_chunk (reference
+        InputSplit::HintChunkSize)."""
         _check(lib().dct_split_hint_chunk_size(self._h, nbytes))
 
     def close(self) -> None:
+        """Free the native split handle (idempotent)."""
         if self._h:
             _check(lib().dct_split_free(self._h))
             self._h = ctypes.c_void_p()
@@ -328,9 +370,12 @@ class NativeRecordIOWriter:
                                                 ctypes.byref(self._h)))
 
     def write_record(self, data: bytes) -> None:
+        """Append one record (< 2^29 bytes; embedded aligned magics are
+        escaped)."""
         _check(lib().dct_recordio_write(self._h, data, len(data)))
 
     def close(self) -> None:
+        """Flush and free the native writer handle (idempotent)."""
         if self._h:
             _check(lib().dct_recordio_writer_free(self._h))
             self._h = ctypes.c_void_p()
@@ -351,6 +396,7 @@ class NativeRecordIOReader:
                                                 ctypes.byref(self._h)))
 
     def next_record(self) -> Optional[bytes]:
+        """Next record payload, or None at end of stream."""
         data = ctypes.c_void_p()
         size = ctypes.c_size_t()
         has = ctypes.c_int()
@@ -370,6 +416,7 @@ class NativeRecordIOReader:
             yield rec
 
     def close(self) -> None:
+        """Free the native reader handle (idempotent)."""
         if self._h:
             _check(lib().dct_recordio_reader_free(self._h))
             self._h = ctypes.c_void_p()
@@ -451,6 +498,8 @@ class NativeParser:
                                        ctypes.byref(self._h)))
 
     def next_block(self) -> Optional[RowBlock]:
+        """Next parsed RowBlock view, or None at end of data; the view stays
+        valid until the following call."""
         c = RowBlockC()
         has = ctypes.c_int()
         _check(lib().dct_parser_next_block(self._h, ctypes.byref(c),
@@ -467,14 +516,18 @@ class NativeParser:
             yield b
 
     def before_first(self) -> None:
+        """Restart parsing from the first row (new epoch)."""
         _check(lib().dct_parser_before_first(self._h))
 
     def bytes_read(self) -> int:
+        """Bytes consumed from the underlying source so far (reference
+        Parser::BytesRead)."""
         out = ctypes.c_size_t()
         _check(lib().dct_parser_bytes_read(self._h, ctypes.byref(out)))
         return out.value
 
     def close(self) -> None:
+        """Free the native parser handle (idempotent)."""
         if self._h:
             _check(lib().dct_parser_free(self._h))
             self._h = ctypes.c_void_p()
@@ -550,6 +603,8 @@ class NativeBatcher:
                  label: np.ndarray, weight: np.ndarray, nrows: np.ndarray,
                  qid: Optional[np.ndarray] = None,
                  field: Optional[np.ndarray] = None) -> None:
+        """Write the staged batch into caller CSR buffers ([D, bucket] planes;
+        see batcher.h FillCSR) with the GIL released."""
         nz = self._num_shards * self._bucket
         _check(lib().dct_batcher_fill_csr(
             self._h, self._ptr(row, np.int32, nz),
@@ -567,6 +622,8 @@ class NativeBatcher:
         # the native side writes float32 or bfloat16 storage bits directly
         # (batcher.h FillDense x_dtype) — bf16 emission halves host fill and
         # host->HBM transfer bytes and skips the numpy astype copy
+        """Write the staged batch into a dense [rows, F] buffer (float32 or
+        bfloat16 storage; batcher.h FillDense) with the GIL released."""
         if x.dtype == np.float32:
             x_dtype = 0
         elif x.dtype == _bf16_dtype():
@@ -585,14 +642,17 @@ class NativeBatcher:
             else self._ptr(qid, np.int32, self._batch_rows)))
 
     def before_first(self) -> None:
+        """Restart batching from the first row (new epoch)."""
         _check(lib().dct_batcher_before_first(self._h))
 
     def bytes_read(self) -> int:
+        """Bytes consumed from the underlying source so far."""
         out = ctypes.c_size_t()
         _check(lib().dct_batcher_bytes_read(self._h, ctypes.byref(out)))
         return out.value
 
     def close(self) -> None:
+        """Free the native batcher handle (idempotent)."""
         if self._h:
             _check(lib().dct_batcher_free(self._h))
             self._h = ctypes.c_void_p()

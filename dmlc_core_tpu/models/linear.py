@@ -78,6 +78,7 @@ class LinearLearner:
         self._step_fn = None
 
     def init(self, seed: int = 0) -> LinearParams:
+        """Fresh parameter pytree (replicated across the mesh)."""
         del seed  # linear model: zero init is canonical
         params = LinearParams(
             w=jnp.zeros((self.num_features,), jnp.float32),
@@ -136,6 +137,7 @@ class LinearLearner:
 
     def step(self, params: LinearParams, batch: PaddedBatch
              ) -> Tuple[LinearParams, jnp.ndarray]:
+        """One jitted training step on a device batch; returns (params, loss)."""
         if self._step_fn is None:
             self._step_fn = {}
         tree = batch.tree()

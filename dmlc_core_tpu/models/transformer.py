@@ -80,6 +80,7 @@ class TransformerLM:
 
     # ------------------------------------------------------------- params --
     def init(self, seed: int = 0) -> Params:
+        """Fresh parameter pytree, sharded per the layer partition specs."""
         cfg = self.config
         rng = np.random.default_rng(seed)
         D = cfg.embed

@@ -33,7 +33,14 @@ def init_from_env() -> None:
     build_tpu_pod_env), falling back to DMLC_TRACKER_URI +
     DMLC_NUM_WORKER + DMLC_TASK_ID for legacy launch environments."""
     if os.getenv("JAX_COORDINATOR_ADDRESS"):
-        jax.distributed.initialize()  # env-driven
+        # pass the trio explicitly: bare initialize() only auto-detects
+        # managed clusters (Slurm/GKE/TPU metadata), not this env protocol
+        nproc = os.getenv("JAX_NUM_PROCESSES")
+        pid = os.getenv("JAX_PROCESS_ID")
+        jax.distributed.initialize(
+            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=None if nproc is None else int(nproc),
+            process_id=None if pid is None else int(pid))
         return
     # Legacy launchers must export the coordinator address explicitly —
     # DMLC_TRACKER_URI is the *submit* machine, where no worker hosts the
@@ -51,10 +58,12 @@ def init_from_env() -> None:
 
 
 def rank() -> int:
+    """This process's index (Rabit GetRank equivalent)."""
     return jax.process_index()
 
 
 def world_size() -> int:
+    """Number of processes in the job (Rabit GetWorldSize equivalent)."""
     return jax.process_count()
 
 

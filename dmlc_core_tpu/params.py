@@ -73,6 +73,8 @@ class Field:
         self.aliases = list(aliases)
 
     def coerce(self, value: Any) -> Any:
+        """Convert `value` to the field's type (strings parse per type, bools
+        accept 1/0/true/false)."""
         if isinstance(value, str) and self.type is not str:
             try:
                 if self.type is bool:
@@ -93,6 +95,7 @@ class Field:
         return value
 
     def validate(self, value: Any) -> None:
+        """Raise ParamError when `value` violates the range/enum constraints."""
         if self.range is not None:
             lo, hi = self.range
             if not (lo <= value < hi):
@@ -112,6 +115,8 @@ class Field:
                 f"{self.enum!r}")
 
     def doc(self) -> str:
+        """One-line rendered documentation (name, type, default, range,
+        choices)."""
         parts = [f"{self.name} : {self.type.__name__}"]
         if self.has_default:
             parts.append(f"(default={self.default!r})")
@@ -217,15 +222,18 @@ class Parameter(metaclass=ParameterMeta):
         return "\n".join(f.doc() for f in cls.__param_fields__.values())
 
     def as_dict(self) -> Dict[str, Any]:
+        """Current field values as a plain dict."""
         return {f.name: getattr(self, f.name)
                 for f in self.__param_fields__.values()
                 if hasattr(self, f.name)}
 
     # -- serialization (parameter.h:211-223) ----------------------------------
     def save_json(self) -> str:
+        """Serialize current field values to a JSON string."""
         return json.dumps(self.as_dict(), sort_keys=True)
 
     def load_json(self, s: str) -> None:
+        """Restore field values from a save_json() string."""
         self.init(json.loads(s), allow_unknown=False)
 
     def __setattr__(self, name: str, value: Any) -> None:

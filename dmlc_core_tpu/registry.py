@@ -30,15 +30,19 @@ class RegistryEntry(Generic[T]):
         self.return_type = ""
 
     def describe(self, description: str) -> "RegistryEntry[T]":
+        """Set the entry's human-readable description; returns self for
+        chaining."""
         self.description = description
         return self
 
     def add_argument(self, name: str, type_str: str, desc: str
                      ) -> "RegistryEntry[T]":
+        """Document one accepted argument (name, type, description)."""
         self.arguments.append((name, type_str, desc))
         return self
 
     def set_return_type(self, t: str) -> "RegistryEntry[T]":
+        """Record the factory's return type name; returns self for chaining."""
         self.return_type = t
         return self
 
@@ -67,6 +71,8 @@ class Registry(Generic[T]):
 
     @classmethod
     def get(cls, kind: str) -> "Registry":
+        """The process-wide registry for `kind`, created on first use
+        (reference Registry<T>::Get singleton)."""
         reg = cls._registries.get(kind)
         if reg is None:
             reg = cls._registries[kind] = Registry(kind)
@@ -92,6 +98,8 @@ class Registry(Generic[T]):
         return self._entries.get(name)
 
     def lookup(self, name: str) -> RegistryEntry[T]:
+        """Entry by name; raises DMLCError listing known entries when
+        absent (use find() for the None-returning probe)."""
         entry = self.find(name)
         if entry is None:
             raise DMLCError(
@@ -100,7 +108,9 @@ class Registry(Generic[T]):
         return entry
 
     def list_names(self) -> List[str]:
+        """Registered entry names, sorted (reference ListAllNames)."""
         return sorted(self._entries)
 
     def remove(self, name: str) -> None:
+        """Unregister an entry by name (no-op when absent)."""
         self._entries.pop(name, None)

@@ -42,13 +42,16 @@ class BinaryWriter:
         self.stream = stream
 
     def write_scalar(self, value: Any, dtype: str) -> None:
+        """Write one POD scalar of the given dtype (LE on disk)."""
         self.stream.write(struct.pack("<" + _POD[dtype], value))
 
     def write_bytes(self, data: bytes) -> None:
+        """Write a length-prefixed byte string."""
         self.write_scalar(len(data), "uint64")
         self.stream.write(data)
 
     def write_string(self, s: str) -> None:
+        """Write a length-prefixed UTF-8 string."""
         self.write_bytes(s.encode("utf-8"))
 
     def write_array(self, arr: np.ndarray) -> None:
@@ -61,11 +64,13 @@ class BinaryWriter:
         self.stream.write(le.tobytes())
 
     def write_str_list(self, items: List[str]) -> None:
+        """Write a length-prefixed list of strings."""
         self.write_scalar(len(items), "uint64")
         for s in items:
             self.write_string(s)
 
     def write_str_map(self, d: Dict[str, str]) -> None:
+        """Write a length-prefixed str->str mapping."""
         self.write_scalar(len(d), "uint64")
         for k, v in d.items():
             self.write_string(k)
@@ -86,17 +91,21 @@ class BinaryReader:
         return data
 
     def read_scalar(self, dtype: str) -> Any:
+        """Read one POD scalar of the given dtype (LE on disk)."""
         fmt = "<" + _POD[dtype]
         return struct.unpack(fmt, self._read_exact(struct.calcsize(fmt)))[0]
 
     def read_bytes(self) -> bytes:
+        """Read a length-prefixed byte string."""
         n = self.read_scalar("uint64")
         return self._read_exact(n)
 
     def read_string(self) -> str:
+        """Read a length-prefixed UTF-8 string."""
         return self.read_bytes().decode("utf-8")
 
     def read_array(self, dtype: str) -> np.ndarray:
+        """Read a length-prefixed numpy array of the given dtype."""
         n = self.read_scalar("uint64")
         np_dt = np.dtype(dtype).newbyteorder("<")
         raw = self._read_exact(n * np_dt.itemsize)
@@ -105,9 +114,11 @@ class BinaryReader:
         return np.frombuffer(raw, dtype=np_dt).astype(np.dtype(dtype))
 
     def read_str_list(self) -> List[str]:
+        """Read a length-prefixed list of strings."""
         return [self.read_string() for _ in range(self.read_scalar("uint64"))]
 
     def read_str_map(self) -> Dict[str, str]:
+        """Read a length-prefixed str->str mapping."""
         n = self.read_scalar("uint64")
         out: Dict[str, str] = {}
         for _ in range(n):

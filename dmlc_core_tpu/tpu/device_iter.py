@@ -96,6 +96,7 @@ class PaddedBatch:
         return self.row.shape[1]
 
     def tree(self) -> Dict[str, Any]:
+        """The batch as a flat dict pytree (the device_put / jit input)."""
         t = {"row": self.row, "col": self.col, "val": self.val,
              "label": self.label, "weight": self.weight,
              "nrows": self.nrows}
@@ -129,6 +130,7 @@ class DenseBatch:
         return self.x.shape[2]
 
     def tree(self) -> Dict[str, Any]:
+        """The batch as a flat dict pytree (the device_put / jit input)."""
         t = {"x": self.x, "label": self.label, "weight": self.weight,
              "nrows": self.nrows}
         if self.qid is not None:
@@ -357,6 +359,7 @@ class HostBatcher:
             qid=qid.reshape(D, R) if self._emit_qid else None)
 
     def reset(self) -> None:
+        """Restart batching from the first row (new epoch)."""
         self.parser.before_first()
         self._pending.clear()
         self._pending_rows = 0
@@ -405,6 +408,8 @@ class NativeHostBatcher:
         self._pool_lock = threading.Lock()
 
     def next_batch(self):
+        """Produce the next static-shape batch of host numpy arrays (None at
+        end); buffers come from the recycle pool when available."""
         meta = self._b.next_meta()
         if meta is None:
             return None
@@ -511,12 +516,16 @@ class NativeHostBatcher:
                 lst.append(arrs)
 
     def reset(self) -> None:
+        """Restart batching from the first row (new epoch); the recycle pool
+        survives."""
         self._b.before_first()
 
     def bytes_read(self) -> int:
+        """Bytes consumed from the underlying source so far."""
         return self._b.bytes_read()
 
     def close(self) -> None:
+        """Free the native batcher handle (idempotent)."""
         self._b.close()
 
 
@@ -702,11 +711,13 @@ class DeviceRowBlockIter:
         self.batcher.reset()
 
     def bytes_read(self) -> int:
+        """Bytes consumed from the underlying source so far."""
         if self.parser is not None:
             return self.parser.bytes_read()
         return self.batcher.bytes_read()
 
     def close(self) -> None:
+        """Stop staging threads and free native resources (idempotent)."""
         self._join_threads()
         if self.parser is not None:
             self.parser.close()

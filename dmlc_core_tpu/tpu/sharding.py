@@ -57,6 +57,7 @@ def process_part(num_parts_per_process: int = 1) -> Tuple[int, int]:
 
 
 def local_device_count(mesh: Optional[Mesh] = None) -> int:
+    """Devices visible to this process (or in `mesh` when given)."""
     if mesh is None:
         return jax.local_device_count()
     return mesh.devices.size

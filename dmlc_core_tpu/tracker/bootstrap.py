@@ -89,6 +89,8 @@ def build_env(base: Dict[str, str],
 
 
 def main(argv: List[str] = None) -> int:
+    """CLI entry: prepare the container env (archives, lib paths) and exec the
+    user command."""
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
         # nonzero so a launcher that interpolated an empty user command

@@ -61,6 +61,7 @@ class RendezvousClient:
         ws.close()
 
     def shutdown(self, rank: int) -> None:
+        """Send the shutdown handshake and close the tracker connection."""
         ws = self._dial_tracker("shutdown", rank=rank)
         ws.close()
 

@@ -102,6 +102,8 @@ def submit_local(args) -> None:
 def build_ssh_commands(hosts: List[Tuple[str, str]], command: Sequence[str],
                        nworker: int, nserver: int, envs: Dict[str, object],
                        working_dir: str) -> List[str]:
+    """One ssh command per host exporting the DMLC env before the worker
+    command."""
     cmds = []
     for i in range(nworker + nserver):
         e = dict(envs)
@@ -116,6 +118,7 @@ def build_ssh_commands(hosts: List[Tuple[str, str]], command: Sequence[str],
 
 
 def submit_ssh(args) -> None:
+    """cluster=ssh backend: spawn one ssh-launched worker per host-file entry."""
     hosts = parse_host_file(args.host_file)
 
     def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
@@ -152,12 +155,16 @@ def mpi_env_flags(envs: Dict[str, object], mpi_version_text: str) -> str:
 def build_mpi_command(command: Sequence[str], n: int,
                       envs: Dict[str, object], mpi_version_text: str,
                       host_file: Optional[str] = None) -> str:
+    """mpirun/mpiexec invocation carrying the DMLC env (OpenMPI -x / MPICH
+    -env dialects)."""
     cmd = f"--hostfile {host_file} " if host_file else ""
     return (f"mpirun -n {n} {mpi_env_flags(envs, mpi_version_text)} "
             f"{cmd}{' '.join(command)}")
 
 
 def submit_mpi(args) -> None:
+    """cluster=mpi backend: run the job under mpirun against the rendezvous
+    tracker."""
     out, _ = subprocess.Popen(["mpirun", "--version"],
                               stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE).communicate()
@@ -186,6 +193,7 @@ def build_sge_script() -> str:
     # array jobs (reference launcher.py:44-49) before exec'ing the command.
     # SGE_TASK_ID is 1-based (qsub -t 1-N); DMLC_TASK_ID is 0-based
     # everywhere else in this tracker, so shift here.
+    """SGE array-job script body; $SGE_TASK_ID maps to DMLC_TASK_ID."""
     return ("source ~/.bashrc\n"
             "export DMLC_TASK_ID=$((SGE_TASK_ID - 1))\n"
             "export DMLC_JOB_CLUSTER=sge\n"
@@ -194,6 +202,7 @@ def build_sge_script() -> str:
 
 def build_sge_command(args, ntask: int, envs: Dict[str, object],
                       runscript: str) -> str:
+    """qsub invocation submitting the generated SGE array-job script."""
     env_arg = ",".join(f'{k}="{v}"' for k, v in envs.items())
     cmd = f"qsub -cwd -t 1-{ntask} -S /bin/bash"
     if args.queue != "default":
@@ -207,6 +216,7 @@ def build_sge_command(args, ntask: int, envs: Dict[str, object],
 
 
 def submit_sge(args) -> None:
+    """cluster=sge backend: submit an array job per role via qsub."""
     if args.jobname is None:
         args.jobname = (f"dmlc{args.num_workers}." +
                         args.command[0].split("/")[-1])
@@ -228,11 +238,13 @@ def submit_sge(args) -> None:
 # -- slurm -------------------------------------------------------------------
 def build_slurm_command(command: Sequence[str], n: int, nodes: int,
                         envs: Dict[str, object]) -> str:
+    """srun invocation carrying the DMLC env (one task per worker)."""
     return (f"{inline_env(envs)} srun --share --exclusive=user -N {nodes} "
             f"-n {n} {' '.join(command)}")
 
 
 def submit_slurm(args) -> None:
+    """cluster=slurm backend: srun workers against the rendezvous tracker."""
     def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
         envs = dict(envs, DMLC_JOB_CLUSTER="slurm")
         for role, n, nodes in (
@@ -276,6 +288,8 @@ def build_tpu_pod_commands(hosts: List[Tuple[str, str]],
                            envs: Dict[str, object],
                            coordinator_port: int = 8476,
                            working_dir: str = ".") -> List[str]:
+    """Per-host launch commands for a TPU pod slice (local exec or ssh), env
+    from build_tpu_pod_env."""
     cmds = []
     for i, (node, port) in enumerate(hosts):
         e = build_tpu_pod_env(i, hosts, coordinator_port, envs)
@@ -489,16 +503,21 @@ def submit_kubernetes(args) -> None:
 
 # -- yarn --------------------------------------------------------------------
 def build_yarn_command(args, role: str, n: int,
-                       envs: Dict[str, object]) -> List[str]:
+                       envs: Dict[str, object],
+                       attempt: int = 0) -> List[str]:
     """Reference yarn.py ships a Java AppMaster jar (tracker/yarn/) that
     allocates one container per task and restarts failed tasks. This build
     has no Java component; the same contract is expressed as one `yarn jar
     <distributed-shell>` submission *per role* (like the mpi/slurm backends)
     carrying the DMLC_* env protocol, with container count/memory/cores
-    mapped onto -num_containers/-container_*."""
+    mapped onto -num_containers/-container_*. The attempt number is baked
+    into -appname so supervision status for a relaunch never reads the
+    previous incarnation's retained FINISHED/FAILED record (YARN keeps
+    completed apps in `-list -appStates ALL`)."""
     e = dict(envs)
     e["DMLC_ROLE"] = role
     e["DMLC_JOB_CLUSTER"] = "yarn"
+    e["DMLC_NUM_ATTEMPT"] = attempt
     if getattr(args, "archives", None):
         e["DMLC_JOB_ARCHIVES"] = ":".join(args.archives)
     shell_env = []
@@ -508,9 +527,9 @@ def build_yarn_command(args, role: str, n: int,
     cores = args.worker_cores if role == "worker" else args.server_cores
     jar = os.getenv("DMLC_YARN_SHELL_JAR",
                     "hadoop-yarn-applications-distributedshell.jar")
-    cmd = ["yarn", "jar", jar,
+    cmd = [os.getenv("DMLC_YARN_BIN", "yarn"), "jar", jar,
            "-jar", jar,
-           "-appname", f"{args.jobname or 'dmlc-job'}-{role}",
+           "-appname", f"{args.jobname or 'dmlc-job'}-{role}-a{attempt}",
            "-num_containers", str(n),
            "-container_memory", str(mem),
            "-container_vcores", str(cores)]
@@ -524,15 +543,51 @@ def build_yarn_command(args, role: str, n: int,
 
 
 def submit_yarn(args) -> None:
+    """Supervised submission (AppMaster parity, mirroring the kubernetes
+    path): each role is a CommandTask — the distributedshell client runs
+    async in the foreground, application state is polled from
+    `yarn application -list` filtered to this app's name, and a FAILED
+    final state kills + resubmits up to --num-attempt times."""
+    from dmlc_core_tpu.tracker.supervisor import CommandTask, WorkerSupervisor
+
+    ybin = os.getenv("DMLC_YARN_BIN", "yarn")
+
+    def kill_cmd_for(name: str) -> List[str]:
+        # real YARN kills by application id; resolve it from the list
+        # output by app name (column 2) at kill time
+        return ["bash", "-lc",
+                f"id=$({ybin} application -list -appStates ALL 2>/dev/null"
+                f" | awk -v n='{name}' '$2==n {{print $1; exit}}');"
+                f" [ -n \"$id\" ] && {ybin} application -kill \"$id\""
+                f" || true"]
+
     def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
-        for role, n in (("server", nserver), ("worker", nworker)):
-            if n == 0:
-                continue
-            cmd = build_yarn_command(args, role, n, envs)
-            logger.info("%s", " ".join(cmd))
-            threading.Thread(
-                target=lambda c=list(cmd): subprocess.check_call(c),
-                daemon=True).start()
+        sup = WorkerSupervisor(max_attempts=args.num_attempt,
+                               poll_interval=5.0)
+        roles = [(r, n) for r, n in (("server", nserver),
+                                     ("worker", nworker)) if n]
+        base = args.jobname or "dmlc-job"
+        for i, (role, n) in enumerate(roles):
+
+            def start(attempt, role=role, n=n):
+                # the per-attempt -appname means a relaunch polls ONLY its
+                # own application; the failed incarnation was already torn
+                # down by the supervisor's terminate() (delete_cmd below)
+                name = f"{base}-{role}-a{attempt}"
+                cmd = build_yarn_command(args, role, n, envs, attempt)
+                logger.info("%s", " ".join(cmd))
+                return CommandTask(
+                    submit_cmd=cmd,
+                    status_cmd=[ybin, "application", "-list",
+                                "-appStates", "ALL"],
+                    status_filter=name,
+                    succeeded_text="SUCCEEDED", failed_text="FAILED",
+                    delete_cmd=kill_cmd_for(name),
+                    submit_async=True)
+
+            sup.add(i, role, start)
+        sup.launch()
+        sup.watch_in_thread()
 
     rendezvous.run_job(args.num_workers, args.num_servers, launch,
                        host_ip=args.host_ip or "auto",
@@ -541,36 +596,62 @@ def submit_yarn(args) -> None:
 
 # -- mesos -------------------------------------------------------------------
 def build_mesos_command(args, role: str, n: int,
-                        envs: Dict[str, object]) -> List[str]:
+                        envs: Dict[str, object],
+                        attempt: int = 0) -> List[str]:
     """Reference mesos.py registers a framework that launches one task per
     worker/server; expressed here as `mesos-execute` task groups against
-    --mesos-master with the env protocol inlined."""
+    --mesos-master with the env protocol inlined. The attempt number is
+    baked into the task name so each incarnation's status is observable
+    independently in the master's /tasks feed (mesos task names are not
+    unique; a relaunch must not read its predecessor's FAILED record)."""
     e = dict(envs)
     e["DMLC_ROLE"] = role
     e["DMLC_JOB_CLUSTER"] = "mesos"
+    e["DMLC_NUM_ATTEMPT"] = attempt
     mem = args.worker_memory_mb if role == "worker" else args.server_memory_mb
     cores = args.worker_cores if role == "worker" else args.server_cores
     master = args.mesos_master or os.getenv("MESOS_MASTER")
     if not master:
         raise SystemExit("mesos: pass --mesos-master or set MESOS_MASTER")
-    return ["mesos-execute",
+    return [os.getenv("DMLC_MESOS_EXECUTE", "mesos-execute"),
             f"--master={master}",
-            f"--name=dmlc-{role}",
+            f"--name=dmlc-{role}-a{attempt}",
             f"--instances={n}",
             f"--resources=cpus:{cores};mem:{mem}",
             "--command=" + inline_env(e) + " " + " ".join(args.command)]
 
 
 def submit_mesos(args) -> None:
+    """Supervised submission: mesos-execute owns the framework and stays in
+    the foreground, so it runs async under a CommandTask whose status is
+    the master's /tasks REST feed (tracker/mesos_status.py), normalized to
+    SUCCEEDED/FAILED; a failed incarnation's client is torn down and the
+    group resubmitted under the next attempt's task name."""
+    from dmlc_core_tpu.tracker.supervisor import CommandTask, WorkerSupervisor
+
+    master = args.mesos_master or os.getenv("MESOS_MASTER")
+
     def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
-        for role, n in (("server", nserver), ("worker", nworker)):
-            if n == 0:
-                continue
-            cmd = build_mesos_command(args, role, n, envs)
-            logger.info("%s", " ".join(cmd))
-            threading.Thread(
-                target=lambda c=list(cmd): subprocess.check_call(c),
-                daemon=True).start()
+        sup = WorkerSupervisor(max_attempts=args.num_attempt,
+                               poll_interval=5.0)
+        roles = [(r, n) for r, n in (("server", nserver),
+                                     ("worker", nworker)) if n]
+        for i, (role, n) in enumerate(roles):
+
+            def start(attempt, role=role, n=n):
+                cmd = build_mesos_command(args, role, n, envs, attempt)
+                logger.info("%s", " ".join(cmd))
+                return CommandTask(
+                    submit_cmd=cmd,
+                    status_cmd=[sys.executable, "-m",
+                                "dmlc_core_tpu.tracker.mesos_status",
+                                str(master), f"dmlc-{role}-a{attempt}"],
+                    succeeded_text="SUCCEEDED", failed_text="FAILED",
+                    submit_async=True)
+
+            sup.add(i, role, start)
+        sup.launch()
+        sup.watch_in_thread()
 
     rendezvous.run_job(args.num_workers, args.num_servers, launch,
                        host_ip=args.host_ip or "auto",

@@ -12,6 +12,8 @@ from typing import List, Optional
 
 
 def build_parser() -> argparse.ArgumentParser:
+    """The dmlc-submit argparse parser (reference dmlc_tracker/opts.py
+    surface)."""
     p = argparse.ArgumentParser(
         prog="dmlc-submit",
         description="Submit a distributed dmlc_core_tpu job")
@@ -81,6 +83,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def get_opts(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    """Parse dmlc-submit arguments; `--` splits launcher args from the user
+    command."""
     args = build_parser().parse_args(argv)
     if args.cluster is None:
         raise SystemExit(

@@ -46,6 +46,7 @@ class WorkerConn:
         self.port: Optional[int] = None
 
     def decide_rank(self, job_map: Dict[str, int]) -> int:
+        """Assign this connection's rank (recovered old rank, else next free)."""
         if self.rank >= 0:
             return self.rank
         if self.jobid != "NULL" and self.jobid in job_map:
@@ -210,6 +211,7 @@ class RabitTracker:
                         self.end_time - self.start_time)
 
     def start(self) -> None:
+        """Begin accepting worker connections on the tracker thread."""
         def guarded():
             try:
                 self._serve(self.num_workers)
@@ -220,6 +222,7 @@ class RabitTracker:
         self.thread.start()
 
     def join(self, timeout: Optional[float] = None) -> None:
+        """Block until every worker has shut down (job end)."""
         deadline = None if timeout is None else time.time() + timeout
         while self.thread is not None and self.thread.is_alive():
             self.thread.join(0.1)
@@ -230,6 +233,7 @@ class RabitTracker:
                 from self.fatal_error
 
     def alive(self) -> bool:
+        """True while the tracker thread is serving."""
         return self.thread is not None and self.thread.is_alive()
 
 
@@ -260,17 +264,20 @@ class PSTracker:
         self.thread.start()
 
     def worker_envs(self) -> Dict[str, object]:
+        """Env vars a PS-lite worker/server needs to find this tracker."""
         if self.cmd is None:
             return {}
         return {"DMLC_PS_ROOT_URI": self.host_ip,
                 "DMLC_PS_ROOT_PORT": self.port}
 
     def join(self) -> None:
+        """Block until every worker/server has checked out."""
         if self.thread is not None:
             while self.thread.is_alive():
                 self.thread.join(0.1)
 
     def alive(self) -> bool:
+        """True while the tracker thread is serving."""
         return self.thread is not None and self.thread.is_alive()
 
 

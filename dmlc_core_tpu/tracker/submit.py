@@ -17,6 +17,8 @@ from dmlc_core_tpu.tracker.opts import get_opts
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    """dmlc-submit CLI entry: parse options and dispatch to the cluster
+    backend."""
     args = get_opts(argv)
     logging.basicConfig(
         format="%(asctime)s %(levelname)s %(message)s",

@@ -70,9 +70,11 @@ class WorkerSupervisor:
 
     def add(self, task_id: int, role: str,
             start: Callable[[int], object]) -> None:
+        """Register a task: (task_id, role, start(attempt) -> handle)."""
         self._tasks.append(_TaskState(task_id, role, start))
 
     def stop(self) -> None:
+        """Stop watching and terminate every live handle."""
         self._stop.set()
         for t in self._tasks:
             if t.handle is not None and not t.done:
@@ -111,6 +113,14 @@ class WorkerSupervisor:
                     raise RuntimeError(
                         f"task {t.task_id} ({t.role}) failed with code "
                         f"{rc} after {t.attempt} attempts")
+                # tear the failed incarnation down before resubmitting —
+                # remote backends may still have live pieces (a surviving
+                # container of a partially-failed group, a foreground
+                # mesos-execute client); a dead local Popen ignores it
+                try:
+                    t.handle.terminate()
+                except Exception:
+                    pass
                 logger.warning(
                     "task %d (%s) exited with code %d; relaunching "
                     "(attempt %d)", t.task_id, t.role, rc, t.attempt)
@@ -144,33 +154,52 @@ class WorkerSupervisor:
 
 class CommandTask:
     """Poll-by-CLI handle for backends whose workers are remote containers
-    (kubernetes/yarn): `submit_cmd` (re)creates the task, `status_cmd` is
-    polled and must exit 0 while running/succeeded-with-`succeeded_text`,
+    (kubernetes/yarn/mesos): `submit_cmd` (re)creates the task, `status_cmd`
+    is polled and must exit 0 while running/succeeded-with-`succeeded_text`,
     and its stdout is matched against `succeeded_text` / `failed_text` to
     decide completion (the AppMaster's container-status watch, expressed
-    over the backend CLI)."""
+    over the backend CLI).
+
+    `submit_async=True` launches the submit command without waiting — for
+    clients that stay in the foreground while the application runs (the
+    yarn distributedshell client, mesos-execute); a nonzero exit of that
+    client counts as a failure signal, exit 0 is ignored (status text
+    decides). `status_filter` restricts matching to output lines containing
+    the filter, so list-style status commands (`yarn application -list`)
+    only see this task's application."""
 
     def __init__(self, submit_cmd: Sequence[str], status_cmd: Sequence[str],
                  succeeded_text: str = "Succeeded",
                  failed_text: str = "Failed",
                  delete_cmd: Optional[Sequence[str]] = None,
                  submit_input: Optional[str] = None,
-                 status_errors_tolerated: int = 3):
+                 status_errors_tolerated: int = 3,
+                 submit_async: bool = False,
+                 status_filter: Optional[str] = None):
         self.status_cmd = list(status_cmd)
         self.succeeded_text = succeeded_text
         self.failed_text = failed_text
         self.delete_cmd = list(delete_cmd) if delete_cmd else None
         self.status_errors_tolerated = status_errors_tolerated
+        self.status_filter = status_filter
         self._status_errors = 0
-        out = subprocess.run(list(submit_cmd), capture_output=True,
-                             input=submit_input,
-                             text=True)
-        if out.returncode != 0:
-            raise RuntimeError(
-                f"submission failed ({' '.join(submit_cmd)}): "
-                f"{out.stderr or out.stdout}")
+        self._proc: Optional[subprocess.Popen] = None
+        if submit_async:
+            self._proc = subprocess.Popen(
+                list(submit_cmd), stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+        else:
+            out = subprocess.run(list(submit_cmd), capture_output=True,
+                                 input=submit_input,
+                                 text=True)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"submission failed ({' '.join(submit_cmd)}): "
+                    f"{out.stderr or out.stdout}")
 
     def poll(self) -> Optional[int]:
+        """Popen-protocol status: None while running, 0 success, nonzero
+        failure (status text first, then the async client's exit)."""
         out = subprocess.run(self.status_cmd, capture_output=True, text=True)
         if out.returncode != 0:
             # a transient CLI/API error must not restart a healthy task;
@@ -183,15 +212,32 @@ class CommandTask:
             return None
         self._status_errors = 0
         text = (out.stdout or "") + (out.stderr or "")
+        if self.status_filter is not None:
+            text = "\n".join(line for line in text.splitlines()
+                             if self.status_filter in line)
         if self.failed_text in text:
             return 1
         if self.succeeded_text in text:
             return 0
+        # no verdict from status output: a foreground client that died
+        # nonzero is the only remaining failure signal (its exit 0 just
+        # means "submission done" for detach-style clients)
+        if self._proc is not None:
+            rc = self._proc.poll()
+            if rc is not None and rc != 0:
+                return rc
         return None  # still running
 
     def terminate(self) -> None:
+        """Tear the task down: run delete_cmd and stop the async submit
+        client."""
         if self.delete_cmd is not None:
             subprocess.run(self.delete_cmd, capture_output=True)
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                self._proc.terminate()
+            except Exception:
+                pass
 
 
 def popen_start_fn(command: Sequence[str], role: str, task_id: int,

@@ -33,6 +33,8 @@ def heap_neighbors(rank: int, n: int) -> List[int]:
 
 
 def build_tree(n: int) -> Tuple[TreeMap, ParentMap]:
+    """Binomial reduction tree over `n` workers; returns (tree_map,
+    parent_map) (reference tracker.py)."""
     tree: TreeMap = {}
     parent: ParentMap = {}
     for r in range(n):
@@ -57,6 +59,8 @@ def _dfs_ring(tree: TreeMap, parent: ParentMap, r: int) -> List[int]:
 
 
 def build_ring(tree: TreeMap, parent: ParentMap) -> RingMap:
+    """Ring order over `n` workers rooted at `r` (reference tracker.py ring
+    construction)."""
     order = _dfs_ring(tree, parent, 0)
     assert len(order) == len(tree)
     n = len(tree)

@@ -21,6 +21,7 @@ class WireSocket:
         self.sock = sock
 
     def recv_all(self, nbytes: int) -> bytes:
+        """Receive exactly `nbytes` bytes (raises on EOF)."""
         chunks = []
         got = 0
         while got < nbytes:
@@ -32,29 +33,36 @@ class WireSocket:
         return b"".join(chunks)
 
     def recv_int(self) -> int:
+        """Receive one int32 (Rabit wire byte order)."""
         return struct.unpack("@i", self.recv_all(4))[0]
 
     def send_int(self, v: int) -> None:
+        """Send one int32 (Rabit wire byte order)."""
         self.sock.sendall(struct.pack("@i", v))
 
     def recv_str(self) -> str:
+        """Receive a length-prefixed string (Rabit wire format)."""
         n = self.recv_int()
         return self.recv_all(n).decode()
 
     def send_str(self, s: str) -> None:
+        """Send a length-prefixed string (Rabit wire format)."""
         data = s.encode()
         self.send_int(len(data))  # byte count, not character count
         self.sock.sendall(data)
 
     def close(self) -> None:
+        """Close the underlying socket (idempotent)."""
         self.sock.close()
 
 
 def resolve_ip(host: str) -> str:
+    """Resolve a hostname to the IP the workers should dial."""
     return socket.getaddrinfo(host, None)[0][4][0]
 
 
 def addr_family(addr: str):
+    """AF_INET or AF_INET6 for the given host string."""
     return socket.getaddrinfo(addr, None)[0][0]
 
 

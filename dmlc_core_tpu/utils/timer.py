@@ -32,10 +32,12 @@ class Timer:
         self._started: Optional[float] = None
 
     def start(self) -> "Timer":
+        """Begin (or resume) timing."""
         self._started = get_time()
         return self
 
     def stop(self) -> float:
+        """Stop timing and add the elapsed span to the total."""
         if self._started is not None:
             self._total += get_time() - self._started
             self._started = None
@@ -88,6 +90,7 @@ def span_totals() -> Dict[str, Dict[str, float]]:
 
 
 def reset_span_totals() -> None:
+    """Zero the global named-span accumulators."""
     with _lock:
         _totals.clear()
         _counts.clear()

@@ -1,0 +1,60 @@
+"""Real-subprocess worker driven by test_distributed_real.py.
+
+The reference proves its launch layer with actual separate worker processes
+rendezvousing over real sockets (reference tracker/dmlc_tracker/local.py:12-49);
+this worker is the TPU-native equivalent: it consumes the cluster=tpu-pod env
+protocol (tracker/launchers.py build_tpu_pod_env), initializes
+jax.distributed against a real coordination service, shards input with
+process_part(), and allreduces shard statistics across OS processes.
+
+Usage: python distributed_worker.py <repo_root> <data_path> <out_json>
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    repo, data, out = sys.argv[1], sys.argv[2], sys.argv[3]
+    sys.path.insert(0, repo)
+    import jax
+    # the axon site config pins JAX_PLATFORMS; force the CPU backend the
+    # same way tests/conftest.py does
+    jax.config.update("jax_platforms", "cpu")
+
+    from dmlc_core_tpu.io.native import NativeParser
+    from dmlc_core_tpu.parallel import distributed
+    from dmlc_core_tpu.tpu.sharding import process_part
+
+    distributed.init_from_env()
+
+    part, npart = process_part()
+    rows = 0
+    label_sum = 0.0
+    with NativeParser(data, part=part, npart=npart) as p:
+        for b in p:
+            rows += b.num_rows
+            label_sum += float(b.label.sum())
+
+    total_rows = int(distributed.allreduce(rows))
+    total_label = float(distributed.allreduce(label_sum))
+    max_rows = int(distributed.allreduce(rows, op="max"))
+    # broadcast: every process must end up with root's value
+    bcast = int(distributed.broadcast(distributed.rank() * 100 + 7, root=0))
+
+    with open(out, "w") as f:
+        json.dump({
+            "rank": distributed.rank(),
+            "world": distributed.world_size(),
+            "part": part,
+            "npart": npart,
+            "local_rows": rows,
+            "total_rows": total_rows,
+            "total_label": total_label,
+            "max_rows": max_rows,
+            "bcast": bcast,
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,11 @@ class MockHdfsState:
         # secure-cluster mode: every op must carry delegation=<this> and no
         # user.name (the WebHDFS token-auth contract)
         self.require_delegation = None
+        # SPNEGO-gateway mode: every op must carry this exact Authorization
+        # header (e.g. "Negotiate abc") and no user.name; 401s with a
+        # WWW-Authenticate challenge otherwise, like a secured namenode
+        self.require_auth_header = None
+        self.seen_auth_headers = []   # Authorization values received
         # fault injection (VERDICT r1 item 6): every Nth GET 500s
         self.get_500_every = 0
         self._get_count = 0
@@ -113,6 +118,27 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
             return False
         return True
 
+    def _check_spnego(self, q) -> bool:
+        """SPNEGO contract: the configured Authorization credential on
+        every request (including datanode hops), no user.name."""
+        st = self.state
+        got = self.headers.get("Authorization")
+        if got:
+            st.seen_auth_headers.append(got)
+        if st.require_auth_header is None:
+            return True
+        if got != st.require_auth_header:
+            self.send_response(401)
+            self.send_header("WWW-Authenticate", "Negotiate")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return False
+        if "user.name" in q:
+            self._remote_exc(
+                400, "user.name must not accompany SPNEGO auth")
+            return False
+        return True
+
     def do_GET(self):
         st = self.state
         st.requests.append(("GET", self.path))
@@ -120,6 +146,8 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
             return
         path, q = self._parse()
         if not self._check_auth(q):
+            return
+        if not self._check_spnego(q):
             return
         op = q.get("op", "").upper()
         # inject 5xx only on the (retried) OPEN data path; metadata ops are
@@ -186,6 +214,8 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
         path, q = self._parse()
         body = self._read_body()
         if not self._check_auth(q):
+            return
+        if not self._check_spnego(q):
             return
         if q.get("op", "").upper() != "CREATE":
             return self._remote_exc(400, "unsupported PUT op")

@@ -208,3 +208,279 @@ def test_command_task_submission_error_raises_with_stderr(tmp_path):
     bad.chmod(bad.stat().st_mode | stat.S_IEXEC)
     with pytest.raises(RuntimeError, match="RBAC"):
         CommandTask(submit_cmd=[str(bad)], status_cmd=[str(bad)])
+
+
+def _run_with_watchdog(sup, timeout=60.0):
+    """sup.run() with a hang guard: a scripting bug in a fake backend must
+    fail the test in seconds, not eat the suite timeout."""
+    err = []
+
+    def _run():
+        try:
+            sup.run()
+        except BaseException as e:  # surfaced below
+            err.append(e)
+
+    th = threading.Thread(target=_run, daemon=True)
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        sup.stop()
+        raise AssertionError("supervisor did not converge (hang)")
+    if err:
+        raise err[0]
+
+
+# -- yarn: fake-CLI supervised round-trip (VERDICT r2 item 6) ---------------
+def make_fake_yarn(tmp_path):
+    """A `yarn` CLI stub with an application registry on disk: `jar`
+    submissions register an app; apps named `*-a0` fail after two polls,
+    later attempts succeed. Realistic in the way that matters for
+    supervision: `application -list -appStates ALL` RETAINS completed and
+    killed applications (real YARN never forgets them — this is why the
+    launcher bakes the attempt into -appname), and `-kill` takes an
+    application id, not a name. Mirrors the fake-kubectl pattern."""
+    state = tmp_path / "yarnstate"
+    state.mkdir()
+    log = tmp_path / "yarn_calls.log"
+    exe = tmp_path / "yarn"
+    exe.write_text(textwrap.dedent(f"""\
+        #!/bin/bash
+        S={state}
+        echo "$@" >> {log}
+        case "$1" in
+        jar)
+          # find -appname value
+          name=""
+          prev=""
+          for a in "$@"; do
+            if [ "$prev" = "-appname" ]; then name="$a"; fi
+            prev="$a"
+          done
+          n=$(ls "$S" | wc -l)
+          echo 0 > "$S/$name.polls"
+          echo application_17_000$((n+1)) > "$S/$name.id"
+          exit 0 ;;
+        application)
+          case "$2" in
+          -list)
+            for f in "$S"/*.id; do
+              [ -e "$f" ] || exit 0
+              name=$(basename "$f" .id)
+              id=$(cat "$f")
+              polls=$(cat "$S/$name.polls")
+              echo $((polls+1)) > "$S/$name.polls"
+              if [ -e "$S/$name.killed" ]; then
+                echo "$id $name YARN default KILLED KILLED 100%"
+              elif [ "$polls" -lt 2 ]; then
+                echo "$id $name YARN default RUNNING UNDEFINED 50%"
+              elif [[ "$name" == *-a0 ]]; then
+                echo "$id $name YARN default FINISHED FAILED 100%"
+              else
+                echo "$id $name YARN default FINISHED SUCCEEDED 100%"
+              fi
+            done
+            exit 0 ;;
+          -kill)
+            # $3 is the application id; the record STAYS listed (KILLED)
+            for f in "$S"/*.id; do
+              if [ "$(cat "$f")" = "$3" ]; then
+                touch "$S/$(basename "$f" .id).killed"
+              fi
+            done
+            exit 0 ;;
+          esac ;;
+        esac
+        exit 2
+        """))
+    exe.chmod(exe.stat().st_mode | stat.S_IEXEC)
+    return exe, log, state
+
+
+def test_yarn_supervised_restart_round_trip(tmp_path, monkeypatch):
+    """submission -> RUNNING -> FAILED -> terminate (kill by id) ->
+    resubmit under the next attempt's -appname -> SUCCEEDED, through the
+    real submit_yarn wiring (per-attempt names keep the retained FAILED
+    record of attempt 0 out of attempt 1's status filter)."""
+    yarn, log, state = make_fake_yarn(tmp_path)
+    monkeypatch.setenv("DMLC_YARN_BIN", str(yarn))
+
+    ybin = str(yarn)
+    base = "yj-worker"
+
+    def kill_cmd_for(name):
+        return ["bash", "-lc",
+                f"id=$({ybin} application -list -appStates ALL 2>/dev/null"
+                f" | awk -v n='{name}' '$2==n {{print $1; exit}}');"
+                f" [ -n \"$id\" ] && {ybin} application -kill \"$id\""
+                f" || true"]
+
+    def start(attempt):
+        name = f"{base}-a{attempt}"
+        return CommandTask(
+            submit_cmd=[ybin, "jar", "ds.jar", "-appname", name,
+                        "-num_containers", "2"],
+            status_cmd=[ybin, "application", "-list", "-appStates", "ALL"],
+            status_filter=name,
+            succeeded_text="SUCCEEDED", failed_text="FAILED",
+            delete_cmd=kill_cmd_for(name), submit_async=True)
+
+    sup = WorkerSupervisor(max_attempts=2, poll_interval=0.01)
+    sup.add(0, "worker", start)
+    _run_with_watchdog(sup)
+
+    assert sup.failures and sup.failures[0][0] == 0  # one observed failure
+    calls = log.read_text()
+    assert calls.count("jar ds.jar") == 2            # initial + relaunch
+    assert "-kill application_17_0001" in calls      # a0 torn down by id
+    # a0's FAILED record is STILL listed (real YARN behavior) yet a1
+    # converged — the per-attempt name isolation worked
+    assert (state / f"{base}-a0.killed").exists()
+    assert (state / f"{base}-a1.id").exists()
+
+
+def test_yarn_build_command_honors_bin_and_attempt(monkeypatch):
+    """The submit command uses DMLC_YARN_BIN (same binary as supervision)
+    and bakes the attempt into -appname."""
+    from dmlc_core_tpu.tracker.launchers import build_yarn_command
+    from dmlc_core_tpu.tracker.opts import get_opts
+    monkeypatch.setenv("DMLC_YARN_BIN", "/opt/hadoop/bin/yarn")
+    args = get_opts(["--cluster=yarn", "--num-workers=1", "--jobname=yj",
+                     "--", "./t"])
+    cmd = build_yarn_command(args, "worker", 1, {}, attempt=3)
+    assert cmd[0] == "/opt/hadoop/bin/yarn"
+    assert cmd[cmd.index("-appname") + 1] == "yj-worker-a3"
+    assert "DMLC_NUM_ATTEMPT=3" in cmd
+
+
+def test_yarn_status_filter_ignores_other_apps(tmp_path):
+    """A FAILED line from an unrelated application must not fail this
+    task (the -list output is cluster-wide)."""
+    lister = tmp_path / "lister"
+    lister.write_text(textwrap.dedent("""\
+        #!/bin/bash
+        if [ "$1" = submit ]; then exit 0; fi
+        echo "application_1 other-job YARN default FINISHED FAILED 100%"
+        echo "application_2 my-job YARN default FINISHED SUCCEEDED 100%"
+        exit 0
+        """))
+    lister.chmod(lister.stat().st_mode | stat.S_IEXEC)
+    task = CommandTask(submit_cmd=[str(lister), "submit"],
+                       status_cmd=[str(lister), "status"],
+                       status_filter="my-job",
+                       succeeded_text="SUCCEEDED", failed_text="FAILED")
+    assert task.poll() == 0   # other-job's FAILED line filtered out
+
+
+# -- mesos: stub REST master supervised round-trip --------------------------
+class FakeMesosMaster:
+    """Stub of the master's /tasks endpoint: submitted task names are
+    registered by the fake mesos-execute (via a spool dir); each name's
+    state is scripted — attempt 0 fails after two polls, attempt 1
+    finishes."""
+
+    def __init__(self, spool):
+        import http.server
+        import json
+
+        self.spool = spool
+        self.polls = {}
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path != "/tasks":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                tasks = []
+                for f in sorted(fake.spool.glob("*.task")):
+                    name = f.stem
+                    n = fake.polls.get(name, 0)
+                    fake.polls[name] = n + 1
+                    if n < 2:
+                        state = "TASK_RUNNING"
+                    elif name.endswith("-a0"):
+                        state = "TASK_FAILED"
+                    else:
+                        state = "TASK_FINISHED"
+                    tasks.append({"name": name, "state": state})
+                body = json.dumps({"tasks": tasks}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+
+
+def make_fake_mesos_execute(tmp_path, spool):
+    exe = tmp_path / "mesos-execute"
+    exe.write_text(textwrap.dedent(f"""\
+        #!/bin/bash
+        for a in "$@"; do
+          case "$a" in
+          --name=*) name="${{a#--name=}}" ;;
+          esac
+        done
+        touch {spool}/"$name".task
+        # the real client stays in the foreground while the task runs
+        sleep 60
+        """))
+    exe.chmod(exe.stat().st_mode | stat.S_IEXEC)
+    return exe
+
+
+def test_mesos_supervised_restart_round_trip(tmp_path):
+    """submission -> TASK_RUNNING -> TASK_FAILED -> resubmit under the next
+    attempt's name -> TASK_FINISHED, status over the stub REST master."""
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    master = FakeMesosMaster(spool)
+    exe = make_fake_mesos_execute(tmp_path, spool)
+    try:
+        def start(attempt):
+            return CommandTask(
+                submit_cmd=[str(exe), f"--master=127.0.0.1:{master.port}",
+                            f"--name=dmlc-worker-a{attempt}",
+                            "--instances=2"],
+                status_cmd=[sys.executable, "-m",
+                            "dmlc_core_tpu.tracker.mesos_status",
+                            f"127.0.0.1:{master.port}",
+                            f"dmlc-worker-a{attempt}"],
+                succeeded_text="SUCCEEDED", failed_text="FAILED",
+                submit_async=True)
+
+        sup = WorkerSupervisor(max_attempts=2, poll_interval=0.01)
+        sup.add(0, "worker", start)
+        _run_with_watchdog(sup)
+
+        assert sup.failures and sup.failures[0][0] == 0
+        assert (spool / "dmlc-worker-a0.task").exists()   # first incarnation
+        assert (spool / "dmlc-worker-a1.task").exists()   # relaunched
+    finally:
+        master.close()
+
+
+def test_mesos_status_group_fold():
+    from dmlc_core_tpu.tracker.mesos_status import group_state
+    t = [{"name": "g", "state": "TASK_RUNNING"},
+         {"name": "g", "state": "TASK_FINISHED"},
+         {"name": "other", "state": "TASK_FAILED"}]
+    assert group_state(t, "g") == "RUNNING"          # one still running
+    t[0]["state"] = "TASK_FINISHED"
+    assert group_state(t, "g") == "SUCCEEDED"        # all done
+    t[1]["state"] = "TASK_KILLED"
+    assert group_state(t, "g") == "FAILED"           # any failure fails
+    assert group_state(t, "missing") == "PENDING"    # not registered yet
