@@ -83,15 +83,41 @@ def ensure_rec_dataset(rows: int) -> str:
     return path
 
 
+def ensure_drec_dataset(rows: int) -> str:
+    """Zero-parse lane: dense bf16 row matrices in device layout
+    (cpp/src/dense_rec.h) — ingest is record framing + memcpy, the bytes on
+    disk are the bytes the MXU wants."""
+    from dmlc_core_tpu.io.convert import rows_to_dense_recordio
+    src = ensure_dataset(rows)
+    path = os.path.join(CACHE_DIR, f"higgs_{rows}.drec")
+    if os.path.exists(path):
+        return path
+    rows_to_dense_recordio(src, path + ".tmp", fmt="libsvm", dtype="bf16")
+    os.replace(path + ".tmp", path)
+    return path
+
+
 def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto"
                        ) -> "tuple[float, float]":
-    """(rows/s, seconds) host-parse throughput at a given worker count."""
-    from dmlc_core_tpu.io.native import NativeParser
+    """(rows/s, seconds) host-side throughput at a given worker count:
+    parse for the text/rec lanes, batch assembly for the zero-parse dense
+    lane (which has no parse stage — nthread does not apply)."""
     t0 = time.time()
     got = 0
-    with NativeParser(path, nthread=nthread, fmt=fmt) as p:
-        for b in p:
-            got += b.num_rows
+    if fmt == "recd":
+        from dmlc_core_tpu.tpu.device_iter import DenseRecHostBatcher
+        b = DenseRecHostBatcher(path, dense_dtype="bfloat16")
+        while True:
+            batch = b.next_batch()
+            if batch is None:
+                break
+            got += batch.total_rows
+        b.close()
+    else:
+        from dmlc_core_tpu.io.native import NativeParser
+        with NativeParser(path, nthread=nthread, fmt=fmt) as p:
+            for blk in p:
+                got += blk.num_rows
     dt = time.time() - t0
     assert got == rows, f"row count mismatch: {got} != {rows}"
     return rows / dt, dt
@@ -99,14 +125,17 @@ def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto"
 
 def attainable_contiguous_bw(sharding, nbytes: int) -> float:
     """Best host->device bandwidth (B/s) for one large contiguous buffer
-    under the pipeline's sharding: the optimistic ceiling."""
+    under the pipeline's sharding: the optimistic ceiling. The buffer is
+    mutated between reps so no transfer-dedup/caching layer can serve a
+    repeat from memory and inflate the ceiling."""
     import numpy as np
     import jax
     n = max(nbytes // 4, 1 << 20)
     buf = np.empty(n, np.float32)
     buf.fill(1.0)
     best = 0.0
-    for _ in range(3):
+    for i in range(3):
+        buf[:: 4096 // 4] = float(i)  # dirty one word per page
         t0 = time.time()
         arr = jax.device_put(buf, sharding)
         arr.block_until_ready()
@@ -119,11 +148,17 @@ def attainable_contiguous_bw(sharding, nbytes: int) -> float:
 def attainable_pytree_bw(host_tree, sharding) -> float:
     """Best host->device bandwidth (B/s) for the SAME pytree of arrays the
     pipeline lands per batch — the honest denominator for bw-util (the
-    per-array dispatch overhead is part of what a real batch pays)."""
+    per-array dispatch overhead is part of what a real batch pays). Arrays
+    are mutated between reps to defeat transfer caching."""
+    import numpy as np
     import jax
     nbytes = sum(int(v.nbytes) for v in host_tree.values())
     best = 0.0
-    for _ in range(3):
+    for i in range(3):
+        for v in host_tree.values():
+            flat = v.reshape(-1)
+            flat[:: max(1, 4096 // max(v.itemsize, 1))] = \
+                np.asarray(i, dtype=v.dtype)
         t0 = time.time()
         tree = (jax.device_put(host_tree, sharding) if sharding is not None
                 else jax.device_put(host_tree))
@@ -177,11 +212,17 @@ def run_lane(path, rows, fmt, args, mesh, consume):
     with DeviceRowBlockIter(path, fmt=fmt, batch_rows=args.batch_rows,
                             mesh=mesh, nthread=args.threads,
                             dense_dtype=args.dense_dtype) as it:
+        t0 = time.time()
         for batch in it:
             consume(batch.tree()).block_until_ready()
+        warm_dt = time.time() - t0
         sharding = it.sharding
+        # fast lanes (binary ingest epochs run in tens of ms) need more
+        # samples for a stable median: auto-scale toward ~1s of timed work
+        # (auto capped at 15; an explicit larger --reps is always honored)
+        reps = max(args.reps, min(15, int(0.75 / max(warm_dt, 1e-3))))
         runs = []
-        for _ in range(args.reps):
+        for _ in range(reps):
             it.before_first()
             runs.append(run_e2e_epoch(it, rows, consume))
     dts = sorted(dt for dt, _ in runs)
@@ -189,16 +230,29 @@ def run_lane(path, rows, fmt, args, mesh, consume):
     dt = statistics.median(dts)
 
     landed_bw = device_bytes / dt
+    best_bw = device_bytes / dts[0]
     attain_pytree = attainable_pytree_bw(host_tree, sharding)
     attain_contig = attainable_contiguous_bw(
         sharding, min(device_bytes, 256 << 20))
-    util = landed_bw / attain_pytree if attain_pytree > 0 else 0.0
+    # the denominator is the best observed host->HBM capability from ANY
+    # probe — including the pipeline's own best epoch. The probes are as
+    # exposed to tunnel-latency noise as the pipeline; taking the max keeps
+    # the ratio honest (a probe hit by a latency spike must not inflate
+    # utilization past 1) and degrades to the pytree probe on quiet hosts.
+    denom = max(attain_pytree, attain_contig, best_bw, 1.0)
+    util = landed_bw / denom
+    # best-epoch utilization answers the capability question ("can this
+    # lane saturate the link") separately from the median ("does it,
+    # typically, on this noisy shared-tunnel host")
+    util_best = best_bw / denom
     return {
         "dt": dt,
+        "reps": len(runs),
         "rows_per_sec": rows / dt,
         "spread_rows_per_sec": [round(rows / dts[-1], 1),
                                 round(rows / dts[0], 1)],
         "hbm_ingest_bw_util": round(util, 4),
+        "hbm_ingest_bw_util_best": round(util_best, 4),
         "device_bytes_per_sec": round(landed_bw, 1),
         "attainable_pytree_bytes_per_sec": round(attain_pytree, 1),
         "attainable_contiguous_bytes_per_sec": round(attain_contig, 1),
@@ -217,8 +271,10 @@ def main() -> None:
                          "overlap even on small hosts; 0 = one per core)")
     ap.add_argument("--reps", type=int, default=5,
                     help="timed e2e repetitions; the median is reported")
-    ap.add_argument("--format", choices=("libsvm", "rec"), default="libsvm",
-                    help="headline lane: text parse or binary RecordIO")
+    ap.add_argument("--format", choices=("libsvm", "rec", "recd"),
+                    default="libsvm",
+                    help="headline lane: text parse, binary CSR row "
+                         "blocks, or zero-parse dense row matrices")
     ap.add_argument("--dense-dtype", choices=("bf16", "f32"), default="bf16",
                     help="dense device dtype (bf16 halves host+HBM bytes)")
     ap.add_argument("--no-scaling-table", action="store_true")
@@ -229,10 +285,12 @@ def main() -> None:
 
     rows = args.rows or (20000 if args.smoke else 200000)
     path = ensure_dataset(rows)
-    # the headline lane's own file: text for libsvm, converted for rec —
-    # every reported number (rows/s, MB/s, parse probe) uses this file
+    # the headline lane's own file: text for libsvm, converted for rec/recd
+    # — every reported number (rows/s, MB/s, parse probe) uses this file
     lane_fmt = args.format
-    lane_path = path if lane_fmt == "libsvm" else ensure_rec_dataset(rows)
+    lane_path = {"libsvm": lambda: path,
+                 "rec": lambda: ensure_rec_dataset(rows),
+                 "recd": lambda: ensure_drec_dataset(rows)}[lane_fmt]()
     size_mb = os.path.getsize(lane_path) / 1e6
 
     from dmlc_core_tpu.io.native import NativeParser
@@ -269,13 +327,14 @@ def main() -> None:
         rps = lane["rows_per_sec"]
         extras.update({
             "hbm_ingest_bw_util": lane["hbm_ingest_bw_util"],
+            "hbm_ingest_bw_util_best": lane["hbm_ingest_bw_util_best"],
             "device_bytes_per_sec": lane["device_bytes_per_sec"],
             "attainable_pytree_bytes_per_sec":
                 lane["attainable_pytree_bytes_per_sec"],
             "attainable_contiguous_bytes_per_sec":
                 lane["attainable_contiguous_bytes_per_sec"],
             "e2e_spread_rows_per_sec": lane["spread_rows_per_sec"],
-            "reps": args.reps,
+            "reps": lane["reps"],
             "ncores": os.cpu_count(),
         })
         # name the binding stage: with one host core the pipeline stages
@@ -300,20 +359,54 @@ def main() -> None:
                   f" MB/s) -> {extras['bottleneck']} on "
                   f"{os.cpu_count()} core(s)", file=sys.stderr)
 
-        # secondary lane: binary RecordIO ingest (north-star isolation)
+        # secondary lanes (north-star isolation): binary CSR row blocks and
+        # zero-parse dense row matrices
         if args.format == "libsvm" and not args.no_rec_lane:
-            rec_path = ensure_rec_dataset(rows)
-            rec = run_lane(rec_path, rows, "rec", args, mesh, consume)
-            extras["rec_lane"] = {
-                "rows_per_sec": round(rec["rows_per_sec"], 1),
-                "hbm_ingest_bw_util": rec["hbm_ingest_bw_util"],
-                "device_bytes_per_sec": rec["device_bytes_per_sec"],
-                "attainable_pytree_bytes_per_sec":
-                    rec["attainable_pytree_bytes_per_sec"],
-                "e2e_spread_rows_per_sec": rec["spread_rows_per_sec"],
-            }
-            print(f"# rec lane: {rec['rows_per_sec']:.0f} rows/s, bw-util "
-                  f"{rec['hbm_ingest_bw_util']:.1%}", file=sys.stderr)
+            # secondary lanes run in their OWN subprocess: a long-lived
+            # device session on the shared tunnel accumulates latency that
+            # crushes the short binary-ingest epochs; a fresh process
+            # measures each lane the way a real job would see it
+            import subprocess
+            for lane_name, ensure in (("rec_lane", ensure_rec_dataset),
+                                      ("recd_lane", ensure_drec_dataset)):
+                fmt2 = "rec" if lane_name == "rec_lane" else "recd"
+                ensure(rows)
+                try:
+                    out = subprocess.run(
+                        [sys.executable, os.path.abspath(__file__),
+                         f"--format={fmt2}", "--no-scaling-table",
+                         "--no-rec-lane", f"--rows={rows}",
+                         f"--batch-rows={args.batch_rows}",
+                         f"--threads={args.threads}", f"--reps={args.reps}",
+                         "--dense-dtype",
+                         "bf16" if args.dense_dtype == "bfloat16"
+                         else "f32"],
+                        capture_output=True, text=True, timeout=900)
+                except subprocess.TimeoutExpired:
+                    # a stalled child must not lose the headline result
+                    extras[lane_name] = {"error": "lane timed out (900s)"}
+                    continue
+                if out.returncode != 0:
+                    extras[lane_name] = {"error": (out.stderr or "")[-400:]}
+                    continue
+                child = json.loads(out.stdout.strip().splitlines()[-1])
+                ce = child["extras"]
+                extras[lane_name] = {
+                    "rows_per_sec": child["value"],
+                    "hbm_ingest_bw_util": ce["hbm_ingest_bw_util"],
+                    "hbm_ingest_bw_util_best":
+                        ce["hbm_ingest_bw_util_best"],
+                    "device_bytes_per_sec": ce["device_bytes_per_sec"],
+                    "attainable_pytree_bytes_per_sec":
+                        ce["attainable_pytree_bytes_per_sec"],
+                    "e2e_spread_rows_per_sec":
+                        ce["e2e_spread_rows_per_sec"],
+                    "reps": ce["reps"],
+                }
+                print(f"# {fmt2} lane: {child['value']:.0f} rows/s, "
+                      f"bw-util {ce['hbm_ingest_bw_util']:.1%} "
+                      f"(best {ce['hbm_ingest_bw_util_best']:.1%})",
+                      file=sys.stderr)
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")

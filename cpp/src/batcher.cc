@@ -4,6 +4,7 @@
 #include <cstring>
 
 #include "base.h"
+#include "bf16.h"
 
 namespace dct {
 
@@ -218,21 +219,9 @@ void PaddedBatcher::FillQid(int32_t* qid) {
 
 namespace {
 
-// float -> bfloat16 storage bits, round-to-nearest-even (the XLA/MXU
-// convention); NaN is quieted with the sign preserved.
-inline uint16_t Bf16Bits(float f) {
-  uint32_t u;
-  std::memcpy(&u, &f, sizeof(u));
-  if ((u & 0x7fffffffu) > 0x7f800000u) {
-    return static_cast<uint16_t>((u >> 16) | 0x0040u);
-  }
-  u += 0x7fffu + ((u >> 16) & 1u);
-  return static_cast<uint16_t>(u >> 16);
-}
-
 inline void StoreDense(float* xr, int32_t c, float v) { xr[c] = v; }
 inline void StoreDense(uint16_t* xr, int32_t c, float v) {
-  xr[c] = Bf16Bits(v);
+  xr[c] = Bf16FromFloat(v);
 }
 
 }  // namespace

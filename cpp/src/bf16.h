@@ -1,0 +1,32 @@
+// bfloat16 <-> float32 storage conversion (the XLA/MXU convention):
+// round-to-nearest-even on narrowing, NaN quieted with sign preserved.
+// Shared by the padded batcher's dense fill (batcher.cc) and the dense
+// RecordIO ingest lane (dense_rec.cc).
+#ifndef DCT_BF16_H_
+#define DCT_BF16_H_
+
+#include <cstdint>
+#include <cstring>
+
+namespace dct {
+
+inline uint16_t Bf16FromFloat(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, sizeof(u));
+  if ((u & 0x7fffffffu) > 0x7f800000u) {
+    return static_cast<uint16_t>((u >> 16) | 0x0040u);
+  }
+  u += 0x7fffu + ((u >> 16) & 1u);
+  return static_cast<uint16_t>(u >> 16);
+}
+
+inline float Bf16ToFloat(uint16_t b) {
+  uint32_t u = static_cast<uint32_t>(b) << 16;
+  float f;
+  std::memcpy(&f, &u, sizeof(f));
+  return f;
+}
+
+}  // namespace dct
+
+#endif  // DCT_BF16_H_

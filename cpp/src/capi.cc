@@ -12,6 +12,7 @@
 #include <string>
 
 #include "batcher.h"
+#include "dense_rec.h"
 #include "filesys.h"
 #include "hdfs_filesys.h"
 #include "input_split.h"
@@ -467,6 +468,51 @@ int dct_batcher_bytes_read(dct_batcher_t h, size_t* out) {
 
 int dct_batcher_free(dct_batcher_t h) {
   return Guard([&] { delete static_cast<dct::PaddedBatcher*>(h); });
+}
+
+// -------------------------------------------------------------- dense rec --
+// Zero-parse dense ingest (dense_rec.h): records carry [rows, F] matrices
+// in device layout, so fill is record framing + bulk memcpy.
+typedef void* dct_denserec_t;
+
+int dct_denserec_create(const char* uri, unsigned part, unsigned npart,
+                        uint64_t batch_rows, uint32_t num_shards,
+                        dct_denserec_t* out) {
+  return Guard([&] {
+    *out = new dct::DenseRecBatcher(uri, part, npart, batch_rows, num_shards);
+  });
+}
+
+int dct_denserec_meta(dct_denserec_t h, uint64_t* num_features,
+                      int32_t* x_dtype, int32_t* has_weight) {
+  return Guard([&] {
+    int dt = 0, hw = 0;
+    static_cast<dct::DenseRecBatcher*>(h)->Meta(num_features, &dt, &hw);
+    *x_dtype = dt;
+    *has_weight = hw;
+  });
+}
+
+int dct_denserec_fill(dct_denserec_t h, void* x, int32_t out_dtype,
+                      uint64_t x_features, float* label, float* weight,
+                      int32_t* nrows, uint64_t* take) {
+  return Guard([&] {
+    *take = static_cast<dct::DenseRecBatcher*>(h)->Fill(
+        x, out_dtype, x_features, label, weight, nrows);
+  });
+}
+
+int dct_denserec_before_first(dct_denserec_t h) {
+  return Guard([&] { static_cast<dct::DenseRecBatcher*>(h)->BeforeFirst(); });
+}
+
+int dct_denserec_bytes_read(dct_denserec_t h, size_t* out) {
+  return Guard(
+      [&] { *out = static_cast<dct::DenseRecBatcher*>(h)->BytesRead(); });
+}
+
+int dct_denserec_free(dct_denserec_t h) {
+  return Guard([&] { delete static_cast<dct::DenseRecBatcher*>(h); });
 }
 
 }  // extern "C"

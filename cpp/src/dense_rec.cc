@@ -1,0 +1,205 @@
+#include "dense_rec.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "base.h"
+#include "bf16.h"
+#include "recordio.h"
+#include "serializer.h"
+
+namespace dct {
+
+namespace {
+
+// little-endian f32 array -> host f32 (bulk memcpy on LE hosts)
+void CopyF32LE(float* dst, const char* src, uint64_t n) {
+  std::memcpy(dst, src, n * sizeof(float));
+  if (!serial::NativeIsLE()) {
+    uint32_t u;
+    for (uint64_t i = 0; i < n; ++i) {
+      std::memcpy(&u, dst + i, 4);
+      u = serial::ByteSwap(u);
+      std::memcpy(dst + i, &u, 4);
+    }
+  }
+}
+
+// disk x rows -> out buffer, converting dtype when needed.
+// dtypes: 0 = f32, 1 = bf16 (uint16 storage). Elements are LE on disk.
+void CopyX(void* dst, int out_dtype, const char* src, int disk_dtype,
+           uint64_t count) {
+  const bool swap = !serial::NativeIsLE();
+  if (out_dtype == disk_dtype && !swap) {
+    std::memcpy(dst, src, count * (disk_dtype == 1 ? 2 : 4));
+    return;
+  }
+  if (disk_dtype == 1) {
+    const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+    if (out_dtype == 1) {
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      for (uint64_t i = 0; i < count; ++i) {
+        d[i] = swap ? serial::ByteSwap(s[i]) : s[i];
+      }
+    } else {
+      float* d = static_cast<float*>(dst);
+      for (uint64_t i = 0; i < count; ++i) {
+        uint16_t v;
+        std::memcpy(&v, s + i, 2);
+        if (swap) v = serial::ByteSwap(v);
+        d[i] = Bf16ToFloat(v);
+      }
+    }
+  } else {
+    const char* s = src;
+    for (uint64_t i = 0; i < count; ++i, s += 4) {
+      uint32_t u;
+      std::memcpy(&u, s, 4);
+      if (swap) u = serial::ByteSwap(u);
+      float f;
+      std::memcpy(&f, &u, 4);
+      if (out_dtype == 1) {
+        static_cast<uint16_t*>(dst)[i] = Bf16FromFloat(f);
+      } else {
+        static_cast<float*>(dst)[i] = f;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+DenseRecBatcher::DenseRecBatcher(const std::string& uri, unsigned part,
+                                 unsigned npart, uint64_t batch_rows,
+                                 uint32_t num_shards)
+    : batch_rows_(batch_rows), num_shards_(num_shards) {
+  DCT_CHECK(num_shards_ > 0) << "num_shards must be positive";
+  DCT_CHECK(batch_rows_ > 0 && batch_rows_ % num_shards_ == 0)
+      << "batch_rows=" << batch_rows_ << " must divide by shards="
+      << num_shards_;
+  URISpec spec(uri, part, npart);
+  split_.reset(InputSplit::Create(spec.uri, part, npart, "recordio", "",
+                                  false, 0, 256, false, /*threaded=*/true,
+                                  spec.cache_file));
+}
+
+bool DenseRecBatcher::AdvanceRecord() {
+  InputSplit::Blob b;
+  if (!split_->NextRecord(&b)) {
+    eof_ = true;
+    have_record_ = false;
+    return false;
+  }
+  bytes_read_ += b.size;
+  DCT_CHECK(b.size >= 16) << "dense rec record too short for its header";
+  const char* p = static_cast<const char*>(b.dptr);
+  DCT_CHECK(recordio::LoadWordLE(p) == kDenseRecMagic)
+      << "not a dense row-matrix record (bad payload magic); .drec files "
+         "are written by rows_to_dense_recordio (dmlc_core_tpu/io/"
+         "convert.py)";
+  const uint32_t flags = recordio::LoadWordLE(p + 4);
+  rec_rows_ = recordio::LoadWordLE(p + 8);
+  const uint32_t F = recordio::LoadWordLE(p + 12);
+  const int dtype = static_cast<int>(flags & 1u);
+  const int hw = static_cast<int>((flags >> 1) & 1u);
+  if (x_dtype_ < 0) {
+    num_features_ = F;
+    x_dtype_ = dtype;
+    has_weight_ = hw;
+  } else {
+    DCT_CHECK(F == num_features_ && dtype == x_dtype_ && hw == has_weight_)
+        << "dense rec record shape drift: got F=" << F << " dtype=" << dtype
+        << " weights=" << hw << ", pinned F=" << num_features_
+        << " dtype=" << x_dtype_ << " weights=" << has_weight_;
+  }
+  const uint64_t esz = dtype == 1 ? 2 : 4;
+  const uint64_t need = 16 + rec_rows_ * 4 + (hw ? rec_rows_ * 4 : 0) +
+                        rec_rows_ * num_features_ * esz;
+  DCT_CHECK(b.size >= need)
+      << "truncated dense rec record: " << b.size << " bytes for "
+      << rec_rows_ << "x" << num_features_ << " payload (need " << need
+      << ")";
+  labels_ = p + 16;
+  weights_ = hw ? labels_ + rec_rows_ * 4 : nullptr;
+  x_ = (hw ? weights_ : labels_) + rec_rows_ * 4;
+  row_in_rec_ = 0;
+  have_record_ = true;
+  return true;
+}
+
+void DenseRecBatcher::Peek() {
+  if (x_dtype_ < 0 && !eof_) {
+    AdvanceRecord();
+  }
+}
+
+void DenseRecBatcher::Meta(uint64_t* num_features, int* x_dtype,
+                           int* has_weight) {
+  Peek();
+  DCT_CHECK(x_dtype_ >= 0)
+      << "dense rec source is empty; cannot determine the batch shape";
+  *num_features = num_features_;
+  *x_dtype = x_dtype_;
+  *has_weight = has_weight_;
+}
+
+uint64_t DenseRecBatcher::Fill(void* x, int out_dtype, uint64_t x_features,
+                               float* label, float* weight, int32_t* nrows) {
+  DCT_CHECK(out_dtype == 0 || out_dtype == 1)
+      << "dense x dtype must be 0 (float32) or 1 (bfloat16), got "
+      << out_dtype;
+  Peek();
+  DCT_CHECK(x_dtype_ < 0 || x_features == num_features_)
+      << "x buffer is " << x_features << " features wide but the dense rec "
+      << "file carries " << num_features_ << " (allocate via meta())";
+  const uint64_t F = num_features_;
+  const uint64_t out_esz = out_dtype == 1 ? 2 : 4;
+  const uint64_t disk_esz = x_dtype_ == 1 ? 2 : 4;
+  uint64_t filled = 0;
+  char* xb = static_cast<char*>(x);
+  while (filled < batch_rows_) {
+    if (!have_record_ || row_in_rec_ >= rec_rows_) {
+      if (eof_ || !AdvanceRecord()) break;
+      if (rec_rows_ == 0) continue;  // empty record: skip
+    }
+    const uint64_t n =
+        std::min(batch_rows_ - filled, rec_rows_ - row_in_rec_);
+    CopyF32LE(label + filled, labels_ + row_in_rec_ * 4, n);
+    if (weights_ != nullptr) {
+      CopyF32LE(weight + filled, weights_ + row_in_rec_ * 4, n);
+    } else {
+      for (uint64_t i = 0; i < n; ++i) weight[filled + i] = 1.0f;
+    }
+    CopyX(xb + filled * F * out_esz, out_dtype,
+          x_ + row_in_rec_ * F * disk_esz, x_dtype_, n * F);
+    filled += n;
+    row_in_rec_ += n;
+  }
+  if (filled == 0) return 0;
+  // zero-pad the tail: weight 0 drops padding rows out of any loss
+  if (filled < batch_rows_) {
+    const uint64_t pad = batch_rows_ - filled;
+    std::memset(label + filled, 0, pad * sizeof(float));
+    std::memset(weight + filled, 0, pad * sizeof(float));
+    std::memset(xb + filled * F * out_esz, 0, pad * F * out_esz);
+  }
+  const uint64_t R = batch_rows_ / num_shards_;
+  for (uint32_t d = 0; d < num_shards_; ++d) {
+    const int64_t left = static_cast<int64_t>(filled) - d * R;
+    nrows[d] = static_cast<int32_t>(
+        std::max<int64_t>(0, std::min<int64_t>(left, R)));
+  }
+  return filled;
+}
+
+void DenseRecBatcher::BeforeFirst() {
+  split_->BeforeFirst();
+  eof_ = false;
+  have_record_ = false;
+  row_in_rec_ = 0;
+  rec_rows_ = 0;
+  // num_features_/x_dtype_/has_weight_ deliberately survive: device shapes
+  // must stay static across epochs
+}
+
+}  // namespace dct

@@ -1,0 +1,91 @@
+// Dense binary ingest: RecordIO records carrying row MATRICES in device
+// layout — the zero-parse lane of the TPU pipeline.
+//
+// The CSR "rec" lane (parser.h RecParser) still pays deserialize + batcher
+// accumulation + dense scatter per row. For dense datasets (HIGGS-like
+// low-dimensional tabular data, the BASELINE.md north-star workload) the
+// device batch is a [rows, F] matrix; storing exactly that on disk —
+// bf16-capable, so the bytes on disk ARE the bytes the TPU wants — reduces
+// ingest to record framing + one memcpy per batch row-range. This is the
+// logical continuation of the reference's pre-baked .rec datasets
+// (reference test/README.md ilsvrc12 val.rec), re-designed for the MXU's
+// preferred layout instead of opaque image payloads.
+//
+// Record layout (little-endian on disk; written by
+// dmlc_core_tpu/io/convert.py rows_to_dense_recordio):
+//   [u32 'DRD1'][u32 flags: bit0 x is bf16, bit1 weights present]
+//   [u32 n_rows][u32 n_features]
+//   label   f32[n_rows]
+//   weight  f32[n_rows]                  (only when flags bit1)
+//   x       dtype[n_rows * n_features]   row-major
+//
+// Byte-range partitioning, shuffling, caching and prefetch all come from
+// the RecordIO InputSplit machinery (input_split.h), so this lane keeps
+// the full distributed-read contract.
+#ifndef DCT_DENSE_REC_H_
+#define DCT_DENSE_REC_H_
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "input_split.h"
+
+namespace dct {
+
+constexpr uint32_t kDenseRecMagic = 0x44524431;  // 'DRD1'
+
+class DenseRecBatcher {
+ public:
+  // batch_rows must divide by num_shards (device-axis reshape contract,
+  // same as PaddedBatcher).
+  DenseRecBatcher(const std::string& uri, unsigned part, unsigned npart,
+                  uint64_t batch_rows, uint32_t num_shards);
+
+  // Static shape discovered from the first record (valid before any Fill):
+  // x_dtype 0 = float32, 1 = bfloat16; has_weight 1 when records carry
+  // per-row weights.
+  void Meta(uint64_t* num_features, int* x_dtype, int* has_weight);
+
+  // Fill one batch into caller buffers: x is [batch_rows, x_features] in
+  // out_dtype (0 = float32, 1 = bfloat16; converted from the disk dtype
+  // when they differ, memcpy when equal), label/weight are [batch_rows]
+  // f32 (weight 1.0 when the file has none), nrows is [num_shards].
+  // x_features must equal the file's feature width (checked — the fill
+  // writes x_features elements per row, so a mismatch would corrupt the
+  // caller's heap). The tail of a final partial batch is zero-padded with
+  // weight 0. Returns the true row count (<= batch_rows); 0 at end.
+  uint64_t Fill(void* x, int out_dtype, uint64_t x_features, float* label,
+                float* weight, int32_t* nrows);
+
+  void BeforeFirst();
+  size_t BytesRead() const { return bytes_read_; }
+
+ private:
+  bool AdvanceRecord();  // load + validate the next record; false at end
+  void Peek();           // ensure the first record's header is parsed
+
+  std::unique_ptr<InputSplit> split_;
+  const uint64_t batch_rows_;
+  const uint32_t num_shards_;
+
+  // current record view (valid until the next NextRecord on split_)
+  const char* labels_ = nullptr;
+  const char* weights_ = nullptr;
+  const char* x_ = nullptr;
+  uint64_t rec_rows_ = 0;
+  uint64_t row_in_rec_ = 0;
+
+  // pinned static shape (first record wins; later mismatches throw)
+  uint64_t num_features_ = 0;
+  int x_dtype_ = -1;
+  int has_weight_ = -1;
+
+  bool eof_ = false;
+  bool have_record_ = false;
+  size_t bytes_read_ = 0;
+};
+
+}  // namespace dct
+
+#endif  // DCT_DENSE_REC_H_

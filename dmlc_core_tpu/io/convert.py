@@ -19,11 +19,13 @@ import struct
 import numpy as np
 
 from dmlc_core_tpu.base import DMLCError
-from dmlc_core_tpu.io.native import NativeParser, NativeRecordIOWriter
+from dmlc_core_tpu.io.native import (NativeParser, NativeRecordIOWriter,
+                                     _bf16_dtype)
 
-__all__ = ["rows_to_recordio"]
+__all__ = ["rows_to_recordio", "rows_to_dense_recordio"]
 
-_REC_MAGIC = 0x44524231  # 'DRB1'
+_REC_MAGIC = 0x44524231       # 'DRB1' (CSR row blocks)
+_DENSE_REC_MAGIC = 0x44524431  # 'DRD1' (dense row matrices)
 
 
 def _vec(arr, dtype) -> bytes:
@@ -69,6 +71,99 @@ def _serialize_rows(block, r0: int, r1: int, index64: bool) -> bytes:
         struct.pack("<iQI", value_dtype, max_index, max_field),
     ]
     return b"".join(parts)
+
+
+def rows_to_dense_recordio(src_uri: str, dst_uri: str, fmt: str = "auto",
+                           rows_per_record: int = 4096,
+                           dtype: str = "bf16",
+                           num_features: int = 0,
+                           part: int = 0, npart: int = 1,
+                           nthread: int = 0) -> int:
+    """Parse `src_uri` and write DENSE row-matrix records (cpp/src/
+    dense_rec.h layout) to `dst_uri`; returns the number of rows.
+
+    The zero-parse ingest lane: each record stores label[] (+weight[] when
+    the source carries weights) and the [rows, F] feature matrix in device
+    layout — bf16 by default, so the bytes on disk are the bytes the MXU
+    wants and ingest is framing + memcpy. Dense-only by design: qid/field
+    data has no dense plane (use rows_to_recordio for those).
+
+    num_features=0 pre-scans the source once for the global max feature id
+    (the matrix width must be uniform across records)."""
+    if rows_per_record <= 0:
+        raise DMLCError("rows_per_record must be positive")
+    if dtype in ("bf16", "bfloat16"):
+        np_dtype, flag_bf16 = _bf16_dtype(), 1
+    elif np.dtype(dtype) == np.float32:
+        np_dtype, flag_bf16 = np.float32, 0
+    else:
+        raise DMLCError(f"dense rec dtype must be bf16 or float32, "
+                        f"got {dtype!r}")
+    if num_features <= 0:
+        num_features = 0
+        with NativeParser(src_uri, part=part, npart=npart, fmt=fmt,
+                          nthread=nthread) as p:
+            for b in p:
+                num_features = max(num_features, int(b.max_index) + 1)
+        if num_features == 0:
+            num_features = 1
+    F = num_features
+
+    total = 0
+    has_weight = None  # pinned on the first block (uniform records)
+    with NativeParser(src_uri, part=part, npart=npart, fmt=fmt,
+                      nthread=nthread) as p, \
+            NativeRecordIOWriter(dst_uri) as w:
+        for block in p:
+            if block.qid is not None or block.field is not None:
+                raise DMLCError(
+                    "qid/field columns have no dense representation; use "
+                    "rows_to_recordio for ranking/FM data")
+            if has_weight is None:
+                has_weight = block.weight is not None
+            elif has_weight != (block.weight is not None):
+                raise DMLCError(
+                    "weight column appeared in some rows only; dense rec "
+                    "records must be uniform")
+            n = block.num_rows
+            if int(block.max_index) + 1 > F:
+                raise DMLCError(
+                    f"feature index {int(block.max_index)} exceeds the "
+                    f"dense width {F}; pass a larger num_features")
+            lens = np.diff(block.offset).astype(np.int64)
+            row_of = np.repeat(np.arange(n, dtype=np.int64), lens)
+            vals = (block.value if block.value is not None
+                    else np.ones(block.nnz, np.float32))
+            for r0 in range(0, n, rows_per_record):
+                r1 = min(r0 + rows_per_record, n)
+                lo, hi = int(block.offset[r0]), int(block.offset[r1])
+                x = np.zeros((r1 - r0, F), dtype=np_dtype)
+                x[row_of[lo:hi] - r0, block.index[lo:hi]] = vals[lo:hi]
+                parts = [struct.pack("<IIII", _DENSE_REC_MAGIC,
+                                     flag_bf16 | (2 if has_weight else 0),
+                                     r1 - r0, F),
+                         np.ascontiguousarray(
+                             block.label[r0:r1],
+                             dtype=np.dtype(np.float32).newbyteorder("<"))
+                         .tobytes()]
+                if has_weight:
+                    parts.append(np.ascontiguousarray(
+                        block.weight[r0:r1],
+                        dtype=np.dtype(np.float32).newbyteorder("<"))
+                        .tobytes())
+                # x elements are little-endian on disk (dense_rec.h):
+                # bf16 has no numpy byteorder variant, so swap via the
+                # uint16 storage view; f32 goes through '<f4'
+                if flag_bf16:
+                    parts.append(x.view(np.uint16)
+                                 .astype(np.dtype("<u2"), copy=False)
+                                 .tobytes())
+                else:
+                    parts.append(x.astype(np.dtype("<f4"), copy=False)
+                                 .tobytes())
+                w.write_record(b"".join(parts))
+            total += n
+    return total
 
 
 def rows_to_recordio(src_uri: str, dst_uri: str, fmt: str = "auto",

@@ -136,6 +136,15 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_batcher_before_first": [vp],
         "dct_batcher_bytes_read": [vp, c.POINTER(sz)],
         "dct_batcher_free": [vp],
+        "dct_denserec_create": [c.c_char_p, u, u, c.c_uint64, c.c_uint32,
+                                c.POINTER(vp)],
+        "dct_denserec_meta": [vp, c.POINTER(c.c_uint64),
+                              c.POINTER(c.c_int32), c.POINTER(c.c_int32)],
+        "dct_denserec_fill": [vp, vp, c.c_int32, c.c_uint64, vp, vp, vp,
+                              c.POINTER(c.c_uint64)],
+        "dct_denserec_before_first": [vp],
+        "dct_denserec_bytes_read": [vp, c.POINTER(sz)],
+        "dct_denserec_free": [vp],
     }
     for name, argtypes in sigs.items():
         fn = getattr(cdll, name)
@@ -655,6 +664,85 @@ class NativeBatcher:
         """Free the native batcher handle (idempotent)."""
         if self._h:
             _check(lib().dct_batcher_free(self._h))
+            self._h = ctypes.c_void_p()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# -- dense rec ----------------------------------------------------------------
+class NativeDenseRecBatcher:
+    """Zero-parse dense ingest (cpp/src/dense_rec.h): records store row
+    matrices in device layout, so a batch fill is record framing + bulk
+    memcpy with the GIL released. meta() reports the static shape; fill()
+    writes caller buffers and returns the true row count (0 at end)."""
+
+    def __init__(self, uri: str, part: int = 0, npart: int = 1,
+                 batch_rows: int = 65536, num_shards: int = 1):
+        self._h = ctypes.c_void_p()
+        self._batch_rows = batch_rows
+        self._num_shards = num_shards
+        _check(lib().dct_denserec_create(uri.encode(), part, npart,
+                                         batch_rows, num_shards,
+                                         ctypes.byref(self._h)))
+
+    def meta(self):
+        """(num_features, x_dtype, has_weight) pinned by the first record;
+        x_dtype 0 = float32, 1 = bfloat16."""
+        F = ctypes.c_uint64()
+        dt = ctypes.c_int32()
+        hw = ctypes.c_int32()
+        _check(lib().dct_denserec_meta(self._h, ctypes.byref(F),
+                                       ctypes.byref(dt), ctypes.byref(hw)))
+        return F.value, dt.value, bool(hw.value)
+
+    def fill(self, x: np.ndarray, label: np.ndarray, weight: np.ndarray,
+             nrows: np.ndarray) -> int:
+        """Fill one batch; returns the true row count (0 = end of data).
+        x dtype selects the output storage (float32 or bfloat16)."""
+        if x.dtype == np.float32:
+            out_dtype = 0
+        elif x.dtype == _bf16_dtype():
+            out_dtype = 1
+        else:
+            raise DMLCError(
+                f"dense fill dtype must be float32 or bfloat16, "
+                f"got {x.dtype}")
+        F = x.shape[-1]
+        take = ctypes.c_uint64()
+        _check(lib().dct_denserec_fill(
+            self._h,
+            NativeBatcher._ptr(x, x.dtype, self._batch_rows * F), out_dtype,
+            F,  # checked natively against the file's feature width
+            NativeBatcher._ptr(label, np.float32, self._batch_rows),
+            NativeBatcher._ptr(weight, np.float32, self._batch_rows),
+            NativeBatcher._ptr(nrows, np.int32, self._num_shards),
+            ctypes.byref(take)))
+        return int(take.value)
+
+    def before_first(self) -> None:
+        """Restart from the first record (new epoch)."""
+        _check(lib().dct_denserec_before_first(self._h))
+
+    def bytes_read(self) -> int:
+        """Record bytes consumed from the source so far."""
+        out = ctypes.c_size_t()
+        _check(lib().dct_denserec_bytes_read(self._h, ctypes.byref(out)))
+        return out.value
+
+    def close(self) -> None:
+        """Free the native handle (idempotent)."""
+        if self._h:
+            _check(lib().dct_denserec_free(self._h))
             self._h = ctypes.c_void_p()
 
     def __enter__(self):

@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 
 from dmlc_core_tpu.base import DMLCError, log_info
-from dmlc_core_tpu.io.native import NativeBatcher, NativeParser, _bf16_dtype
+from dmlc_core_tpu.io.native import (NativeBatcher, NativeDenseRecBatcher,
+                                     NativeParser, _bf16_dtype)
 from dmlc_core_tpu.tpu.sharding import batch_sharding, data_mesh
 
 
@@ -57,7 +58,7 @@ def _dense_dtype_of(d) -> np.dtype:
     return dt
 
 __all__ = ["PaddedBatch", "DenseBatch", "DeviceRowBlockIter", "HostBatcher",
-           "NativeHostBatcher"]
+           "NativeHostBatcher", "DenseRecHostBatcher"]
 
 
 @dataclass
@@ -143,6 +144,32 @@ def _next_pow2(n: int, floor: int) -> int:
     while b < n:
         b <<= 1
     return b
+
+
+class _HostBufferPool:
+    """Shape-keyed free-list of host batch buffers shared by the batcher
+    implementations: avoids per-batch allocate + page-fault churn on the
+    staging thread. Buffers enter via put() only after the host->device
+    copy has completed and only when device arrays cannot alias host
+    memory (DeviceRowBlockIter's transfer-thread contract); bounded per
+    key so idle memory stays small."""
+
+    CAP = 4  # per shape key; covers the prefetch depth
+
+    def __init__(self):
+        self._pool: Dict[Any, list] = {}
+        self._lock = threading.Lock()
+
+    def pop(self, key):
+        with self._lock:
+            lst = self._pool.get(key)
+            return lst.pop() if lst else None
+
+    def put(self, key, arrs) -> None:
+        with self._lock:
+            lst = self._pool.setdefault(key, [])
+            if len(lst) < self.CAP:
+                lst.append(arrs)
 
 
 class HostBatcher:
@@ -399,13 +426,8 @@ class NativeHostBatcher:
         # structure (and therefore jitted consumers' traces) stays static
         self._emit_qid: Optional[bool] = None
         self._emit_field: Optional[bool] = None
-        # recycled host buffers, keyed by batch shape: avoids the per-batch
-        # allocate + page-fault churn on the staging thread. Buffers come
-        # back via recycle() once the host->HBM copy has completed
-        # (DeviceRowBlockIter's transfer thread) — never while the device
-        # could still read them.
-        self._pool: Dict[Any, list] = {}
-        self._pool_lock = threading.Lock()
+        # recycled host buffers (see _HostBufferPool contract)
+        self._pool = _HostBufferPool()
 
     def next_batch(self):
         """Produce the next static-shape batch of host numpy arrays (None at
@@ -482,12 +504,8 @@ class NativeHostBatcher:
                            field=field)
 
     # -- host-buffer recycling ---------------------------------------------
-    _POOL_CAP = 4  # per shape key; bounds idle memory, covers the prefetch
-
     def _pool_pop(self, key):
-        with self._pool_lock:
-            lst = self._pool.get(key)
-            return lst.pop() if lst else None
+        return self._pool.pop(key)
 
     def recycle(self, batch) -> None:
         """Return a consumed host batch's buffers for reuse.
@@ -510,10 +528,7 @@ class NativeHostBatcher:
                     batch.label.reshape(-1), batch.weight.reshape(-1),
                     batch.nrows, None if batch.qid is None
                     else batch.qid.reshape(-1), batch.field)
-        with self._pool_lock:
-            lst = self._pool.setdefault(key, [])
-            if len(lst) < self._POOL_CAP:
-                lst.append(arrs)
+        self._pool.put(key, arrs)
 
     def reset(self) -> None:
         """Restart batching from the first row (new epoch); the recycle pool
@@ -526,6 +541,79 @@ class NativeHostBatcher:
 
     def close(self) -> None:
         """Free the native batcher handle (idempotent)."""
+        self._b.close()
+
+
+class DenseRecHostBatcher:
+    """Host batcher over the zero-parse dense lane (cpp/src/dense_rec.h):
+    records store [rows, F] matrices in device layout, so next_batch() is
+    record framing + bulk memcpy into (pooled) numpy buffers. Emits the
+    same DenseBatch the dense text path produces — downstream consumers
+    cannot tell the lanes apart."""
+
+    def __init__(self, uri: str, part: int = 0, npart: int = 1,
+                 batch_rows: int = 65536, num_shards: int = 1,
+                 dense_dtype=np.float32):
+        if batch_rows % num_shards != 0:
+            raise DMLCError(
+                f"batch_rows={batch_rows} must divide by shards="
+                f"{num_shards}")
+        self._b = NativeDenseRecBatcher(uri, part=part, npart=npart,
+                                        batch_rows=batch_rows,
+                                        num_shards=num_shards)
+        self.batch_rows = batch_rows
+        self.num_shards = num_shards
+        self.dense_dtype = _dense_dtype_of(dense_dtype)
+        self._F: Optional[int] = None
+        self._pool = _HostBufferPool()
+
+    def recycle(self, batch) -> None:
+        """Return a consumed host batch's buffers for reuse (same contract
+        as NativeHostBatcher.recycle: only after the host->device copy has
+        finished and only when device arrays cannot alias host memory)."""
+        if not isinstance(batch, DenseBatch) or \
+                batch.x.dtype != self.dense_dtype:
+            return
+        self._pool.put(("drec", batch.x.shape[-1]),
+                       (batch.x.reshape(self.batch_rows, -1),
+                        batch.label.reshape(-1), batch.weight.reshape(-1),
+                        batch.nrows))
+
+    def next_batch(self) -> Optional[DenseBatch]:
+        """Next static-shape DenseBatch of host numpy arrays (None at
+        end); the fill is one GIL-released native pass."""
+        if self._F is None:
+            self._F, _, _ = self._b.meta()
+            self._F = max(int(self._F), 1)
+        F = self._F
+        D = self.num_shards
+        R = self.batch_rows // D
+        pooled = self._pool.pop(("drec", F))
+        if pooled is not None:
+            x, label, weight, nrows = pooled
+        else:
+            x = np.empty((self.batch_rows, F), self.dense_dtype)
+            label = np.empty(self.batch_rows, np.float32)
+            weight = np.empty(self.batch_rows, np.float32)
+            nrows = np.empty(D, np.int32)
+        take = self._b.fill(x, label, weight, nrows)
+        if take == 0:
+            return None
+        return DenseBatch(x=x.reshape(D, R, F),
+                          label=label.reshape(D, R),
+                          weight=weight.reshape(D, R), nrows=nrows,
+                          total_rows=int(take))
+
+    def reset(self) -> None:
+        """Restart from the first record (new epoch); the pool survives."""
+        self._b.before_first()
+
+    def bytes_read(self) -> int:
+        """Record bytes consumed from the source so far."""
+        return self._b.bytes_read()
+
+    def close(self) -> None:
+        """Free the native handle (idempotent)."""
         self._b.close()
 
 
@@ -549,7 +637,17 @@ class DeviceRowBlockIter:
         self.mesh = mesh
         self.to_device = to_device
         num_shards = 1 if mesh is None else int(mesh.devices.size)
-        if index64:
+        if fmt == "auto" and uri.split("?", 1)[0].split("#", 1)[0] \
+                .endswith(".drec"):
+            fmt = "recd"  # dense row-matrix records are self-identifying
+        if fmt == "recd":
+            # zero-parse dense lane: records already hold device-layout
+            # matrices (dense_rec.h); CSR options don't apply
+            self.parser = None
+            self.batcher = DenseRecHostBatcher(
+                uri, part=part, npart=npart, batch_rows=batch_rows,
+                num_shards=num_shards, dense_dtype=dense_dtype)
+        elif index64:
             # 64-bit parse width; the int32 device layout is still the hard
             # contract — the numpy batcher raises on any id >= 2^31
             # (_block_to_parts guard) instead of wrapping silently
@@ -638,11 +736,15 @@ class DeviceRowBlockIter:
                     return
                 if recycle_ok and item is not host:
                     # recycle lags one batch so successive device_puts stay
-                    # back-to-back: dispatch batch k, then wait on batch
-                    # k-1's DMA and hand its host buffers back
-                    if pending is not None:
-                        jax.block_until_ready(
-                            list(pending[1].tree().values()))
+                    # back-to-back: dispatch batch k, then — only if batch
+                    # k-1's DMA has ALREADY landed (non-blocking check; a
+                    # blocking wait here would stall the pipeline for a
+                    # device round-trip per batch on high-latency links) —
+                    # hand its host buffers back; otherwise the buffers
+                    # just fall to the allocator
+                    if pending is not None and all(
+                            v.is_ready()
+                            for v in pending[1].tree().values()):
                         self.batcher.recycle(pending[0])
                     pending = (host, item)
         except BaseException as e:

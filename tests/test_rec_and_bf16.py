@@ -232,3 +232,134 @@ def test_index_below_limit_ok(tmp_path):
     assert batch is not None
     assert int(batch.col.max()) == 2 ** 31 - 1
     parser.close()
+
+
+# -- recd: zero-parse dense row-matrix lane ---------------------------------
+def write_dense_pair(tmp_path, rows=3000, features=14, weights=False,
+                     seed=6):
+    from dmlc_core_tpu.io.convert import rows_to_dense_recordio
+    rng = np.random.default_rng(seed)
+    src = tmp_path / "dd.libsvm"
+    lines = []
+    for i in range(rows):
+        w = f":{rng.uniform(0.5, 2):.3f}" if weights else ""
+        feats = " ".join(
+            f"{j}:{rng.uniform(-2, 2):.5f}" for j in range(features))
+        lines.append(f"{i % 2}{w} {feats}")
+    src.write_text("\n".join(lines) + "\n")
+    dst = tmp_path / "dd.drec"
+    n = rows_to_dense_recordio(str(src), str(dst), rows_per_record=256)
+    assert n == rows
+    return src, dst
+
+
+def batches_of(path, fmt="auto", dt="bf16", batch_rows=512, **kw):
+    out = []
+    with DeviceRowBlockIter(str(path), fmt=fmt, batch_rows=batch_rows,
+                            to_device=False, dense_dtype=dt, **kw) as it:
+        for b in it:
+            out.append(b)
+    return out
+
+def test_recd_matches_text_dense_lane(tmp_path):
+    src, dst = write_dense_pair(tmp_path)
+    a = batches_of(src)
+    b = batches_of(dst)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.total_rows == y.total_rows
+        assert np.array_equal(np.asarray(x.label), np.asarray(y.label))
+        assert np.array_equal(np.asarray(x.weight), np.asarray(y.weight))
+        assert np.array_equal(np.asarray(x.nrows), np.asarray(y.nrows))
+        # both lanes quantize to bf16: identical storage expected
+        assert np.array_equal(
+            np.asarray(x.x).view(np.uint16), np.asarray(y.x).view(np.uint16))
+
+
+def test_recd_weights_carried(tmp_path):
+    src, dst = write_dense_pair(tmp_path, rows=700, weights=True)
+    a = batches_of(src)
+    b = batches_of(dst)
+    for x, y in zip(a, b):
+        assert np.allclose(np.asarray(x.weight), np.asarray(y.weight))
+    # padding rows keep weight 0
+    assert float(np.asarray(b[-1].weight).reshape(-1)[-1]) == 0.0
+
+
+def test_recd_f32_output_from_bf16_disk(tmp_path):
+    _, dst = write_dense_pair(tmp_path, rows=600)
+    b = batches_of(dst, dt=np.float32)
+    assert all(np.asarray(x.x).dtype == np.float32 for x in b)
+    # bf16 -> f32 widening is exact: values representable in bf16
+    bb = batches_of(dst, dt="bf16")
+    for x, y in zip(b, bb):
+        assert np.array_equal(np.asarray(x.x),
+                              np.asarray(y.x).astype(np.float32))
+
+
+def test_recd_partitioned_exact_cover_and_epochs(tmp_path):
+    _, dst = write_dense_pair(tmp_path, rows=4000)
+    total = 0
+    for k in range(4):
+        total += sum(b.total_rows for b in batches_of(dst, part=k, npart=4))
+    assert total == 4000
+    # two epochs via before_first
+    from dmlc_core_tpu.tpu.device_iter import DenseRecHostBatcher
+    hb = DenseRecHostBatcher(str(dst), batch_rows=512, dense_dtype="bf16")
+    def epoch_rows():
+        n = 0
+        while True:
+            b = hb.next_batch()
+            if b is None:
+                return n
+            n += b.total_rows
+    assert epoch_rows() == 4000
+    hb.reset()
+    assert epoch_rows() == 4000
+    hb.close()
+
+
+def test_recd_rejects_qid_data(tmp_path):
+    from dmlc_core_tpu.io.convert import rows_to_dense_recordio
+    src = tmp_path / "q.libsvm"
+    src.write_text("1 qid:1 0:1.0\n0 qid:1 1:2.0\n")
+    with pytest.raises(DMLCError, match="dense representation"):
+        rows_to_dense_recordio(str(src), str(tmp_path / "q.drec"))
+
+
+def test_recd_rejects_foreign_records(tmp_path):
+    from dmlc_core_tpu.io.native import (NativeDenseRecBatcher,
+                                         NativeRecordIOWriter)
+    dst = tmp_path / "bad.drec"
+    with NativeRecordIOWriter(str(dst)) as w:
+        w.write_record(b"0123456789abcdef not a dense record")
+    b = NativeDenseRecBatcher(str(dst), batch_rows=64)
+    with pytest.raises(DMLCError, match="bad payload magic"):
+        b.meta()
+    b.close()
+
+
+def test_recd_truncated_record_raises(tmp_path):
+    import struct
+    from dmlc_core_tpu.io.native import (NativeDenseRecBatcher,
+                                         NativeRecordIOWriter)
+    dst = tmp_path / "trunc.drec"
+    with NativeRecordIOWriter(str(dst)) as w:
+        # claims 100 rows x 8 features but carries no payload
+        w.write_record(struct.pack("<IIII", 0x44524431, 1, 100, 8))
+    b = NativeDenseRecBatcher(str(dst), batch_rows=64)
+    with pytest.raises(DMLCError, match="truncated"):
+        b.meta()
+    b.close()
+
+
+def test_recd_recycle_pool(tmp_path):
+    _, dst = write_dense_pair(tmp_path, rows=2000)
+    from dmlc_core_tpu.tpu.device_iter import DenseRecHostBatcher
+    hb = DenseRecHostBatcher(str(dst), batch_rows=256, dense_dtype="bf16")
+    first = hb.next_batch()
+    ptr = first.x.base.__array_interface__["data"][0]
+    hb.recycle(first)
+    second = hb.next_batch()
+    assert second.x.base.__array_interface__["data"][0] == ptr
+    hb.close()
