@@ -2,10 +2,14 @@
 # on every push: .github/workflows/githubci.yml, scripts/test_script.sh).
 # `make ci` runs every lane; each lane is also callable alone.
 
-.PHONY: ci lint native-test tsan-test pytest bench-smoke dryrun doc clean
+.PHONY: ci lint native-test tsan-test asan-test pytest bench-smoke dryrun \
+        doc clean
 
-ci: lint native-test tsan-test pytest dryrun doc
+ci: lint native-test tsan-test asan-test pytest dryrun doc
 	@echo "== all CI lanes green =="
+
+asan-test:
+	$(MAKE) -C cpp asan-test
 
 lint:
 	python3 scripts/lint.py

@@ -97,7 +97,8 @@ def ensure_drec_dataset(rows: int) -> str:
     return path
 
 
-def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto"
+def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto",
+                       dense_dtype: str = "bfloat16"
                        ) -> "tuple[float, float]":
     """(rows/s, seconds) host-side throughput at a given worker count:
     parse for the text/rec lanes, batch assembly for the zero-parse dense
@@ -106,7 +107,7 @@ def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto"
     got = 0
     if fmt == "recd":
         from dmlc_core_tpu.tpu.device_iter import DenseRecHostBatcher
-        b = DenseRecHostBatcher(path, dense_dtype="bfloat16")
+        b = DenseRecHostBatcher(path, dense_dtype=dense_dtype)
         while True:
             batch = b.next_batch()
             if batch is None:
@@ -212,17 +213,18 @@ def run_lane(path, rows, fmt, args, mesh, consume):
     with DeviceRowBlockIter(path, fmt=fmt, batch_rows=args.batch_rows,
                             mesh=mesh, nthread=args.threads,
                             dense_dtype=args.dense_dtype) as it:
-        t0 = time.time()
         for batch in it:
             consume(batch.tree()).block_until_ready()
-        warm_dt = time.time() - t0
         sharding = it.sharding
         # fast lanes (binary ingest epochs run in tens of ms) need more
         # samples for a stable median: auto-scale toward ~1s of timed work
-        # (auto capped at 15; an explicit larger --reps is always honored)
-        reps = max(args.reps, min(15, int(0.75 / max(warm_dt, 1e-3))))
-        runs = []
-        for _ in range(reps):
+        # based on the FIRST STEADY epoch (the warm epoch includes compile
+        # and first-transfer costs and would never trigger the scale).
+        # Auto capped at 15; an explicit larger --reps is always honored.
+        it.before_first()
+        runs = [run_e2e_epoch(it, rows, consume)]
+        reps = max(args.reps, min(15, int(0.75 / max(runs[0][0], 1e-3))))
+        for _ in range(reps - 1):
             it.before_first()
             runs.append(run_e2e_epoch(it, rows, consume))
     dts = sorted(dt for dt, _ in runs)
@@ -300,7 +302,10 @@ def main() -> None:
         p.next_block()
 
     extras = {}
-    if not args.no_scaling_table:
+    if not args.no_scaling_table and lane_fmt != "recd":
+        # recd has no parse stage to thread-scale (ingest is framing +
+        # memcpy on one staging thread): the table would be three
+        # identical passes, so it is omitted for that lane
         extras["thread_scaling"] = {
             str(t): round(parse_rows_per_sec(lane_path, rows, t,
                                              fmt=lane_fmt)[0], 1)
@@ -308,7 +313,8 @@ def main() -> None:
 
     if args.parse_only:
         rps, dt = parse_rows_per_sec(lane_path, rows, args.threads,
-                                     fmt=lane_fmt)
+                                     fmt=lane_fmt,
+                                     dense_dtype=args.dense_dtype)
     else:
         import jax
         import jax.numpy as jnp
@@ -345,8 +351,9 @@ def main() -> None:
             if (os.cpu_count() or 1) <= 1:
                 extras["bottleneck"] = "host_cpu_serialized_single_core"
             else:
-                parse_rps, _ = parse_rows_per_sec(lane_path, rows,
-                                                  args.threads, fmt=lane_fmt)
+                parse_rps, _ = parse_rows_per_sec(
+                    lane_path, rows, args.threads, fmt=lane_fmt,
+                    dense_dtype=args.dense_dtype)
                 extras["bottleneck"] = ("host_parse"
                                         if rps >= 0.75 * parse_rps
                                         else "host_to_hbm_transfer")
@@ -420,7 +427,8 @@ def main() -> None:
         vs = round(rps / base["reference_rows_per_sec"], 3)
 
     print(f"# {rows} rows ({size_mb:.1f} MB {lane_fmt}) in {dt:.3f}s = "
-          f"{size_mb / dt:.1f} MB/s (median of {args.reps})", file=sys.stderr)
+          f"{size_mb / dt:.1f} MB/s (median of "
+          f"{extras.get('reps', args.reps)})", file=sys.stderr)
     print(json.dumps({
         "metric": f"higgs_{lane_fmt}_ingest_rows_per_sec",
         "value": round(rps, 1),

@@ -143,7 +143,18 @@ class PipelineIter {
             ++total_cells_;  // next_fn allocates into the null cell
           }
         }
-        bool more = next_fn_(&cell);
+        bool more;
+        try {
+          more = next_fn_(&cell);
+        } catch (...) {
+          // reclaim the in-flight cell (next_fn may have allocated into
+          // it before throwing) so Shutdown's free-list sweep deletes it
+          std::lock_guard<std::mutex> lock(mu_);
+          if (cell != nullptr) free_.push_back(cell);
+          error_ = std::current_exception();
+          cv_consumer_.notify_all();
+          return;
+        }
         {
           std::lock_guard<std::mutex> lock(mu_);
           if (more) {

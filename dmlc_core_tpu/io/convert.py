@@ -100,8 +100,10 @@ def rows_to_dense_recordio(src_uri: str, dst_uri: str, fmt: str = "auto",
         raise DMLCError(f"dense rec dtype must be bf16 or float32, "
                         f"got {dtype!r}")
     if num_features <= 0:
+        # the matrix width must be GLOBAL: prescan the whole source (not
+        # this part) so parallel part-wise conversions agree on F
         num_features = 0
-        with NativeParser(src_uri, part=part, npart=npart, fmt=fmt,
+        with NativeParser(src_uri, part=0, npart=1, fmt=fmt,
                           nthread=nthread) as p:
             for b in p:
                 num_features = max(num_features, int(b.max_index) + 1)

@@ -12,6 +12,7 @@ public symbol without a docstring fails the build (`make doc` in ci).
 import importlib
 import inspect
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -83,9 +84,13 @@ def first_paragraph(doc) -> str:
 
 def signature_of(obj) -> str:
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (TypeError, ValueError):
         return "(...)"
+    # non-literal defaults repr with memory addresses
+    # ("<function f at 0x7f...>"); sanitize so regeneration is
+    # deterministic and the doc lane stays churn-free
+    return re.sub(r"<([\w.]+)[^<>]* at 0x[0-9a-f]+>", r"<\1>", sig)
 
 
 def public_names(mod):
