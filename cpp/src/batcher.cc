@@ -21,88 +21,69 @@ PaddedBatcher::PaddedBatcher(Parser<uint32_t>* parser, uint64_t batch_rows,
 }
 
 void PaddedBatcher::Accumulate() {
-  while (AvailRows() < batch_rows_ && !done_) {
-    const RowBlockContainer<uint32_t>* b = parser_->NextBlock();
-    if (b == nullptr) {
+  while (avail_rows_ < batch_rows_ && !done_) {
+    Block b;
+    if (!spares_.empty()) {  // recycled capacity rides back to the parser
+      b = std::move(spares_.back());
+      spares_.pop_back();
+      b.Clear();
+    }
+    if (!parser_->NextBlockMove(&b)) {
       done_ = true;
       break;
     }
-    const size_t n = b->Size();
-    const size_t nnz = b->offset.back();
+    const size_t n = b.Size();
+    const size_t nnz = b.offset.back();
+    // Validation happens ON ARRIVAL, before the block joins the deque, so
+    // a caught error leaves the pending state consistent.
     // The device layout is int32: a feature id >= 2^31 would wrap negative
-    // in the bulk copy below and scatter to a wrong column — refuse loudly
-    // instead of corrupting silently (reference data.h:26-32 makes index
-    // width a first-class contract; the Python HostBatcher mirrors this).
-    // Checked BEFORE any insert so a caught error leaves the pending
-    // arrays consistent.
-    DCT_CHECK(b->max_index <= 0x7fffffffULL)
-        << "feature index " << b->max_index
+    // and scatter to a wrong column — refuse loudly instead of corrupting
+    // silently (reference data.h:26-32 makes index width a first-class
+    // contract; the Python HostBatcher mirrors this).
+    DCT_CHECK(b.max_index <= 0x7fffffffULL)
+        << "feature index " << b.max_index
         << " exceeds the int32 device layout (max 2147483647); remap "
            "feature ids below 2^31 for the TPU batch layout";
-    const size_t prev_rows = label_.size();  // pre-block counts for the
-    const size_t prev_nnz = val_.size();     // lazy qid_/field_ backfill
-    label_.insert(label_.end(), b->label.begin(), b->label.end());
-    if (b->weight.empty()) {
-      weight_.insert(weight_.end(), n, 1.0f);
-    } else {
-      weight_.insert(weight_.end(), b->weight.begin(), b->weight.end());
-    }
-    lens_.reserve(lens_.size() + n);
-    for (size_t i = 0; i < n; ++i) {
-      lens_.push_back(static_cast<int32_t>(b->offset[i + 1] - b->offset[i]));
-    }
-    // qid/field ride along in the int32 device layout. The side arrays stay
-    // EMPTY until the stream first carries the column (keeping the headline
-    // qid/field-free ingest path free of their fill+compact traffic); on
-    // first appearance earlier rows are backfilled with the sentinel.
-    // Rows from qid-less blocks get -1 (a value the uint64 parse can never
-    // produce) so they can't merge with a legitimate qid:0 group.
-    if (!b->qid.empty()) {
-      DCT_CHECK(b->qid.size() == n) << "ragged qid column in block";
-      have_qid_ = true;
-      qid_.resize(prev_rows, -1);  // no-op except on first appearance
-      qid_.reserve(prev_rows + n);
-      for (uint64_t q : b->qid) {
+    if (!b.qid.empty()) {
+      DCT_CHECK(b.qid.size() == n) << "ragged qid column in block";
+      for (uint64_t q : b.qid) {
         DCT_CHECK(q <= 0x7fffffffULL)
             << "qid " << q << " exceeds the int32 device layout";
-        qid_.push_back(static_cast<int32_t>(q));
       }
-    } else if (have_qid_) {
-      qid_.insert(qid_.end(), n, -1);
+      have_qid_ = true;
     }
-    if (!b->field.empty()) {
-      DCT_CHECK(b->field.size() == nnz) << "ragged field column in block";
+    if (!b.field.empty()) {
+      DCT_CHECK(b.field.size() == nnz) << "ragged field column in block";
       have_field_ = true;
-      field_.resize(prev_nnz, 0);  // no-op except on first appearance
-      // uint32 -> int32 bit-identical (same rationale as col above)
-      const size_t old = field_.size();
-      field_.resize(old + nnz);
-      std::memcpy(field_.data() + old, b->field.data(),
-                  nnz * sizeof(int32_t));
-    } else if (have_field_) {
-      field_.insert(field_.end(), nnz, 0);
     }
-    // uint32 -> int32 is bit-identical for ids < 2^31 (guarded at the top
-    // of this loop): bulk copy.
-    // Guard nnz == 0: data() may be null then and memcpy is nonnull-UB.
-    if (nnz != 0) {
-      const size_t col_old = col_.size();
-      col_.resize(col_old + nnz);
-      std::memcpy(col_.data() + col_old, b->index.data(),
-                  nnz * sizeof(int32_t));
-    }
-    val_.reserve(val_.size() + nnz);
-    if (b->value_dtype == 1) {
-      for (int32_t v : b->value_i32) val_.push_back(static_cast<float>(v));
-    } else if (b->value_dtype == 2) {
-      for (int64_t v : b->value_i64) val_.push_back(static_cast<float>(v));
-    } else if (b->value.empty()) {
-      val_.insert(val_.end(), nnz, 1.0f);  // implicit 1.0 (binary features)
-    } else {
-      val_.insert(val_.end(), b->value.begin(), b->value.end());
-    }
-    max_index_ = std::max(max_index_, b->max_index);
+    DCT_CHECK(b.weight.empty() || b.weight.size() == n)
+        << "ragged weight column in block";
+    max_index_ = std::max(max_index_, b.max_index);
+    avail_rows_ += n;
+    blocks_.push_back(std::move(b));
   }
+}
+
+template <typename Fn>
+void PaddedBatcher::ForEachRowRange(uint64_t skip, uint64_t count,
+                                    Fn&& fn) const {
+  // visit `count` staged rows starting `skip` rows past the cursor
+  uint64_t pos = row_in_front_ + skip;  // block-local start in walk order
+  uint64_t out_row = 0;
+  for (const Block& b : blocks_) {
+    if (count == 0) return;
+    const uint64_t n = b.Size();
+    if (pos >= n) {
+      pos -= n;
+      continue;
+    }
+    const uint64_t r1 = std::min<uint64_t>(n, pos + count);
+    fn(b, pos, r1, out_row);
+    out_row += r1 - pos;
+    count -= r1 - pos;
+    pos = 0;
+  }
+  DCT_CHECK(count == 0) << "row walk ran past the staged data";
 }
 
 bool PaddedBatcher::NextMeta(uint64_t* take, uint64_t* bucket,
@@ -110,26 +91,27 @@ bool PaddedBatcher::NextMeta(uint64_t* take, uint64_t* bucket,
                              int* has_field) {
   DCT_CHECK(!staged_) << "NextMeta called with an unconsumed staged batch";
   Accumulate();
-  const uint64_t avail = AvailRows();
-  if (avail == 0) return false;
-  take_ = std::min<uint64_t>(batch_rows_, avail);
+  if (avail_rows_ == 0) return false;
+  take_ = std::min<uint64_t>(batch_rows_, avail_rows_);
 
   // per-shard nnz -> bucket = next pow2 of the max, floored at min_bucket_
   const uint64_t R = batch_rows_ / num_shards_;
   uint64_t max_shard = 0;
   for (uint32_t d = 0; d < num_shards_; ++d) {
-    uint64_t shard_nnz = 0;
     const uint64_t lo = d * R;
     const uint64_t hi = std::min<uint64_t>((d + 1) * R, take_);
-    for (uint64_t r = lo; r < hi; ++r) {
-      shard_nnz += static_cast<uint64_t>(lens_[row_pos_ + r]);
-    }
+    if (lo >= hi) break;
+    uint64_t shard_nnz = 0;
+    ForEachRowRange(lo, hi - lo, [&](const Block& b, uint64_t r0,
+                                     uint64_t r1, uint64_t) {
+      shard_nnz += RowRangeNnz(b, r0, r1);
+    });
     max_shard = std::max(max_shard, shard_nnz);
   }
-  uint64_t b = min_bucket_;
-  while (b < max_shard) b <<= 1;
+  uint64_t bkt = min_bucket_;
+  while (bkt < max_shard) bkt <<= 1;
 
-  bucket_ = b;
+  bucket_ = bkt;
   staged_ = true;
   *take = take_;
   *bucket = bucket_;
@@ -141,8 +123,16 @@ bool PaddedBatcher::NextMeta(uint64_t* take, uint64_t* bucket,
 
 void PaddedBatcher::FillRowArrays(float* label, float* weight,
                                   int32_t* nrows) {
-  std::memcpy(label, label_.data() + row_pos_, take_ * sizeof(float));
-  std::memcpy(weight, weight_.data() + row_pos_, take_ * sizeof(float));
+  ForEachRowRange(0, take_, [&](const Block& b, uint64_t r0, uint64_t r1,
+                                uint64_t out) {
+    std::memcpy(label + out, b.label.data() + r0, (r1 - r0) * sizeof(float));
+    if (b.weight.empty()) {
+      std::fill(weight + out, weight + out + (r1 - r0), 1.0f);
+    } else {
+      std::memcpy(weight + out, b.weight.data() + r0,
+                  (r1 - r0) * sizeof(float));
+    }
+  });
   if (take_ < batch_rows_) {  // weight 0 ⇒ padding rows drop out of the loss
     std::memset(label + take_, 0, (batch_rows_ - take_) * sizeof(float));
     std::memset(weight + take_, 0, (batch_rows_ - take_) * sizeof(float));
@@ -160,33 +150,52 @@ void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
                             int32_t* qid, int32_t* field) {
   DCT_CHECK(staged_) << "FillCSR without a staged batch (call NextMeta)";
   const uint64_t R = batch_rows_ / num_shards_;
-  size_t p = nnz_pos_;
   for (uint32_t d = 0; d < num_shards_; ++d) {
     int32_t* rowd = row + d * bucket_;
     int32_t* cold = col + d * bucket_;
     float* vald = val + d * bucket_;
-    // fields may be requested for a stream that never carried them (field_
-    // stays empty then); emit all-zero planes instead of reading off-end
-    int32_t* fieldd = (field == nullptr || field_.empty())
-                          ? nullptr
-                          : field + d * bucket_;
-    if (field != nullptr && field_.empty()) {
-      std::memset(field + d * bucket_, 0, bucket_ * sizeof(int32_t));
-    }
+    int32_t* fieldd = field == nullptr ? nullptr : field + d * bucket_;
     uint64_t written = 0;
     const uint64_t lo = d * R;
     const uint64_t hi = std::min<uint64_t>((d + 1) * R, take_);
-    for (uint64_t r = lo; r < hi; ++r) {
-      const uint64_t l = static_cast<uint64_t>(lens_[row_pos_ + r]);
-      const int32_t local = static_cast<int32_t>(r - lo);
-      for (uint64_t k = 0; k < l; ++k) rowd[written + k] = local;
-      std::memcpy(cold + written, col_.data() + p, l * sizeof(int32_t));
-      std::memcpy(vald + written, val_.data() + p, l * sizeof(float));
-      if (fieldd != nullptr) {
-        std::memcpy(fieldd + written, field_.data() + p, l * sizeof(int32_t));
-      }
-      p += l;
-      written += l;
+    if (lo < hi) {
+      ForEachRowRange(lo, hi - lo, [&](const Block& b, uint64_t r0,
+                                       uint64_t r1, uint64_t out) {
+        const uint64_t p0 = b.offset[r0];
+        const uint64_t range_nnz = b.offset[r1] - p0;
+        if (range_nnz == 0) return;  // feature-less rows; data() may be
+        // null for empty vectors and memcpy is nonnull-UB
+        // per-nonzero local row segment ids; `out` already walks the
+        // shard-local row space (the walk starts at shard row lo == d*R)
+        for (uint64_t r = r0; r < r1; ++r) {
+          const int32_t local = static_cast<int32_t>(out + (r - r0));
+          const uint64_t l = b.offset[r + 1] - b.offset[r];
+          for (uint64_t k = 0; k < l; ++k) rowd[written + k] = local;
+          written += l;
+        }
+        written -= range_nnz;  // rewind; bulk copies advance it once below
+        // uint32 -> int32 is bit-identical for ids < 2^31 (guarded on
+        // arrival in Accumulate): bulk copy straight from the block
+        std::memcpy(cold + written, b.index.data() + p0,
+                    range_nnz * sizeof(int32_t));
+        if (b.value_dtype == 0 && !b.value.empty()) {
+          std::memcpy(vald + written, b.value.data() + p0,
+                      range_nnz * sizeof(float));
+        } else {
+          for (uint64_t k = 0; k < range_nnz; ++k) {
+            vald[written + k] = ValueAt(b, p0 + k);
+          }
+        }
+        if (fieldd != nullptr) {
+          if (b.field.empty()) {
+            std::memset(fieldd + written, 0, range_nnz * sizeof(int32_t));
+          } else {
+            std::memcpy(fieldd + written, b.field.data() + p0,
+                        range_nnz * sizeof(int32_t));
+          }
+        }
+        written += range_nnz;
+      });
     }
     // padding nonzeros land in the sacrificial segment id R, sliced off by
     // the segment ops (dmlc_core_tpu/ops/sparse.py)
@@ -205,15 +214,19 @@ void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
 }
 
 void PaddedBatcher::FillQid(int32_t* qid) {
-  // a caller may pass a buffer even when the stream never carried qid
-  // (qid_ stays empty then — the lazy scheme in Accumulate); emit the -1
-  // sentinel rather than memcpy from an empty vector. Padding rows get -1
-  // too (weight 0 already excludes them; -1 keeps them out of any grouping).
-  if (qid_.empty()) {
-    std::fill(qid, qid + batch_rows_, -1);
-    return;
-  }
-  std::memcpy(qid, qid_.data() + row_pos_, take_ * sizeof(int32_t));
+  // Rows from qid-less blocks get -1 (a value the uint64 parse can never
+  // produce) so they can't merge with a legitimate qid:0 group; padding
+  // rows get -1 too (weight 0 already excludes them from the loss).
+  ForEachRowRange(0, take_, [&](const Block& b, uint64_t r0, uint64_t r1,
+                                uint64_t out) {
+    if (b.qid.empty()) {
+      std::fill(qid + out, qid + out + (r1 - r0), -1);
+    } else {
+      for (uint64_t r = r0; r < r1; ++r) {
+        qid[out + (r - r0)] = static_cast<int32_t>(b.qid[r]);
+      }
+    }
+  });
   std::fill(qid + take_, qid + batch_rows_, -1);
 }
 
@@ -229,20 +242,20 @@ inline void StoreDense(uint16_t* xr, int32_t c, float v) {
 template <typename T>
 void PaddedBatcher::FillDenseT(T* x, uint64_t num_features) {
   std::memset(x, 0, batch_rows_ * num_features * sizeof(T));
-  size_t p = nnz_pos_;
-  for (uint64_t r = 0; r < take_; ++r) {
-    T* xr = x + r * num_features;
-    const uint64_t l = static_cast<uint64_t>(lens_[row_pos_ + r]);
-    for (uint64_t k = 0; k < l; ++k) {
-      const int32_t c = col_[p + k];
-      DCT_CHECK(static_cast<uint64_t>(c) < num_features)
-          << "dense layout fixed at " << num_features
-          << " features but saw index " << c
-          << "; pass layout='csr' or a larger dense_max_features";
-      StoreDense(xr, c, val_[p + k]);
+  ForEachRowRange(0, take_, [&](const Block& b, uint64_t r0, uint64_t r1,
+                                uint64_t out) {
+    for (uint64_t r = r0; r < r1; ++r) {
+      T* xr = x + (out + (r - r0)) * num_features;
+      for (uint64_t k = b.offset[r]; k < b.offset[r + 1]; ++k) {
+        const uint32_t c = b.index[k];
+        DCT_CHECK(static_cast<uint64_t>(c) < num_features)
+            << "dense layout fixed at " << num_features
+            << " features but saw index " << c
+            << "; pass layout='csr' or a larger dense_max_features";
+        StoreDense(xr, static_cast<int32_t>(c), ValueAt(b, k));
+      }
     }
-    p += l;
-  }
+  });
 }
 
 void PaddedBatcher::FillDense(void* x, int x_dtype, uint64_t num_features,
@@ -264,40 +277,31 @@ void PaddedBatcher::FillDense(void* x, int x_dtype, uint64_t num_features,
 }
 
 void PaddedBatcher::Consume() {
-  for (uint64_t r = 0; r < take_; ++r) {
-    nnz_pos_ += static_cast<size_t>(lens_[row_pos_ + r]);
+  uint64_t left = take_;
+  while (left > 0) {
+    Block& front = blocks_.front();
+    const uint64_t remaining = front.Size() - row_in_front_;
+    if (remaining <= left) {
+      left -= remaining;
+      if (spares_.size() < 16) {  // park capacity for the next Accumulate
+        spares_.push_back(std::move(front));
+      }
+      blocks_.pop_front();
+      row_in_front_ = 0;
+    } else {
+      row_in_front_ += left;
+      left = 0;
+    }
   }
-  row_pos_ += take_;
+  avail_rows_ -= take_;
   staged_ = false;
-  // compact once the dead prefix outweighs the live tail
-  if (row_pos_ > lens_.size() - row_pos_) {
-    label_.erase(label_.begin(), label_.begin() + row_pos_);
-    weight_.erase(weight_.begin(), weight_.begin() + row_pos_);
-    lens_.erase(lens_.begin(), lens_.begin() + row_pos_);
-    if (!qid_.empty()) {
-      qid_.erase(qid_.begin(), qid_.begin() + row_pos_);
-    }
-    col_.erase(col_.begin(), col_.begin() + nnz_pos_);
-    val_.erase(val_.begin(), val_.begin() + nnz_pos_);
-    if (!field_.empty()) {
-      field_.erase(field_.begin(), field_.begin() + nnz_pos_);
-    }
-    row_pos_ = 0;
-    nnz_pos_ = 0;
-  }
 }
 
 void PaddedBatcher::BeforeFirst() {
   parser_->BeforeFirst();
-  label_.clear();
-  weight_.clear();
-  val_.clear();
-  lens_.clear();
-  col_.clear();
-  qid_.clear();
-  field_.clear();
-  row_pos_ = 0;
-  nnz_pos_ = 0;
+  blocks_.clear();
+  row_in_front_ = 0;
+  avail_rows_ = 0;
   done_ = false;
   staged_ = false;
   // max_index_ deliberately survives reset: the dense/csr layout choice must

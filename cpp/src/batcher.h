@@ -11,16 +11,24 @@
 // writes them in one pass — no per-block numpy concatenation, padding, or
 // fancy indexing on the (GIL-holding) Python thread.
 //
+// Zero-copy discipline (reference src/data/parser.h:95-109): parsed blocks
+// are MOVED from the parser (Parser::NextBlockMove swap hand-off) into a
+// deque and consumed through a (block, row) cursor — the only host copy of
+// the parsed data is the final write into the caller's batch buffers.
+// Normalization (implicit 1.0 values, default weights, typed csv values,
+// qid/field sentinels) happens during that single write.
+//
 // Layouts match dmlc_core_tpu/tpu/device_iter.py:
 //   CSR:   row/col/val [D, bucket]; per-nonzero local row segment ids with a
 //          sacrificial padding segment id == R; label/weight [D*R] with
 //          weight 0 marking padding rows; nrows [D].
 //   Dense: x [D*R, F] zero-filled then scattered (the MXU on-ramp for
-//          low-dimensional data, e.g. HIGGS's 28 columns).
+//          low-dimensional data, e.g. HIGGS's 28 columns), float32 or bf16.
 #ifndef DCT_BATCHER_H_
 #define DCT_BATCHER_H_
 
 #include <cstdint>
+#include <deque>
 #include <memory>
 #include <vector>
 
@@ -65,25 +73,44 @@ class PaddedBatcher {
   size_t BytesRead() const { return parser_->BytesRead(); }
 
  private:
-  void Accumulate();           // pull parser blocks until a batch is pending
+  // pending parsed blocks in arrival order; the front is partially
+  // consumed up to row_in_front_
+  using Block = RowBlockContainer<uint32_t>;
+
+  void Accumulate();           // move parser blocks in until a batch pends
+  // Visit the staged batch's rows as (block, row range) segments:
+  // fn(block, r0, r1, out_row) covers block-local rows [r0, r1) landing at
+  // batch rows [out_row, out_row + (r1-r0)).
+  template <typename Fn>
+  void ForEachRowRange(uint64_t skip, uint64_t count, Fn&& fn) const;
   template <typename T>
   void FillDenseT(T* x, uint64_t num_features);  // zero + scatter, typed
   void FillQid(int32_t* qid);  // staged qid column (or the -1 sentinel)
   void FillRowArrays(float* label, float* weight, int32_t* nrows);
-  void Consume();              // advance past the staged batch + compact
-  uint64_t AvailRows() const { return lens_.size() - row_pos_; }
+  void Consume();              // pop the staged rows off the deque
+  // nnz of block-local rows [r0, r1)
+  static uint64_t RowRangeNnz(const Block& b, uint64_t r0, uint64_t r1) {
+    return b.offset[r1] - b.offset[r0];
+  }
+  // value of nonzero k of `b` under dtype/implicit-1.0 normalization
+  static float ValueAt(const Block& b, uint64_t k) {
+    if (b.value_dtype == 1) return static_cast<float>(b.value_i32[k]);
+    if (b.value_dtype == 2) return static_cast<float>(b.value_i64[k]);
+    return b.value.empty() ? 1.0f : b.value[k];
+  }
 
   std::unique_ptr<Parser<uint32_t>> parser_;
   const uint64_t batch_rows_;
   const uint32_t num_shards_;
   const uint64_t min_bucket_;
 
-  // pending rows in arrival order; a consumed prefix [0, row_pos_) /
-  // [0, nnz_pos_) is compacted away once it outgrows the live tail
-  std::vector<float> label_, weight_, val_;
-  std::vector<int32_t> lens_, col_, qid_, field_;
-  size_t row_pos_ = 0;
-  size_t nnz_pos_ = 0;
+  std::deque<Block> blocks_;
+  // consumed blocks parked here (cleared, capacity kept) and fed back as
+  // NextBlockMove out-arguments, so the swap hand-off really does recycle
+  // buffer capacity end-to-end instead of reallocating per chunk
+  std::vector<Block> spares_;
+  uint64_t row_in_front_ = 0;  // consumed rows of blocks_.front()
+  uint64_t avail_rows_ = 0;    // unconsumed rows across the deque
   bool done_ = false;
   bool have_qid_ = false;
   bool have_field_ = false;

@@ -243,6 +243,17 @@ const RowBlockContainer<IndexType>* TextParserBase<IndexType>::NextBlock() {
   }
 }
 
+template <typename IndexType>
+bool TextParserBase<IndexType>::NextBlockMove(
+    RowBlockContainer<IndexType>* out) {
+  // swap hand-off: the consumer gets the parsed buffers, the worker slot
+  // keeps out's old capacity for the next chunk
+  const RowBlockContainer<IndexType>* b = NextBlock();
+  if (b == nullptr) return false;
+  std::swap(*out, blocks_[block_idx_ - 1]);
+  return true;
+}
+
 // --------------------------------------------------------------------------
 template <typename IndexType>
 LibSVMParser<IndexType>::LibSVMParser(
@@ -592,6 +603,19 @@ void DiskCacheParser<IndexType>::FinalizeCache() {
 }
 
 template <typename IndexType>
+void DiskCacheParser<IndexType>::EnsureWriter() {
+  if (writer_ != nullptr) return;
+  writer_.reset(Stream::Create(cache_file_ + ".tmp", "w"));
+  uint64_t magic = kRowCacheMagic, fp = fingerprint_;
+  if (!serial::NativeIsLE()) {
+    magic = serial::ByteSwap(magic);
+    fp = serial::ByteSwap(fp);
+  }
+  writer_->Write(&magic, 8);
+  writer_->Write(&fp, 8);
+}
+
+template <typename IndexType>
 const RowBlockContainer<IndexType>* DiskCacheParser<IndexType>::NextBlock() {
   if (replaying_) {
     StartReplayPipeline();
@@ -605,18 +629,32 @@ const RowBlockContainer<IndexType>* DiskCacheParser<IndexType>::NextBlock() {
     FinalizeCache();
     return nullptr;
   }
-  if (writer_ == nullptr) {
-    writer_.reset(Stream::Create(cache_file_ + ".tmp", "w"));
-    uint64_t magic = kRowCacheMagic, fp = fingerprint_;
-    if (!serial::NativeIsLE()) {
-      magic = serial::ByteSwap(magic);
-      fp = serial::ByteSwap(fp);
-    }
-    writer_->Write(&magic, 8);
-    writer_->Write(&fp, 8);
-  }
+  EnsureWriter();
   b->Save(writer_.get());
   return b;
+}
+
+template <typename IndexType>
+bool DiskCacheParser<IndexType>::NextBlockMove(
+    RowBlockContainer<IndexType>* out) {
+  if (replaying_) {
+    StartReplayPipeline();
+    if (replay_cell_ != nullptr) replay_pipe_.Recycle(&replay_cell_);
+    if (!replay_pipe_.Next(&replay_cell_)) return false;
+    // swap hand-off: the recycled replay cell keeps out's old capacity
+    std::swap(*out, *replay_cell_);
+    replay_cell_->Clear();
+    return true;
+  }
+  // write-through epoch: move from base, then append to the cache
+  if (!base_->NextBlockMove(out)) {
+    write_complete_ = true;
+    FinalizeCache();
+    return false;
+  }
+  EnsureWriter();
+  out->Save(writer_.get());
+  return true;
 }
 
 template <typename IndexType>
@@ -668,12 +706,12 @@ void ThreadedParser<IndexType>::BeforeFirst() {
 }
 
 template <typename IndexType>
-const RowBlockContainer<IndexType>* ThreadedParser<IndexType>::NextBlock() {
+RowBlockContainer<IndexType>* ThreadedParser<IndexType>::NextMutable() {
   EnsureStarted();
   while (true) {
     if (current_ != nullptr) {
       while (current_->next < current_->blocks.size()) {
-        const RowBlockContainer<IndexType>* b =
+        RowBlockContainer<IndexType>* b =
             &current_->blocks[current_->next++];
         if (b->Size() != 0) return b;
       }
@@ -681,6 +719,22 @@ const RowBlockContainer<IndexType>* ThreadedParser<IndexType>::NextBlock() {
     }
     if (!pipe_.Next(&current_)) return nullptr;
   }
+}
+
+template <typename IndexType>
+const RowBlockContainer<IndexType>* ThreadedParser<IndexType>::NextBlock() {
+  return NextMutable();
+}
+
+template <typename IndexType>
+bool ThreadedParser<IndexType>::NextBlockMove(
+    RowBlockContainer<IndexType>* out) {
+  RowBlockContainer<IndexType>* b = NextMutable();
+  if (b == nullptr) return false;
+  // swap hand-off: the recycled cell keeps out's old buffer capacity
+  std::swap(*out, *b);
+  b->Clear();
+  return true;
 }
 
 // --------------------------------------------------------------------------

@@ -46,6 +46,17 @@ class Parser {
   // Produce the next block of rows; nullptr at end of data. The returned
   // container stays valid until the next call.
   virtual const RowBlockContainer<IndexType>* NextBlock() = 0;
+  // Move the next block into *out; false at end of data. Swap semantics
+  // where the implementation allows it (out's old buffers return to the
+  // producer's recycled cells, so capacity is never lost) — the zero-copy
+  // hand-off the padded batcher rides (reference parser.h:95-109 keeps
+  // the same discipline with its shared data_ vector). Base: copy.
+  virtual bool NextBlockMove(RowBlockContainer<IndexType>* out) {
+    const RowBlockContainer<IndexType>* b = NextBlock();
+    if (b == nullptr) return false;
+    *out = *b;
+    return true;
+  }
   virtual size_t BytesRead() const = 0;
 
   // Factory (reference src/data.cc:62-85 CreateParser_): format is
@@ -68,6 +79,7 @@ class TextParserBase : public Parser<IndexType> {
 
   void BeforeFirst() override;
   const RowBlockContainer<IndexType>* NextBlock() override;
+  bool NextBlockMove(RowBlockContainer<IndexType>* out) override;
   size_t BytesRead() const override {
     return bytes_read_.load(std::memory_order_relaxed);
   }
@@ -181,12 +193,14 @@ class DiskCacheParser : public Parser<IndexType> {
 
   void BeforeFirst() override;
   const RowBlockContainer<IndexType>* NextBlock() override;
+  bool NextBlockMove(RowBlockContainer<IndexType>* out) override;
   size_t BytesRead() const override { return base_->BytesRead(); }
 
  private:
   void FinalizeCache();
   bool TryOpenCache();
   void StartReplayPipeline();
+  void EnsureWriter();  // open the .tmp cache + header on first write
 
   std::unique_ptr<Parser<IndexType>> base_;
   std::string cache_file_;
@@ -213,6 +227,7 @@ class ThreadedParser : public Parser<IndexType> {
 
   void BeforeFirst() override;
   const RowBlockContainer<IndexType>* NextBlock() override;
+  bool NextBlockMove(RowBlockContainer<IndexType>* out) override;
   size_t BytesRead() const override { return base_->BytesRead(); }
 
  private:
@@ -220,6 +235,7 @@ class ThreadedParser : public Parser<IndexType> {
     std::vector<RowBlockContainer<IndexType>> blocks;
     size_t next = 0;
   };
+  RowBlockContainer<IndexType>* NextMutable();  // shared walk for both Next*
   std::unique_ptr<TextParserBase<IndexType>> base_;
   PipelineIter<Cell> pipe_;
   Cell* current_ = nullptr;
