@@ -636,6 +636,11 @@ class DeviceRowBlockIter:
                  dense_dtype=np.float32):
         self.mesh = mesh
         self.to_device = to_device
+        self.batch_rows = batch_rows
+        # determinism keys for mid-epoch resume: the batch count is only a
+        # position within THIS stream slicing (state()/restore())
+        self._identity = {"uri": uri, "part": part, "npart": npart,
+                          "fmt": fmt, "batch_rows": batch_rows}
         num_shards = 1 if mesh is None else int(mesh.devices.size)
         if fmt == "auto" and uri.split("?", 1)[0].split("#", 1)[0] \
                 .endswith(".drec"):
@@ -675,6 +680,9 @@ class DeviceRowBlockIter:
         self._thread: Optional[threading.Thread] = None
         self._xfer_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # mid-epoch resume position (state()/restore())
+        self.batches_consumed = 0
+        self._skip_batches = 0
 
     # -- staging threads -----------------------------------------------------
     # Queue ops are stop-aware: a blocking put/get could otherwise race the
@@ -705,6 +713,22 @@ class DeviceRowBlockIter:
 
     def _parse_loop(self) -> None:
         try:
+            # mid-epoch resume: burn the recorded prefix on this thread —
+            # parsed and discarded, never transferred (restore())
+            skip, self._skip_batches = self._skip_batches, 0
+            for i in range(skip):
+                if self._stop.is_set():  # close() must not wait out a
+                    return               # potentially huge resume prefix
+                batch = self.batcher.next_batch()
+                if batch is None:
+                    raise DMLCError(
+                        f"restore: resume point ({skip} batches) is past "
+                        f"end-of-data (got {i}); the checkpoint and the "
+                        f"data stream disagree")
+                if hasattr(self.batcher, "recycle"):
+                    # discarded host batches never touched the device, so
+                    # immediate recycling is safe on any backend
+                    self.batcher.recycle(batch)
             while not self._stop.is_set():
                 batch = self.batcher.next_batch()
                 if not self._put_stop(self._host_q, batch):  # None terminates
@@ -783,7 +807,38 @@ class DeviceRowBlockIter:
                 self._thread = None
                 self._xfer_thread = None
                 raise item
+            self.batches_consumed += 1
             yield item
+
+    # -- mid-epoch checkpoint/resume ----------------------------------------
+    def state(self) -> Dict[str, Any]:
+        """Resume point for mid-epoch checkpointing: the number of batches
+        yielded this epoch plus the determinism keys (uri/part/npart/fmt/
+        batch_rows) that make the count a position. Save it next to the
+        model checkpoint (utils/checkpoint.py) and hand it to restore()
+        after a preemption — the TPU-pod recovery story."""
+        return dict(self._identity, batches_consumed=self.batches_consumed)
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        """Rewind to the epoch start, then skip `state['batches_consumed']`
+        batches HOST-SIDE on the staging thread (parsed/filled and
+        discarded — never transferred to the device), so iteration resumes
+        exactly where state() was captured. Raises if any recorded
+        determinism key (batch_rows/part/npart/uri/fmt) disagrees with
+        this iterator — batch k of a different stream slicing is different
+        data, and resuming there would silently skip and duplicate rows —
+        or, at iteration time, if the resume point lies past end-of-data."""
+        for key, ours in self._identity.items():
+            theirs = state.get(key, ours)
+            if theirs != ours:
+                raise DMLCError(
+                    f"restore: checkpoint was taken with {key}={theirs!r} "
+                    f"but this iterator uses {ours!r}; resuming a batch "
+                    f"count across a different stream slicing would read "
+                    f"the wrong rows")
+        self.before_first()
+        self._skip_batches = int(state.get("batches_consumed", 0))
+        self.batches_consumed = self._skip_batches
 
     def _join_threads(self) -> None:
         self._stop.set()
@@ -811,6 +866,8 @@ class DeviceRowBlockIter:
         """Restart iteration (reference DataIter::BeforeFirst)."""
         self._join_threads()
         self.batcher.reset()
+        self.batches_consumed = 0
+        self._skip_batches = 0
 
     def bytes_read(self) -> int:
         """Bytes consumed from the underlying source so far."""

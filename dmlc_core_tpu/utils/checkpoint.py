@@ -134,6 +134,11 @@ def fast_forward(iterator: Iterable, n_batches: int) -> Iterable:
     """Skip `n_batches` from a (deterministic-order) batch iterator —
     mid-epoch data resume; returns the advanced iterator.
 
+    Works on any iterator but pulls the skipped batches through the full
+    pipeline; DeviceRowBlockIter offers the cheaper native path —
+    `state()` / `restore()` skip the prefix on the staging thread without
+    ever transferring it to the device.
+
     Raises DMLCError if the iterator runs dry before `n_batches` were
     skipped: a resume point past end-of-data means the checkpoint step
     and the data stream disagree, and silently yielding zero batches
