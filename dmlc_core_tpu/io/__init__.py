@@ -1,0 +1,12 @@
+"""I/O layer: native streams/splits/parsers binding + dataset conversion."""
+
+from dmlc_core_tpu.io.convert import (rows_to_dense_recordio,  # noqa: F401
+                                      rows_to_recordio)
+from dmlc_core_tpu.io.native import (NativeBatcher,  # noqa: F401
+                                     NativeDenseRecBatcher, NativeInputSplit,
+                                     NativeParser, NativeRecordIOReader,
+                                     NativeRecordIOWriter, NativeStream,
+                                     RowBlock, list_directory,
+                                     parser_formats_doc, path_info,
+                                     set_webhdfs_auth_header,
+                                     set_webhdfs_delegation_token)

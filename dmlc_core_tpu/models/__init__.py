@@ -1,0 +1,6 @@
+"""Flagship consumers: the sparse/dense linear learner and the DPxSP
+transformer (ring attention)."""
+
+from dmlc_core_tpu.models.linear import LinearLearner  # noqa: F401
+from dmlc_core_tpu.models.transformer import (TransformerConfig,  # noqa: F401
+                                              TransformerLM)

@@ -1,0 +1,5 @@
+"""Device ops: segment-CSR math, attention, ranking, Pallas kernels."""
+
+from dmlc_core_tpu.ops.sparse import (csr_matmul_dense,  # noqa: F401
+                                      csr_matvec, csr_to_dense,
+                                      field_aware_matvec, row_sdot)

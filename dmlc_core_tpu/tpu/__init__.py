@@ -1,0 +1,9 @@
+"""Device bridge: HBM-resident sharded batches + mesh/sharding helpers."""
+
+from dmlc_core_tpu.tpu.device_iter import (DenseBatch,  # noqa: F401
+                                           DenseRecHostBatcher,
+                                           DeviceRowBlockIter, HostBatcher,
+                                           NativeHostBatcher, PaddedBatch)
+from dmlc_core_tpu.tpu.sharding import (batch_sharding,  # noqa: F401
+                                        data_mesh, local_device_count,
+                                        process_part, replicated_sharding)

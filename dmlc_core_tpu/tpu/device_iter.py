@@ -637,14 +637,18 @@ class DeviceRowBlockIter:
         self.mesh = mesh
         self.to_device = to_device
         self.batch_rows = batch_rows
+        num_shards = 1 if mesh is None else int(mesh.devices.size)
+        path_part = uri.split("?", 1)[0].split("#", 1)[0]
+        if fmt == "auto" and path_part.endswith(".drec"):
+            fmt = "recd"  # dense row-matrix records are self-identifying
+        elif fmt == "auto" and path_part.endswith(".rec"):
+            fmt = "rec"  # mirror the native suffix rule (parser.cc Create)
         # determinism keys for mid-epoch resume: the batch count is only a
-        # position within THIS stream slicing (state()/restore())
+        # position within THIS stream slicing (state()/restore()). Stored
+        # AFTER suffix resolution so a checkpoint taken under fmt="auto"
+        # restores into an iterator built with the explicit format.
         self._identity = {"uri": uri, "part": part, "npart": npart,
                           "fmt": fmt, "batch_rows": batch_rows}
-        num_shards = 1 if mesh is None else int(mesh.devices.size)
-        if fmt == "auto" and uri.split("?", 1)[0].split("#", 1)[0] \
-                .endswith(".drec"):
-            fmt = "recd"  # dense row-matrix records are self-identifying
         if fmt == "recd":
             # zero-parse dense lane: records already hold device-layout
             # matrices (dense_rec.h); CSR options don't apply

@@ -217,6 +217,8 @@ def gen_index() -> str:
         "",
         "| page | contents |",
         "|---|---|",
+        "| [migration.md](migration.md) | dmlc-core -> dmlc_core_tpu "
+        "API mapping |",
         "| [api.md](api.md) | generated Python API reference |",
         "| [parameters.md](parameters.md) | parameter system + native "
         "data-format registry |",

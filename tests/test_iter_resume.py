@@ -117,13 +117,52 @@ def test_restore_identity_mismatch_raises(tmp_path):
 
 
 def test_close_interrupts_large_resume_skip(tmp_path):
+    """The skip loop must check _stop per iteration: with a slow batcher
+    and a huge resume prefix, close() returns after a handful of skipped
+    batches instead of waiting out all 1000."""
     import time
-    src = write_libsvm(tmp_path / "big.libsvm", rows=20000)
+
+    class SlowBatcher:
+        def __init__(self):
+            self.calls = 0
+
+        def next_batch(self):
+            self.calls += 1
+            time.sleep(0.02)
+            return object()  # truthy stand-in; never leaves the skip loop
+
+        def reset(self):
+            pass
+
+        def close(self):
+            pass
+
+    src = write_libsvm(tmp_path / "big.libsvm", rows=100)
     it = DeviceRowBlockIter(str(src), batch_rows=64, to_device=False)
-    it.restore({"batches_consumed": 250, "batch_rows": 64,
-                "uri": str(src)})
-    it._ensure_started()  # staging threads begin burning the skip prefix
-    time.sleep(0.05)      # let the skip loop actually get going
-    t0 = time.time()
+    slow = SlowBatcher()
+    it.batcher.close()
+    it.batcher = slow
+    it._skip_batches = 1000  # 1000 * 20ms = 20s if close cannot interrupt
+    it._ensure_started()
+    time.sleep(0.1)  # let the skip loop actually get going
     it.close()
-    assert time.time() - t0 < 10.0  # close must not wait out the prefix
+    assert 0 < slow.calls < 50, slow.calls  # interrupted, not waited out
+
+
+def test_restore_auto_fmt_matches_explicit(tmp_path):
+    """A checkpoint taken under fmt='auto' restores into an iterator built
+    with the resolved explicit format (suffix resolution happens before
+    the identity is recorded)."""
+    from dmlc_core_tpu.io.convert import rows_to_dense_recordio
+    src = write_libsvm(tmp_path / "a.libsvm", rows=600)
+    drec = str(tmp_path / "a.drec")
+    rows_to_dense_recordio(str(src), drec, rows_per_record=64)
+    with DeviceRowBlockIter(drec, fmt="auto", batch_rows=128,
+                            to_device=False, dense_dtype="bf16") as it:
+        next(iter(it))
+        st = it.state()
+    assert st["fmt"] == "recd"
+    with DeviceRowBlockIter(drec, fmt="recd", batch_rows=128,
+                            to_device=False, dense_dtype="bf16") as it2:
+        it2.restore(st)  # must not raise
+        assert sum(1 for _ in it2) == 4  # 5 batches - 1 consumed
