@@ -100,6 +100,12 @@ bool DenseRecBatcher::AdvanceRecord() {
   const uint32_t flags = recordio::LoadWordLE(p + 4);
   rec_rows_ = recordio::LoadWordLE(p + 8);
   const uint32_t F = recordio::LoadWordLE(p + 12);
+  // RecordIO records are < 2^29 bytes, so legitimate dims are far below
+  // 2^30; bounding them here keeps the `need` arithmetic below free of
+  // uint64 overflow (a fuzzed 2^32-ish rows x features pair could
+  // otherwise wrap `need` small and defeat the size check)
+  DCT_CHECK(rec_rows_ <= (1u << 30) && F <= (1u << 30))
+      << "corrupt dense rec header: rows=" << rec_rows_ << " features=" << F;
   const int dtype = static_cast<int>(flags & 1u);
   const int hw = static_cast<int>((flags >> 1) & 1u);
   if (x_dtype_ < 0) {

@@ -44,6 +44,20 @@ class StdFileStream : public SeekStream {
         << "seek failed";
   }
   size_t Tell() override { return static_cast<size_t>(ftello(fp_)); }
+  size_t BytesRemaining() const override {
+    // known for regular files: arms the corrupt-length guards in the
+    // deserializers (serializer.h ReadVecAppend) on the disk-cache replay
+    // path, where a flipped bit in a length prefix must raise instead of
+    // driving a multi-GB allocation
+    struct stat st;
+    if (fp_ == nullptr || fstat(fileno(fp_), &st) != 0 ||
+        !S_ISREG(st.st_mode)) {
+      return static_cast<size_t>(-1);
+    }
+    const off_t pos = ftello(fp_);
+    if (pos < 0 || st.st_size < pos) return static_cast<size_t>(-1);
+    return static_cast<size_t>(st.st_size - pos);
+  }
 
  private:
   std::FILE* fp_;

@@ -122,6 +122,9 @@ struct RowBlockContainer {
     uint64_t n;
     if (s->Read(&n, 8) != 8) return false;
     if (!serial::NativeIsLE()) n = serial::ByteSwap(n);
+    DCT_CHECK(n <= s->BytesRemaining() / 8 + 1)
+        << "corrupt row-block image: offset count " << n
+        << " exceeds the remaining payload";
     // Offsets: the wire image carries n absolute offsets starting with a 0;
     // appended rows rebase onto the current nnz tail and the leading 0 is
     // dropped. Read all n into the grown tail, then shift-rebase in place
@@ -171,6 +174,9 @@ struct RowBlockContainer {
     uint64_t n;
     if (s->Read(&n, 8) != 8) return false;
     if (!serial::NativeIsLE()) n = serial::ByteSwap(n);
+    DCT_CHECK(n <= s->BytesRemaining() / 8)
+        << "corrupt row-block image: offset count " << n
+        << " exceeds the remaining payload";
     offset.resize(n);
     if (n != 0) {
       s->ReadExact(offset.data(), n * 8);

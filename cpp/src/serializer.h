@@ -78,11 +78,17 @@ inline void WriteVec(Stream* s, const std::vector<T>& v) {
 
 // Append-read: deserialize a vector onto the tail of *v (no intermediate
 // copy — the zero-copy discipline of the rec ingest lane, parser.cc
-// RecParser). Returns the number of elements appended.
+// RecParser). Returns the number of elements appended. The length prefix
+// is validated against the stream's remaining bytes BEFORE the resize: a
+// corrupt length must raise, not allocate gigabytes (bounded streams
+// only; unbounded streams report SIZE_MAX and fail at ReadExact).
 template <typename T>
 inline uint64_t ReadVecAppend(Stream* s, std::vector<T>* v) {
   uint64_t n = ReadPOD<uint64_t>(s);
   if (n == 0) return 0;
+  DCT_CHECK(n <= s->BytesRemaining() / sizeof(T))
+      << "corrupt stream: vector length " << n << " exceeds the "
+      << s->BytesRemaining() << " remaining bytes";
   size_t old = v->size();
   v->resize(old + n);
   if (NativeIsLE() || sizeof(T) == 1) {

@@ -40,6 +40,12 @@ class Stream {
     size_t n = Read(ptr, size);
     DCT_CHECK_EQ(n, size) << "unexpected end of stream";
   }
+
+  // Upper bound on bytes still readable, when the stream knows it
+  // (bounded memory views); SIZE_MAX otherwise. Deserializers use this to
+  // reject corrupt length prefixes BEFORE allocating (a flipped bit in a
+  // u64 length must raise an error, not a multi-GB resize).
+  virtual size_t BytesRemaining() const { return static_cast<size_t>(-1); }
 };
 
 // Seekable read stream.
@@ -57,6 +63,10 @@ class MemoryStream : public SeekStream {
  public:
   MemoryStream() = default;
   explicit MemoryStream(std::string data) : buf_(std::move(data)) {}
+
+  size_t BytesRemaining() const override {
+    return buf_.size() - std::min(pos_, buf_.size());
+  }
 
   size_t Read(void* ptr, size_t size) override {
     size_t n = std::min(size, buf_.size() - std::min(pos_, buf_.size()));
@@ -86,6 +96,10 @@ class MemoryFixedSizeStream : public SeekStream {
  public:
   MemoryFixedSizeStream(void* buffer, size_t capacity)
       : buf_(static_cast<char*>(buffer)), cap_(capacity) {}
+
+  size_t BytesRemaining() const override {
+    return cap_ - std::min(pos_, cap_);
+  }
 
   size_t Read(void* ptr, size_t size) override {
     size_t n = std::min(size, cap_ - std::min(pos_, cap_));

@@ -170,3 +170,40 @@ def test_sha256_matches_hashlib():
     assert _STATE.objects[("bkt", "hash/probe.bin")] == body
     # if the C++ sha256(body) differed from hashlib's, the mock would have
     # rejected the PUT with 403 and the write would have raised
+
+
+def test_binary_lanes_over_s3(tmp_path):
+    """The round-3 binary ingest lanes compose with remote filesystems:
+    convert locally, upload through the native s3:// stream, ingest the
+    rec and recd lanes straight from s3:// (split/prefetch included)."""
+    import numpy as np
+    from dmlc_core_tpu.io.convert import (rows_to_dense_recordio,
+                                          rows_to_recordio)
+    from dmlc_core_tpu.tpu.device_iter import DeviceRowBlockIter
+
+    rng = np.random.default_rng(17)
+    src = tmp_path / "s.libsvm"
+    with open(src, "w") as f:
+        for i in range(1500):
+            f.write(f"{i % 2} " + " ".join(
+                f"{j}:{rng.uniform():.4f}" for j in range(8)) + "\n")
+    # converters write THROUGH the stream layer: s3:// destinations work
+    rows_to_recordio(str(src), "s3://bkt/data/a.rec", rows_per_record=128)
+    rows_to_dense_recordio(str(src), "s3://bkt/data/a.drec",
+                           rows_per_record=128)
+    for uri, fmt in (("s3://bkt/data/a.rec", "rec"),
+                     ("s3://bkt/data/a.drec", "recd")):
+        got = 0
+        with DeviceRowBlockIter(uri, fmt=fmt, batch_rows=256,
+                                to_device=False, dense_dtype="bf16") as it:
+            for b in it:
+                got += b.total_rows
+        assert got == 1500, (uri, got)
+    # partitioned remote read: exact cover
+    got = 0
+    for k in range(3):
+        with DeviceRowBlockIter("s3://bkt/data/a.rec", fmt="rec", part=k,
+                                npart=3, batch_rows=256,
+                                to_device=False) as it:
+            got += sum(b.total_rows for b in it)
+    assert got == 1500
