@@ -5,6 +5,7 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <exception>
 #include <limits>
@@ -769,9 +770,44 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
   }
   // binary row-block files partition on RecordIO magics, text on newlines
   const char* split_type = fmt == "rec" ? "recordio" : "text";
+  // epoch shuffling rides URI sugar like #cachefile does
+  // (reference input_split_shuffle.h exposes the same knob through
+  // InputSplit::Create): `?shuffle_parts=K[&shuffle_seed=S]` subdivides
+  // this part into K byte ranges visited in a freshly shuffled order each
+  // epoch — the coarse-grained training shuffle
+  unsigned shuffle_parts = 0;
+  int shuffle_seed = 0;
+  {
+    // strict numeric parse: garbage must error, not silently disable the
+    // shuffle; negative/huge values must not wrap into multi-GB state
+    auto parse_uarg = [&](const char* key, long lo, long hi,
+                          long dflt) -> long {
+      auto it = spec.args.find(key);
+      if (it == spec.args.end()) return dflt;
+      const char* s = it->second.c_str();
+      char* end = nullptr;
+      const long v = std::strtol(s, &end, 10);
+      DCT_CHECK(end != s && *end == '\0' && v >= lo && v <= hi)
+          << "bad URI arg " << key << "=" << it->second << " (expected an "
+          << "integer in [" << lo << ", " << hi << "])";
+      return v;
+    };
+    shuffle_parts = static_cast<unsigned>(
+        parse_uarg("shuffle_parts", 0, 65536, 0));
+    shuffle_seed = static_cast<int>(
+        parse_uarg("shuffle_seed", 0, 1 << 30, 0));
+    // a row-block cache replays the first epoch's PARSED order, which
+    // would freeze (and fingerprint-ignore) the shuffle — same rule as
+    // the split layer's own guard
+    DCT_CHECK(shuffle_parts == 0 || spec.cache_file.empty())
+        << "shuffle_parts cannot combine with #cachefile: the cache "
+           "replays epoch 1's order and would silently disable the "
+           "per-epoch reshuffle";
+  }
   InputSplit* split = InputSplit::Create(spec.uri, part, npart, split_type,
-                                         "", false, 0, 256, false,
-                                         /*threaded=*/true, "");
+                                         "", false, shuffle_seed, 256, false,
+                                         /*threaded=*/true, "",
+                                         shuffle_parts);
   // ownership of split passes into the parser's base immediately; a throwing
   // constructor body unwinds through the already-built base, which frees it
   TextParserBase<IndexType>* parser = entry->body(split, args, nthread);

@@ -205,7 +205,15 @@ def gen_parameters() -> str:
         "",
         "Formats resolve by name through the native registry "
         "(`cpp/src/registry.h`); `?format=` URI arguments or the `fmt` "
-        "argument select one, `.rec` files are auto-detected.",
+        "argument select one; `.rec`/`.drec` files are auto-detected by "
+        "suffix.",
+        "",
+        "URI sugar shared by every format: `#cachefile` caches parsed "
+        "row blocks on disk for later epochs, and "
+        "`?shuffle_parts=K[&shuffle_seed=S]` subdivides each partition "
+        "into K byte ranges visited in a freshly shuffled order every "
+        "epoch (the coarse-grained training shuffle, reference "
+        "input_split_shuffle.h).",
         "",
         parser_formats_doc(),
     ])

@@ -358,3 +358,74 @@ def test_nthread_invariance(tmp_path, fmt, line):
         got = _concat_parse(path, fmt, nthread)
         for a, b in zip(base, got):
             assert np.array_equal(a, b), f"{fmt} nthread={nthread} differs"
+
+
+# -- URI-level epoch shuffling (?shuffle_parts=K[&shuffle_seed=S]) ----------
+def _order(uri, part=0, npart=1):
+    out = []
+    with NativeParser(uri, part=part, npart=npart) as p:
+        for b in p:
+            out.extend(b.label.astype(int).tolist())
+    return out
+
+
+def _write_rowid_file(tmp_path, rows=3000):
+    p = tmp_path / "ids.libsvm"
+    p.write_text("".join(f"{i} 0:{float(i)}\n" for i in range(rows)))
+    return str(p), rows
+
+
+def test_shuffle_uri_exact_cover_and_determinism(tmp_path):
+    p, rows = _write_rowid_file(tmp_path)
+    plain = _order(p)
+    assert plain == list(range(rows))
+    s = _order(p + "?shuffle_parts=16&shuffle_seed=5")
+    assert sorted(s) == plain and s != plain     # same rows, shuffled order
+    assert _order(p + "?shuffle_parts=16&shuffle_seed=5") == s  # seeded
+    assert _order(p + "?shuffle_parts=16&shuffle_seed=9") != s  # new seed
+
+
+def test_shuffle_uri_reshuffles_each_epoch(tmp_path):
+    p, rows = _write_rowid_file(tmp_path)
+    with NativeParser(p + "?shuffle_parts=16") as pr:
+        e1 = [x for b in pr for x in b.label.astype(int).tolist()]
+        pr.before_first()
+        e2 = [x for b in pr for x in b.label.astype(int).tolist()]
+    assert sorted(e1) == sorted(e2) == list(range(rows))
+    assert e1 != e2  # fresh order per epoch
+
+
+def test_shuffle_uri_composes_with_partitioning(tmp_path):
+    p, rows = _write_rowid_file(tmp_path)
+    seen = []
+    for k in range(3):
+        seen += _order(p + "?shuffle_parts=8&shuffle_seed=2", part=k,
+                       npart=3)
+    assert sorted(seen) == list(range(rows))  # exact cover survives
+
+
+def test_shuffle_uri_through_device_iter(tmp_path):
+    from dmlc_core_tpu.tpu.device_iter import DeviceRowBlockIter
+    p, rows = _write_rowid_file(tmp_path, rows=2000)
+    labels = []
+    with DeviceRowBlockIter(p + "?shuffle_parts=8&shuffle_seed=3",
+                            batch_rows=256, to_device=False) as it:
+        for b in it:
+            labels.extend(np.asarray(b.label).reshape(-1)[
+                :b.total_rows].astype(int).tolist())
+    assert sorted(labels) == list(range(rows))
+    assert labels != list(range(rows))
+
+
+def test_shuffle_uri_rejects_cachefile_combo(tmp_path):
+    p, _ = _write_rowid_file(tmp_path)
+    cache = str(tmp_path / "cache")
+    with pytest.raises(DMLCError, match="cachefile"):
+        NativeParser(p + "?shuffle_parts=8#" + cache)
+
+
+def test_shuffle_uri_rejects_bad_values(tmp_path):
+    p, _ = _write_rowid_file(tmp_path)
+    for bad in ("-1", "sixteen", "999999999"):
+        with pytest.raises(DMLCError, match="shuffle_parts"):
+            NativeParser(p + f"?shuffle_parts={bad}")
