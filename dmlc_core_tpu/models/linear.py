@@ -50,6 +50,26 @@ def _shard_loss(params: LinearParams, shard: Dict[str, jnp.ndarray],
             jnp.log1p(jnp.exp(-jnp.abs(margin)))
     elif objective == "squared":
         per_row = 0.5 * (margin - y) ** 2
+    elif objective == "pairwise":
+        # RankNet-style learning-to-rank over qid groups (the reference's
+        # qid column exists for exactly this consumer lineage,
+        # data.h:174-236); the second return is the summed PAIR weight —
+        # the psum'd denominator, mirroring wsum for the pointwise losses
+        if "qid" not in shard:
+            raise ValueError(
+                "objective='pairwise' needs qid-grouped data (libsvm "
+                "`qid:` column; carried to the device as the qid plane)")
+        # the pair mining is an [R, R] broadcast: R f32 temporaries square
+        # in rows-per-shard, so an unchecked default batch (65536 rows)
+        # would ask for 17 GB on one device — refuse past a sane ceiling
+        if num_rows > 8192:
+            raise ValueError(
+                f"objective='pairwise' mines pairs in [R, R] space; "
+                f"R={num_rows} rows per shard would materialize "
+                f"{num_rows * num_rows * 4 / 1e9:.1f} GB temporaries. Use "
+                f"batch_rows <= 8192 * num_shards for ranking workloads")
+        from dmlc_core_tpu.ops.ranking import pairwise_logistic_loss
+        return pairwise_logistic_loss(margin, y, shard["qid"], wgt)
     else:
         raise ValueError(f"unknown objective {objective!r}")
     return jnp.sum(per_row * wgt), jnp.sum(wgt)

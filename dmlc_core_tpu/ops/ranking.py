@@ -36,11 +36,18 @@ def pairwise_logistic_loss(margin: jnp.ndarray, label: jnp.ndarray,
     label_i > label_j, and both rows are real (weight > 0; padding rows have
     weight 0). Rows with qid < 0 (the batcher's absent-qid/padding sentinel,
     cpp/src/batcher.cc) never pair — qid-less rows must not merge into one
-    pseudo-query. Returns (loss_sum, pair_count) — callers psum both across
-    the mesh and divide.
+    pseudo-query. Instance weights carry into the objective as the pair
+    weight w_i * w_j (unit weights reduce to plain pair counting), keeping
+    the weighted-loss contract of the pointwise objectives
+    (models/linear.py _shard_loss). Returns (weighted loss sum, weight
+    sum) — callers psum both across the mesh and divide.
 
     loss(i, j) = log1p(exp(-(margin_i - margin_j))), the standard smooth
     upper bound on pairwise misorder.
+
+    Memory: builds [R, R] temporaries — R here is rows per SHARD, so size
+    batch_rows/num_shards for ranking workloads (LinearLearner enforces a
+    ceiling).
     """
     same_q = qid[:, None] == qid[None, :]
     ordered = label[:, None] > label[None, :]
@@ -50,5 +57,5 @@ def pairwise_logistic_loss(margin: jnp.ndarray, label: jnp.ndarray,
     # stable log1p(exp(-diff)); masked entries contribute 0
     per_pair = jnp.maximum(-diff, 0.0) + jnp.log1p(
         jnp.exp(-jnp.abs(diff)))
-    per_pair = jnp.where(valid, per_pair, 0.0)
-    return per_pair.sum(), valid.sum().astype(jnp.float32)
+    pair_w = jnp.where(valid, weight[:, None] * weight[None, :], 0.0)
+    return (per_pair * pair_w).sum(), pair_w.sum()
