@@ -58,4 +58,8 @@ def pairwise_logistic_loss(margin: jnp.ndarray, label: jnp.ndarray,
     per_pair = jnp.maximum(-diff, 0.0) + jnp.log1p(
         jnp.exp(-jnp.abs(diff)))
     pair_w = jnp.where(valid, weight[:, None] * weight[None, :], 0.0)
-    return (per_pair * pair_w).sum(), pair_w.sum()
+    # mask with where, not multiplication: a non-finite margin on a masked
+    # row (e.g. an overflowed qid-less row) would otherwise leak NaN via
+    # 0 * inf into the sum — and jnp.where also zeroes the cotangent, so
+    # gradients stay finite too
+    return jnp.where(valid, per_pair * pair_w, 0.0).sum(), pair_w.sum()

@@ -116,3 +116,21 @@ def test_pairwise_instance_weights_scale_pairs():
     per_pair_q1 = float(np.log1p(np.exp(-(0.0 - (-1.0)))))
     assert float(s2) == pytest.approx(9 * per_pair_q0 + 1 * per_pair_q1,
                                       rel=1e-5)
+
+
+def test_pairwise_masked_nonfinite_rows_stay_finite():
+    from dmlc_core_tpu.ops.ranking import pairwise_logistic_loss
+    import jax
+    # an overflowed qid-less row must not poison valid pairs via 0 * inf
+    margin = jnp.array([1.0, 0.0, jnp.inf])
+    label = jnp.array([1.0, 0.0, 5.0])
+    qid = jnp.array([0, 0, -1], jnp.int32)
+    w = jnp.ones(3)
+
+    def loss(m):
+        s, n = pairwise_logistic_loss(m, label, qid, w)
+        return s / jnp.maximum(n, 1.0)
+
+    val, grad = jax.value_and_grad(loss)(margin)
+    assert np.isfinite(float(val))
+    assert np.isfinite(np.asarray(grad)[:2]).all()
