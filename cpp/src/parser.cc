@@ -775,39 +775,70 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
   // InputSplit::Create): `?shuffle_parts=K[&shuffle_seed=S]` subdivides
   // this part into K byte ranges visited in a freshly shuffled order each
   // epoch — the coarse-grained training shuffle
-  unsigned shuffle_parts = 0;
-  int shuffle_seed = 0;
+  // strict numeric parse: garbage must error, not silently disable the
+  // shuffle; negative/huge values must not wrap into multi-GB state
+  auto parse_uarg = [&](const char* key, long lo, long hi,
+                        long dflt) -> long {
+    auto it = spec.args.find(key);
+    if (it == spec.args.end()) return dflt;
+    const char* s = it->second.c_str();
+    char* end = nullptr;
+    const long v = std::strtol(s, &end, 10);
+    DCT_CHECK(end != s && *end == '\0' && v >= lo && v <= hi)
+        << "bad URI arg " << key << "=" << it->second << " (expected an "
+        << "integer in [" << lo << ", " << hi << "])";
+    return v;
+  };
+  const unsigned shuffle_parts = static_cast<unsigned>(
+      parse_uarg("shuffle_parts", 0, 65536, 0));
+  const int shuffle_seed = static_cast<int>(
+      parse_uarg("shuffle_seed", 0, 1 << 30, 0));
+  // a row-block cache replays the first epoch's PARSED order, which
+  // would freeze (and fingerprint-ignore) the shuffle — same rule as
+  // the split layer's own guard
+  DCT_CHECK(shuffle_parts == 0 || spec.cache_file.empty())
+      << "shuffle_parts cannot combine with #cachefile: the cache "
+         "replays epoch 1's order and would silently disable the "
+         "per-epoch reshuffle";
+
+  // `?index=1` (the conventional <uri>.idx) or `?index=<path>` switches a
+  // rec stream onto the indexed_recordio splitter: record-count
+  // partitioning plus EXACT per-epoch record shuffling with `?shuffle=1`
+  // (reference indexed_recordio_split.h; index written by
+  // build_recordio_index, dmlc_core_tpu/io/convert.py)
+  std::string index_uri;
   {
-    // strict numeric parse: garbage must error, not silently disable the
-    // shuffle; negative/huge values must not wrap into multi-GB state
-    auto parse_uarg = [&](const char* key, long lo, long hi,
-                          long dflt) -> long {
-      auto it = spec.args.find(key);
-      if (it == spec.args.end()) return dflt;
-      const char* s = it->second.c_str();
-      char* end = nullptr;
-      const long v = std::strtol(s, &end, 10);
-      DCT_CHECK(end != s && *end == '\0' && v >= lo && v <= hi)
-          << "bad URI arg " << key << "=" << it->second << " (expected an "
-          << "integer in [" << lo << ", " << hi << "])";
-      return v;
-    };
-    shuffle_parts = static_cast<unsigned>(
-        parse_uarg("shuffle_parts", 0, 65536, 0));
-    shuffle_seed = static_cast<int>(
-        parse_uarg("shuffle_seed", 0, 1 << 30, 0));
-    // a row-block cache replays the first epoch's PARSED order, which
-    // would freeze (and fingerprint-ignore) the shuffle — same rule as
-    // the split layer's own guard
-    DCT_CHECK(shuffle_parts == 0 || spec.cache_file.empty())
-        << "shuffle_parts cannot combine with #cachefile: the cache "
-           "replays epoch 1's order and would silently disable the "
-           "per-epoch reshuffle";
+    auto it = spec.args.find("index");
+    if (it != spec.args.end()) {
+      DCT_CHECK(fmt == "rec")
+          << "?index= applies to the rec binary format only";
+      DCT_CHECK(shuffle_parts == 0)
+          << "?index= (exact record shuffle) and ?shuffle_parts= (coarse "
+             "byte-range shuffle) are alternatives; pass one";
+      DCT_CHECK(spec.cache_file.empty())
+          << "?index= cannot combine with #cachefile (the cache replays "
+             "epoch 1's order)";
+      index_uri = it->second == "1" ? spec.uri + ".idx" : it->second;
+    }
   }
-  InputSplit* split = InputSplit::Create(spec.uri, part, npart, split_type,
-                                         "", false, shuffle_seed, 256, false,
-                                         /*threaded=*/true, "",
-                                         shuffle_parts);
+  const bool rec_shuffle = parse_uarg("shuffle", 0, 1, 0) != 0;
+  DCT_CHECK(!rec_shuffle || !index_uri.empty())
+      << "?shuffle=1 needs ?index= (exact shuffling walks the record "
+         "index); for index-less streams use ?shuffle_parts=";
+  DCT_CHECK(spec.args.count("shuffle_batch") == 0 || !index_uri.empty())
+      << "?shuffle_batch= applies to indexed streams only (pass ?index=); "
+         "it would otherwise be silently ignored";
+  const size_t shuffle_batch = static_cast<size_t>(
+      parse_uarg("shuffle_batch", 1, 1 << 20, 256));
+
+  InputSplit* split =
+      index_uri.empty()
+          ? InputSplit::Create(spec.uri, part, npart, split_type, "", false,
+                               shuffle_seed, 256, false, /*threaded=*/true,
+                               "", shuffle_parts)
+          : InputSplit::Create(spec.uri, part, npart, "indexed_recordio",
+                               index_uri, rec_shuffle, shuffle_seed,
+                               shuffle_batch, false, /*threaded=*/true, "");
   // ownership of split passes into the parser's base immediately; a throwing
   // constructor body unwinds through the already-built base, which frees it
   TextParserBase<IndexType>* parser = entry->body(split, args, nthread);

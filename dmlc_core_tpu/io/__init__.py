@@ -1,6 +1,7 @@
 """I/O layer: native streams/splits/parsers binding + dataset conversion."""
 
-from dmlc_core_tpu.io.convert import (rows_to_dense_recordio,  # noqa: F401
+from dmlc_core_tpu.io.convert import (build_recordio_index,  # noqa: F401
+                                      rows_to_dense_recordio,
                                       rows_to_recordio)
 from dmlc_core_tpu.io.native import (NativeBatcher,  # noqa: F401
                                      NativeDenseRecBatcher, NativeInputSplit,

@@ -22,7 +22,8 @@ from dmlc_core_tpu.base import DMLCError
 from dmlc_core_tpu.io.native import (NativeParser, NativeRecordIOWriter,
                                      _bf16_dtype)
 
-__all__ = ["rows_to_recordio", "rows_to_dense_recordio"]
+__all__ = ["rows_to_recordio", "rows_to_dense_recordio",
+           "build_recordio_index"]
 
 _REC_MAGIC = 0x44524231       # 'DRB1' (CSR row blocks)
 _DENSE_REC_MAGIC = 0x44524431  # 'DRD1' (dense row matrices)
@@ -187,3 +188,65 @@ def rows_to_recordio(src_uri: str, dst_uri: str, fmt: str = "auto",
                 w.write_record(_serialize_rows(block, r0, r1, index64))
             total += n
     return total
+
+
+def build_recordio_index(uri: str, index_uri: str = None) -> int:
+    """Write the `id offset` text index for a RecordIO file — the
+    indexed_recordio contract (reference indexed_recordio_split.h) that
+    unlocks record-count partitioning and EXACT per-epoch record shuffling
+    (`?index=1&shuffle=1` on a .rec data URI). Walks the on-disk frames,
+    so escaped multi-part records index at their first part. Returns the
+    record count; index lands at `uri + ".idx"` unless given."""
+    from dmlc_core_tpu.io.native import NativeStream
+
+    magic = 0xCED7230A
+    entries = []
+    rec_id = 0
+    pos = 0
+    with NativeStream(uri) as s:
+        buf = b""
+        buf_start = 0  # stream offset of buf[0]
+
+        def headers():
+            """Yield (pos, word, lrec) for each frame head, skipping
+            payload bytes the walk doesn't need (the stream is
+            sequential-only, so 'seek' = read-and-discard)."""
+            nonlocal buf, buf_start, pos
+            while True:
+                # the payload may extend past everything buffered: discard
+                # the buffer and swallow the gap chunkwise
+                if pos >= buf_start + len(buf):
+                    gap = pos - (buf_start + len(buf))
+                    while gap > 0:
+                        chunk = s.read(min(gap, 1 << 20))
+                        if not chunk:
+                            return  # truncated tail: stop at EOF
+                        gap -= len(chunk)
+                    buf = b""
+                    buf_start = pos
+                else:  # drop the consumed prefix only
+                    buf = buf[pos - buf_start:]
+                    buf_start = pos
+                while len(buf) < 8:
+                    chunk = s.read()
+                    if not chunk:
+                        return  # end of stream (or trailing partial head)
+                    buf += chunk
+                yield struct.unpack_from("<II", buf, 0)
+
+        for word, lrec in headers():
+            if word != magic:
+                raise DMLCError(
+                    f"not a RecordIO file: bad magic at byte {pos} of "
+                    f"{uri}")
+            cflag = lrec >> 29
+            length = lrec & ((1 << 29) - 1)
+            if cflag in (0, 1):  # whole record or first part
+                entries.append((rec_id, pos))
+                rec_id += 1
+            pos += 8 + (length + 3) // 4 * 4
+    if index_uri is None:
+        index_uri = uri + ".idx"
+    with NativeStream(index_uri, "w") as s:
+        s.write("".join(f"{i} {o}\n" for i, o in entries).encode())
+    return rec_id

@@ -76,11 +76,15 @@ def main() -> int:
         start_epoch = step
         if "batches_consumed" in extra:
             # the epoch-boundary checkpoint below records 0 batches; a
-            # preemption-time checkpoint records the mid-epoch position
-            data_state = {"batches_consumed": int(extra["batches_consumed"]),
-                          "batch_rows": args.batch_rows, "uri": args.uri,
-                          "part": part, "npart": npart,
-                          "fmt": extra.get("fmt", "auto")}
+            # preemption-time checkpoint records the mid-epoch position.
+            # Rebuild the state from the SAVED identity (not current CLI
+            # args) so restore() can catch a mismatched resume — a batch
+            # count under different batch_rows/uri/part is different data.
+            data_state = {
+                k: int(extra[k]) if k in ("batches_consumed", "batch_rows",
+                                          "part", "npart") else extra[k]
+                for k in ("batches_consumed", "batch_rows", "part",
+                          "npart", "uri", "fmt") if k in extra}
 
     it = DeviceRowBlockIter(args.uri, part=part, npart=npart, mesh=mesh,
                             batch_rows=args.batch_rows, dense_dtype="bf16")
@@ -93,8 +97,10 @@ def main() -> int:
             for batch in it:
                 params, loss = learner.step(params, batch)
                 losses.append(float(loss))
-            print(f"epoch {epoch}: mean loss "
-                  f"{float(np.mean(losses)):.6f} over {len(losses)} batches")
+            summary = (f"mean loss {float(np.mean(losses)):.6f} over "
+                       f"{len(losses)} batches" if losses
+                       else "no batches in this part")
+            print(f"epoch {epoch}: {summary}")
             it.before_first()
             if args.checkpoint:
                 st = {str(k): str(v) for k, v in it.state().items()}

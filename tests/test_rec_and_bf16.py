@@ -416,3 +416,99 @@ def test_recd_multi_file_exact_cover(tmp_path):
             got += batch.total_rows
         b.close()
     assert got == total
+
+
+# -- exact record shuffling over an index (?index=1&shuffle=1) --------------
+def _rowid_rec(tmp_path, rows=2000, rows_per_record=25):
+    from dmlc_core_tpu.io.convert import (build_recordio_index,
+                                          rows_to_recordio)
+    src = tmp_path / "ids.libsvm"
+    src.write_text("".join(f"{i} 0:{float(i)}\n" for i in range(rows)))
+    rec = str(tmp_path / "ids.rec")
+    rows_to_recordio(str(src), rec, rows_per_record=rows_per_record)
+    nrec = build_recordio_index(rec)
+    assert nrec == rows // rows_per_record
+    return rec, rows
+
+
+def _rec_order(uri, part=0, npart=1):
+    out = []
+    with NativeParser(uri, part=part, npart=npart, fmt="rec") as p:
+        for b in p:
+            out.extend(b.label.astype(int).tolist())
+    return out
+
+
+def test_indexed_shuffle_exact_cover_and_epochs(tmp_path):
+    rec, rows = _rowid_rec(tmp_path)
+    plain = _rec_order(rec)
+    assert plain == list(range(rows))
+    s = _rec_order(rec + "?index=1&shuffle=1&shuffle_seed=7")
+    assert sorted(s) == plain and s != plain
+    assert _rec_order(rec + "?index=1&shuffle=1&shuffle_seed=7") == s
+    with NativeParser(rec + "?index=1&shuffle=1", fmt="rec") as p:
+        e1 = [x for b in p for x in b.label.astype(int).tolist()]
+        p.before_first()
+        e2 = [x for b in p for x in b.label.astype(int).tolist()]
+    assert sorted(e1) == sorted(e2) == plain and e1 != e2
+    # record-count partitioning composes with the index
+    cover = sorted(sum((_rec_order(rec + "?index=1", part=k, npart=4)
+                        for k in range(4)), []))
+    assert cover == plain
+
+
+def test_indexed_shuffle_through_device_iter(tmp_path):
+    rec, rows = _rowid_rec(tmp_path)
+    labels = []
+    with DeviceRowBlockIter(rec + "?index=1&shuffle=1&shuffle_seed=2",
+                            fmt="rec", batch_rows=256,
+                            to_device=False) as it:
+        for b in it:
+            labels.extend(np.asarray(b.label).reshape(-1)[
+                :b.total_rows].astype(int).tolist())
+    assert sorted(labels) == list(range(rows))
+    assert labels != list(range(rows))
+
+
+def test_indexed_shuffle_arg_validation(tmp_path):
+    rec, _ = _rowid_rec(tmp_path)
+    with pytest.raises(DMLCError, match="shuffle_parts"):
+        NativeParser(rec + "?index=1&shuffle_parts=4", fmt="rec")
+    with pytest.raises(DMLCError, match="index"):
+        NativeParser(rec + "?shuffle=1", fmt="rec")
+    src = tmp_path / "t.libsvm"
+    src.write_text("1 0:1.0\n")
+    with pytest.raises(DMLCError, match="rec"):
+        NativeParser(str(src) + "?index=1")
+
+
+def test_index_builder_handles_multi_chunk_and_escaped_records(tmp_path):
+    from dmlc_core_tpu.io.convert import (build_recordio_index,
+                                          rows_to_recordio)
+    from dmlc_core_tpu.io.native import NativeRecordIOWriter
+    # file larger than one 1 MiB read chunk: the walk must stay aligned
+    # when a record payload straddles chunk boundaries
+    rng = np.random.default_rng(0)
+    src = tmp_path / "big.libsvm"
+    with open(src, "w") as f:
+        for i in range(10000):
+            f.write(f"{i % 2} " + " ".join(
+                f"{j}:{rng.uniform():.6f}" for j in range(30)) + "\n")
+    rec = str(tmp_path / "big.rec")
+    rows_to_recordio(str(src), rec, rows_per_record=200)
+    assert (tmp_path / "big.rec").stat().st_size > 2 * (1 << 20)
+    assert build_recordio_index(rec) == 50
+    # escaped records (embedded aligned magics split into parts) index at
+    # their first part, once each
+    rec2 = str(tmp_path / "esc.rec")
+    magic = (0xCED7230A).to_bytes(4, "little")
+    with NativeRecordIOWriter(rec2) as w:
+        for _ in range(50):
+            w.write_record(b"A" * 4096 + magic * 3 + b"B" * 4096)
+    assert build_recordio_index(rec2) == 50
+
+
+def test_shuffle_batch_requires_index(tmp_path):
+    rec, _ = _rowid_rec(tmp_path)
+    with pytest.raises(DMLCError, match="shuffle_batch"):
+        NativeParser(rec + "?shuffle_parts=4&shuffle_batch=64", fmt="rec")
