@@ -71,6 +71,11 @@ class PaddedBatcher {
 
   void BeforeFirst();
   size_t BytesRead() const { return parser_->BytesRead(); }
+  // Pin the shuffle permutation the next BeforeFirst samples (mid-epoch
+  // resume; Parser::SetShuffleEpoch). False when nothing shuffles.
+  bool SetShuffleEpoch(unsigned epoch) {
+    return parser_->SetShuffleEpoch(epoch);
+  }
 
  private:
   // pending parsed blocks in arrival order; the front is partially

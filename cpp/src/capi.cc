@@ -377,6 +377,17 @@ int dct_parser_bytes_read(dct_parser_t h, size_t* out) {
   });
 }
 
+// Pin the shuffle permutation the next before_first samples; *supported = 0
+// when nothing in the chain shuffles (resume is order-safe regardless).
+int dct_parser_set_epoch(dct_parser_t h, unsigned epoch, int32_t* supported) {
+  return Guard([&] {
+    auto* ph = static_cast<ParserHandle*>(h);
+    const bool ok = ph->p64 != nullptr ? ph->p64->SetShuffleEpoch(epoch)
+                                       : ph->p32->SetShuffleEpoch(epoch);
+    *supported = ok ? 1 : 0;
+  });
+}
+
 int dct_parser_free(dct_parser_t h) {
   return Guard([&] { delete static_cast<ParserHandle*>(h); });
 }
@@ -461,6 +472,14 @@ int dct_batcher_before_first(dct_batcher_t h) {
   return Guard([&] { static_cast<dct::PaddedBatcher*>(h)->BeforeFirst(); });
 }
 
+int dct_batcher_set_epoch(dct_batcher_t h, unsigned epoch,
+                          int32_t* supported) {
+  return Guard([&] {
+    *supported =
+        static_cast<dct::PaddedBatcher*>(h)->SetShuffleEpoch(epoch) ? 1 : 0;
+  });
+}
+
 int dct_batcher_bytes_read(dct_batcher_t h, size_t* out) {
   return Guard(
       [&] { *out = static_cast<dct::PaddedBatcher*>(h)->BytesRead(); });
@@ -504,6 +523,14 @@ int dct_denserec_fill(dct_denserec_t h, void* x, int32_t out_dtype,
 
 int dct_denserec_before_first(dct_denserec_t h) {
   return Guard([&] { static_cast<dct::DenseRecBatcher*>(h)->BeforeFirst(); });
+}
+
+int dct_denserec_set_epoch(dct_denserec_t h, unsigned epoch,
+                           int32_t* supported) {
+  return Guard([&] {
+    *supported =
+        static_cast<dct::DenseRecBatcher*>(h)->SetShuffleEpoch(epoch) ? 1 : 0;
+  });
 }
 
 int dct_denserec_bytes_read(dct_denserec_t h, size_t* out) {

@@ -60,6 +60,11 @@ class DenseRecBatcher {
 
   void BeforeFirst();
   size_t BytesRead() const { return bytes_read_; }
+  // Pin the shuffle permutation the next BeforeFirst samples (mid-epoch
+  // resume; InputSplit::SetShuffleEpoch). False when nothing shuffles.
+  bool SetShuffleEpoch(unsigned epoch) {
+    return split_->SetShuffleEpoch(epoch);
+  }
 
  private:
   bool AdvanceRecord();  // load + validate the next record; false at end

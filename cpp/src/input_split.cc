@@ -847,7 +847,15 @@ void PrefetchSplit::EnsureStarted() {
 
 void PrefetchSplit::BeforeFirst() {
   if (current_ != nullptr) pipe_.Recycle(&current_);
-  if (started_) pipe_.BeforeFirst();
+  if (started_) {
+    pipe_.BeforeFirst();
+  } else {
+    // the pipeline starts producing from the source's CURRENT state
+    // (PipelineIter::Init does not rewind), so an unstarted BeforeFirst
+    // must walk the source chain synchronously — shuffled splits resample
+    // their permutation here, which a pinned SetShuffleEpoch relies on
+    src_->SourceBeforeFirst();
+  }
 }
 
 bool PrefetchSplit::NextChunk(Blob* out) {

@@ -16,6 +16,7 @@
 #ifndef DCT_INPUT_SPLIT_H_
 #define DCT_INPUT_SPLIT_H_
 
+#include <atomic>
 #include <memory>
 #include <string>
 #include <vector>
@@ -36,6 +37,17 @@ class InputSplit {
   virtual ~InputSplit() = default;
   // restart this part from its beginning (re-shuffles shuffled variants)
   virtual void BeforeFirst() = 0;
+  // Pin the permutation the NEXT BeforeFirst() samples: shuffled variants
+  // derive their per-epoch order from (seed, epoch), so a checkpoint that
+  // records the epoch can replay the exact visit order after a restart —
+  // without this, a resumed skip-prefix walks a different permutation and
+  // silently duplicates/drops rows (mid-epoch resume, device_iter.py).
+  // Returns false when nothing in the split chain shuffles (ordering is
+  // epoch-independent and resume is safe anyway).
+  virtual bool SetShuffleEpoch(unsigned epoch) {
+    (void)epoch;
+    return false;
+  }
   // next single record; false at end of part
   virtual bool NextRecord(Blob* out) = 0;
   // next raw chunk of whole records; false at end of part
@@ -228,6 +240,10 @@ class IndexedRecordIOSplit : public InputSplit, public RecordChunkSource {
   bool NextChunk(Blob* out) override;
   size_t GetTotalSize() override { return total_size_; }
   void ResetPartition(unsigned rank, unsigned nsplit) override;
+  bool SetShuffleEpoch(unsigned epoch) override {
+    epoch_.store(epoch, std::memory_order_relaxed);
+    return shuffle_;
+  }
 
   bool FillChunkBuffer(std::vector<char>* buf) override;
   bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
@@ -248,7 +264,10 @@ class IndexedRecordIOSplit : public InputSplit, public RecordChunkSource {
   size_t batch_size_;
   bool shuffle_;
   int seed_;
-  unsigned epoch_ = 0;
+  // written by SetShuffleEpoch on the control thread, read/bumped inside
+  // BeforeFirst on the prefetch producer thread (the pipe's mutex orders
+  // the two; atomic removes the formal data race)
+  std::atomic<unsigned> epoch_{0};
   std::vector<char> chunk_;
   size_t cursor_ = 0;
   std::string assembled_;
@@ -276,6 +295,9 @@ class CachedSplit : public InputSplit, public RecordChunkSource {
   void HintChunkSize(size_t bytes) override { base_->HintChunkSize(bytes); }
   size_t GetTotalSize() override { return base_->GetTotalSize(); }
   void ResetPartition(unsigned rank, unsigned nsplit) override;
+  bool SetShuffleEpoch(unsigned epoch) override {
+    return base_->SetShuffleEpoch(epoch);
+  }
 
   bool FillChunkBuffer(std::vector<char>* buf) override;
   bool ExtractRecordAt(char* data, size_t valid, size_t* cursor,
@@ -313,6 +335,10 @@ class ShuffleSplit : public InputSplit {
   void HintChunkSize(size_t bytes) override { base_->HintChunkSize(bytes); }
   size_t GetTotalSize() override { return base_->GetTotalSize(); }
   void ResetPartition(unsigned rank, unsigned nsplit) override;
+  bool SetShuffleEpoch(unsigned epoch) override {
+    epoch_.store(epoch, std::memory_order_relaxed);
+    return true;
+  }
 
  private:
   bool AdvanceSubPart();
@@ -320,7 +346,7 @@ class ShuffleSplit : public InputSplit {
   std::unique_ptr<InputSplit> base_;
   unsigned part_, nsplit_, num_shuffle_parts_;
   int seed_;
-  unsigned epoch_ = 0;
+  std::atomic<unsigned> epoch_{0};  // see IndexedRecordIOSplit::epoch_
   std::vector<unsigned> order_;
   size_t cur_ = 0;
 };
@@ -341,6 +367,9 @@ class PrefetchSplit : public InputSplit {
   void HintChunkSize(size_t bytes) override { base_->HintChunkSize(bytes); }
   size_t GetTotalSize() override { return base_->GetTotalSize(); }
   void ResetPartition(unsigned rank, unsigned nsplit) override;
+  bool SetShuffleEpoch(unsigned epoch) override {
+    return base_->SetShuffleEpoch(epoch);
+  }
 
  private:
   struct Cell {

@@ -154,8 +154,23 @@ namespace {
 // Optional per-row arrays must be absent or full-length: the C ABI exposes
 // them as dense parallel arrays, so ragged input (e.g. libsvm rows mixing
 // `idx:val` and bare `idx` features) must fail loudly, not misalign.
+// The offset checks guard the binary rec lane: LoadAppend validates vector
+// LENGTHS against the stream, but a bit-flipped record can carry
+// non-monotone or inflated offset VALUES that would underflow
+// offset[r+1]-offset[r] in the batcher fills and index out of bounds —
+// they must die here, not in a memcpy.
 template <typename IndexType>
 void ValidateBlock(const RowBlockContainer<IndexType>& b) {
+  DCT_CHECK(b.offset.size() == b.label.size() + 1 && b.offset.front() == 0)
+      << "corrupt row block: " << b.offset.size() << " offsets for "
+      << b.label.size() << " rows";
+  DCT_CHECK(b.offset.back() == b.index.size())
+      << "corrupt row block: final offset " << b.offset.back()
+      << " does not match " << b.index.size() << " features";
+  for (size_t i = 1; i < b.offset.size(); ++i) {
+    DCT_CHECK(b.offset[i - 1] <= b.offset[i])
+        << "corrupt row block: offsets decrease at row " << (i - 1);
+  }
   DCT_CHECK(b.ValueCount() == 0 || b.ValueCount() == b.index.size())
       << "inconsistent input: some features have explicit values and some "
          "do not (" << b.ValueCount() << " values for " << b.index.size()
@@ -267,48 +282,117 @@ LibSVMParser<IndexType>::LibSVMParser(
   indexing_mode_ = param.indexing_mode;
 }
 
-// reference src/data/libsvm_parser.h:87-169
+namespace {
+// Advance past the current line: to just after the next '\n'/'\r', or end.
+inline const char* SkipToEol(const char* p, const char* end) {
+  const char* nl =
+      static_cast<const char*>(memchr(p, '\n', static_cast<size_t>(end - p)));
+  const char* limit = nl == nullptr ? end : nl;
+  const char* cr =
+      static_cast<const char*>(memchr(p, '\r', static_cast<size_t>(limit - p)));
+  const char* term = cr == nullptr ? limit : cr;
+  return term == end ? end : term + 1;
+}
+
+inline bool IsEolChar(char c) { return c == '\n' || c == '\r'; }
+}  // namespace
+
+// reference src/data/libsvm_parser.h:87-169. Single-pass tokenizer: rows
+// and tokens are recognized in the same scan (newlines terminate the token
+// loop directly), instead of pre-scanning each line for its end and then
+// re-walking it — one traversal of the chunk instead of three. Semantics
+// (comment/blank lines, label[:weight], qid:, bare-index features,
+// discard-line-on-garbage, CRLF/CR/NOEOL) match the line-oriented form;
+// tests/test_native_parser.py pins them.
 template <typename IndexType>
 void LibSVMParser<IndexType>::ParseBlock(const char* begin, const char* end,
                                          RowBlockContainer<IndexType>* out) {
+  // feature ids below 10 digits accumulate in a u64 without overflow; wider
+  // tokens delegate to ParseNum for exact from_chars overflow semantics
+  constexpr int kFastIdxDigits = sizeof(IndexType) == 8 ? 19 : 9;
   out->Clear();
   IndexType min_feat = std::numeric_limits<IndexType>::max();
   const char* p = SkipUTF8BOM(begin, end);
   while (p != end) {
-    const char* line_end;
-    const char* next = LineSpan(p, end, &line_end);
-    const char* cur = SkipBlankOrComment(p, line_end);
-    p = next;
-    // label[:weight]
-    float label, weight;
-    const char* after;
-    int r = ParsePair<float, float>(cur, line_end, &after, &label, &weight);
-    if (r < 1) continue;  // blank or comment-only line
-    if (r == 2) out->weight.push_back(weight);
+    // between rows: swallow blanks and empty lines in one skip
+    while (p != end && (IsBlankChar(*p) || IsEolChar(*p))) ++p;
+    if (p == end) break;
+    if (*p == '#') {  // comment-only line
+      p = SkipToEol(p, end);
+      continue;
+    }
+    // label[:weight] — ParseNum stops at any non-numeric char, so the
+    // chunk end doubles as the line bound here
+    float label;
+    if (!ParseNum<float>(p, end, &p, &label)) {
+      p = SkipToEol(p, end);  // garbage line: discard (ParsePair contract)
+      continue;
+    }
+    if (p != end && *p == ':') {
+      float weight;
+      const char* wp;
+      if (ParseNum<float>(p + 1, end, &wp, &weight)) {
+        out->weight.push_back(weight);
+        p = wp;
+      }
+      // ":garbage" leaves p at ':' — the token loop below then discards
+      // the rest of the line, matching the line-oriented behavior
+    }
     out->label.push_back(label);
-    cur = after;
-    // optional qid:n
-    while (cur != line_end && *cur == ' ') ++cur;
-    if (line_end - cur > 4 && std::memcmp(cur, "qid:", 4) == 0) {
+    // optional qid:n (space-separated, reference libsvm_parser.h:116-126)
+    while (p != end && *p == ' ') ++p;
+    if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
       uint64_t qid = 0;
       const char* qp;
-      if (ParseNum<uint64_t>(cur + 4, line_end, &qp, &qid)) {
+      if (ParseNum<uint64_t>(p + 4, end, &qp, &qid)) {
         out->qid.push_back(qid);
-        cur = qp;
+        p = qp;
       }
     }
-    // index[:value] tokens
-    while (cur != line_end) {
-      cur = SkipBlankOrComment(cur, line_end);
-      IndexType idx;
-      float value;
-      int rr =
-          ParsePair<IndexType, float>(cur, line_end, &after, &idx, &value);
-      cur = after;
-      if (rr < 1) continue;
-      out->index.push_back(idx);
-      min_feat = std::min(min_feat, idx);
-      if (rr == 2) out->value.push_back(value);
+    // index[:value] tokens until end of line
+    while (true) {
+      while (p != end && IsBlankChar(*p)) ++p;
+      if (p == end) break;
+      const char c = *p;
+      if (IsEolChar(c)) {
+        ++p;
+        break;
+      }
+      if (c == '#') {
+        p = SkipToEol(p, end);
+        break;
+      }
+      // feature id: inline digit loop for the short common case
+      uint64_t idx = 0;
+      int nd = 0;
+      const char* tok = p;
+      while (p != end && IsDigitChar(*p)) {
+        idx = idx * 10 + static_cast<uint64_t>(*p - '0');
+        ++p;
+        if (++nd > kFastIdxDigits) break;
+      }
+      IndexType idx_t;
+      if (nd == 0 || nd > kFastIdxDigits) {
+        // '+'-prefixed, overflowing, or non-numeric token: exact fallback
+        if (!ParseNum<IndexType>(tok, end, &p, &idx_t)) {
+          p = SkipToEol(tok, end);  // discard rest of line
+          break;
+        }
+      } else {
+        idx_t = static_cast<IndexType>(idx);
+      }
+      out->index.push_back(idx_t);
+      min_feat = std::min(min_feat, idx_t);
+      if (p != end && *p == ':') {
+        float value;
+        const char* vp;
+        if (ParseNum<float>(p + 1, end, &vp, &value)) {
+          out->value.push_back(value);
+          p = vp;
+        }
+        // ":garbage": p stays at ':' and the next iteration discards the
+        // line, matching ParsePair's r==1-then-fail sequence
+      }
     }
     out->offset.push_back(out->index.size());
   }
@@ -703,7 +787,15 @@ void ThreadedParser<IndexType>::EnsureStarted() {
 template <typename IndexType>
 void ThreadedParser<IndexType>::BeforeFirst() {
   if (current_ != nullptr) pipe_.Recycle(&current_);
-  if (started_) pipe_.BeforeFirst();
+  if (started_) {
+    pipe_.BeforeFirst();
+  } else {
+    // unstarted pipelines begin from the source's current state, so the
+    // rewind must reach the split chain synchronously (shuffled splits
+    // resample their permutation in BeforeFirst — see
+    // PrefetchSplit::BeforeFirst for the same rule)
+    base_->BeforeFirst();
+  }
 }
 
 template <typename IndexType>

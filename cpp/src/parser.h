@@ -58,6 +58,13 @@ class Parser {
     return true;
   }
   virtual size_t BytesRead() const = 0;
+  // Pin the shuffle permutation the next BeforeFirst samples (mid-epoch
+  // resume across restarts; InputSplit::SetShuffleEpoch). False when the
+  // underlying split chain does not shuffle.
+  virtual bool SetShuffleEpoch(unsigned epoch) {
+    (void)epoch;
+    return false;
+  }
 
   // Factory (reference src/data.cc:62-85 CreateParser_): format is
   // "libsvm" | "csv" | "libfm" | "auto" (resolved from ?format= URI arg).
@@ -82,6 +89,9 @@ class TextParserBase : public Parser<IndexType> {
   bool NextBlockMove(RowBlockContainer<IndexType>* out) override;
   size_t BytesRead() const override {
     return bytes_read_.load(std::memory_order_relaxed);
+  }
+  bool SetShuffleEpoch(unsigned epoch) override {
+    return source_->SetShuffleEpoch(epoch);
   }
 
   // Parse [begin, end) — whole lines — into *out. Public for testing.
@@ -195,6 +205,10 @@ class DiskCacheParser : public Parser<IndexType> {
   const RowBlockContainer<IndexType>* NextBlock() override;
   bool NextBlockMove(RowBlockContainer<IndexType>* out) override;
   size_t BytesRead() const override { return base_->BytesRead(); }
+  bool SetShuffleEpoch(unsigned epoch) override {
+    // unreachable in practice: Create forbids shuffle + #cachefile
+    return base_->SetShuffleEpoch(epoch);
+  }
 
  private:
   void FinalizeCache();
@@ -229,6 +243,9 @@ class ThreadedParser : public Parser<IndexType> {
   const RowBlockContainer<IndexType>* NextBlock() override;
   bool NextBlockMove(RowBlockContainer<IndexType>* out) override;
   size_t BytesRead() const override { return base_->BytesRead(); }
+  bool SetShuffleEpoch(unsigned epoch) override {
+    return base_->SetShuffleEpoch(epoch);
+  }
 
  private:
   struct Cell {

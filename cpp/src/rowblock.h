@@ -119,10 +119,13 @@ struct RowBlockContainer {
   // binary ingest hot path, parser.cc RecParser::ParseBlock). Returns
   // false when the stream is exhausted before the first field.
   bool LoadAppend(Stream* s) {
+    // a prior Load() of a corrupt n=0 image can leave offset empty; the
+    // rebase below reads offset.back(), so re-establish the invariant
+    if (offset.empty()) offset.assign(1, 0);
     uint64_t n;
     if (s->Read(&n, 8) != 8) return false;
     if (!serial::NativeIsLE()) n = serial::ByteSwap(n);
-    DCT_CHECK(n <= s->BytesRemaining() / 8 + 1)
+    DCT_CHECK(n <= s->BytesRemaining() / 8)
         << "corrupt row-block image: offset count " << n
         << " exceeds the remaining payload";
     // Offsets: the wire image carries n absolute offsets starting with a 0;

@@ -1,0 +1,115 @@
+// Host-parse microbenchmark: times ParseBlock on synthetic corpora shaped
+// like the bench.py datasets (HIGGS-ish libsvm, dense csv, libfm triples).
+// Build:  make -C cpp benchparse   Run: ./dmlc_core_tpu/_native/bench_parse
+// This is the fast inner loop for parser optimization work — it isolates
+// the single-core ParseBlock cost from the split/pipeline/device stages
+// (reference keeps equivalent manual probes in test/, e.g.
+// test/split_read_test.cc:27-33 printing MB/s).
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "../src/parser.h"
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double Secs(Clock::time_point a, Clock::time_point b) {
+  return std::chrono::duration<double>(b - a).count();
+}
+
+std::string MakeLibsvm(int rows, int feats, uint32_t seed) {
+  std::mt19937 rng(seed);
+  std::uniform_real_distribution<double> val(-3.0, 3.0);
+  std::string out;
+  out.reserve(static_cast<size_t>(rows) * (feats * 11 + 3));
+  char buf[64];
+  for (int r = 0; r < rows; ++r) {
+    out += (rng() & 1) ? '1' : '0';
+    for (int f = 0; f < feats; ++f) {
+      snprintf(buf, sizeof(buf), " %d:%.6f", f, val(rng));
+      out += buf;
+    }
+    out += '\n';
+  }
+  return out;
+}
+
+std::string MakeCSV(int rows, int cols, uint32_t seed) {
+  std::mt19937 rng(seed);
+  std::uniform_real_distribution<double> val(-3.0, 3.0);
+  std::string out;
+  out.reserve(static_cast<size_t>(rows) * (cols * 10 + 3));
+  char buf[64];
+  for (int r = 0; r < rows; ++r) {
+    out += (rng() & 1) ? '1' : '0';
+    for (int c = 0; c < cols; ++c) {
+      snprintf(buf, sizeof(buf), ",%.6f", val(rng));
+      out += buf;
+    }
+    out += '\n';
+  }
+  return out;
+}
+
+std::string MakeLibfm(int rows, int feats, uint32_t seed) {
+  std::mt19937 rng(seed);
+  std::uniform_real_distribution<double> val(-3.0, 3.0);
+  std::string out;
+  out.reserve(static_cast<size_t>(rows) * (feats * 14 + 3));
+  char buf[64];
+  for (int r = 0; r < rows; ++r) {
+    out += (rng() & 1) ? '1' : '0';
+    for (int f = 0; f < feats; ++f) {
+      snprintf(buf, sizeof(buf), " %d:%d:%.6f", f % 7, f, val(rng));
+      out += buf;
+    }
+    out += '\n';
+  }
+  return out;
+}
+
+template <typename ParserT>
+void BenchFormat(const char* name, const std::string& corpus,
+                 const std::map<std::string, std::string>& args, int reps) {
+  ParserT parser(nullptr, args, 1);
+  dct::RowBlockContainer<uint32_t> out;
+  // warm
+  parser.ParseBlock(corpus.data(), corpus.data() + corpus.size(), &out);
+  const size_t rows = out.Size();
+  double best = 1e30;
+  for (int i = 0; i < reps; ++i) {
+    auto t0 = Clock::now();
+    parser.ParseBlock(corpus.data(), corpus.data() + corpus.size(), &out);
+    auto t1 = Clock::now();
+    double dt = Secs(t0, t1);
+    if (dt < best) best = dt;
+  }
+  printf("%-8s %7.1f MB/s  %9.0f rows/s  (%zu rows, %.1f MB, best of %d)\n",
+         name, corpus.size() / best / 1e6, rows / best, rows,
+         corpus.size() / 1e6, reps);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int rows = argc > 1 ? atoi(argv[1]) : 100000;
+  int reps = argc > 2 ? atoi(argv[2]) : 7;
+  {
+    std::string c = MakeLibsvm(rows, 28, 7);
+    BenchFormat<dct::LibSVMParser<uint32_t>>("libsvm", c, {}, reps);
+  }
+  {
+    std::string c = MakeCSV(rows, 28, 7);
+    BenchFormat<dct::CSVParser<uint32_t>>("csv", c, {}, reps);
+  }
+  {
+    std::string c = MakeLibfm(rows, 28, 7);
+    BenchFormat<dct::LibFMParser<uint32_t>>("libfm", c, {}, reps);
+  }
+  return 0;
+}

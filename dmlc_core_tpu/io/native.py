@@ -118,6 +118,7 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
                               c.POINTER(vp)],
         "dct_parser_next_block": [vp, c.POINTER(RowBlockC), c.POINTER(i)],
         "dct_parser_before_first": [vp],
+        "dct_parser_set_epoch": [vp, u, c.POINTER(c.c_int32)],
         "dct_parser_bytes_read": [vp, c.POINTER(sz)],
         "dct_parser_free": [vp],
         "dct_webhdfs_set_delegation_token": [c.c_char_p],
@@ -134,6 +135,7 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_batcher_fill_dense": [vp, vp, c.c_int32, c.c_uint64, vp, vp, vp,
                                    vp],
         "dct_batcher_before_first": [vp],
+        "dct_batcher_set_epoch": [vp, u, c.POINTER(c.c_int32)],
         "dct_batcher_bytes_read": [vp, c.POINTER(sz)],
         "dct_batcher_free": [vp],
         "dct_denserec_create": [c.c_char_p, u, u, c.c_uint64, c.c_uint32,
@@ -143,6 +145,7 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_denserec_fill": [vp, vp, c.c_int32, c.c_uint64, vp, vp, vp,
                               c.POINTER(c.c_uint64)],
         "dct_denserec_before_first": [vp],
+        "dct_denserec_set_epoch": [vp, u, c.POINTER(c.c_int32)],
         "dct_denserec_bytes_read": [vp, c.POINTER(sz)],
         "dct_denserec_free": [vp],
     }
@@ -528,6 +531,15 @@ class NativeParser:
         """Restart parsing from the first row (new epoch)."""
         _check(lib().dct_parser_before_first(self._h))
 
+    def set_epoch(self, epoch: int) -> bool:
+        """Pin the shuffle permutation the next before_first() samples
+        (mid-epoch resume across restarts). Returns False when nothing in
+        the split chain shuffles — ordering is then epoch-independent."""
+        supported = ctypes.c_int32()
+        _check(lib().dct_parser_set_epoch(self._h, epoch,
+                                          ctypes.byref(supported)))
+        return bool(supported.value)
+
     def bytes_read(self) -> int:
         """Bytes consumed from the underlying source so far (reference
         Parser::BytesRead)."""
@@ -654,6 +666,15 @@ class NativeBatcher:
         """Restart batching from the first row (new epoch)."""
         _check(lib().dct_batcher_before_first(self._h))
 
+    def set_epoch(self, epoch: int) -> bool:
+        """Pin the shuffle permutation the next before_first() samples
+        (mid-epoch resume across restarts). Returns False when nothing in
+        the split chain shuffles — ordering is then epoch-independent."""
+        supported = ctypes.c_int32()
+        _check(lib().dct_batcher_set_epoch(self._h, epoch,
+                                           ctypes.byref(supported)))
+        return bool(supported.value)
+
     def bytes_read(self) -> int:
         """Bytes consumed from the underlying source so far."""
         out = ctypes.c_size_t()
@@ -732,6 +753,14 @@ class NativeDenseRecBatcher:
     def before_first(self) -> None:
         """Restart from the first record (new epoch)."""
         _check(lib().dct_denserec_before_first(self._h))
+
+    def set_epoch(self, epoch: int) -> bool:
+        """Pin the shuffle permutation the next before_first() samples.
+        Returns False (the dense-rec lane's split does not shuffle)."""
+        supported = ctypes.c_int32()
+        _check(lib().dct_denserec_set_epoch(self._h, epoch,
+                                            ctypes.byref(supported)))
+        return bool(supported.value)
 
     def bytes_read(self) -> int:
         """Record bytes consumed from the source so far."""

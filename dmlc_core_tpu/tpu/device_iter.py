@@ -392,6 +392,11 @@ class HostBatcher:
         self._pending_rows = 0
         self._done = False
 
+    def set_epoch(self, epoch: int) -> bool:
+        """Pin the shuffle permutation the next reset() samples (mid-epoch
+        resume). False when the underlying split chain does not shuffle."""
+        return self.parser.set_epoch(epoch)
+
 
 class NativeHostBatcher:
     """HostBatcher drop-in backed by the C++ PaddedBatcher (cpp/src/batcher.h).
@@ -535,6 +540,11 @@ class NativeHostBatcher:
         survives."""
         self._b.before_first()
 
+    def set_epoch(self, epoch: int) -> bool:
+        """Pin the shuffle permutation the next reset() samples (mid-epoch
+        resume). False when the underlying split chain does not shuffle."""
+        return self._b.set_epoch(epoch)
+
     def bytes_read(self) -> int:
         """Bytes consumed from the underlying source so far."""
         return self._b.bytes_read()
@@ -607,6 +617,11 @@ class DenseRecHostBatcher:
     def reset(self) -> None:
         """Restart from the first record (new epoch); the pool survives."""
         self._b.before_first()
+
+    def set_epoch(self, epoch: int) -> bool:
+        """Pin the shuffle permutation the next reset() samples. Always
+        False today: the dense-rec split does not shuffle."""
+        return self._b.set_epoch(epoch)
 
     def bytes_read(self) -> int:
         """Record bytes consumed from the source so far."""
@@ -687,6 +702,12 @@ class DeviceRowBlockIter:
         # mid-epoch resume position (state()/restore())
         self.batches_consumed = 0
         self._skip_batches = 0
+        # epoch ordinal: selects the shuffle permutation for shuffled URIs
+        # (?shuffle_parts= / ?index=&shuffle=1). The split samples epoch 0's
+        # permutation at construction; before_first() advances it. state()
+        # records it so restore() can replay the exact visit order — a
+        # batch prefix under a different permutation is different data.
+        self._epoch = 0
 
     # -- staging threads -----------------------------------------------------
     # Queue ops are stop-aware: a blocking put/get could otherwise race the
@@ -821,7 +842,8 @@ class DeviceRowBlockIter:
         batch_rows) that make the count a position. Save it next to the
         model checkpoint (utils/checkpoint.py) and hand it to restore()
         after a preemption — the TPU-pod recovery story."""
-        return dict(self._identity, batches_consumed=self.batches_consumed)
+        return dict(self._identity, batches_consumed=self.batches_consumed,
+                    epoch=self._epoch)
 
     def restore(self, state: Dict[str, Any]) -> None:
         """Rewind to the epoch start, then skip `state['batches_consumed']`
@@ -840,7 +862,12 @@ class DeviceRowBlockIter:
                     f"but this iterator uses {ours!r}; resuming a batch "
                     f"count across a different stream slicing would read "
                     f"the wrong rows")
-        self.before_first()
+        # replay the checkpoint's epoch so shuffled URIs rewind into the
+        # SAME permutation the prefix was counted under (split-level
+        # SetShuffleEpoch; no-op for unshuffled streams, where ordering is
+        # epoch-independent)
+        self._epoch = int(state.get("epoch", 0))
+        self._reset_stream()
         self._skip_batches = int(state.get("batches_consumed", 0))
         self.batches_consumed = self._skip_batches
 
@@ -867,8 +894,19 @@ class DeviceRowBlockIter:
         self._stop.clear()
 
     def before_first(self) -> None:
-        """Restart iteration (reference DataIter::BeforeFirst)."""
+        """Restart iteration as the next epoch (reference
+        DataIter::BeforeFirst; shuffled URIs resample their permutation)."""
+        self._epoch += 1
+        self._reset_stream()
+
+    def _reset_stream(self) -> None:
+        """Rewind to the start of epoch ``self._epoch``."""
         self._join_threads()
+        if hasattr(self.batcher, "set_epoch"):
+            # pin the permutation deterministically to the epoch ordinal
+            # (instead of the split's own BeforeFirst counter, which a
+            # process restart would silently reset to 0)
+            self.batcher.set_epoch(self._epoch)
         self.batcher.reset()
         self.batches_consumed = 0
         self._skip_batches = 0

@@ -24,6 +24,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Honor JAX_PLATFORMS even where a site config (e.g. an axon install) pins
+# the platform before env vars are consulted: site plugins register through
+# jax.config, so requesting the platform through jax.config outranks them.
+# This is what lets the test suite run this example hermetically on CPU
+# while production runs pick up the TPU default.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import numpy as np  # noqa: E402
 
 from dmlc_core_tpu.models import LinearLearner  # noqa: E402
@@ -82,9 +92,10 @@ def main() -> int:
             # count under different batch_rows/uri/part is different data.
             data_state = {
                 k: int(extra[k]) if k in ("batches_consumed", "batch_rows",
-                                          "part", "npart") else extra[k]
+                                          "part", "npart", "epoch")
+                else extra[k]
                 for k in ("batches_consumed", "batch_rows", "part",
-                          "npart", "uri", "fmt") if k in extra}
+                          "npart", "uri", "fmt", "epoch") if k in extra}
 
     it = DeviceRowBlockIter(args.uri, part=part, npart=npart, mesh=mesh,
                             batch_rows=args.batch_rows, dense_dtype="bf16")

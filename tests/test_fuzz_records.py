@@ -11,7 +11,8 @@ import pytest
 from dmlc_core_tpu.base import DMLCError
 from dmlc_core_tpu.io.convert import rows_to_dense_recordio, rows_to_recordio
 from dmlc_core_tpu.io.native import NativeParser
-from dmlc_core_tpu.tpu.device_iter import DenseRecHostBatcher
+from dmlc_core_tpu.tpu.device_iter import (DenseRecHostBatcher,
+                                           NativeHostBatcher)
 
 
 def _make_sources(tmp_path, rows=800):
@@ -75,6 +76,65 @@ def test_random_mutations_never_crash(tmp_path, kind):
     # ever succeeds is mutating dead bytes; one that only errors suggests
     # resync is broken)
     assert outcomes["ok"] > 0 and outcomes["error"] > 0, outcomes
+
+
+def _drive_rec_batcher(path):
+    """Full batcher fill path: parse -> ValidateBlock -> FillCSR/FillDense.
+    Corrupt offset VALUES that pass the length checks would otherwise
+    underflow offset[r+1]-offset[r] inside the fills and memcpy out of
+    bounds (ADVICE r3: the fuzz suite must drive the batcher, not just the
+    parser)."""
+    n = 0
+    b = NativeHostBatcher(str(path), fmt="rec", batch_rows=128)
+    try:
+        while True:
+            batch = b.next_batch()
+            if batch is None:
+                return n
+            n += batch.total_rows
+    finally:
+        b.close()
+
+
+def test_random_mutations_never_crash_batcher_path(tmp_path):
+    rec_bytes, _ = _make_sources(tmp_path)
+    rng = np.random.default_rng(1234)
+    target = tmp_path / "mutb.rec"
+    outcomes = {"ok": 0, "error": 0}
+    for trial in range(120):
+        data = bytearray(rec_bytes)
+        for _ in range(int(rng.integers(1, 4))):
+            pos = int(rng.integers(0, len(data)))
+            data[pos] = int(rng.integers(0, 256))
+        target.write_bytes(bytes(data))
+        try:
+            n = _drive_rec_batcher(target)
+            assert 0 <= n <= 800, n
+            outcomes["ok"] += 1
+        except DMLCError:
+            outcomes["error"] += 1
+    assert outcomes["ok"] > 0 and outcomes["error"] > 0, outcomes
+
+
+def test_corrupt_offsets_rejected_not_crash(tmp_path):
+    """Targeted offset-value corruption (not random): bump bytes inside the
+    first record's offset array so lengths stay plausible but values break
+    monotonicity/final-sum invariants — ValidateBlock must throw."""
+    rec_bytes, _ = _make_sources(tmp_path)
+    target = tmp_path / "off.rec"
+    saw_error = False
+    # the first record's payload starts after the 8B RecordIO header + 8B
+    # payload magic/flags; its offset vector begins with [count][0, ...]
+    for ofs in range(24, 24 + 64, 8):
+        data = bytearray(rec_bytes)
+        data[ofs] ^= 0xFF  # inflate one offset value
+        target.write_bytes(bytes(data))
+        try:
+            n = _drive_rec_batcher(target)
+            assert 0 <= n <= 800, n
+        except DMLCError:
+            saw_error = True
+    assert saw_error  # at least one corrupted offset must be caught
 
 
 @pytest.mark.parametrize("kind", ["rec", "drec"])

@@ -166,3 +166,80 @@ def test_restore_auto_fmt_matches_explicit(tmp_path):
                             to_device=False, dense_dtype="bf16") as it2:
         it2.restore(st)  # must not raise
         assert sum(1 for _ in it2) == 4  # 5 batches - 1 consumed
+
+
+def write_id_libsvm(path, rows, features=4):
+    """Rows whose feature 0 carries the row id — resume-order probes."""
+    rng = np.random.default_rng(5)
+    with open(path, "w") as f:
+        for i in range(rows):
+            feats = " ".join(
+                f"{j}:{rng.uniform():.5f}" for j in range(1, features))
+            f.write(f"{i % 2} 0:{float(i):.1f} {feats}\n")
+    return path
+
+
+def test_shuffled_restore_replays_permutation(tmp_path):
+    """ADVICE r3 (high): restore() under ?shuffle_parts= must resume into
+    the SAME permutation the checkpoint's batch prefix was counted under —
+    including in a fresh process (here: a fresh iterator), where the
+    split's internal epoch counter would otherwise restart at 0."""
+    src = write_id_libsvm(tmp_path / "s.libsvm", rows=960)
+    uri = str(src) + "?shuffle_parts=4"
+
+    # reference pass: epoch 0, then epoch 1 (reshuffled)
+    with DeviceRowBlockIter(uri, batch_rows=128, to_device=False) as ref:
+        ep0 = [np.asarray(b.x, np.float32).copy() for b in ref]
+        ref.before_first()
+        ep1 = [np.asarray(b.x, np.float32).copy() for b in ref]
+    # the reshuffle must actually change the visit order
+    assert not all(np.array_equal(a, c) for a, c in zip(ep0, ep1))
+
+    # fresh iterator: advance to epoch 1, consume 3 batches, checkpoint
+    with DeviceRowBlockIter(uri, batch_rows=128, to_device=False) as it:
+        it.before_first()
+        got = 0
+        for b in it:
+            got += 1
+            if got == 3:
+                state = it.state()
+                break
+    assert state["epoch"] == 1 and state["batches_consumed"] == 3
+
+    # fresh "restarted process": restore must replay epoch 1's permutation
+    with DeviceRowBlockIter(uri, batch_rows=128, to_device=False) as it2:
+        it2.restore(state)
+        tail = [np.asarray(b.x, np.float32).copy() for b in it2]
+    assert len(tail) == len(ep1) - 3
+    for a, c in zip(tail, ep1[3:]):
+        assert np.array_equal(a, c)
+
+
+def test_indexed_shuffled_restore_replays_permutation(tmp_path):
+    """Same contract for the exact per-record shuffle (?index=&shuffle=1)."""
+    from dmlc_core_tpu.io.convert import (build_recordio_index,
+                                          rows_to_recordio)
+    src = write_id_libsvm(tmp_path / "x.libsvm", rows=640)
+    rec = str(tmp_path / "x.rec")
+    rows_to_recordio(str(src), rec, rows_per_record=32)
+    build_recordio_index(rec)
+    uri = rec + "?index=1&shuffle=1&shuffle_batch=8"
+
+    with DeviceRowBlockIter(uri, fmt="rec", batch_rows=128,
+                            to_device=False) as ref:
+        ref.before_first()  # epoch 1
+        ep1 = [np.asarray(b.x, np.float32).copy() for b in ref]
+
+    with DeviceRowBlockIter(uri, fmt="rec", batch_rows=128,
+                            to_device=False) as it:
+        it.before_first()
+        next(iter(it))
+        state = it.state()
+
+    with DeviceRowBlockIter(uri, fmt="rec", batch_rows=128,
+                            to_device=False) as it2:
+        it2.restore(state)
+        tail = [np.asarray(b.x, np.float32).copy() for b in it2]
+    assert len(tail) == len(ep1) - 1
+    for a, c in zip(tail, ep1[1:]):
+        assert np.array_equal(a, c)
