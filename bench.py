@@ -42,6 +42,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# Honor JAX_PLATFORMS even under site configs that pin the platform before
+# env vars are consulted (same rule as examples/train.py): lets the bench
+# harness itself be smoke-tested on CPU while real runs use the TPU.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".bench_cache")
 
@@ -131,14 +139,26 @@ def attainable_contiguous_bw(sharding, nbytes: int) -> float:
     repeat from memory and inflate the ceiling."""
     import numpy as np
     import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    if isinstance(sharding, dict):
+        # per-leaf sharding of the packed batch tree: the 1-D probe buffer
+        # needs a plain leading-axis spec over the SAME mesh so the
+        # multi-chip ceiling still measures D parallel DMAs
+        any_leaf = next(iter(sharding.values()))
+        sharding = NamedSharding(any_leaf.mesh, PartitionSpec("data"))
+    ndev = 1
+    if sharding is not None:
+        ndev = int(np.prod([d for d in sharding.mesh.devices.shape]))
     n = max(nbytes // 4, 1 << 20)
+    n -= n % max(ndev, 1)  # divisible by the device count for P("data")
     buf = np.empty(n, np.float32)
     buf.fill(1.0)
     best = 0.0
     for i in range(3):
         buf[:: 4096 // 4] = float(i)  # dirty one word per page
         t0 = time.time()
-        arr = jax.device_put(buf, sharding)
+        arr = (jax.device_put(buf, sharding) if sharding is not None
+               else jax.device_put(buf))
         arr.block_until_ready()
         dt = time.time() - t0
         best = max(best, buf.nbytes / dt)

@@ -1,0 +1,201 @@
+#include "http_filesys.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "http.h"
+#include "http_stream.h"
+
+namespace dct {
+namespace {
+
+// Retry policy mirrors the S3 reader's defaults (reference
+// s3_filesys.cc:522-546: <=50 attempts, 100 ms); DCT_HTTP_MAX_RETRY /
+// DCT_HTTP_RETRY_SLEEP_MS override (the fault-injection tests shrink them).
+int EnvInt(const char* key, int dflt) {
+  const char* v = std::getenv(key);
+  return v != nullptr && *v != '\0' ? std::atoi(v) : dflt;
+}
+
+int MaxRetry() { return EnvInt("DCT_HTTP_MAX_RETRY", 50); }
+int RetrySleepMs() { return EnvInt("DCT_HTTP_RETRY_SLEEP_MS", 100); }
+
+void CheckPlainHttp(const URI& uri) {
+  if (uri.scheme == "https") {
+    throw Error(
+        "https:// is registered but the built-in client is plain-HTTP "
+        "(no TLS stack in-image; http.h). Route the object through "
+        "http://, an S3-compatible endpoint (S3_ENDPOINT), or a local "
+        "TLS-terminating proxy: " + uri.Str());
+  }
+}
+
+// Ranged GET stream with reconnect-at-offset (http_stream.h retry loop —
+// the same shape as the S3/WebHDFS readers).
+class HttpReadStream : public RetryingHttpReadStream {
+ public:
+  HttpReadStream(const URI& uri, size_t file_size, int max_retry,
+                 int retry_sleep_ms)
+      : RetryingHttpReadStream("http", file_size, max_retry, retry_sleep_ms),
+        uri_(uri) {}
+
+ protected:
+  void Connect() override {
+    std::string host;
+    int port;
+    SplitHostPort(uri_.host, &host, &port, 80);
+    auto conn = std::make_unique<HttpConnection>(host, port);
+    std::map<std::string, std::string> h;
+    h["Range"] = "bytes=" + std::to_string(pos_) + "-";
+    h["Accept-Encoding"] = "identity";
+    conn->SendRequest("GET", uri_.path.empty() ? "/" : uri_.path, h, "");
+    HttpResponse head;
+    conn->ReadResponseHead(&head);
+    if (head.status == 200 && pos_ != 0) {
+      // the server ignored Range (Python's http.server does): stream and
+      // discard the prefix so resume-at-offset still lands on the right
+      // byte — slower than a real ranged read, never wrong
+      char scratch[65536];
+      size_t left = pos_;
+      while (left > 0) {
+        size_t n = conn->ReadBody(
+            scratch, std::min(left, sizeof(scratch)));
+        if (n == 0) {
+          throw Error("http body ended before resume offset " +
+                      std::to_string(pos_) + ": " + uri_.Str());
+        }
+        left -= n;
+      }
+    } else if (head.status != 206 && head.status != 200) {
+      throw HttpStatusError(
+          "http GET " + uri_.Str() + " -> status " +
+          std::to_string(head.status), head.status);
+    }
+    conn_ = std::move(conn);
+  }
+
+ private:
+  URI uri_;
+};
+
+// HEAD the object; fall back to `Range: bytes=0-0` GET parsing
+// Content-Range when the server rejects HEAD.
+size_t RemoteSize(const URI& uri, bool allow_null, bool* found) {
+  std::string host;
+  int port;
+  SplitHostPort(uri.host, &host, &port, 80);
+  const std::string path = uri.path.empty() ? "/" : uri.path;
+  *found = true;
+  // HEAD by hand: Content-Length describes the WOULD-BE body — none
+  // follows, so the one-shot HttpRequest helper (which drains a body)
+  // would block on it
+  HttpResponse r;
+  {
+    HttpConnection conn(host, port);
+    conn.SendRequest("HEAD", path, {}, "");
+    conn.ReadResponseHead(&r);
+  }
+  if (r.status == 404 || r.status == 410) {
+    if (allow_null) {
+      *found = false;
+      return 0;
+    }
+    throw HttpStatusError("http object not found: " + uri.Str(), r.status);
+  }
+  if (r.status == 405 || r.status == 501) {  // HEAD unsupported
+    HttpResponse g = HttpRequest(host, port, "GET", path,
+                                 {{"Range", "bytes=0-0"}}, "");
+    if (g.status == 404 || g.status == 410) {  // same contract as HEAD 404
+      if (allow_null) {
+        *found = false;
+        return 0;
+      }
+      throw HttpStatusError("http object not found: " + uri.Str(),
+                            g.status);
+    }
+    auto it = g.headers.find("content-range");
+    if (g.status == 206 && it != g.headers.end()) {
+      // "bytes 0-0/TOTAL"
+      size_t slash = it->second.rfind('/');
+      if (slash != std::string::npos) {
+        return static_cast<size_t>(
+            std::strtoull(it->second.c_str() + slash + 1, nullptr, 10));
+      }
+    }
+    if (g.status == 200) return g.body.size();
+    throw HttpStatusError("http size probe failed for " + uri.Str() +
+                          " (status " + std::to_string(g.status) + ")",
+                          g.status);
+  }
+  if (r.status != 200) {
+    throw HttpStatusError("http HEAD " + uri.Str() + " -> status " +
+                          std::to_string(r.status), r.status);
+  }
+  auto it = r.headers.find("content-length");
+  if (it == r.headers.end()) {
+    throw Error("http server sent no Content-Length for " + uri.Str() +
+                "; ranged reads need a sized object");
+  }
+  return static_cast<size_t>(std::strtoull(it->second.c_str(), nullptr, 10));
+}
+
+}  // namespace
+
+HttpFileSystem* HttpFileSystem::GetInstance() {
+  static HttpFileSystem inst;
+  return &inst;
+}
+
+FileInfo HttpFileSystem::GetPathInfo(const URI& path) {
+  CheckPlainHttp(path);
+  bool found = true;
+  FileInfo info;
+  info.path = path;
+  info.size = RemoteSize(path, /*allow_null=*/false, &found);
+  info.type = FileType::kFile;
+  return info;
+}
+
+void HttpFileSystem::ListDirectory(const URI& path,
+                                   std::vector<FileInfo>* out) {
+  throw Error(
+      "http(s) filesystem cannot list directories (no listing protocol); "
+      "pass explicit file URIs or a ';'-separated list: " + path.Str());
+}
+
+Stream* HttpFileSystem::Open(const URI& path, const char* mode,
+                             bool allow_null) {
+  if (mode != nullptr && mode[0] == 'r') {
+    return OpenForRead(path, allow_null);
+  }
+  throw Error("http(s) filesystem is read-only; cannot open " + path.Str() +
+              " with mode '" + (mode ? mode : "") + "'");
+}
+
+SeekStream* HttpFileSystem::OpenForRead(const URI& path, bool allow_null) {
+  CheckPlainHttp(path);
+  bool found = true;
+  size_t size = RemoteSize(path, allow_null, &found);
+  if (!found) return nullptr;
+  return new HttpReadStream(path, size, MaxRetry(), RetrySleepMs());
+}
+
+namespace {
+// register http:// + https:// at load time (the reference dispatches both
+// to its S3 reader, src/io.cc:53)
+struct HttpRegistrar {
+  HttpRegistrar() {
+    FileSystem::RegisterScheme("http", [](const URI&) -> FileSystem* {
+      return HttpFileSystem::GetInstance();
+    });
+    FileSystem::RegisterScheme("https", [](const URI&) -> FileSystem* {
+      return HttpFileSystem::GetInstance();
+    });
+  }
+} http_registrar;
+}  // namespace
+
+}  // namespace dct

@@ -1,0 +1,40 @@
+// Read-only filesystem over plain HTTP.
+//
+// The reference routes `http://`/`https://` URIs to its curl-backed S3
+// reader so public objects can be read with ranged GETs
+// (/root/reference/src/io.cc:53). Here the plain-HTTP client (http.h)
+// backs a dedicated read-only filesystem instead: ranged GET streams with
+// reconnect-at-offset retries (http_stream.h, the same loop the S3 path
+// uses), HEAD-based path info, and graceful degradation to
+// skip-the-prefix when a server ignores Range (Python's http.server,
+// for one, serves 200/full-body).
+//
+// `https://` registers too, but the built-in client is plain-HTTP only
+// (http.h rationale: no TLS stack in-image) — opening an https URI
+// throws a clear error pointing at an http:// or S3-endpoint route.
+#ifndef DCT_HTTP_FILESYS_H_
+#define DCT_HTTP_FILESYS_H_
+
+#include <vector>
+
+#include "filesys.h"
+
+namespace dct {
+
+class HttpFileSystem : public FileSystem {
+ public:
+  static HttpFileSystem* GetInstance();
+
+  FileInfo GetPathInfo(const URI& path) override;
+  void ListDirectory(const URI& path, std::vector<FileInfo>* out) override;
+  Stream* Open(const URI& path, const char* mode,
+               bool allow_null = false) override;
+  SeekStream* OpenForRead(const URI& path, bool allow_null = false) override;
+
+ private:
+  HttpFileSystem() = default;
+};
+
+}  // namespace dct
+
+#endif  // DCT_HTTP_FILESYS_H_

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.ops.sparse import csr_matvec
-from dmlc_core_tpu.tpu.device_iter import DenseBatch, PaddedBatch
+from dmlc_core_tpu.tpu.device_iter import (DenseBatch, PaddedBatch,
+                                           unpack_shard, unpack_tree)
 
 __all__ = ["LinearParams", "LinearLearner"]
 
@@ -112,7 +113,17 @@ class LinearLearner:
     def _build_step(self, rows_per_shard: int, keys: tuple):
         objective, l2, lr = self.objective, self.l2, self.learning_rate
         axis = self.axis_name
-        tree_keys = [(k, P(axis)) for k in keys]
+        # packed leaves (aux/big — device_iter packing) carry the device
+        # axis at position 1; named leaves lead with it
+        tree_keys = [(k, P(None, axis) if k in ("aux", "big") else P(axis))
+                     for k in keys]
+
+        def shard_view(tree):
+            """Drop the device axis and unpack aux/big into named arrays
+            (a bitcast+slice — free inside the jitted step)."""
+            local = {k: v[:, 0] if k in ("aux", "big") else v[0]
+                     for k, v in tree.items()}
+            return unpack_shard(local)
 
         def local_grads(params, shard):
             def loss_fn(p):
@@ -124,7 +135,7 @@ class LinearLearner:
 
         if self.mesh is None:
             def step(params, tree):
-                shard = {k: v[0] for k, v in tree.items()}
+                shard = shard_view(tree)
                 loss_sum, wsum, grads = local_grads(params, shard)
                 denom = jnp.maximum(wsum, 1.0)
                 new = LinearParams(
@@ -140,7 +151,7 @@ class LinearLearner:
                            in_specs=(P(), dict(tree_keys)),
                            out_specs=(P(), P()))
         def sharded_step(params, tree):
-            shard = {k: v[0] for k, v in tree.items()}  # drop device axis
+            shard = shard_view(tree)  # drop device axis + unpack
             loss_sum, wsum, grads = local_grads(params, shard)
             # ONE reduction per step over ICI — the Rabit allreduce
             # equivalent (SURVEY §2.5)
@@ -161,7 +172,8 @@ class LinearLearner:
         if self._step_fn is None:
             self._step_fn = {}
         tree = batch.tree()
-        D = tree["label"].shape[0]
+        D = (tree["aux"].shape[1] if "aux" in tree
+             else tree["label"].shape[0])
         n_dev = 1 if self.mesh is None else int(self.mesh.devices.size)
         if D != n_dev:
             # the step reads shard block[0] only — a mismatch would
@@ -182,6 +194,7 @@ class LinearLearner:
 
         @jax.jit
         def fwd(params, tree):
+            tree = unpack_tree(tree)  # packed batches: bitcast + slice
             if "x" in tree:
                 return tree["x"].astype(jnp.float32) @ params.w + params.b
             def one(row, col, val):

@@ -43,7 +43,8 @@ import jax.numpy as jnp
 from dmlc_core_tpu.base import DMLCError, log_info
 from dmlc_core_tpu.io.native import (NativeBatcher, NativeDenseRecBatcher,
                                      NativeParser, _bf16_dtype)
-from dmlc_core_tpu.tpu.sharding import batch_sharding, data_mesh
+from dmlc_core_tpu.tpu.sharding import (batch_sharding, data_mesh,
+                                        packed_batch_sharding)
 
 
 def _dense_dtype_of(d) -> np.dtype:
@@ -58,12 +59,13 @@ def _dense_dtype_of(d) -> np.dtype:
     return dt
 
 __all__ = ["PaddedBatch", "DenseBatch", "DeviceRowBlockIter", "HostBatcher",
-           "NativeHostBatcher", "DenseRecHostBatcher"]
+           "NativeHostBatcher", "DenseRecHostBatcher", "unpack_tree",
+           "unpack_shard"]
 
 
 @dataclass
 class PaddedBatch:
-    """Static-shape CSR batch; all arrays lead with the device axis D.
+    """Static-shape CSR batch; named arrays lead with the device axis D.
 
     row/col/val: [D, NNZ]  per-nonzero segment id (local), column, value
     label/weight: [D, R]   weight 0 marks padding rows
@@ -75,29 +77,43 @@ class PaddedBatch:
 
     qid/field continue the reference RowBlock's optional columns
     (data.h:174-236) into the device layout.
-    """
-    row: Any
-    col: Any
-    val: Any
-    label: Any
-    weight: Any
-    nrows: Any
+
+    Packed transfer layout (native batchers): `big` [Kb, D, NNZ] int32
+    stacks row/col/val(f32 bits)[/field] and `aux` [K, D, R] int32 stacks
+    label(f32 bits)/weight(f32 bits)[/qid]/nrows-plane, so a batch crosses
+    host->HBM in TWO transfers instead of one RPC per leaf — on
+    high-latency links the per-transfer dispatch, not bandwidth, was the
+    recd/rec-lane ceiling (BENCH_r03). Host-side the named fields are
+    zero-copy views into the packs; device-side batches carry only the
+    packs and consumers unpack INSIDE jit (unpack_shard/unpack_tree)."""
+    row: Any = None
+    col: Any = None
+    val: Any = None
+    label: Any = None
+    weight: Any = None
+    nrows: Any = None
     # host-side true row count (not part of the device tree; avoids a
     # device->host sync when consumers just need progress accounting)
     total_rows: int = 0
     qid: Any = None
     field: Any = None
+    big: Any = None  # [Kb, D, NNZ] packed row/col/val[/field]
+    aux: Any = None  # [K, D, R] packed label/weight[/qid]/nrows
 
     @property
     def rows_per_shard(self) -> int:
-        return self.label.shape[1]
+        return self.aux.shape[2] if self.label is None else \
+            self.label.shape[1]
 
     @property
     def nnz_bucket(self) -> int:
-        return self.row.shape[1]
+        return self.big.shape[2] if self.row is None else self.row.shape[1]
 
     def tree(self) -> Dict[str, Any]:
-        """The batch as a flat dict pytree (the device_put / jit input)."""
+        """The batch as a flat dict pytree (the device_put / jit input):
+        the two packed leaves when packed, the named leaves otherwise."""
+        if self.aux is not None:
+            return {"big": self.big, "aux": self.aux}
         t = {"row": self.row, "col": self.col, "val": self.val,
              "label": self.label, "weight": self.weight,
              "nrows": self.nrows}
@@ -114,29 +130,122 @@ class DenseBatch:
     max_index is small): x is [D, R, F] — downstream matmuls tile straight
     onto the MXU, and host->HBM transfer drops from 12 B/nnz (CSR triple) to
     4 B/value (or 2 with bfloat16). Missing entries are 0 (the reference's
-    CSR semantics for absent features in a linear model)."""
-    x: Any
-    label: Any
-    weight: Any
-    nrows: Any
+    CSR semantics for absent features in a linear model).
+
+    `aux` packs label/weight[/qid]/nrows as in PaddedBatch: a batch is TWO
+    host->HBM transfers (x + aux) instead of 4-5."""
+    x: Any = None
+    label: Any = None
+    weight: Any = None
+    nrows: Any = None
     total_rows: int = 0
     qid: Any = None  # [D, R] int32 group ids (field has no dense layout)
+    aux: Any = None  # [K, D, R] packed label/weight[/qid]/nrows
 
     @property
     def rows_per_shard(self) -> int:
-        return self.label.shape[1]
+        return self.aux.shape[2] if self.label is None else \
+            self.label.shape[1]
 
     @property
     def num_features(self) -> int:
         return self.x.shape[2]
 
     def tree(self) -> Dict[str, Any]:
-        """The batch as a flat dict pytree (the device_put / jit input)."""
+        """The batch as a flat dict pytree (the device_put / jit input):
+        the two packed leaves when packed, the named leaves otherwise."""
+        if self.aux is not None:
+            return {"x": self.x, "aux": self.aux}
         t = {"x": self.x, "label": self.label, "weight": self.weight,
              "nrows": self.nrows}
         if self.qid is not None:
             t["qid"] = self.qid
         return t
+
+
+# -- packed-batch helpers ----------------------------------------------------
+# aux block order: 0=label (f32 bits), 1=weight (f32 bits), [2=qid],
+# last=nrows plane (entry [d, 0] holds shard d's true row count).
+# big block order: 0=row, 1=col, 2=val (f32 bits), [3=field].
+# Both are int32 containers; float planes travel as raw bits and are
+# bitcast back on device (a dtype-preserving reinterpretation, not a cast).
+
+def _view_aux(aux: np.ndarray):
+    """Contiguous flat views over an [K, D, R] aux pack that the native
+    fills write directly (label/weight/qid blocks are contiguous D*R runs
+    of the flat buffer — no repacking copy on the staging thread)."""
+    K, D, R = aux.shape
+    flat = aux.reshape(-1)
+    n = D * R
+    label = flat[0:n].view(np.float32)
+    weight = flat[n:2 * n].view(np.float32)
+    qid = flat[2 * n:3 * n] if K == 4 else None
+    return aux, label, weight, qid
+
+
+def _view_big(big: np.ndarray):
+    """Contiguous row/col/val[/field] planes over a [Kb, D, NNZ] pack
+    (val viewed float32)."""
+    Kb, D, bucket = big.shape
+    flat = big.reshape(-1)
+    n = D * bucket
+    row = flat[0:n].reshape(D, bucket)
+    col = flat[n:2 * n].reshape(D, bucket)
+    val = flat[2 * n:3 * n].view(np.float32).reshape(D, bucket)
+    field = flat[3 * n:4 * n].reshape(D, bucket) if Kb == 4 else None
+    return row, col, val, field
+
+
+def _finish_aux(aux, nrows) -> None:
+    """Mirror the [D] nrows vector into the aux nrows plane ([d, 0])."""
+    aux[-1].fill(0)
+    aux[-1, :, 0] = nrows
+
+
+def _unpack(tree: Dict[str, Any], nrows_of) -> Dict[str, Any]:
+    """Shared aux/big plane decoding; `nrows_of` extracts the nrows vector
+    from the last aux plane (the only shape that differs between the
+    device-axis-ful and per-shard views)."""
+    if "aux" not in tree:
+        return tree
+    aux = tree["aux"]
+    out = {}
+    if "x" in tree:
+        out["x"] = tree["x"]
+    if "big" in tree:
+        big = tree["big"]
+        out["row"] = big[0]
+        out["col"] = big[1]
+        out["val"] = _bitcast_f32(big[2])
+        if big.shape[0] == 4:
+            out["field"] = big[3]
+    out["label"] = _bitcast_f32(aux[0])
+    out["weight"] = _bitcast_f32(aux[1])
+    if aux.shape[0] == 4:
+        out["qid"] = aux[2]
+    out["nrows"] = nrows_of(aux[-1])
+    return out
+
+
+def unpack_tree(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Named leaves from a packed batch tree (device-axis-ful shapes:
+    label/weight/qid [D, R], row/col/val/field [D, NNZ], nrows [D]).
+    Identity for already-named trees. Usable under jit (bitcasts and
+    slices only) and on host numpy."""
+    return _unpack(tree, lambda plane: plane[:, 0])
+
+
+def unpack_shard(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Named leaves from one shard of a packed tree (device axis already
+    dropped: aux [K, R], big [Kb, NNZ], x [R, F]; nrows becomes [1]).
+    Identity for already-named trees. For use inside shard_map bodies."""
+    return _unpack(tree, lambda plane: plane[:1])
+
+
+def _bitcast_f32(a):
+    if isinstance(a, np.ndarray):
+        return a.view(np.float32)
+    return jax.lax.bitcast_convert_type(a, jnp.float32)
 
 
 def _next_pow2(n: int, floor: int) -> int:
@@ -470,43 +579,44 @@ class NativeHostBatcher:
             F = self._num_features
             pooled = self._pool_pop(("dense", F))
             if pooled is not None:
-                x, label, weight, nrows, qid = pooled
+                x, aux, nrows = pooled
             else:
                 # the native fill writes float32 or bf16 storage directly
                 # (batcher.h x_dtype) — no astype copy on this thread
                 x = np.empty((self.batch_rows, F), self.dense_dtype)
-                label = np.empty(self.batch_rows, np.float32)
-                weight = np.empty(self.batch_rows, np.float32)
+                aux = None
                 nrows = np.empty(D, np.int32)
-                qid = (np.empty(self.batch_rows, np.int32)
-                       if has_qid else None)
+            if aux is None or aux.shape[0] != (4 if has_qid else 3):
+                aux = np.empty((4 if has_qid else 3, D, R), np.int32)
+            _, label, weight, qid = _view_aux(aux)  # float32 flat views
             self._b.fill_dense(x, label, weight, nrows, qid=qid)
+            _finish_aux(aux, nrows)
             return DenseBatch(x=x.reshape(D, R, F),
                               label=label.reshape(D, R),
-                              weight=weight.reshape(D, R), nrows=nrows,
-                              total_rows=int(take),
+                              weight=weight.reshape(D, R),
+                              nrows=nrows, total_rows=int(take),
                               qid=None if qid is None
-                              else qid.reshape(D, R))
+                              else qid.reshape(D, R), aux=aux)
         pooled = self._pool_pop(("csr", bucket))
         if pooled is not None:
-            row, col, val, label, weight, nrows, qid, field = pooled
+            big, aux, nrows = pooled
         else:
-            label = np.empty(self.batch_rows, np.float32)
-            weight = np.empty(self.batch_rows, np.float32)
-            nrows = np.empty(D, np.int32)
-            qid = np.empty(self.batch_rows, np.int32) if has_qid else None
-            row = np.empty((D, bucket), np.int32)
-            col = np.empty((D, bucket), np.int32)
-            val = np.empty((D, bucket), np.float32)
-            field = np.empty((D, bucket), np.int32) if has_field else None
+            big, aux, nrows = None, None, np.empty(D, np.int32)
+        if big is None or big.shape[0] != (4 if has_field else 3):
+            big = np.empty((4 if has_field else 3, D, bucket), np.int32)
+        if aux is None or aux.shape[0] != (4 if has_qid else 3):
+            aux = np.empty((4 if has_qid else 3, D, R), np.int32)
+        row, col, val, field = _view_big(big)
+        _, label, weight, qid = _view_aux(aux)  # float32 flat views
         self._b.fill_csr(row, col, val, label, weight, nrows, qid=qid,
                          field=field)
+        _finish_aux(aux, nrows)
         return PaddedBatch(row=row, col=col, val=val,
                            label=label.reshape(D, R),
-                           weight=weight.reshape(D, R), nrows=nrows,
-                           total_rows=int(take),
+                           weight=weight.reshape(D, R),
+                           nrows=nrows, total_rows=int(take),
                            qid=None if qid is None else qid.reshape(D, R),
-                           field=field)
+                           field=field, big=big, aux=aux)
 
     # -- host-buffer recycling ---------------------------------------------
     def _pool_pop(self, key):
@@ -519,20 +629,18 @@ class NativeHostBatcher:
         block_until_ready on the device arrays) and that the device arrays
         do not alias host memory (true on TPU; NOT on the CPU backend,
         where the caller must skip recycling)."""
+        if getattr(batch, "aux", None) is None or \
+                not isinstance(batch.aux, np.ndarray):
+            return  # foreign/device batch; nothing to pool
         if isinstance(batch, DenseBatch):
             if batch.x.dtype != self.dense_dtype:
                 return  # foreign buffer set; drop it
             key = ("dense", batch.x.shape[-1])
-            arrs = (batch.x.reshape(self.batch_rows, -1),
-                    batch.label.reshape(-1), batch.weight.reshape(-1),
-                    batch.nrows, None if batch.qid is None
-                    else batch.qid.reshape(-1))
+            arrs = (batch.x.reshape(self.batch_rows, -1), batch.aux,
+                    batch.nrows)
         else:
-            key = ("csr", batch.row.shape[-1])
-            arrs = (batch.row, batch.col, batch.val,
-                    batch.label.reshape(-1), batch.weight.reshape(-1),
-                    batch.nrows, None if batch.qid is None
-                    else batch.qid.reshape(-1), batch.field)
+            key = ("csr", batch.big.shape[-1])
+            arrs = (batch.big, batch.aux, batch.nrows)
         self._pool.put(key, arrs)
 
     def reset(self) -> None:
@@ -582,11 +690,11 @@ class DenseRecHostBatcher:
         as NativeHostBatcher.recycle: only after the host->device copy has
         finished and only when device arrays cannot alias host memory)."""
         if not isinstance(batch, DenseBatch) or \
+                not isinstance(getattr(batch, "aux", None), np.ndarray) or \
                 batch.x.dtype != self.dense_dtype:
             return
         self._pool.put(("drec", batch.x.shape[-1]),
-                       (batch.x.reshape(self.batch_rows, -1),
-                        batch.label.reshape(-1), batch.weight.reshape(-1),
+                       (batch.x.reshape(self.batch_rows, -1), batch.aux,
                         batch.nrows))
 
     def next_batch(self) -> Optional[DenseBatch]:
@@ -600,19 +708,20 @@ class DenseRecHostBatcher:
         R = self.batch_rows // D
         pooled = self._pool.pop(("drec", F))
         if pooled is not None:
-            x, label, weight, nrows = pooled
+            x, aux, nrows = pooled
         else:
             x = np.empty((self.batch_rows, F), self.dense_dtype)
-            label = np.empty(self.batch_rows, np.float32)
-            weight = np.empty(self.batch_rows, np.float32)
+            aux = np.empty((3, D, R), np.int32)
             nrows = np.empty(D, np.int32)
+        _, label, weight, _ = _view_aux(aux)  # float32 flat views
         take = self._b.fill(x, label, weight, nrows)
         if take == 0:
             return None
+        _finish_aux(aux, nrows)
         return DenseBatch(x=x.reshape(D, R, F),
                           label=label.reshape(D, R),
-                          weight=weight.reshape(D, R), nrows=nrows,
-                          total_rows=int(take))
+                          weight=weight.reshape(D, R),
+                          nrows=nrows, total_rows=int(take), aux=aux)
 
     def reset(self) -> None:
         """Restart from the first record (new epoch); the pool survives."""
@@ -689,7 +798,14 @@ class DeviceRowBlockIter:
                 min_nnz_bucket=min_nnz_bucket, layout=layout,
                 dense_max_features=dense_max_features,
                 dense_dtype=dense_dtype)
-        self.sharding = None if mesh is None else batch_sharding(mesh)
+        # per-leaf sharding (packed leaves carry the device axis at
+        # position 1); materialized lazily from the first batch's tree
+        # structure — exposed for bench probes
+        self.sharding = None
+        self._leading_sharding = (None if mesh is None
+                                  else batch_sharding(mesh))
+        self._packed_sharding = (None if mesh is None
+                                 else packed_batch_sharding(mesh))
         self._prefetch = prefetch
         # two-stage pipeline: parse+pad thread -> _host_q -> transfer thread
         # -> _queue -> consumer. Parsing of batch k+1 overlaps the host->HBM
@@ -813,7 +929,11 @@ class DeviceRowBlockIter:
         if not self.to_device:
             return batch
         tree = batch.tree()
-        if self.sharding is not None:
+        if self._leading_sharding is not None:
+            if self.sharding is None or set(self.sharding) != set(tree):
+                self.sharding = {
+                    k: (self._packed_sharding if k in ("aux", "big")
+                        else self._leading_sharding) for k in tree}
             tree = jax.device_put(tree, self.sharding)
         else:
             tree = jax.device_put(tree)

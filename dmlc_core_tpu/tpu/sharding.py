@@ -23,8 +23,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["data_mesh", "batch_sharding", "replicated_sharding",
-           "process_part", "local_device_count"]
+__all__ = ["data_mesh", "batch_sharding", "packed_batch_sharding",
+           "replicated_sharding", "process_part", "local_device_count"]
 
 
 def data_mesh(num_devices: Optional[int] = None,
@@ -39,6 +39,15 @@ def data_mesh(num_devices: Optional[int] = None,
 def batch_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
     """Shard the leading (device) axis of a batch across the mesh."""
     return NamedSharding(mesh, P(axis_name))
+
+
+def packed_batch_sharding(mesh: Mesh, axis_name: str = "data"
+                          ) -> NamedSharding:
+    """Shard the SECOND axis across the mesh: the packed batch leaves
+    (`aux` [K, D, R], `big` [Kb, D, NNZ] — device_iter packing) carry the
+    device axis at position 1 so each plane stays a contiguous native
+    fill target."""
+    return NamedSharding(mesh, P(None, axis_name))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

@@ -112,9 +112,27 @@ def test_device_iter_sharding(tmp_path):
         batches = list(it)
     assert len(batches) == 2
     b = batches[0]
-    assert isinstance(b.row, jax.Array)
-    assert b.row.sharding.spec == jax.sharding.PartitionSpec("data")
-    assert b.row.shape[0] == 8
+    # a batch crosses host->device as exactly TWO packed transfers whose
+    # device axis (position 1) is sharded over the mesh
+    assert set(b.tree()) == {"big", "aux"}
+    assert isinstance(b.big, jax.Array) and isinstance(b.aux, jax.Array)
+    none_data = jax.sharding.PartitionSpec(None, "data")
+    assert b.big.sharding.spec == none_data
+    assert b.aux.sharding.spec == none_data
+    assert b.big.shape[1] == 8 and b.aux.shape[1] == 8
+    # unpack recovers the named planes bit-exactly vs the host staging
+    from dmlc_core_tpu.tpu.device_iter import unpack_tree
+    with DeviceRowBlockIter(str(p), batch_rows=1024, mesh=mesh,
+                            min_nnz_bucket=512, layout="csr",
+                            to_device=False) as hit:
+        hb = next(iter(hit))
+    named = unpack_tree({k: np.asarray(v) for k, v in b.tree().items()})
+    assert np.array_equal(named["row"], hb.row)
+    assert np.array_equal(named["col"], hb.col)
+    assert np.array_equal(named["val"], hb.val)
+    assert np.array_equal(named["label"], hb.label)
+    assert np.array_equal(named["weight"], hb.weight)
+    assert np.array_equal(named["nrows"], hb.nrows)
 
 
 def test_device_iter_before_first(tmp_path):

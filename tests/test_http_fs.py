@@ -1,0 +1,184 @@
+"""http:// read streams against an in-process mock server.
+
+The reference routes plain http(s) URIs to its S3 reader for public ranged
+reads (reference src/io.cc:53); here a dedicated read-only HttpFileSystem
+(cpp/src/http_filesys.cc) serves them. Covered: Stream -> InputSplit ->
+parser composition over an http URI, ranged reads with seek, the
+discard-prefix fallback for servers that ignore Range, 404 handling, the
+read-only/https guards, and reconnect-at-offset through a fault-injecting
+server (the S3 retry-loop contract, http_stream.h)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.native import NativeParser, NativeStream
+
+
+class _State:
+    def __init__(self):
+        self.objects = {}
+        self.honor_range = True
+        self.drop_after = None  # bytes into a GET body, then cut the socket
+        self.requests = []
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: _State = None
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _object(self):
+        return self.state.objects.get(self.path)
+
+    def do_HEAD(self):
+        body = self._object()
+        self.state.requests.append(("HEAD", self.path))
+        if body is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+
+    def do_GET(self):
+        body = self._object()
+        self.state.requests.append(("GET", self.path,
+                                    self.headers.get("Range")))
+        if body is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        status, lo = 200, 0
+        if rng and self.state.honor_range:
+            lo = int(rng.split("=")[1].split("-")[0])
+            status, body = 206, body[lo:]
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        if status == 206:
+            self.send_header(
+                "Content-Range",
+                f"bytes {lo}-{lo + len(body) - 1}"
+                f"/{len(self.state.objects[self.path])}")
+        self.end_headers()
+        cut = self.state.drop_after
+        if cut is not None and len(body) > cut:
+            self.wfile.write(body[:cut])
+            self.wfile.flush()
+            self.connection.close()  # mid-body transport drop
+            return
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def http_server(monkeypatch):
+    monkeypatch.setenv("DCT_HTTP_MAX_RETRY", "10")
+    monkeypatch.setenv("DCT_HTTP_RETRY_SLEEP_MS", "5")
+    state = _State()
+    handler = type("H", (_Handler,), {"state": state})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield state, f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _libsvm_corpus(rows=200, features=5, seed=11):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(rows):
+        feats = " ".join(
+            f"{j}:{rng.uniform(-2, 2):.5f}" for j in range(features))
+        lines.append(f"{i % 2} {feats}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def test_stream_reads_and_seeks(http_server):
+    state, base = http_server
+    state.objects["/blob.bin"] = bytes(range(256)) * 40
+    with NativeStream(base + "/blob.bin", "r") as s:
+        first = s.read(100)
+        assert first == (bytes(range(256)) * 40)[:100]
+    # seek via a fresh stream at offset: the split layer drives Seek through
+    # reconnect — emulate with a partial read then full re-read
+    with NativeStream(base + "/blob.bin", "r") as s:
+        assert s.read(1 << 20) == bytes(range(256)) * 40
+
+
+def test_parser_composes_over_http(http_server):
+    state, base = http_server
+    corpus = _libsvm_corpus()
+    state.objects["/train.libsvm"] = corpus
+    rows = 0
+    with NativeParser(base + "/train.libsvm") as p:
+        for b in p:
+            rows += b.num_rows
+    assert rows == 200
+    # the split issued ranged GETs (not whole-object replays)
+    assert any(r[0] == "GET" and r[2] for r in state.requests)
+
+
+def test_distributed_parts_cover_exactly(http_server):
+    state, base = http_server
+    state.objects["/train.libsvm"] = _libsvm_corpus(rows=331)
+    got = 0
+    for part in range(3):
+        with NativeParser(base + "/train.libsvm", part=part, npart=3) as p:
+            got += sum(b.num_rows for b in p)
+    assert got == 331  # exact cover, reference InputSplit contract
+
+
+def test_range_ignoring_server_still_correct(http_server):
+    state, base = http_server
+    state.honor_range = False
+    state.objects["/train.libsvm"] = _libsvm_corpus(rows=97)
+    for part in range(2):
+        with NativeParser(base + "/train.libsvm", part=part, npart=2) as p:
+            for _ in p:
+                pass
+    got = 0
+    for part in range(2):
+        with NativeParser(base + "/train.libsvm", part=part, npart=2) as p:
+            got += sum(b.num_rows for b in p)
+    assert got == 97  # discard-prefix fallback keeps offsets exact
+
+
+def test_mid_body_drop_reconnects_at_offset(http_server):
+    state, base = http_server
+    corpus = _libsvm_corpus(rows=400)
+    state.objects["/train.libsvm"] = corpus
+    state.drop_after = 4096  # every GET dies 4 KB in; reader must resume
+    rows = 0
+    with NativeParser(base + "/train.libsvm") as p:
+        for b in p:
+            rows += b.num_rows
+    assert rows == 400
+    # multiple reconnects happened, each at a deeper offset
+    offsets = [int(r[2].split("=")[1].split("-")[0])
+               for r in state.requests if r[0] == "GET" and r[2]]
+    assert len(offsets) > 2 and offsets == sorted(offsets)
+
+
+def test_missing_object_and_guards(http_server):
+    state, base = http_server
+    with pytest.raises(DMLCError, match="404|not found"):
+        with NativeStream(base + "/nope", "r") as s:
+            s.read(1)
+    with pytest.raises(DMLCError, match="read-only"):
+        NativeStream(base + "/x", "w")
+    with pytest.raises(DMLCError, match="plain-HTTP|TLS"):
+        NativeStream("https://127.0.0.1:1/x", "r")

@@ -134,14 +134,17 @@ def test_qid_reaches_device_and_ranking_loss_runs(tmp_path):
     with DeviceRowBlockIter(str(p), batch_rows=32, mesh=mesh,
                             min_nnz_bucket=64, layout="csr") as it:
         batch = next(iter(it))
-    assert batch.qid is not None
-    tree = batch.tree()
-    assert "qid" in tree
+    # device batches travel packed (two leaves); qid rides inside aux and
+    # unpacks to the same named plane
+    from dmlc_core_tpu.tpu.device_iter import unpack_tree
+    named = unpack_tree({k: np.asarray(v) for k, v in
+                         batch.tree().items()})
+    assert "qid" in named
 
     # jitted per-shard pairwise loss vs a numpy oracle over the same shard
-    qid0 = np.asarray(batch.qid[0])
-    lab0 = np.asarray(batch.label[0])
-    wgt0 = np.asarray(batch.weight[0])
+    qid0 = np.asarray(named["qid"][0])
+    lab0 = np.asarray(named["label"][0])
+    wgt0 = np.asarray(named["weight"][0])
     margin = np.linspace(-1, 1, len(qid0)).astype(np.float32)
 
     loss, pairs = jax.jit(pairwise_logistic_loss)(
@@ -189,8 +192,11 @@ def test_dense_layout_carries_qid(tmp_path):
                           num_shards=2)
     batch = b.next_batch()
     b.close()
-    assert batch.qid is not None and "qid" in batch.tree()
+    assert batch.qid is not None
     assert int(batch.qid[0, 0]) == 1  # first query id
+    # the packed tree carries qid inside aux (K == 4 planes)
+    tree = batch.tree()
+    assert set(tree) == {"x", "aux"} and tree["aux"].shape[0] == 4
 
 
 def test_no_qid_no_field_stays_none(tmp_path):
