@@ -105,6 +105,118 @@ def ensure_drec_dataset(rows: int) -> str:
     return path
 
 
+def ensure_crec_dataset(rows: int) -> str:
+    """Zero-rearrangement CSR lane: col/val/row-length planes in device
+    layout (cpp/src/csr_rec.h) — ingest is bulk memcpy + row-id expansion,
+    one pass, static nnz bucket."""
+    from dmlc_core_tpu.io.convert import rows_to_csr_recordio
+    src = ensure_dataset(rows)
+    path = os.path.join(CACHE_DIR, f"higgs_{rows}.crec")
+    if os.path.exists(path):
+        return path
+    rows_to_csr_recordio(src, path + ".tmp", fmt="libsvm")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def ensure_csv_dataset(rows: int) -> str:
+    """The same HIGGS-shaped data as dense csv (label first column)."""
+    import numpy as np
+    path = os.path.join(CACHE_DIR, f"higgs_{rows}.csv")
+    if os.path.exists(path):
+        return path
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    rng = np.random.default_rng(7)
+    F = 28
+    step = min(rows, 10000)
+    with open(path + ".tmp", "w") as f:
+        for start in range(0, rows, step):
+            n = min(step, rows - start)
+            vals = rng.uniform(-3, 3, size=(n, F))
+            labels = rng.integers(0, 2, size=n)
+            f.write("\n".join(
+                f"{labels[i]}," + ",".join(f"{v:.6f}" for v in vals[i])
+                for i in range(n)) + "\n")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def ensure_libfm_dataset(rows: int) -> str:
+    """KDD-shaped factorization rows: `label field:feature:value`."""
+    import numpy as np
+    path = os.path.join(CACHE_DIR, f"higgs_{rows}.libfm")
+    if os.path.exists(path):
+        return path
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    rng = np.random.default_rng(7)
+    F = 28
+    step = min(rows, 10000)
+    with open(path + ".tmp", "w") as f:
+        for start in range(0, rows, step):
+            n = min(step, rows - start)
+            vals = rng.uniform(-3, 3, size=(n, F))
+            labels = rng.integers(0, 2, size=n)
+            f.write("\n".join(
+                f"{labels[i]} " + " ".join(
+                    f"{j % 7}:{j}:{vals[i, j]:.6f}" for j in range(F))
+                for i in range(n)) + "\n")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def text_lane_probe(path: str, rows: int, nthread: int, fmt: str,
+                    fmt_args: str = "") -> dict:
+    """Host parse throughput for a text lane (prefetch + parse pipeline —
+    NativeParser always rides PrefetchSplit + ThreadedParser). No device
+    stage, so it runs in-process (the subprocess isolation of the binary
+    lanes exists for tunnel-latency effects that only device sessions
+    see). Best of 3 passes."""
+    from dmlc_core_tpu.io.native import NativeParser
+    best = None
+    uri = path + fmt_args
+    for _ in range(3):
+        t0 = time.time()
+        got = 0
+        with NativeParser(uri, nthread=nthread, fmt=fmt) as p:
+            for blk in p:
+                got += blk.num_rows
+        dt = time.time() - t0
+        assert got == rows, f"row count mismatch: {got} != {rows}"
+        best = dt if best is None else min(best, dt)
+    return {"rows_per_sec": round(rows / best, 1),
+            "mb_per_sec": round(os.path.getsize(path) / best / 1e6, 1)}
+
+
+def recordio_roundtrip_probe(records: int = 200000,
+                             payload: int = 256) -> dict:
+    """RecordIO write+read round-trip records/s (BASELINE.md target row;
+    reference analog: recordio_test.cc / the ImageNet .rec round-trip)."""
+    import tempfile
+    from dmlc_core_tpu.io.native import (NativeRecordIOReader,
+                                         NativeRecordIOWriter)
+    blob = bytes(range(256)) * (payload // 256 + 1)
+    blob = blob[:payload]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "rt.rec")
+        t0 = time.time()
+        with NativeRecordIOWriter(path) as w:
+            for i in range(records):
+                w.write_record(blob)
+        t_write = time.time() - t0
+        t0 = time.time()
+        got = 0
+        with NativeRecordIOReader(path) as r:
+            for rec in r:
+                assert len(rec) == payload
+                got += 1
+        t_read = time.time() - t0
+    assert got == records
+    return {"records_per_sec": round(records / (t_write + t_read), 1),
+            "write_records_per_sec": round(records / t_write, 1),
+            "read_records_per_sec": round(records / t_read, 1),
+            "payload_bytes": payload}
+
+
 def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto",
                        dense_dtype: str = "bfloat16"
                        ) -> "tuple[float, float]":
@@ -113,9 +225,11 @@ def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto",
     lane (which has no parse stage — nthread does not apply)."""
     t0 = time.time()
     got = 0
-    if fmt == "recd":
-        from dmlc_core_tpu.tpu.device_iter import DenseRecHostBatcher
-        b = DenseRecHostBatcher(path, dense_dtype=dense_dtype)
+    if fmt in ("recd", "crec"):
+        from dmlc_core_tpu.tpu.device_iter import (CsrRecHostBatcher,
+                                                   DenseRecHostBatcher)
+        b = (DenseRecHostBatcher(path, dense_dtype=dense_dtype)
+             if fmt == "recd" else CsrRecHostBatcher(path))
         while True:
             batch = b.next_batch()
             if batch is None:
@@ -293,10 +407,11 @@ def main() -> None:
                          "overlap even on small hosts; 0 = one per core)")
     ap.add_argument("--reps", type=int, default=5,
                     help="timed e2e repetitions; the median is reported")
-    ap.add_argument("--format", choices=("libsvm", "rec", "recd"),
+    ap.add_argument("--format", choices=("libsvm", "rec", "crec", "recd"),
                     default="libsvm",
                     help="headline lane: text parse, binary CSR row "
-                         "blocks, or zero-parse dense row matrices")
+                         "blocks, CSR device planes, or zero-parse dense "
+                         "row matrices")
     ap.add_argument("--dense-dtype", choices=("bf16", "f32"), default="bf16",
                     help="dense device dtype (bf16 halves host+HBM bytes)")
     ap.add_argument("--no-scaling-table", action="store_true")
@@ -312,6 +427,7 @@ def main() -> None:
     lane_fmt = args.format
     lane_path = {"libsvm": lambda: path,
                  "rec": lambda: ensure_rec_dataset(rows),
+                 "crec": lambda: ensure_crec_dataset(rows),
                  "recd": lambda: ensure_drec_dataset(rows)}[lane_fmt]()
     size_mb = os.path.getsize(lane_path) / 1e6
 
@@ -322,10 +438,10 @@ def main() -> None:
         p.next_block()
 
     extras = {}
-    if not args.no_scaling_table and lane_fmt != "recd":
-        # recd has no parse stage to thread-scale (ingest is framing +
-        # memcpy on one staging thread): the table would be three
-        # identical passes, so it is omitted for that lane
+    if not args.no_scaling_table and lane_fmt not in ("recd", "crec"):
+        # recd/crec have no parse stage to thread-scale (ingest is framing
+        # + memcpy on one staging thread): the table would be three
+        # identical passes, so it is omitted for those lanes
         extras["thread_scaling"] = {
             str(t): round(parse_rows_per_sec(lane_path, rows, t,
                                              fmt=lane_fmt)[0], 1)
@@ -395,8 +511,9 @@ def main() -> None:
             # measures each lane the way a real job would see it
             import subprocess
             for lane_name, ensure in (("rec_lane", ensure_rec_dataset),
+                                      ("crec_lane", ensure_crec_dataset),
                                       ("recd_lane", ensure_drec_dataset)):
-                fmt2 = "rec" if lane_name == "rec_lane" else "recd"
+                fmt2 = lane_name.split("_")[0]
                 ensure(rows)
                 try:
                     out = subprocess.run(
@@ -434,6 +551,23 @@ def main() -> None:
                       f"bw-util {ce['hbm_ingest_bw_util']:.1%} "
                       f"(best {ce['hbm_ingest_bw_util_best']:.1%})",
                       file=sys.stderr)
+
+        # the remaining BASELINE.md target rows: csv-with-prefetch MB/s,
+        # libfm rows/s, and the RecordIO write+read round-trip (host
+        # probes — no device stage, so in-process)
+        if args.format == "libsvm":
+            extras["csv_lane"] = text_lane_probe(
+                ensure_csv_dataset(rows), rows, args.threads, "csv",
+                "?format=csv&label_column=0")
+            extras["libfm_lane"] = text_lane_probe(
+                ensure_libfm_dataset(rows), rows, args.threads, "libfm")
+            extras["recordio_roundtrip"] = recordio_roundtrip_probe(
+                records=20000 if args.smoke else 200000)
+            print(f"# csv {extras['csv_lane']['mb_per_sec']} MB/s, "
+                  f"libfm {extras['libfm_lane']['rows_per_sec']:.0f} "
+                  f"rows/s, recordio rt "
+                  f"{extras['recordio_roundtrip']['records_per_sec']:.0f} "
+                  f"rec/s", file=sys.stderr)
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")

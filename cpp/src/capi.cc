@@ -12,6 +12,7 @@
 #include <string>
 
 #include "batcher.h"
+#include "csr_rec.h"
 #include "dense_rec.h"
 #include "filesys.h"
 #include "hdfs_filesys.h"
@@ -540,6 +541,61 @@ int dct_denserec_bytes_read(dct_denserec_t h, size_t* out) {
 
 int dct_denserec_free(dct_denserec_t h) {
   return Guard([&] { delete static_cast<dct::DenseRecBatcher*>(h); });
+}
+
+// ---------------------------------------------------------------- csr rec --
+// Zero-rearrangement CSR ingest (csr_rec.h): records carry col/val/row-len
+// planes in device batch layout; fill is bulk memcpy + run-length row ids.
+typedef void* dct_csrrec_t;
+
+int dct_csrrec_create(const char* uri, unsigned part, unsigned npart,
+                      uint64_t batch_rows, uint32_t num_shards,
+                      uint64_t min_nnz_bucket, dct_csrrec_t* out) {
+  return Guard([&] {
+    *out = new dct::CsrRecBatcher(uri, part, npart, batch_rows, num_shards,
+                                  min_nnz_bucket);
+  });
+}
+
+int dct_csrrec_meta(dct_csrrec_t h, uint64_t* bucket, int32_t* has_weight,
+                    int32_t* has_qid, int32_t* has_field) {
+  return Guard([&] {
+    int hw = 0, hq = 0, hf = 0;
+    static_cast<dct::CsrRecBatcher*>(h)->Meta(bucket, &hw, &hq, &hf);
+    *has_weight = hw;
+    *has_qid = hq;
+    *has_field = hf;
+  });
+}
+
+int dct_csrrec_fill(dct_csrrec_t h, int32_t* row, int32_t* col, float* val,
+                    int32_t* field, float* label, float* weight,
+                    int32_t* qid, int32_t* nrows, uint64_t* take) {
+  return Guard([&] {
+    *take = static_cast<dct::CsrRecBatcher*>(h)->Fill(
+        row, col, val, field, label, weight, qid, nrows);
+  });
+}
+
+int dct_csrrec_before_first(dct_csrrec_t h) {
+  return Guard([&] { static_cast<dct::CsrRecBatcher*>(h)->BeforeFirst(); });
+}
+
+int dct_csrrec_set_epoch(dct_csrrec_t h, unsigned epoch,
+                         int32_t* supported) {
+  return Guard([&] {
+    *supported =
+        static_cast<dct::CsrRecBatcher*>(h)->SetShuffleEpoch(epoch) ? 1 : 0;
+  });
+}
+
+int dct_csrrec_bytes_read(dct_csrrec_t h, size_t* out) {
+  return Guard(
+      [&] { *out = static_cast<dct::CsrRecBatcher*>(h)->BytesRead(); });
+}
+
+int dct_csrrec_free(dct_csrrec_t h) {
+  return Guard([&] { delete static_cast<dct::CsrRecBatcher*>(h); });
 }
 
 }  // extern "C"

@@ -1,0 +1,301 @@
+#include "csr_rec.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "base.h"
+#include "recordio.h"
+#include "serializer.h"
+#include "stream.h"
+
+namespace dct {
+
+namespace {
+
+// little-endian u32/f32 array -> host (bulk memcpy on LE hosts)
+void Copy32LE(void* dst, const char* src, uint64_t n) {
+  std::memcpy(dst, src, n * 4);
+  if (!serial::NativeIsLE()) {
+    uint32_t u;
+    char* d = static_cast<char*>(dst);
+    for (uint64_t i = 0; i < n; ++i) {
+      std::memcpy(&u, d + i * 4, 4);
+      u = serial::ByteSwap(u);
+      std::memcpy(d + i * 4, &u, 4);
+    }
+  }
+}
+
+uint64_t LoadU64LE(const char* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  if (!serial::NativeIsLE()) v = serial::ByteSwap(v);
+  return v;
+}
+
+uint32_t LoadRowLen(const char* row_len, uint64_t i) {
+  uint32_t v;
+  std::memcpy(&v, row_len + i * 4, 4);
+  if (!serial::NativeIsLE()) v = serial::ByteSwap(v);
+  return v;
+}
+
+}  // namespace
+
+CsrRecBatcher::CsrRecBatcher(const std::string& uri, unsigned part,
+                             unsigned npart, uint64_t batch_rows,
+                             uint32_t num_shards, uint64_t min_nnz_bucket)
+    : batch_rows_(batch_rows),
+      num_shards_(num_shards),
+      min_bucket_(std::max<uint64_t>(min_nnz_bucket, 1)) {
+  DCT_CHECK(num_shards_ > 0) << "num_shards must be positive";
+  DCT_CHECK(batch_rows_ > 0 && batch_rows_ % num_shards_ == 0)
+      << "batch_rows=" << batch_rows_ << " must divide by shards="
+      << num_shards_;
+  URISpec spec(uri, part, npart);
+  split_.reset(InputSplit::Create(spec.uri, part, npart, "recordio", "",
+                                  false, 0, 256, false, /*threaded=*/true,
+                                  spec.cache_file));
+}
+
+bool CsrRecBatcher::AdvanceRecord() {
+  InputSplit::Blob b;
+  if (!split_->NextRecord(&b)) {
+    eof_ = true;
+    have_record_ = false;
+    return false;
+  }
+  bytes_read_ += b.size;
+  DCT_CHECK(b.size >= 32) << "csr rec record too short for its header";
+  const char* p = static_cast<const char*>(b.dptr);
+  DCT_CHECK(recordio::LoadWordLE(p) == kCsrRecMagic)
+      << "not a csr-plane record (bad payload magic); .crec files are "
+         "written by rows_to_csr_recordio (dmlc_core_tpu/io/convert.py)";
+  const uint32_t flags = recordio::LoadWordLE(p + 4);
+  const uint64_t rows = recordio::LoadWordLE(p + 8);
+  const uint32_t nwin = recordio::LoadWordLE(p + 12);
+  const uint64_t nnz = LoadU64LE(p + 16);
+  const uint32_t max_col = recordio::LoadWordLE(p + 24);
+  // RecordIO records are < 2^29 bytes; bounding the dims keeps the `need`
+  // arithmetic overflow-free under fuzzed headers (dense_rec.cc rule)
+  DCT_CHECK(rows <= (1u << 30) && nnz <= (1ull << 34) && nwin <= 64)
+      << "corrupt csr rec header: rows=" << rows << " nnz=" << nnz
+      << " nwin=" << nwin;
+  DCT_CHECK(max_col <= 0x7fffffffu)
+      << "csr rec feature index " << max_col
+      << " exceeds the int32 device layout";
+  const int hw = static_cast<int>(flags & 1u);
+  const int hq = static_cast<int>((flags >> 1) & 1u);
+  const int hf = static_cast<int>((flags >> 2) & 1u);
+  // the window table must fit the blob BEFORE any table read: a truncated
+  // record with a large claimed nwin would otherwise read past the end
+  DCT_CHECK(nwin >= 1 && b.size >= 32 + 8ull * nwin)
+      << "csr rec record truncated inside its window table";
+  if (has_weight_ < 0) {
+    has_weight_ = hw;
+    has_qid_ = hq;
+    has_field_ = hf;
+    // the per-shard nnz capacity: any R consecutive rows carry at most
+    // win_max[ceil_log2(R)] nonzeros (the converter's GLOBAL sliding
+    // bound), so one pow2 bucket serves every batch of the epoch
+    const uint64_t R = batch_rows_ / num_shards_;
+    uint32_t wi = 0;
+    while ((1ull << wi) < R && wi + 1 < nwin) ++wi;
+    const uint64_t bound = LoadU64LE(p + 32 + 8 * wi);
+    // same sanity bound as nnz: a flipped high bit in the table must die
+    // here, not drive the pow2 loop into overflow or a multi-GB alloc
+    DCT_CHECK(bound <= (1ull << 34))
+        << "corrupt csr rec window table: bound " << bound;
+    uint64_t bkt = min_bucket_;
+    while (bkt < bound) bkt <<= 1;
+    bucket_ = bkt;
+  } else {
+    DCT_CHECK(hw == has_weight_ && hq == has_qid_ && hf == has_field_)
+        << "csr rec record flag drift: got w/q/f=" << hw << hq << hf
+        << ", pinned " << has_weight_ << has_qid_ << has_field_;
+  }
+  const char* tab_end = p + 32 + 8 * static_cast<uint64_t>(nwin);
+  const uint64_t need = 32 + 8ull * nwin + rows * 4 /*row_len*/ +
+                        rows * 4 /*label*/ + (hw ? rows * 4 : 0) +
+                        (hq ? rows * 4 : 0) + nnz * 4 /*col*/ +
+                        nnz * 4 /*val*/ + (hf ? nnz * 4 : 0);
+  DCT_CHECK(b.size >= need)
+      << "truncated csr rec record: " << b.size << " bytes, need " << need;
+  row_len_ = tab_end;
+  labels_ = row_len_ + rows * 4;
+  weights_ = hw ? labels_ + rows * 4 : nullptr;
+  qids_ = hq ? (hw ? weights_ : labels_) + rows * 4 : nullptr;
+  const char* after_rowwise =
+      (hq ? qids_ : (hw ? weights_ : labels_)) + rows * 4;
+  cols_ = after_rowwise;
+  vals_ = cols_ + nnz * 4;
+  fields_ = hf ? vals_ + nnz * 4 : nullptr;
+  rec_rows_ = rows;
+  rec_nnz_ = nnz;
+  row_in_rec_ = 0;
+  nnz_in_rec_ = 0;
+  have_record_ = true;
+  return true;
+}
+
+void CsrRecBatcher::Peek() {
+  if (has_weight_ < 0 && !eof_) {
+    AdvanceRecord();
+  }
+}
+
+void CsrRecBatcher::Meta(uint64_t* bucket, int* has_weight, int* has_qid,
+                         int* has_field) {
+  Peek();
+  DCT_CHECK(has_weight_ >= 0)
+      << "csr rec source is empty; cannot determine the batch shape";
+  *bucket = bucket_;
+  *has_weight = has_weight_;
+  *has_qid = has_qid_;
+  *has_field = has_field_;
+}
+
+uint64_t CsrRecBatcher::Fill(int32_t* row, int32_t* col, float* val,
+                             int32_t* field, float* label, float* weight,
+                             int32_t* qid, int32_t* nrows) {
+  Peek();
+  DCT_CHECK(has_field_ <= 0 || field != nullptr)
+      << "csr rec file carries field ids but no field plane was passed";
+  DCT_CHECK(has_qid_ <= 0 || qid != nullptr)
+      << "csr rec file carries qid but no qid plane was passed";
+  const uint64_t R = batch_rows_ / num_shards_;
+  const uint64_t B = bucket_;
+  uint64_t filled = 0;                   // rows placed into this batch
+  uint64_t shard_written = 0;            // nnz in the current shard's plane
+  while (filled < batch_rows_) {
+    if (!have_record_ || row_in_rec_ >= rec_rows_) {
+      if (eof_ || !AdvanceRecord()) break;
+      if (rec_rows_ == 0) continue;  // empty record: skip
+    }
+    const uint32_t d = static_cast<uint32_t>(filled / R);
+    if (filled % R == 0) shard_written = 0;
+    // rows until the shard boundary, batch end, or record end
+    const uint64_t n = std::min({R * (d + 1) - filled,
+                                 batch_rows_ - filled,
+                                 rec_rows_ - row_in_rec_});
+    // single pass over the span's row lengths: expand local segment ids
+    // and count the span's nnz
+    int32_t* rowd = row + static_cast<uint64_t>(d) * B;
+    uint64_t span_nnz = 0;
+    const uint64_t local0 = filled - static_cast<uint64_t>(d) * R;
+    for (uint64_t i = 0; i < n; ++i) {
+      const uint32_t l = LoadRowLen(row_len_, row_in_rec_ + i);
+      DCT_CHECK(shard_written + span_nnz + l <= B)
+          << "csr rec shard nnz exceeds the file's window bound (corrupt "
+             "row_len or window table)";
+      const int32_t local = static_cast<int32_t>(local0 + i);
+      for (uint32_t k = 0; k < l; ++k) {
+        rowd[shard_written + span_nnz + k] = local;
+      }
+      span_nnz += l;
+    }
+    DCT_CHECK(nnz_in_rec_ + span_nnz <= rec_nnz_)
+        << "csr rec row lengths overrun the record's nnz";
+    // bulk copies: the span's col/val[/field] are contiguous on disk
+    Copy32LE(col + static_cast<uint64_t>(d) * B + shard_written,
+             cols_ + nnz_in_rec_ * 4, span_nnz);
+    Copy32LE(val + static_cast<uint64_t>(d) * B + shard_written,
+             vals_ + nnz_in_rec_ * 4, span_nnz);
+    if (field != nullptr) {
+      if (fields_ != nullptr) {
+        Copy32LE(field + static_cast<uint64_t>(d) * B + shard_written,
+                 fields_ + nnz_in_rec_ * 4, span_nnz);
+      } else {
+        std::memset(field + static_cast<uint64_t>(d) * B + shard_written, 0,
+                    span_nnz * 4);
+      }
+    }
+    Copy32LE(label + filled, labels_ + row_in_rec_ * 4, n);
+    if (weights_ != nullptr) {
+      Copy32LE(weight + filled, weights_ + row_in_rec_ * 4, n);
+    } else {
+      for (uint64_t i = 0; i < n; ++i) weight[filled + i] = 1.0f;
+    }
+    if (qid != nullptr) {
+      if (qids_ != nullptr) {
+        Copy32LE(qid + filled, qids_ + row_in_rec_ * 4, n);
+      } else {
+        for (uint64_t i = 0; i < n; ++i) qid[filled + i] = -1;
+      }
+    }
+    shard_written += span_nnz;
+    nnz_in_rec_ += span_nnz;
+    row_in_rec_ += n;
+    filled += n;
+    // pad the shard's plane tail when the shard completes (or data ends)
+    if (filled % R == 0 || filled == batch_rows_) {
+      for (uint64_t k = shard_written; k < B; ++k) {
+        rowd[k] = static_cast<int32_t>(R);  // sacrificial segment
+      }
+      std::memset(col + static_cast<uint64_t>(d) * B + shard_written, 0,
+                  (B - shard_written) * 4);
+      std::memset(val + static_cast<uint64_t>(d) * B + shard_written, 0,
+                  (B - shard_written) * 4);
+      if (field != nullptr) {
+        std::memset(field + static_cast<uint64_t>(d) * B + shard_written, 0,
+                    (B - shard_written) * 4);
+      }
+    }
+  }
+  if (filled == 0) return 0;
+  // data ended mid-shard: the loop's pad-on-complete never ran for it
+  if (filled % R != 0) {
+    const uint32_t d = static_cast<uint32_t>(filled / R);
+    int32_t* rowd = row + static_cast<uint64_t>(d) * B;
+    for (uint64_t k = shard_written; k < B; ++k) {
+      rowd[k] = static_cast<int32_t>(R);
+    }
+    std::memset(col + static_cast<uint64_t>(d) * B + shard_written, 0,
+                (B - shard_written) * 4);
+    std::memset(val + static_cast<uint64_t>(d) * B + shard_written, 0,
+                (B - shard_written) * 4);
+    if (field != nullptr) {
+      std::memset(field + static_cast<uint64_t>(d) * B + shard_written, 0,
+                  (B - shard_written) * 4);
+    }
+  }
+  // pad wholly-empty shards and the row-wise tails
+  const uint32_t first_empty =
+      static_cast<uint32_t>((filled + R - 1) / R);
+  for (uint32_t d = first_empty; d < num_shards_; ++d) {
+    int32_t* rowd = row + static_cast<uint64_t>(d) * B;
+    for (uint64_t k = 0; k < B; ++k) rowd[k] = static_cast<int32_t>(R);
+    std::memset(col + static_cast<uint64_t>(d) * B, 0, B * 4);
+    std::memset(val + static_cast<uint64_t>(d) * B, 0, B * 4);
+    if (field != nullptr) {
+      std::memset(field + static_cast<uint64_t>(d) * B, 0, B * 4);
+    }
+  }
+  if (filled < batch_rows_) {
+    std::memset(label + filled, 0, (batch_rows_ - filled) * 4);
+    std::memset(weight + filled, 0, (batch_rows_ - filled) * 4);
+    if (qid != nullptr) {
+      for (uint64_t i = filled; i < batch_rows_; ++i) qid[i] = -1;
+    }
+  }
+  for (uint32_t d = 0; d < num_shards_; ++d) {
+    const int64_t left = static_cast<int64_t>(filled) - d * R;
+    nrows[d] = static_cast<int32_t>(
+        std::max<int64_t>(0, std::min<int64_t>(left, R)));
+  }
+  return filled;
+}
+
+void CsrRecBatcher::BeforeFirst() {
+  split_->BeforeFirst();
+  eof_ = false;
+  have_record_ = false;
+  row_in_rec_ = 0;
+  nnz_in_rec_ = 0;
+  rec_rows_ = 0;
+  rec_nnz_ = 0;
+  // flags/bucket deliberately survive: device shapes stay static across
+  // epochs (dense_rec.cc rule)
+}
+
+}  // namespace dct

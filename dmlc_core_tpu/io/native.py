@@ -148,6 +148,17 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_denserec_set_epoch": [vp, u, c.POINTER(c.c_int32)],
         "dct_denserec_bytes_read": [vp, c.POINTER(sz)],
         "dct_denserec_free": [vp],
+        "dct_csrrec_create": [c.c_char_p, u, u, c.c_uint64, c.c_uint32,
+                              c.c_uint64, c.POINTER(vp)],
+        "dct_csrrec_meta": [vp, c.POINTER(c.c_uint64),
+                            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                            c.POINTER(c.c_int32)],
+        "dct_csrrec_fill": [vp, vp, vp, vp, vp, vp, vp, vp, vp,
+                            c.POINTER(c.c_uint64)],
+        "dct_csrrec_before_first": [vp],
+        "dct_csrrec_set_epoch": [vp, u, c.POINTER(c.c_int32)],
+        "dct_csrrec_bytes_read": [vp, c.POINTER(sz)],
+        "dct_csrrec_free": [vp],
     }
     for name, argtypes in sigs.items():
         fn = getattr(cdll, name)
@@ -685,6 +696,95 @@ class NativeBatcher:
         """Free the native batcher handle (idempotent)."""
         if self._h:
             _check(lib().dct_batcher_free(self._h))
+            self._h = ctypes.c_void_p()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# -- csr rec ------------------------------------------------------------------
+class NativeCsrRecBatcher:
+    """Zero-rearrangement CSR ingest (cpp/src/csr_rec.h): records store
+    col/val/row-length planes in device batch layout, so a batch fill is
+    bulk memcpy + run-length row-id expansion with the GIL released.
+    meta() reports the STATIC per-shard nnz bucket (derived from the
+    file's global window table); fill() writes caller planes and returns
+    the true row count (0 at end)."""
+
+    def __init__(self, uri: str, part: int = 0, npart: int = 1,
+                 batch_rows: int = 65536, num_shards: int = 1,
+                 min_nnz_bucket: int = 4096):
+        self._h = ctypes.c_void_p()
+        self._batch_rows = batch_rows
+        self._num_shards = num_shards
+        self._bucket = 0
+        _check(lib().dct_csrrec_create(uri.encode(), part, npart,
+                                       batch_rows, num_shards,
+                                       min_nnz_bucket,
+                                       ctypes.byref(self._h)))
+
+    def meta(self):
+        """(bucket, has_weight, has_qid, has_field) — static for the whole
+        epoch (one compiled device shape)."""
+        bucket = ctypes.c_uint64()
+        hw = ctypes.c_int32()
+        hq = ctypes.c_int32()
+        hf = ctypes.c_int32()
+        _check(lib().dct_csrrec_meta(self._h, ctypes.byref(bucket),
+                                     ctypes.byref(hw), ctypes.byref(hq),
+                                     ctypes.byref(hf)))
+        self._bucket = bucket.value
+        return (bucket.value, bool(hw.value), bool(hq.value),
+                bool(hf.value))
+
+    def fill(self, row, col, val, label, weight, nrows, qid=None,
+             field=None) -> int:
+        """Fill one batch; returns the true row count (0 = end)."""
+        if self._bucket == 0:
+            self.meta()  # plane sizing needs the static bucket
+        nz = self._num_shards * self._bucket
+        take = ctypes.c_uint64()
+        ptr = NativeBatcher._ptr
+        _check(lib().dct_csrrec_fill(
+            self._h, ptr(row, np.int32, nz), ptr(col, np.int32, nz),
+            ptr(val, np.float32, nz),
+            None if field is None else ptr(field, np.int32, nz),
+            ptr(label, np.float32, self._batch_rows),
+            ptr(weight, np.float32, self._batch_rows),
+            None if qid is None else ptr(qid, np.int32, self._batch_rows),
+            ptr(nrows, np.int32, self._num_shards), ctypes.byref(take)))
+        return int(take.value)
+
+    def before_first(self) -> None:
+        """Restart from the first record (new epoch)."""
+        _check(lib().dct_csrrec_before_first(self._h))
+
+    def set_epoch(self, epoch: int) -> bool:
+        """Pin the shuffle permutation the next before_first() samples."""
+        supported = ctypes.c_int32()
+        _check(lib().dct_csrrec_set_epoch(self._h, epoch,
+                                          ctypes.byref(supported)))
+        return bool(supported.value)
+
+    def bytes_read(self) -> int:
+        """Record bytes consumed from the source so far."""
+        out = ctypes.c_size_t()
+        _check(lib().dct_csrrec_bytes_read(self._h, ctypes.byref(out)))
+        return out.value
+
+    def close(self) -> None:
+        """Free the native handle (idempotent)."""
+        if self._h:
+            _check(lib().dct_csrrec_free(self._h))
             self._h = ctypes.c_void_p()
 
     def __enter__(self):

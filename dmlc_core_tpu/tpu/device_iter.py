@@ -41,8 +41,9 @@ import jax
 import jax.numpy as jnp
 
 from dmlc_core_tpu.base import DMLCError, log_info
-from dmlc_core_tpu.io.native import (NativeBatcher, NativeDenseRecBatcher,
-                                     NativeParser, _bf16_dtype)
+from dmlc_core_tpu.io.native import (NativeBatcher, NativeCsrRecBatcher,
+                                     NativeDenseRecBatcher, NativeParser,
+                                     _bf16_dtype)
 from dmlc_core_tpu.tpu.sharding import (batch_sharding, data_mesh,
                                         packed_batch_sharding)
 
@@ -59,8 +60,8 @@ def _dense_dtype_of(d) -> np.dtype:
     return dt
 
 __all__ = ["PaddedBatch", "DenseBatch", "DeviceRowBlockIter", "HostBatcher",
-           "NativeHostBatcher", "DenseRecHostBatcher", "unpack_tree",
-           "unpack_shard"]
+           "NativeHostBatcher", "DenseRecHostBatcher", "CsrRecHostBatcher",
+           "unpack_tree", "unpack_shard"]
 
 
 @dataclass
@@ -662,6 +663,85 @@ class NativeHostBatcher:
         self._b.close()
 
 
+class CsrRecHostBatcher:
+    """Host batcher over the zero-rearrangement CSR lane (cpp/src/
+    csr_rec.h): records store col/val/row-length planes in device layout,
+    so next_batch() is bulk memcpy + row-id expansion straight into the
+    packed big/aux buffers. The per-shard nnz bucket is STATIC for the
+    epoch (the file's window table bounds it), so every batch compiles to
+    one device shape. Emits the same PaddedBatch as the CSR text path."""
+
+    def __init__(self, uri: str, part: int = 0, npart: int = 1,
+                 batch_rows: int = 65536, num_shards: int = 1,
+                 min_nnz_bucket: int = 4096):
+        if batch_rows % num_shards != 0:
+            raise DMLCError(
+                f"batch_rows={batch_rows} must divide by shards="
+                f"{num_shards}")
+        self._b = NativeCsrRecBatcher(uri, part=part, npart=npart,
+                                      batch_rows=batch_rows,
+                                      num_shards=num_shards,
+                                      min_nnz_bucket=min_nnz_bucket)
+        self.batch_rows = batch_rows
+        self.num_shards = num_shards
+        self._meta = None
+        self._pool = _HostBufferPool()
+
+    def recycle(self, batch) -> None:
+        """Return a consumed host batch's buffers for reuse (same contract
+        as NativeHostBatcher.recycle)."""
+        if not isinstance(batch, PaddedBatch) or \
+                not isinstance(getattr(batch, "aux", None), np.ndarray):
+            return
+        self._pool.put(("crec", batch.big.shape[-1]),
+                       (batch.big, batch.aux, batch.nrows))
+
+    def next_batch(self) -> Optional[PaddedBatch]:
+        """Next static-shape PaddedBatch of host numpy arrays (None at
+        end); the fill is one GIL-released native pass."""
+        if self._meta is None:
+            self._meta = self._b.meta()
+        bucket, _, has_qid, has_field = self._meta
+        D = self.num_shards
+        R = self.batch_rows // D
+        pooled = self._pool.pop(("crec", bucket))
+        if pooled is not None:
+            big, aux, nrows = pooled
+        else:
+            big = np.empty((4 if has_field else 3, D, bucket), np.int32)
+            aux = np.empty((4 if has_qid else 3, D, R), np.int32)
+            nrows = np.empty(D, np.int32)
+        row, col, val, field = _view_big(big)
+        _, label, weight, qid = _view_aux(aux)
+        take = self._b.fill(row, col, val, label, weight, nrows, qid=qid,
+                            field=field)
+        if take == 0:
+            return None
+        _finish_aux(aux, nrows)
+        return PaddedBatch(row=row, col=col, val=val,
+                           label=label.reshape(D, R),
+                           weight=weight.reshape(D, R),
+                           nrows=nrows, total_rows=int(take),
+                           qid=None if qid is None else qid.reshape(D, R),
+                           field=field, big=big, aux=aux)
+
+    def reset(self) -> None:
+        """Restart from the first record (new epoch); the pool survives."""
+        self._b.before_first()
+
+    def set_epoch(self, epoch: int) -> bool:
+        """Pin the shuffle permutation the next reset() samples."""
+        return self._b.set_epoch(epoch)
+
+    def bytes_read(self) -> int:
+        """Record bytes consumed from the source so far."""
+        return self._b.bytes_read()
+
+    def close(self) -> None:
+        """Free the native handle (idempotent)."""
+        self._b.close()
+
+
 class DenseRecHostBatcher:
     """Host batcher over the zero-parse dense lane (cpp/src/dense_rec.h):
     records store [rows, F] matrices in device layout, so next_batch() is
@@ -765,6 +845,8 @@ class DeviceRowBlockIter:
         path_part = uri.split("?", 1)[0].split("#", 1)[0]
         if fmt == "auto" and path_part.endswith(".drec"):
             fmt = "recd"  # dense row-matrix records are self-identifying
+        elif fmt == "auto" and path_part.endswith(".crec"):
+            fmt = "crec"  # CSR device-plane records (csr_rec.h)
         elif fmt == "auto" and path_part.endswith(".rec"):
             fmt = "rec"  # mirror the native suffix rule (parser.cc Create)
         # determinism keys for mid-epoch resume: the batch count is only a
@@ -780,6 +862,13 @@ class DeviceRowBlockIter:
             self.batcher = DenseRecHostBatcher(
                 uri, part=part, npart=npart, batch_rows=batch_rows,
                 num_shards=num_shards, dense_dtype=dense_dtype)
+        elif fmt == "crec":
+            # zero-rearrangement CSR lane: records hold device-layout
+            # col/val/row-length planes (csr_rec.h)
+            self.parser = None
+            self.batcher = CsrRecHostBatcher(
+                uri, part=part, npart=npart, batch_rows=batch_rows,
+                num_shards=num_shards, min_nnz_bucket=min_nnz_bucket)
         elif index64:
             # 64-bit parse width; the int32 device layout is still the hard
             # contract — the numpy batcher raises on any id >= 2^31

@@ -1,0 +1,198 @@
+"""The zero-rearrangement CSR lane (.crec): device-plane records whose
+ingest is bulk memcpy + row-id expansion (cpp/src/csr_rec.h). Contract:
+identical batches to the text CSR path (modulo the static bucket), exact
+distributed cover, mid-epoch resume, corruption safety."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.convert import rows_to_csr_recordio
+from dmlc_core_tpu.tpu.device_iter import (CsrRecHostBatcher,
+                                           DeviceRowBlockIter, unpack_tree)
+
+
+def write_libsvm(path, rows, features=24, seed=9, qid=False):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(rows):
+            nnz = int(rng.integers(1, features))
+            cols = np.sort(rng.choice(features, size=nnz, replace=False))
+            feats = " ".join(f"{c}:{rng.uniform(-2, 2):.5f}" for c in cols)
+            q = f"qid:{i // 10} " if qid else ""
+            f.write(f"{i % 2} {q}{feats}\n")
+    return str(path)
+
+
+def batches_of(uri, fmt, batch_rows=256, **kw):
+    out = []
+    with DeviceRowBlockIter(uri, fmt=fmt, batch_rows=batch_rows,
+                            to_device=False, layout="csr", **kw) as it:
+        for b in it:
+            out.append({k: np.asarray(v).copy()
+                        for k, v in unpack_tree(b.tree()).items()})
+            out[-1]["total_rows"] = b.total_rows
+    return out
+
+
+def rows_as_dicts(batches):
+    """Flatten batches to per-row {col: val} dicts + labels, dropping
+    padding (weight 0 rows and the sacrificial segment)."""
+    rows = []
+    for b in batches:
+        D, B = b["row"].shape
+        R = b["label"].shape[1]
+        for d in range(D):
+            nr = int(b["nrows"][d])
+            for r in range(nr):
+                mask = b["row"][d] == r
+                rows.append((float(b["label"][d, r]),
+                             dict(zip(b["col"][d][mask].tolist(),
+                                      np.round(b["val"][d][mask],
+                                               5).tolist()))))
+    return rows
+
+
+def test_crec_matches_text_parse(tmp_path):
+    src = write_libsvm(tmp_path / "c.libsvm", rows=700)
+    crec = str(tmp_path / "c.crec")
+    n = rows_to_csr_recordio(src, crec, rows_per_record=96)
+    assert n == 700
+    text = rows_as_dicts(batches_of(src, "auto"))
+    binary = rows_as_dicts(batches_of(crec, "auto"))  # suffix-detected
+    assert len(text) == len(binary) == 700
+    for (tl, tf), (bl, bf) in zip(text, binary):
+        assert tl == bl and tf == bf
+
+
+def test_crec_static_bucket_single_shape(tmp_path):
+    src = write_libsvm(tmp_path / "s.libsvm", rows=500)
+    crec = str(tmp_path / "s.crec")
+    rows_to_csr_recordio(src, crec, rows_per_record=64)
+    shapes = set()
+    with DeviceRowBlockIter(crec, batch_rows=128, to_device=False) as it:
+        for b in it:
+            shapes.add(tuple(b.big.shape) + tuple(b.aux.shape))
+    assert len(shapes) == 1  # one compiled device shape for the epoch
+
+
+def test_crec_distributed_parts_cover_exactly(tmp_path):
+    src = write_libsvm(tmp_path / "d.libsvm", rows=611)
+    crec = str(tmp_path / "d.crec")
+    rows_to_csr_recordio(src, crec, rows_per_record=50)
+    got = 0
+    for part in range(3):
+        b = CsrRecHostBatcher(crec, part=part, npart=3, batch_rows=128)
+        try:
+            while True:
+                batch = b.next_batch()
+                if batch is None:
+                    break
+                got += batch.total_rows
+        finally:
+            b.close()
+    assert got == 611
+
+
+def test_crec_qid_weight_carried(tmp_path):
+    src = write_libsvm(tmp_path / "q.libsvm", rows=120, qid=True)
+    crec = str(tmp_path / "q.crec")
+    rows_to_csr_recordio(src, crec, rows_per_record=32)
+    batches = batches_of(crec, "auto", batch_rows=64)
+    qids = np.concatenate([b["qid"].reshape(-1) for b in batches])
+    real = qids[qids >= 0]
+    assert real.size == 120 and int(real[0]) == 0 and int(real[-1]) == 11
+
+
+def test_crec_resume_exact(tmp_path):
+    src = write_libsvm(tmp_path / "r.libsvm", rows=900)
+    crec = str(tmp_path / "r.crec")
+    rows_to_csr_recordio(src, crec, rows_per_record=128)
+    with DeviceRowBlockIter(crec, batch_rows=128, to_device=False) as ref:
+        all_b = [np.asarray(b.big).copy() for b in ref]
+    with DeviceRowBlockIter(crec, batch_rows=128, to_device=False) as it:
+        for i, b in enumerate(it):
+            if i == 2:
+                st = it.state()
+                break
+    with DeviceRowBlockIter(crec, batch_rows=128, to_device=False) as it2:
+        it2.restore(st)
+        tail = [np.asarray(b.big).copy() for b in it2]
+    assert len(tail) == len(all_b) - 3
+    for a, c in zip(tail, all_b[3:]):
+        assert np.array_equal(a, c)
+
+
+def test_crec_corrupt_window_table_errors_fast(tmp_path):
+    """Code-review r4 regression: a flipped high bit in the window-maxima
+    table must raise (bound check), not drive the pow2 bucket loop into an
+    infinite spin / multi-GB allocation."""
+    src = write_libsvm(tmp_path / "w.libsvm", rows=100)
+    crec = tmp_path / "w.crec"
+    rows_to_csr_recordio(src, str(crec), rows_per_record=32)
+    data = bytearray(crec.read_bytes())
+    # first record: 8B RecordIO frame + 32B payload header, then win_max;
+    # the reader consults win_max[ceil_log2(R)] = win_max[6] for R=64 —
+    # flip ITS big-end byte
+    data[8 + 32 + 6 * 8 + 7] = 0xFF
+    bad = tmp_path / "wbad.crec"
+    bad.write_bytes(bytes(data))
+    b = CsrRecHostBatcher(str(bad), batch_rows=64)
+    try:
+        with pytest.raises(DMLCError, match="window table"):
+            b.next_batch()
+    finally:
+        b.close()
+
+
+def test_crec_distributed_conversion_shares_window_table(tmp_path):
+    """Part-wise conversions with a precomputed table must byte-agree with
+    a monolithic conversion of the same rows."""
+    from dmlc_core_tpu.io.convert import compute_csr_window_table
+    src = write_libsvm(tmp_path / "p.libsvm", rows=400)
+    table = compute_csr_window_table(src)
+    whole = tmp_path / "whole.crec"
+    rows_to_csr_recordio(src, str(whole), rows_per_record=64,
+                         window_table=table)
+    n = 0
+    for part in range(2):
+        piece = tmp_path / f"part{part}.crec"
+        n += rows_to_csr_recordio(src, str(piece), rows_per_record=64,
+                                  part=part, npart=2, window_table=table)
+    assert n == 400
+    # the two parts together hold every row the monolithic file holds
+    both = str(tmp_path / "part0.crec") + ";" + str(tmp_path / "part1.crec")
+    got = sum(b["total_rows"] for b in batches_of(both, "crec"))
+    assert got == sum(b["total_rows"]
+                      for b in batches_of(str(whole), "auto")) == 400
+
+
+def test_crec_mutations_never_crash(tmp_path):
+    src = write_libsvm(tmp_path / "f.libsvm", rows=300)
+    crec = tmp_path / "f.crec"
+    rows_to_csr_recordio(src, str(crec), rows_per_record=64)
+    base = crec.read_bytes()
+    rng = np.random.default_rng(5)
+    target = tmp_path / "mut.crec"
+    outcomes = {"ok": 0, "error": 0}
+    for _ in range(100):
+        data = bytearray(base)
+        for _ in range(int(rng.integers(1, 4))):
+            data[int(rng.integers(0, len(data)))] = int(rng.integers(0, 256))
+        target.write_bytes(bytes(data))
+        try:
+            b = CsrRecHostBatcher(str(target), batch_rows=128)
+            try:
+                n = 0
+                while True:
+                    batch = b.next_batch()
+                    if batch is None:
+                        break
+                    n += batch.total_rows
+                assert 0 <= n <= 300
+                outcomes["ok"] += 1
+            finally:
+                b.close()
+        except DMLCError:
+            outcomes["error"] += 1
+    assert outcomes["ok"] > 0 and outcomes["error"] > 0, outcomes
