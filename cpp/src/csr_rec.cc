@@ -12,32 +12,11 @@ namespace dct {
 
 namespace {
 
-// little-endian u32/f32 array -> host (bulk memcpy on LE hosts)
-void Copy32LE(void* dst, const char* src, uint64_t n) {
-  std::memcpy(dst, src, n * 4);
-  if (!serial::NativeIsLE()) {
-    uint32_t u;
-    char* d = static_cast<char*>(dst);
-    for (uint64_t i = 0; i < n; ++i) {
-      std::memcpy(&u, d + i * 4, 4);
-      u = serial::ByteSwap(u);
-      std::memcpy(d + i * 4, &u, 4);
-    }
-  }
-}
-
-uint64_t LoadU64LE(const char* p) {
-  uint64_t v;
-  std::memcpy(&v, p, 8);
-  if (!serial::NativeIsLE()) v = serial::ByteSwap(v);
-  return v;
-}
+using recordio::CopyWords32LE;
+using recordio::LoadU64LE;
 
 uint32_t LoadRowLen(const char* row_len, uint64_t i) {
-  uint32_t v;
-  std::memcpy(&v, row_len + i * 4, 4);
-  if (!serial::NativeIsLE()) v = serial::ByteSwap(v);
-  return v;
+  return recordio::LoadWordLE(row_len + i * 4);
 }
 
 }  // namespace
@@ -197,28 +176,28 @@ uint64_t CsrRecBatcher::Fill(int32_t* row, int32_t* col, float* val,
     DCT_CHECK(nnz_in_rec_ + span_nnz <= rec_nnz_)
         << "csr rec row lengths overrun the record's nnz";
     // bulk copies: the span's col/val[/field] are contiguous on disk
-    Copy32LE(col + static_cast<uint64_t>(d) * B + shard_written,
+    CopyWords32LE(col + static_cast<uint64_t>(d) * B + shard_written,
              cols_ + nnz_in_rec_ * 4, span_nnz);
-    Copy32LE(val + static_cast<uint64_t>(d) * B + shard_written,
+    CopyWords32LE(val + static_cast<uint64_t>(d) * B + shard_written,
              vals_ + nnz_in_rec_ * 4, span_nnz);
     if (field != nullptr) {
       if (fields_ != nullptr) {
-        Copy32LE(field + static_cast<uint64_t>(d) * B + shard_written,
+        CopyWords32LE(field + static_cast<uint64_t>(d) * B + shard_written,
                  fields_ + nnz_in_rec_ * 4, span_nnz);
       } else {
         std::memset(field + static_cast<uint64_t>(d) * B + shard_written, 0,
                     span_nnz * 4);
       }
     }
-    Copy32LE(label + filled, labels_ + row_in_rec_ * 4, n);
+    CopyWords32LE(label + filled, labels_ + row_in_rec_ * 4, n);
     if (weights_ != nullptr) {
-      Copy32LE(weight + filled, weights_ + row_in_rec_ * 4, n);
+      CopyWords32LE(weight + filled, weights_ + row_in_rec_ * 4, n);
     } else {
       for (uint64_t i = 0; i < n; ++i) weight[filled + i] = 1.0f;
     }
     if (qid != nullptr) {
       if (qids_ != nullptr) {
-        Copy32LE(qid + filled, qids_ + row_in_rec_ * 4, n);
+        CopyWords32LE(qid + filled, qids_ + row_in_rec_ * 4, n);
       } else {
         for (uint64_t i = 0; i < n; ++i) qid[filled + i] = -1;
       }

@@ -10,26 +10,15 @@
 
 namespace dct {
 
-namespace {
-
-// little-endian f32 array -> host f32 (bulk memcpy on LE hosts)
-void CopyF32LE(float* dst, const char* src, uint64_t n) {
-  std::memcpy(dst, src, n * sizeof(float));
-  if (!serial::NativeIsLE()) {
-    uint32_t u;
-    for (uint64_t i = 0; i < n; ++i) {
-      std::memcpy(&u, dst + i, 4);
-      u = serial::ByteSwap(u);
-      std::memcpy(dst + i, &u, 4);
-    }
-  }
-}
+namespace denserec_detail {
 
 // disk x rows -> out buffer, converting dtype when needed.
 // dtypes: 0 = f32, 1 = bf16 (uint16 storage). Elements are LE on disk.
+// host_is_le defaults to the real host; tests drive the big-endian branch
+// explicitly (recordio.h LoadWordAs rationale).
 void CopyX(void* dst, int out_dtype, const char* src, int disk_dtype,
-           uint64_t count) {
-  const bool swap = !serial::NativeIsLE();
+           uint64_t count, bool host_is_le) {
+  const bool swap = !host_is_le;
   if (out_dtype == disk_dtype && !swap) {
     std::memcpy(dst, src, count * (disk_dtype == 1 ? 2 : 4));
     return;
@@ -67,7 +56,10 @@ void CopyX(void* dst, int out_dtype, const char* src, int disk_dtype,
   }
 }
 
-}  // namespace
+}  // namespace denserec_detail
+
+using denserec_detail::CopyX;
+using recordio::CopyWords32LE;
 
 DenseRecBatcher::DenseRecBatcher(const std::string& uri, unsigned part,
                                  unsigned npart, uint64_t batch_rows,
@@ -170,9 +162,9 @@ uint64_t DenseRecBatcher::Fill(void* x, int out_dtype, uint64_t x_features,
     }
     const uint64_t n =
         std::min(batch_rows_ - filled, rec_rows_ - row_in_rec_);
-    CopyF32LE(label + filled, labels_ + row_in_rec_ * 4, n);
+    CopyWords32LE(label + filled, labels_ + row_in_rec_ * 4, n);
     if (weights_ != nullptr) {
-      CopyF32LE(weight + filled, weights_ + row_in_rec_ * 4, n);
+      CopyWords32LE(weight + filled, weights_ + row_in_rec_ * 4, n);
     } else {
       for (uint64_t i = 0; i < n; ++i) weight[filled + i] = 1.0f;
     }

@@ -30,10 +30,19 @@
 #include <string>
 
 #include "input_split.h"
+#include "serializer.h"
 
 namespace dct {
 
 constexpr uint32_t kDenseRecMagic = 0x44524431;  // 'DRD1'
+
+// Decode helper with an explicit host_is_le switch so the big-endian
+// branch is testable on an LE host (recordio.h LoadWordAs rationale; the
+// shared 32-bit copy lives in recordio.h CopyWords32LE).
+namespace denserec_detail {
+void CopyX(void* dst, int out_dtype, const char* src, int disk_dtype,
+           uint64_t count, bool host_is_le = serial::NativeIsLE());
+}  // namespace denserec_detail
 
 class DenseRecBatcher {
  public:

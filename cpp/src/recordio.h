@@ -35,11 +35,47 @@ constexpr uint32_t HeaderFlag(uint32_t lrec) { return (lrec >> 29) & 7u; }
 constexpr uint32_t HeaderLen(uint32_t lrec) { return lrec & ((1u << 29) - 1); }
 constexpr size_t AlignUp4(size_t n) { return (n + 3) & ~size_t(3); }
 
-inline uint32_t LoadWordLE(const char* p) {
+// host_is_le parameterization (defaulting to the real host) lets the
+// big-endian decode branch run under test on an LE host — the QEMU-free
+// equivalent of the reference's s390x lane (scripts/test_script.sh:60-65),
+// same discipline as serial::ToDisk/FromDisk.
+inline uint32_t LoadWordAs(const char* p, bool host_is_le) {
   uint32_t w;
   std::memcpy(&w, p, 4);
-  if (!serial::NativeIsLE()) w = serial::ByteSwap(w);
+  if (!host_is_le) w = serial::ByteSwap(w);
   return w;
+}
+
+inline uint32_t LoadWordLE(const char* p) {
+  return LoadWordAs(p, serial::NativeIsLE());
+}
+
+// Bulk little-endian 32-bit-word copy shared by the binary record lanes
+// (dense_rec labels/weights, csr_rec planes): memcpy, then elementwise
+// swap on big-endian hosts.
+inline void CopyWords32LE(void* dst, const void* src, uint64_t n,
+                          bool host_is_le = serial::NativeIsLE()) {
+  std::memcpy(dst, src, n * 4);
+  if (!host_is_le) {
+    uint32_t u;
+    char* d = static_cast<char*>(dst);
+    for (uint64_t i = 0; i < n; ++i) {
+      std::memcpy(&u, d + i * 4, 4);
+      u = serial::ByteSwap(u);
+      std::memcpy(d + i * 4, &u, 4);
+    }
+  }
+}
+
+inline uint64_t LoadU64As(const char* p, bool host_is_le) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  if (!host_is_le) v = serial::ByteSwap(v);
+  return v;
+}
+
+inline uint64_t LoadU64LE(const char* p) {
+  return LoadU64As(p, serial::NativeIsLE());
 }
 
 // True when [p, p+8) looks like a record head (magic + cflag 0|1) — the

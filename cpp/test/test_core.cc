@@ -21,6 +21,8 @@
 
 #include "../src/concurrency.h"
 #include "../src/config.h"
+#include "../src/csr_rec.h"
+#include "../src/dense_rec.h"
 #include "../src/lockfree.h"
 #include "../src/memory.h"
 #include "../src/pipeline.h"
@@ -794,6 +796,152 @@ void ExpectSummariesMatch(const ParseSummary& a, const ParseSummary& b) {
   EXPECT(std::abs(a.weighted_index - b.weighted_index) < 1e-2);
 }
 
+// Golden on-disk bytes for the binary framing + the BE decode branches —
+// the QEMU-free equivalent of the reference's s390x lane
+// (scripts/test_script.sh:60-65): every decode helper takes host_is_le, so
+// the big-endian branch runs here on the LE host and must be the exact
+// byte-swap of the LE branch.
+void TestRecordIOGoldenBytes() {
+  // frame of payload "hi!": magic 0xced7230a LE, lrec = len 3 cflag 0 LE,
+  // payload, 1 pad byte to the 4-byte boundary (recordio.h format spec)
+  const uint8_t golden[] = {0x0A, 0x23, 0xD7, 0xCE, 0x03, 0x00, 0x00, 0x00,
+                            'h',  'i',  '!',  0x00};
+  dct::MemoryStream ms;
+  {
+    dct::RecordIOWriter w(&ms);
+    w.WriteRecord("hi!", 3);
+  }
+  EXPECT(ms.data().size() == sizeof(golden));
+  EXPECT(std::memcmp(ms.data().data(), golden, sizeof(golden)) == 0);
+  // reader over the golden bytes
+  dct::MemoryFixedSizeStream in(const_cast<char*>(
+      reinterpret_cast<const char*>(golden)), sizeof(golden));
+  dct::RecordIOReader r(&in);
+  std::string rec;
+  EXPECT(r.NextRecord(&rec));
+  EXPECT(rec == "hi!");
+  EXPECT(!r.NextRecord(&rec));
+  // BE decode branch: LoadWordAs(p, false) must equal the byte-swap of
+  // the LE load — a BE host's memory image of the same disk bytes
+  const char* gp = reinterpret_cast<const char*>(golden);
+  EXPECT(dct::recordio::LoadWordAs(gp, true) == 0xCED7230Au);
+  EXPECT(dct::recordio::LoadWordAs(gp, false) ==
+         dct::serial::ByteSwap(0xCED7230Au));
+}
+
+void TestBinaryLaneBEDecodeBranches() {
+  using dct::serial::ByteSwap;
+  // shared CopyWords32LE: the BE branch output is elementwise ByteSwap of
+  // the LE branch output over identical disk bytes
+  const float src[3] = {1.5f, -2.25f, 0.0f};
+  const char* sb = reinterpret_cast<const char*>(src);
+  float le_out[3], be_out[3];
+  dct::recordio::CopyWords32LE(le_out, sb, 3, true);
+  dct::recordio::CopyWords32LE(be_out, sb, 3, false);
+  for (int i = 0; i < 3; ++i) {
+    uint32_t a, b;
+    std::memcpy(&a, le_out + i, 4);
+    std::memcpy(&b, be_out + i, 4);
+    EXPECT(b == ByteSwap(a));
+    EXPECT(le_out[i] == src[i]);
+  }
+  // dense_rec CopyX bf16 -> f32: bf16 of 1.5 is 0x3FC0; on a BE host the
+  // memcpy'd halfword is pre-swap, so the branch must swap it back. Feed
+  // the swapped image through the BE branch and expect the true value.
+  const uint16_t le_h = 0x3FC0;                     // LE disk bytes C0 3F
+  const uint16_t be_mem = ByteSwap(le_h);           // BE memory image
+  float out_f;
+  dct::denserec_detail::CopyX(&out_f, 0,
+                              reinterpret_cast<const char*>(&be_mem), 1, 1,
+                              false);
+  EXPECT(out_f == 1.5f);
+  // integer words through the same shared copy
+  const uint32_t words[2] = {0x01020304u, 0xDEADBEEFu};
+  uint32_t le_w[2], be_w[2];
+  dct::recordio::CopyWords32LE(le_w, words, 2, true);
+  dct::recordio::CopyWords32LE(be_w, words, 2, false);
+  EXPECT(le_w[0] == 0x01020304u && be_w[0] == 0x04030201u);
+  EXPECT(be_w[1] == ByteSwap(le_w[1]));
+  // recordio LoadU64As (csr_rec header words ride through it)
+  const uint64_t u = 0x1122334455667788ull;
+  const char* up = reinterpret_cast<const char*>(&u);
+  EXPECT(dct::recordio::LoadU64As(up, true) == u);
+  EXPECT(dct::recordio::LoadU64As(up, false) == ByteSwap(u));
+}
+
+// Hand-crafted golden DRD1 + DRC1 records decoded by the real batchers:
+// pins the on-disk layout independent of the Python encoder.
+void TestGoldenBinaryRecordsDecode() {
+  dct::TemporaryDirectory tmp;
+  {  // DRD1: 2 rows x 2 features f32, no weights
+    dct::MemoryStream payload;
+    dct::serial::WritePOD<uint32_t>(&payload, 0x44524431u);  // 'DRD1'
+    dct::serial::WritePOD<uint32_t>(&payload, 0u);  // f32, no weight
+    dct::serial::WritePOD<uint32_t>(&payload, 2u);  // rows
+    dct::serial::WritePOD<uint32_t>(&payload, 2u);  // F
+    for (float v : {1.0f, 0.0f}) dct::serial::WritePOD(&payload, v);
+    for (float v : {0.5f, -1.5f, 2.0f, 4.25f}) {
+      dct::serial::WritePOD(&payload, v);
+    }
+    std::unique_ptr<dct::Stream> out(
+        dct::Stream::Create(tmp.path() + "/g.drec", "w"));
+    dct::RecordIOWriter w(out.get());
+    w.WriteRecord(payload.data());
+  }
+  {
+    dct::DenseRecBatcher b(tmp.path() + "/g.drec", 0, 1, 2, 1);
+    float x[4], label[2], weight[2];
+    int32_t nrows[1];
+    EXPECT(b.Fill(x, 0, 2, label, weight, nrows) == 2);
+    EXPECT(label[0] == 1.0f && label[1] == 0.0f);
+    EXPECT(weight[0] == 1.0f && weight[1] == 1.0f);
+    EXPECT(x[0] == 0.5f && x[1] == -1.5f && x[2] == 2.0f && x[3] == 4.25f);
+    EXPECT(nrows[0] == 2);
+  }
+  {  // DRC1: 2 rows, nnz 3, row lens {1, 2}, no optional planes
+    dct::MemoryStream payload;
+    dct::serial::WritePOD<uint32_t>(&payload, 0x44524331u);  // 'DRC1'
+    dct::serial::WritePOD<uint32_t>(&payload, 0u);           // flags
+    dct::serial::WritePOD<uint32_t>(&payload, 2u);           // rows
+    dct::serial::WritePOD<uint32_t>(&payload, 2u);           // nwin
+    dct::serial::WritePOD<uint64_t>(&payload, 3u);           // nnz
+    dct::serial::WritePOD<uint32_t>(&payload, 7u);           // max_col
+    dct::serial::WritePOD<uint32_t>(&payload, 0u);           // reserved
+    dct::serial::WritePOD<uint64_t>(&payload, 2u);  // win_max[0] (1 row)
+    dct::serial::WritePOD<uint64_t>(&payload, 3u);  // win_max[1] (2 rows)
+    dct::serial::WritePOD<uint32_t>(&payload, 1u);  // row_len[0]
+    dct::serial::WritePOD<uint32_t>(&payload, 2u);  // row_len[1]
+    for (float v : {1.0f, 0.0f}) dct::serial::WritePOD(&payload, v);
+    for (uint32_t c : {3u, 5u, 7u}) dct::serial::WritePOD(&payload, c);
+    for (float v : {0.25f, -0.5f, 1.75f}) {
+      dct::serial::WritePOD(&payload, v);
+    }
+    std::unique_ptr<dct::Stream> out(
+        dct::Stream::Create(tmp.path() + "/g.crec", "w"));
+    dct::RecordIOWriter w(out.get());
+    w.WriteRecord(payload.data());
+  }
+  {
+    dct::CsrRecBatcher b(tmp.path() + "/g.crec", 0, 1, 2, 1, 4);
+    uint64_t bucket = 0;
+    int hw = -1, hq = -1, hf = -1;
+    b.Meta(&bucket, &hw, &hq, &hf);
+    EXPECT(bucket == 4 && hw == 0 && hq == 0 && hf == 0);
+    std::vector<int32_t> row(bucket), col(bucket);
+    std::vector<float> val(bucket);
+    float label[2], weight[2];
+    int32_t nrows[1];
+    EXPECT(b.Fill(row.data(), col.data(), val.data(), nullptr, label,
+                  weight, nullptr, nrows) == 2);
+    EXPECT(label[0] == 1.0f && label[1] == 0.0f);
+    EXPECT(row[0] == 0 && row[1] == 1 && row[2] == 1);
+    EXPECT(row[3] == 2);  // padding points at the sacrificial segment R
+    EXPECT(col[0] == 3 && col[1] == 5 && col[2] == 7 && col[3] == 0);
+    EXPECT(val[0] == 0.25f && val[1] == -0.5f && val[2] == 1.75f);
+    EXPECT(nrows[0] == 2);
+  }
+}
+
 void TestThreadedTextParse() {
   dct::TemporaryDirectory tmp;
   std::string path = tmp.path() + "/big.libsvm";
@@ -872,6 +1020,9 @@ int main(int argc, char** argv) {
   TestXmlUnescape();
   TestSplitHostPort();
   TestEndianGoldenBytes();
+  TestRecordIOGoldenBytes();
+  TestBinaryLaneBEDecodeBranches();
+  TestGoldenBinaryRecordsDecode();
   TestThreadedTextParse();
   TestThreadedRecParse();
   if (g_failures == 0) {

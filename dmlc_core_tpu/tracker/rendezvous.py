@@ -30,7 +30,11 @@ logger = logging.getLogger("dmlc_core_tpu.tracker")
 class WorkerConn:
     """One accepted worker connection (reference SlaveEntry)."""
 
-    def __init__(self, sock, addr):
+    def __init__(self, sock, addr, timeout: Optional[float] = None):
+        # a client that connects and goes silent must not stall the
+        # single-threaded accept loop forever; socket.timeout is an
+        # OSError, which every caller already treats as a dead peer
+        sock.settimeout(timeout)
         self.sock = WireSocket(sock)
         self.host = resolve_ip(addr[0])
         magic = self.sock.recv_int()
@@ -76,8 +80,17 @@ class WorkerConn:
                 out.send_int(-1)
         while True:
             ngood = out.recv_int()
+            if ngood < 0 or ngood > len(tree_map):
+                raise ConnectionError(
+                    f"rank {rank} reported {ngood} good links "
+                    f"(world is {len(tree_map)})")
             good = {out.recv_int() for _ in range(ngood)}
-            assert good.issubset(neighbors), (good, neighbors)
+            if not good.issubset(neighbors):
+                # a peer claiming links it was never assigned is a protocol
+                # violation — drop IT, not the tracker thread
+                raise ConnectionError(
+                    f"rank {rank} reported links {sorted(good - neighbors)} "
+                    f"outside its neighbor set")
             bad = neighbors - good
             # peers already listening that this worker should dial
             dial = [r for r in bad if r in wait_conn]
@@ -130,21 +143,43 @@ class RabitTracker:
         job_map: Dict[str, int] = {}
         pending: List[WorkerConn] = []
         todo: List[int] = []
+        assigned: set = set()  # ranks actually handed to a worker
         maps = None
 
+        # Every malformed or adversarial input below is rejected with a
+        # log line and a closed socket — never an assert: a protocol
+        # violation from one worker must not kill the rendezvous for the
+        # rest (the reference tracker.py:254-320 has the assert flaw;
+        # tests/test_tracker_fuzz.py pins the hardened behavior).
+        handshake_timeout = float(
+            os.environ.get("DMLC_TRACKER_HANDSHAKE_TIMEOUT", "300"))
         while len(shutdown) != num_workers:
             fd, addr = self.listener.accept()
             try:
-                conn = WorkerConn(fd, addr)
-            except ConnectionError as e:
+                conn = WorkerConn(fd, addr, timeout=handshake_timeout)
+            except (ConnectionError, OSError, UnicodeDecodeError,
+                    ValueError) as e:
                 logger.warning("rejected connection: %s", e)
                 fd.close()
                 continue
             if conn.cmd == "print":
-                logger.info("%s", conn.sock.recv_str().strip())
+                try:
+                    logger.info("%s", conn.sock.recv_str().strip())
+                except (ConnectionError, OSError, UnicodeDecodeError) as e:
+                    logger.warning("bad print from %s: %s", conn.host, e)
                 continue
             if conn.cmd == "shutdown":
-                assert conn.rank >= 0 and conn.rank not in shutdown
+                # only ranks that were actually handed out may check out:
+                # a spoofed shutdown for a merely in-range rank would
+                # otherwise end the rendezvous under live workers
+                if conn.rank not in assigned or conn.rank in shutdown:
+                    logger.warning(
+                        "rejecting shutdown from %s: rank %d is %s",
+                        conn.host, conn.rank,
+                        "already shut down" if conn.rank in shutdown
+                        else "not an assigned rank")
+                    conn.sock.close()
+                    continue
                 shutdown[conn.rank] = conn
                 logger.debug("rank %d shut down", conn.rank)
                 continue
@@ -154,17 +189,37 @@ class RabitTracker:
                 conn.sock.close()
                 continue
             if maps is None:
-                assert conn.cmd == "start"
+                if conn.cmd != "start":
+                    logger.warning(
+                        "rejecting %s from %s: no worker has started yet",
+                        conn.cmd, conn.host)
+                    conn.sock.close()
+                    continue
                 if conn.world_size > 0:
                     num_workers = conn.world_size
                 maps = topology.build_link_maps(num_workers)
                 todo = list(range(num_workers))
-            else:
-                assert conn.world_size in (-1, num_workers)
-            if conn.cmd == "recover":
-                assert conn.rank >= 0
+            elif conn.world_size not in (-1, num_workers):
+                logger.warning(
+                    "rejecting %s from %s: world_size %d does not match "
+                    "the job's %d", conn.cmd, conn.host, conn.world_size,
+                    num_workers)
+                conn.sock.close()
+                continue
+            if conn.cmd == "recover" and not 0 <= conn.rank < num_workers:
+                logger.warning(
+                    "rejecting recover from %s: rank %d was never "
+                    "assigned", conn.host, conn.rank)
+                conn.sock.close()
+                continue
 
             rank = conn.decide_rank(job_map)
+            if rank >= num_workers:
+                logger.warning(
+                    "rejecting %s from %s: rank %d out of range",
+                    conn.cmd, conn.host, rank)
+                conn.sock.close()
+                continue
             if rank == -1:
                 todo_pending = len(todo)
                 pending.append(conn)
@@ -184,7 +239,9 @@ class RabitTracker:
                             logger.warning(
                                 "worker %s died during rank %d handshake: "
                                 "%s (awaiting recover)", c.host, r, e)
+                            c.sock.close()  # violators see a clean drop
                             continue
+                        assigned.add(r)
                         if c.wait_accept > 0:
                             wait_conn[r] = c
                         logger.debug("assigned rank %d to %s", r, c.host)
@@ -200,7 +257,9 @@ class RabitTracker:
                     logger.warning(
                         "worker %s died during %s of rank %d: %s",
                         conn.host, conn.cmd, rank, e)
+                    conn.sock.close()  # violators see a clean drop
                     continue
+                assigned.add(rank)
                 if conn.wait_accept > 0:
                     wait_conn[rank] = conn
                 logger.debug("%s rank %d re-linked", conn.cmd, rank)

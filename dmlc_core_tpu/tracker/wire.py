@@ -40,9 +40,18 @@ class WireSocket:
         """Send one int32 (Rabit wire byte order)."""
         self.sock.sendall(struct.pack("@i", v))
 
+    # strings on this protocol are hostnames/job ids/log lines; a length
+    # beyond this is a corrupt or adversarial frame, not data — without
+    # the cap a bogus 2 GB prefix would balloon recv_all and stall the
+    # tracker's accept loop
+    MAX_STR = 1 << 20
+
     def recv_str(self) -> str:
         """Receive a length-prefixed string (Rabit wire format)."""
         n = self.recv_int()
+        if n < 0 or n > self.MAX_STR:
+            raise ConnectionError(
+                f"invalid string length {n} on tracker wire")
         return self.recv_all(n).decode()
 
     def send_str(self, s: str) -> None:
