@@ -419,6 +419,15 @@ CSVParser<IndexType>::CSVParser(InputSplit* source,
   DCT_CHECK_EQ(param.delimiter.size(), size_t(1))
       << "delimiter must be a single char";
   delimiter_ = param.delimiter[0];
+  // the single-pass cell parse relies on the delimiter terminating a
+  // number scan; a numeric-looking delimiter would let values run across
+  // cells (reference csv_parser.h has the same implicit assumption via
+  // strtof stopping at it)
+  DCT_CHECK(!IsDigitChar(delimiter_) && delimiter_ != '.' &&
+            delimiter_ != '-' && delimiter_ != '+' && delimiter_ != 'e' &&
+            delimiter_ != 'E')
+      << "csv delimiter '" << delimiter_
+      << "' is a numeric character; values could not be delimited";
   DCT_CHECK(label_column_ != weight_column_ || label_column_ < 0)
       << "label and weight columns must differ";
   // typed values (reference csv_parser.h:24-147 DType float32/int32/int64);
@@ -427,18 +436,27 @@ CSVParser<IndexType>::CSVParser(InputSplit* source,
 }
 
 namespace {
-// value-cell sink per csv dtype: parses [vp, cell_end) into `values`
+// value-cell sink per csv dtype: parses a number at vp into `values` and
+// advances *out past it (the caller then skips any cell residue)
 template <typename VT>
-bool ParseCell(const char* vp, const char* cell_end, std::vector<VT>* values) {
+bool ParseCell(const char* vp, const char* end, const char** out,
+               std::vector<VT>* values) {
   VT v;
   const char* after;
-  if (!ParseNum<VT>(vp, cell_end, &after, &v)) return false;
+  if (!ParseNum<VT>(vp, end, &after, &v)) return false;
+  *out = after;
   values->push_back(v);
   return true;
 }
 }  // namespace
 
-// reference src/data/csv_parser.h:76-147
+// reference src/data/csv_parser.h:76-147. Single-pass tokenizer (same
+// rationale as the libsvm ParseBlock above): cells are parsed where the
+// cursor stands and EOL characters double as cell terminators, instead of
+// pre-scanning each line and then each cell for its end — one traversal
+// instead of three. Semantics (missing values keep their column index,
+// label/weight columns, blank-only lines emit empty rows, delimiter
+// presence check) match the line-oriented form; tests pin them.
 template <typename IndexType>
 void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
                                       RowBlockContainer<IndexType>* out) {
@@ -446,43 +464,52 @@ void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
   out->value_dtype = value_dtype_;
   const char* p = SkipUTF8BOM(begin, end);
   while (p != end) {
-    const char* line_end;
-    const char* next = LineSpan(p, end, &line_end);
-    const char* cur = SkipUTF8BOM(p, line_end);
-    p = next;
-    if (cur == line_end) continue;  // empty line
+    if (IsEolChar(*p)) {  // empty line (also the LF of a CRLF pair)
+      ++p;
+      continue;
+    }
+    p = SkipUTF8BOM(p, end);
     int column = 0;
     IndexType idx = 0;
     float label = 0.0f;
     float weight = std::numeric_limits<float>::quiet_NaN();
     bool any_delim = false;
-    while (cur <= line_end) {
-      // cell = [cur, cell_end)
-      const char* cell_end = cur;
-      while (cell_end != line_end && *cell_end != delimiter_) ++cell_end;
-      const char* vp = cur;
-      while (vp != cell_end && IsBlankChar(*vp)) ++vp;
+    bool line_done = false;
+    while (!line_done) {
+      // leading blanks of the cell — but never across a blank DELIMITER
+      // (tab-separated files: '\t' both blank and delimiter)
+      while (p != end && IsBlankChar(*p) && *p != delimiter_) ++p;
       if (column == label_column_ || column == weight_column_) {
         float v;
         const char* after;
-        if (ParseNum<float>(vp, cell_end, &after, &v)) {
+        if (ParseNum<float>(p, end, &after, &v)) {
           (column == label_column_ ? label : weight) = v;
+          p = after;
         }
       } else {
         bool parsed =
-            value_dtype_ == 0 ? ParseCell(vp, cell_end, &out->value)
-            : value_dtype_ == 1 ? ParseCell(vp, cell_end, &out->value_i32)
-                                : ParseCell(vp, cell_end, &out->value_i64);
+            value_dtype_ == 0 ? ParseCell(p, end, &p, &out->value)
+            : value_dtype_ == 1 ? ParseCell(p, end, &p, &out->value_i32)
+                                : ParseCell(p, end, &p, &out->value_i64);
         if (parsed) {
           out->index.push_back(idx++);
         } else {
           ++idx;  // missing value: skip but keep the column index
         }
       }
+      // cell residue (trailing garbage/blanks) up to the next delimiter
+      // or end of line
+      while (p != end && *p != delimiter_ && !IsEolChar(*p)) ++p;
       ++column;
-      if (cell_end == line_end) break;
-      any_delim = true;
-      cur = cell_end + 1;
+      if (p == end) {
+        line_done = true;  // NOEOL final line
+      } else if (*p == delimiter_) {
+        any_delim = true;
+        ++p;
+      } else {
+        ++p;  // consume the EOL character
+        line_done = true;
+      }
     }
     DCT_CHECK(any_delim || column <= 1 || idx > 0)
         << "delimiter '" << delimiter_ << "' not found in csv line";
@@ -507,7 +534,9 @@ LibFMParser<IndexType>::LibFMParser(
   indexing_mode_ = param.indexing_mode;
 }
 
-// reference src/data/libfm_parser.h:67-144
+// reference src/data/libfm_parser.h:67-144. Single-pass tokenizer (same
+// structure as the libsvm ParseBlock: rows and `field:feature[:value]`
+// triples recognized in one scan, newlines terminate the token loop).
 template <typename IndexType>
 void LibFMParser<IndexType>::ParseBlock(const char* begin, const char* end,
                                         RowBlockContainer<IndexType>* out) {
@@ -516,26 +545,54 @@ void LibFMParser<IndexType>::ParseBlock(const char* begin, const char* end,
   IndexType min_feat = std::numeric_limits<IndexType>::max();
   const char* p = SkipUTF8BOM(begin, end);
   while (p != end) {
-    const char* line_end;
-    const char* next = LineSpan(p, end, &line_end);
-    const char* cur = SkipBlankOrComment(p, line_end);
-    p = next;
-    float label, weight;
-    const char* after;
-    int r = ParsePair<float, float>(cur, line_end, &after, &label, &weight);
-    if (r < 1) continue;
-    if (r == 2) out->weight.push_back(weight);
+    while (p != end && (IsBlankChar(*p) || IsEolChar(*p))) ++p;
+    if (p == end) break;
+    if (*p == '#') {  // comment-only line
+      p = SkipToEol(p, end);
+      continue;
+    }
+    float label;
+    if (!ParseNum<float>(p, end, &p, &label)) {
+      p = SkipToEol(p, end);  // garbage line: discard (ParsePair contract)
+      continue;
+    }
+    if (p != end && *p == ':') {
+      float weight;
+      const char* wp;
+      if (ParseNum<float>(p + 1, end, &wp, &weight)) {
+        out->weight.push_back(weight);
+        p = wp;
+      }
+    }
     out->label.push_back(label);
-    cur = after;
-    while (cur != line_end) {
-      cur = SkipBlankOrComment(cur, line_end);
+    // field:feature[:value] triples until end of line
+    while (true) {
+      while (p != end && IsBlankChar(*p)) ++p;
+      if (p == end) break;
+      const char c = *p;
+      if (IsEolChar(c)) {
+        ++p;
+        break;
+      }
+      if (c == '#') {
+        p = SkipToEol(p, end);
+        break;
+      }
       uint32_t field;
       IndexType feat;
       float value;
-      int rr = ParseTriple<uint32_t, IndexType, float>(cur, line_end, &after,
-                                                       &field, &feat, &value);
-      cur = after;
-      if (rr <= 1) continue;
+      const char* after;
+      // a triple shares the pair grammar; ParseTriple's rr<=1 cases
+      // (bare number, no second ':') keep the line-oriented semantics
+      int rr = ParseTriple<uint32_t, IndexType, float>(p, end, &after,
+                                                       &field, &feat,
+                                                       &value);
+      if (rr == 0) {
+        p = SkipToEol(p, end);  // non-numeric token: discard the line
+        break;
+      }
+      p = after;
+      if (rr == 1) continue;  // bare number token: skipped (reference)
       out->field.push_back(field);
       out->index.push_back(feat);
       min_field = std::min(min_field, field);

@@ -153,6 +153,8 @@ class RabitTracker:
         # tests/test_tracker_fuzz.py pins the hardened behavior).
         handshake_timeout = float(
             os.environ.get("DMLC_TRACKER_HANDSHAKE_TIMEOUT", "300"))
+        max_world = int(os.environ.get("DMLC_TRACKER_MAX_WORLD",
+                                       str(1 << 20)))
         while len(shutdown) != num_workers:
             fd, addr = self.listener.accept()
             try:
@@ -195,6 +197,16 @@ class RabitTracker:
                         conn.cmd, conn.host)
                     conn.sock.close()
                     continue
+                if conn.world_size > max_world:
+                    # the first start frame pins the world size; an
+                    # unbounded value would feed build_link_maps an O(n)
+                    # allocation and make the job unfinishable
+                    logger.warning(
+                        "rejecting start from %s: world_size %d exceeds "
+                        "DMLC_TRACKER_MAX_WORLD=%d", conn.host,
+                        conn.world_size, max_world)
+                    conn.sock.close()
+                    continue
                 if conn.world_size > 0:
                     num_workers = conn.world_size
                 maps = topology.build_link_maps(num_workers)
@@ -206,10 +218,14 @@ class RabitTracker:
                     num_workers)
                 conn.sock.close()
                 continue
-            if conn.cmd == "recover" and not 0 <= conn.rank < num_workers:
+            if conn.rank >= 0 and conn.rank not in assigned:
+                # a preset rank (recover, or start claiming one) is only
+                # honored for ranks this tracker actually handed out — an
+                # unauthenticated claim would hijack the rank's topology
+                # slot and reroute its peers' links
                 logger.warning(
-                    "rejecting recover from %s: rank %d was never "
-                    "assigned", conn.host, conn.rank)
+                    "rejecting %s from %s: rank %d was never assigned",
+                    conn.cmd, conn.host, conn.rank)
                 conn.sock.close()
                 continue
 
@@ -229,6 +245,10 @@ class RabitTracker:
                     pending.sort(key=lambda c: c.host)
                     for c in pending:
                         r = todo.pop(0)
+                        # the rank is handed out from here on (a worker
+                        # dying mid-handshake below reclaims it via
+                        # recover, which requires membership here)
+                        assigned.add(r)
                         if c.jobid != "NULL":
                             job_map[c.jobid] = r
                         # a worker dying mid-handshake must not kill the
@@ -241,7 +261,6 @@ class RabitTracker:
                                 "%s (awaiting recover)", c.host, r, e)
                             c.sock.close()  # violators see a clean drop
                             continue
-                        assigned.add(r)
                         if c.wait_accept > 0:
                             wait_conn[r] = c
                         logger.debug("assigned rank %d to %s", r, c.host)
@@ -259,7 +278,6 @@ class RabitTracker:
                         conn.host, conn.cmd, rank, e)
                     conn.sock.close()  # violators see a clean drop
                     continue
-                assigned.add(rank)
                 if conn.wait_accept > 0:
                     wait_conn[rank] = conn
                 logger.debug("%s rank %d re-linked", conn.cmd, rank)

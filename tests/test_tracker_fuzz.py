@@ -100,6 +100,53 @@ def test_spoofed_shutdowns_for_unassigned_ranks_do_not_end_the_job():
     _finish_job(tracker)  # real workers still get ranks and finish
 
 
+def test_rank_hijack_rejected():
+    """Code-review r4 regression: a spoofed start/recover claiming an
+    in-range rank that was never handed out must be rejected — honoring
+    it would hand the adversary the rank's topology slot and reroute its
+    peers' links to an attacker endpoint."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start()
+
+    def worker(results):
+        c = RendezvousClient("127.0.0.1", tracker.port)
+        a = c.start()
+        results[a.rank] = c, a
+
+    results = {}
+    ths = [threading.Thread(target=worker, args=(results,))
+           for _ in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=30)
+    assert sorted(results) == [0, 1]
+    # hijack attempts for an assigned-but-not-ours rank and a fresh one
+    ws = _wire(tracker.port, rank=5, cmd="recover")  # never assigned
+    assert ws.sock.recv(4) == b""  # dropped without an assignment
+    # rank 0 IS assigned, so recover for it still works (the legit
+    # recovery path) — topology comes back
+    ws2 = _wire(tracker.port, rank=0, cmd="recover")
+    got_rank = ws2.recv_int()
+    assert got_rank == 0
+    ws2.close()  # abandon mid-handshake; rank stays recoverable
+    for r, (c, a) in results.items():
+        c.shutdown(r)
+    tracker.join(timeout=30)
+
+
+def test_giant_world_size_rejected():
+    """Code-review r4 regression: the FIRST start frame's world_size is
+    attacker-controlled; an absurd value must be rejected before it
+    feeds build_link_maps an O(n) allocation and pins an unfinishable
+    job."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start()
+    _wire(tracker.port, world=1 << 30, cmd="start").close()
+    assert tracker.alive()
+    _finish_job(tracker)  # real 2-worker job still completes
+
+
 def test_adversarial_commands_rejected():
     tracker = RabitTracker("127.0.0.1", 2)
     tracker.start()
