@@ -32,15 +32,10 @@ CsrRecBatcher::CsrRecBatcher(const std::string& uri, unsigned part,
       << "batch_rows=" << batch_rows_ << " must divide by shards="
       << num_shards_;
   URISpec spec(uri, part, npart);
-  // URI sugar this lane does not implement must error, not silently
-  // no-op (dense_rec.cc rule). Shuffling is additionally unsound here:
-  // the window-table bucket bounds CONSECUTIVE rows, and a coarse
-  // shuffle would compose batches from two windows' tails.
-  for (const auto& kv : spec.args) {
-    DCT_CHECK(kv.first == "format")
-        << "csr rec lane does not support the URI arg `" << kv.first
-        << "` (shuffling/batching knobs apply to the text and rec lanes)";
-  }
+  // shuffling is additionally unsound here: the window-table bucket
+  // bounds CONSECUTIVE rows, and a coarse shuffle would compose batches
+  // from two windows' tails
+  spec.RejectUnknownArgs("csr rec lane", {"format"});
   split_.reset(InputSplit::Create(spec.uri, part, npart, "recordio", "",
                                   false, 0, 256, false, /*threaded=*/true,
                                   spec.cache_file));

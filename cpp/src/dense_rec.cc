@@ -70,14 +70,7 @@ DenseRecBatcher::DenseRecBatcher(const std::string& uri, unsigned part,
       << "batch_rows=" << batch_rows_ << " must divide by shards="
       << num_shards_;
   URISpec spec(uri, part, npart);
-  // URI sugar this lane does not implement must error, not silently
-  // no-op: a user passing ?shuffle_parts= would otherwise train on
-  // unshuffled data without noticing
-  for (const auto& kv : spec.args) {
-    DCT_CHECK(kv.first == "format")
-        << "dense rec lane does not support the URI arg `" << kv.first
-        << "` (shuffling/batching knobs apply to the text and rec lanes)";
-  }
+  spec.RejectUnknownArgs("dense rec lane", {"format"});
   split_.reset(InputSplit::Create(spec.uri, part, npart, "recordio", "",
                                   false, 0, 256, false, /*threaded=*/true,
                                   spec.cache_file));

@@ -9,6 +9,7 @@
 #define DCT_STREAM_H_
 
 #include <cstring>
+#include <initializer_list>
 #include <map>
 #include <memory>
 #include <string>
@@ -196,6 +197,21 @@ struct URISpec {
       }
     }
     uri = rest;
+  }
+
+  // URI sugar a lane does not implement must error, not silently no-op
+  // (a user passing ?shuffle_parts= to a lane without shuffling would
+  // otherwise train on unshuffled data without noticing). `allowed` is
+  // the lane's known-args allowlist.
+  void RejectUnknownArgs(const char* lane,
+                         std::initializer_list<const char*> allowed) const {
+    for (const auto& kv : args) {
+      bool ok = false;
+      for (const char* a : allowed) ok = ok || kv.first == a;
+      DCT_CHECK(ok) << lane << " does not support the URI arg `"
+                    << kv.first << "` (shuffling/batching knobs apply to "
+                    << "the text and rec lanes)";
+    }
   }
 };
 

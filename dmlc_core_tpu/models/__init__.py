@@ -1,6 +1,8 @@
-"""Flagship consumers: the sparse/dense linear learner and the DPxSP
-transformer (ring attention)."""
+"""Flagship consumers: the sparse/dense linear learner, the factorization
+machine (the libfm lane's canonical model), and the DPxSP transformer
+(ring attention)."""
 
+from dmlc_core_tpu.models.fm import FMLearner, FMParams  # noqa: F401
 from dmlc_core_tpu.models.linear import LinearLearner  # noqa: F401
 from dmlc_core_tpu.models.transformer import (TransformerConfig,  # noqa: F401
                                               TransformerLM)

@@ -1,0 +1,110 @@
+"""Shared data-parallel step harness for the margin models.
+
+LinearLearner and FMLearner differ only in their parameter pytrees, margin
+computation, and SGD update; everything about running a step over a device
+batch is identical — unpack the packed two-leaf batch per shard, take
+value_and_grad of the shard loss, psum the (loss, weight, grad) triple
+once over ICI (the Rabit allreduce equivalent, SURVEY §2.5), apply the
+update, and jit-cache per batch shape. That harness lives here once.
+
+Subclasses implement:
+  _shard_loss(params, shard, rows_per_shard) -> (loss_sum, weight_sum)
+  _apply(params, grads, denom) -> new params
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dmlc_core_tpu.tpu.device_iter import unpack_shard
+
+__all__ = ["DataParallelModel"]
+
+
+class DataParallelModel:
+    """Mixin: the shard_map+psum step over packed or named batch trees."""
+
+    mesh: Optional[Mesh]
+    axis_name: str
+
+    def _shard_loss(self, params, shard, rows_per_shard: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def _apply(self, params, grads, denom):
+        raise NotImplementedError
+
+    def _build_step(self, rows_per_shard: int, keys: tuple):
+        axis = self.axis_name
+        # packed leaves (aux/big — device_iter packing) carry the device
+        # axis at position 1; named leaves lead with it
+        tree_keys = [(k, P(None, axis) if k in ("aux", "big") else P(axis))
+                     for k in keys]
+
+        def shard_view(tree):
+            """Drop the device axis and unpack aux/big into named arrays
+            (a bitcast+slice — free inside the jitted step)."""
+            local = {k: v[:, 0] if k in ("aux", "big") else v[0]
+                     for k, v in tree.items()}
+            return unpack_shard(local)
+
+        def local_grads(params, shard):
+            def loss_fn(p):
+                return self._shard_loss(p, shard, rows_per_shard)
+            (loss_sum, wsum), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss_sum, wsum, grads
+
+        if self.mesh is None:
+            def step(params, tree):
+                shard = shard_view(tree)
+                loss_sum, wsum, grads = local_grads(params, shard)
+                denom = jnp.maximum(wsum, 1.0)
+                return self._apply(params, grads, denom), loss_sum / denom
+            return jax.jit(step)
+
+        from jax import shard_map
+        mesh = self.mesh
+
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P(), dict(tree_keys)),
+                           out_specs=(P(), P()))
+        def sharded_step(params, tree):
+            shard = shard_view(tree)  # drop device axis + unpack
+            loss_sum, wsum, grads = local_grads(params, shard)
+            # ONE reduction per step over ICI — the Rabit allreduce
+            # equivalent (SURVEY §2.5)
+            loss_sum = jax.lax.psum(loss_sum, axis)
+            wsum = jax.lax.psum(wsum, axis)
+            grads = jax.tree.map(lambda g: jax.lax.psum(g, axis), grads)
+            denom = jnp.maximum(wsum, 1.0)
+            return self._apply(params, grads, denom), loss_sum / denom
+
+        return jax.jit(sharded_step)
+
+    def step(self, params, batch):
+        """One jitted training step on a device batch; returns
+        (params, loss)."""
+        if getattr(self, "_step_fn", None) is None:
+            self._step_fn = {}
+        tree = batch.tree()
+        D = (tree["aux"].shape[1] if "aux" in tree
+             else tree["label"].shape[0])
+        n_dev = 1 if self.mesh is None else int(self.mesh.devices.size)
+        if D != n_dev:
+            # the step reads shard block[0] only — a mismatch would
+            # silently train on 1/D of the rows
+            raise ValueError(
+                f"batch device axis D={D} != mesh size {n_dev}; "
+                f"build the batch with num_shards={n_dev}")
+        sig = tuple((k, tuple(v.shape)) for k, v in sorted(tree.items()))
+        fn = self._step_fn.get(sig)
+        if fn is None:
+            fn = self._step_fn[sig] = self._build_step(
+                batch.rows_per_shard, tuple(sorted(tree.keys())))
+        return fn(params, tree)

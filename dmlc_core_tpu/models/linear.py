@@ -15,16 +15,16 @@ the dense MXU path when F is small (ops/sparse.csr_to_dense).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_core_tpu.models._dp import DataParallelModel
 from dmlc_core_tpu.ops.sparse import csr_matvec
 from dmlc_core_tpu.tpu.device_iter import (DenseBatch, PaddedBatch,
-                                           unpack_shard, unpack_tree)
+                                           unpack_tree)
 
 __all__ = ["LinearParams", "LinearLearner"]
 
@@ -34,15 +34,12 @@ class LinearParams(NamedTuple):
     b: jnp.ndarray  # []
 
 
-def _shard_loss(params: LinearParams, shard: Dict[str, jnp.ndarray],
-                num_rows: int, objective: str, l2: float
-                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(weighted loss sum, weight sum) for one local shard."""
-    if "x" in shard:  # dense layout: one MXU matvec
-        margin = shard["x"].astype(jnp.float32) @ params.w + params.b
-    else:
-        margin = csr_matvec(shard["row"], shard["col"], shard["val"],
-                            params.w, num_rows) + params.b
+def objective_loss(margin: jnp.ndarray, shard: Dict[str, jnp.ndarray],
+                   num_rows: int, objective: str
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(weighted loss sum, weight sum) for a shard given its margins —
+    the objective zoo shared by every margin-producing model (linear here,
+    the factorization machine in models/fm.py)."""
     y = shard["label"]
     wgt = shard["weight"]  # 0 on padding rows
     if objective == "logistic":
@@ -76,7 +73,20 @@ def _shard_loss(params: LinearParams, shard: Dict[str, jnp.ndarray],
     return jnp.sum(per_row * wgt), jnp.sum(wgt)
 
 
-class LinearLearner:
+def _shard_loss(params: LinearParams, shard: Dict[str, jnp.ndarray],
+                num_rows: int, objective: str
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(weighted loss sum, weight sum) for one local shard. (L2 is applied
+    as decoupled weight decay in the update, not in the loss.)"""
+    if "x" in shard:  # dense layout: one MXU matvec
+        margin = shard["x"].astype(jnp.float32) @ params.w + params.b
+    else:
+        margin = csr_matvec(shard["row"], shard["col"], shard["val"],
+                            params.w, num_rows) + params.b
+    return objective_loss(margin, shard, num_rows, objective)
+
+
+class LinearLearner(DataParallelModel):
     """Distributed sparse linear model.
 
     Usage::
@@ -109,84 +119,15 @@ class LinearLearner:
             params = jax.device_put(params, rep)
         return params
 
-    # -- core step (pure function; jitted once per batch shape) -------------
-    def _build_step(self, rows_per_shard: int, keys: tuple):
-        objective, l2, lr = self.objective, self.l2, self.learning_rate
-        axis = self.axis_name
-        # packed leaves (aux/big — device_iter packing) carry the device
-        # axis at position 1; named leaves lead with it
-        tree_keys = [(k, P(None, axis) if k in ("aux", "big") else P(axis))
-                     for k in keys]
+    # -- DataParallelModel hooks (the step harness lives in models/_dp.py) --
+    def _shard_loss(self, params, shard, rows_per_shard):
+        return _shard_loss(params, shard, rows_per_shard, self.objective)
 
-        def shard_view(tree):
-            """Drop the device axis and unpack aux/big into named arrays
-            (a bitcast+slice — free inside the jitted step)."""
-            local = {k: v[:, 0] if k in ("aux", "big") else v[0]
-                     for k, v in tree.items()}
-            return unpack_shard(local)
-
-        def local_grads(params, shard):
-            def loss_fn(p):
-                s, n = _shard_loss(p, shard, rows_per_shard, objective, l2)
-                return s, n
-            (loss_sum, wsum), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            return loss_sum, wsum, grads
-
-        if self.mesh is None:
-            def step(params, tree):
-                shard = shard_view(tree)
-                loss_sum, wsum, grads = local_grads(params, shard)
-                denom = jnp.maximum(wsum, 1.0)
-                new = LinearParams(
-                    w=params.w - lr * (grads.w / denom + l2 * params.w),
-                    b=params.b - lr * grads.b / denom)
-                return new, loss_sum / denom
-            return jax.jit(step)
-
-        from jax import shard_map
-        mesh = self.mesh
-
-        @functools.partial(shard_map, mesh=mesh,
-                           in_specs=(P(), dict(tree_keys)),
-                           out_specs=(P(), P()))
-        def sharded_step(params, tree):
-            shard = shard_view(tree)  # drop device axis + unpack
-            loss_sum, wsum, grads = local_grads(params, shard)
-            # ONE reduction per step over ICI — the Rabit allreduce
-            # equivalent (SURVEY §2.5)
-            loss_sum = jax.lax.psum(loss_sum, axis)
-            wsum = jax.lax.psum(wsum, axis)
-            grads = jax.tree.map(lambda g: jax.lax.psum(g, axis), grads)
-            denom = jnp.maximum(wsum, 1.0)
-            new = LinearParams(
-                w=params.w - lr * (grads.w / denom + l2 * params.w),
-                b=params.b - lr * grads.b / denom)
-            return new, loss_sum / denom
-
-        return jax.jit(sharded_step)
-
-    def step(self, params: LinearParams, batch: PaddedBatch
-             ) -> Tuple[LinearParams, jnp.ndarray]:
-        """One jitted training step on a device batch; returns (params, loss)."""
-        if self._step_fn is None:
-            self._step_fn = {}
-        tree = batch.tree()
-        D = (tree["aux"].shape[1] if "aux" in tree
-             else tree["label"].shape[0])
-        n_dev = 1 if self.mesh is None else int(self.mesh.devices.size)
-        if D != n_dev:
-            # the step reads shard block[0] only — a mismatch would
-            # silently train on 1/D of the rows
-            raise ValueError(
-                f"batch device axis D={D} != mesh size {n_dev}; "
-                f"build the batch with num_shards={n_dev}")
-        shape_sig = tuple((k, tuple(v.shape)) for k, v in sorted(tree.items()))
-        fn = self._step_fn.get(shape_sig)
-        if fn is None:
-            fn = self._step_fn[shape_sig] = self._build_step(
-                batch.rows_per_shard, tuple(sorted(tree.keys())))
-        return fn(params, tree)
+    def _apply(self, params, grads, denom):
+        lr, l2 = self.learning_rate, self.l2
+        return LinearParams(
+            w=params.w - lr * (grads.w / denom + l2 * params.w),
+            b=params.b - lr * grads.b / denom)
 
     def predict(self, params: LinearParams, batch) -> jnp.ndarray:
         """Margins [D, R] (apply sigmoid for probabilities)."""

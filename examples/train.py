@@ -36,7 +36,7 @@ if os.environ.get("JAX_PLATFORMS"):
 
 import numpy as np  # noqa: E402
 
-from dmlc_core_tpu.models import LinearLearner  # noqa: E402
+from dmlc_core_tpu.models import FMLearner, LinearLearner  # noqa: E402
 from dmlc_core_tpu.parallel import init_from_env  # noqa: E402
 from dmlc_core_tpu.tpu import DeviceRowBlockIter, data_mesh  # noqa: E402
 from dmlc_core_tpu.tpu.sharding import process_part  # noqa: E402
@@ -50,6 +50,11 @@ def main() -> int:
                                "(file://, s3://, hdfs://, azure://)")
     ap.add_argument("--num-features", type=int, default=0,
                     help="0 = discover from the first epoch's max index")
+    ap.add_argument("--model", default="linear", choices=("linear", "fm"),
+                    help="linear learner or second-order factorization "
+                         "machine (the libfm lane's canonical consumer)")
+    ap.add_argument("--fm-rank", type=int, default=8,
+                    help="FM interaction-factor rank k")
     ap.add_argument("--objective", default="logistic",
                     choices=("logistic", "squared", "pairwise"))
     ap.add_argument("--epochs", type=int, default=2)
@@ -75,9 +80,14 @@ def main() -> int:
                 mx = max(mx, int(b.max_index))
         args.num_features = mx + 1
 
-    learner = LinearLearner(num_features=args.num_features, mesh=mesh,
-                            objective=args.objective,
+    if args.model == "fm":
+        learner = FMLearner(num_features=args.num_features, mesh=mesh,
+                            k=args.fm_rank, objective=args.objective,
                             learning_rate=args.learning_rate)
+    else:
+        learner = LinearLearner(num_features=args.num_features, mesh=mesh,
+                                objective=args.objective,
+                                learning_rate=args.learning_rate)
     params = learner.init()
     start_epoch = 0
     data_state = None

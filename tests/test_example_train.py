@@ -58,3 +58,19 @@ def test_example_pairwise_over_shuffled_uri(tmp_path):
     out = _run([str(data) + "?shuffle_parts=4", "--objective", "pairwise",
                 "--epochs", "2", "--batch-rows", "128"], cwd=str(tmp_path))
     assert out.count("mean loss") == 2
+
+
+def test_example_trains_fm_on_libfm(tmp_path):
+    """The FM path of the example over the libfm text lane end-to-end."""
+    rng = np.random.default_rng(5)
+    data = tmp_path / "f.libfm"
+    with open(data, "w") as f:
+        for i in range(600):
+            x = rng.uniform(-1, 1, 4)
+            y = 1 if x[0] * x[1] > 0 else 0
+            toks = " ".join(f"{j % 2}:{j}:{x[j]:.4f}" for j in range(4))
+            f.write(f"{y} {toks}\n")
+    out = _run([str(data) + "?format=libfm", "--model", "fm",
+                "--fm-rank", "4", "--epochs", "2", "--batch-rows", "128"],
+               cwd=str(tmp_path))
+    assert out.count("mean loss") == 2
