@@ -4,5 +4,7 @@ machine (the libfm lane's canonical model), and the DPxSP transformer
 
 from dmlc_core_tpu.models.fm import FMLearner, FMParams  # noqa: F401
 from dmlc_core_tpu.models.linear import LinearLearner  # noqa: F401
+from dmlc_core_tpu.models.tp_transformer import (  # noqa: F401
+    TPTransformerConfig, TPTransformerLM)
 from dmlc_core_tpu.models.transformer import (TransformerConfig,  # noqa: F401
                                               TransformerLM)

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.models._dp import DataParallelModel
 from dmlc_core_tpu.models.linear import objective_loss
+from dmlc_core_tpu.ops.sparse import csr_matvec
 from dmlc_core_tpu.tpu.device_iter import unpack_tree
 
 __all__ = ["FMParams", "FMLearner"]
@@ -45,7 +46,7 @@ def _fm_margin_csr(params: FMParams, row, col, val, num_rows: int
     seg = functools.partial(jax.ops.segment_sum,
                             num_segments=num_rows + 1,
                             indices_are_sorted=True)
-    linear = seg(val * params.w[col], row)[:num_rows]
+    linear = csr_matvec(row, col, val, params.w, num_rows)
     vx = params.v[col] * val[:, None]          # [NNZ, K]
     s1 = seg(vx, row)[:num_rows]               # Σ V x   per row  [R, K]
     s2 = seg(vx * vx, row)[:num_rows]          # Σ V²x²  per row  [R, K]
@@ -132,14 +133,20 @@ class FMLearner(DataParallelModel):
     def predict(self, params: FMParams, batch) -> jnp.ndarray:
         """Margins [D, R] (apply sigmoid for probabilities)."""
         R = batch.rows_per_shard
-
-        @jax.jit
-        def fwd(params, tree):
-            tree = unpack_tree(tree)
-            if "x" in tree:
+        # one jitted fwd per rows-per-shard, cached on the learner — a
+        # fresh @jax.jit closure per call would retrace every predict
+        if getattr(self, "_fwd_fn", None) is None:
+            self._fwd_fn = {}
+        fwd = self._fwd_fn.get(R)
+        if fwd is None:
+            @jax.jit
+            def fwd(params, tree):
+                tree = unpack_tree(tree)
+                if "x" in tree:
+                    return jax.vmap(
+                        lambda x: _fm_margin_dense(params, x))(tree["x"])
                 return jax.vmap(
-                    lambda x: _fm_margin_dense(params, x))(tree["x"])
-            return jax.vmap(
-                lambda r, c, v: _fm_margin_csr(params, r, c, v, R))(
-                    tree["row"], tree["col"], tree["val"])
+                    lambda r, c, v: _fm_margin_csr(params, r, c, v, R))(
+                        tree["row"], tree["col"], tree["val"])
+            self._fwd_fn[R] = fwd
         return fwd(params, batch.tree())

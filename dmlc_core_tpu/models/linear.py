@@ -132,13 +132,20 @@ class LinearLearner(DataParallelModel):
     def predict(self, params: LinearParams, batch) -> jnp.ndarray:
         """Margins [D, R] (apply sigmoid for probabilities)."""
         R = batch.rows_per_shard
-
-        @jax.jit
-        def fwd(params, tree):
-            tree = unpack_tree(tree)  # packed batches: bitcast + slice
-            if "x" in tree:
-                return tree["x"].astype(jnp.float32) @ params.w + params.b
-            def one(row, col, val):
-                return csr_matvec(row, col, val, params.w, R) + params.b
-            return jax.vmap(one)(tree["row"], tree["col"], tree["val"])
+        # one jitted fwd per rows-per-shard, cached on the learner — a
+        # fresh @jax.jit closure per call would retrace every predict
+        if getattr(self, "_fwd_fn", None) is None:
+            self._fwd_fn = {}
+        fwd = self._fwd_fn.get(R)
+        if fwd is None:
+            @jax.jit
+            def fwd(params, tree):
+                tree = unpack_tree(tree)  # packed batches: bitcast + slice
+                if "x" in tree:
+                    return tree["x"].astype(jnp.float32) @ params.w + \
+                        params.b
+                def one(row, col, val):
+                    return csr_matvec(row, col, val, params.w, R) + params.b
+                return jax.vmap(one)(tree["row"], tree["col"], tree["val"])
+            self._fwd_fn[R] = fwd
         return fwd(params, batch.tree())

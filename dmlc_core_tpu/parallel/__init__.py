@@ -1,7 +1,10 @@
-"""Parallelism layer: mesh/sharding helpers + multi-host init/collectives."""
+"""Parallelism layer: mesh/sharding helpers + multi-host init/collectives
++ the ring (SP) and GPipe (PP) schedules."""
 
 from dmlc_core_tpu.parallel.distributed import (allreduce, broadcast,
                                                 init_from_env, rank,
                                                 world_size)
+from dmlc_core_tpu.parallel.pipeline_parallel import pipeline_apply
 
-__all__ = ["allreduce", "broadcast", "init_from_env", "rank", "world_size"]
+__all__ = ["allreduce", "broadcast", "init_from_env", "rank", "world_size",
+           "pipeline_apply"]

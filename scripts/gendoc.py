@@ -44,10 +44,13 @@ MODULE_GROUPS = [
         "dmlc_core_tpu.ops.ranking",
         "dmlc_core_tpu.ops.pallas_kernels",
         "dmlc_core_tpu.models.linear",
+        "dmlc_core_tpu.models.fm",
         "dmlc_core_tpu.models.transformer",
+        "dmlc_core_tpu.models.tp_transformer",
     ]),
     ("Parallelism & communication", [
         "dmlc_core_tpu.parallel.ring",
+        "dmlc_core_tpu.parallel.pipeline_parallel",
         "dmlc_core_tpu.parallel.distributed",
     ]),
     ("Distributed launch", [
@@ -121,7 +124,14 @@ def render_module(modname: str) -> str:
                 warn(f"{modname}.{name}: class has no docstring")
             else:
                 out += [first_paragraph(obj.__doc__), ""]
-            for mname, meth in sorted(vars(obj).items()):
+            # walk the MRO so inherited public API (e.g. the shared
+            # DataParallelModel.step harness) documents on every learner;
+            # only project-defined bases contribute (never object/etc.)
+            members = {}
+            for klass in reversed(obj.__mro__):
+                if klass.__module__.startswith("dmlc_core_tpu"):
+                    members.update(vars(klass))
+            for mname, meth in sorted(members.items()):
                 if mname.startswith("_"):
                     continue
                 # unwrap BEFORE the callable test: classmethod objects are
