@@ -240,6 +240,8 @@ def gen_index() -> str:
         "| [api.md](api.md) | generated Python API reference |",
         "| [parameters.md](parameters.md) | parameter system + native "
         "data-format registry |",
+        "| [parallelism.md](parallelism.md) | the five sharding "
+        "strategies (DP/SP/TP/EP/PP) and their oracles |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
         "",
