@@ -15,7 +15,7 @@ the dense MXU path when F is small (ops/sparse.csr_to_dense).
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,8 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.models._dp import DataParallelModel
 from dmlc_core_tpu.ops.sparse import csr_matvec
-from dmlc_core_tpu.tpu.device_iter import (DenseBatch, PaddedBatch,
-                                           unpack_tree)
+from dmlc_core_tpu.tpu.device_iter import unpack_tree
 
 __all__ = ["LinearParams", "LinearLearner"]
 

@@ -21,7 +21,6 @@ trace time), bfloat16-friendly: matmuls hit the MXU, masks/softmax fuse.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, NamedTuple
 
 import jax

@@ -14,7 +14,6 @@ shard, and segment ids never cross shards by construction
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,7 @@ scripts/test_script.sh:60-65).
 from __future__ import annotations
 
 import struct
-from typing import Any, BinaryIO, Callable, Dict, List, Tuple
+from typing import Any, BinaryIO, Dict, List
 
 import numpy as np
 

@@ -40,12 +40,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from dmlc_core_tpu.base import DMLCError, log_info
+from dmlc_core_tpu.base import DMLCError
 from dmlc_core_tpu.io.native import (NativeBatcher, NativeCsrRecBatcher,
                                      NativeDenseRecBatcher, NativeParser,
                                      _bf16_dtype)
-from dmlc_core_tpu.tpu.sharding import (batch_sharding, data_mesh,
-                                        packed_batch_sharding)
+from dmlc_core_tpu.tpu.sharding import (batch_sharding, packed_batch_sharding)
 
 
 def _dense_dtype_of(d) -> np.dtype:

@@ -11,9 +11,8 @@ join a legacy Rabit rendezvous without the C++ library.
 from __future__ import annotations
 
 import socket
-import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List
 
 from dmlc_core_tpu.tracker.wire import MAGIC, WireSocket
 

@@ -9,7 +9,6 @@ Usage::
 from __future__ import annotations
 
 import logging
-import sys
 from typing import List, Optional
 
 from dmlc_core_tpu.tracker.launchers import BACKENDS

@@ -30,7 +30,7 @@ import logging
 import subprocess
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")

@@ -1,7 +1,6 @@
 """Device bridge tests: padding/bucketing invariants, sharding, double-buffer
 semantics, and end-to-end learning on a virtual 8-device mesh."""
 
-import os
 import random
 
 import numpy as np
@@ -11,8 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from dmlc_core_tpu.tpu.device_iter import DeviceRowBlockIter, HostBatcher
-from dmlc_core_tpu.tpu.sharding import (batch_sharding, data_mesh,
-                                        process_part)
+from dmlc_core_tpu.tpu.sharding import data_mesh, process_part
 from dmlc_core_tpu.io.native import NativeParser
 from dmlc_core_tpu.models.linear import LinearLearner
 from dmlc_core_tpu.ops.sparse import csr_matvec, csr_to_dense

@@ -6,7 +6,6 @@ unittest_inputsplit.cc:116-145): instantiating the same URI with every
 set, for any file layout.
 """
 
-import os
 import random
 
 import pytest

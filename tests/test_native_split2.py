@@ -3,7 +3,6 @@ shuffle, disk row-block cache — mirrors reference indexed_recordio_split /
 cached_input_split / input_split_shuffle / disk_row_iter behavior."""
 
 import os
-import struct
 
 import pytest
 

@@ -9,7 +9,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_core_tpu.parallel.pipeline_parallel import pipeline_apply
 

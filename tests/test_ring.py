@@ -13,8 +13,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_core_tpu.ops.attention import blockwise_attention, mha_reference
 from dmlc_core_tpu.parallel.ring import (ring_allreduce, ring_attention,

@@ -46,7 +46,6 @@ def test_signed_read():
 def test_bad_signature_rejected(monkeypatch):
     # a wrong secret must produce a 403 from the verifying mock
     put("k", b"data")
-    import dmlc_core_tpu.io.native as native
     # the C++ singleton caches FromEnv at first use; use a tampered payload
     # instead: corrupt the object and check integrity via size mismatch is
     # not applicable — instead verify the server actually checks signatures
@@ -62,8 +61,6 @@ def test_bad_signature_rejected(monkeypatch):
 
 def test_ranged_read_and_seek():
     put("big.bin", bytes(range(256)) * 64)  # 16 KB
-    from dmlc_core_tpu.io.native import lib
-    import ctypes
     # exercise Seek via the recordio-independent split path below; here use
     # stream read after fresh open (stream always starts at 0)
     with NativeStream("s3://bkt/big.bin", "r") as s:
@@ -163,7 +160,6 @@ def test_sha256_matches_hashlib():
     """The C++ SHA-256 is exercised through SIG4 on every request above;
     this is the direct probe: an object PUT whose payload hash the mock
     verifies with hashlib (payload_hash != UNSIGNED-PAYLOAD on writes)."""
-    import hashlib
     body = os.urandom(70000)  # multi-block, non-aligned length
     with NativeStream("s3://bkt/hash/probe.bin", "w") as s:
         s.write(body)

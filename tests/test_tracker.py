@@ -5,7 +5,6 @@ is tested in-process: N RendezvousClient fake workers connect to a real
 RabitTracker over loopback and the full link-brokering handshake runs.
 """
 
-import socket
 import subprocess
 import sys
 import threading

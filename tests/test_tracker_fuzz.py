@@ -12,7 +12,6 @@ import struct
 import threading
 
 import numpy as np
-import pytest
 
 from dmlc_core_tpu.tracker.client import RendezvousClient
 from dmlc_core_tpu.tracker.rendezvous import RabitTracker

@@ -13,7 +13,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from dmlc_core_tpu.models.transformer import TransformerConfig, TransformerLM
-from dmlc_core_tpu.ops.attention import mha_reference
 
 
 def mesh2d(data, seq):
