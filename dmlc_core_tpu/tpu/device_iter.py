@@ -202,6 +202,25 @@ def _finish_aux(aux, nrows) -> None:
     aux[-1, :, 0] = nrows
 
 
+def _pack_aux(label, weight, qid, nrows, D: int, R: int, emit_qid: bool,
+              aux=None):
+    """Assemble an aux pack from already-built flat row arrays (the
+    python-batcher path; the native batchers fill their aux views
+    in-place instead). Reuses `aux` when its shape fits. Returns
+    (aux, label_view, weight_view, qid_view) with views shaped [D, R]."""
+    K = 4 if emit_qid else 3
+    if aux is None or aux.shape != (K, D, R):
+        aux = np.empty((K, D, R), np.int32)
+    _, label_v, weight_v, qid_v = _view_aux(aux)
+    label_v[:] = label
+    weight_v[:] = weight
+    if qid_v is not None:
+        qid_v[:] = qid
+    _finish_aux(aux, nrows)
+    return (aux, label_v.reshape(D, R), weight_v.reshape(D, R),
+            None if qid_v is None else qid_v.reshape(D, R))
+
+
 def _unpack(tree: Dict[str, Any], nrows_of) -> Dict[str, Any]:
     """Shared aux/big plane decoding; `nrows_of` extracts the nrows vector
     from the last aux plane (the only shape that differs between the
@@ -315,6 +334,26 @@ class HostBatcher:
         # for jitted consumers; same contract as NativeHostBatcher)
         self._emit_qid: Optional[bool] = None
         self._emit_field: Optional[bool] = None
+        # recycled big/aux packs (see _HostBufferPool contract)
+        self._pool = _HostBufferPool()
+
+    def recycle(self, batch) -> None:
+        """Return a consumed host batch's packed buffers for reuse (same
+        contract as NativeHostBatcher.recycle: only after the host->device
+        copy has finished and only when device arrays cannot alias host
+        memory). Every plane is fully rewritten on reuse, so dirty packs
+        are safe."""
+        if not isinstance(getattr(batch, "aux", None), np.ndarray):
+            return
+        if isinstance(batch, DenseBatch):
+            if batch.x.dtype != self.dense_dtype:
+                return
+            self._pool.put(("dense", batch.x.shape[-1]),
+                           (batch.x.reshape(self.batch_rows, -1),
+                            batch.aux))
+        else:
+            self._pool.put(("csr", batch.big.shape[-1]),
+                           (batch.big, batch.aux))
 
     def _block_to_parts(self, b) -> tuple:
         lens = np.diff(b.offset).astype(np.int32)
@@ -449,11 +488,25 @@ class HostBatcher:
         bucket = _next_pow2(int(shard_nnz.max()) if take else 1,
                             self.min_nnz_bucket)
 
-        row = np.full((D, bucket), R, dtype=np.int32)  # R = padding segment
-        colp = np.zeros((D, bucket), dtype=np.int32)
-        valp = np.zeros((D, bucket), dtype=np.float32)
-        fldp = (np.zeros((D, bucket), dtype=np.int32)
-                if self._emit_field else None)
+        # assemble straight into the packed two-leaf layout (the same
+        # big/aux contract the native batchers emit, so index64 batches
+        # also cross host->HBM in two transfers); pooled packs are fully
+        # rewritten below, so reuse needs no clearing beyond the fills
+        Kb = 4 if self._emit_field else 3
+        big = aux_buf = None
+        pooled = self._pool.pop(("csr", bucket))
+        if pooled is not None:
+            big, aux_buf = pooled
+            if big.shape[0] != Kb:
+                big = None
+        if big is None:
+            big = np.empty((Kb, D, bucket), np.int32)
+        row, colp, valp, fldp = _view_big(big)
+        row[:] = R  # R = padding segment
+        colp[:] = 0
+        valp[:] = 0.0
+        if fldp is not None:
+            fldp[:] = 0
         for d in range(D):
             lo, hi = shard_starts[d], shard_starts[d + 1]
             n = hi - lo
@@ -465,12 +518,13 @@ class HostBatcher:
 
         nrows = np.minimum(
             np.maximum(take - np.arange(D) * R, 0), R).astype(np.int32)
+        aux, label_v, weight_v, qid_v = _pack_aux(
+            label, weight, qid, nrows, D, R, self._emit_qid, aux=aux_buf)
         return PaddedBatch(
             row=row, col=colp, val=valp,
-            label=label.reshape(D, R), weight=weight.reshape(D, R),
+            label=label_v, weight=weight_v,
             nrows=nrows, total_rows=int(take),
-            qid=qid.reshape(D, R) if self._emit_qid else None,
-            field=fldp)
+            qid=qid_v, field=fldp, big=big, aux=aux)
 
     def _emit_dense(self, take, label, weight, lens, col, val, qid):
         D = self.num_shards
@@ -483,16 +537,24 @@ class HostBatcher:
             raise DMLCError(
                 f"dense layout fixed at {F} features but saw index {mx - 1}; "
                 f"pass layout='csr' or a larger dense_max_features")
-        x = np.zeros((self.batch_rows, F), dtype=self.dense_dtype)
+        x = aux_buf = None
+        pooled = self._pool.pop(("dense", F))
+        if pooled is not None:
+            x, aux_buf = pooled
+            x.fill(0)  # the scatter below only touches present entries
+        if x is None:
+            x = np.zeros((self.batch_rows, F), dtype=self.dense_dtype)
         row_of = np.repeat(np.arange(self.batch_rows, dtype=np.int64), lens)
         x[row_of, col] = val
         nrows = np.minimum(
             np.maximum(take - np.arange(D) * R, 0), R).astype(np.int32)
+        aux, label_v, weight_v, qid_v = _pack_aux(
+            label, weight, qid, nrows, D, R, self._emit_qid, aux=aux_buf)
         return DenseBatch(
             x=x.reshape(D, R, F),
-            label=label.reshape(D, R), weight=weight.reshape(D, R),
+            label=label_v, weight=weight_v,
             nrows=nrows, total_rows=int(take),
-            qid=qid.reshape(D, R) if self._emit_qid else None)
+            qid=qid_v, aux=aux)
 
     def reset(self) -> None:
         """Restart batching from the first row (new epoch)."""
