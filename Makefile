@@ -34,6 +34,11 @@ pytest:
 
 dryrun:
 	python3 -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	JAX_PLATFORMS=cpu python3 -c "import jax; \
+	  jax.config.update('jax_platforms', 'cpu'); \
+	  import __graft_entry__ as g; fn, args = g.entry(); \
+	  jax.jit(fn).lower(*args).compile(); \
+	  print('entry() compile-check OK')"
 
 bench-smoke:
 	python3 bench.py --smoke
