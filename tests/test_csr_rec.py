@@ -196,3 +196,34 @@ def test_crec_mutations_never_crash(tmp_path):
         except DMLCError:
             outcomes["error"] += 1
     assert outcomes["ok"] > 0 and outcomes["error"] > 0, outcomes
+
+
+def test_crec_cachefile_replays(tmp_path):
+    """`#cachefile` composes with the crec lane (the split-level chunk
+    cache, reference cached_input_split.h): epoch 2+ replays the local
+    cache and batches stay identical."""
+    src = write_libsvm(tmp_path / "cc.libsvm", rows=400)
+    crec = str(tmp_path / "cc.crec")
+    rows_to_csr_recordio(src, crec, rows_per_record=64)
+    cache = str(tmp_path / "chunks.cache")
+    b = CsrRecHostBatcher(crec + "#" + cache, batch_rows=128)
+    try:
+        first, second = [], []
+        while True:
+            batch = b.next_batch()
+            if batch is None:
+                break
+            first.append(np.asarray(batch.big).copy())
+        b.reset()  # replays from the cache file now
+        import os
+        assert os.path.exists(cache)
+        while True:
+            batch = b.next_batch()
+            if batch is None:
+                break
+            second.append(np.asarray(batch.big).copy())
+    finally:
+        b.close()
+    assert len(first) == len(second) == 4
+    for a, c in zip(first, second):
+        assert np.array_equal(a, c)
