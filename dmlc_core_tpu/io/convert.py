@@ -311,6 +311,53 @@ def rows_to_recordio(src_uri: str, dst_uri: str, fmt: str = "auto",
     return total
 
 
+def _main(argv=None) -> int:
+    """CLI: `python -m dmlc_core_tpu.io.convert SRC DST` — the output
+    lane is chosen by DST's suffix (.rec / .crec / .drec), mirroring the
+    readers' suffix auto-detection. `--index` additionally builds the
+    .idx file that unlocks ?index=1&shuffle=1 on .rec outputs."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Convert text datasets (libsvm/csv/libfm) to the "
+                    "binary ingest lanes")
+    ap.add_argument("src", help="source URI (any supported filesystem)")
+    ap.add_argument("dst", help="destination: *.rec (CSR row blocks), "
+                                "*.crec (CSR device planes), *.drec "
+                                "(dense matrices)")
+    ap.add_argument("--format", default="auto",
+                    help="source format (auto/libsvm/csv/libfm; "
+                         "?format= URI sugar also works)")
+    ap.add_argument("--rows-per-record", type=int, default=4096)
+    ap.add_argument("--dtype", default="bf16",
+                    help="dense (.drec) element dtype: bf16 or float32")
+    ap.add_argument("--part", type=int, default=0)
+    ap.add_argument("--npart", type=int, default=1)
+    ap.add_argument("--index", action="store_true",
+                    help="also write DST.idx (rec outputs only)")
+    args = ap.parse_args(argv)
+    if args.index and not args.dst.endswith(".rec"):
+        # usage errors must surface BEFORE a possibly hours-long write
+        raise DMLCError("--index applies to .rec outputs only")
+    common = dict(fmt=args.format, rows_per_record=args.rows_per_record,
+                  part=args.part, npart=args.npart)
+    if args.dst.endswith(".crec"):
+        n = rows_to_csr_recordio(args.src, args.dst, **common)
+    elif args.dst.endswith(".drec"):
+        n = rows_to_dense_recordio(args.src, args.dst, dtype=args.dtype,
+                                   **common)
+    elif args.dst.endswith(".rec"):
+        n = rows_to_recordio(args.src, args.dst, **common)
+    else:
+        raise DMLCError(
+            f"cannot infer the output lane from {args.dst!r}: use a "
+            f".rec, .crec, or .drec suffix")
+    print(f"wrote {n} rows to {args.dst}")
+    if args.index:
+        nrec = build_recordio_index(args.dst)
+        print(f"indexed {nrec} records -> {args.dst}.idx")
+    return 0
+
+
 def build_recordio_index(uri: str, index_uri: str = None) -> int:
     """Write the `id offset` text index for a RecordIO file — the
     indexed_recordio contract (reference indexed_recordio_split.h) that
@@ -371,3 +418,9 @@ def build_recordio_index(uri: str, index_uri: str = None) -> int:
     with NativeStream(index_uri, "w") as s:
         s.write("".join(f"{i} {o}\n" for i, o in entries).encode())
     return rec_id
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    _sys.exit(_main())

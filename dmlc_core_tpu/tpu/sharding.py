@@ -17,6 +17,7 @@ Conventions:
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -58,11 +59,47 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def process_part(num_parts_per_process: int = 1) -> Tuple[int, int]:
     """(part_index, num_parts) for this host's InputSplit.
 
-    The multi-host composition: every process opens the same URI with its own
-    part of `process_count` parts — the exact-cover property of ByteSplit
-    guarantees global coverage (the contract reference workers rely on,
-    SURVEY §3.2)."""
-    return jax.process_index(), max(jax.process_count(), 1)
+    The multi-host composition: every process opens the same URI with its
+    own part of `process_count` parts — the exact-cover property of
+    ByteSplit guarantees global coverage (the contract reference workers
+    rely on, SURVEY §3.2).
+
+    Launch regimes resolve the part in order (SURVEY §2.4 env protocol):
+    - ``cluster=tpu-pod`` (or any `jax.distributed` job): the JAX process
+      id/count — collectives and data sharding agree by construction.
+    - task-id launchers (local/sge/kubernetes/yarn): the launcher's
+      ``DMLC_TASK_ID`` / ``DMLC_NUM_WORKER`` assignment (the reference
+      contract: InputSplit::Create(uri, rank, nworker)). Server/scheduler
+      roles read the whole stream by convention (their task ids sit past
+      the worker range).
+    - mpi / slurm: the runtime's native rank vars
+      (OMPI_COMM_WORLD_RANK / PMI_RANK / SLURM_PROCID).
+    - otherwise (ssh/mesos workers, whose rank is assigned dynamically at
+      rendezvous): (0, 1) — pass part/npart explicitly from the
+      rendezvous rank for those clusters.
+    Without the fallbacks every single-process worker would silently
+    train on the FULL dataset.
+    """
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
+    if os.environ.get("DMLC_ROLE", "worker") != "worker":
+        return 0, 1  # servers/schedulers are not data consumers
+    for rank_var, count_var in (
+            ("DMLC_TASK_ID", "DMLC_NUM_WORKER"),
+            ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+            ("PMI_RANK", "PMI_SIZE"),
+            ("SLURM_PROCID", "SLURM_NTASKS")):
+        rank = os.environ.get(rank_var)
+        count = os.environ.get(count_var)
+        if rank is None or count is None or int(count) <= 1:
+            continue
+        part, npart = int(rank), int(count)
+        if not 0 <= part < npart:
+            raise ValueError(
+                f"{rank_var}={part} out of range for "
+                f"{count_var}={npart}")
+        return part, npart
+    return 0, 1
 
 
 def local_device_count(mesh: Optional[Mesh] = None) -> int:

@@ -5,9 +5,12 @@ is tested in-process: N RendezvousClient fake workers connect to a real
 RabitTracker over loopback and the full link-brokering handshake runs.
 """
 
+import os
 import subprocess
 import sys
 import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 import pytest
 
@@ -383,3 +386,49 @@ def test_mesos_requires_master(monkeypatch):
     args = get_opts(["--cluster=mesos", "--num-workers=1", "--", "./t"])
     with pytest.raises(SystemExit):
         build_mesos_command(args, "worker", 1, {})
+
+
+def test_local_cluster_workers_cover_dataset_exactly(tmp_path):
+    """System-level DP contract under the rabit-style local launcher:
+    each worker resolves its part from DMLC_TASK_ID/DMLC_NUM_WORKER
+    (process_part fallback — without it every worker reads the FULL
+    dataset) and the union of parts covers the file exactly once."""
+    import numpy as np
+    data = tmp_path / "cover.libsvm"
+    rng = np.random.default_rng(11)
+    with open(data, "w") as f:
+        for i in range(907):
+            f.write(f"{i % 2} " + " ".join(
+                f"{j}:{rng.uniform():.4f}" for j in range(4)) + "\n")
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import os, sys
+sys.path.insert(0, {str(REPO)!r})
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from dmlc_core_tpu.tpu.sharding import process_part
+from dmlc_core_tpu.io.native import NativeParser
+from dmlc_core_tpu.tracker.client import RendezvousClient
+c = RendezvousClient(os.environ['DMLC_TRACKER_URI'],
+                     int(os.environ['DMLC_TRACKER_PORT']))
+a = c.start()  # rendezvous check-in (the rabit worker contract)
+part, npart = process_part()  # data part from DMLC_TASK_ID/NUM_WORKER
+with NativeParser({str(data)!r}, part=part, npart=npart) as p:
+    n = sum(b.num_rows for b in p)
+open({str(tmp_path)!r} + f'/rows{{part}}of{{npart}}.txt', 'w').write(str(n))
+c.shutdown(a.rank)
+""")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+         "--cluster=local", "--num-workers=3", "--host-ip=127.0.0.1",
+         "--", sys.executable, str(worker)],
+        cwd=str(REPO), capture_output=True, timeout=120, text=True,
+        env=dict(os.environ, PYTHONPATH=str(REPO)))
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    counts = []
+    for part in range(3):
+        f = tmp_path / f"rows{part}of3.txt"
+        assert f.exists(), (part, proc.stderr[-800:])
+        counts.append(int(f.read_text()))
+    assert sum(counts) == 907 and all(c > 0 for c in counts), counts
