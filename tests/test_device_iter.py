@@ -386,3 +386,34 @@ def test_index64_path_emits_packed_batches(tmp_path):
     assert np.array_equal(
         np.asarray(hb.label),
         np.asarray(hb.aux[0]).view(np.float32))
+
+
+def test_linear_predict_matches_oracle_and_caches(tmp_path):
+    """predict() margins match a numpy oracle on both layouts, for packed
+    device batches, and the jitted forward is cached across calls."""
+    p = write_libsvm(tmp_path / "pr.libsvm", rows=256, features=5)
+    want_rows = []
+    for line in p.read_text().splitlines():
+        parts = line.split()
+        want_rows.append({int(k): float(v) for k, v in
+                          (t.split(":") for t in parts[1:])})
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=5).astype(np.float32)
+    b0 = 0.25
+    want = np.array([sum(w[c] * v for c, v in r.items()) + b0
+                     for r in want_rows], np.float32)
+    from dmlc_core_tpu.models.linear import LinearParams
+    params = LinearParams(w=jnp.asarray(w), b=jnp.asarray(b0))
+    learner = LinearLearner(5, mesh=None)
+    for layout in ("csr", "dense"):
+        with DeviceRowBlockIter(str(p), batch_rows=256, layout=layout,
+                                min_nnz_bucket=512,
+                                dense_dtype="float32") as it:
+            batch = next(iter(it))
+            got = np.asarray(learner.predict(params, batch)).reshape(-1)
+            np.testing.assert_allclose(got[:256], want, rtol=2e-5,
+                                       atol=2e-5)
+            # second call hits the cached jitted forward
+            fn_before = dict(learner._fwd_fn)
+            learner.predict(params, batch)
+            assert dict(learner._fwd_fn) == fn_before
