@@ -74,3 +74,29 @@ def test_example_trains_fm_on_libfm(tmp_path):
                 "--fm-rank", "4", "--epochs", "2", "--batch-rows", "128"],
                cwd=str(tmp_path))
     assert out.count("mean loss") == 2
+
+
+def test_example_trains_on_crec_with_checkpoint(tmp_path):
+    """The README quick-start journey: convert text once to CSR device
+    planes, then train + checkpoint + resume over the .crec."""
+    from dmlc_core_tpu.io.convert import rows_to_csr_recordio
+    rng = np.random.default_rng(7)
+    src = tmp_path / "j.libsvm"
+    with open(src, "w") as f:
+        for i in range(900):
+            x0 = rng.uniform(-1, 1)
+            feats = " ".join([f"0:{x0:.4f}"] + [
+                f"{j}:{rng.uniform(-1, 1):.4f}" for j in range(1, 5)])
+            f.write(f"{1 if x0 > 0 else 0} {feats}\n")
+    crec = tmp_path / "j.crec"
+    assert rows_to_csr_recordio(str(src), str(crec)) == 900
+    ckpt = str(tmp_path / "c.bin")
+    out = _run([str(crec), "--epochs", "2", "--batch-rows", "256",
+                "--num-features", "5", "--checkpoint", ckpt],
+               cwd=str(tmp_path))
+    assert out.count("mean loss") == 2
+    out2 = _run([str(crec), "--epochs", "3", "--batch-rows", "256",
+                 "--num-features", "5", "--resume", ckpt],
+                cwd=str(tmp_path))
+    lines = [ln for ln in out2.splitlines() if "mean loss" in ln]
+    assert len(lines) == 1 and lines[0].startswith("epoch 2:"), out2
