@@ -457,19 +457,52 @@ def main() -> None:
         import subprocess
         probe_timeout = float(os.environ.get("DCT_DEVICE_PROBE_TIMEOUT",
                                              "240"))
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 # same site-config workaround as the top of this file:
-                 # the env var must be applied through jax.config
-                 "import os, jax;\n"
-                 "p = os.environ.get('JAX_PLATFORMS');\n"
-                 "p and jax.config.update('jax_platforms', p);\n"
-                 "print(jax.devices()[0].platform)"],
-                capture_output=True, text=True, timeout=probe_timeout)
-            device_ok = probe.returncode == 0
-        except subprocess.TimeoutExpired:
-            device_ok = False
+        # The tunnel flaps minute-to-minute: one unlucky probe must not
+        # forfeit a whole round's device evidence. Retry with backoff,
+        # bounded BOTH by attempt count and by a hard elapsed-time window
+        # (default 900s total, probes + sleeps included) before degrading
+        # to host-only metrics. Any failure is presumed transient (tunnel
+        # outages surface many ways: init errors, connect refusals, hangs)
+        # except known-permanent signatures like a missing jax.
+        # smoke/CI runs keep the old fail-fast behavior (one attempt);
+        # full runs get the retry window unless env-overridden
+        probe_retries = max(1, int(os.environ.get(
+            "DCT_DEVICE_PROBE_RETRIES", "1" if args.smoke else "6")))
+        probe_window = float(os.environ.get(
+            "DCT_DEVICE_PROBE_WINDOW", "60" if args.smoke else "900"))
+        deadline = time.time() + probe_window
+        device_ok = False
+        for attempt in range(probe_retries):
+            transient = True
+            try:
+                probe = subprocess.run(
+                    [sys.executable, "-c",
+                     # same site-config workaround as the top of this file:
+                     # the env var must be applied through jax.config
+                     "import os, jax;\n"
+                     "p = os.environ.get('JAX_PLATFORMS');\n"
+                     "p and jax.config.update('jax_platforms', p);\n"
+                     "print(jax.devices()[0].platform)"],
+                    capture_output=True, text=True,
+                    timeout=min(probe_timeout,
+                                max(deadline - time.time(), 10.0)))
+                device_ok = probe.returncode == 0
+                transient = not any(s in (probe.stderr or "") for s in (
+                    "ModuleNotFoundError", "ImportError", "SyntaxError"))
+            except subprocess.TimeoutExpired:
+                device_ok = False
+            if device_ok or not transient or time.time() >= deadline:
+                break
+            if attempt < probe_retries - 1:
+                backoff = min(30 * (2 ** attempt), 300,
+                              max(deadline - time.time(), 0))
+                # don't sleep into a window too small to fund a real probe
+                if backoff <= 0 or (deadline - time.time() - backoff) < 30:
+                    break
+                print(f"# device probe attempt {attempt + 1}/"
+                      f"{probe_retries} failed; retrying in {backoff:.0f}s",
+                      file=sys.stderr)
+                time.sleep(backoff)
         if not device_ok:
             print("# device backend unavailable (probe timed out/failed);"
                   " reporting host parse-only metrics", file=sys.stderr)
@@ -591,22 +624,24 @@ def main() -> None:
                       f"(best {ce['hbm_ingest_bw_util_best']:.1%})",
                       file=sys.stderr)
 
-        # the remaining BASELINE.md target rows: csv-with-prefetch MB/s,
-        # libfm rows/s, and the RecordIO write+read round-trip (host
-        # probes — no device stage, so in-process)
-        if args.format == "libsvm":
-            extras["csv_lane"] = text_lane_probe(
-                ensure_csv_dataset(rows), rows, args.threads, "csv",
-                "?format=csv&label_column=0")
-            extras["libfm_lane"] = text_lane_probe(
-                ensure_libfm_dataset(rows), rows, args.threads, "libfm")
-            extras["recordio_roundtrip"] = recordio_roundtrip_probe(
-                records=20000 if args.smoke else 200000)
-            print(f"# csv {extras['csv_lane']['mb_per_sec']} MB/s, "
-                  f"libfm {extras['libfm_lane']['rows_per_sec']:.0f} "
-                  f"rows/s, recordio rt "
-                  f"{extras['recordio_roundtrip']['records_per_sec']:.0f} "
-                  f"rec/s", file=sys.stderr)
+    # the remaining BASELINE.md target rows: csv-with-prefetch MB/s,
+    # libfm rows/s, and the RecordIO write+read round-trip. These are pure
+    # HOST probes (no device stage) so they run UNCONDITIONALLY — including
+    # on a degraded parse-only run when the tunnel is down (the r04 round
+    # lost them by nesting them in the device branch).
+    if args.format == "libsvm":
+        extras["csv_lane"] = text_lane_probe(
+            ensure_csv_dataset(rows), rows, args.threads, "csv",
+            "?format=csv&label_column=0")
+        extras["libfm_lane"] = text_lane_probe(
+            ensure_libfm_dataset(rows), rows, args.threads, "libfm")
+        extras["recordio_roundtrip"] = recordio_roundtrip_probe(
+            records=20000 if args.smoke else 200000)
+        print(f"# csv {extras['csv_lane']['mb_per_sec']} MB/s, "
+              f"libfm {extras['libfm_lane']['rows_per_sec']:.0f} "
+              f"rows/s, recordio rt "
+              f"{extras['recordio_roundtrip']['records_per_sec']:.0f} "
+              f"rec/s", file=sys.stderr)
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")
