@@ -107,19 +107,25 @@ namespace {
 struct Target {
   std::string host;
   int port;
+  std::string scheme;
 };
 
 Target ResolveTarget(const AzureConfig& cfg) {
-  // The built-in client speaks plain HTTP only. Real Azure accounts enforce
-  // secure transfer and would reject (or worse, silently downgrade) port-80
-  // traffic, so refuse to guess a public endpoint: require AZURE_ENDPOINT to
-  // name an emulator/TLS-terminating gateway explicitly.
-  DCT_CHECK(!cfg.endpoint_host.empty())
-      << "AZURE_ENDPOINT is not set; the built-in azure client is http-only "
-      << "and will not talk to " << cfg.account
-      << ".blob.core.windows.net directly. Point AZURE_ENDPOINT at an "
-      << "Azurite emulator or an https-terminating local gateway.";
-  return {cfg.endpoint_host, cfg.endpoint_port};
+  if (cfg.endpoint_host.empty()) {
+    // real Azure enforces secure transfer: default to the public https
+    // endpoint, reached through the TLS helper (ResolveHttpRoute raises a
+    // guidance error when DCT_TLS_PROXY is unset)
+    DCT_CHECK(!cfg.account.empty())
+        << "AZURE_STORAGE_ACCOUNT is not set and AZURE_ENDPOINT names no "
+        << "emulator/gateway";
+    return {cfg.account + ".blob.core.windows.net", 443, "https"};
+  }
+  return {cfg.endpoint_host, cfg.endpoint_port, cfg.scheme};
+}
+
+// Socket route for a resolved target (via the TLS helper for https).
+HttpRoute RouteOf(const Target& t) {
+  return ResolveHttpRoute(t.scheme, t.host, t.port);
 }
 
 // azure://container/blob-path -> ("/container", "/blob/path")
@@ -168,7 +174,7 @@ class AzureReadStream : public RetryingHttpReadStream {
     std::map<std::string, std::string> extra = {
         {"Range", "bytes=" + std::to_string(pos_) + "-"}};
     auto headers = SignedHeaders(cfg_, "GET", resource, {}, 0, extra);
-    conn_.reset(new HttpConnection(target_.host, target_.port));
+    conn_.reset(new HttpConnection(RouteOf(target_)));
     conn_->SendRequest("GET", s3::UriEncode(resource, true), headers, "");
     HttpResponse head;
     conn_->ReadResponseHead(&head);
@@ -230,7 +236,7 @@ class AzureWriteStream : public Stream {
           SignedHeaders(cfg_, "PUT", resource, {}, buffer_.size(),
                         {{"x-ms-blob-type", "BlockBlob"}});
       HttpResponse resp =
-          HttpRequest(target_.host, target_.port, "PUT",
+          HttpRequest(RouteOf(target_), "PUT",
                       s3::UriEncode(resource, true), headers, buffer_);
       DCT_CHECK(resp.status == 201)
           << "azure Put Blob failed: " << resp.status << " " << resp.body;
@@ -245,7 +251,7 @@ class AzureWriteStream : public Stream {
     std::map<std::string, std::string> q = {{"comp", "blocklist"}};
     auto headers = SignedHeaders(cfg_, "PUT", resource, q, body.size());
     HttpResponse resp = HttpRequest(
-        target_.host, target_.port, "PUT",
+        RouteOf(target_), "PUT",
         s3::UriEncode(resource, true) + QueryString(q), headers, body);
     DCT_CHECK(resp.status == 201)
         << "azure Put Block List failed: " << resp.status << " " << resp.body;
@@ -269,7 +275,7 @@ class AzureWriteStream : public Stream {
                                             {"comp", "block"}};
     auto headers = SignedHeaders(cfg_, "PUT", resource, q, part.size());
     HttpResponse resp = HttpRequest(
-        target_.host, target_.port, "PUT",
+        RouteOf(target_), "PUT",
         s3::UriEncode(resource, true) + QueryString(q), headers, part);
     DCT_CHECK(resp.status == 201)
         << "azure Put Block failed: " << resp.status << " " << resp.body;
@@ -298,12 +304,9 @@ AzureConfig AzureConfig::FromEnv() {
   const char* endpoint = std::getenv("AZURE_ENDPOINT");
   if (endpoint != nullptr && *endpoint != '\0') {
     std::string s = endpoint;
-    size_t scheme = s.find("://");
-    if (scheme != std::string::npos) {
-      DCT_CHECK(s.compare(0, scheme, "http") == 0)
-          << "built-in azure client supports http endpoints only, got " << s;
-      s = s.substr(scheme + 3);
-    }
+    std::string sch = StripUrlScheme(&s);
+    if (!sch.empty()) cfg.scheme = sch;
+    if (cfg.scheme == "https") cfg.endpoint_port = 443;
     SplitHostPort(s, &cfg.endpoint_host, &cfg.endpoint_port,
                   cfg.endpoint_port);
   }
@@ -337,7 +340,7 @@ void AzureFileSystem::ListDirectory(const URI& path,
     std::string resource = "/" + container;
     auto headers = azure::SignedHeaders(config_, "GET", resource, q, 0);
     HttpResponse resp = HttpRequest(
-        t.host, t.port, "GET",
+        azure::RouteOf(t), "GET",
         s3::UriEncode(resource, true) + azure::QueryString(q), headers, "");
     DCT_CHECK(resp.status == 200)
         << "azure List Blobs failed: " << resp.status << " " << resp.body;
@@ -392,7 +395,7 @@ FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
                                             {"restype", "container"}};
     auto headers = azure::SignedHeaders(config_, "GET", resource, q, 0);
     HttpResponse resp = HttpRequest(
-        t.host, t.port, "GET",
+        azure::RouteOf(t), "GET",
         s3::UriEncode(resource, true) + azure::QueryString(q), headers, "");
     DCT_CHECK(resp.status == 200)
         << "azure List Blobs failed: " << resp.status << " " << resp.body;

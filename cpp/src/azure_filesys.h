@@ -26,12 +26,16 @@ struct AzureConfig {
   std::string key_base64;     // SharedKey account key (base64)
   std::string endpoint_host;  // empty => <account>.blob.core.windows.net
   int endpoint_port = 80;
+  // "https" routes through the local TLS helper (DCT_TLS_PROXY, http.h
+  // ResolveHttpRoute). The no-endpoint default is https against the real
+  // <account>.blob.core.windows.net — Azure enforces secure transfer.
+  std::string scheme = "http";
   int max_retry = 50;
   int retry_sleep_ms = 100;
 
   // AZURE_STORAGE_ACCOUNT / AZURE_STORAGE_ACCESS_KEY (reference
-  // azure_filesys.cc:31-39) + AZURE_ENDPOINT ("host[:port]") for
-  // emulators/gateways.
+  // azure_filesys.cc:31-39) + AZURE_ENDPOINT ("host[:port]" or
+  // "http(s)://host[:port]") for emulators/gateways.
   static AzureConfig FromEnv();
 };
 

@@ -18,15 +18,18 @@ namespace webhdfs {
 HttpUrl ParseHttpUrl(const std::string& url) {
   HttpUrl out;
   size_t scheme = url.find("://");
-  DCT_CHECK(scheme != std::string::npos && url.compare(0, scheme, "http") == 0)
-      << "webhdfs redirect must be an http url, got " << url;
+  DCT_CHECK(scheme != std::string::npos) << "not a url: " << url;
+  out.scheme = url.substr(0, scheme);
+  DCT_CHECK(out.scheme == "http" || out.scheme == "https")
+      << "webhdfs redirect must be an http(s) url, got " << url;
   size_t body = scheme + 3;
   size_t slash = url.find('/', body);
   std::string hostport =
       slash == std::string::npos ? url.substr(body)
                                  : url.substr(body, slash - body);
   out.path_query = slash == std::string::npos ? "/" : url.substr(slash);
-  SplitHostPort(hostport, &out.host, &out.port, 80);
+  SplitHostPort(hostport, &out.host, &out.port,
+                out.scheme == "https" ? 443 : 80);
   return out;
 }
 
@@ -35,12 +38,13 @@ namespace {
 struct Target {
   std::string host;
   int port;
+  std::string scheme = "http";
 };
 
 // Resolve namenode from URI host ("hdfs://host:port/...") falling back to
 // the configured default (reference hdfs_filesys GetInstance namenode arg).
 Target ResolveTarget(const WebHdfsConfig& cfg, const URI& uri) {
-  Target t{cfg.namenode_host, cfg.namenode_port};
+  Target t{cfg.namenode_host, cfg.namenode_port, cfg.scheme};
   if (!uri.host.empty()) {
     SplitHostPort(uri.host, &t.host, &t.port, cfg.namenode_port);
   }
@@ -128,10 +132,11 @@ class WebHdfsReadStream : public RetryingHttpReadStream {
         OpPath(cfg_, uri_.path, "OPEN", "offset=" + std::to_string(pos_));
     std::string host = target_.host;
     int port = target_.port;
+    std::string scheme = target_.scheme;
     // follow namenode -> datanode redirects (bounded; gateways may serve
     // the body directly with 200)
     for (int hop = 0; hop < 5; ++hop) {
-      conn_.reset(new HttpConnection(host, port));
+      conn_.reset(new HttpConnection(ResolveHttpRoute(scheme, host, port)));
       conn_->SendRequest("GET", path, AuthHeaders(cfg_), "");
       HttpResponse head;
       conn_->ReadResponseHead(&head);
@@ -144,6 +149,7 @@ class WebHdfsReadStream : public RetryingHttpReadStream {
         webhdfs::HttpUrl next = webhdfs::ParseHttpUrl(it->second);
         host = next.host;
         port = next.port;
+        scheme = next.scheme;
         path = next.path_query;
         continue;
       }
@@ -217,22 +223,24 @@ class WebHdfsWriteStream : public Stream {
     std::string path = OpPath(cfg_, uri_.path, op_extra, extra);
     // step 1: namenode; expect redirect to a datanode (send no body, per
     // the WebHDFS two-step protocol)
-    HttpResponse head = HttpRequest(target_.host, target_.port, method, path,
-                                    AuthHeaders(cfg_), "");
+    HttpResponse head = HttpRequest(
+        ResolveHttpRoute(target_.scheme, target_.host, target_.port), method,
+        path, AuthHeaders(cfg_), "");
     if (head.status == 307 || head.status == 302) {
       auto it = head.headers.find("location");
       DCT_CHECK(it != head.headers.end())
           << "webhdfs redirect without Location header";
       webhdfs::HttpUrl next = webhdfs::ParseHttpUrl(it->second);
-      head = HttpRequest(next.host, next.port, method, next.path_query,
-                         AuthHeaders(cfg_), part);
+      head = HttpRequest(ResolveHttpRoute(next.scheme, next.host, next.port),
+                         method, next.path_query, AuthHeaders(cfg_), part);
     } else if (head.status >= 200 && head.status < 300 && !part.empty()) {
       // One-step gateway (HttpFS style): the empty step-1 request was
       // accepted directly, so the payload was never transmitted. Re-send
       // with the body: CREATE&overwrite=true is idempotent and the empty
       // APPEND appended nothing, so exactly one copy of `part` lands.
-      head = HttpRequest(target_.host, target_.port, method, path,
-                         AuthHeaders(cfg_), part);
+      head = HttpRequest(
+          ResolveHttpRoute(target_.scheme, target_.host, target_.port),
+          method, path, AuthHeaders(cfg_), part);
     }
     CheckStatus(head, created_ ? 200 : 201,
                 created_ ? "APPEND" : "CREATE", uri_);
@@ -256,8 +264,11 @@ WebHdfsConfig WebHdfsConfig::FromEnv() {
   const char* nn = std::getenv("WEBHDFS_NAMENODE");
   if (nn != nullptr && *nn != '\0') {
     std::string s = nn;
-    size_t scheme = s.find("://");
-    if (scheme != std::string::npos) s = s.substr(scheme + 3);
+    std::string sch = StripUrlScheme(&s);
+    if (!sch.empty()) {
+      cfg.scheme = sch;
+      if (sch == "https") cfg.namenode_port = 9871;  // secure REST default
+    }
     SplitHostPort(s, &cfg.namenode_host, &cfg.namenode_port,
                            cfg.namenode_port);
   }
@@ -284,8 +295,8 @@ FileInfo WebHdfsFileSystem::GetPathInfo(const URI& path) {
   const WebHdfsConfig cfg = config_copy();
   webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
   std::string p = webhdfs::OpPath(cfg, path.path, "GETFILESTATUS", "");
-  HttpResponse resp = HttpRequest(t.host, t.port, "GET", p,
-                                  webhdfs::AuthHeaders(cfg), "");
+  HttpResponse resp = HttpRequest(ResolveHttpRoute(t.scheme, t.host, t.port),
+                                  "GET", p, webhdfs::AuthHeaders(cfg), "");
   webhdfs::CheckStatus(resp, 200, "GETFILESTATUS", path);
   FileInfo info;
   info.path = path;
@@ -308,8 +319,8 @@ void WebHdfsFileSystem::ListDirectory(const URI& path,
   const WebHdfsConfig cfg = config_copy();
   webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
   std::string p = webhdfs::OpPath(cfg, path.path, "LISTSTATUS", "");
-  HttpResponse resp = HttpRequest(t.host, t.port, "GET", p,
-                                  webhdfs::AuthHeaders(cfg), "");
+  HttpResponse resp = HttpRequest(ResolveHttpRoute(t.scheme, t.host, t.port),
+                                  "GET", p, webhdfs::AuthHeaders(cfg), "");
   webhdfs::CheckStatus(resp, 200, "LISTSTATUS", path);
   std::string dir = path.path.empty() ? "/" : path.path;
   if (dir.back() != '/') dir += '/';

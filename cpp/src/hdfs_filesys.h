@@ -23,6 +23,10 @@ namespace dct {
 struct WebHdfsConfig {
   std::string namenode_host;  // default namenode when the URI has no host
   int namenode_port = 9870;   // WebHDFS default REST port
+  // "https" (secure WebHDFS / swebhdfs, e.g. WEBHDFS_NAMENODE=
+  // https://nn:9871) routes every request through the local TLS helper
+  // (DCT_TLS_PROXY, http.h ResolveHttpRoute)
+  std::string scheme = "http";
   std::string user;           // appended as user.name= when non-empty
   // Hadoop delegation token: when non-empty every op carries
   // `delegation=<token>` and user.name is omitted (the WebHDFS REST
@@ -90,8 +94,9 @@ class WebHdfsFileSystem : public FileSystem {
 
 namespace webhdfs {
 
-// Parsed "http://host:port/path?query" (datanode redirect Location).
+// Parsed "http(s)://host:port/path?query" (datanode redirect Location).
 struct HttpUrl {
+  std::string scheme;      // "http" or "https"
   std::string host;
   int port = 80;
   std::string path_query;  // path + query, ready for the request line

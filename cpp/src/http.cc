@@ -19,9 +19,8 @@ std::string Lower(std::string s) {
 }
 }  // namespace
 
-HttpConnection::HttpConnection(const std::string& host, int port)
-    : default_host_header_(port == 80 ? host
-                                      : host + ":" + std::to_string(port)) {
+namespace {
+int ConnectSocket(const std::string& host, int port) {
   struct addrinfo hints;
   std::memset(&hints, 0, sizeof(hints));
   hints.ai_family = AF_UNSPEC;
@@ -31,15 +30,30 @@ HttpConnection::HttpConnection(const std::string& host, int port)
   int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
   DCT_CHECK(rc == 0) << "cannot resolve host " << host << ": "
                      << gai_strerror(rc);
+  int fd = -1;
   for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
-    fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-    if (fd_ < 0) continue;
-    if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
-    close(fd_);
-    fd_ = -1;
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
   }
   freeaddrinfo(res);
-  DCT_CHECK(fd_ >= 0) << "cannot connect to " << host << ":" << port;
+  DCT_CHECK(fd >= 0) << "cannot connect to " << host << ":" << port;
+  return fd;
+}
+}  // namespace
+
+HttpConnection::HttpConnection(const std::string& host, int port)
+    : default_host_header_(port == 80 ? host
+                                      : host + ":" + std::to_string(port)) {
+  fd_ = ConnectSocket(host, port);
+}
+
+HttpConnection::HttpConnection(const HttpRoute& route)
+    : default_host_header_(route.host_header),
+      path_prefix_(route.path_prefix) {
+  fd_ = ConnectSocket(route.connect_host, route.connect_port);
 }
 
 HttpConnection::~HttpConnection() {
@@ -50,7 +64,7 @@ void HttpConnection::SendRequest(
     const std::string& method, const std::string& path,
     const std::map<std::string, std::string>& headers,
     const std::string& body) {
-  std::string req = method + " " + path + " HTTP/1.1\r\n";
+  std::string req = method + " " + path_prefix_ + path + " HTTP/1.1\r\n";
   for (const auto& kv : headers) {
     req += kv.first + ": " + kv.second + "\r\n";
   }
@@ -218,11 +232,62 @@ void SplitHostPort(const std::string& s, std::string* host, int* port,
   *port = ParsePortOrDie(s, s.substr(colon + 1));
 }
 
+std::string DefaultHostHeader(const std::string& scheme,
+                              const std::string& host, int port) {
+  bool is_default = scheme == "https" ? port == 443 : port == 80;
+  return is_default ? host : host + ":" + std::to_string(port);
+}
+
+std::string StripUrlScheme(std::string* s) {
+  size_t pos = s->find("://");
+  if (pos == std::string::npos) return "";
+  std::string scheme = s->substr(0, pos);
+  DCT_CHECK(scheme == "http" || scheme == "https")
+      << "endpoint scheme must be http or https, got " << *s;
+  s->erase(0, pos + 3);
+  return scheme;
+}
+
+HttpRoute ResolveHttpRoute(const std::string& scheme, const std::string& host,
+                           int port) {
+  HttpRoute r;
+  r.host_header = DefaultHostHeader(scheme, host, port);
+  if (scheme != "https") {
+    r.connect_host = host;
+    r.connect_port = port;
+    return r;
+  }
+  const char* proxy = std::getenv("DCT_TLS_PROXY");
+  if (proxy == nullptr || *proxy == '\0') {
+    throw Error(
+        "https origin but the built-in client is plain-HTTP and "
+        "DCT_TLS_PROXY is unset. Start the TLS-terminating helper "
+        "(python -m dmlc_core_tpu.io.tls_proxy) and export "
+        "DCT_TLS_PROXY=host:port, or route the object through http:// / "
+        "an S3-compatible endpoint: https://" + r.host_header);
+  }
+  SplitHostPort(proxy, &r.connect_host, &r.connect_port, 3128);
+  r.path_prefix = "https://" + r.host_header;
+  return r;
+}
+
 HttpResponse HttpRequest(const std::string& host, int port,
                          const std::string& method, const std::string& path,
                          const std::map<std::string, std::string>& headers,
                          const std::string& body) {
   HttpConnection conn(host, port);
+  conn.SendRequest(method, path, headers, body);
+  HttpResponse resp;
+  conn.ReadResponseHead(&resp);
+  conn.ReadFullBody(&resp);
+  return resp;
+}
+
+HttpResponse HttpRequest(const HttpRoute& route, const std::string& method,
+                         const std::string& path,
+                         const std::map<std::string, std::string>& headers,
+                         const std::string& body) {
+  HttpConnection conn(route);
   conn.SendRequest(method, path, headers, body);
   HttpResponse resp;
   conn.ReadResponseHead(&resp);

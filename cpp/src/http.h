@@ -4,8 +4,10 @@
 // curl multi + select loops); this environment has no libcurl, so the small
 // subset S3 needs is implemented directly: one request per connection
 // (Connection: close), Content-Length and chunked responses, streaming body
-// reads. Plain http only — TLS is out of scope for the built-in client
-// (S3-compatible stores and the test harness speak http; see s3_filesys.h).
+// reads. The socket client itself is plain http; https origins are reached
+// through the local TLS-terminating helper via HttpRoute (below) — TLS
+// terminates in the helper process (python -m dmlc_core_tpu.io.tls_proxy,
+// stdlib ssl), not in this client.
 #ifndef DCT_HTTP_H_
 #define DCT_HTTP_H_
 
@@ -38,9 +40,44 @@ inline bool RetryableHttpStatus(int status) {
   return status == 408 || status == 429 || status >= 500;
 }
 
+// Where a request for an origin actually connects, and how the request
+// path is phrased. Direct plain-http origins connect straight through with
+// origin-form paths. https origins are reached via the local
+// TLS-terminating helper (python -m dmlc_core_tpu.io.tls_proxy), selected
+// by DCT_TLS_PROXY=host:port: the client connects to the helper and sends
+// ABSOLUTE-form requests ("GET https://origin/path"), the helper opens TLS
+// to the origin and relays — the reference gets the same capability from
+// libcurl+OpenSSL inside its S3 client (s3_filesys.cc curl handles).
+struct HttpRoute {
+  std::string connect_host;
+  int connect_port = 0;
+  std::string path_prefix;  // "" direct; "https://host[:port]" via helper
+  std::string host_header;  // origin Host (survives the helper unchanged)
+};
+
+// Resolve (scheme, host, port) to a route. Throws for https origins when
+// DCT_TLS_PROXY is unset (the built-in socket client is plain-HTTP).
+HttpRoute ResolveHttpRoute(const std::string& scheme, const std::string& host,
+                           int port);
+
+// "host" or "host:port", omitting the scheme's default port. Signing
+// clients (S3 SIG4) MUST build their signed Host with this same formula —
+// it is also what ResolveHttpRoute puts on the wire.
+std::string DefaultHostHeader(const std::string& scheme,
+                              const std::string& host, int port);
+
+// Strip a leading "http://"/"https://" from *s in place; returns the
+// scheme, or "" when *s carries none. Throws on any other scheme. Shared
+// by the endpoint-env parsers (S3_ENDPOINT / AZURE_ENDPOINT /
+// WEBHDFS_NAMENODE).
+std::string StripUrlScheme(std::string* s);
+
 class HttpConnection {
  public:
   HttpConnection(const std::string& host, int port);
+  // Connect along a resolved route (possibly via the TLS helper; requests
+  // then use absolute-form paths and the origin's Host header).
+  explicit HttpConnection(const HttpRoute& route);
   ~HttpConnection();
   HttpConnection(const HttpConnection&) = delete;
   HttpConnection& operator=(const HttpConnection&) = delete;
@@ -63,6 +100,7 @@ class HttpConnection {
 
   int fd_ = -1;
   std::string default_host_header_;  // injected when caller sets no Host
+  std::string path_prefix_;  // absolute-form prefix when routed via helper
   std::string rbuf_;          // buffered unread bytes
   size_t rpos_ = 0;
   int64_t body_remaining_ = -1;  // -1: read-to-close
@@ -74,6 +112,10 @@ class HttpConnection {
 // One-shot request helper.
 HttpResponse HttpRequest(const std::string& host, int port,
                          const std::string& method, const std::string& path,
+                         const std::map<std::string, std::string>& headers,
+                         const std::string& body);
+HttpResponse HttpRequest(const HttpRoute& route, const std::string& method,
+                         const std::string& path,
                          const std::map<std::string, std::string>& headers,
                          const std::string& body);
 

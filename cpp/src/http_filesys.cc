@@ -23,14 +23,14 @@ int EnvInt(const char* key, int dflt) {
 int MaxRetry() { return EnvInt("DCT_HTTP_MAX_RETRY", 50); }
 int RetrySleepMs() { return EnvInt("DCT_HTTP_RETRY_SLEEP_MS", 100); }
 
-void CheckPlainHttp(const URI& uri) {
-  if (uri.scheme == "https") {
-    throw Error(
-        "https:// is registered but the built-in client is plain-HTTP "
-        "(no TLS stack in-image; http.h). Route the object through "
-        "http://, an S3-compatible endpoint (S3_ENDPOINT), or a local "
-        "TLS-terminating proxy: " + uri.Str());
-  }
+// Route for this URI's origin: direct for http://, via the DCT_TLS_PROXY
+// helper for https:// (ResolveHttpRoute throws a guidance error when the
+// helper is not configured).
+HttpRoute RouteFor(const URI& uri) {
+  std::string host;
+  int port;
+  SplitHostPort(uri.host, &host, &port, uri.scheme == "https" ? 443 : 80);
+  return ResolveHttpRoute(uri.scheme, host, port);
 }
 
 // Ranged GET stream with reconnect-at-offset (http_stream.h retry loop —
@@ -44,10 +44,7 @@ class HttpReadStream : public RetryingHttpReadStream {
 
  protected:
   void Connect() override {
-    std::string host;
-    int port;
-    SplitHostPort(uri_.host, &host, &port, 80);
-    auto conn = std::make_unique<HttpConnection>(host, port);
+    auto conn = std::make_unique<HttpConnection>(RouteFor(uri_));
     std::map<std::string, std::string> h;
     h["Range"] = "bytes=" + std::to_string(pos_) + "-";
     h["Accept-Encoding"] = "identity";
@@ -57,7 +54,11 @@ class HttpReadStream : public RetryingHttpReadStream {
     if (head.status == 200 && pos_ != 0) {
       // the server ignored Range (Python's http.server does): stream and
       // discard the prefix so resume-at-offset still lands on the right
-      // byte — slower than a real ranged read, never wrong
+      // byte — slower than a real ranged read, never wrong. Every retry
+      // replays the FULL prefix on such a server, so the ranged-read
+      // retry budget (default 50) would admit O(50 x file) transfer on a
+      // flaky link: cut the budget to a couple of attempts instead.
+      max_retry_ = std::min(max_retry_, 2);
       char scratch[65536];
       size_t left = pos_;
       while (left > 0) {
@@ -84,9 +85,7 @@ class HttpReadStream : public RetryingHttpReadStream {
 // HEAD the object; fall back to `Range: bytes=0-0` GET parsing
 // Content-Range when the server rejects HEAD.
 size_t RemoteSize(const URI& uri, bool allow_null, bool* found) {
-  std::string host;
-  int port;
-  SplitHostPort(uri.host, &host, &port, 80);
+  const HttpRoute route = RouteFor(uri);
   const std::string path = uri.path.empty() ? "/" : uri.path;
   *found = true;
   // HEAD by hand: Content-Length describes the WOULD-BE body — none
@@ -94,7 +93,7 @@ size_t RemoteSize(const URI& uri, bool allow_null, bool* found) {
   // would block on it
   HttpResponse r;
   {
-    HttpConnection conn(host, port);
+    HttpConnection conn(route);
     conn.SendRequest("HEAD", path, {}, "");
     conn.ReadResponseHead(&r);
   }
@@ -106,8 +105,13 @@ size_t RemoteSize(const URI& uri, bool allow_null, bool* found) {
     throw HttpStatusError("http object not found: " + uri.Str(), r.status);
   }
   if (r.status == 405 || r.status == 501) {  // HEAD unsupported
-    HttpResponse g = HttpRequest(host, port, "GET", path,
-                                 {{"Range", "bytes=0-0"}}, "");
+    // manual connection (not the one-shot HttpRequest helper): a server
+    // that also ignores Range answers 200 with the WHOLE object, and the
+    // helper would buffer it all in memory just to learn a length
+    HttpConnection gconn(route);
+    gconn.SendRequest("GET", path, {{"Range", "bytes=0-0"}}, "");
+    HttpResponse g;
+    gconn.ReadResponseHead(&g);
     if (g.status == 404 || g.status == 410) {  // same contract as HEAD 404
       if (allow_null) {
         *found = false;
@@ -118,14 +122,27 @@ size_t RemoteSize(const URI& uri, bool allow_null, bool* found) {
     }
     auto it = g.headers.find("content-range");
     if (g.status == 206 && it != g.headers.end()) {
-      // "bytes 0-0/TOTAL"
+      // "bytes 0-0/TOTAL"; the 1-byte body is abandoned with the socket
       size_t slash = it->second.rfind('/');
       if (slash != std::string::npos) {
         return static_cast<size_t>(
             std::strtoull(it->second.c_str() + slash + 1, nullptr, 10));
       }
     }
-    if (g.status == 200) return g.body.size();
+    if (g.status == 200) {
+      auto cl = g.headers.find("content-length");
+      if (cl != g.headers.end()) {
+        return static_cast<size_t>(
+            std::strtoull(cl->second.c_str(), nullptr, 10));
+      }
+      // chunked/unsized: stream-and-discard, counting bytes
+      size_t total = 0;
+      char scratch[65536];
+      for (size_t n; (n = gconn.ReadBody(scratch, sizeof(scratch))) > 0;) {
+        total += n;
+      }
+      return total;
+    }
     throw HttpStatusError("http size probe failed for " + uri.Str() +
                           " (status " + std::to_string(g.status) + ")",
                           g.status);
@@ -150,7 +167,6 @@ HttpFileSystem* HttpFileSystem::GetInstance() {
 }
 
 FileInfo HttpFileSystem::GetPathInfo(const URI& path) {
-  CheckPlainHttp(path);
   bool found = true;
   FileInfo info;
   info.path = path;
@@ -176,7 +192,6 @@ Stream* HttpFileSystem::Open(const URI& path, const char* mode,
 }
 
 SeekStream* HttpFileSystem::OpenForRead(const URI& path, bool allow_null) {
-  CheckPlainHttp(path);
   bool found = true;
   size_t size = RemoteSize(path, allow_null, &found);
   if (!found) return nullptr;

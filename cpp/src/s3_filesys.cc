@@ -186,11 +186,17 @@ Target ResolveTarget(const S3Config& cfg, const std::string& bucket) {
     t.base_path = cfg.path_style ? "/" + bucket : "";
     if (!cfg.path_style) t.host = bucket + "." + t.host;
   } else {
+    // real AWS is TLS-only: default to https (reached via DCT_TLS_PROXY)
     t.host = bucket + ".s3." + cfg.region + ".amazonaws.com";
-    t.port = 80;
+    t.port = cfg.scheme == "https" ? 443 : 80;
     t.base_path = "";
   }
   return t;
+}
+
+// Socket route for a resolved target (via the TLS helper for https).
+HttpRoute RouteOf(const S3Config& cfg, const Target& t) {
+  return ResolveHttpRoute(cfg.scheme, t.host, t.port);
 }
 
 std::map<std::string, std::string> SignedHeaders(
@@ -202,8 +208,8 @@ std::map<std::string, std::string> SignedHeaders(
   req.method = method;
   req.canonical_path = path;
   req.query = query;
-  req.host_header =
-      t.port == 80 ? t.host : t.host + ":" + std::to_string(t.port);
+  // MUST match the wire Host (ResolveHttpRoute) or SIG4 verification fails
+  req.host_header = DefaultHostHeader(cfg.scheme, t.host, t.port);
   req.payload_hash = payload_hash;
   req.amz_date = s3::AmzDateNow();
   std::map<std::string, std::string> headers;
@@ -246,7 +252,7 @@ class S3ReadStream : public RetryingHttpReadStream {
     std::string path = target_.base_path + key_;
     auto headers = SignedHeaders(cfg_, target_, "GET", path, {}, kUnsigned);
     headers["Range"] = "bytes=" + std::to_string(pos_) + "-";
-    conn_.reset(new HttpConnection(target_.host, target_.port));
+    conn_.reset(new HttpConnection(RouteOf(cfg_, target_)));
     // the wire path must be the same percent-encoded form that was signed
     conn_->SendRequest("GET", s3::UriEncode(path, true), headers, "");
     HttpResponse head;
@@ -345,7 +351,7 @@ class S3WriteStream : public Stream {
     while (true) {
       try {
         HttpResponse resp = HttpRequest(
-            target_.host, target_.port, method,
+            RouteOf(cfg_, target_), method,
             s3::UriEncode(path, true) + QueryString(query), headers, body);
         if (RetryableHttpStatus(resp.status) && attempts < cfg_.max_retry) {
           ++attempts;
@@ -422,17 +428,15 @@ S3Config S3Config::FromEnv() {
   if (!region.empty()) cfg.region = region;
   std::string endpoint = get("S3_ENDPOINT", "AWS_ENDPOINT");
   if (!endpoint.empty()) {
-    // strip scheme; only http endpoints are supported by the built-in client
-    size_t scheme = endpoint.find("://");
-    if (scheme != std::string::npos) {
-      DCT_CHECK(endpoint.compare(0, scheme, "http") == 0)
-          << "built-in s3 client supports http endpoints only, got "
-          << endpoint;
-      endpoint = endpoint.substr(scheme + 3);
-    }
+    // scheme picks the transport: http direct, https via the TLS helper
+    std::string scheme = StripUrlScheme(&endpoint);
+    if (!scheme.empty()) cfg.scheme = scheme;
+    if (cfg.scheme == "https") cfg.endpoint_port = 443;
     SplitHostPort(endpoint, &cfg.endpoint_host, &cfg.endpoint_port,
                   cfg.endpoint_port);
     cfg.path_style = true;  // custom endpoints default to path-style
+  } else {
+    cfg.scheme = "https";  // real AWS endpoints are TLS-only
   }
   const char* vs = std::getenv("S3_PATH_STYLE");
   if (vs != nullptr) cfg.path_style = std::atoi(vs) != 0;
@@ -466,7 +470,7 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
     auto headers = s3::SignedHeaders(config_, t, "GET", base, q,
                                      crypto::Sha256Hex(""));
     HttpResponse resp =
-        HttpRequest(t.host, t.port, "GET",
+        HttpRequest(s3::RouteOf(config_, t), "GET",
                     s3::UriEncode(base, true) + s3::QueryString(q),
                     headers, "");
     DCT_CHECK(resp.status == 200)
@@ -540,7 +544,7 @@ FileInfo S3FileSystem::GetPathInfo(const URI& path) {
     auto headers =
         s3::SignedHeaders(config_, t, "GET", base, q, crypto::Sha256Hex(""));
     HttpResponse resp =
-        HttpRequest(t.host, t.port, "GET",
+        HttpRequest(s3::RouteOf(config_, t), "GET",
                     s3::UriEncode(base, true) + s3::QueryString(q), headers,
                     "");
     DCT_CHECK(resp.status == 200)

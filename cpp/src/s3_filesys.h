@@ -6,9 +6,11 @@
 // retries at 100 ms), multipart-upload write streams (:978-1016), ListObjects
 // XML paging, and the S3_* -> AWS_* env credential chain (:1150-1214).
 // Differences from the reference: the transport is the built-in POSIX-socket
-// HTTP client (no libcurl/OpenSSL in this toolchain — see http.h/sha256.h),
-// so custom *http* endpoints (S3-compatible stores, test harnesses) are
-// first-class and TLS endpoints are not supported by the built-in client.
+// HTTP client (no libcurl/OpenSSL in this toolchain — see http.h/sha256.h).
+// Custom http endpoints (S3-compatible stores, test harnesses) connect
+// directly; https endpoints — including the no-endpoint default, real
+// TLS-only AWS — route through the local TLS-terminating helper
+// (DCT_TLS_PROXY, http.h ResolveHttpRoute, io/tls_proxy.py).
 #ifndef DCT_S3_FILESYS_H_
 #define DCT_S3_FILESYS_H_
 
@@ -26,12 +28,17 @@ struct S3Config {
   std::string region = "us-east-1";
   std::string endpoint_host;  // empty => <bucket>.s3.<region>.amazonaws.com
   int endpoint_port = 80;
+  // "http" for custom plain endpoints; "https" routes through the local
+  // TLS-terminating helper (DCT_TLS_PROXY, http.h ResolveHttpRoute). The
+  // no-endpoint AWS default is https — the real service is TLS-only.
+  std::string scheme = "http";
   bool path_style = false;    // true for custom endpoints (bucket in path)
   int max_retry = 50;
   int retry_sleep_ms = 100;
 
   // Environment chain: S3_* falling back to AWS_* (reference
-  // s3_filesys.cc:1150-1214). S3_ENDPOINT accepts "host:port".
+  // s3_filesys.cc:1150-1214). S3_ENDPOINT accepts "host:port" or
+  // "http(s)://host[:port]".
   static S3Config FromEnv();
 };
 

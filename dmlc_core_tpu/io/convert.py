@@ -328,8 +328,9 @@ def _main(argv=None) -> int:
                     help="source format (auto/libsvm/csv/libfm; "
                          "?format= URI sugar also works)")
     ap.add_argument("--rows-per-record", type=int, default=4096)
-    ap.add_argument("--dtype", default="bf16",
-                    help="dense (.drec) element dtype: bf16 or float32")
+    ap.add_argument("--dtype", default=None,
+                    help="dense (.drec) element dtype: bf16 (default) or "
+                         "float32; rejected for other output lanes")
     ap.add_argument("--part", type=int, default=0)
     ap.add_argument("--npart", type=int, default=1)
     ap.add_argument("--index", action="store_true",
@@ -338,13 +339,16 @@ def _main(argv=None) -> int:
     if args.index and not args.dst.endswith(".rec"):
         # usage errors must surface BEFORE a possibly hours-long write
         raise DMLCError("--index applies to .rec outputs only")
+    if args.dtype is not None and not args.dst.endswith(".drec"):
+        raise DMLCError("--dtype applies to .drec outputs only "
+                        "(.rec/.crec store exact CSR values)")
     common = dict(fmt=args.format, rows_per_record=args.rows_per_record,
                   part=args.part, npart=args.npart)
     if args.dst.endswith(".crec"):
         n = rows_to_csr_recordio(args.src, args.dst, **common)
     elif args.dst.endswith(".drec"):
-        n = rows_to_dense_recordio(args.src, args.dst, dtype=args.dtype,
-                                   **common)
+        n = rows_to_dense_recordio(args.src, args.dst,
+                                   dtype=args.dtype or "bf16", **common)
     elif args.dst.endswith(".rec"):
         n = rows_to_recordio(args.src, args.dst, **common)
     else:

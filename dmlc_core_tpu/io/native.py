@@ -171,11 +171,59 @@ def _check(status: int) -> None:
         raise DMLCError(lib().dct_last_error().decode("utf-8", "replace"))
 
 
+def _uri_needs_tls(uri: str) -> bool:
+    """Whether any member of this (possibly ';'-separated) URI reaches an
+    https origin under the native clients' env rules: https:// directly;
+    s3:// and azure:// whenever their endpoint env is https or UNSET (the
+    no-endpoint default is the real TLS-only cloud service,
+    cpp/src/{s3,azure}_filesys.cc ResolveTarget); hdfs:// under an https
+    WEBHDFS_NAMENODE (secure WebHDFS)."""
+    if "https://" in uri:
+        return True
+
+    def env(*names: str) -> str:
+        for n in names:
+            v = os.environ.get(n)
+            if v:
+                return v
+        return ""
+
+    if "s3://" in uri:
+        ep = env("S3_ENDPOINT", "AWS_ENDPOINT")
+        return not ep or ep.startswith("https://")
+    if "azure://" in uri:
+        ep = env("AZURE_ENDPOINT")
+        return not ep or ep.startswith("https://")
+    if "hdfs://" in uri or "viewfs://" in uri:
+        return env("WEBHDFS_NAMENODE").startswith("https://")
+    return False
+
+
+def _route_https(uri: str) -> str:
+    """Make https-origin URIs reachable before handing them to the native
+    lib.
+
+    The native client is plain-HTTP; https origins route through the local
+    TLS-terminating helper (io/tls_proxy.py). When the operator configured
+    none (DCT_TLS_PROXY unset), start the in-process singleton — the
+    native side reads the env per request, so the export is picked up
+    immediately. DCT_TLS_AUTO=0 opts out (operators running an external
+    helper fleet-wide set DCT_TLS_PROXY themselves). Returns the uri
+    unchanged (routing is by env)."""
+    if (os.environ.get("DCT_TLS_AUTO") != "0"
+            and not os.environ.get("DCT_TLS_PROXY")
+            and _uri_needs_tls(uri)):
+        from dmlc_core_tpu.io.tls_proxy import ensure_tls_proxy
+        ensure_tls_proxy()
+    return uri
+
+
 # -- streams ----------------------------------------------------------------
 class NativeStream:
     """URI-dispatched byte stream (reference Stream::Create, io.h:57)."""
 
     def __init__(self, uri: str, mode: str = "r"):
+        uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         _check(lib().dct_stream_create(uri.encode(), mode.encode(),
                                        ctypes.byref(self._h)))
@@ -227,6 +275,7 @@ class NativeStream:
 def list_directory(uri: str, recursive: bool = False
                    ) -> List[Tuple[str, int, str]]:
     """List (path, size, 'f'|'d') entries (reference FileSystem, io.h:591)."""
+    uri = _route_https(uri)
     out = ctypes.c_char_p()
     _check(lib().dct_fs_list(uri.encode(), 1 if recursive else 0,
                              ctypes.byref(out)))
@@ -243,6 +292,7 @@ def list_directory(uri: str, recursive: bool = False
 
 def path_info(uri: str) -> Tuple[int, bool]:
     """Return (size, is_dir)."""
+    uri = _route_https(uri)
     size = ctypes.c_size_t()
     is_dir = ctypes.c_int()
     _check(lib().dct_fs_path_info(uri.encode(), ctypes.byref(size),
@@ -295,6 +345,7 @@ class NativeInputSplit:
                  index_uri: str = "", shuffle: bool = False, seed: int = 0,
                  batch_size: int = 256, cache_file: str = "",
                  shuffle_parts: int = 0, recurse: bool = False):
+        uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         if (index_uri or shuffle or cache_file or shuffle_parts or recurse
                 or split_type == "indexed_recordio"):
@@ -388,6 +439,7 @@ class NativeRecordIOWriter:
     """reference RecordIOWriter (recordio.h:38); format spec in recordio.h."""
 
     def __init__(self, uri: str):
+        uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         _check(lib().dct_recordio_writer_create(uri.encode(),
                                                 ctypes.byref(self._h)))
@@ -414,6 +466,7 @@ class NativeRecordIOReader:
     """reference RecordIOReader (recordio.h:119)."""
 
     def __init__(self, uri: str):
+        uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         _check(lib().dct_recordio_reader_create(uri.encode(),
                                                 ctypes.byref(self._h)))
@@ -514,6 +567,7 @@ class NativeParser:
     def __init__(self, uri: str, part: int = 0, npart: int = 1,
                  fmt: str = "auto", nthread: int = 0, threaded: bool = True,
                  index64: bool = False):
+        uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         _check(lib().dct_parser_create(uri.encode(), part, npart, fmt.encode(),
                                        nthread, 1 if threaded else 0,
@@ -591,6 +645,7 @@ class NativeBatcher:
                  fmt: str = "auto", nthread: int = 0, threaded: bool = True,
                  batch_rows: int = 65536, num_shards: int = 1,
                  min_nnz_bucket: int = 4096):
+        uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         self._batch_rows = batch_rows
         self._num_shards = num_shards
@@ -723,6 +778,7 @@ class NativeCsrRecBatcher:
     def __init__(self, uri: str, part: int = 0, npart: int = 1,
                  batch_rows: int = 65536, num_shards: int = 1,
                  min_nnz_bucket: int = 4096):
+        uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         self._batch_rows = batch_rows
         self._num_shards = num_shards
@@ -809,6 +865,7 @@ class NativeDenseRecBatcher:
 
     def __init__(self, uri: str, part: int = 0, npart: int = 1,
                  batch_rows: int = 65536, num_shards: int = 1):
+        uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         self._batch_rows = batch_rows
         self._num_shards = num_shards

@@ -256,9 +256,12 @@ def unpack_tree(tree: Dict[str, Any]) -> Dict[str, Any]:
 
 def unpack_shard(tree: Dict[str, Any]) -> Dict[str, Any]:
     """Named leaves from one shard of a packed tree (device axis already
-    dropped: aux [K, R], big [Kb, NNZ], x [R, F]; nrows becomes [1]).
-    Identity for already-named trees. For use inside shard_map bodies."""
-    return _unpack(tree, lambda plane: plane[:1])
+    dropped: aux [K, R], big [Kb, NNZ], x [R, F]; nrows becomes a 0-d
+    scalar — the SAME rank the named-tree lane yields after its v[0]
+    device-axis slice, so _shard_loss implementations see one shape
+    regardless of how the batch arrived). Identity for already-named
+    trees. For use inside shard_map bodies."""
+    return _unpack(tree, lambda plane: plane[0])
 
 
 def _bitcast_f32(a):

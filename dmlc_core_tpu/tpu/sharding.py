@@ -73,7 +73,11 @@ def process_part(num_parts_per_process: int = 1) -> Tuple[int, int]:
       roles read the whole stream by convention (their task ids sit past
       the worker range).
     - mpi / slurm: the runtime's native rank vars
-      (OMPI_COMM_WORLD_RANK / PMI_RANK / SLURM_PROCID).
+      (OMPI_COMM_WORLD_RANK / PMI_RANK / SLURM_PROCID). The slurm count
+      comes from SLURM_STEP_NUM_TASKS — step-scoped, exported only inside
+      an `srun` step — NOT from SLURM_NTASKS, which sbatch/salloc export
+      for the whole allocation even when the script runs as ONE process
+      (such a job must read the full dataset, not 1/N of it).
     - otherwise (ssh/mesos workers, whose rank is assigned dynamically at
       rendezvous): (0, 1) — pass part/npart explicitly from the
       rendezvous rank for those clusters.
@@ -88,7 +92,7 @@ def process_part(num_parts_per_process: int = 1) -> Tuple[int, int]:
             ("DMLC_TASK_ID", "DMLC_NUM_WORKER"),
             ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
             ("PMI_RANK", "PMI_SIZE"),
-            ("SLURM_PROCID", "SLURM_NTASKS")):
+            ("SLURM_PROCID", "SLURM_STEP_NUM_TASKS")):
         rank = os.environ.get(rank_var)
         count = os.environ.get(count_var)
         if rank is None or count is None or int(count) <= 1:

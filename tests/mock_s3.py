@@ -268,11 +268,17 @@ class MockS3Handler(BaseHTTPRequestHandler):
         self._reject(400, "BadRequest")
 
 
-def serve():
-    """Start the mock server; returns (state, port, shutdown_fn)."""
+def serve(ssl_context=None):
+    """Start the mock server; returns (state, port, shutdown_fn).
+
+    With `ssl_context` (an SSLContext loaded with a cert chain) the mock
+    speaks TLS — the S3-over-https lane's stand-in for real AWS."""
     state = MockS3State()
     handler = type("Handler", (MockS3Handler,), {"state": state})
     server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    if ssl_context is not None:
+        server.socket = ssl_context.wrap_socket(server.socket,
+                                                server_side=True)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return state, server.server_address[1], server.shutdown

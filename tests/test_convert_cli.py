@@ -1,0 +1,53 @@
+"""Converter CLI contract: suffix-driven lane choice and up-front flag
+validation (usage errors must surface BEFORE a possibly hours-long write —
+the same rationale the reference applies to its CLI arg checks)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.convert import _main
+
+
+def _write_libsvm(path, rows=64, features=5, seed=3):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(rows):
+        feats = " ".join(
+            f"{j}:{rng.uniform(-1, 1):.4f}" for j in range(features))
+        lines.append(f"{i % 2} {feats}")
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_cli_converts_each_lane(tmp_path, capsys):
+    src = _write_libsvm(tmp_path / "a.libsvm")
+    for suffix in (".rec", ".crec", ".drec"):
+        dst = str(tmp_path / ("out" + suffix))
+        assert _main([src, dst]) == 0
+        assert "wrote 64 rows" in capsys.readouterr().out
+
+
+def test_cli_dtype_only_for_drec(tmp_path):
+    src = _write_libsvm(tmp_path / "b.libsvm")
+    # explicit --dtype is honored on the dense lane...
+    assert _main([src, str(tmp_path / "o.drec"), "--dtype", "float32"]) == 0
+    # ...and rejected up front everywhere else (it would otherwise be
+    # silently ignored — .rec/.crec store exact CSR values)
+    for suffix in (".rec", ".crec"):
+        with pytest.raises(DMLCError, match="--dtype"):
+            _main([src, str(tmp_path / ("o" + suffix)), "--dtype", "bf16"])
+
+
+def test_cli_index_only_for_rec(tmp_path):
+    src = _write_libsvm(tmp_path / "c.libsvm")
+    with pytest.raises(DMLCError, match="--index"):
+        _main([src, str(tmp_path / "o.drec"), "--index"])
+
+
+def test_cli_unknown_suffix_rejected(tmp_path):
+    src = _write_libsvm(tmp_path / "d.libsvm")
+    with pytest.raises(DMLCError, match="suffix"):
+        _main([src, str(tmp_path / "o.bin")])

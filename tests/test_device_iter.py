@@ -197,6 +197,30 @@ def test_process_part_single_host():
     assert process_part() == (0, 1)
 
 
+def test_process_part_slurm_requires_step_scope(monkeypatch):
+    # sbatch/salloc export SLURM_PROCID=0 + SLURM_NTASKS=N for the WHOLE
+    # allocation even when the script runs as one process without srun;
+    # partitioning on those would silently train on 1/N of the data. Only
+    # the step-scoped count (exported by srun) may trigger partitioning.
+    monkeypatch.setenv("SLURM_PROCID", "0")
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    assert process_part() == (0, 1)
+    monkeypatch.setenv("SLURM_STEP_NUM_TASKS", "8")
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    assert process_part() == (3, 8)
+
+
+def test_unpack_shard_nrows_is_scalar():
+    # rank contract: a _shard_loss sees nrows as a 0-d scalar whether the
+    # batch arrived packed (this path) or named (the v[0] device-axis
+    # slice in models/_dp.py shard_view, also 0-d)
+    from dmlc_core_tpu.tpu.device_iter import unpack_shard
+    aux = np.zeros((3, 4), np.int32)
+    aux[-1, 0] = 2
+    out = unpack_shard({"aux": aux})
+    assert np.ndim(out["nrows"]) == 0 and int(out["nrows"]) == 2
+
+
 def test_staging_error_propagates(tmp_path):
     # a parse error on the staging thread must surface at the consumer
     bad = tmp_path / "bad.csv"

@@ -24,6 +24,7 @@ class _State:
     def __init__(self):
         self.objects = {}
         self.honor_range = True
+        self.head_status = None  # e.g. 405: server refuses HEAD
         self.drop_after = None  # bytes into a GET body, then cut the socket
         self.requests = []
 
@@ -41,6 +42,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_HEAD(self):
         body = self._object()
         self.state.requests.append(("HEAD", self.path))
+        if self.state.head_status is not None:
+            self.send_response(self.state.head_status)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         if body is None:
             self.send_response(404)
             self.send_header("Content-Length", "0")
@@ -173,6 +179,44 @@ def test_mid_body_drop_reconnects_at_offset(http_server):
     assert len(offsets) > 2 and offsets == sorted(offsets)
 
 
+def test_headless_server_sizing(http_server):
+    # HEAD-unsupported servers are sized via a `Range: bytes=0-0` GET; if
+    # the server ALSO ignores Range, the Content-Length of its 200 answer
+    # is used — the client never buffers the whole object to learn a size
+    state, base = http_server
+    state.head_status = 405
+    corpus = _libsvm_corpus(rows=150)
+    state.objects["/train.libsvm"] = corpus
+    rows = 0
+    with NativeParser(base + "/train.libsvm") as p:
+        for b in p:
+            rows += b.num_rows
+    assert rows == 150
+    state.honor_range = False
+    state.requests.clear()
+    rows = 0
+    with NativeParser(base + "/train.libsvm") as p:
+        for b in p:
+            rows += b.num_rows
+    assert rows == 150
+
+
+def test_range_ignoring_server_caps_retries(http_server):
+    # against a Range-ignoring server every reconnect replays the FULL
+    # prefix; the ranged-read budget (50 tries) would admit O(50 x file)
+    # transfer, so the reader must cut the budget and fail fast instead
+    state, base = http_server
+    state.honor_range = False
+    state.objects["/big.libsvm"] = _libsvm_corpus(rows=800)
+    state.drop_after = 4096  # every GET dies 4 KB in: unrecoverable here
+    with pytest.raises(DMLCError):
+        with NativeParser(base + "/big.libsvm") as p:
+            for _ in p:
+                pass
+    gets = sum(1 for r in state.requests if r[0] == "GET")
+    assert gets <= 8, f"{gets} full-body replays against a flaky server"
+
+
 def test_missing_object_and_guards(http_server):
     state, base = http_server
     with pytest.raises(DMLCError, match="404|not found"):
@@ -180,5 +224,16 @@ def test_missing_object_and_guards(http_server):
             s.read(1)
     with pytest.raises(DMLCError, match="read-only"):
         NativeStream(base + "/x", "w")
-    with pytest.raises(DMLCError, match="plain-HTTP|TLS"):
-        NativeStream("https://127.0.0.1:1/x", "r")
+    # with auto-start opted out and no helper configured, https fails
+    # with guidance toward the TLS helper instead of a connect error
+    import os
+    old = {k: os.environ.pop(k, None) for k in ("DCT_TLS_PROXY",)}
+    os.environ["DCT_TLS_AUTO"] = "0"
+    try:
+        with pytest.raises(DMLCError, match="DCT_TLS_PROXY|plain-HTTP"):
+            NativeStream("https://127.0.0.1:1/x", "r")
+    finally:
+        os.environ.pop("DCT_TLS_AUTO", None)
+        for k, v in old.items():
+            if v is not None:
+                os.environ[k] = v

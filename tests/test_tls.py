@@ -1,0 +1,267 @@
+"""https:// end to end: Stream -> InputSplit -> parser over a self-signed
+in-process TLS server, through the TLS-terminating helper.
+
+The reference reads https objects via libcurl+OpenSSL inside its S3 client
+(reference src/io/s3_filesys.cc; src/io.cc:53 routes https there). Here TLS
+terminates in the local helper (dmlc_core_tpu/io/tls_proxy.py) and the
+native plain-HTTP client sends it absolute-form requests
+(cpp/src/http.cc ResolveHttpRoute). Covered: ranged reads + seek,
+distributed exact cover, reconnect-at-offset through mid-body TLS drops,
+HEAD-unsupported sizing, upload passthrough (PUT bodies survive the relay),
+the auto-start facade hook, and trust failure (unknown CA -> clear error).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.native import NativeParser, NativeStream, path_info
+from dmlc_core_tpu.io.tls_proxy import TlsProxy
+
+
+@pytest.fixture(scope="module")
+def cert_pair(tmp_path_factory):
+    """Self-signed cert/key for 127.0.0.1 (SAN: IP + localhost)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    d = tmp_path_factory.mktemp("tls")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "127.0.0.1")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.IPv4Address("127.0.0.1")),
+                 x509.DNSName("localhost")]), critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_file = d / "cert.pem"
+    key_file = d / "key.pem"
+    cert_file.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_file.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    return str(cert_file), str(key_file)
+
+
+class _State:
+    def __init__(self):
+        self.objects = {}
+        self.honor_range = True
+        self.refuse_head = False
+        self.drop_after = None
+        self.requests = []
+        self.uploads = {}
+
+
+class _TlsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: _State = None
+
+    def log_message(self, *a):
+        pass
+
+    def do_HEAD(self):
+        body = self.state.objects.get(self.path)
+        self.state.requests.append(("HEAD", self.path))
+        if self.state.refuse_head:
+            self.send_response(405)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if body is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+
+    def do_GET(self):
+        body = self.state.objects.get(self.path)
+        self.state.requests.append(
+            ("GET", self.path, self.headers.get("Range")))
+        if body is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        status, lo = 200, 0
+        if rng and self.state.honor_range:
+            lo = int(rng.split("=")[1].split("-")[0])
+            status, body = 206, body[lo:]
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        if status == 206:
+            self.send_header(
+                "Content-Range",
+                f"bytes {lo}-{lo + len(body) - 1}"
+                f"/{len(self.state.objects[self.path])}")
+        self.end_headers()
+        cut = self.state.drop_after
+        if cut is not None and len(body) > cut:
+            self.wfile.write(body[:cut])
+            self.wfile.flush()
+            self.close_connection = True  # release rfile/wfile refs too
+            self.connection.close()
+            return
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        self.state.uploads[self.path] = self.rfile.read(length)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture()
+def tls_stack(cert_pair, monkeypatch):
+    """(state, https_base): self-signed TLS origin + helper + env."""
+    cert_file, key_file = cert_pair
+    monkeypatch.setenv("DCT_HTTP_MAX_RETRY", "10")
+    monkeypatch.setenv("DCT_HTTP_RETRY_SLEEP_MS", "5")
+    monkeypatch.setenv("DCT_TLS_CA", cert_file)
+    state = _State()
+    handler = type("H", (_TlsHandler,), {"state": state})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file)
+    srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    proxy = TlsProxy()
+    monkeypatch.setenv("DCT_TLS_PROXY", proxy.start())
+    try:
+        yield state, f"https://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        proxy.stop()
+        srv.shutdown()
+        srv.server_close()
+
+
+def _libsvm_corpus(rows=200, features=5, seed=11):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(rows):
+        feats = " ".join(
+            f"{j}:{rng.uniform(-2, 2):.5f}" for j in range(features))
+        lines.append(f"{i % 2} {feats}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def test_stream_reads_over_tls(tls_stack):
+    state, base = tls_stack
+    blob = bytes(range(256)) * 64
+    state.objects["/blob.bin"] = blob
+    assert path_info(base + "/blob.bin") == (len(blob), False)
+    with NativeStream(base + "/blob.bin", "r") as s:
+        assert s.read_all() == blob
+
+
+def test_parser_composes_over_tls(tls_stack):
+    state, base = tls_stack
+    state.objects["/train.libsvm"] = _libsvm_corpus(rows=331)
+    got = 0
+    for part in range(3):
+        with NativeParser(base + "/train.libsvm", part=part, npart=3) as p:
+            got += sum(b.num_rows for b in p)
+    assert got == 331  # exact cover through the TLS relay
+    # the split issued ranged GETs which survived the relay end to end
+    assert any(r[0] == "GET" and r[2] for r in state.requests)
+
+
+def test_tls_reconnect_at_offset(tls_stack):
+    state, base = tls_stack
+    state.objects["/train.libsvm"] = _libsvm_corpus(rows=400)
+    state.drop_after = 4096  # every TLS GET dies 4 KB in
+    rows = 0
+    with NativeParser(base + "/train.libsvm") as p:
+        for b in p:
+            rows += b.num_rows
+    assert rows == 400
+    offsets = [int(r[2].split("=")[1].split("-")[0])
+               for r in state.requests if r[0] == "GET" and r[2]]
+    assert len(offsets) > 2 and offsets == sorted(offsets)
+
+
+def test_tls_headless_sizing(tls_stack):
+    state, base = tls_stack
+    state.refuse_head = True
+    state.objects["/o.bin"] = b"z" * 12345
+    assert path_info(base + "/o.bin") == (12345, False)
+
+
+def test_tls_facade_autostarts_helper(tls_stack, monkeypatch):
+    # no DCT_TLS_PROXY configured: the facade starts the in-process
+    # helper on first https:// open and exports its address
+    state, base = tls_stack
+    state.objects["/auto.bin"] = b"hello tls"
+    monkeypatch.delenv("DCT_TLS_PROXY")
+    with NativeStream(base + "/auto.bin", "r") as s:
+        assert s.read_all() == b"hello tls"
+    assert os.environ.get("DCT_TLS_PROXY")  # exported by ensure_tls_proxy
+
+
+def test_s3_full_surface_over_tls(cert_pair):
+    # fresh process: the native S3 singleton captures env at first use
+    import subprocess
+    import sys
+    cert_file, key_file = cert_pair
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("DCT_TLS_PROXY", "S3_ENDPOINT")}
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tests", "tls_s3_worker.py"),
+         repo, cert_file, key_file],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "TLS_S3_OK" in out.stdout
+
+
+def test_uri_needs_tls_env_rules(monkeypatch):
+    # the facade must auto-start the helper exactly when the native client
+    # will resolve an https origin — including the s3:// and azure://
+    # cases whose no-endpoint default is the real TLS-only cloud service
+    from dmlc_core_tpu.io.native import _uri_needs_tls
+    for v in ("S3_ENDPOINT", "AWS_ENDPOINT", "AZURE_ENDPOINT",
+              "WEBHDFS_NAMENODE"):
+        monkeypatch.delenv(v, raising=False)
+    assert _uri_needs_tls("s3://bkt/key")
+    assert _uri_needs_tls("azure://cont/blob")
+    assert not _uri_needs_tls("hdfs://nn/x")  # webhdfs default is http
+    assert not _uri_needs_tls("/local/file.libsvm")
+    monkeypatch.setenv("S3_ENDPOINT", "http://127.0.0.1:9000")
+    assert not _uri_needs_tls("s3://bkt/key")
+    monkeypatch.setenv("S3_ENDPOINT", "https://minio.internal")
+    assert _uri_needs_tls("s3://bkt/key")
+    monkeypatch.setenv("WEBHDFS_NAMENODE", "https://nn:9871")
+    assert _uri_needs_tls("hdfs://cluster/x")
+    assert _uri_needs_tls("/a.rec;https://host/b.rec")  # list member
+
+
+def test_tls_unknown_ca_fails_clearly(tls_stack, monkeypatch):
+    state, base = tls_stack
+    state.objects["/x.bin"] = b"data"
+    monkeypatch.delenv("DCT_TLS_CA")  # helper no longer trusts the server
+    with pytest.raises(DMLCError, match="502|relay|certificate"):
+        with NativeStream(base + "/x.bin", "r") as s:
+            s.read(1)

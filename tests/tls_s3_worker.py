@@ -1,0 +1,67 @@
+"""Subprocess worker: the full S3 surface over TLS.
+
+Run by test_tls.py in a fresh process because the native S3 singleton
+captures its env config at first use. Serves the SIG4-verifying mock S3
+behind TLS (the stand-in for real AWS, which is TLS-only), routes the
+native client through the TLS-terminating helper, and exercises signed
+read / ranged parser composition / write / listing end to end.
+
+argv: repo_root cert_file key_file
+"""
+
+import os
+import ssl
+import sys
+
+
+def main() -> int:
+    repo, cert, key = sys.argv[1], sys.argv[2], sys.argv[3]
+    sys.path.insert(0, repo)
+    import tests.mock_s3 as mock_s3
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    state, port, shutdown = mock_s3.serve(ssl_context=ctx)
+
+    os.environ["S3_ENDPOINT"] = f"https://127.0.0.1:{port}"
+    os.environ["S3_ACCESS_KEY_ID"] = mock_s3.ACCESS_KEY
+    os.environ["S3_SECRET_ACCESS_KEY"] = mock_s3.SECRET_KEY
+    os.environ["S3_REGION"] = mock_s3.REGION
+    os.environ["DCT_TLS_CA"] = cert
+
+    from dmlc_core_tpu.io.tls_proxy import TlsProxy
+    with TlsProxy() as addr:
+        os.environ["DCT_TLS_PROXY"] = addr
+        from dmlc_core_tpu.io.native import (NativeParser, NativeStream,
+                                             list_directory)
+
+        lines = [f"{i % 2} 0:{i}.5 3:-{i}.25" for i in range(257)]
+        corpus = ("\n".join(lines) + "\n").encode()
+        state.objects[("bkt", "data/train.libsvm")] = corpus
+
+        # signed ranged read through the relay
+        with NativeStream("s3://bkt/data/train.libsvm", "r") as s:
+            assert s.read_all() == corpus, "read mismatch"
+
+        # parser composition with exact part cover
+        rows = 0
+        for part in range(2):
+            with NativeParser("s3://bkt/data/train.libsvm", part=part,
+                              npart=2) as p:
+                rows += sum(b.num_rows for b in p)
+        assert rows == 257, f"cover mismatch: {rows}"
+
+        # signed write back (single-put path) + listing
+        with NativeStream("s3://bkt/out/copy.bin", "w") as s:
+            s.write(corpus)
+        assert state.objects[("bkt", "out/copy.bin")] == corpus
+        entries = list_directory("s3://bkt/out")
+        assert any(e[0].endswith("copy.bin") for e in entries), entries
+
+    shutdown()
+    print("TLS_S3_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
