@@ -148,15 +148,8 @@ class TransformerLM:
         rule inserts an implicit cross-device psum into their cotangents
         (e.g. through the position-table dynamic_slice), so the explicit
         psum below would double-count by the axis size."""
-        if hasattr(lax, "pcast"):
-            return jax.tree.map(lambda t: lax.pcast(t, axes, to="varying"),
-                                tree)
-        if hasattr(lax, "pvary"):
-            return jax.tree.map(lambda t: lax.pvary(t, axes), tree)
-        raise RuntimeError(
-            "this JAX version has neither lax.pcast nor lax.pvary; "
-            "falling back to untyped params would make the explicit psum "
-            "double-count gradients by the mesh axis size")
+        from dmlc_core_tpu.parallel.varying import mark_varying
+        return mark_varying(tree, axes)
 
     def _shard_step(self, params: Params, tokens: jnp.ndarray,
                     labels: jnp.ndarray):

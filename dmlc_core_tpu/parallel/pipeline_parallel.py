@@ -65,11 +65,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
 
     # the carry must enter the scan with the same device-varying type the
     # ppermute output carries (shard_map's varying-type discipline)
-    state0 = zero
-    if hasattr(lax, "pvary"):
-        state0 = lax.pvary(state0, (axis_name,))
-    elif hasattr(lax, "pcast"):
-        state0 = lax.pcast(state0, (axis_name,), to="varying")
+    from dmlc_core_tpu.parallel.varying import mark_varying
+    state0 = mark_varying(zero, (axis_name,))
     _, ys = lax.scan(tick, state0, injections)
     # the last stage finishes microbatch m at tick m + (P-1)
     outs = ys[num_stages - 1:]

@@ -1,0 +1,31 @@
+"""Varying-type marking shared by the shard_map-based parallel lanes.
+
+Under shard_map's varying-type discipline, values entering a shard body as
+replicated must be explicitly cast to device-varying before they mix with
+collective outputs (ppermute carries, psum'd cotangents) — otherwise
+autodiff's transpose rule inserts implicit cross-device psums that
+double-count by the axis size, or scan rejects the carry type. JAX renamed
+the API (lax.pvary -> lax.pcast(..., to='varying')); this is the single
+probe point so the next rename is a one-place change.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["mark_varying"]
+
+
+def mark_varying(tree, axes):
+    """Cast every leaf of `tree` to device-varying over `axes` (a tuple of
+    mesh axis names). Accepts a single array or any pytree."""
+    if hasattr(lax, "pcast"):  # probe pcast first: pvary is deprecated
+        return jax.tree.map(lambda t: lax.pcast(t, axes, to="varying"),
+                            tree)
+    if hasattr(lax, "pvary"):
+        return jax.tree.map(lambda t: lax.pvary(t, axes), tree)
+    raise RuntimeError(
+        "this JAX version has neither lax.pcast nor lax.pvary; an untyped "
+        "replicated value inside shard_map would make explicit psums "
+        "double-count by the mesh axis size")

@@ -89,12 +89,14 @@ def test_params_stay_replicated():
 
 def test_mark_varying_unsupported_jax_raises(monkeypatch):
     # Neither lax.pcast nor lax.pvary: silently skipping the varying cast
-    # would double-count gradients (ADVICE r1); must raise instead.
-    import dmlc_core_tpu.models.transformer as tmod
+    # would double-count gradients (ADVICE r1); must raise instead. The
+    # probe lives in the shared parallel.varying helper (one place for
+    # the next JAX API rename).
+    import dmlc_core_tpu.parallel.varying as vmod
 
     class _BareLax:  # stands in for a JAX version lacking both APIs
         pass
 
-    monkeypatch.setattr(tmod, "lax", _BareLax())
+    monkeypatch.setattr(vmod, "lax", _BareLax())
     with pytest.raises(RuntimeError, match="pcast nor lax.pvary"):
         TransformerLM._mark_varying({"w": jnp.ones(2)}, ("data",))
