@@ -246,6 +246,51 @@ def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto",
     return rows / dt, dt
 
 
+def pallas_format_probe(batch_rows: int = 8192, features: int = 28,
+                        nnz_per_row: int = 28) -> dict:
+    """Device-side CSR->dense batch formatting: the Pallas
+    scatter-as-matmul kernel (ops/pallas_kernels.py) vs XLA scatter-add,
+    on a shard-sized problem. TPU-gated — interpret mode on CPU measures
+    nothing; the caller only invokes this when the device probe passed.
+    Values are cross-checked on device before timing."""
+    import numpy as np
+    import jax
+    from dmlc_core_tpu.ops.pallas_kernels import csr_to_dense_pallas
+    from dmlc_core_tpu.ops.sparse import csr_to_dense
+    if jax.default_backend() != "tpu":
+        return {"skipped": f"backend is {jax.default_backend()}, not tpu"}
+    rng = np.random.default_rng(11)
+    nnz = batch_rows * nnz_per_row
+    row = np.repeat(np.arange(batch_rows, dtype=np.int32), nnz_per_row)
+    col = rng.integers(0, features, nnz).astype(np.int32)
+    val = rng.normal(size=nnz).astype(np.float32)
+    row_d, col_d, val_d = (jax.device_put(a) for a in (row, col, val))
+    xla_fn = jax.jit(lambda r, c, v: csr_to_dense(
+        r, c, v, batch_rows, features, impl="xla"))
+    pl_fn = jax.jit(lambda r, c, v: csr_to_dense_pallas(
+        r, c, v, batch_rows, features))
+    np.testing.assert_allclose(np.asarray(pl_fn(row_d, col_d, val_d)),
+                               np.asarray(xla_fn(row_d, col_d, val_d)),
+                               rtol=1e-5, atol=1e-5)
+
+    def one_ms(fn):
+        t0 = time.time()
+        fn(row_d, col_d, val_d).block_until_ready()
+        return (time.time() - t0) * 1e3
+
+    # A/B-interleaved best-of-5: tunnel latency swings minute-to-minute,
+    # so sequential blocks would charge the drift to one side
+    xla_ms = pallas_ms = float("inf")
+    one_ms(xla_fn), one_ms(pl_fn)  # compile both outside the timed reps
+    for _ in range(5):
+        xla_ms = min(xla_ms, one_ms(xla_fn))
+        pallas_ms = min(pallas_ms, one_ms(pl_fn))
+    return {"rows": batch_rows, "features": features, "nnz": nnz,
+            "xla_ms": round(xla_ms, 3), "pallas_ms": round(pallas_ms, 3),
+            "pallas_speedup": round(xla_ms / pallas_ms, 3),
+            "pallas_rows_per_sec": round(batch_rows / (pallas_ms / 1e3), 1)}
+
+
 def attainable_contiguous_bw(sharding, nbytes: int) -> float:
     """Best host->device bandwidth (B/s) for one large contiguous buffer
     under the pipeline's sharding: the optimistic ceiling. The buffer is
@@ -417,7 +462,15 @@ def main() -> None:
     ap.add_argument("--no-scaling-table", action="store_true")
     ap.add_argument("--no-rec-lane", action="store_true",
                     help="skip the secondary binary-ingest lane")
+    ap.add_argument("--pallas-probe", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess child mode
     args = ap.parse_args()
+    if args.pallas_probe:
+        # child mode for the device-gated kernel probe: the parent runs it
+        # in a subprocess with a hard timeout because device hangs stall
+        # inside native code where no in-process guard can interrupt
+        print(json.dumps(pallas_format_probe()))
+        return
     args.dense_dtype = "bfloat16" if args.dense_dtype == "bf16" else "float32"
 
     rows = args.rows or (20000 if args.smoke else 200000)
@@ -623,6 +676,32 @@ def main() -> None:
                       f"bw-util {ce['hbm_ingest_bw_util']:.1%} "
                       f"(best {ce['hbm_ingest_bw_util_best']:.1%})",
                       file=sys.stderr)
+
+        # device-gated Pallas kernel row (VERDICT r4 item 5): on-device
+        # CSR->dense formatting, kernel vs XLA scatter-add. Runs for ANY
+        # headline format (it needs nothing from the rec lanes) but only
+        # in the parent (children carry DCT_SKIP_DEVICE_PROBE). Own
+        # subprocess + hard timeout: a tunnel hang mid-probe is
+        # uninterruptible in-process and must not cost the measured lanes.
+        if not os.environ.get("DCT_SKIP_DEVICE_PROBE"):
+            import subprocess
+            try:
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--pallas-probe"],
+                    capture_output=True, text=True, timeout=600,
+                    env=dict(os.environ, DCT_SKIP_DEVICE_PROBE="1"))
+                if out.returncode == 0:
+                    extras["pallas_csr_to_dense"] = json.loads(
+                        out.stdout.strip().splitlines()[-1])
+                else:
+                    extras["pallas_csr_to_dense"] = {
+                        "error": (out.stderr or "")[-300:]}
+            except subprocess.TimeoutExpired:
+                extras["pallas_csr_to_dense"] = {
+                    "error": "probe timed out (600s)"}
+            print(f"# pallas csr->dense: {extras['pallas_csr_to_dense']}",
+                  file=sys.stderr)
 
     # the remaining BASELINE.md target rows: csv-with-prefetch MB/s,
     # libfm rows/s, and the RecordIO write+read round-trip. These are pure

@@ -73,12 +73,25 @@ def objective_loss(margin: jnp.ndarray, shard: Dict[str, jnp.ndarray],
 
 
 def _shard_loss(params: LinearParams, shard: Dict[str, jnp.ndarray],
-                num_rows: int, objective: str
+                num_rows: int, objective: str,
+                margin_path: str = "segment"
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(weighted loss sum, weight sum) for one local shard. (L2 is applied
-    as decoupled weight decay in the update, not in the loss.)"""
+    as decoupled weight decay in the update, not in the loss.)
+
+    margin_path (CSR shards only): "segment" rides the segment-sum matvec;
+    "dense" materializes the shard dense-first (ops/sparse.csr_to_dense —
+    the MXU on-ramp, whose impl the DCT_CSR_TO_DENSE env can switch to the
+    Pallas kernel) and takes one matmul. The materialization depends only
+    on batch data, never on params, so autodiff does not differentiate
+    through the formatting kernel."""
     if "x" in shard:  # dense layout: one MXU matvec
         margin = shard["x"].astype(jnp.float32) @ params.w + params.b
+    elif margin_path == "dense":
+        from dmlc_core_tpu.ops.sparse import csr_to_dense
+        dense = csr_to_dense(shard["row"], shard["col"], shard["val"],
+                             num_rows, params.w.shape[0])
+        margin = dense @ params.w + params.b
     else:
         margin = csr_matvec(shard["row"], shard["col"], shard["val"],
                             params.w, num_rows) + params.b
@@ -98,13 +111,18 @@ class LinearLearner(DataParallelModel):
 
     def __init__(self, num_features: int, mesh: Optional[Mesh] = None,
                  objective: str = "logistic", learning_rate: float = 0.1,
-                 l2: float = 0.0, axis_name: str = "data"):
+                 l2: float = 0.0, axis_name: str = "data",
+                 margin_path: str = "segment"):
         self.num_features = num_features
         self.mesh = mesh
         self.objective = objective
         self.learning_rate = learning_rate
         self.l2 = l2
         self.axis_name = axis_name
+        # "segment" | "dense": see _shard_loss — "dense" is the MXU
+        # on-ramp whose formatting impl DCT_CSR_TO_DENSE can switch to
+        # the Pallas kernel (opt-in device-side batch formatting)
+        self.margin_path = margin_path
         self._step_fn = None
 
     def init(self, seed: int = 0) -> LinearParams:
@@ -120,7 +138,8 @@ class LinearLearner(DataParallelModel):
 
     # -- DataParallelModel hooks (the step harness lives in models/_dp.py) --
     def _shard_loss(self, params, shard, rows_per_shard):
-        return _shard_loss(params, shard, rows_per_shard, self.objective)
+        return _shard_loss(params, shard, rows_per_shard, self.objective,
+                           self.margin_path)
 
     def _apply(self, params, grads, denom):
         lr, l2 = self.learning_rate, self.l2

@@ -33,6 +33,15 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _vma_of(*operands) -> frozenset:
+    """Union of the operands' varying-manual-axes sets (empty outside
+    shard_map) — the one place that touches the jax vma probing API."""
+    vma = set()
+    for op in operands:
+        vma |= set(getattr(jax.typeof(op), "vma", ()) or ())
+    return frozenset(vma)
+
+
 def _csr_scatter_kernel(row_ref, col_ref, val_ref, out_ref, *, chunk: int):
     step = pl.program_id(0)
 
@@ -78,9 +87,16 @@ def _csr_to_dense_call(row, col, val, num_rows: int, num_features: int,
         val = jnp.pad(val, (0, pad))
 
     grid = nnz_pad // chunk
+    # under shard_map's varying-type discipline the kernel output varies
+    # over the same mesh axes its inputs do; jax requires that declared
+    # on the out_shape (vma is absent/empty outside shard_map)
+    vma = _vma_of(row, col, val)
+    out_sds = (jax.ShapeDtypeStruct((R_pad, F_pad), jnp.float32, vma=vma)
+               if vma else jax.ShapeDtypeStruct((R_pad, F_pad),
+                                                jnp.float32))
     out = pl.pallas_call(
         functools.partial(_csr_scatter_kernel, chunk=chunk),
-        out_shape=jax.ShapeDtypeStruct((R_pad, F_pad), jnp.float32),
+        out_shape=out_sds,
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((chunk,), lambda i: (i,)),
@@ -108,6 +124,20 @@ def csr_to_dense_pallas(row: jnp.ndarray, col: jnp.ndarray,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if interpret:
+        # Interpret mode re-traces the kernel BODY as jax ops; inside a
+        # shard_map that trace runs under the varying-type checker, whose
+        # internal iotas/gathers cannot be made to match the inputs' vma.
+        # The real (Mosaic) path has no such trace — the pallas_call
+        # lowers as one opaque primitive with vma declared on its
+        # out_shape. So under shard_map, interpret mode stands in with
+        # the numerically identical XLA scatter; kernel-correctness tests
+        # run it outside shard_map, and the dry run proves the REAL
+        # composed path by exporting shard_map+Mosaic for the TPU target.
+        if _vma_of(row, col, val):
+            from dmlc_core_tpu.ops.sparse import csr_to_dense
+            return csr_to_dense(row, col, jnp.asarray(val, jnp.float32),
+                                num_rows, num_features, impl="xla")
     return _csr_to_dense_call(row, col, jnp.asarray(val, jnp.float32),
                               int(num_rows), int(num_features), int(chunk),
                               bool(interpret))

@@ -14,6 +14,7 @@ shard, and segment ids never cross shards by construction
 
 from __future__ import annotations
 
+import os
 
 import jax
 import jax.numpy as jnp
@@ -46,10 +47,31 @@ def csr_matmul_dense(row: jnp.ndarray, col: jnp.ndarray, val: jnp.ndarray,
 
 
 def csr_to_dense(row: jnp.ndarray, col: jnp.ndarray, val: jnp.ndarray,
-                 num_rows: int, num_features: int) -> jnp.ndarray:
+                 num_rows: int, num_features: int,
+                 impl: "str | None" = None) -> jnp.ndarray:
     """Materialize a dense [num_rows, num_features] shard — the MXU on-ramp
     for dense-ish data (e.g. HIGGS's 28 columns): downstream matmuls tile
-    onto the systolic array instead of scatter units."""
+    onto the systolic array instead of scatter units.
+
+    impl: "xla" (scatter-add, the default), "pallas" (the scatter-as-
+    matmul TPU kernel, ops/pallas_kernels.py), or None to read the
+    DCT_CSR_TO_DENSE env var (trace-time; the opt-in switch for the
+    device-side batch-formatting path)."""
+    if impl is None:
+        impl = os.environ.get("DCT_CSR_TO_DENSE", "xla")
+    if impl == "pallas":
+        # the kernel accumulates in f32 on the MXU: a silent f64/int cast
+        # would change results beyond epsilon vs the XLA path, breaking
+        # the drop-in-switch contract — refuse instead
+        if jnp.asarray(val).dtype != jnp.float32:
+            raise ValueError(
+                f"csr_to_dense impl='pallas' supports float32 values only "
+                f"(got {jnp.asarray(val).dtype}); use impl='xla'")
+        from dmlc_core_tpu.ops.pallas_kernels import csr_to_dense_pallas
+        return csr_to_dense_pallas(row, col, val, num_rows, num_features)
+    if impl != "xla":
+        raise ValueError(f"unknown csr_to_dense impl {impl!r} "
+                         "(expected 'xla' or 'pallas')")
     dense = jnp.zeros((num_rows + 1, num_features), dtype=val.dtype)
     dense = dense.at[row, col].add(val)
     return dense[:num_rows]
