@@ -144,6 +144,24 @@ TextParserBase<IndexType>::TextParserBase(InputSplit* source, int nthread)
     : source_(source), nthread_(DefaultThreads(nthread)) {}
 
 template <typename IndexType>
+TextParserBase<IndexType>::~TextParserBase() {
+  {
+    std::lock_guard<std::mutex> lk(pool_mu_);
+    pool_stop_ = true;
+  }
+  pool_cv_.notify_all();
+  for (auto& t : pool_) t.join();
+}
+
+template <typename IndexType>
+void TextParserBase<IndexType>::EnsurePool(int workers) {
+  while (static_cast<int>(pool_.size()) < workers) {
+    int i = static_cast<int>(pool_.size());
+    pool_.emplace_back([this, i] { WorkerLoop(i); });
+  }
+}
+
+template <typename IndexType>
 void TextParserBase<IndexType>::BeforeFirst() {
   source_->BeforeFirst();
   blocks_.clear();
@@ -187,6 +205,35 @@ void ValidateBlock(const RowBlockContainer<IndexType>& b) {
 }  // namespace
 
 template <typename IndexType>
+void TextParserBase<IndexType>::WorkerLoop(int i) {
+  uint64_t seen = 0;
+  for (;;) {
+    std::unique_lock<std::mutex> lk(pool_mu_);
+    pool_cv_.wait(lk, [&] {
+      return pool_stop_ ||
+             (pool_generation_ != seen && i < pool_active_);
+    });
+    if (pool_stop_) return;
+    seen = pool_generation_;
+    // worker i owns slice i+1 (slice 0 runs on the calling thread)
+    const char* b = (*round_cuts_)[i + 1];
+    const char* e = (*round_cuts_)[i + 2];
+    auto* out = &(*round_blocks_)[i + 1];
+    auto* err = &(*round_errors_)[i + 1];
+    lk.unlock();
+    try {
+      this->ParseBlock(b, e, out);
+      ValidateBlock(*out);
+      out->UpdateMax();
+    } catch (...) {
+      *err = std::current_exception();
+    }
+    lk.lock();
+    if (++pool_done_ == pool_active_) done_cv_.notify_one();
+  }
+}
+
+template <typename IndexType>
 bool TextParserBase<IndexType>::FillBlocks(
     std::vector<RowBlockContainer<IndexType>>* blocks) {
   InputSplit::Blob chunk;
@@ -216,20 +263,34 @@ bool TextParserBase<IndexType>::FillBlocks(
   for (int i = 1; i < nworker; ++i) {
     if (cuts[i] < cuts[i - 1]) cuts[i] = cuts[i - 1];
   }
-  std::vector<std::thread> workers;
+  // fan out slices 1..n-1 to the persistent pool; slice 0 parses on this
+  // thread (spawning fresh threads per chunk would tax every chunk ~100 us
+  // per worker — the pool signals instead)
   std::vector<std::exception_ptr> errors(nworker);
-  for (int i = 0; i < nworker; ++i) {
-    workers.emplace_back([this, &cuts, blocks, &errors, i] {
-      try {
-        this->ParseBlock(cuts[i], cuts[i + 1], &(*blocks)[i]);
-        ValidateBlock((*blocks)[i]);
-        (*blocks)[i].UpdateMax();
-      } catch (...) {
-        errors[i] = std::current_exception();
-      }
-    });
+  EnsurePool(nworker - 1);
+  {
+    std::lock_guard<std::mutex> lk(pool_mu_);
+    round_cuts_ = &cuts;
+    round_blocks_ = blocks;
+    round_errors_ = &errors;
+    pool_done_ = 0;
+    pool_active_ = nworker - 1;
+    ++pool_generation_;
   }
-  for (auto& t : workers) t.join();
+  pool_cv_.notify_all();
+  std::exception_ptr my_error;
+  try {
+    ParseBlock(cuts[0], cuts[1], &(*blocks)[0]);
+    ValidateBlock((*blocks)[0]);
+    (*blocks)[0].UpdateMax();
+  } catch (...) {
+    my_error = std::current_exception();
+  }
+  {
+    std::unique_lock<std::mutex> lk(pool_mu_);
+    done_cv_.wait(lk, [&] { return pool_done_ == pool_active_; });
+  }
+  if (my_error != nullptr) std::rethrow_exception(my_error);
   for (auto& e : errors) {
     if (e != nullptr) std::rethrow_exception(e);  // reference OMPException
   }

@@ -1,0 +1,42 @@
+// Full native-pipeline benchmark: file -> InputSplit(prefetch) ->
+// ThreadedParser -> consumed blocks, all in C++ — the stage between the
+// ParseBlock microbench (bench_parse.cc) and the Python e2e number
+// (bench.py --parse-only). The spread between the three locates the
+// pipeline overhead: IO+split+threading here, ctypes/Python above.
+// Build: make -C cpp benchpipeline
+// Run:   ./dmlc_core_tpu/_native/bench_pipeline FILE [nthread] [reps]
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+
+#include "../src/parser.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s FILE [nthread] [reps]\n", argv[0]);
+    return 2;
+  }
+  const char* path = argv[1];
+  int nthread = argc > 2 ? atoi(argv[2]) : 1;
+  int reps = argc > 3 ? atoi(argv[3]) : 5;
+  using Clock = std::chrono::steady_clock;
+  double best = 1e30;
+  size_t rows = 0, bytes = 0;
+  for (int i = 0; i < reps; ++i) {
+    auto t0 = Clock::now();
+    auto parser = std::unique_ptr<dct::Parser<uint32_t>>(
+        dct::Parser<uint32_t>::Create(path, 0, 1, "libsvm", nthread,
+                                      /*threaded=*/true));
+    rows = 0;
+    while (const auto* b = parser->NextBlock()) {
+      rows += b->Size();
+    }
+    bytes = parser->BytesRead();
+    double dt = std::chrono::duration<double>(Clock::now() - t0).count();
+    if (dt < best) best = dt;
+  }
+  printf("pipeline  %7.1f MB/s  %9.0f rows/s  (%zu rows, %.1f MB, "
+         "nthread=%d, best of %d)\n",
+         bytes / best / 1e6, rows / best, rows, bytes / 1e6, nthread, reps);
+  return 0;
+}
