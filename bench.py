@@ -164,6 +164,14 @@ def ensure_libfm_dataset(rows: int) -> str:
     return path
 
 
+# the binary ingest lanes and their one-time converters — the single
+# source for the headline-lane path picker, the subprocess device lanes,
+# and the host-side lane rates
+BINARY_LANES = (("rec", ensure_rec_dataset),
+                ("crec", ensure_crec_dataset),
+                ("recd", ensure_drec_dataset))
+
+
 def text_lane_probe(path: str, rows: int, nthread: int, fmt: str,
                     fmt_args: str = "") -> dict:
     """Host parse throughput for a text lane (prefetch + parse pipeline —
@@ -478,10 +486,8 @@ def main() -> None:
     # the headline lane's own file: text for libsvm, converted for rec/recd
     # — every reported number (rows/s, MB/s, parse probe) uses this file
     lane_fmt = args.format
-    lane_path = {"libsvm": lambda: path,
-                 "rec": lambda: ensure_rec_dataset(rows),
-                 "crec": lambda: ensure_crec_dataset(rows),
-                 "recd": lambda: ensure_drec_dataset(rows)}[lane_fmt]()
+    lane_path = (path if lane_fmt == "libsvm"
+                 else dict(BINARY_LANES)[lane_fmt](rows))
     size_mb = os.path.getsize(lane_path) / 1e6
 
     from dmlc_core_tpu.io.native import NativeParser
@@ -625,10 +631,8 @@ def main() -> None:
             # crushes the short binary-ingest epochs; a fresh process
             # measures each lane the way a real job would see it
             import subprocess
-            for lane_name, ensure in (("rec_lane", ensure_rec_dataset),
-                                      ("crec_lane", ensure_crec_dataset),
-                                      ("recd_lane", ensure_drec_dataset)):
-                fmt2 = lane_name.split("_")[0]
+            for fmt2, ensure in BINARY_LANES:
+                lane_name = fmt2 + "_lane"
                 ensure(rows)
                 try:
                     out = subprocess.run(
@@ -709,6 +713,26 @@ def main() -> None:
     # on a degraded parse-only run when the tunnel is down (the r04 round
     # lost them by nesting them in the device branch).
     if args.format == "libsvm":
+        # host-side rates for the binary lanes (deserialize for rec,
+        # batch assembly for crec/recd — parse_rows_per_sec's per-format
+        # path): on a device outage the subprocess device lanes above are
+        # skipped entirely, and these rows keep the lanes' HOST half
+        # measured (best of 2 passes each; rows/s). A failure here must
+        # not lose the already-measured headline (same posture as the
+        # subprocess lanes).
+        if not args.no_rec_lane:
+            try:
+                extras["host_lane_rates"] = {
+                    fmt: round(max(
+                        parse_rows_per_sec(
+                            ensure(rows), rows, args.threads, fmt=fmt,
+                            dense_dtype=args.dense_dtype)[0]
+                        for _ in range(2)), 1)
+                    for fmt, ensure in BINARY_LANES}
+                print(f"# host lane rates: {extras['host_lane_rates']}",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 - report, don't die
+                extras["host_lane_rates"] = {"error": str(e)[-300:]}
         extras["csv_lane"] = text_lane_probe(
             ensure_csv_dataset(rows), rows, args.threads, "csv",
             "?format=csv&label_column=0")

@@ -169,12 +169,14 @@ class TlsProxy:
         return f"127.0.0.1:{self._srv.server_address[1]}"
 
     def start(self) -> str:
+        """Serve on a daemon thread; returns the ``host:port`` address."""
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         name="dct-tls-proxy", daemon=True)
         self._thread.start()
         return self.address
 
     def stop(self) -> None:
+        """Shut the relay down and release its listening socket."""
         self._srv.shutdown()
         self._srv.server_close()
         if self._thread is not None:

@@ -33,6 +33,7 @@ MODULE_GROUPS = [
         "dmlc_core_tpu.data",
         "dmlc_core_tpu.io.native",
         "dmlc_core_tpu.io.convert",
+        "dmlc_core_tpu.io.tls_proxy",
     ]),
     ("TPU device bridge", [
         "dmlc_core_tpu.tpu.device_iter",
@@ -52,6 +53,7 @@ MODULE_GROUPS = [
         "dmlc_core_tpu.parallel.ring",
         "dmlc_core_tpu.parallel.pipeline_parallel",
         "dmlc_core_tpu.parallel.distributed",
+        "dmlc_core_tpu.parallel.varying",
     ]),
     ("Distributed launch", [
         "dmlc_core_tpu.tracker.submit",
