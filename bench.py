@@ -172,6 +172,16 @@ BINARY_LANES = (("rec", ensure_rec_dataset),
                 ("recd", ensure_drec_dataset))
 
 
+def _load_baseline():
+    """bench_baseline.json as a dict, or None when absent."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_baseline.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 def text_lane_probe(path: str, rows: int, nthread: int, fmt: str,
                     fmt_args: str = "") -> dict:
     """Host parse throughput for a text lane (prefetch + parse pipeline —
@@ -707,6 +717,8 @@ def main() -> None:
             print(f"# pallas csr->dense: {extras['pallas_csr_to_dense']}",
                   file=sys.stderr)
 
+    baseline = _load_baseline()  # one read serves the parity ratios + vs
+
     # the remaining BASELINE.md target rows: csv-with-prefetch MB/s,
     # libfm rows/s, and the RecordIO write+read round-trip. These are pure
     # HOST probes (no device stage) so they run UNCONDITIONALLY — including
@@ -740,22 +752,37 @@ def main() -> None:
             ensure_libfm_dataset(rows), rows, args.threads, "libfm")
         extras["recordio_roundtrip"] = recordio_roundtrip_probe(
             records=20000 if args.smoke else 200000)
+        # parity ratios vs the same-machine reference build
+        # (bench_baseline.json parity_rows, measured by
+        # scripts/ref_bench.cc; the recordio row is engine-level on both
+        # sides there — the probe above measures the Python binding).
+        # Guarded: a stale/hand-edited baseline must not cost the
+        # already-measured headline.
+        try:
+            pr = (baseline or {}).get("parity_rows") or {}
+            ref_csv = pr.get("reference_csv_mb_per_sec")
+            ref_fm = pr.get("reference_libfm_rows_per_sec")
+            if ref_csv:
+                extras["csv_lane"]["vs_reference"] = round(
+                    extras["csv_lane"]["mb_per_sec"] / ref_csv, 3)
+            if ref_fm:
+                extras["libfm_lane"]["vs_reference"] = round(
+                    extras["libfm_lane"]["rows_per_sec"] / ref_fm, 3)
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            extras["vs_reference_error"] = str(e)[-200:]
         print(f"# csv {extras['csv_lane']['mb_per_sec']} MB/s, "
               f"libfm {extras['libfm_lane']['rows_per_sec']:.0f} "
               f"rows/s, recordio rt "
               f"{extras['recordio_roundtrip']['records_per_sec']:.0f} "
               f"rec/s", file=sys.stderr)
 
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "bench_baseline.json")
     vs = None
-    if os.path.exists(baseline_path) and lane_fmt == "libsvm":
+    if baseline is not None and lane_fmt == "libsvm":
         # the recorded baseline is the reference's TEXT parse-to-host rate;
         # the rec lane has no reference analog, so it reports no ratio
-        with open(baseline_path) as f:
-            base = json.load(f)
-        # scale: baseline measured on the 200k dataset; rows/s is size-stable
-        vs = round(rps / base["reference_rows_per_sec"], 3)
+        # (scale: baseline measured on the 200k dataset; rows/s is
+        # size-stable)
+        vs = round(rps / baseline["reference_rows_per_sec"], 3)
 
     print(f"# {rows} rows ({size_mb:.1f} MB {lane_fmt}) in {dt:.3f}s = "
           f"{size_mb / dt:.1f} MB/s (median of "

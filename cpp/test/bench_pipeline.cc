@@ -8,13 +8,57 @@
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
+#include <string>
 
 #include "../src/parser.h"
+#include "../src/recordio.h"
+
+namespace {
+
+// `bench_pipeline rt N PAYLOAD PATH`: native RecordIO write+read
+// round-trip — the BASELINE.md parity row measured engine-to-engine
+// (the Python-facade probe in bench.py pays one ctypes call per record,
+// which measures the binding, not the format).
+int RoundTrip(int n, int payload, const char* path) {
+  using Clock = std::chrono::steady_clock;
+  std::string blob(payload, 'x');
+  for (int i = 0; i < payload; ++i) blob[i] = static_cast<char>(i & 0xff);
+  auto t0 = Clock::now();
+  {
+    std::unique_ptr<dct::Stream> fo(dct::Stream::Create(path, "w"));
+    dct::RecordIOWriter w(fo.get());
+    for (int i = 0; i < n; ++i) w.WriteRecord(blob.data(), blob.size());
+  }
+  double t_write = std::chrono::duration<double>(Clock::now() - t0).count();
+  t0 = Clock::now();
+  size_t got = 0;
+  {
+    std::unique_ptr<dct::Stream> fi(dct::Stream::Create(path, "r"));
+    dct::RecordIOReader r(fi.get());
+    std::string rec;
+    while (r.NextRecord(&rec)) ++got;
+  }
+  double t_read = std::chrono::duration<double>(Clock::now() - t0).count();
+  printf("recordio_rt %9.0f rec/s  (write %.0f, read %.0f, %zu recs, "
+         "payload %d)\n", got / (t_write + t_read), n / t_write,
+         got / t_read, got, payload);
+  return got == static_cast<size_t>(n) ? 0 : 1;
+}
+
+}  // namespace
 
 int main(int argc, char** argv) {
   if (argc < 2) {
-    fprintf(stderr, "usage: %s FILE [nthread] [reps]\n", argv[0]);
+    fprintf(stderr, "usage: %s FILE [nthread] [reps] | %s rt N PAYLOAD "
+            "PATH\n", argv[0], argv[0]);
     return 2;
+  }
+  if (std::string(argv[1]) == "rt") {
+    if (argc < 5) {
+      fprintf(stderr, "usage: %s rt N PAYLOAD PATH\n", argv[0]);
+      return 2;
+    }
+    return RoundTrip(atoi(argv[2]), atoi(argv[3]), argv[4]);
   }
   const char* path = argv[1];
   int nthread = argc > 2 ? atoi(argv[2]) : 1;

@@ -1,0 +1,72 @@
+// Same-machine reference measurements for the BASELINE.md parity rows:
+// csv MB/s, libfm rows/s (Parser::Create -> ThreadedParser like the
+// reference's own consumers), and the RecordIO write+read round-trip.
+#include <chrono>
+#include <cstdio>
+#include <string>
+
+#include <dmlc/data.h>
+#include <dmlc/io.h>
+#include <dmlc/recordio.h>
+
+using Clock = std::chrono::steady_clock;
+
+static double secs(Clock::time_point a, Clock::time_point b) {
+  return std::chrono::duration<double>(b - a).count();
+}
+
+static void bench_parser(const char* name, const char* path,
+                         const char* ftype, size_t fsize) {
+  double best = 1e30;
+  size_t rows = 0;
+  for (int rep = 0; rep < 3; ++rep) {
+    auto t0 = Clock::now();
+    dmlc::Parser<unsigned>* p =
+        dmlc::Parser<unsigned>::Create(path, 0, 1, ftype);
+    rows = 0;
+    while (p->Next()) rows += p->Value().size;
+    delete p;
+    double dt = secs(t0, Clock::now());
+    if (dt < best) best = dt;
+  }
+  printf("%s: %.0f rows/s  %.1f MB/s (%zu rows, best of 3)\n", name,
+         rows / best, fsize / best / 1e6, rows);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 7) {
+    fprintf(stderr,
+            "usage: %s CSV_PATH CSV_BYTES LIBFM_PATH LIBFM_BYTES "
+            "RT_RECORDS RT_PAYLOAD\n", argv[0]);
+    return 2;
+  }
+  bench_parser("ref_csv", argv[1], "csv", atoll(argv[2]));
+  bench_parser("ref_libfm", argv[3], "libfm", atoll(argv[4]));
+  const int n = atoi(argv[5]);
+  const int payload = atoi(argv[6]);
+  std::string blob(payload, 'x');
+  for (int i = 0; i < payload; ++i) blob[i] = char(i & 0xff);
+  const char* tmp = "/tmp/ref_bench_rt.rec";
+  auto t0 = Clock::now();
+  {
+    dmlc::Stream* fo = dmlc::Stream::Create(tmp, "w");
+    dmlc::RecordIOWriter writer(fo);
+    for (int i = 0; i < n; ++i) writer.WriteRecord(blob);
+    delete fo;
+  }
+  double t_write = secs(t0, Clock::now());
+  t0 = Clock::now();
+  size_t got = 0;
+  {
+    dmlc::Stream* fi = dmlc::Stream::Create(tmp, "r");
+    dmlc::RecordIOReader reader(fi);
+    std::string rec;
+    while (reader.NextRecord(&rec)) ++got;
+    delete fi;
+  }
+  double t_read = secs(t0, Clock::now());
+  printf("ref_recordio_rt: %.0f rec/s (write %.0f, read %.0f, %zu recs, "
+         "payload %d)\n", got / (t_write + t_read), n / t_write,
+         got / t_read, got, payload);
+  return 0;
+}
