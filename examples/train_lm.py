@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Language-model training example: byte-level next-token prediction over
+the framework's parallelism lanes.
+
+Two flagship configurations, both driven from one script:
+
+  # DP x SP — ring attention for long sequences (seq sharded over "seq")
+  python examples/train_lm.py corpus.txt --mesh data=2,seq=4 --seq 2048
+
+  # DP x TP(+MoE) — Megatron splits + top-1 experts via GSPMD
+  python examples/train_lm.py corpus.txt --model tp --mesh data=2,model=4
+
+The corpus is any text/binary file; tokens are raw bytes (vocab 256), so
+there is no external tokenizer. Windows are sampled deterministically.
+Under dmlc-submit each host trains its own byte range of the corpus
+(process_part — the reference's distributed-read contract, composed with
+the chip-level mesh).
+
+Smoke-testable on CPU:  JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/train_lm.py README.md --mesh data=2,seq=4 --seq 256 \
+      --steps 3 --embed 32 --layers 1
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# same site-config workaround as examples/train.py: JAX_PLATFORMS must be
+# applied through jax.config to outrank platform-pinning site plugins
+if os.environ.get("JAX_PLATFORMS"):
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np  # noqa: E402
+
+
+def parse_mesh(spec: str):
+    """"data=2,seq=4" -> (("data", 2), ("seq", 4))."""
+    out = []
+    for part in spec.split(","):
+        name, _, n = part.partition("=")
+        out.append((name.strip(), int(n)))
+    return tuple(out)
+
+
+def load_part(path: str, part: int, npart: int, seq: int) -> np.ndarray:
+    """This host's byte slice of the corpus (read once, sampled per step)."""
+    size = os.path.getsize(path)
+    lo = size * part // npart
+    hi = size * (part + 1) // npart
+    span = hi - lo
+    if span < seq + 1:
+        raise SystemExit(f"corpus part {part}/{npart} has {span} bytes; "
+                         f"need at least seq+1={seq + 1}")
+    with open(path, "rb") as f:
+        f.seek(lo)
+        return np.frombuffer(f.read(span), np.uint8)
+
+
+def byte_windows(data: np.ndarray, seq: int, batch: int, rng) -> np.ndarray:
+    """[batch, seq+1] int32 windows sampled uniformly (the final window,
+    ending on the slice's last byte, included)."""
+    starts = rng.integers(0, data.size - seq, size=batch)
+    return np.stack([data[s:s + seq + 1] for s in starts]).astype(np.int32)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("corpus", help="any file; bytes are the tokens")
+    ap.add_argument("--model", default="lm", choices=("lm", "tp"),
+                    help="lm: DP x SP ring attention; tp: DP x TP + MoE")
+    ap.add_argument("--mesh", default="data=1,seq=1",
+                    help='axis spec, e.g. "data=2,seq=4" (lm) or '
+                         '"data=2,model=4" (tp)')
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="rows per step (0 = one per data-axis slice)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--embed", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--experts", type=int, default=0,
+                    help="tp only: MoE experts (0 = 2 per model slice)")
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    from jax.sharding import Mesh
+    from dmlc_core_tpu.parallel import init_from_env
+    from dmlc_core_tpu.tpu.sharding import process_part
+
+    init_from_env()  # multi-host: jax.distributed under dmlc-submit
+    axes = parse_mesh(args.mesh)
+    need = int(np.prod([n for _, n in axes]))
+    devs = jax.devices()
+    if len(devs) < need:
+        raise SystemExit(f"mesh {args.mesh} needs {need} devices, "
+                         f"have {len(devs)}")
+    mesh = Mesh(np.array(devs[:need]).reshape([n for _, n in axes]),
+                tuple(name for name, _ in axes))
+    names = dict(axes)
+    n_data = names.get("data", 1)
+    batch = args.batch or n_data
+    if batch % n_data:
+        raise SystemExit(f"--batch {batch} must divide by data={n_data}")
+    n_seq = names.get("seq", 1)
+    if args.seq % n_seq:
+        raise SystemExit(f"--seq {args.seq} must divide by seq={n_seq}")
+
+    if args.model == "lm":
+        from dmlc_core_tpu.models.transformer import (TransformerConfig,
+                                                      TransformerLM)
+        cfg = TransformerConfig(vocab=256, max_seq=args.seq,
+                                embed=args.embed, heads=args.heads,
+                                layers=args.layers)
+        model = TransformerLM(cfg, mesh, learning_rate=args.lr)
+    else:
+        from dmlc_core_tpu.models.tp_transformer import (TPTransformerConfig,
+                                                         TPTransformerLM)
+        n_model = names.get("model", 1)
+        # attention heads shard over the model axis: round up to the next
+        # multiple so every (heads, mesh) combination is valid, and say so
+        heads = -(-args.heads // n_model) * n_model
+        if heads != args.heads:
+            print(f"note: --heads {args.heads} rounded up to {heads} "
+                  f"(must divide by model={n_model})")
+        cfg = TPTransformerConfig(
+            vocab=256, max_seq=args.seq, embed=args.embed,
+            heads=heads, layers=args.layers,
+            moe_experts=args.experts or 2 * n_model)
+        model = TPTransformerLM(cfg, mesh, learning_rate=args.lr)
+
+    params = model.init(seed=args.seed)
+    part, npart = process_part()
+    data = load_part(args.corpus, part, npart, args.seq)
+    rng = np.random.default_rng(args.seed + part)
+    first = last = None
+    for step in range(args.steps):
+        w = byte_windows(data, args.seq, batch, rng)
+        params, loss = model.step(params, w[:, :-1], w[:, 1:])
+        last = float(loss)
+        if first is None:
+            first = last
+        print(f"step {step}: loss {last:.4f}", flush=True)
+    print(f"done: loss {first:.4f} -> {last:.4f} over {args.steps} steps "
+          f"(mesh {args.mesh}, seq {args.seq}, part {part}/{npart})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
