@@ -264,13 +264,16 @@ def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto",
     return rows / dt, dt
 
 
-def pallas_format_probe(batch_rows: int = 8192, features: int = 28,
+def pallas_format_probe(batch_rows: int = 1024, features: int = 28,
                         nnz_per_row: int = 28) -> dict:
     """Device-side CSR->dense batch formatting: the Pallas
     scatter-as-matmul kernel (ops/pallas_kernels.py) vs XLA scatter-add,
-    on a shard-sized problem. TPU-gated — interpret mode on CPU measures
-    nothing; the caller only invokes this when the device probe passed.
-    Values are cross-checked on device before timing."""
+    on a shard-sized problem. batch_rows is capped by the kernel's VMEM
+    working set (row_oh [R_pad, chunk] — csr_to_dense_pallas falls back
+    to XLA past it, which would silently time XLA against itself).
+    TPU-gated — interpret mode on CPU measures nothing; the caller only
+    invokes this when the device probe passed. Values are cross-checked
+    on device before timing."""
     import numpy as np
     import jax
     from dmlc_core_tpu.ops.pallas_kernels import csr_to_dense_pallas

@@ -33,6 +33,14 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _padded_shape(num_rows: int, num_features: int) -> "tuple[int, int]":
+    """The kernel's [R_pad, F_pad] block: rows to the f32 sublane multiple
+    (+1 sacrificial padding row), features to the lane width. Shared by
+    the call path and the VMEM guard so they cannot desynchronize."""
+    return (max(_round_up(num_rows + 1, 8), 8),
+            max(_round_up(num_features, 128), 128))
+
+
 def _vma_of(*operands) -> frozenset:
     """Union of the operands' varying-manual-axes sets (empty outside
     shard_map) — the one place that touches the jax vma probing API."""
@@ -76,8 +84,7 @@ def _csr_to_dense_call(row, col, val, num_rows: int, num_features: int,
     # pad to TPU-friendly shapes: rows to the f32 sublane multiple, features
     # to the lane width, nnz to whole chunks. nnz pads carry row ==
     # num_rows (the sacrificial row, sliced away below) and val == 0.
-    R_pad = max(_round_up(num_rows + 1, 8), 8)
-    F_pad = max(_round_up(num_features, 128), 128)
+    R_pad, F_pad = _padded_shape(num_rows, num_features)
     nnz = row.shape[0]
     nnz_pad = max(_round_up(nnz, chunk), chunk)
     if nnz_pad != nnz:
@@ -124,6 +131,18 @@ def csr_to_dense_pallas(row: jnp.ndarray, col: jnp.ndarray,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # the kernel's VMEM residents: the [R_pad, F_pad] accumulator (held
+    # across every grid step) plus the per-step one-hots row_oh
+    # [R_pad, chunk] and col_mix [chunk, F_pad]. Past ~12 MB combined they
+    # cannot fit (v5e VMEM is ~16 MB) and Mosaic would fail at compile —
+    # shards that large (or that skewed) take the XLA scatter instead of
+    # a cryptic lowering error
+    R_pad, F_pad = _padded_shape(num_rows, num_features)
+    vmem_bytes = 4 * (R_pad * F_pad + R_pad * chunk + chunk * F_pad)
+    if vmem_bytes > (12 << 20):
+        from dmlc_core_tpu.ops.sparse import csr_to_dense
+        return csr_to_dense(row, col, jnp.asarray(val, jnp.float32),
+                            num_rows, num_features, impl="xla")
     if interpret:
         # Interpret mode re-traces the kernel BODY as jax ops; inside a
         # shard_map that trace runs under the varying-type checker, whose

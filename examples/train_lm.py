@@ -124,11 +124,19 @@ def main() -> int:
                                                          TPTransformerLM)
         n_model = names.get("model", 1)
         # attention heads shard over the model axis: round up to the next
-        # multiple so every (heads, mesh) combination is valid, and say so
+        # multiple so every (heads, mesh) combination is valid, and say
+        # so. The rounded count must still divide --embed (head_dim =
+        # embed // heads) — fail with guidance instead of a reshape error
+        # deep inside jit.
         heads = -(-args.heads // n_model) * n_model
         if heads != args.heads:
             print(f"note: --heads {args.heads} rounded up to {heads} "
                   f"(must divide by model={n_model})")
+        if args.embed % heads:
+            raise SystemExit(
+                f"--embed {args.embed} must divide by heads={heads} "
+                f"(after rounding to the model axis); pick --embed as a "
+                f"multiple of {heads}")
         cfg = TPTransformerConfig(
             vocab=256, max_seq=args.seq, embed=args.embed,
             heads=heads, layers=args.layers,

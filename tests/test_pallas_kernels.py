@@ -109,6 +109,37 @@ def test_linear_dense_margin_path_matches_segment(tmp_path, monkeypatch):
     np.testing.assert_allclose(w_dense, w_seg, rtol=1e-5, atol=1e-7)
 
 
+def test_oversized_output_falls_back_to_xla():
+    # [R_pad, F_pad] f32 must stay VMEM-resident; a shard too large for
+    # that silently takes the XLA scatter with identical values
+    rng = np.random.default_rng(6)
+    R, F = 4096, 1024  # 16 MB accumulator > the 12 MB guard
+    row, col, val = random_csr(rng, R, F, 500)
+    got = csr_to_dense_pallas(row, col, val, R, F)
+    want = csr_to_dense(row, col, val, R, F)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_bench_probe_shape_stays_on_kernel(monkeypatch):
+    # bench.py's pallas probe shape must pass the VMEM guard — a silent
+    # fallback would time the XLA scatter against itself
+    import dmlc_core_tpu.ops.sparse as sparse_mod
+    from bench import pallas_format_probe
+    import inspect
+    R = inspect.signature(pallas_format_probe).parameters[
+        "batch_rows"].default
+
+    def boom(*a, **k):
+        raise AssertionError("probe shape fell back to the XLA scatter")
+
+    monkeypatch.setattr(sparse_mod, "csr_to_dense", boom)
+    rng = np.random.default_rng(2)
+    row, col, val = random_csr(rng, R, 28, R * 28)
+    out = csr_to_dense_pallas(row, col, val, R, 28)  # interpret on CPU
+    assert out.shape == (R, 28)
+
+
 def test_tpu_mosaic_lowering_exports():
     # the kernel must survive the real TPU lowering pipeline (Mosaic)
     # even on a host with no chip — block-spec/layout bugs surface here
