@@ -205,8 +205,8 @@ def text_lane_probe(path: str, rows: int, nthread: int, fmt: str,
             "mb_per_sec": round(os.path.getsize(path) / best / 1e6, 1)}
 
 
-def recordio_roundtrip_probe(records: int = 200000,
-                             payload: int = 256) -> dict:
+def recordio_roundtrip_probe(records: int = 200000, payload: int = 256,
+                             native: bool = True) -> dict:
     """RecordIO write+read round-trip records/s (BASELINE.md target row;
     reference analog: recordio_test.cc / the ImageNet .rec round-trip)."""
     import tempfile
@@ -229,10 +229,37 @@ def recordio_roundtrip_probe(records: int = 200000,
                 got += 1
         t_read = time.time() - t0
     assert got == records
-    return {"records_per_sec": round(records / (t_write + t_read), 1),
-            "write_records_per_sec": round(records / t_write, 1),
-            "read_records_per_sec": round(records / t_read, 1),
-            "payload_bytes": payload}
+    out = {"records_per_sec": round(records / (t_write + t_read), 1),
+           "write_records_per_sec": round(records / t_write, 1),
+           "read_records_per_sec": round(records / t_read, 1),
+           "payload_bytes": payload}
+    # ENGINE-level number alongside the Python-API one above (which pays
+    # a ctypes call per record): this is the rate comparable to the
+    # reference's C++ round-trip in bench_baseline.json parity_rows.
+    # `make` runs unconditionally (dependency-tracked: a no-op when fresh,
+    # a rebuild after C++ edits — never a stale engine). Skipped in smoke
+    # runs (native=False): a clean checkout would pay an -O3 build inside
+    # the CI path.
+    if not native:
+        return out
+    try:
+        import subprocess
+        repo = os.path.dirname(os.path.abspath(__file__))
+        binary = os.path.join(repo, "dmlc_core_tpu", "_native",
+                              "bench_pipeline")
+        subprocess.run(["make", "-C", os.path.join(repo, "cpp"),
+                        "benchpipeline"], check=True,
+                       capture_output=True, timeout=300)
+        with tempfile.TemporaryDirectory() as d2:
+            r = subprocess.run(
+                [binary, "rt", str(records), str(payload),
+                 os.path.join(d2, "rt.rec")],
+                capture_output=True, text=True, timeout=300, check=True)
+        # "recordio_rt   NNN rec/s  (write ..., read ..., ...)"
+        out["native_records_per_sec"] = float(r.stdout.split()[1])
+    except Exception as e:  # noqa: BLE001 - optional row, never fatal
+        out["native_error"] = str(e)[-200:]
+    return out
 
 
 def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto",
@@ -754,7 +781,8 @@ def main() -> None:
         extras["libfm_lane"] = text_lane_probe(
             ensure_libfm_dataset(rows), rows, args.threads, "libfm")
         extras["recordio_roundtrip"] = recordio_roundtrip_probe(
-            records=20000 if args.smoke else 200000)
+            records=20000 if args.smoke else 200000,
+            native=not args.smoke)
         # parity ratios vs the same-machine reference build
         # (bench_baseline.json parity_rows, measured by
         # scripts/ref_bench.cc; the recordio row is engine-level on both
@@ -771,6 +799,12 @@ def main() -> None:
             if ref_fm:
                 extras["libfm_lane"]["vs_reference"] = round(
                     extras["libfm_lane"]["rows_per_sec"] / ref_fm, 3)
+            ref_rt = pr.get("reference_recordio_rt_records_per_sec")
+            ours_rt = extras["recordio_roundtrip"].get(
+                "native_records_per_sec")
+            if ref_rt and ours_rt:
+                extras["recordio_roundtrip"]["vs_reference_native"] = \
+                    round(ours_rt / ref_rt, 3)
         except Exception as e:  # noqa: BLE001 - report, don't die
             extras["vs_reference_error"] = str(e)[-200:]
         print(f"# csv {extras['csv_lane']['mb_per_sec']} MB/s, "
