@@ -22,6 +22,7 @@ class MockHdfsState:
         self.fail_reads_after = None  # int: truncate OPEN bodies (retry test)
         self.requests = []       # (method, path) log
         self.port = None         # filled by serve(); used for redirect URLs
+        self.scheme = "http"     # "https" when serve() wraps TLS
         self.one_step_writes = False  # HttpFS-style: no redirect on writes
         # secure-cluster mode: every op must carry delegation=<this> and no
         # user.name (the WebHDFS token-auth contract)
@@ -79,8 +80,10 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
 
     def _redirect(self, extra=""):
         # bounce back to this same server on a "datanode" flavored URL
-        loc = (f"http://127.0.0.1:{self.state.port}{self.path}"
-               f"&datanode=true{extra}")
+        # (https when the mock serves TLS — secure WebHDFS issues https
+        # redirect Locations)
+        loc = (f"{self.state.scheme}://127.0.0.1:{self.state.port}"
+               f"{self.path}&datanode=true{extra}")
         self.send_response(307)
         self.send_header("Location", loc)
         self.send_header("Content-Length", "0")
@@ -245,11 +248,18 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
 
-def serve():
-    """Start the mock server; returns (state, port, shutdown_fn)."""
+def serve(ssl_context=None):
+    """Start the mock server; returns (state, port, shutdown_fn).
+
+    With `ssl_context` the mock speaks TLS and issues https redirect
+    Locations — the secure-WebHDFS (swebhdfs) stand-in."""
     state = MockHdfsState()
     handler = type("Handler", (MockHdfsHandler,), {"state": state})
     server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    if ssl_context is not None:
+        server.socket = ssl_context.wrap_socket(server.socket,
+                                                server_side=True)
+        state.scheme = "https"
     state.port = server.server_address[1]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()

@@ -221,20 +221,27 @@ def test_tls_facade_autostarts_helper(tls_stack, monkeypatch):
     assert os.environ.get("DCT_TLS_PROXY")  # exported by ensure_tls_proxy
 
 
-def test_s3_full_surface_over_tls(cert_pair):
-    # fresh process: the native S3 singleton captures env at first use
+def _run_tls_worker(worker: str, strip_vars, ok_marker: str, cert_pair):
+    """Run a tests/<worker>.py subprocess (fresh process: the native
+    filesystem singletons capture env at first use) and assert its OK
+    marker."""
     import subprocess
     import sys
     cert_file, key_file = cert_pair
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {k: v for k, v in os.environ.items()
-           if k not in ("DCT_TLS_PROXY", "S3_ENDPOINT")}
+           if k not in ("DCT_TLS_PROXY",) + tuple(strip_vars)}
     out = subprocess.run(
-        [sys.executable, os.path.join(repo, "tests", "tls_s3_worker.py"),
+        [sys.executable, os.path.join(repo, "tests", worker),
          repo, cert_file, key_file],
         capture_output=True, text=True, timeout=120, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
-    assert "TLS_S3_OK" in out.stdout
+    assert ok_marker in out.stdout
+
+
+def test_s3_full_surface_over_tls(cert_pair):
+    _run_tls_worker("tls_s3_worker.py", ("S3_ENDPOINT",), "TLS_S3_OK",
+                    cert_pair)
 
 
 def test_uri_needs_tls_env_rules(monkeypatch):
@@ -256,6 +263,11 @@ def test_uri_needs_tls_env_rules(monkeypatch):
     monkeypatch.setenv("WEBHDFS_NAMENODE", "https://nn:9871")
     assert _uri_needs_tls("hdfs://cluster/x")
     assert _uri_needs_tls("/a.rec;https://host/b.rec")  # list member
+
+
+def test_webhdfs_secure_over_tls(cert_pair):
+    _run_tls_worker("tls_webhdfs_worker.py", ("WEBHDFS_NAMENODE",),
+                    "TLS_WEBHDFS_OK", cert_pair)
 
 
 def test_tls_unknown_ca_fails_clearly(tls_stack, monkeypatch):

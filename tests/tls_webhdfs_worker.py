@@ -1,0 +1,64 @@
+"""Subprocess worker: secure WebHDFS (https namenode + https redirects)
+through the TLS-terminating helper.
+
+Run by test_tls.py in a fresh process because the native WebHDFS
+singleton captures WEBHDFS_NAMENODE at first use. The mock namenode
+serves TLS and issues https datanode redirect Locations — the client
+must route BOTH hops through the helper (cpp/src/hdfs_filesys.cc
+ResolveHttpRoute on the target and on every ParseHttpUrl'd redirect).
+
+argv: repo_root cert_file key_file
+"""
+
+import os
+import ssl
+import sys
+
+
+def main() -> int:
+    repo, cert, key = sys.argv[1], sys.argv[2], sys.argv[3]
+    sys.path.insert(0, repo)
+    import tests.mock_webhdfs as mock_webhdfs
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    state, port, shutdown = mock_webhdfs.serve(ssl_context=ctx)
+
+    os.environ["WEBHDFS_NAMENODE"] = f"https://127.0.0.1:{port}"
+    os.environ["DCT_TLS_CA"] = cert
+
+    from dmlc_core_tpu.io.tls_proxy import TlsProxy
+    with TlsProxy() as addr:
+        os.environ["DCT_TLS_PROXY"] = addr
+        from dmlc_core_tpu.io.native import (NativeParser, NativeStream,
+                                             path_info)
+
+        lines = [f"{i % 2} 0:{i}.25 2:{i}.5" for i in range(153)]
+        corpus = ("\n".join(lines) + "\n").encode()
+        state.files["/data/train.libsvm"] = corpus
+
+        # hdfs:// with no URI host resolves the https namenode from env
+        assert path_info("hdfs:///data/train.libsvm") == (len(corpus),
+                                                          False)
+        with NativeStream("hdfs:///data/train.libsvm", "r") as s:
+            assert s.read_all() == corpus, "read mismatch"
+        # the read followed an https datanode redirect through the relay
+        opens = [p for m, p in state.requests if "op=OPEN" in p]
+        assert any("datanode" in p for p in opens), state.requests
+
+        rows = sum(b.num_rows
+                   for b in NativeParser("hdfs:///data/train.libsvm"))
+        assert rows == 153, rows
+
+        # two-step CREATE/APPEND write over TLS (namenode + datanode hops)
+        with NativeStream("hdfs:///out/copy.bin", "w") as s:
+            s.write(corpus)
+        assert state.files["/out/copy.bin"] == corpus
+
+    shutdown()
+    print("TLS_WEBHDFS_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
