@@ -189,11 +189,17 @@ class MockAzureHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
 
-def serve():
-    """Start the mock server; returns (state, port, shutdown_fn)."""
+def serve(ssl_context=None):
+    """Start the mock server; returns (state, port, shutdown_fn).
+
+    With `ssl_context` the mock speaks TLS — the stand-in for real Azure
+    Blob endpoints, which enforce secure transfer."""
     state = MockAzureState()
     handler = type("Handler", (MockAzureHandler,), {"state": state})
     server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    if ssl_context is not None:
+        server.socket = ssl_context.wrap_socket(server.socket,
+                                                server_side=True)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return state, server.server_address[1], server.shutdown

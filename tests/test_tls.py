@@ -270,6 +270,13 @@ def test_webhdfs_secure_over_tls(cert_pair):
                     "TLS_WEBHDFS_OK", cert_pair)
 
 
+def test_azure_full_surface_over_tls(cert_pair):
+    _run_tls_worker("tls_azure_worker.py",
+                    ("AZURE_ENDPOINT", "AZURE_STORAGE_ACCOUNT",
+                     "AZURE_STORAGE_ACCESS_KEY"),
+                    "TLS_AZURE_OK", cert_pair)
+
+
 def test_tls_unknown_ca_fails_clearly(tls_stack, monkeypatch):
     state, base = tls_stack
     state.objects["/x.bin"] = b"data"

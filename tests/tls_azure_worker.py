@@ -1,0 +1,70 @@
+"""Subprocess worker: the Azure Blob surface over TLS.
+
+Run by test_tls.py in a fresh process because the native Azure singleton
+captures its env config at first use. Serves the SharedKey-verifying
+mock behind TLS (real Azure enforces secure transfer), routes the native
+client through the TLS-terminating helper, and exercises signed read /
+parser composition / block write / listing end to end.
+
+argv: repo_root cert_file key_file
+"""
+
+import os
+import ssl
+import sys
+
+
+def main() -> int:
+    repo, cert, key = sys.argv[1], sys.argv[2], sys.argv[3]
+    sys.path.insert(0, repo)
+    import tests.mock_azure as mock_azure
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    state, port, shutdown = mock_azure.serve(ssl_context=ctx)
+
+    os.environ["AZURE_STORAGE_ACCOUNT"] = mock_azure.ACCOUNT
+    os.environ["AZURE_STORAGE_ACCESS_KEY"] = mock_azure.KEY_B64
+    os.environ["AZURE_ENDPOINT"] = f"https://127.0.0.1:{port}"
+    os.environ["DCT_TLS_CA"] = cert
+
+    from dmlc_core_tpu.io.tls_proxy import TlsProxy
+    with TlsProxy() as addr:
+        os.environ["DCT_TLS_PROXY"] = addr
+        from dmlc_core_tpu.io.native import (NativeParser, NativeStream,
+                                             list_directory)
+
+        lines = [f"{i % 2} 0:{i}.5 1:-{i}.25" for i in range(117)]
+        corpus = ("\n".join(lines) + "\n").encode()
+        state.blobs[("cont", "data/train.libsvm")] = corpus
+
+        with NativeStream("azure://cont/data/train.libsvm", "r") as s:
+            assert s.read_all() == corpus, "read mismatch"
+        rows = sum(b.num_rows
+                   for b in NativeParser("azure://cont/data/train.libsvm"))
+        assert rows == 117, rows
+
+        with NativeStream("azure://cont/out/copy.bin", "w") as s:
+            s.write(corpus)
+        assert state.blobs[("cont", "out/copy.bin")] == corpus
+        entries = list_directory("azure://cont/out")
+        assert any(e[0].endswith("copy.bin") for e in entries), entries
+
+        # block-blob write: >4 MB (cpp/src/azure_filesys.cc kBlockSize)
+        # forces Put Block + Put Block List through the relay — their
+        # comp=block/blocklist query params ride the SharedKey canonical
+        # resource, exactly what a proxy mangling queries would break
+        big = bytes(range(256)) * ((5 << 20) // 256)
+        with NativeStream("azure://cont/out/big.bin", "w") as s:
+            s.write(big)
+        assert state.blobs[("cont", "out/big.bin")] == big
+        assert any("comp=block" in p for m, p in state.requests
+                   if m == "PUT"), "block path never fired"
+
+    shutdown()
+    print("TLS_AZURE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
