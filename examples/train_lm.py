@@ -87,7 +87,20 @@ def main() -> int:
                     help="tp only: MoE experts (0 = 2 per model slice)")
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint", default="",
+                    help="URI to write params + step each --ckpt-every "
+                         "steps (any stream scheme: file/s3/hdfs/azure). "
+                         "Multi-host runs write one file per host "
+                         "(.partK suffix appended). Saving params whose "
+                         "model axis spans HOSTS is out of this "
+                         "example's scope (shards must be addressable)")
+    ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--resume", default="",
+                    help="checkpoint URI (same base as --checkpoint) to "
+                         "restore before training")
     args = ap.parse_args()
+    if args.checkpoint and args.ckpt_every <= 0:
+        raise SystemExit("--ckpt-every must be positive")
 
     import jax
     from jax.sharding import Mesh
@@ -143,20 +156,57 @@ def main() -> int:
             moe_experts=args.experts or 2 * n_model)
         model = TPTransformerLM(cfg, mesh, learning_rate=args.lr)
 
+    from dmlc_core_tpu.utils import restore_checkpoint, save_checkpoint
+
     params = model.init(seed=args.seed)
     part, npart = process_part()
+    # one checkpoint file per host: concurrent writers to a shared URI
+    # would clobber each other
+    suffix = f".part{part}of{npart}" if npart > 1 else ""
+    # the data stream's identity: a resume under a different one would
+    # silently continue on different windows (same pattern as train.py)
+    identity = {"model": args.model, "mesh": args.mesh,
+                "seq": str(args.seq), "batch": str(batch),
+                "seed": str(args.seed), "part": f"{part}/{npart}"}
+    start = 0
+    if args.resume:
+        # restore onto the template's shardings (preemption recovery)
+        params, start, extra = restore_checkpoint(args.resume + suffix,
+                                                  like=params)
+        mismatch = {k: (extra.get(k), v) for k, v in identity.items()
+                    if extra.get(k) != v}
+        if mismatch:
+            raise SystemExit(
+                f"checkpoint was written under a different run identity "
+                f"(stored vs now): {mismatch}")
+        print(f"resumed from {args.resume}{suffix} at step {start}")
     data = load_part(args.corpus, part, npart, args.seq)
     rng = np.random.default_rng(args.seed + part)
+    # replay the sampler to the resume point so the data stream continues
+    # where the interrupted run left off (windows are rng-driven)
+    for _ in range(start):
+        rng.integers(0, data.size - args.seq, size=batch)
     first = last = None
-    for step in range(args.steps):
+    for step in range(start, args.steps):
         w = byte_windows(data, args.seq, batch, rng)
         params, loss = model.step(params, w[:, :-1], w[:, 1:])
         last = float(loss)
         if first is None:
             first = last
         print(f"step {step}: loss {last:.4f}", flush=True)
-    print(f"done: loss {first:.4f} -> {last:.4f} over {args.steps} steps "
-          f"(mesh {args.mesh}, seq {args.seq}, part {part}/{npart})")
+        if args.checkpoint and (step + 1) % args.ckpt_every == 0:
+            save_checkpoint(args.checkpoint + suffix, params,
+                            step=step + 1, extra=identity)
+    if (args.checkpoint and last is not None
+            and args.steps % args.ckpt_every != 0):  # not already saved
+        save_checkpoint(args.checkpoint + suffix, params,
+                        step=args.steps, extra=identity)
+    if last is None:
+        print(f"nothing to do: resume step {start} >= --steps {args.steps}")
+        return 0
+    print(f"done: loss {first:.4f} -> {last:.4f} over steps "
+          f"{start}..{args.steps - 1} (mesh {args.mesh}, seq {args.seq}, "
+          f"part {part}/{npart})")
     return 0
 
 

@@ -140,3 +140,25 @@ def test_lm_example_dp_tp_moe(tmp_path):
     losses = [float(ln.rsplit(" ", 1)[1]) for ln in out.splitlines()
               if ln.startswith("step ")]
     assert len(losses) == 3 and losses[-1] < losses[0], out
+
+
+def test_lm_example_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """Checkpoint at step 2, resume, finish: the resumed run's remaining
+    losses must equal the uninterrupted run's (params restored onto the
+    mesh + the window sampler replayed to the cut point)."""
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_bytes(b"to be or not to be that is the question. " * 300)
+    common = [str(corpus), "--mesh", "data=2,seq=2", "--seq", "128",
+              "--embed", "32", "--layers", "1"]
+
+    base = _run_lm(common + ["--steps", "4"], cwd=str(tmp_path))
+    base_losses = [ln for ln in base.splitlines() if ln.startswith("step ")]
+
+    ckpt = str(tmp_path / "lm.ckpt")
+    _run_lm(common + ["--steps", "2", "--checkpoint", ckpt,
+                      "--ckpt-every", "2"], cwd=str(tmp_path))
+    out = _run_lm(common + ["--steps", "4", "--resume", ckpt],
+                  cwd=str(tmp_path))
+    tail = [ln for ln in out.splitlines() if ln.startswith("step ")]
+    assert [ln.split()[1] for ln in tail] == ["2:", "3:"], out
+    assert tail == base_losses[2:], (tail, base_losses)
