@@ -20,7 +20,10 @@ Prints ONE JSON line:
   contiguous single-buffer ceiling is also reported
   (attainable_contiguous_bytes_per_sec) so both denominators are visible
   (VERDICT r2 weak 7). extras.bottleneck names the binding stage.
-- extras.thread_scaling: host-parse rows/s at 1/2/4 parse workers.
+- extras.thread_scaling: host-parse rows/s at 1/2/4/8 parse workers;
+  extras.parse_pipeline_occupancy carries the multi-chunk pipeline's
+  per-stage counters (avg chunks in flight, reader/worker/consumer waits)
+  at each worker count so a flat scaling row names its binding stage.
 - --format=rec: binary-ingest lane — the dataset is converted once to
   RecordIO-framed row blocks (rows_to_recordio) and ingested through the
   native "rec" parser, isolating the north star from the text-parse
@@ -184,8 +187,8 @@ def _load_baseline():
 
 def text_lane_probe(path: str, rows: int, nthread: int, fmt: str,
                     fmt_args: str = "") -> dict:
-    """Host parse throughput for a text lane (prefetch + parse pipeline —
-    NativeParser always rides PrefetchSplit + ThreadedParser). No device
+    """Host parse throughput for a text lane (multi-chunk parse pipeline —
+    NativeParser rides the native reader/worker/reassembly stages). No device
     stage, so it runs in-process (the subprocess isolation of the binary
     lanes exists for tunnel-latency effects that only device sessions
     see). Best of 3 passes."""
@@ -263,11 +266,14 @@ def recordio_roundtrip_probe(records: int = 200000, payload: int = 256,
 
 
 def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto",
-                       dense_dtype: str = "bfloat16"
+                       dense_dtype: str = "bfloat16",
+                       stats_out: "dict | None" = None
                        ) -> "tuple[float, float]":
     """(rows/s, seconds) host-side throughput at a given worker count:
     parse for the text/rec lanes, batch assembly for the zero-parse dense
-    lane (which has no parse stage — nthread does not apply)."""
+    lane (which has no parse stage — nthread does not apply). When
+    `stats_out` is given, the parse pipeline's occupancy counters
+    (NativeParser.pipeline_stats) are copied into it."""
     t0 = time.time()
     got = 0
     if fmt in ("recd", "crec"):
@@ -286,6 +292,8 @@ def parse_rows_per_sec(path: str, rows: int, nthread: int, fmt: str = "auto",
         with NativeParser(path, nthread=nthread, fmt=fmt) as p:
             for blk in p:
                 got += blk.num_rows
+            if stats_out is not None:
+                stats_out.update(p.pipeline_stats() or {})
     dt = time.time() - t0
     assert got == rows, f"row count mismatch: {got} != {rows}"
     return rows / dt, dt
@@ -539,12 +547,29 @@ def main() -> None:
     extras = {}
     if not args.no_scaling_table and lane_fmt not in ("recd", "crec"):
         # recd/crec have no parse stage to thread-scale (ingest is framing
-        # + memcpy on one staging thread): the table would be three
-        # identical passes, so it is omitted for those lanes
-        extras["thread_scaling"] = {
-            str(t): round(parse_rows_per_sec(lane_path, rows, t,
-                                             fmt=lane_fmt)[0], 1)
-            for t in (1, 2, 4)}
+        # + memcpy on one staging thread): the table would be four
+        # identical passes, so it is omitted for those lanes. Extended to
+        # 8 threads so scaling regressions past the 4-worker point stay
+        # visible; per-stage pipeline occupancy (reader/worker/consumer
+        # waits, avg chunks in flight) rides along so a flat row is
+        # attributable to a stage, not a guess.
+        scaling = {}
+        occupancy = {}
+        for t in (1, 2, 4, 8):
+            stats = {}
+            scaling[str(t)] = round(
+                parse_rows_per_sec(lane_path, rows, t, fmt=lane_fmt,
+                                   stats_out=stats)[0], 1)
+            if stats:
+                occupancy[str(t)] = {
+                    k: stats[k] for k in
+                    ("occupancy_avg", "inflight_peak", "capacity",
+                     "workers", "chunks_read", "reader_waits",
+                     "worker_waits", "consumer_waits")
+                    if k in stats}
+        extras["thread_scaling"] = scaling
+        if occupancy:
+            extras["parse_pipeline_occupancy"] = occupancy
 
     if not args.parse_only and not os.environ.get("DCT_SKIP_DEVICE_PROBE"):
         # The device backend is reached through a tunnel that can go down;

@@ -16,6 +16,7 @@
 #include "dense_rec.h"
 #include "filesys.h"
 #include "hdfs_filesys.h"
+#include "http.h"
 #include "input_split.h"
 #include "parser.h"
 #include "recordio.h"
@@ -115,6 +116,15 @@ int dct_webhdfs_set_auth_header(const char* header) {
     dct::WebHdfsFileSystem::GetInstance()->set_auth_header(
         header == nullptr ? "" : header);
   });
+}
+
+// Publish the TLS-terminating helper's "host:port" address to the native
+// https router (http.h SetTlsProxyOverride). The binding calls this instead
+// of mutating DCT_TLS_PROXY: setenv after native request threads exist
+// races their getenv (glibc UB). Empty/NULL clears back to the env fallback.
+int dct_set_tls_proxy(const char* addr) {
+  return Guard(
+      [&] { dct::SetTlsProxyOverride(addr == nullptr ? "" : addr); });
 }
 
 // ---------------------------------------------------------------- streams --
@@ -329,20 +339,30 @@ typedef void* dct_parser_t;
 
 
 
-int dct_parser_create(const char* uri, unsigned part, unsigned npart,
-                      const char* format, int nthread, int threaded,
-                      int index64, dct_parser_t* out) {
+// chunks_in_flight bounds the threaded pipeline's outstanding chunks
+// (0 = auto-size to the worker count; parser.cc DefaultChunksInFlight).
+int dct_parser_create_ex(const char* uri, unsigned part, unsigned npart,
+                         const char* format, int nthread, int threaded,
+                         int index64, int chunks_in_flight,
+                         dct_parser_t* out) {
   return Guard([&] {
     auto* h = new ParserHandle();
     if (index64 != 0) {
       h->p64 = dct::Parser<uint64_t>::Create(uri, part, npart, format, nthread,
-                                             threaded != 0);
+                                             threaded != 0, chunks_in_flight);
     } else {
       h->p32 = dct::Parser<uint32_t>::Create(uri, part, npart, format, nthread,
-                                             threaded != 0);
+                                             threaded != 0, chunks_in_flight);
     }
     *out = h;
   });
+}
+
+int dct_parser_create(const char* uri, unsigned part, unsigned npart,
+                      const char* format, int nthread, int threaded,
+                      int index64, dct_parser_t* out) {
+  return dct_parser_create_ex(uri, part, npart, format, nthread, threaded,
+                              index64, 0, out);
 }
 
 int dct_parser_next_block(dct_parser_t h, dct_rowblock_t* out, int* has) {
@@ -375,6 +395,45 @@ int dct_parser_bytes_read(dct_parser_t h, size_t* out) {
   return Guard([&] {
     auto* ph = static_cast<ParserHandle*>(h);
     *out = ph->p64 != nullptr ? ph->p64->BytesRead() : ph->p32->BytesRead();
+  });
+}
+
+// Mirror of dct::ParsePipelineStats (parser.h) — occupancy/stall counters
+// of the multi-chunk parse pipeline, for bench/ops introspection.
+typedef struct {
+  uint64_t chunks_read;
+  uint64_t blocks_delivered;
+  uint64_t reader_waits;
+  uint64_t worker_waits;
+  uint64_t consumer_waits;
+  uint64_t inflight_now;
+  uint64_t inflight_peak;
+  uint64_t inflight_sum;
+  uint64_t capacity;
+  uint64_t workers;
+} dct_parse_pipeline_stats_t;
+
+// *has = 0 when the handle carries no pipeline (threaded=0 parsers).
+int dct_parser_pipeline_stats(dct_parser_t h, dct_parse_pipeline_stats_t* out,
+                              int* has) {
+  return Guard([&] {
+    auto* ph = static_cast<ParserHandle*>(h);
+    dct::ParsePipelineStats s;
+    const bool ok = ph->p64 != nullptr ? ph->p64->GetPipelineStats(&s)
+                                       : ph->p32->GetPipelineStats(&s);
+    *has = ok ? 1 : 0;
+    if (ok) {
+      out->chunks_read = s.chunks_read;
+      out->blocks_delivered = s.blocks_delivered;
+      out->reader_waits = s.reader_waits;
+      out->worker_waits = s.worker_waits;
+      out->consumer_waits = s.consumer_waits;
+      out->inflight_now = s.inflight_now;
+      out->inflight_peak = s.inflight_peak;
+      out->inflight_sum = s.inflight_sum;
+      out->capacity = s.capacity;
+      out->workers = s.workers;
+    }
   });
 }
 

@@ -133,7 +133,14 @@ class ManualEvent {
   template <typename Rep, typename Period>
   bool wait_for(std::chrono::duration<Rep, Period> d) {
     std::unique_lock<std::mutex> lock(mu_);
-    return cv_.wait_for(lock, d, [this] { return set_; });
+    // system_clock deadline on purpose: the steady-clock wait_for of
+    // libstdc++ 10 lowers to pthread_cond_clockwait, which the matching
+    // TSan runtime does not intercept — it then misses the unlock inside
+    // the wait and reports a bogus "double lock of a mutex" on this gate.
+    // The system-clock path (pthread_cond_timedwait) is instrumented. A
+    // wall-clock jump at worst stretches one poll of a shutdown gate.
+    return cv_.wait_until(lock, std::chrono::system_clock::now() + d,
+                          [this] { return set_; });
   }
 
  private:

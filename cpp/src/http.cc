@@ -9,6 +9,7 @@
 #include <cctype>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 
 namespace dct {
 
@@ -248,6 +249,32 @@ std::string StripUrlScheme(std::string* s) {
   return scheme;
 }
 
+// Explicitly published TLS-helper address (dct_set_tls_proxy). Reading the
+// DCT_TLS_PROXY env per request raced the Python side's setenv (glibc
+// getenv/setenv are not thread-safe against each other; request threads
+// crashed mid-scan when the io facade auto-started its helper), so the
+// binding now pushes the address through this mutex-guarded global and the
+// env is only the operator-configured fallback, set before any native
+// thread exists.
+namespace {
+std::mutex g_tls_proxy_mu;
+std::string g_tls_proxy_override;
+}  // namespace
+
+void SetTlsProxyOverride(const std::string& addr) {
+  std::lock_guard<std::mutex> lk(g_tls_proxy_mu);
+  g_tls_proxy_override = addr;
+}
+
+std::string TlsProxyAddress() {
+  {
+    std::lock_guard<std::mutex> lk(g_tls_proxy_mu);
+    if (!g_tls_proxy_override.empty()) return g_tls_proxy_override;
+  }
+  const char* proxy = std::getenv("DCT_TLS_PROXY");
+  return proxy == nullptr ? "" : proxy;
+}
+
 HttpRoute ResolveHttpRoute(const std::string& scheme, const std::string& host,
                            int port) {
   HttpRoute r;
@@ -257,8 +284,8 @@ HttpRoute ResolveHttpRoute(const std::string& scheme, const std::string& host,
     r.connect_port = port;
     return r;
   }
-  const char* proxy = std::getenv("DCT_TLS_PROXY");
-  if (proxy == nullptr || *proxy == '\0') {
+  const std::string proxy = TlsProxyAddress();
+  if (proxy.empty()) {
     throw Error(
         "https origin but the built-in client is plain-HTTP and "
         "DCT_TLS_PROXY is unset. Start the TLS-terminating helper "

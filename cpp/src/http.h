@@ -56,9 +56,16 @@ struct HttpRoute {
 };
 
 // Resolve (scheme, host, port) to a route. Throws for https origins when
-// DCT_TLS_PROXY is unset (the built-in socket client is plain-HTTP).
+// no TLS helper is published (the built-in socket client is plain-HTTP).
 HttpRoute ResolveHttpRoute(const std::string& scheme, const std::string& host,
                            int port);
+
+// Publish the TLS helper address ("host:port"; empty clears) explicitly —
+// the race-free alternative to mutating DCT_TLS_PROXY after native threads
+// exist (C ABI: dct_set_tls_proxy). The override wins over the env var.
+void SetTlsProxyOverride(const std::string& addr);
+// Current helper address: the override, else DCT_TLS_PROXY, else "".
+std::string TlsProxyAddress();
 
 // "host" or "host:port", omitting the scheme's default port. Signing
 // clients (S3 SIG4) MUST build their signed Host with this same formula —

@@ -15,12 +15,27 @@
 #ifndef DCT_NUMPARSE_H_
 #define DCT_NUMPARSE_H_
 
+#include <cerrno>
 #include <charconv>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <string>
 #include <type_traits>
 
 #include "base.h"
+
+// libstdc++ < 11 ships integer from_chars only; the exact-fallback lane
+// then routes through strtod on a bounded NUL-terminated copy (slow path
+// only — the fast path above it is unchanged).
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+#define DCT_HAS_FP_FROM_CHARS 1
+#else
+#define DCT_HAS_FP_FROM_CHARS 0
+#include <locale.h>  // newlocale/strtod_l: locale-pinned fallback parsing
+
+#include <cmath>  // isinf: narrowing range check in the fallback
+#endif
 
 namespace dct {
 
@@ -160,6 +175,69 @@ inline bool ParseFloatFast(const char* p, const char* end, const char** out,
   return true;
 }
 
+#if !DCT_HAS_FP_FROM_CHARS
+// strtod_l-based stand-in for FP from_chars on old libstdc++: copy the
+// candidate token into a NUL-terminated buffer (strtod needs one; the
+// source region is not), parse under a pinned "C" locale (plain strtod
+// honors LC_NUMERIC — a host process that set a comma-decimal locale
+// would silently misparse "3.14" as 3), and map the result back. Mirrors
+// from_chars semantics the parsers rely on: no leading whitespace/'+'
+// accepted (callers strip '+'; strtod would skip \n\r\v\f into the next
+// line), range errors fail, consumed length is reported exactly.
+inline locale_t CNumericLocale() {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", static_cast<locale_t>(0));
+  return loc;
+}
+
+template <typename T>
+inline std::from_chars_result FromCharsFloat(const char* q, const char* end,
+                                             T* v) {
+  if (q == end || IsBlankChar(*q) || *q == '+' ||
+      *q == '\n' || *q == '\r' || *q == '\v' || *q == '\f') {
+    return {q, std::errc::invalid_argument};
+  }
+  // from_chars(general) never consumes hex ("0x10" parses as 0, stopping at
+  // the 'x'); strtod would. Short-circuit that shape to keep parity.
+  {
+    const char* h = q + (*q == '-' ? 1 : 0);
+    if (end - h >= 2 && h[0] == '0' && (h[1] == 'x' || h[1] == 'X')) {
+      *v = static_cast<T>(*q == '-' ? -0.0 : 0.0);
+      return {h + 1, std::errc()};
+    }
+  }
+  char stack_buf[64];
+  std::string heap_buf;
+  const char* buf;
+  size_t len = static_cast<size_t>(end - q);
+  if (len < sizeof stack_buf) {
+    std::memcpy(stack_buf, q, len);
+    stack_buf[len] = '\0';
+    buf = stack_buf;
+  } else {
+    heap_buf.assign(q, end);  // pathological token length; rare by design
+    buf = heap_buf.c_str();
+  }
+  errno = 0;
+  char* parse_end = nullptr;
+  const double d = strtod_l(buf, &parse_end, CNumericLocale());
+  if (parse_end == buf) return {q, std::errc::invalid_argument};
+  if (errno == ERANGE) return {q, std::errc::result_out_of_range};
+  *v = static_cast<T>(d);
+  if (sizeof(T) < sizeof(double)) {
+    // strtod range-checks against DOUBLE; narrowing must fail the same
+    // way from_chars<float> does — overflow to inf (unless the token was
+    // a literal infinity) and underflow past the narrower type's
+    // smallest subnormal both report out-of-range instead of silently
+    // returning inf / 0
+    const double back = static_cast<double>(*v);
+    if ((back == 0.0 && d != 0.0) || (std::isinf(back) && !std::isinf(d))) {
+      return {q, std::errc::result_out_of_range};
+    }
+  }
+  return {q + (parse_end - buf), std::errc()};
+}
+#endif  // !DCT_HAS_FP_FROM_CHARS
+
 }  // namespace detail
 
 // Parse one value of T from [p, end); advance *out past it.
@@ -174,7 +252,11 @@ inline bool ParseNum(const char* p, const char* end, const char** out, T* v) {
   if (q != end && *q == '+') ++q;
   std::from_chars_result r;
   if constexpr (std::is_floating_point_v<T>) {
+#if DCT_HAS_FP_FROM_CHARS
     r = std::from_chars(q, end, *v, std::chars_format::general);
+#else
+    r = detail::FromCharsFloat(q, end, v);
+#endif
   } else {
     r = std::from_chars(q, end, *v);
   }

@@ -234,6 +234,53 @@ void TextParserBase<IndexType>::WorkerLoop(int i) {
 }
 
 template <typename IndexType>
+bool TextParserBase<IndexType>::ReadChunk(std::vector<char>* buf) {
+  // Fast lane: when the split chain's top exposes the chunk-producer
+  // interface (ByteSplit / IndexedRecordIOSplit — the pipelined Create
+  // skips the PrefetchSplit wrapper precisely so it does), fill the task
+  // buffer straight from the stream: zero extra copies.
+  if (!chunk_source_probed_) {
+    chunk_source_ = dynamic_cast<RecordChunkSource*>(source_.get());
+    chunk_source_probed_ = true;
+  }
+  if (chunk_source_ != nullptr) {
+    if (!chunk_source_->FillChunkBuffer(buf)) return false;
+    bytes_read_.fetch_add(buf->size(), std::memory_order_relaxed);
+    return true;
+  }
+  // wrapped chains (ShuffleSplit, PrefetchSplit): the Blob aliases the
+  // split's internal buffer (invalid after the next NextChunk), so an
+  // in-flight chunk needs its own copy — a memcpy at memory bandwidth
+  // against parsing at ~1% of it
+  InputSplit::Blob chunk;
+  if (!source_->NextChunk(&chunk)) return false;
+  bytes_read_.fetch_add(chunk.size, std::memory_order_relaxed);
+  buf->assign(static_cast<const char*>(chunk.dptr),
+              static_cast<const char*>(chunk.dptr) + chunk.size);
+  return true;
+}
+
+template <typename IndexType>
+void TextParserBase<IndexType>::TileCuts(const char* begin, const char* end,
+                                         int nslice,
+                                         std::vector<const char*>* cuts) {
+  // Tile the chunk into unit-aligned slices: cut i starts at the first
+  // parse-unit head at/after i*size/n — line heads for text formats,
+  // RecordIO magics for binary (FindUnitBoundary; the reference tiles text
+  // backward via BackFindEndLine — forward tiling yields the same cover).
+  const size_t size = static_cast<size_t>(end - begin);
+  cuts->resize(nslice + 1);
+  (*cuts)[0] = begin;
+  (*cuts)[nslice] = end;
+  for (int i = 1; i < nslice; ++i) {
+    (*cuts)[i] = FindUnitBoundary(begin, begin + size * i / nslice, end);
+  }
+  for (int i = 1; i < nslice; ++i) {
+    if ((*cuts)[i] < (*cuts)[i - 1]) (*cuts)[i] = (*cuts)[i - 1];
+  }
+}
+
+template <typename IndexType>
 bool TextParserBase<IndexType>::FillBlocks(
     std::vector<RowBlockContainer<IndexType>>* blocks) {
   InputSplit::Blob chunk;
@@ -241,8 +288,7 @@ bool TextParserBase<IndexType>::FillBlocks(
   bytes_read_.fetch_add(chunk.size, std::memory_order_relaxed);
   const char* begin = static_cast<const char*>(chunk.dptr);
   const char* end = begin + chunk.size;
-  int nworker = nthread_;
-  if (chunk.size < (size_t(1) << 16)) nworker = 1;  // small chunk: no fan-out
+  const int nworker = SlicesFor(chunk.size);
   blocks->resize(nworker);
   if (nworker == 1) {
     ParseBlock(begin, end, &(*blocks)[0]);
@@ -250,19 +296,8 @@ bool TextParserBase<IndexType>::FillBlocks(
     (*blocks)[0].UpdateMax();
     return true;
   }
-  // Tile the chunk into unit-aligned slices: cut i starts at the first
-  // parse-unit head at/after i*size/n — line heads for text formats,
-  // RecordIO magics for binary (FindUnitBoundary; the reference tiles text
-  // backward via BackFindEndLine — forward tiling yields the same cover).
-  std::vector<const char*> cuts(nworker + 1);
-  cuts[0] = begin;
-  cuts[nworker] = end;
-  for (int i = 1; i < nworker; ++i) {
-    cuts[i] = FindUnitBoundary(begin, begin + chunk.size * i / nworker, end);
-  }
-  for (int i = 1; i < nworker; ++i) {
-    if (cuts[i] < cuts[i - 1]) cuts[i] = cuts[i - 1];
-  }
+  std::vector<const char*> cuts;
+  TileCuts(begin, end, nworker, &cuts);
   // fan out slices 1..n-1 to the persistent pool; slice 0 parses on this
   // thread (spawning fresh threads per chunk would tax every chunk ~100 us
   // per worker — the pool signals instead)
@@ -878,73 +913,312 @@ void DiskCacheParser<IndexType>::BeforeFirst() {
 }
 
 // --------------------------------------------------------------------------
-template <typename IndexType>
-ThreadedParser<IndexType>::ThreadedParser(TextParserBase<IndexType>* base,
-                                          size_t capacity)
-    : base_(base), pipe_(capacity) {}
+// PipelinedParser: reader -> (chunk, slice) work queue -> worker pool ->
+// ordered head-of-line reassembly. See parser.h for the stage diagram.
+namespace {
+// Default in-flight chunk bound: enough outstanding slices to ride over a
+// straggler slice plus one chunk being read and one being consumed, capped
+// so host RSS stays bounded (each task holds ~chunk bytes raw + ~chunk
+// bytes parsed).
+size_t DefaultChunksInFlight(int workers) {
+  return static_cast<size_t>(
+      std::max(3, std::min(workers + 2, 10)));
+}
+}  // namespace
 
 template <typename IndexType>
-ThreadedParser<IndexType>::~ThreadedParser() {
-  if (current_ != nullptr) pipe_.Recycle(&current_);
-  pipe_.Shutdown();
+PipelinedParser<IndexType>::PipelinedParser(TextParserBase<IndexType>* base,
+                                            int chunks_in_flight)
+    : base_(base),
+      capacity_(chunks_in_flight > 0
+                    ? static_cast<size_t>(chunks_in_flight)
+                    : DefaultChunksInFlight(base->num_threads())),
+      nworker_(base->num_threads()) {
+  if (capacity_ < 2) capacity_ = 2;  // 1 would re-serialize read vs parse
 }
 
 template <typename IndexType>
-void ThreadedParser<IndexType>::EnsureStarted() {
+PipelinedParser<IndexType>::~PipelinedParser() {
+  StopThreads();
+  if (current_ != nullptr) delete current_;
+  for (ChunkTask* t : free_) delete t;
+}
+
+template <typename IndexType>
+void PipelinedParser<IndexType>::Start() {
   if (started_) return;
-  pipe_.Init(
-      [this](Cell** cell) {
-        if (*cell == nullptr) *cell = new Cell();
-        (*cell)->next = 0;
-        return base_->FillBlocks(&(*cell)->blocks);
-      },
-      [this] { base_->BeforeFirst(); });
+  stop_ = false;
+  eof_ = false;
+  reader_ = std::thread([this] { ReaderLoop(); });
+  workers_.reserve(nworker_);
+  for (int i = 0; i < nworker_; ++i) {
+    workers_.emplace_back([this] { WorkerLoop(); });
+  }
   started_ = true;
 }
 
 template <typename IndexType>
-void ThreadedParser<IndexType>::BeforeFirst() {
-  if (current_ != nullptr) pipe_.Recycle(&current_);
-  if (started_) {
-    pipe_.BeforeFirst();
-  } else {
-    // unstarted pipelines begin from the source's current state, so the
-    // rewind must reach the split chain synchronously (shuffled splits
-    // resample their permutation in BeforeFirst — see
-    // PrefetchSplit::BeforeFirst for the same rule)
-    base_->BeforeFirst();
+void PipelinedParser<IndexType>::StopThreads() {
+  if (!started_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = true;
+  }
+  space_cv_.notify_all();
+  work_cv_.notify_all();
+  done_cv_.notify_all();
+  reader_.join();
+  for (auto& w : workers_) w.join();
+  workers_.clear();
+  started_ = false;
+  stop_ = false;
+  // reclaim in-flight tasks (buffers kept for the next epoch); claim_ holds
+  // aliases of inflight_ entries, never owned tasks
+  for (ChunkTask* t : inflight_) free_.push_back(t);
+  inflight_.clear();
+  claim_.clear();
+  // an unconsumed reader error dies with the round it belongs to: the
+  // consumer either already rethrew it (failed_ set, restart forbidden) or
+  // abandoned the epoch — a stale pointer here would poison the NEXT
+  // epoch's first NextBlock
+  reader_error_ = nullptr;
+}
+
+template <typename IndexType>
+void PipelinedParser<IndexType>::ReaderLoop() {
+  try {
+    for (;;) {
+      ChunkTask* t = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (inflight_.size() >= capacity_) {
+          reader_waits_.fetch_add(1, std::memory_order_relaxed);
+          space_cv_.wait(lk, [&] {
+            return stop_ || inflight_.size() < capacity_;
+          });
+        }
+        if (stop_) return;
+        if (!free_.empty()) {
+          t = free_.back();
+          free_.pop_back();
+        }
+      }
+      if (t == nullptr) t = new ChunkTask();
+      bool more;
+      try {
+        more = base_->ReadChunk(&t->data);
+        if (more) {
+          const int nslice = base_->SlicesFor(t->data.size());
+          t->nslice = nslice;
+          t->next_slice = 0;
+          t->remaining = nslice;
+          t->next_serve = 0;
+          // keep blocks at their high-water count so a small final chunk
+          // does not free the recycled capacity of unused slices
+          if (static_cast<int>(t->blocks.size()) < nslice) {
+            t->blocks.resize(nslice);
+          }
+          t->errors.assign(nslice, nullptr);
+          base_->TileCuts(t->data.data(), t->data.data() + t->data.size(),
+                          nslice, &t->cuts);
+        }
+      } catch (...) {
+        // reclaim the in-flight task (read OR slice-prep may have thrown)
+        // so the destructor's free-list sweep still owns it
+        std::lock_guard<std::mutex> lk(mu_);
+        free_.push_back(t);
+        throw;
+      }
+      if (!more) {
+        std::lock_guard<std::mutex> lk(mu_);
+        free_.push_back(t);
+        eof_ = true;
+        done_cv_.notify_all();
+        return;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (stop_) {
+          free_.push_back(t);
+          return;
+        }
+        inflight_.push_back(t);
+        claim_.push_back(t);
+        chunks_read_.fetch_add(1, std::memory_order_relaxed);
+        inflight_sum_.fetch_add(inflight_.size(),
+                                std::memory_order_relaxed);
+        // single writer (this thread, under mu_); atomic only for the
+        // lock-free stats read
+        if (inflight_.size() >
+            inflight_peak_.load(std::memory_order_relaxed)) {
+          inflight_peak_.store(inflight_.size(), std::memory_order_relaxed);
+        }
+      }
+      work_cv_.notify_all();
+    }
+  } catch (...) {
+    std::lock_guard<std::mutex> lk(mu_);
+    reader_error_ = std::current_exception();
+    done_cv_.notify_all();
   }
 }
 
 template <typename IndexType>
-RowBlockContainer<IndexType>* ThreadedParser<IndexType>::NextMutable() {
-  EnsureStarted();
+void PipelinedParser<IndexType>::WorkerLoop() {
+  for (;;) {
+    ChunkTask* t;
+    int slice;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (claim_.empty() && !stop_) {
+        worker_waits_.fetch_add(1, std::memory_order_relaxed);
+        work_cv_.wait(lk, [&] { return stop_ || !claim_.empty(); });
+      }
+      if (stop_) return;
+      // oldest chunk first: finishing the head chunk unblocks the ordered
+      // consumer soonest, and chunks complete roughly in input order
+      t = claim_.front();
+      slice = t->next_slice++;
+      if (t->next_slice == t->nslice) claim_.pop_front();
+    }
+    try {
+      RowBlockContainer<IndexType>* out = &t->blocks[slice];
+      base_->ParseBlock(t->cuts[slice], t->cuts[slice + 1], out);
+      ValidateBlock(*out);
+      out->UpdateMax();
+    } catch (...) {
+      t->errors[slice] = std::current_exception();
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (--t->remaining == 0 && !inflight_.empty() &&
+          inflight_.front() == t) {
+        done_cv_.notify_all();
+      }
+    }
+  }
+}
+
+template <typename IndexType>
+void PipelinedParser<IndexType>::RecycleCurrent() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    free_.push_back(current_);
+    current_ = nullptr;
+  }
+  space_cv_.notify_one();
+}
+
+template <typename IndexType>
+RowBlockContainer<IndexType>* PipelinedParser<IndexType>::NextMutable() {
+  if (failed_) {
+    throw Error("parse pipeline is in a failed state after an earlier error");
+  }
+  Start();
   while (true) {
     if (current_ != nullptr) {
-      while (current_->next < current_->blocks.size()) {
-        RowBlockContainer<IndexType>* b =
-            &current_->blocks[current_->next++];
-        if (b->Size() != 0) return b;
+      while (current_->next_serve < static_cast<size_t>(current_->nslice)) {
+        const size_t i = current_->next_serve++;
+        if (current_->errors[i] != nullptr) {
+          // input-order rethrow: everything before this slice was already
+          // served, matching where a serial parse would have died
+          std::exception_ptr e = current_->errors[i];
+          failed_ = true;
+          StopThreads();
+          std::rethrow_exception(e);
+        }
+        RowBlockContainer<IndexType>* b = &current_->blocks[i];
+        if (b->Size() != 0) {
+          blocks_delivered_.fetch_add(1, std::memory_order_relaxed);
+          return b;
+        }
       }
-      pipe_.Recycle(&current_);
+      RecycleCurrent();
     }
-    if (!pipe_.Next(&current_)) return nullptr;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      bool waited = false;
+      done_cv_.wait(lk, [&] {
+        if (stop_) return true;
+        if (!inflight_.empty()) {
+          if (inflight_.front()->remaining == 0) return true;
+          waited = true;
+          return false;
+        }
+        if (eof_ || reader_error_ != nullptr) return true;
+        waited = true;
+        return false;
+      });
+      if (waited) consumer_waits_.fetch_add(1, std::memory_order_relaxed);
+      if (!inflight_.empty() && inflight_.front()->remaining == 0) {
+        current_ = inflight_.front();
+        inflight_.pop_front();
+      } else if (reader_error_ != nullptr) {
+        // all chunks admitted before the failure were drained above — the
+        // error surfaces exactly where the serial read would have died
+        std::exception_ptr e = reader_error_;
+        lk.unlock();
+        failed_ = true;
+        StopThreads();
+        std::rethrow_exception(e);
+      } else {
+        return nullptr;  // eof (or stop)
+      }
+    }
+    space_cv_.notify_one();  // popping the head freed an in-flight slot
   }
 }
 
 template <typename IndexType>
-const RowBlockContainer<IndexType>* ThreadedParser<IndexType>::NextBlock() {
+const RowBlockContainer<IndexType>* PipelinedParser<IndexType>::NextBlock() {
   return NextMutable();
 }
 
 template <typename IndexType>
-bool ThreadedParser<IndexType>::NextBlockMove(
+bool PipelinedParser<IndexType>::NextBlockMove(
     RowBlockContainer<IndexType>* out) {
   RowBlockContainer<IndexType>* b = NextMutable();
   if (b == nullptr) return false;
-  // swap hand-off: the recycled cell keeps out's old buffer capacity
+  // swap hand-off: the recycled task slot keeps out's old buffer capacity
   std::swap(*out, *b);
   b->Clear();
+  return true;
+}
+
+template <typename IndexType>
+void PipelinedParser<IndexType>::BeforeFirst() {
+  DCT_CHECK(!failed_)
+      << "cannot restart a parse pipeline after a parse error";
+  StopThreads();
+  if (current_ != nullptr) {
+    std::lock_guard<std::mutex> lk(mu_);
+    free_.push_back(current_);
+    current_ = nullptr;
+  }
+  eof_ = false;
+  // the rewind reaches the split chain synchronously (shuffled splits
+  // resample their permutation in BeforeFirst — see
+  // PrefetchSplit::BeforeFirst for the same rule); threads respawn lazily
+  // on the next NextBlock
+  base_->BeforeFirst();
+}
+
+template <typename IndexType>
+bool PipelinedParser<IndexType>::GetPipelineStats(
+    ParsePipelineStats* out) const {
+  out->chunks_read = chunks_read_.load(std::memory_order_relaxed);
+  out->blocks_delivered = blocks_delivered_.load(std::memory_order_relaxed);
+  out->reader_waits = reader_waits_.load(std::memory_order_relaxed);
+  out->worker_waits = worker_waits_.load(std::memory_order_relaxed);
+  out->consumer_waits = consumer_waits_.load(std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    out->inflight_now = inflight_.size();
+  }
+  out->inflight_peak = inflight_peak_.load(std::memory_order_relaxed);
+  out->inflight_sum = inflight_sum_.load(std::memory_order_relaxed);
+  out->capacity = capacity_;
+  out->workers = static_cast<uint64_t>(nworker_);
   return true;
 }
 
@@ -953,7 +1227,8 @@ template <typename IndexType>
 Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
                                              unsigned part, unsigned npart,
                                              const std::string& format,
-                                             int nthread, bool threaded) {
+                                             int nthread, bool threaded,
+                                             int chunks_in_flight) {
   URISpec spec(uri, part, npart);
   std::string fmt = format;
   if (fmt == "auto" || fmt.empty()) {
@@ -1031,6 +1306,12 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
       index_uri = it->second == "1" ? spec.uri + ".idx" : it->second;
     }
   }
+  // pipeline depth knob rides the same URI sugar so batcher/device lanes
+  // (which reach Create through their own C-ABI entry points) can tune it
+  // without a signature change
+  const int uri_cif = static_cast<int>(
+      parse_uarg("chunks_in_flight", 0, 1024, 0));
+  if (uri_cif > 0) chunks_in_flight = uri_cif;
   const bool rec_shuffle = parse_uarg("shuffle", 0, 1, 0) != 0;
   DCT_CHECK(!rec_shuffle || !index_uri.empty())
       << "?shuffle=1 needs ?index= (exact shuffling walks the record "
@@ -1041,20 +1322,26 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
   const size_t shuffle_batch = static_cast<size_t>(
       parse_uarg("shuffle_batch", 1, 1 << 20, 256));
 
+  // The pipelined parser's reader thread IS the prefetch stage, so layering
+  // PrefetchSplit under it would only add a second copy of every chunk and
+  // a thread hop (ReadChunk then fills task buffers directly through the
+  // RecordChunkSource fast lane). The synchronous parser keeps the
+  // prefetch wrapper — it is its only read/parse overlap.
+  const bool split_threaded = !threaded;
   InputSplit* split =
       index_uri.empty()
           ? InputSplit::Create(spec.uri, part, npart, split_type, "", false,
-                               shuffle_seed, 256, false, /*threaded=*/true,
+                               shuffle_seed, 256, false, split_threaded,
                                "", shuffle_parts)
           : InputSplit::Create(spec.uri, part, npart, "indexed_recordio",
                                index_uri, rec_shuffle, shuffle_seed,
-                               shuffle_batch, false, /*threaded=*/true, "");
+                               shuffle_batch, false, split_threaded, "");
   // ownership of split passes into the parser's base immediately; a throwing
   // constructor body unwinds through the already-built base, which frees it
   TextParserBase<IndexType>* parser = entry->body(split, args, nthread);
   Parser<IndexType>* out =
       threaded ? static_cast<Parser<IndexType>*>(
-                     new ThreadedParser<IndexType>(parser, 8))
+                     new PipelinedParser<IndexType>(parser, chunks_in_flight))
                : parser;
   if (!spec.cache_file.empty()) {
     std::string fingerprint = spec.uri + "|" + std::to_string(part) + "|" +
@@ -1078,8 +1365,8 @@ template class LibFMParser<uint32_t>;
 template class LibFMParser<uint64_t>;
 template class RecParser<uint32_t>;
 template class RecParser<uint64_t>;
-template class ThreadedParser<uint32_t>;
-template class ThreadedParser<uint64_t>;
+template class PipelinedParser<uint32_t>;
+template class PipelinedParser<uint64_t>;
 template class DiskCacheParser<uint32_t>;
 template class DiskCacheParser<uint64_t>;
 template class Parser<uint32_t>;

@@ -13,6 +13,7 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -28,6 +29,24 @@ namespace dct {
 
 template <typename IndexType>
 class TextParserBase;
+
+// Occupancy/stall counters for the multi-chunk parse pipeline
+// (PipelinedParser below), exposed through the C ABI
+// (dct_parser_pipeline_stats) so the bench harness can see which stage
+// binds: a starved reader (reader_waits low, consumer_waits high) means
+// parse-bound; a full queue (reader_waits high) means consume-bound.
+struct ParsePipelineStats {
+  uint64_t chunks_read = 0;      // chunks admitted by the reader stage
+  uint64_t blocks_delivered = 0; // row blocks handed to the consumer
+  uint64_t reader_waits = 0;     // reader blocked on the in-flight bound
+  uint64_t worker_waits = 0;     // worker slept with no claimable slice
+  uint64_t consumer_waits = 0;   // consumer slept on the head-of-line chunk
+  uint64_t inflight_now = 0;     // chunks currently outstanding
+  uint64_t inflight_peak = 0;
+  uint64_t inflight_sum = 0;     // summed at each admit; avg = sum/chunks
+  uint64_t capacity = 0;         // configured chunks-in-flight bound
+  uint64_t workers = 0;          // parse worker thread count
+};
 
 // Parser factory registry entry (reference ParserFactoryReg +
 // DMLC_REGISTER_DATA_PARSER, data.h:330-358): formats resolve by name
@@ -68,15 +87,23 @@ class Parser {
     (void)epoch;
     return false;
   }
+  // Pipeline occupancy counters; false when this parser chain carries no
+  // multi-chunk pipeline (threaded=false). Wrappers forward to their base.
+  virtual bool GetPipelineStats(ParsePipelineStats* out) const {
+    (void)out;
+    return false;
+  }
 
   // Factory (reference src/data.cc:62-85 CreateParser_): format is
   // "libsvm" | "csv" | "libfm" | "auto" (resolved from ?format= URI arg).
-  // `threaded` pipelines parsing against consumption (ThreadedParser).
-  // `#cachefile` URI sugar enables DiskCacheParser row-block caching
-  // (reference uri_spec.h:42-57, src/data.cc:97-103).
+  // `threaded` pipelines parsing against consumption (PipelinedParser).
+  // `chunks_in_flight` bounds the pipeline's outstanding chunks (0 = auto;
+  // also settable per-URI via `?chunks_in_flight=K`). `#cachefile` URI
+  // sugar enables DiskCacheParser row-block caching (reference
+  // uri_spec.h:42-57, src/data.cc:97-103).
   static Parser* Create(const std::string& uri, unsigned part, unsigned npart,
                         const std::string& format, int nthread = 0,
-                        bool threaded = true);
+                        bool threaded = true, int chunks_in_flight = 0);
 };
 
 // --------------------------------------------------------------------------
@@ -102,8 +129,27 @@ class TextParserBase : public Parser<IndexType> {
                           RowBlockContainer<IndexType>* out) = 0;
 
   // Fill `blocks` (resized to the worker count) from the next chunk;
-  // returns false at end of data. Used by the ThreadedParser producer.
+  // returns false at end of data. The synchronous (threaded=false) path:
+  // barrier fan-out over the persistent pool, one chunk per round.
   bool FillBlocks(std::vector<RowBlockContainer<IndexType>>* blocks);
+
+  // -- multi-chunk pipeline hooks (PipelinedParser stages) -----------------
+  // Copy the next chunk into *buf (the source Blob is only valid until the
+  // following NextChunk, so in-flight chunks need owned bytes); false at
+  // end of data. Counts toward BytesRead.
+  bool ReadChunk(std::vector<char>* buf);
+  // Tile [begin, end) into `nslice` unit-aligned slices: cuts has
+  // nslice + 1 monotone entries, cut i at the first parse-unit head at or
+  // after i*size/nslice (the same tiling FillBlocks uses, so pipelined
+  // output block boundaries match the barrier path exactly).
+  void TileCuts(const char* begin, const char* end, int nslice,
+                std::vector<const char*>* cuts);
+  // Slice count for a chunk of `size` bytes: nthread, or 1 for chunks too
+  // small to amortize the fan-out.
+  int SlicesFor(size_t size) const {
+    return size < (size_t(1) << 16) ? 1 : nthread_;
+  }
+  int num_threads() const { return nthread_; }
 
  protected:
   // Worker-tiling resync: the first parse-unit head at/after `hint` in
@@ -116,8 +162,12 @@ class TextParserBase : public Parser<IndexType> {
 
   std::unique_ptr<InputSplit> source_;
   int nthread_;
-  // read from the consumer thread while the ThreadedParser producer fills
+  // read from the consumer thread while the pipeline reader fills
   std::atomic<size_t> bytes_read_{0};
+  // direct chunk-producer view of source_ when its top layer exposes one
+  // (ReadChunk fast lane); probed once, lazily
+  RecordChunkSource* chunk_source_ = nullptr;
+  bool chunk_source_probed_ = false;
 
  private:
   // Persistent worker pool for the chunk fan-out: spawning fresh
@@ -232,6 +282,11 @@ class DiskCacheParser : public Parser<IndexType> {
     // unreachable in practice: Create forbids shuffle + #cachefile
     return base_->SetShuffleEpoch(epoch);
   }
+  bool GetPipelineStats(ParsePipelineStats* out) const override {
+    // meaningful during the write-through epoch; replay bypasses the parse
+    // pipeline (counters then freeze at their epoch-1 values)
+    return base_->GetPipelineStats(out);
+  }
 
  private:
   void FinalizeCache();
@@ -254,13 +309,43 @@ class DiskCacheParser : public Parser<IndexType> {
 };
 
 // --------------------------------------------------------------------------
-// Pipelined wrapper: parsing runs on a producer thread while the consumer
-// drains blocks (reference src/data/parser.h:70-126, capacity 8).
+// Multi-chunk in-flight parse pipeline — the threaded=true wrapper.
+//
+// The predecessor (ThreadedParser, reference src/data/parser.h:70-126)
+// pipelined exactly ONE chunk against consumption and fanned each chunk out
+// behind a barrier (FillBlocks), so the producer thread serialized the
+// InputSplit read against the straggler slice of every round and added
+// workers mostly waited (BENCH_r05 thread_scaling: +2% at 4 threads).
+// Here the stages are decoupled:
+//
+//   reader thread ──> bounded in-flight chunk queue ──> worker pool
+//                        (≤ chunks_in_flight)        (claim (chunk, slice))
+//                                  │
+//                        ordered head-of-line reassembly ──> consumer
+//
+// - The reader keeps up to `chunks_in_flight` chunks outstanding, copying
+//   each InputSplit::NextChunk blob into an owned, recycled buffer and
+//   pre-tiling it into nthread unit-aligned slices (TileCuts — identical
+//   tiling to the barrier path, so output blocks are byte-identical to
+//   nthread=1 concatenation).
+// - Workers claim (chunk, slice) work items oldest-chunk-first; slices of
+//   chunk N+1 parse while a straggler of chunk N is still running — no
+//   barrier anywhere.
+// - The consumer drains chunks strictly in input order (head-of-line wait
+//   on the oldest chunk), preserving deterministic output; consumed chunk
+//   tasks recycle their buffers through a free list so the zero-copy C-ABI
+//   hand-off and NextBlockMove swap semantics keep their capacity-reuse
+//   discipline.
+// Exceptions from any stage surface at the consumer in input order
+// (reference OMPException rethrow semantics).
 template <typename IndexType>
-class ThreadedParser : public Parser<IndexType> {
+class PipelinedParser : public Parser<IndexType> {
  public:
-  explicit ThreadedParser(TextParserBase<IndexType>* base, size_t capacity = 8);
-  ~ThreadedParser() override;
+  // takes ownership of base; chunks_in_flight <= 0 picks a default sized
+  // to the worker count
+  explicit PipelinedParser(TextParserBase<IndexType>* base,
+                           int chunks_in_flight = 0);
+  ~PipelinedParser() override;
 
   void BeforeFirst() override;
   const RowBlockContainer<IndexType>* NextBlock() override;
@@ -269,18 +354,55 @@ class ThreadedParser : public Parser<IndexType> {
   bool SetShuffleEpoch(unsigned epoch) override {
     return base_->SetShuffleEpoch(epoch);
   }
+  bool GetPipelineStats(ParsePipelineStats* out) const override;
 
  private:
-  struct Cell {
+  // One chunk in flight: owned bytes, slice cuts, per-slice output blocks
+  // and errors. Buffers (data + blocks) survive recycling, so steady state
+  // allocates nothing.
+  struct ChunkTask {
+    std::vector<char> data;
+    std::vector<const char*> cuts;  // nslice + 1 monotone boundaries
     std::vector<RowBlockContainer<IndexType>> blocks;
-    size_t next = 0;
+    std::vector<std::exception_ptr> errors;
+    int nslice = 0;
+    int next_slice = 0;  // next unclaimed slice (guarded by mu_)
+    int remaining = 0;   // unparsed slices (guarded by mu_); 0 = complete
+    size_t next_serve = 0;  // consumer cursor over blocks[0..nslice)
   };
+
+  void Start();        // spawn reader + workers (lazy, on first NextBlock)
+  void StopThreads();  // join all stages, reclaim in-flight tasks
+  void ReaderLoop();
+  void WorkerLoop();
   RowBlockContainer<IndexType>* NextMutable();  // shared walk for both Next*
+  void RecycleCurrent();
+
   std::unique_ptr<TextParserBase<IndexType>> base_;
-  PipelineIter<Cell> pipe_;
-  Cell* current_ = nullptr;
+  size_t capacity_;
+  int nworker_;
+
+  mutable std::mutex mu_;             // mutable: locked by const stats reads
+  std::condition_variable space_cv_;  // reader waits for in-flight room
+  std::condition_variable work_cv_;   // workers wait for claimable slices
+  std::condition_variable done_cv_;   // consumer waits on head-of-line
+  std::deque<ChunkTask*> inflight_;   // admitted chunks, input order
+  std::deque<ChunkTask*> claim_;      // prefix of inflight_ with free slices
+  std::vector<ChunkTask*> free_;      // recycled tasks
+  bool stop_ = false;
+  bool eof_ = false;
+  std::exception_ptr reader_error_;
+  bool failed_ = false;  // consumer saw an error; restart is forbidden
   bool started_ = false;
-  void EnsureStarted();
+  std::thread reader_;
+  std::vector<std::thread> workers_;
+
+  ChunkTask* current_ = nullptr;  // chunk being served to the consumer
+
+  // stats: relaxed atomics — written by stage threads, read via the C ABI
+  std::atomic<uint64_t> chunks_read_{0}, blocks_delivered_{0},
+      reader_waits_{0}, worker_waits_{0}, consumer_waits_{0},
+      inflight_peak_{0}, inflight_sum_{0};
 };
 
 }  // namespace dct

@@ -6,6 +6,7 @@
 #include <cassert>
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <fstream>
@@ -752,7 +753,7 @@ void TestEndianGoldenBytes() {
 }
 
 // Threaded text-parse fan-out under the race detector: the ParseBlock
-// worker tiling + ThreadedParser/PipelineIter hand-off are the riskiest
+// worker tiling + PipelinedParser stage hand-off are the riskiest
 // threaded code in the library (VERDICT r2 item 5b); this drive puts them
 // under `make tsan-test`. Determinism contract: any worker count must
 // produce the identical multiset of rows (verified via order-insensitive
@@ -942,6 +943,175 @@ void TestGoldenBinaryRecordsDecode() {
   }
 }
 
+// -- multi-chunk parse pipeline (parser.h PipelinedParser): ordering,
+//    restart, consumer abandonment, and worker/reader exception surfacing.
+//    Chunks are shrunk via DCT_CHUNK_SIZE_KB so several are in flight even
+//    on small fixtures. ----------------------------------------------------
+
+// RAII chunk-size shrink: the env var is read at split construction, so it
+// only needs to be set across Parser::Create.
+struct SmallChunks {
+  SmallChunks() { setenv("DCT_CHUNK_SIZE_KB", "64", 1); }
+  ~SmallChunks() { unsetenv("DCT_CHUNK_SIZE_KB"); }
+};
+
+std::string WriteOrderedLibsvm(const std::string& dir, int rows) {
+  std::string path = dir + "/ordered.libsvm";
+  std::ofstream f(path);
+  for (int i = 0; i < rows; ++i) {
+    // the label encodes the line number (exact in float up to 2^24), so an
+    // out-of-order or duplicated block shows up as a sequence mismatch,
+    // not just a sum mismatch
+    f << i << " 0:1 " << (i % 7) + 1 << ':' << (i % 13) * 0.25 << '\n';
+  }
+  return path;
+}
+
+std::vector<float> CollectLabels(const std::string& uri, int nthread,
+                                 bool threaded, int chunks_in_flight = 0) {
+  std::unique_ptr<dct::Parser<uint32_t>> p(dct::Parser<uint32_t>::Create(
+      uri, 0, 1, "libsvm", nthread, threaded, chunks_in_flight));
+  std::vector<float> labels;
+  const dct::RowBlockContainer<uint32_t>* b;
+  while ((b = p->NextBlock()) != nullptr) {
+    labels.insert(labels.end(), b->label.begin(), b->label.end());
+  }
+  return labels;
+}
+
+void TestParsePipelineOrdered() {
+  dct::TemporaryDirectory tmp;
+  SmallChunks small;
+  std::string path = WriteOrderedLibsvm(tmp.path(), 60000);
+  std::vector<float> serial = CollectLabels(path, 1, false);
+  EXPECT(serial.size() == 60000u);
+  EXPECT(serial.front() == 0.0f && serial.back() == 59999.0f);
+  // several worker counts and pipeline depths must all reproduce the
+  // serial sequence exactly (ordered reassembly, not just coverage)
+  for (int nt : {1, 3, 4}) {
+    for (int cif : {0, 2, 6}) {
+      EXPECT(CollectLabels(path, nt, true, cif) == serial);
+    }
+  }
+}
+
+void TestParsePipelineRestart() {
+  dct::TemporaryDirectory tmp;
+  SmallChunks small;
+  std::string path = WriteOrderedLibsvm(tmp.path(), 30000);
+  std::unique_ptr<dct::Parser<uint32_t>> p(
+      dct::Parser<uint32_t>::Create(path, 0, 1, "libsvm", 4, true, 3));
+  for (int epoch = 0; epoch < 3; ++epoch) {
+    float next = 0.0f;
+    const dct::RowBlockContainer<uint32_t>* b;
+    while ((b = p->NextBlock()) != nullptr) {
+      for (float l : b->label) EXPECT(l == next++);
+    }
+    EXPECT(next == 30000.0f);
+    p->BeforeFirst();
+  }
+  // restart mid-stream: drain a prefix, rewind, and the full ordered
+  // sequence must come back (in-flight chunks of the old epoch dropped)
+  const dct::RowBlockContainer<uint32_t>* b = p->NextBlock();
+  EXPECT(b != nullptr && b->label.front() == 0.0f);
+  p->BeforeFirst();
+  std::vector<float> again;
+  while ((b = p->NextBlock()) != nullptr) {
+    again.insert(again.end(), b->label.begin(), b->label.end());
+  }
+  EXPECT(again.size() == 30000u && again.front() == 0.0f &&
+         again.back() == 29999.0f);
+}
+
+void TestParsePipelineAbandon() {
+  // consumer walks away mid-stream with chunks in flight: the destructor
+  // must stop the reader/worker stages without a hang or leak (run under
+  // TSan via the tsan-test lane)
+  dct::TemporaryDirectory tmp;
+  SmallChunks small;
+  std::string path = WriteOrderedLibsvm(tmp.path(), 60000);
+  {
+    std::unique_ptr<dct::Parser<uint32_t>> p(
+        dct::Parser<uint32_t>::Create(path, 0, 1, "libsvm", 4, true, 4));
+    EXPECT(p->NextBlock() != nullptr);  // pipeline running, queue filling
+  }
+  {
+    // abandon before ANY read: stages never started (lazy Start)
+    std::unique_ptr<dct::Parser<uint32_t>> p(
+        dct::Parser<uint32_t>::Create(path, 0, 1, "libsvm", 4, true, 4));
+  }
+}
+
+void TestParsePipelineWorkerThrow() {
+  // a parse-worker exception (ragged libsvm row: explicit values on some
+  // features only -> ValidateBlock) must surface at the consumer, poison
+  // the pipeline, and forbid restart (reference OMPException semantics)
+  dct::TemporaryDirectory tmp;
+  SmallChunks small;
+  std::string path = tmp.path() + "/bad.libsvm";
+  {
+    std::ofstream f(path);
+    for (int i = 0; i < 40000; ++i) f << "1 0:1 1:2\n";
+    f << "1 0:1 2\n";  // ragged row lands in a late chunk
+  }
+  std::unique_ptr<dct::Parser<uint32_t>> p(
+      dct::Parser<uint32_t>::Create(path, 0, 1, "libsvm", 4, true, 3));
+  size_t rows = 0;
+  bool threw = false;
+  try {
+    const dct::RowBlockContainer<uint32_t>* b;
+    while ((b = p->NextBlock()) != nullptr) rows += b->Size();
+  } catch (const dct::Error& e) {
+    threw = std::string(e.what()).find("inconsistent") != std::string::npos;
+  }
+  EXPECT(threw);
+  EXPECT(rows < 40001u);  // the poisoned slice never reaches the consumer
+  bool threw_again = false;
+  try {
+    p->NextBlock();
+  } catch (const dct::Error&) {
+    threw_again = true;
+  }
+  EXPECT(threw_again);
+  bool restart_threw = false;
+  try {
+    p->BeforeFirst();
+  } catch (const dct::Error&) {
+    restart_threw = true;
+  }
+  EXPECT(restart_threw);
+}
+
+void TestParsePipelineReaderThrow() {
+  // a reader-stage exception (second input file vanishes between listing
+  // and read) surfaces at the consumer after the preceding chunks drain
+  dct::TemporaryDirectory tmp;
+  SmallChunks small;
+  std::string a = WriteOrderedLibsvm(tmp.path(), 20000);
+  std::string b_path = tmp.path() + "/gone.libsvm";
+  {
+    std::ofstream f(b_path);
+    for (int i = 0; i < 20000; ++i) f << "1 0:1\n";
+  }
+  std::unique_ptr<dct::Parser<uint32_t>> p(dct::Parser<uint32_t>::Create(
+      a + ";" + b_path, 0, 1, "libsvm", 2, true, 2));
+  EXPECT(p->NextBlock() != nullptr);  // streams are open lazily per file
+  std::remove(b_path.c_str());
+  bool threw = false;
+  size_t rows = 0;
+  try {
+    const dct::RowBlockContainer<uint32_t>* blk;
+    while ((blk = p->NextBlock()) != nullptr) rows += blk->Size();
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  // either the split had already opened the second file (POSIX keeps an
+  // unlinked open file readable) or the reader died and the error
+  // surfaced; both must leave the pipeline shut down cleanly — no hang,
+  // no crash on destruction
+  EXPECT(threw || rows == 2u * 20000u);
+}
+
 void TestThreadedTextParse() {
   dct::TemporaryDirectory tmp;
   std::string path = tmp.path() + "/big.libsvm";
@@ -1003,6 +1173,23 @@ int main(int argc, char** argv) {
     TestStdinSplit();
     return 0;
   }
+  if (argc > 1 && std::string(argv[1]) == "--pipeline") {
+    // the parse-pipeline concurrency suite alone — the cpp/Makefile
+    // tsan-pipeline lane runs exactly this under ThreadSanitizer
+    TestParsePipelineOrdered();
+    TestParsePipelineRestart();
+    TestParsePipelineAbandon();
+    TestParsePipelineWorkerThrow();
+    TestParsePipelineReaderThrow();
+    TestThreadedTextParse();
+    TestThreadedRecParse();
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
   TestMemoryStreams();
   TestIostreamBridge();
   TestTemporaryDirectory();
@@ -1023,6 +1210,11 @@ int main(int argc, char** argv) {
   TestRecordIOGoldenBytes();
   TestBinaryLaneBEDecodeBranches();
   TestGoldenBinaryRecordsDecode();
+  TestParsePipelineOrdered();
+  TestParsePipelineRestart();
+  TestParsePipelineAbandon();
+  TestParsePipelineWorkerThrow();
+  TestParsePipelineReaderThrow();
   TestThreadedTextParse();
   TestThreadedRecParse();
   if (g_failures == 0) {

@@ -313,9 +313,16 @@ class Parser:
 
     @staticmethod
     def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
-               nthread: int = 0, index64: bool = False, **kwargs):
+               nthread: int = 0, index64: bool = False,
+               chunks_in_flight: int = 0, **kwargs):
         """Instantiate a parser for `uri` by format name via the registry
-        (reference Parser<I>::Create, data.h:307)."""
+        (reference Parser<I>::Create, data.h:307).
+
+        ``nthread`` sizes the native parse worker pool and
+        ``chunks_in_flight`` bounds the chunks the pipelined reader keeps
+        outstanding (0 = auto; native formats only — see
+        cpp/src/parser.h PipelinedParser). The returned native parser
+        exposes ``pipeline_stats()`` with per-stage occupancy counters."""
         base = uri.split("#", 1)[0]
         args: Dict[str, str] = {}
         if "?" in base:
@@ -332,7 +339,8 @@ class Parser:
                     f"native format {resolved!r} takes options as URI args "
                     f"(e.g. ?label_column=0), got kwargs {sorted(kwargs)}")
             return NativeParser(uri, part=part, npart=npart, fmt=fmt,
-                                nthread=nthread, index64=index64)
+                                nthread=nthread, index64=index64,
+                                chunks_in_flight=chunks_in_flight)
         entry = PARSER_REGISTRY.find(resolved)
         if entry is None:
             raise DMLCError(
@@ -359,10 +367,12 @@ class RowBlockIter:
 
     @staticmethod
     def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
-               nthread: int = 0, index64: bool = False) -> "RowBlockIter":
+               nthread: int = 0, index64: bool = False,
+               chunks_in_flight: int = 0) -> "RowBlockIter":
         """Factory matching reference RowBlockIter<I>::Create (data.h:267)."""
         parser = Parser.create(uri, part, npart, fmt, nthread=nthread,
-                               index64=index64)
+                               index64=index64,
+                               chunks_in_flight=chunks_in_flight)
         return RowBlockIter(parser, eager="#" not in uri)
 
     def _load_eager(self) -> RowBlockContainer:
@@ -417,6 +427,13 @@ class RowBlockIter:
         """Bytes consumed from the underlying source so far (reference
         Parser::BytesRead)."""
         return self._parser.bytes_read()
+
+    def pipeline_stats(self) -> Optional[dict]:
+        """Per-stage occupancy counters of the native parse pipeline
+        (NativeParser.pipeline_stats), or None for python-registered
+        formats / unpipelined parsers."""
+        stats = getattr(self._parser, "pipeline_stats", None)
+        return stats() if stats is not None else None
 
     def close(self) -> None:
         """Release the native parser handle (idempotent)."""

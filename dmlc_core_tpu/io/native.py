@@ -55,6 +55,22 @@ class RowBlockC(ctypes.Structure):
     ]
 
 
+class ParsePipelineStatsC(ctypes.Structure):
+    """Mirror of dct_parse_pipeline_stats_t in cpp/src/capi.cc."""
+    _fields_ = [
+        ("chunks_read", ctypes.c_uint64),
+        ("blocks_delivered", ctypes.c_uint64),
+        ("reader_waits", ctypes.c_uint64),
+        ("worker_waits", ctypes.c_uint64),
+        ("consumer_waits", ctypes.c_uint64),
+        ("inflight_now", ctypes.c_uint64),
+        ("inflight_peak", ctypes.c_uint64),
+        ("inflight_sum", ctypes.c_uint64),
+        ("capacity", ctypes.c_uint64),
+        ("workers", ctypes.c_uint64),
+    ]
+
+
 def _build_native() -> None:
     sources_newer = True
     if os.path.exists(_LIB_PATH):
@@ -116,6 +132,10 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_recordio_reader_free": [vp],
         "dct_parser_create": [c.c_char_p, u, u, c.c_char_p, i, i, i,
                               c.POINTER(vp)],
+        "dct_parser_create_ex": [c.c_char_p, u, u, c.c_char_p, i, i, i, i,
+                                 c.POINTER(vp)],
+        "dct_parser_pipeline_stats": [vp, c.POINTER(ParsePipelineStatsC),
+                                      c.POINTER(i)],
         "dct_parser_next_block": [vp, c.POINTER(RowBlockC), c.POINTER(i)],
         "dct_parser_before_first": [vp],
         "dct_parser_set_epoch": [vp, u, c.POINTER(c.c_int32)],
@@ -123,6 +143,7 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_parser_free": [vp],
         "dct_webhdfs_set_delegation_token": [c.c_char_p],
         "dct_webhdfs_set_auth_header": [c.c_char_p],
+        "dct_set_tls_proxy": [c.c_char_p],
         "dct_parser_formats_doc": [c.POINTER(c.c_char_p)],
         "dct_batcher_create": [c.c_char_p, u, u, c.c_char_p, i, i,
                                c.c_uint64, c.c_uint32, c.c_uint64,
@@ -177,9 +198,12 @@ def _uri_needs_tls(uri: str) -> bool:
     s3:// and azure:// whenever their endpoint env is https or UNSET (the
     no-endpoint default is the real TLS-only cloud service,
     cpp/src/{s3,azure}_filesys.cc ResolveTarget); hdfs:// under an https
-    WEBHDFS_NAMENODE (secure WebHDFS)."""
-    if "https://" in uri:
-        return True
+    WEBHDFS_NAMENODE (secure WebHDFS).
+
+    Matching is per-';'-member startswith on the scheme — a local path
+    whose query string merely EMBEDS "https://" (e.g.
+    ``/data/f.libsvm?note=https://origin``) must not spawn the TLS helper
+    singleton."""
 
     def env(*names: str) -> str:
         for n in names:
@@ -188,14 +212,21 @@ def _uri_needs_tls(uri: str) -> bool:
                 return v
         return ""
 
-    if "s3://" in uri:
-        ep = env("S3_ENDPOINT", "AWS_ENDPOINT")
-        return not ep or ep.startswith("https://")
-    if "azure://" in uri:
-        ep = env("AZURE_ENDPOINT")
-        return not ep or ep.startswith("https://")
-    if "hdfs://" in uri or "viewfs://" in uri:
-        return env("WEBHDFS_NAMENODE").startswith("https://")
+    for member in uri.split(";"):
+        member = member.strip()
+        if member.startswith("https://"):
+            return True
+        if member.startswith("s3://"):
+            ep = env("S3_ENDPOINT", "AWS_ENDPOINT")
+            if not ep or ep.startswith("https://"):
+                return True
+        elif member.startswith("azure://"):
+            ep = env("AZURE_ENDPOINT")
+            if not ep or ep.startswith("https://"):
+                return True
+        elif member.startswith(("hdfs://", "viewfs://")):
+            if env("WEBHDFS_NAMENODE").startswith("https://"):
+                return True
     return False
 
 
@@ -205,16 +236,25 @@ def _route_https(uri: str) -> str:
 
     The native client is plain-HTTP; https origins route through the local
     TLS-terminating helper (io/tls_proxy.py). When the operator configured
-    none (DCT_TLS_PROXY unset), start the in-process singleton — the
-    native side reads the env per request, so the export is picked up
-    immediately. DCT_TLS_AUTO=0 opts out (operators running an external
-    helper fleet-wide set DCT_TLS_PROXY themselves). Returns the uri
-    unchanged (routing is by env)."""
-    if (os.environ.get("DCT_TLS_AUTO") != "0"
-            and not os.environ.get("DCT_TLS_PROXY")
-            and _uri_needs_tls(uri)):
-        from dmlc_core_tpu.io.tls_proxy import ensure_tls_proxy
-        ensure_tls_proxy()
+    none (DCT_TLS_PROXY unset), start the in-process singleton and publish
+    its address to the native router through the explicit C-ABI setter
+    (dct_set_tls_proxy) — NEVER by mutating os.environ: other native
+    handles may already be running request threads whose per-request
+    getenv (endpoint/credential env reads) a setenv would race (glibc
+    setenv/getenv are mutually unsafe). When the operator DID configure a
+    helper (env set before launch) or opted out (DCT_TLS_AUTO=0), any
+    earlier auto-start override is cleared so the env — or the native
+    guidance error — stays authoritative. Returns the uri unchanged
+    (routing is by the published address)."""
+    if not _uri_needs_tls(uri):
+        return uri
+    if (os.environ.get("DCT_TLS_PROXY")
+            or os.environ.get("DCT_TLS_AUTO") == "0"):
+        _check(lib().dct_set_tls_proxy(b""))
+        return uri
+    from dmlc_core_tpu.io.tls_proxy import ensure_tls_proxy
+    addr = ensure_tls_proxy(export_env=False)
+    _check(lib().dct_set_tls_proxy(addr.encode()))
     return uri
 
 
@@ -559,20 +599,25 @@ class RowBlock:
 class NativeParser:
     """Multithreaded text parser producing RowBlock batches.
 
-    reference Parser<I,D>::Create (data.h:307) + ThreadedParser pipeline
-    (src/data/parser.h:70-126): parsing runs on background threads; iteration
-    here drains ready blocks.
+    reference Parser<I,D>::Create (data.h:307), pipelined like its
+    ThreadedParser (src/data/parser.h:70-126) but multi-chunk: with
+    ``threaded=True`` a native reader keeps up to ``chunks_in_flight``
+    chunks outstanding while a pool of ``nthread`` workers claims
+    (chunk, slice) work items and an ordered reassembly stage delivers
+    blocks in input order (cpp/src/parser.h PipelinedParser) — output is
+    byte-identical to ``nthread=1``. ``pipeline_stats()`` exposes the
+    per-stage occupancy counters.
     """
 
     def __init__(self, uri: str, part: int = 0, npart: int = 1,
                  fmt: str = "auto", nthread: int = 0, threaded: bool = True,
-                 index64: bool = False):
+                 index64: bool = False, chunks_in_flight: int = 0):
         uri = _route_https(uri)
         self._h = ctypes.c_void_p()
-        _check(lib().dct_parser_create(uri.encode(), part, npart, fmt.encode(),
-                                       nthread, 1 if threaded else 0,
-                                       1 if index64 else 0,
-                                       ctypes.byref(self._h)))
+        _check(lib().dct_parser_create_ex(
+            uri.encode(), part, npart, fmt.encode(), nthread,
+            1 if threaded else 0, 1 if index64 else 0, chunks_in_flight,
+            ctypes.byref(self._h)))
 
     def next_block(self) -> Optional[RowBlock]:
         """Next parsed RowBlock view, or None at end of data; the view stays
@@ -611,6 +656,23 @@ class NativeParser:
         out = ctypes.c_size_t()
         _check(lib().dct_parser_bytes_read(self._h, ctypes.byref(out)))
         return out.value
+
+    def pipeline_stats(self) -> Optional[dict]:
+        """Occupancy/stall counters of the multi-chunk parse pipeline
+        (cpp/src/parser.h ParsePipelineStats), or None for threaded=False
+        parsers. ``occupancy_avg`` is the mean chunks-in-flight sampled at
+        each admit; high ``reader_waits`` means the consumer binds, high
+        ``consumer_waits`` means parsing binds."""
+        s = ParsePipelineStatsC()
+        has = ctypes.c_int()
+        _check(lib().dct_parser_pipeline_stats(self._h, ctypes.byref(s),
+                                               ctypes.byref(has)))
+        if not has.value:
+            return None
+        out = {name: int(getattr(s, name)) for name, _ in s._fields_}
+        out["occupancy_avg"] = (round(s.inflight_sum / s.chunks_read, 3)
+                                if s.chunks_read else 0.0)
+        return out
 
     def close(self) -> None:
         """Free the native parser handle (idempotent)."""

@@ -99,9 +99,11 @@ class _RelayHandler(BaseHTTPRequestHandler):
         if target.query:
             path += "?" + target.query
         # end-to-end request headers pass through; body per Content-Length
-        # (the native client always sets one on uploads)
+        # (the native client always sets one on uploads). The body STREAMS
+        # to the origin in bounded pieces rather than being buffered whole:
+        # parallel multipart uploads run one handler thread per part, and
+        # part-sized (8-64 MB) buffers per thread multiply into real RSS.
         length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length) if length else b""
         try:
             conn = http.client.HTTPSConnection(
                 target.hostname, port, context=_origin_context(),
@@ -120,7 +122,17 @@ class _RelayHandler(BaseHTTPRequestHandler):
             # one origin connection per relayed request: announce it so
             # the origin never waits for a second request on this socket
             conn.putheader("Connection", "close")
-            conn.endheaders(body)
+            conn.endheaders()
+            remaining = length
+            while remaining > 0:
+                piece = self.rfile.read(min(remaining, 65536))
+                if not piece:
+                    # client hung up mid-body: the origin sees a short
+                    # body and fails the request itself; nothing to relay
+                    raise OSError("client closed mid-upload with "
+                                  f"{remaining} bytes unsent")
+                conn.send(piece)
+                remaining -= len(piece)
             resp = conn.getresponse()
         except (OSError, ssl.SSLError, http.client.HTTPException) as e:
             self._refuse(502, f"tls relay to {target.netloc} failed: {e}")
@@ -193,13 +205,18 @@ _auto_proxy: Optional[TlsProxy] = None
 _auto_lock = threading.Lock()
 
 
-def ensure_tls_proxy() -> str:
+def ensure_tls_proxy(export_env: bool = True) -> str:
     """Address of a TLS helper for this process, starting one if needed.
 
     Returns ``DCT_TLS_PROXY`` untouched when the operator configured a
-    helper; otherwise starts a process-wide singleton and exports its
-    address through the SAME env var so the native client (which reads
-    the env per request) picks it up.
+    helper; otherwise starts a process-wide singleton and returns its
+    address. The NATIVE layer learns the address through the explicit
+    C-ABI setter (io/native.py _route_https → dct_set_tls_proxy), not the
+    env: mutating os.environ (setenv) while native request threads call
+    getenv is undefined behavior in glibc. ``export_env`` additionally
+    exports the address for Python-side consumers and subprocesses — it
+    writes at most once (skipped when the value is already current), and
+    callers that already publish natively pass False.
     """
     configured = os.environ.get("DCT_TLS_PROXY")
     if configured:
@@ -209,9 +226,14 @@ def ensure_tls_proxy() -> str:
         if _auto_proxy is None:
             _auto_proxy = TlsProxy()
             _auto_proxy.start()
-        # (re-)export every time: the env var may have been cleared since
-        # the singleton started
-        os.environ["DCT_TLS_PROXY"] = _auto_proxy.address
+        if (export_env
+                and os.environ.get("DCT_TLS_PROXY") != _auto_proxy.address):
+            # setenv is only safe while no native request thread can be
+            # mid-getenv; the io facade therefore passes export_env=False
+            # and publishes natively instead. This export path serves
+            # Python-level callers that spawn subprocesses BEFORE touching
+            # native io.
+            os.environ["DCT_TLS_PROXY"] = _auto_proxy.address
         return _auto_proxy.address
 
 

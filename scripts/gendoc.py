@@ -244,6 +244,8 @@ def gen_index() -> str:
         "data-format registry |",
         "| [parallelism.md](parallelism.md) | the five sharding "
         "strategies (DP/SP/TP/EP/PP) and their oracles |",
+        "| [pipeline.md](pipeline.md) | the multi-chunk parse pipeline: "
+        "stages, knobs, occupancy counters |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
         "",

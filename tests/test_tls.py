@@ -212,13 +212,20 @@ def test_tls_headless_sizing(tls_stack):
 
 def test_tls_facade_autostarts_helper(tls_stack, monkeypatch):
     # no DCT_TLS_PROXY configured: the facade starts the in-process
-    # helper on first https:// open and exports its address
+    # helper on first https:// open and publishes its address through the
+    # C-ABI setter (dct_set_tls_proxy) — NOT via os.environ, whose setenv
+    # would race native request threads' getenv
     state, base = tls_stack
     state.objects["/auto.bin"] = b"hello tls"
     monkeypatch.delenv("DCT_TLS_PROXY")
     with NativeStream(base + "/auto.bin", "r") as s:
         assert s.read_all() == b"hello tls"
-    assert os.environ.get("DCT_TLS_PROXY")  # exported by ensure_tls_proxy
+    assert not os.environ.get("DCT_TLS_PROXY")  # no setenv on this path
+    # the helper is nonetheless live and routing: a second native open
+    # (still no env var) reuses the published address
+    state.objects["/auto2.bin"] = b"again"
+    with NativeStream(base + "/auto2.bin", "r") as s:
+        assert s.read_all() == b"again"
 
 
 def _run_tls_worker(worker: str, strip_vars, ok_marker: str, cert_pair):
