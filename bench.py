@@ -846,6 +846,16 @@ def main() -> None:
         # size-stable)
         vs = round(rps / baseline["reference_rows_per_sec"], 3)
 
+    # remote-I/O resilience counters (cpp/src/retry.h): local-file runs
+    # report zeros, but remote-source runs record the retry noise behind
+    # the throughput number so the perf trajectory distinguishes "slower
+    # code" from "flakier storage" (doc/robustness.md)
+    try:
+        from dmlc_core_tpu.io.native import io_retry_stats
+        extras["io_retry"] = io_retry_stats()
+    except Exception as e:  # never let observability sink the benchmark
+        extras["io_retry"] = {"error": str(e)[-200:]}
+
     print(f"# {rows} rows ({size_mb:.1f} MB {lane_fmt}) in {dt:.3f}s = "
           f"{size_mb / dt:.1f} MB/s (median of "
           f"{extras.get('reps', args.reps)})", file=sys.stderr)

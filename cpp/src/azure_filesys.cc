@@ -160,9 +160,9 @@ std::string QueryString(const std::map<std::string, std::string>& query) {
 // ---------------------------------------------------------------- reading --
 class AzureReadStream : public RetryingHttpReadStream {
  public:
-  AzureReadStream(const AzureConfig& cfg, const URI& uri, size_t file_size)
-      : RetryingHttpReadStream("azure", file_size, cfg.max_retry,
-                               cfg.retry_sleep_ms),
+  AzureReadStream(const AzureConfig& cfg, const URI& uri, size_t file_size,
+                  const io::RetryPolicy& policy, int timeout_ms)
+      : RetryingHttpReadStream("azure", file_size, policy, timeout_ms),
         cfg_(cfg), uri_(uri) {
     SplitContainerBlob(uri, &container_, &blob_);
     target_ = ResolveTarget(cfg_);
@@ -235,9 +235,9 @@ class AzureWriteStream : public Stream {
       auto headers =
           SignedHeaders(cfg_, "PUT", resource, {}, buffer_.size(),
                         {{"x-ms-blob-type", "BlockBlob"}});
-      HttpResponse resp =
-          HttpRequest(RouteOf(target_), "PUT",
-                      s3::UriEncode(resource, true), headers, buffer_);
+      HttpResponse resp = RetryingHttpRequest(
+          RouteOf(target_), "PUT", s3::UriEncode(resource, true), headers,
+          buffer_, cfg_.retry);
       DCT_CHECK(resp.status == 201)
           << "azure Put Blob failed: " << resp.status << " " << resp.body;
       return;
@@ -250,9 +250,10 @@ class AzureWriteStream : public Stream {
     std::string body = xml.str();
     std::map<std::string, std::string> q = {{"comp", "blocklist"}};
     auto headers = SignedHeaders(cfg_, "PUT", resource, q, body.size());
-    HttpResponse resp = HttpRequest(
+    HttpResponse resp = RetryingHttpRequest(
         RouteOf(target_), "PUT",
-        s3::UriEncode(resource, true) + QueryString(q), headers, body);
+        s3::UriEncode(resource, true) + QueryString(q), headers, body,
+        cfg_.retry);
     DCT_CHECK(resp.status == 201)
         << "azure Put Block List failed: " << resp.status << " " << resp.body;
   }
@@ -274,9 +275,10 @@ class AzureWriteStream : public Stream {
     std::map<std::string, std::string> q = {{"blockid", id},
                                             {"comp", "block"}};
     auto headers = SignedHeaders(cfg_, "PUT", resource, q, part.size());
-    HttpResponse resp = HttpRequest(
+    HttpResponse resp = RetryingHttpRequest(
         RouteOf(target_), "PUT",
-        s3::UriEncode(resource, true) + QueryString(q), headers, part);
+        s3::UriEncode(resource, true) + QueryString(q), headers, part,
+        cfg_.retry);
     DCT_CHECK(resp.status == 201)
         << "azure Put Block failed: " << resp.status << " " << resp.body;
     block_ids_.push_back(id);
@@ -310,6 +312,7 @@ AzureConfig AzureConfig::FromEnv() {
     SplitHostPort(s, &cfg.endpoint_host, &cfg.endpoint_port,
                   cfg.endpoint_port);
   }
+  cfg.retry = io::RetryPolicy::FromEnv("AZURE");
   return cfg;
 }
 
@@ -339,9 +342,10 @@ void AzureFileSystem::ListDirectory(const URI& path,
     if (!marker.empty()) q["marker"] = marker;
     std::string resource = "/" + container;
     auto headers = azure::SignedHeaders(config_, "GET", resource, q, 0);
-    HttpResponse resp = HttpRequest(
+    HttpResponse resp = RetryingHttpRequest(
         azure::RouteOf(t), "GET",
-        s3::UriEncode(resource, true) + azure::QueryString(q), headers, "");
+        s3::UriEncode(resource, true) + azure::QueryString(q), headers, "",
+        config_.retry);
     DCT_CHECK(resp.status == 200)
         << "azure List Blobs failed: " << resp.status << " " << resp.body;
     size_t pos = 0;
@@ -381,6 +385,11 @@ void AzureFileSystem::ListDirectory(const URI& path,
 }
 
 FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
+  return PathInfoUnderPolicy(path, config_.retry);
+}
+
+FileInfo AzureFileSystem::PathInfoUnderPolicy(
+    const URI& path, const io::RetryPolicy& policy) {
   // exact-prefix List Blobs (mirrors the S3 TryGetPathInfo approach; avoids
   // HEAD, which the built-in client's body-framing doesn't model);
   // file-vs-directory resolution is the shared ProbePathInfo (listing.h)
@@ -394,9 +403,10 @@ FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
                                             {"prefix", pfx},
                                             {"restype", "container"}};
     auto headers = azure::SignedHeaders(config_, "GET", resource, q, 0);
-    HttpResponse resp = HttpRequest(
+    HttpResponse resp = RetryingHttpRequest(
         azure::RouteOf(t), "GET",
-        s3::UriEncode(resource, true) + azure::QueryString(q), headers, "");
+        s3::UriEncode(resource, true) + azure::QueryString(q), headers, "",
+        policy);
     DCT_CHECK(resp.status == 200)
         << "azure List Blobs failed: " << resp.status << " " << resp.body;
     ListedPage page;
@@ -424,11 +434,18 @@ FileInfo AzureFileSystem::GetPathInfo(const URI& path) {
 }
 
 SeekStream* AzureFileSystem::OpenForRead(const URI& path, bool allow_null) {
+  URI clean = path;
+  io::RetryPolicy policy = config_.retry;
+  int timeout_ms = 0;
+  io::ExtractUriRetryArgs(&clean.path, &policy, &timeout_ms);
+  // bind the open-time metadata probe to the per-open timeout as well
+  io::ScopedIoTimeout scoped_timeout(timeout_ms);
   try {
-    FileInfo info = GetPathInfo(path);
+    FileInfo info = PathInfoUnderPolicy(clean, policy);
     DCT_CHECK(info.type == FileType::kFile)
-        << "cannot open azure directory for read: " << path.Str();
-    return new azure::AzureReadStream(config_, path, info.size);
+        << "cannot open azure directory for read: " << clean.Str();
+    return new azure::AzureReadStream(config_, clean, info.size, policy,
+                                      timeout_ms);
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;

@@ -18,6 +18,7 @@
 #include <vector>
 
 #include "filesys.h"
+#include "retry.h"
 
 namespace dct {
 
@@ -30,8 +31,10 @@ struct AzureConfig {
   // ResolveHttpRoute). The no-endpoint default is https against the real
   // <account>.blob.core.windows.net — Azure enforces secure transfer.
   std::string scheme = "http";
-  int max_retry = 50;
-  int retry_sleep_ms = 100;
+  // Shared resilience policy (retry.h): DMLC_IO_* globals overridden by
+  // AZURE_MAX_RETRY / AZURE_RETRY_SLEEP_MS / AZURE_BACKOFF_* /
+  // AZURE_DEADLINE_MS (checked parsing).
+  io::RetryPolicy retry;
 
   // AZURE_STORAGE_ACCOUNT / AZURE_STORAGE_ACCESS_KEY (reference
   // azure_filesys.cc:31-39) + AZURE_ENDPOINT ("host[:port]" or
@@ -53,6 +56,12 @@ class AzureFileSystem : public FileSystem {
   const AzureConfig& config() const { return config_; }
 
  private:
+  // GetPathInfo under an explicit resilience policy — OpenForRead routes
+  // its per-open `?io_*=` overrides through here so the open-time probe
+  // honors the caller's budget, not just the env default.
+  FileInfo PathInfoUnderPolicy(const URI& path,
+                               const io::RetryPolicy& policy);
+
   AzureConfig config_;
 };
 

@@ -20,6 +20,7 @@
 #include "input_split.h"
 #include "parser.h"
 #include "recordio.h"
+#include "retry.h"
 #include "rowblock.h"
 #include "stream.h"
 
@@ -125,6 +126,57 @@ int dct_webhdfs_set_auth_header(const char* header) {
 int dct_set_tls_proxy(const char* addr) {
   return Guard(
       [&] { dct::SetTlsProxyOverride(addr == nullptr ? "" : addr); });
+}
+
+// ----------------------------------------------------------- io resilience --
+// Mirror of dct::io::IoStats (retry.h) — process-global remote-I/O
+// resilience counters, surfaced in Python as io_stats() (alongside the
+// PR-1 dct_parser_pipeline_stats).
+typedef struct {
+  uint64_t requests;          // HTTP requests sent
+  uint64_t retries;           // backoff sleeps taken
+  uint64_t backoff_ms_total;  // total milliseconds slept in backoff
+  uint64_t timeouts;          // per-attempt timeout expiries
+  uint64_t faults_injected;   // DMLC_IO_FAULT_PLAN firings
+  uint64_t giveups;           // retry loops that exhausted their budget
+  uint64_t deadline_exhausted;  // giveups caused by the deadline
+} dct_io_retry_stats_t;
+
+int dct_io_retry_stats(dct_io_retry_stats_t* out) {
+  return Guard([&] {
+    const dct::io::IoStats& st = dct::io::GlobalIoStats();
+    out->requests = st.requests.load(std::memory_order_relaxed);
+    out->retries = st.retries.load(std::memory_order_relaxed);
+    out->backoff_ms_total =
+        st.backoff_ms_total.load(std::memory_order_relaxed);
+    out->timeouts = st.timeouts.load(std::memory_order_relaxed);
+    out->faults_injected =
+        st.faults_injected.load(std::memory_order_relaxed);
+    out->giveups = st.giveups.load(std::memory_order_relaxed);
+    out->deadline_exhausted =
+        st.deadline_exhausted.load(std::memory_order_relaxed);
+  });
+}
+
+int dct_io_stats_reset() {
+  return Guard([&] { dct::io::ResetIoStats(); });
+}
+
+// Install/replace the deterministic fault-injection plan evaluated inside
+// the native HTTP client (retry.h grammar, e.g.
+// "reset:every=3;stall:every=5,ms=80;5xx:every=7,status=503"); empty/NULL
+// clears. The explicit setter is the race-free alternative to mutating
+// DMLC_IO_FAULT_PLAN after native request threads exist (same rule as
+// dct_set_tls_proxy).
+int dct_io_set_fault_plan(const char* plan) {
+  return Guard(
+      [&] { dct::io::SetFaultPlan(plan == nullptr ? "" : plan); });
+}
+
+// Override the per-attempt socket timeout (connect/recv/send bound,
+// milliseconds); <=0 reverts to DMLC_IO_TIMEOUT_MS / the 60 s default.
+int dct_io_set_timeout_ms(int ms) {
+  return Guard([&] { dct::io::SetIoTimeoutMs(ms); });
 }
 
 // ---------------------------------------------------------------- streams --

@@ -121,9 +121,9 @@ void CheckStatus(const HttpResponse& resp, int expect, const char* what,
 class WebHdfsReadStream : public RetryingHttpReadStream {
  public:
   WebHdfsReadStream(const WebHdfsConfig& cfg, const Target& target,
-                    const URI& uri, size_t file_size)
-      : RetryingHttpReadStream("webhdfs", file_size, cfg.max_retry,
-                               cfg.retry_sleep_ms),
+                    const URI& uri, size_t file_size,
+                    const io::RetryPolicy& policy, int timeout_ms)
+      : RetryingHttpReadStream("webhdfs", file_size, policy, timeout_ms),
         cfg_(cfg), target_(target), uri_(uri) {}
 
  private:
@@ -279,10 +279,7 @@ WebHdfsConfig WebHdfsConfig::FromEnv() {
   if (tok != nullptr && *tok != '\0') cfg.delegation_token = tok;
   const char* ah = std::getenv("WEBHDFS_AUTH_HEADER");
   if (ah != nullptr && *ah != '\0') cfg.auth_header = ah;
-  const char* mr = std::getenv("WEBHDFS_MAX_RETRY");
-  if (mr != nullptr && *mr != '\0') cfg.max_retry = std::atoi(mr);
-  const char* rs = std::getenv("WEBHDFS_RETRY_SLEEP_MS");
-  if (rs != nullptr && *rs != '\0') cfg.retry_sleep_ms = std::atoi(rs);
+  cfg.retry = io::RetryPolicy::FromEnv("WEBHDFS");
   return cfg;
 }
 
@@ -292,11 +289,18 @@ WebHdfsFileSystem* WebHdfsFileSystem::GetInstance() {
 }
 
 FileInfo WebHdfsFileSystem::GetPathInfo(const URI& path) {
+  return PathInfoUnderPolicy(path, config_copy().retry);
+}
+
+FileInfo WebHdfsFileSystem::PathInfoUnderPolicy(
+    const URI& path, const io::RetryPolicy& policy) {
   const WebHdfsConfig cfg = config_copy();
   webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
   std::string p = webhdfs::OpPath(cfg, path.path, "GETFILESTATUS", "");
-  HttpResponse resp = HttpRequest(ResolveHttpRoute(t.scheme, t.host, t.port),
-                                  "GET", p, webhdfs::AuthHeaders(cfg), "");
+  // metadata ops ride the shared resilience policy (idempotent GET)
+  HttpResponse resp = RetryingHttpRequest(
+      ResolveHttpRoute(t.scheme, t.host, t.port), "GET", p,
+      webhdfs::AuthHeaders(cfg), "", policy);
   webhdfs::CheckStatus(resp, 200, "GETFILESTATUS", path);
   FileInfo info;
   info.path = path;
@@ -319,8 +323,9 @@ void WebHdfsFileSystem::ListDirectory(const URI& path,
   const WebHdfsConfig cfg = config_copy();
   webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
   std::string p = webhdfs::OpPath(cfg, path.path, "LISTSTATUS", "");
-  HttpResponse resp = HttpRequest(ResolveHttpRoute(t.scheme, t.host, t.port),
-                                  "GET", p, webhdfs::AuthHeaders(cfg), "");
+  HttpResponse resp = RetryingHttpRequest(
+      ResolveHttpRoute(t.scheme, t.host, t.port), "GET", p,
+      webhdfs::AuthHeaders(cfg), "", cfg.retry);
   webhdfs::CheckStatus(resp, 200, "LISTSTATUS", path);
   std::string dir = path.path.empty() ? "/" : path.path;
   if (dir.back() != '/') dir += '/';
@@ -357,13 +362,20 @@ void WebHdfsFileSystem::ListDirectory(const URI& path,
 }
 
 SeekStream* WebHdfsFileSystem::OpenForRead(const URI& path, bool allow_null) {
+  URI clean = path;
+  const WebHdfsConfig cfg = config_copy();
+  io::RetryPolicy policy = cfg.retry;
+  int timeout_ms = 0;
+  io::ExtractUriRetryArgs(&clean.path, &policy, &timeout_ms);
+  // bind the open-time metadata probe to the per-open timeout as well
+  io::ScopedIoTimeout scoped_timeout(timeout_ms);
   try {
-    FileInfo info = GetPathInfo(path);
+    FileInfo info = PathInfoUnderPolicy(clean, policy);
     DCT_CHECK(info.type == FileType::kFile)
-        << "cannot open hdfs directory for read: " << path.Str();
-    const WebHdfsConfig cfg = config_copy();
-    webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
-    return new webhdfs::WebHdfsReadStream(cfg, t, path, info.size);
+        << "cannot open hdfs directory for read: " << clean.Str();
+    webhdfs::Target t = webhdfs::ResolveTarget(cfg, clean);
+    return new webhdfs::WebHdfsReadStream(cfg, t, clean, info.size, policy,
+                                          timeout_ms);
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;

@@ -17,6 +17,7 @@
 #include <vector>
 
 #include "filesys.h"
+#include "retry.h"
 
 namespace dct {
 
@@ -41,8 +42,10 @@ struct WebHdfsConfig {
   // (scope decision in PARITY.md; the reference gets Kerberos via the JVM's
   // org.apache.hadoop.security stack, CMakeLists.txt:71-83).
   std::string auth_header;
-  int max_retry = 50;         // read reconnect attempts (reference S3 parity)
-  int retry_sleep_ms = 100;
+  // Shared resilience policy (retry.h): DMLC_IO_* globals overridden by
+  // WEBHDFS_MAX_RETRY / WEBHDFS_RETRY_SLEEP_MS / WEBHDFS_BACKOFF_* /
+  // WEBHDFS_DEADLINE_MS (checked parsing).
+  io::RetryPolicy retry;
 
   // Env chain: WEBHDFS_NAMENODE ("host[:port]"), then
   // WEBHDFS_DELEGATION_TOKEN for token auth, then HADOOP_USER_NAME /
@@ -59,6 +62,11 @@ class WebHdfsFileSystem : public FileSystem {
   static WebHdfsFileSystem* GetInstance();
 
   FileInfo GetPathInfo(const URI& path) override;
+  // GetPathInfo under an explicit resilience policy — OpenForRead routes
+  // its per-open `?io_*=` overrides through here so the open-time probe
+  // honors the caller's budget, not just the env default.
+  FileInfo PathInfoUnderPolicy(const URI& path,
+                               const io::RetryPolicy& policy);
   void ListDirectory(const URI& path, std::vector<FileInfo>* out) override;
   Stream* Open(const URI& path, const char* mode,
                bool allow_null = false) override;

@@ -1,15 +1,20 @@
 // HTTP client implementation (see http.h).
 #include "http.h"
 
+#include <fcntl.h>
 #include <netdb.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <cctype>
+#include <cerrno>
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
+
+#include "retry.h"
 
 namespace dct {
 
@@ -18,9 +23,66 @@ std::string Lower(std::string s) {
   for (char& c : s) c = static_cast<char>(tolower(c));
   return s;
 }
-}  // namespace
 
-namespace {
+// StatusThrower for the fault-injection hook: retry.h stays independent of
+// http.h, so the 5xx fault kind throws through this adapter.
+[[noreturn]] void ThrowHttpStatus(const std::string& what, int status) {
+  throw HttpStatusError(what, status);
+}
+
+// Block until fd is ready for `events` or the per-attempt I/O timeout
+// (retry.h IoTimeoutMs) expires — the expiry surfaces as a retryable
+// TimeoutError instead of the unbounded block a hung peer used to cause.
+void WaitFdReady(int fd, short events, const char* what) {
+  struct pollfd pfd;
+  pfd.fd = fd;
+  pfd.events = events;
+  pfd.revents = 0;
+  const int timeout_ms = io::IoTimeoutMs();
+  int rc;
+  do {
+    rc = poll(&pfd, 1, timeout_ms);
+  } while (rc < 0 && errno == EINTR);
+  if (rc == 0) {
+    io::GlobalIoStats().timeouts.fetch_add(1, std::memory_order_relaxed);
+    throw TimeoutError(std::string("http ") + what + " timed out after " +
+                       std::to_string(timeout_ms) + " ms");
+  }
+  DCT_CHECK(rc > 0) << "poll failed during http " << what << ": "
+                    << std::strerror(errno);
+}
+
+// Non-blocking connect bounded by the I/O timeout; restores the fd to
+// blocking mode on success. Sets *timed_out when the bound expired.
+bool ConnectWithTimeout(int fd, const struct sockaddr* addr, socklen_t len,
+                        bool* timed_out) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (flags < 0 || fcntl(fd, F_SETFL, flags | O_NONBLOCK) != 0) return false;
+  bool ok = false;
+  if (connect(fd, addr, len) == 0) {
+    ok = true;
+  } else if (errno == EINPROGRESS) {
+    struct pollfd pfd;
+    pfd.fd = fd;
+    pfd.events = POLLOUT;
+    pfd.revents = 0;
+    int rc;
+    do {
+      rc = poll(&pfd, 1, io::IoTimeoutMs());
+    } while (rc < 0 && errno == EINTR);
+    if (rc == 0) {
+      *timed_out = true;
+    } else if (rc > 0) {
+      int err = 0;
+      socklen_t elen = sizeof(err);
+      ok = getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) == 0 &&
+           err == 0;
+    }
+  }
+  if (ok && fcntl(fd, F_SETFL, flags) != 0) ok = false;
+  return ok;
+}
+
 int ConnectSocket(const std::string& host, int port) {
   struct addrinfo hints;
   std::memset(&hints, 0, sizeof(hints));
@@ -29,17 +91,33 @@ int ConnectSocket(const std::string& host, int port) {
   struct addrinfo* res = nullptr;
   std::string port_str = std::to_string(port);
   int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
-  DCT_CHECK(rc == 0) << "cannot resolve host " << host << ": "
-                     << gai_strerror(rc);
+  if (rc != 0) {
+    const std::string what = "cannot resolve host " + host + ": " +
+                             gai_strerror(rc);
+    // EAI_AGAIN is a transient resolver hiccup worth retrying; anything
+    // else (NXDOMAIN from a typo'd endpoint) is permanent — fail fast
+    // instead of burning the whole backoff budget per request
+    if (rc == EAI_AGAIN) throw Error(what);
+    throw PermanentNetworkError(what);
+  }
   int fd = -1;
+  bool timed_out = false;
   for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
     fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
-    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    if (ConnectWithTimeout(fd, ai->ai_addr, ai->ai_addrlen, &timed_out)) {
+      break;
+    }
     close(fd);
     fd = -1;
   }
   freeaddrinfo(res);
+  if (fd < 0 && timed_out) {
+    io::GlobalIoStats().timeouts.fetch_add(1, std::memory_order_relaxed);
+    throw TimeoutError("http connect to " + host + ":" +
+                       std::to_string(port) + " timed out after " +
+                       std::to_string(io::IoTimeoutMs()) + " ms");
+  }
   DCT_CHECK(fd >= 0) << "cannot connect to " << host << ":" << port;
   return fd;
 }
@@ -82,8 +160,12 @@ void HttpConnection::SendRequest(
   }
   req += "Connection: close\r\n\r\n";
   req += body;
+  // fault-injection hook: evaluated per outgoing request, below every mock
+  // and every backend (retry.h DMLC_IO_FAULT_PLAN / dct_io_set_fault_plan)
+  io::MaybeInjectFault(&ThrowHttpStatus);
   size_t sent = 0;
   while (sent < req.size()) {
+    WaitFdReady(fd_, POLLOUT, "send");
     ssize_t n = send(fd_, req.data() + sent, req.size() - sent, 0);
     DCT_CHECK(n > 0) << "http send failed";
     sent += static_cast<size_t>(n);
@@ -97,6 +179,7 @@ size_t HttpConnection::RawRead(void* buf, size_t size) {
     rpos_ += n;
     return n;
   }
+  WaitFdReady(fd_, POLLIN, "recv");
   ssize_t n = recv(fd_, buf, size, 0);
   DCT_CHECK(n >= 0) << "http recv failed";
   return static_cast<size_t>(n);

@@ -40,6 +40,15 @@ inline bool RetryableHttpStatus(int status) {
   return status == 408 || status == 429 || status >= 500;
 }
 
+// A network failure retrying cannot fix — DNS says the name does not
+// exist (typo'd endpoint config). Retry ladders rethrow it immediately
+// instead of backing off through their whole budget. Transient resolver
+// failures (EAI_AGAIN) stay plain Error and retry.
+class PermanentNetworkError : public Error {
+ public:
+  explicit PermanentNetworkError(const std::string& what) : Error(what) {}
+};
+
 // Where a request for an origin actually connects, and how the request
 // path is phrased. Direct plain-http origins connect straight through with
 // origin-form paths. https origins are reached via the local

@@ -8,20 +8,18 @@
 
 #include "http.h"
 #include "http_stream.h"
+#include "retry.h"
 
 namespace dct {
 namespace {
 
-// Retry policy mirrors the S3 reader's defaults (reference
-// s3_filesys.cc:522-546: <=50 attempts, 100 ms); DCT_HTTP_MAX_RETRY /
-// DCT_HTTP_RETRY_SLEEP_MS override (the fault-injection tests shrink them).
-int EnvInt(const char* key, int dflt) {
-  const char* v = std::getenv(key);
-  return v != nullptr && *v != '\0' ? std::atoi(v) : dflt;
+// Retry policy: DMLC_IO_* globals with DCT_HTTP_MAX_RETRY /
+// DCT_HTTP_RETRY_SLEEP_MS (legacy names, checked parsing) and the other
+// DCT_HTTP_* knobs as overrides (retry.h RetryPolicy::FromEnv); re-read
+// per open so the fault-injection tests can reshape it between streams.
+io::RetryPolicy HttpRetryPolicy() {
+  return io::RetryPolicy::FromEnv("DCT_HTTP");
 }
-
-int MaxRetry() { return EnvInt("DCT_HTTP_MAX_RETRY", 50); }
-int RetrySleepMs() { return EnvInt("DCT_HTTP_RETRY_SLEEP_MS", 100); }
 
 // Route for this URI's origin: direct for http://, via the DCT_TLS_PROXY
 // helper for https:// (ResolveHttpRoute throws a guidance error when the
@@ -33,13 +31,42 @@ HttpRoute RouteFor(const URI& uri) {
   return ResolveHttpRoute(uri.scheme, host, port);
 }
 
+// Retry a hand-rolled request under `policy` until its response HEAD is
+// definitive: `issue` opens its own connection, sends, and fills *out with
+// the response head (throwing on transport problems). Retryable statuses
+// and transport drops back off and reissue; permanent failures rethrow.
+// Shared by RemoteSize's HEAD and Range-GET probe legs, which must manage
+// their connections by hand (the one-shot HttpRequest helper drains
+// bodies, which HEAD must not and the size probe must not buffer).
+template <typename Issue>
+void RetryRequestHead(const io::RetryPolicy& policy, HttpResponse* out,
+                      Issue&& issue) {
+  io::RetryController ctl(policy);
+  while (true) {
+    try {
+      *out = HttpResponse();  // no stale headers from a failed attempt
+      issue(out);
+      if (RetryableHttpStatus(out->status) && ctl.BackoffOrGiveUp()) {
+        continue;
+      }
+      return;
+    } catch (const HttpStatusError& e) {
+      if (!RetryableHttpStatus(e.status) || !ctl.BackoffOrGiveUp()) throw;
+    } catch (const PermanentNetworkError&) {
+      throw;
+    } catch (const Error&) {
+      if (!ctl.BackoffOrGiveUp()) throw;
+    }
+  }
+}
+
 // Ranged GET stream with reconnect-at-offset (http_stream.h retry loop —
 // the same shape as the S3/WebHDFS readers).
 class HttpReadStream : public RetryingHttpReadStream {
  public:
-  HttpReadStream(const URI& uri, size_t file_size, int max_retry,
-                 int retry_sleep_ms)
-      : RetryingHttpReadStream("http", file_size, max_retry, retry_sleep_ms),
+  HttpReadStream(const URI& uri, size_t file_size,
+                 const io::RetryPolicy& policy, int timeout_ms)
+      : RetryingHttpReadStream("http", file_size, policy, timeout_ms),
         uri_(uri) {}
 
  protected:
@@ -58,7 +85,10 @@ class HttpReadStream : public RetryingHttpReadStream {
       // replays the FULL prefix on such a server, so the ranged-read
       // retry budget (default 50) would admit O(50 x file) transfer on a
       // flaky link: cut the budget to a couple of attempts instead.
-      max_retry_ = std::min(max_retry_, 2);
+      // The cut happens only AFTER the discard completes: a connection
+      // reset mid-header can spell out "200 OK" and then die — that is a
+      // transport fault to retry at full budget, not proof the server
+      // ignores Range.
       char scratch[65536];
       size_t left = pos_;
       while (left > 0) {
@@ -70,6 +100,7 @@ class HttpReadStream : public RetryingHttpReadStream {
         }
         left -= n;
       }
+      policy_.max_retry = std::min(policy_.max_retry, 2);
     } else if (head.status != 206 && head.status != 200) {
       throw HttpStatusError(
           "http GET " + uri_.Str() + " -> status " +
@@ -84,19 +115,21 @@ class HttpReadStream : public RetryingHttpReadStream {
 
 // HEAD the object; fall back to `Range: bytes=0-0` GET parsing
 // Content-Range when the server rejects HEAD.
-size_t RemoteSize(const URI& uri, bool allow_null, bool* found) {
+size_t RemoteSize(const URI& uri, bool allow_null, bool* found,
+                  const io::RetryPolicy& policy) {
   const HttpRoute route = RouteFor(uri);
   const std::string path = uri.path.empty() ? "/" : uri.path;
   *found = true;
   // HEAD by hand: Content-Length describes the WOULD-BE body — none
   // follows, so the one-shot HttpRequest helper (which drains a body)
-  // would block on it
+  // would block on it. The probe rides the shared resilience policy:
+  // transport drops / timeouts / retryable statuses back off and resend.
   HttpResponse r;
-  {
+  RetryRequestHead(policy, &r, [&](HttpResponse* out) {
     HttpConnection conn(route);
     conn.SendRequest("HEAD", path, {}, "");
-    conn.ReadResponseHead(&r);
-  }
+    conn.ReadResponseHead(out);
+  });
   if (r.status == 404 || r.status == 410) {
     if (allow_null) {
       *found = false;
@@ -107,11 +140,17 @@ size_t RemoteSize(const URI& uri, bool allow_null, bool* found) {
   if (r.status == 405 || r.status == 501) {  // HEAD unsupported
     // manual connection (not the one-shot HttpRequest helper): a server
     // that also ignores Range answers 200 with the WHOLE object, and the
-    // helper would buffer it all in memory just to learn a length
-    HttpConnection gconn(route);
-    gconn.SendRequest("GET", path, {{"Range", "bytes=0-0"}}, "");
+    // helper would buffer it all in memory just to learn a length. The
+    // request/response-head leg retries like the HEAD above; only the
+    // body-counting stream below is one-shot.
+    std::unique_ptr<HttpConnection> gconn_holder;
     HttpResponse g;
-    gconn.ReadResponseHead(&g);
+    RetryRequestHead(policy, &g, [&](HttpResponse* out) {
+      gconn_holder = std::make_unique<HttpConnection>(route);
+      gconn_holder->SendRequest("GET", path, {{"Range", "bytes=0-0"}}, "");
+      gconn_holder->ReadResponseHead(out);
+    });
+    HttpConnection& gconn = *gconn_holder;
     if (g.status == 404 || g.status == 410) {  // same contract as HEAD 404
       if (allow_null) {
         *found = false;
@@ -170,7 +209,8 @@ FileInfo HttpFileSystem::GetPathInfo(const URI& path) {
   bool found = true;
   FileInfo info;
   info.path = path;
-  info.size = RemoteSize(path, /*allow_null=*/false, &found);
+  info.size = RemoteSize(path, /*allow_null=*/false, &found,
+                         HttpRetryPolicy());
   info.type = FileType::kFile;
   return info;
 }
@@ -192,10 +232,17 @@ Stream* HttpFileSystem::Open(const URI& path, const char* mode,
 }
 
 SeekStream* HttpFileSystem::OpenForRead(const URI& path, bool allow_null) {
+  // `?io_*=` args are OURS (per-open retry overrides, retry.h) and are
+  // stripped before the path goes on the wire; any other query survives.
+  URI clean = path;
+  io::RetryPolicy policy = HttpRetryPolicy();
+  int timeout_ms = 0;
+  io::ExtractUriRetryArgs(&clean.path, &policy, &timeout_ms);
   bool found = true;
-  size_t size = RemoteSize(path, allow_null, &found);
+  io::ScopedIoTimeout scoped_timeout(timeout_ms);
+  size_t size = RemoteSize(clean, allow_null, &found, policy);
   if (!found) return nullptr;
-  return new HttpReadStream(path, size, MaxRetry(), RetrySleepMs());
+  return new HttpReadStream(clean, size, policy, timeout_ms);
 }
 
 namespace {

@@ -1,34 +1,66 @@
 // Shared ranged-read scaffolding for HTTP-backed filesystems.
 //
-// S3, WebHDFS, and Azure readers all follow the same shape: a SeekStream
-// whose Connect() opens a ranged GET at the current offset, with
-// reconnect-at-offset retries on transport drops (the reference's S3 retry
-// loop, s3_filesys.cc:522-546, <=50 attempts at 100 ms) and fail-fast on
-// definitive HTTP statuses. Only Connect() differs per backend, so the
-// loop lives here once.
+// S3, WebHDFS, Azure, and http(s) readers all follow the same shape: a
+// SeekStream whose Connect() opens a ranged GET at the current offset, with
+// reconnect-at-offset retries on transport drops and fail-fast on
+// definitive HTTP statuses. The reference's loop (s3_filesys.cc:522-546)
+// slept a CONSTANT 100 ms up to 50 times; here the loop drives the shared
+// RetryPolicy (retry.h): exponential backoff with decorrelated jitter, a
+// per-operation deadline budget, and per-attempt socket timeouts underneath
+// (http.cc WaitFdReady), all feeding the global io-retry counters. Only
+// Connect() differs per backend, so the loop lives here once.
 #ifndef DCT_HTTP_STREAM_H_
 #define DCT_HTTP_STREAM_H_
-
-#include <unistd.h>
 
 #include <memory>
 #include <string>
 
 #include "http.h"
+#include "retry.h"
 #include "stream.h"
 
 namespace dct {
 
+// One-shot request under the shared policy: transport errors and retryable
+// statuses (408/429/5xx) back off and resend; definitive statuses return
+// to the caller unchanged. Only for IDEMPOTENT requests (metadata probes,
+// listings, S3 part PUTs keyed by partNumber, Azure blocks keyed by block
+// id) — a non-idempotent request (WebHDFS APPEND) must not ride this.
+inline HttpResponse RetryingHttpRequest(
+    const HttpRoute& route, const std::string& method,
+    const std::string& path,
+    const std::map<std::string, std::string>& headers,
+    const std::string& body, const io::RetryPolicy& policy) {
+  io::RetryController ctl(policy);
+  while (true) {
+    try {
+      HttpResponse resp = HttpRequest(route, method, path, headers, body);
+      if (RetryableHttpStatus(resp.status) && ctl.BackoffOrGiveUp()) {
+        continue;
+      }
+      return resp;
+    } catch (const PermanentNetworkError&) {
+      throw;  // a typo'd endpoint does not get better with backoff
+    } catch (const Error&) {
+      if (!ctl.BackoffOrGiveUp()) throw;
+    }
+  }
+}
+
 class RetryingHttpReadStream : public SeekStream {
  public:
-  RetryingHttpReadStream(const char* backend, size_t file_size, int max_retry,
-                         int retry_sleep_ms)
-      : backend_(backend), file_size_(file_size), max_retry_(max_retry),
-        retry_sleep_ms_(retry_sleep_ms) {}
+  RetryingHttpReadStream(const char* backend, size_t file_size,
+                         const io::RetryPolicy& policy,
+                         int timeout_ms_override = 0)
+      : backend_(backend), file_size_(file_size), policy_(policy),
+        timeout_ms_override_(timeout_ms_override) {}
 
   size_t Read(void* ptr, size_t size) override {
     if (pos_ >= file_size_ || size == 0) return 0;
-    int attempts = 0;
+    // one controller per Read call: the deadline budget bounds this
+    // operation's retry loop, not the whole stream's lifetime
+    io::RetryController ctl(policy_);
+    io::ScopedIoTimeout scoped_timeout(timeout_ms_override_);
     while (true) {
       try {
         if (conn_ == nullptr) Connect();
@@ -42,12 +74,13 @@ class RetryingHttpReadStream : public SeekStream {
       } catch (const HttpStatusError& e) {
         conn_.reset();
         if (!RetryableHttpStatus(e.status)) throw;
-        if (++attempts > max_retry_) throw;
-        usleep(retry_sleep_ms_ * 1000);
+        if (!ctl.BackoffOrGiveUp()) throw;
+      } catch (const PermanentNetworkError&) {
+        conn_.reset();
+        throw;
       } catch (const Error&) {
         conn_.reset();
-        if (++attempts > max_retry_) throw;
-        usleep(retry_sleep_ms_ * 1000);
+        if (!ctl.BackoffOrGiveUp()) throw;
       }
     }
   }
@@ -73,8 +106,8 @@ class RetryingHttpReadStream : public SeekStream {
 
   const char* backend_;
   size_t file_size_;
-  int max_retry_;
-  int retry_sleep_ms_;
+  io::RetryPolicy policy_;   // subclasses may tighten (http 200-resume path)
+  int timeout_ms_override_;  // per-stream ?io_timeout_ms=; 0 = global
   size_t pos_ = 0;
   std::unique_ptr<HttpConnection> conn_;
 };

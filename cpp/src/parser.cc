@@ -1244,6 +1244,19 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
   }
   std::map<std::string, std::string> args = spec.args;
   args["format"] = fmt;
+  // `?io_*=` resilience overrides (retry.h) apply to DIRECT filesystem
+  // opens (streams, OpenForRead); the parser lane strips the query into
+  // parser args before the filesystem ever sees it, so the knobs would be
+  // silent no-ops here — and URI sugar a lane does not implement must
+  // error, not no-op (stream.h RejectUnknownArgs rationale). Configure
+  // parser-lane resilience through the DMLC_IO_* / per-backend env.
+  for (const auto& kv : args) {
+    if (kv.first.compare(0, 3, "io_") == 0) {
+      throw Error("the parser lane does not support per-open `?" + kv.first +
+                  "=` resilience overrides (they reach only direct stream "
+                  "opens); set DMLC_IO_* / per-backend env knobs instead");
+    }
+  }
   // NOTE: the chunk-level CachedSplit is NOT layered here — the row-block
   // DiskCacheParser below caches the *parsed* data, and double-caching
   // would write the dataset to disk twice (reference disk_row_iter caches

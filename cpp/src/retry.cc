@@ -1,0 +1,414 @@
+// Remote-I/O resilience layer implementation (see retry.h).
+#include "retry.h"
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdlib>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace dct {
+namespace io {
+
+// ---------------------------------------------------------------- config --
+int64_t CheckedInt(const std::string& what, const std::string& text,
+                   int64_t lo, int64_t hi) {
+  if (text.empty()) {
+    throw Error("invalid integer for " + what + ": empty value");
+  }
+  char* end = nullptr;
+  errno = 0;
+  long long v = std::strtoll(text.c_str(), &end, 10);
+  if (end == text.c_str() || *end != '\0' || errno == ERANGE) {
+    throw Error("invalid integer for " + what + ": '" + text + "'");
+  }
+  return std::min<int64_t>(std::max<int64_t>(v, lo), hi);
+}
+
+int64_t CheckedEnvInt(const char* name, int64_t dflt, int64_t lo,
+                      int64_t hi) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  return CheckedInt(std::string("env ") + name, v, lo, hi);
+}
+
+namespace {
+
+constexpr int64_t kMaxRetryCap = 100000;
+constexpr int64_t kMsCap = 24LL * 3600 * 1000;  // one day
+
+// Overlay <NAME> (exact env var) onto *out when set.
+void EnvOverride(const std::string& name, int64_t lo, int64_t hi,
+                 int64_t* out) {
+  *out = CheckedEnvInt(name.c_str(), *out, lo, hi);
+}
+
+}  // namespace
+
+RetryPolicy RetryPolicy::FromEnv(const char* prefix) {
+  RetryPolicy p;
+  int64_t max_retry = p.max_retry, base = p.backoff_base_ms;
+  int64_t cap = p.backoff_cap_ms, deadline = p.deadline_ms;
+  // global layer
+  EnvOverride("DMLC_IO_MAX_RETRY", 0, kMaxRetryCap, &max_retry);
+  EnvOverride("DMLC_IO_BACKOFF_BASE_MS", 1, kMsCap, &base);
+  EnvOverride("DMLC_IO_BACKOFF_CAP_MS", 1, kMsCap, &cap);
+  EnvOverride("DMLC_IO_DEADLINE_MS", 0, kMsCap, &deadline);
+  // per-backend layer (legacy names kept: <P>_MAX_RETRY and
+  // <P>_RETRY_SLEEP_MS predate this policy; the sleep maps to the backoff
+  // base, giving old configs the old first-retry latency)
+  const std::string P(prefix);
+  EnvOverride(P + "_MAX_RETRY", 0, kMaxRetryCap, &max_retry);
+  EnvOverride(P + "_RETRY_SLEEP_MS", 1, kMsCap, &base);
+  EnvOverride(P + "_BACKOFF_BASE_MS", 1, kMsCap, &base);
+  EnvOverride(P + "_BACKOFF_CAP_MS", 1, kMsCap, &cap);
+  EnvOverride(P + "_DEADLINE_MS", 0, kMsCap, &deadline);
+  p.max_retry = static_cast<int>(max_retry);
+  p.backoff_base_ms = static_cast<int>(base);
+  p.backoff_cap_ms = static_cast<int>(std::max(base, cap));
+  p.deadline_ms = deadline;
+  p.jitter_seed = CheckedEnvInt("DMLC_IO_JITTER_SEED", -1, -1, INT64_MAX);
+  return p;
+}
+
+bool RetryPolicy::ApplyUriArg(const std::string& key,
+                              const std::string& value) {
+  if (key == "io_max_retry") {
+    max_retry = static_cast<int>(
+        CheckedInt("uri arg io_max_retry", value, 0, kMaxRetryCap));
+  } else if (key == "io_backoff_base_ms") {
+    backoff_base_ms = static_cast<int>(
+        CheckedInt("uri arg io_backoff_base_ms", value, 1, kMsCap));
+  } else if (key == "io_backoff_cap_ms") {
+    backoff_cap_ms = static_cast<int>(
+        CheckedInt("uri arg io_backoff_cap_ms", value, 1, kMsCap));
+  } else if (key == "io_deadline_ms") {
+    deadline_ms = CheckedInt("uri arg io_deadline_ms", value, 0, kMsCap);
+  } else {
+    return false;
+  }
+  return true;
+}
+
+void ExtractUriRetryArgs(std::string* path, RetryPolicy* policy,
+                         int* timeout_ms_override) {
+  size_t q = path->find('?');
+  if (q == std::string::npos) return;
+  std::string query = path->substr(q + 1);
+  std::string kept;
+  size_t start = 0;
+  while (start <= query.size()) {
+    size_t amp = query.find('&', start);
+    std::string kv = query.substr(
+        start, amp == std::string::npos ? std::string::npos : amp - start);
+    if (!kv.empty()) {
+      size_t eq = kv.find('=');
+      std::string key = eq == std::string::npos ? kv : kv.substr(0, eq);
+      std::string val = eq == std::string::npos ? "" : kv.substr(eq + 1);
+      bool consumed = false;
+      if (key == "io_timeout_ms") {
+        // 0 means "no override" — the same <=0-reverts semantics as
+        // SetIoTimeoutMs, not a 1 ms clamp
+        int parsed = static_cast<int>(
+            CheckedInt("uri arg io_timeout_ms", val, 0, kMsCap));
+        if (timeout_ms_override != nullptr && parsed > 0) {
+          *timeout_ms_override = parsed;
+        }
+        consumed = true;
+      } else if (key.compare(0, 3, "io_") == 0) {
+        consumed = policy->ApplyUriArg(key, val);
+        if (!consumed) {
+          throw Error("unknown io_* retry uri arg `" + key +
+                      "` (known: io_max_retry, io_backoff_base_ms, "
+                      "io_backoff_cap_ms, io_deadline_ms, io_timeout_ms)");
+        }
+      }
+      if (!consumed) {
+        kept += kept.empty() ? "" : "&";
+        kept += kv;
+      }
+    }
+    if (amp == std::string::npos) break;
+    start = amp + 1;
+  }
+  *path = path->substr(0, q);
+  if (!kept.empty()) *path += "?" + kept;
+}
+
+// --------------------------------------------------------------- runtime --
+RetryController::RetryController(const RetryPolicy& policy)
+    : policy_(policy),
+      start_(std::chrono::steady_clock::now()),
+      prev_sleep_ms_(std::max(policy.backoff_base_ms, 1)) {}
+
+int64_t RetryController::elapsed_ms() const {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+bool RetryController::BackoffOrGiveUp() {
+  IoStats& st = GlobalIoStats();
+  ++attempts_;
+  if (attempts_ > policy_.max_retry) {
+    st.giveups.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  const int64_t elapsed = elapsed_ms();
+  if (policy_.deadline_ms > 0 && elapsed >= policy_.deadline_ms) {
+    st.giveups.fetch_add(1, std::memory_order_relaxed);
+    st.deadline_exhausted.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  if (!rng_ready_) {
+    rng_.seed(policy_.jitter_seed >= 0
+                  ? static_cast<uint64_t>(policy_.jitter_seed)
+                  : std::random_device{}());
+    rng_ready_ = true;
+  }
+  // decorrelated jitter: sleep ~ U[base, prev*3], capped; the next draw's
+  // upper bound follows the value actually slept
+  const int64_t base = std::max(policy_.backoff_base_ms, 1);
+  const int64_t hi = std::max(base, prev_sleep_ms_ * 3);
+  std::uniform_int_distribution<int64_t> dist(base, hi);
+  int64_t sleep_ms =
+      std::min<int64_t>(dist(rng_), std::max(policy_.backoff_cap_ms, 1));
+  prev_sleep_ms_ = std::max(sleep_ms, base);
+  if (policy_.deadline_ms > 0) {
+    // never sleep past the deadline: the budget bounds wall clock, and a
+    // clamped sleep lets the next attempt (or giveup) happen inside it
+    sleep_ms = std::min(sleep_ms, policy_.deadline_ms - elapsed);
+  }
+  if (sleep_ms > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
+    st.backoff_ms_total.fetch_add(static_cast<uint64_t>(sleep_ms),
+                                  std::memory_order_relaxed);
+  }
+  st.retries.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
+
+// ----------------------------------------------------------------- stats --
+IoStats& GlobalIoStats() {
+  static IoStats stats;
+  return stats;
+}
+
+void ResetIoStats() {
+  IoStats& st = GlobalIoStats();
+  st.requests.store(0);
+  st.retries.store(0);
+  st.backoff_ms_total.store(0);
+  st.timeouts.store(0);
+  st.faults_injected.store(0);
+  st.giveups.store(0);
+  st.deadline_exhausted.store(0);
+}
+
+// -------------------------------------------------------- fault injection --
+namespace {
+
+struct FaultRule {
+  enum Kind { kReset, kStall, k5xx } kind;
+  uint64_t every = 0;          // fire on every Nth observed request
+  double probability = 0.0;    // alternative: fire with seeded probability
+  int ms = 50;                 // stall duration
+  int status = 503;            // 5xx status carried
+  std::atomic<uint64_t> count{0};
+};
+
+struct FaultPlan {
+  std::vector<std::unique_ptr<FaultRule>> rules;
+  // seeded RNG for probabilistic rules; mutex-guarded (probabilistic mode
+  // trades a lock for reproducible draws — deterministic every-N rules
+  // never touch it)
+  std::mutex rng_mu;
+  std::mt19937_64 rng;
+};
+
+std::mutex g_plan_mu;
+std::shared_ptr<FaultPlan> g_plan;          // null = no faults
+bool g_plan_explicitly_set = false;         // SetFaultPlan called (even "")
+std::once_flag g_env_plan_once;
+
+std::shared_ptr<FaultPlan> ParsePlan(const std::string& plan) {
+  auto out = std::make_shared<FaultPlan>();
+  out->rng.seed(static_cast<uint64_t>(
+      CheckedEnvInt("DMLC_IO_FAULT_SEED", 1, INT64_MIN, INT64_MAX)));
+  size_t start = 0;
+  while (start <= plan.size()) {
+    size_t semi = plan.find(';', start);
+    std::string rule_text = plan.substr(
+        start, semi == std::string::npos ? std::string::npos : semi - start);
+    if (!rule_text.empty()) {
+      auto rule = std::make_unique<FaultRule>();
+      size_t colon = rule_text.find(':');
+      std::string kind = rule_text.substr(0, colon);
+      if (kind == "reset") {
+        rule->kind = FaultRule::kReset;
+      } else if (kind == "stall") {
+        rule->kind = FaultRule::kStall;
+      } else if (kind == "5xx") {
+        rule->kind = FaultRule::k5xx;
+      } else {
+        throw Error("fault plan: unknown kind '" + kind +
+                    "' (known: reset, stall, 5xx) in '" + plan + "'");
+      }
+      if (colon != std::string::npos) {
+        std::string params = rule_text.substr(colon + 1);
+        size_t p = 0;
+        while (p <= params.size()) {
+          size_t comma = params.find(',', p);
+          std::string kv = params.substr(
+              p, comma == std::string::npos ? std::string::npos : comma - p);
+          if (!kv.empty()) {
+            size_t eq = kv.find('=');
+            if (eq == std::string::npos) {
+              throw Error("fault plan: malformed param '" + kv + "' in '" +
+                          plan + "'");
+            }
+            std::string key = kv.substr(0, eq);
+            std::string val = kv.substr(eq + 1);
+            if (key == "every") {
+              rule->every = static_cast<uint64_t>(
+                  CheckedInt("fault plan every", val, 1, INT64_MAX));
+            } else if (key == "p") {
+              char* end = nullptr;
+              rule->probability = std::strtod(val.c_str(), &end);
+              if (end == val.c_str() || *end != '\0' ||
+                  rule->probability < 0.0 || rule->probability > 1.0) {
+                throw Error("fault plan: p must be in [0,1], got '" + val +
+                            "'");
+              }
+            } else if (key == "ms") {
+              rule->ms = static_cast<int>(
+                  CheckedInt("fault plan ms", val, 0, kMsCap));
+            } else if (key == "status") {
+              rule->status = static_cast<int>(
+                  CheckedInt("fault plan status", val, 500, 599));
+            } else {
+              throw Error("fault plan: unknown param '" + key + "' in '" +
+                          plan + "'");
+            }
+          }
+          if (comma == std::string::npos) break;
+          p = comma + 1;
+        }
+      }
+      if (rule->every == 0 && rule->probability == 0.0) {
+        throw Error("fault plan: rule '" + rule_text +
+                    "' needs every=N or p=<prob>");
+      }
+      out->rules.push_back(std::move(rule));
+    }
+    if (semi == std::string::npos) break;
+    start = semi + 1;
+  }
+  return out->rules.empty() ? nullptr : out;
+}
+
+[[noreturn]] void FireFault(const FaultRule& rule,
+                            StatusThrower status_thrower) {
+  IoStats& st = GlobalIoStats();
+  st.faults_injected.fetch_add(1, std::memory_order_relaxed);
+  switch (rule.kind) {
+    case FaultRule::kReset:
+      throw Error("dct fault-injection: connection reset");
+    case FaultRule::kStall:
+      if (rule.ms > 0) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(rule.ms));
+      }
+      st.timeouts.fetch_add(1, std::memory_order_relaxed);
+      throw TimeoutError("dct fault-injection: stalled " +
+                         std::to_string(rule.ms) + " ms, timing out");
+    case FaultRule::k5xx:
+    default:
+      status_thrower("dct fault-injection: http status " +
+                         std::to_string(rule.status),
+                     rule.status);
+      throw Error("unreachable");  // status_thrower always throws
+  }
+}
+
+}  // namespace
+
+void SetFaultPlan(const std::string& plan) {
+  std::shared_ptr<FaultPlan> parsed =
+      plan.empty() ? nullptr : ParsePlan(plan);
+  std::lock_guard<std::mutex> lk(g_plan_mu);
+  g_plan = std::move(parsed);
+  g_plan_explicitly_set = true;  // an explicit CLEAR also beats the env
+}
+
+void EnsureFaultPlanFromEnv() {
+  std::call_once(g_env_plan_once, [] {
+    const char* env = std::getenv("DMLC_IO_FAULT_PLAN");
+    if (env == nullptr || *env == '\0') return;
+    std::shared_ptr<FaultPlan> parsed = ParsePlan(env);
+    std::lock_guard<std::mutex> lk(g_plan_mu);
+    if (!g_plan_explicitly_set) g_plan = std::move(parsed);
+  });
+}
+
+void MaybeInjectFault(StatusThrower status_thrower) {
+  GlobalIoStats().requests.fetch_add(1, std::memory_order_relaxed);
+  EnsureFaultPlanFromEnv();
+  std::shared_ptr<FaultPlan> plan;
+  {
+    std::lock_guard<std::mutex> lk(g_plan_mu);
+    plan = g_plan;
+  }
+  if (plan == nullptr) return;
+  // tick EVERY rule's counter for this request, then fire the first hit:
+  // "every=N" means every Nth request the plan observes, independent of
+  // whether an earlier rule also fired on it
+  const FaultRule* fire = nullptr;
+  for (auto& rule : plan->rules) {
+    bool hit = false;
+    if (rule->every > 0) {
+      uint64_t n = rule->count.fetch_add(1, std::memory_order_relaxed) + 1;
+      hit = n % rule->every == 0;
+    } else if (rule->probability > 0.0) {
+      double draw;
+      {
+        std::lock_guard<std::mutex> lk(plan->rng_mu);
+        draw = std::uniform_real_distribution<double>(0.0, 1.0)(plan->rng);
+      }
+      hit = draw < rule->probability;
+    }
+    if (hit && fire == nullptr) fire = rule.get();
+  }
+  if (fire != nullptr) FireFault(*fire, status_thrower);
+}
+
+// --------------------------------------------------------------- timeouts --
+namespace {
+std::atomic<int> g_timeout_override_ms{0};
+thread_local int tl_timeout_override_ms = 0;
+}  // namespace
+
+int IoTimeoutMs() {
+  if (tl_timeout_override_ms > 0) return tl_timeout_override_ms;
+  int v = g_timeout_override_ms.load(std::memory_order_relaxed);
+  if (v > 0) return v;
+  // env read once: request threads must not race a Python-side setenv
+  // (same rule as the TLS-proxy override, http.cc)
+  static const int env_ms = static_cast<int>(
+      CheckedEnvInt("DMLC_IO_TIMEOUT_MS", 60000, 1, kMsCap));
+  return env_ms;
+}
+
+void SetIoTimeoutMs(int ms) {
+  g_timeout_override_ms.store(ms > 0 ? ms : 0, std::memory_order_relaxed);
+}
+
+ScopedIoTimeout::ScopedIoTimeout(int ms) : saved_(tl_timeout_override_ms) {
+  if (ms > 0) tl_timeout_override_ms = ms;
+}
+
+ScopedIoTimeout::~ScopedIoTimeout() { tl_timeout_override_ms = saved_; }
+
+}  // namespace io
+}  // namespace dct

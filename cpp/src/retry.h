@@ -1,0 +1,195 @@
+// Unified remote-I/O resilience layer shared by every HTTP-backed
+// filesystem (s3/azure/webhdfs/http).
+//
+// The reference's only failure story is a fixed 50 x 100 ms retry loop in
+// the S3 read path (s3_filesys.cc:522-546) and sockets with no timeout at
+// all — a stalled remote peer hangs the parse pipeline forever. This layer
+// replaces that with:
+//   - RetryPolicy: exponential backoff with DECORRELATED JITTER
+//     (sleep = min(cap, uniform(base, prev*3)) — the AWS architecture-blog
+//     variant that both spreads thundering herds and keeps a short first
+//     retry), a per-attempt socket timeout, and an overall per-operation
+//     deadline budget. Configured once via DMLC_IO_MAX_RETRY /
+//     DMLC_IO_BACKOFF_BASE_MS / DMLC_IO_BACKOFF_CAP_MS /
+//     DMLC_IO_DEADLINE_MS / DMLC_IO_TIMEOUT_MS; per-backend env names
+//     (S3_MAX_RETRY, WEBHDFS_RETRY_SLEEP_MS, ...) stay as overrides, and
+//     per-open `?io_*=` URI query args override both.
+//   - RetryController: the runtime loop state (attempt count, previous
+//     sleep, deadline clock) a retry site drives via BackoffOrGiveUp().
+//   - IoStats: process-global atomic counters (requests, retries, timeouts,
+//     injected faults, deadline exhaustions) surfaced through the C ABI
+//     (dct_io_retry_stats) into Python io_stats().
+//   - Fault injection: DMLC_IO_FAULT_PLAN / dct_io_set_fault_plan installs
+//     a deterministic plan ("reset:every=3;stall:every=5,ms=80;5xx:every=7")
+//     evaluated inside the native HTTP client — BELOW every mock — so the
+//     chaos suites prove the real retry machinery, not the test harness.
+//   - CheckedEnvInt: the shared validated config parser (replaces the raw
+//     atoi on S3_MAX_RETRY et al., which silently turned typos into
+//     0-retry or garbage configs).
+#ifndef DCT_RETRY_H_
+#define DCT_RETRY_H_
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <random>
+#include <string>
+
+#include "base.h"
+
+namespace dct {
+
+// A per-attempt timeout expiry (socket connect/recv/send, or an injected
+// stall). Distinct from Error so the stats layer can classify, but callers
+// that only catch Error keep working — timeouts are retryable transport
+// errors like any other drop.
+class TimeoutError : public Error {
+ public:
+  explicit TimeoutError(const std::string& what) : Error(what) {}
+};
+
+namespace io {
+
+// ---------------------------------------------------------------- config --
+// Validated integer env read: returns `dflt` when unset; throws on
+// non-numeric text (a typo'd retry config must not silently become 0
+// retries); clamps into [lo, hi]. The shared replacement for the raw
+// atoi() reads the backends used to do.
+int64_t CheckedEnvInt(const char* name, int64_t dflt, int64_t lo, int64_t hi);
+
+// Parse a decimal integer out of a URI-arg/env value. Throws Error naming
+// `what` on empty/non-numeric text; clamps into [lo, hi].
+int64_t CheckedInt(const std::string& what, const std::string& text,
+                   int64_t lo, int64_t hi);
+
+struct RetryPolicy {
+  int max_retry = 50;        // retries after the first attempt
+  int backoff_base_ms = 100; // first sleep; legacy *_RETRY_SLEEP_MS maps here
+  int backoff_cap_ms = 10000;    // jittered sleeps never exceed this
+  // Per-operation wall-clock budget (one Read call's reconnect loop, one
+  // write request); 0 = unbounded. The default bounds worst-case
+  // time-to-failure: 50 capped jittered sleeps alone would admit ~8 min
+  // of backoff against a persistently sick endpoint, where the legacy
+  // constant loop failed in 5 s.
+  int64_t deadline_ms = 120000;
+  int64_t jitter_seed = -1;      // >=0 pins the jitter RNG (tests)
+
+  // Layered construction: defaults <- DMLC_IO_* <- <prefix>_* overrides.
+  // `prefix` is the backend's env namespace ("S3", "AZURE", "WEBHDFS",
+  // "DCT_HTTP"); reads <prefix>_MAX_RETRY, <prefix>_RETRY_SLEEP_MS
+  // (legacy alias for the backoff base), <prefix>_BACKOFF_BASE_MS,
+  // <prefix>_BACKOFF_CAP_MS, <prefix>_DEADLINE_MS — all through
+  // CheckedEnvInt.
+  static RetryPolicy FromEnv(const char* prefix);
+
+  // Consume one `io_*` URI query arg (io_max_retry, io_backoff_base_ms,
+  // io_backoff_cap_ms, io_deadline_ms, io_timeout_ms). Returns false when
+  // the key is not a retry knob (caller leaves it in the URI). Throws on
+  // non-numeric values.
+  bool ApplyUriArg(const std::string& key, const std::string& value);
+};
+
+// Strip `io_*` retry args from the query segment of `path` in place,
+// applying them to `policy` (and the per-open socket timeout override via
+// io_timeout_ms -> policy handling in the stream). Non-io_* args and paths
+// without a query are left untouched; the '?' is dropped when the query
+// empties. Backends call this at Open/OpenForRead entry so the remaining
+// path is the real object key.
+void ExtractUriRetryArgs(std::string* path, RetryPolicy* policy,
+                         int* timeout_ms_override);
+
+// --------------------------------------------------------------- runtime --
+// Holds a REFERENCE to its policy (which must outlive it): Connect()
+// implementations may tighten the policy mid-loop (the http reader cuts
+// max_retry to 2 once it learns the server ignores Range) and the change
+// must bind the in-flight loop, not just the next one.
+class RetryController {
+ public:
+  explicit RetryController(const RetryPolicy& policy);
+
+  // Call after a retryable failure. Sleeps the next jittered backoff and
+  // returns true, or returns false (recording the giveup) when the retry
+  // count or the deadline budget is exhausted — the caller then rethrows.
+  bool BackoffOrGiveUp();
+
+  int attempts() const { return attempts_; }
+  int64_t elapsed_ms() const;
+
+ private:
+  const RetryPolicy& policy_;
+  std::chrono::steady_clock::time_point start_;
+  int attempts_ = 0;
+  int64_t prev_sleep_ms_;
+  // seeded lazily on the first backoff: a controller is built per Read()
+  // call / per one-shot request, and on the healthy hot path the RNG
+  // (random_device open + mt19937_64 state init) would be pure overhead
+  bool rng_ready_ = false;
+  std::mt19937_64 rng_;
+};
+
+// ----------------------------------------------------------------- stats --
+// Process-global counters; plain atomics so request threads never contend
+// on a lock. Snapshot through the C ABI (dct_io_retry_stats).
+struct IoStats {
+  std::atomic<uint64_t> requests{0};         // HTTP requests sent
+  std::atomic<uint64_t> retries{0};          // backoff sleeps taken
+  std::atomic<uint64_t> backoff_ms_total{0}; // total time slept in backoff
+  std::atomic<uint64_t> timeouts{0};         // per-attempt timeout expiries
+  std::atomic<uint64_t> faults_injected{0};  // DMLC_IO_FAULT_PLAN firings
+  std::atomic<uint64_t> giveups{0};          // retry loops that gave up
+  std::atomic<uint64_t> deadline_exhausted{0};  // giveups due to deadline
+};
+
+IoStats& GlobalIoStats();
+void ResetIoStats();
+
+// --------------------------------------------------------- fault injection --
+// Install a fault plan ("" clears). Grammar, ';'-separated rules:
+//   <kind>:every=N[,ms=M][,status=S]
+// kinds: reset (transport drop), stall (sleep M ms — default 50 — then
+// time out), 5xx (HTTP status S — default 503 — carried as
+// HttpStatusError). Each rule keeps its own atomic request counter and
+// fires on every Nth request it observes, so multi-threaded runs stay
+// deterministic in COUNT (which request draws the fault races, the total
+// does not). Throws Error on bad grammar.
+void SetFaultPlan(const std::string& plan);
+
+// Evaluate the installed plan for one outgoing request (also counts the
+// request). May throw Error / TimeoutError / an HTTP-status error built by
+// `status_thrower` (the http layer passes a lambda that throws its
+// HttpStatusError so this header stays independent of http.h).
+using StatusThrower = void (*)(const std::string& what, int status);
+void MaybeInjectFault(StatusThrower status_thrower);
+
+// Lazily installs DMLC_IO_FAULT_PLAN from the env on first use (explicit
+// SetFaultPlan wins; called by the http client).
+void EnsureFaultPlanFromEnv();
+
+// --------------------------------------------------------------- timeouts --
+// Per-attempt socket-operation timeout (connect/recv/send), milliseconds.
+// Order of precedence: explicit SetIoTimeoutMs override (C ABI, race-free
+// like SetTlsProxyOverride) > DMLC_IO_TIMEOUT_MS > 60000. A hung peer now
+// surfaces as a retryable TimeoutError within this bound instead of
+// blocking forever.
+int IoTimeoutMs();
+void SetIoTimeoutMs(int ms);  // <=0 clears back to env/default
+
+// RAII thread-local timeout override for the current thread's socket ops —
+// how a per-open `?io_timeout_ms=` URI arg applies to exactly the stream
+// that asked for it (socket ops run on the calling thread), without racing
+// other threads' global setting. ms <= 0 is a no-op.
+class ScopedIoTimeout {
+ public:
+  explicit ScopedIoTimeout(int ms);
+  ~ScopedIoTimeout();
+  ScopedIoTimeout(const ScopedIoTimeout&) = delete;
+  ScopedIoTimeout& operator=(const ScopedIoTimeout&) = delete;
+
+ private:
+  int saved_;
+};
+
+}  // namespace io
+}  // namespace dct
+
+#endif  // DCT_RETRY_H_

@@ -239,9 +239,9 @@ void SplitBucketKey(const URI& uri, std::string* bucket, std::string* key) {
 // ---------------------------------------------------------------- reading --
 class S3ReadStream : public RetryingHttpReadStream {
  public:
-  S3ReadStream(const S3Config& cfg, const URI& uri, size_t file_size)
-      : RetryingHttpReadStream("s3", file_size, cfg.max_retry,
-                               cfg.retry_sleep_ms),
+  S3ReadStream(const S3Config& cfg, const URI& uri, size_t file_size,
+               const io::RetryPolicy& policy, int timeout_ms)
+      : RetryingHttpReadStream("s3", file_size, policy, timeout_ms),
         cfg_(cfg), uri_(uri) {
     SplitBucketKey(uri, &bucket_, &key_);
     target_ = ResolveTarget(cfg_, bucket_);
@@ -344,26 +344,12 @@ class S3WriteStream : public Stream {
       const std::vector<std::pair<std::string, std::string>>& query,
       std::map<std::string, std::string> headers, const std::string& body) {
     // write-side retry: 5xx/429 and transport drops are retried like the
-    // read path (RetryingHttpReadStream); request signing is
-    // deterministic, so a resend is byte-identical and parts are idempotent
-    // by partNumber
-    int attempts = 0;
-    while (true) {
-      try {
-        HttpResponse resp = HttpRequest(
-            RouteOf(cfg_, target_), method,
-            s3::UriEncode(path, true) + QueryString(query), headers, body);
-        if (RetryableHttpStatus(resp.status) && attempts < cfg_.max_retry) {
-          ++attempts;
-          usleep(cfg_.retry_sleep_ms * 1000);
-          continue;
-        }
-        return resp;
-      } catch (const Error&) {
-        if (++attempts > cfg_.max_retry) throw;
-        usleep(cfg_.retry_sleep_ms * 1000);
-      }
-    }
+    // read path; request signing is deterministic, so a resend is
+    // byte-identical and parts are idempotent by partNumber
+    return RetryingHttpRequest(
+        RouteOf(cfg_, target_), method,
+        s3::UriEncode(path, true) + QueryString(query), headers, body,
+        cfg_.retry);
   }
 
   void StartMultipart() {
@@ -440,12 +426,10 @@ S3Config S3Config::FromEnv() {
   }
   const char* vs = std::getenv("S3_PATH_STYLE");
   if (vs != nullptr) cfg.path_style = std::atoi(vs) != 0;
-  // fault-tolerance knobs (defaults mirror the reference's <=50 x 100 ms
-  // read-retry loop, s3_filesys.cc:522-546)
-  const char* mr = std::getenv("S3_MAX_RETRY");
-  if (mr != nullptr && *mr != '\0') cfg.max_retry = std::atoi(mr);
-  const char* rs = std::getenv("S3_RETRY_SLEEP_MS");
-  if (rs != nullptr && *rs != '\0') cfg.retry_sleep_ms = std::atoi(rs);
+  // fault-tolerance knobs: DMLC_IO_* layered under the legacy S3_* names,
+  // all through the checked parser (a typo'd S3_MAX_RETRY used to atoi()
+  // to a silent 0-retry config; now it throws)
+  cfg.retry = io::RetryPolicy::FromEnv("S3");
   return cfg;
 }
 
@@ -469,10 +453,12 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
     std::string base = t.base_path.empty() ? "/" : t.base_path;
     auto headers = s3::SignedHeaders(config_, t, "GET", base, q,
                                      crypto::Sha256Hex(""));
+    // metadata requests ride the same resilience policy as data reads
+    // (idempotent GET: RetryingHttpRequest)
     HttpResponse resp =
-        HttpRequest(s3::RouteOf(config_, t), "GET",
-                    s3::UriEncode(base, true) + s3::QueryString(q),
-                    headers, "");
+        RetryingHttpRequest(s3::RouteOf(config_, t), "GET",
+                            s3::UriEncode(base, true) + s3::QueryString(q),
+                            headers, "", config_.retry);
     DCT_CHECK(resp.status == 200)
         << "s3 ListObjects failed: " << resp.status << " " << resp.body;
     // scan <Contents><Key>..</Key><Size>..</Size></Contents> and
@@ -531,6 +517,11 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
 }
 
 FileInfo S3FileSystem::GetPathInfo(const URI& path) {
+  return PathInfoUnderPolicy(path, config_.retry);
+}
+
+FileInfo S3FileSystem::PathInfoUnderPolicy(const URI& path,
+                                           const io::RetryPolicy& policy) {
   // TryGetPathInfo via ListObjects with the exact key as prefix
   // (reference s3_filesys.cc:1221-1239); file-vs-directory resolution is
   // the shared ProbePathInfo (listing.h)
@@ -544,9 +535,9 @@ FileInfo S3FileSystem::GetPathInfo(const URI& path) {
     auto headers =
         s3::SignedHeaders(config_, t, "GET", base, q, crypto::Sha256Hex(""));
     HttpResponse resp =
-        HttpRequest(s3::RouteOf(config_, t), "GET",
-                    s3::UriEncode(base, true) + s3::QueryString(q), headers,
-                    "");
+        RetryingHttpRequest(s3::RouteOf(config_, t), "GET",
+                            s3::UriEncode(base, true) + s3::QueryString(q),
+                            headers, "", policy);
     DCT_CHECK(resp.status == 200)
         << "s3 ListObjects failed: " << resp.status << " " << resp.body;
     ListedPage page;
@@ -574,11 +565,22 @@ FileInfo S3FileSystem::GetPathInfo(const URI& path) {
 }
 
 SeekStream* S3FileSystem::OpenForRead(const URI& path, bool allow_null) {
+  // per-open resilience overrides ride `?io_*=` query args (retry.h); the
+  // stripped path is the real object key
+  URI clean = path;
+  io::RetryPolicy policy = config_.retry;
+  int timeout_ms = 0;
+  io::ExtractUriRetryArgs(&clean.path, &policy, &timeout_ms);
+  // the per-open socket-timeout override must bind the open-time metadata
+  // probe too, or a stalled endpoint holds `open` for the global 60 s
+  // despite the URI asking for less
+  io::ScopedIoTimeout scoped_timeout(timeout_ms);
   try {
-    FileInfo info = GetPathInfo(path);
+    FileInfo info = PathInfoUnderPolicy(clean, policy);
     DCT_CHECK(info.type == FileType::kFile)
-        << "cannot open s3 directory for read: " << path.Str();
-    return new s3::S3ReadStream(config_, path, info.size);
+        << "cannot open s3 directory for read: " << clean.Str();
+    return new s3::S3ReadStream(config_, clean, info.size, policy,
+                                timeout_ms);
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;

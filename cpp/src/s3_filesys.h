@@ -18,6 +18,7 @@
 #include <vector>
 
 #include "filesys.h"
+#include "retry.h"
 
 namespace dct {
 
@@ -33,8 +34,10 @@ struct S3Config {
   // no-endpoint AWS default is https — the real service is TLS-only.
   std::string scheme = "http";
   bool path_style = false;    // true for custom endpoints (bucket in path)
-  int max_retry = 50;
-  int retry_sleep_ms = 100;
+  // Shared resilience policy (retry.h): DMLC_IO_* globals overridden by
+  // S3_MAX_RETRY / S3_RETRY_SLEEP_MS (legacy, checked parsing now) /
+  // S3_BACKOFF_BASE_MS / S3_BACKOFF_CAP_MS / S3_DEADLINE_MS.
+  io::RetryPolicy retry;
 
   // Environment chain: S3_* falling back to AWS_* (reference
   // s3_filesys.cc:1150-1214). S3_ENDPOINT accepts "host:port" or
@@ -56,6 +59,12 @@ class S3FileSystem : public FileSystem {
   const S3Config& config() const { return config_; }
 
  private:
+  // GetPathInfo under an explicit resilience policy — OpenForRead routes
+  // its per-open `?io_*=` overrides through here so the open-time probe
+  // honors the caller's budget, not just the env default.
+  FileInfo PathInfoUnderPolicy(const URI& path,
+                               const io::RetryPolicy& policy);
+
   S3Config config_;
 };
 

@@ -3,7 +3,13 @@
 // and SingleFileSplit. Mirrors the reference's gtest suite role
 // (test/unittest/*.cc) with a dependency-free assert harness; run by
 // tests/test_native_core.py via subprocess.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <cassert>
+#include <chrono>
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
@@ -36,6 +42,7 @@
 #include "../src/recordio.h"
 #include "../src/http.h"
 #include "../src/registry.h"
+#include "../src/retry.h"
 #include "../src/s3_filesys.h"
 #include "../src/serializer.h"
 #include "../src/stream.h"
@@ -1166,12 +1173,310 @@ void TestThreadedRecParse() {
   ExpectSummariesMatch(serial, fanout);
 }
 
+// ---- remote-I/O resilience layer (retry.h) -- the `--io` / tsan-io suite --
+
+void TestCheckedEnvParse() {
+  ::setenv("DCT_TEST_IO_INT", "17", 1);
+  EXPECT(dct::io::CheckedEnvInt("DCT_TEST_IO_INT", 3, 0, 100) == 17);
+  EXPECT(dct::io::CheckedEnvInt("DCT_TEST_IO_ABSENT", 3, 0, 100) == 3);
+  // clamped, not silently wrong
+  EXPECT(dct::io::CheckedEnvInt("DCT_TEST_IO_INT", 3, 0, 10) == 10);
+  ::setenv("DCT_TEST_IO_INT", "-5", 1);
+  EXPECT(dct::io::CheckedEnvInt("DCT_TEST_IO_INT", 3, 0, 100) == 0);
+  // non-numeric text throws instead of atoi()-ing to 0
+  ::setenv("DCT_TEST_IO_INT", "fifty", 1);
+  bool threw = false;
+  try {
+    dct::io::CheckedEnvInt("DCT_TEST_IO_INT", 3, 0, 100);
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  EXPECT(threw);
+  ::setenv("DCT_TEST_IO_INT", "12x", 1);
+  threw = false;
+  try {
+    dct::io::CheckedEnvInt("DCT_TEST_IO_INT", 3, 0, 100);
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  EXPECT(threw);
+  ::unsetenv("DCT_TEST_IO_INT");
+}
+
+void TestRetryPolicyFromEnvLayering() {
+  // global DMLC_IO_* layer, overridden by the backend prefix layer (the
+  // legacy <P>_RETRY_SLEEP_MS name maps onto the backoff base)
+  ::setenv("DMLC_IO_MAX_RETRY", "9", 1);
+  ::setenv("DMLC_IO_BACKOFF_BASE_MS", "20", 1);
+  ::setenv("DMLC_IO_DEADLINE_MS", "4000", 1);
+  ::setenv("T9_MAX_RETRY", "4", 1);
+  ::setenv("T9_RETRY_SLEEP_MS", "7", 1);
+  dct::io::RetryPolicy p = dct::io::RetryPolicy::FromEnv("T9");
+  EXPECT(p.max_retry == 4);
+  EXPECT(p.backoff_base_ms == 7);
+  EXPECT(p.deadline_ms == 4000);
+  dct::io::RetryPolicy q = dct::io::RetryPolicy::FromEnv("T8");
+  EXPECT(q.max_retry == 9);
+  EXPECT(q.backoff_base_ms == 20);
+  ::unsetenv("DMLC_IO_MAX_RETRY");
+  ::unsetenv("DMLC_IO_BACKOFF_BASE_MS");
+  ::unsetenv("DMLC_IO_DEADLINE_MS");
+  ::unsetenv("T9_MAX_RETRY");
+  ::unsetenv("T9_RETRY_SLEEP_MS");
+}
+
+void TestExtractUriRetryArgs() {
+  dct::io::RetryPolicy p;
+  int timeout_ms = 0;
+  std::string path = "/bkt/key?io_max_retry=3&fmt=csv&io_deadline_ms=250"
+                     "&io_timeout_ms=99";
+  dct::io::ExtractUriRetryArgs(&path, &p, &timeout_ms);
+  EXPECT(path == "/bkt/key?fmt=csv");  // foreign args survive
+  EXPECT(p.max_retry == 3);
+  EXPECT(p.deadline_ms == 250);
+  EXPECT(timeout_ms == 99);
+  // all-ours query drops the '?' entirely
+  path = "/k?io_backoff_base_ms=2&io_backoff_cap_ms=8";
+  dct::io::ExtractUriRetryArgs(&path, &p, &timeout_ms);
+  EXPECT(path == "/k");
+  EXPECT(p.backoff_base_ms == 2 && p.backoff_cap_ms == 8);
+  // no query is a no-op; garbage values throw (checked parser)
+  path = "/plain";
+  dct::io::ExtractUriRetryArgs(&path, &p, &timeout_ms);
+  EXPECT(path == "/plain");
+  path = "/k?io_max_retry=banana";
+  bool threw = false;
+  try {
+    dct::io::ExtractUriRetryArgs(&path, &p, &timeout_ms);
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  EXPECT(threw);
+}
+
+void TestRetryBackoffDeterministicAndBounded() {
+  dct::io::ResetIoStats();
+  dct::io::RetryPolicy p;
+  p.max_retry = 6;
+  p.backoff_base_ms = 1;
+  p.backoff_cap_ms = 4;
+  p.jitter_seed = 42;
+  auto run = [&] {
+    dct::io::RetryController ctl(p);
+    int ok = 0;
+    while (ctl.BackoffOrGiveUp()) ++ok;
+    return ok;
+  };
+  uint64_t before = dct::io::GlobalIoStats().backoff_ms_total.load();
+  int a = run();
+  uint64_t mid = dct::io::GlobalIoStats().backoff_ms_total.load();
+  int b = run();
+  uint64_t after = dct::io::GlobalIoStats().backoff_ms_total.load();
+  EXPECT(a == 6 && b == 6);  // exactly max_retry sleeps, then giveup
+  // same seed -> identical jitter sequence; every sleep within [base, cap]
+  EXPECT(mid - before == after - mid);
+  EXPECT(mid - before >= 6u * 1u && mid - before <= 6u * 4u);
+  EXPECT(dct::io::GlobalIoStats().retries.load() == 12u);
+  EXPECT(dct::io::GlobalIoStats().giveups.load() == 2u);
+}
+
+void TestRetryDeadlineExhaustion() {
+  dct::io::ResetIoStats();
+  dct::io::RetryPolicy p;
+  p.max_retry = 1000000;  // retries alone would run ~forever
+  p.backoff_base_ms = 5;
+  p.backoff_cap_ms = 10;
+  p.deadline_ms = 60;
+  p.jitter_seed = 1;
+  dct::io::RetryController ctl(p);
+  auto t0 = std::chrono::steady_clock::now();
+  int loops = 0;
+  while (ctl.BackoffOrGiveUp()) ++loops;
+  auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+  EXPECT(loops >= 1);
+  EXPECT(elapsed >= 50 && elapsed < 2000);  // bounded by the budget
+  EXPECT(dct::io::GlobalIoStats().deadline_exhausted.load() == 1u);
+  EXPECT(dct::io::GlobalIoStats().giveups.load() == 1u);
+}
+
+void TestFaultPlanParseAndDeterministicTick() {
+  dct::io::ResetIoStats();
+  // bad grammar throws (out-of-range numerics merely clamp — the shared
+  // checked parser's contract: reject garbage, clamp extremes)
+  for (const char* bad :
+       {"flood:every=3", "reset", "reset:every=x", "5xx:rate=2",
+        "stall:ms=abc,every=2", "reset:p=1.5"}) {
+    bool threw = false;
+    try {
+      dct::io::SetFaultPlan(bad);
+    } catch (const dct::Error&) {
+      threw = true;
+    }
+    EXPECT(threw);
+  }
+  auto thrower = [](const std::string& what, int status) {
+    throw dct::HttpStatusError(what, status);
+  };
+  dct::io::SetFaultPlan("reset:every=4;5xx:every=6,status=599");
+  int resets = 0, fivexx = 0, clean = 0;
+  for (int i = 0; i < 24; ++i) {
+    try {
+      dct::io::MaybeInjectFault(thrower);
+      ++clean;
+    } catch (const dct::HttpStatusError& e) {
+      EXPECT(e.status == 599);
+      ++fivexx;
+    } catch (const dct::Error&) {
+      ++resets;
+    }
+  }
+  // every 4th of 24 -> 6 resets; every 6th -> 4 hits for 5xx, of which
+  // multiples of both (12, 24) fire as the first-listed rule (reset)
+  EXPECT(resets == 6);
+  EXPECT(fivexx == 2);
+  EXPECT(clean == 16);
+  EXPECT(dct::io::GlobalIoStats().faults_injected.load() == 8u);
+  EXPECT(dct::io::GlobalIoStats().requests.load() == 24u);
+  // stall fires as a TimeoutError after sleeping its ms
+  dct::io::SetFaultPlan("stall:every=1,ms=1");
+  bool timed = false;
+  try {
+    dct::io::MaybeInjectFault(thrower);
+  } catch (const dct::TimeoutError&) {
+    timed = true;
+  }
+  EXPECT(timed);
+  dct::io::SetFaultPlan("");
+  dct::io::MaybeInjectFault(thrower);  // cleared: no throw
+}
+
+void TestFaultPlanThreadSafety() {
+  // shared mutable state under concurrent tick: rule counters are atomic,
+  // so the TOTAL fault count is exact even when the firing thread races
+  dct::io::ResetIoStats();
+  auto thrower = [](const std::string& what, int status) {
+    throw dct::HttpStatusError(what, status);
+  };
+  dct::io::SetFaultPlan("reset:every=5");
+  constexpr int kThreads = 4, kPerThread = 250;
+  std::atomic<int> faults{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&] {
+      for (int i = 0; i < kPerThread; ++i) {
+        try {
+          dct::io::MaybeInjectFault(thrower);
+        } catch (const dct::Error&) {
+          faults.fetch_add(1);
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  EXPECT(faults.load() == kThreads * kPerThread / 5);
+  EXPECT(dct::io::GlobalIoStats().faults_injected.load() ==
+         static_cast<uint64_t>(kThreads * kPerThread / 5));
+  dct::io::SetFaultPlan("");
+}
+
+void TestHttpRecvTimeoutOnStalledServer() {
+  // a server that accepts and then goes silent must surface as a bounded
+  // retryable TimeoutError, not an infinite block (the ISSUE's headline
+  // failure mode)
+  int listener = ::socket(AF_INET, SOCK_STREAM, 0);
+  EXPECT(listener >= 0);
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  EXPECT(::bind(listener, reinterpret_cast<struct sockaddr*>(&addr),
+                sizeof(addr)) == 0);
+  EXPECT(::listen(listener, 1) == 0);
+  socklen_t alen = sizeof(addr);
+  EXPECT(::getsockname(listener, reinterpret_cast<struct sockaddr*>(&addr),
+                       &alen) == 0);
+  int port = ntohs(addr.sin_port);
+  std::atomic<int> conn_fd{-1};
+  std::thread server([&] {
+    int fd = ::accept(listener, nullptr, nullptr);
+    conn_fd.store(fd);  // hold it open, never answer
+  });
+  dct::io::SetIoTimeoutMs(120);
+  bool timed_out = false;
+  auto t0 = std::chrono::steady_clock::now();
+  try {
+    dct::HttpConnection conn("127.0.0.1", port);
+    conn.SendRequest("GET", "/stall", {}, "");
+    dct::HttpResponse head;
+    conn.ReadResponseHead(&head);
+  } catch (const dct::TimeoutError&) {
+    timed_out = true;
+  }
+  auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+  dct::io::SetIoTimeoutMs(0);
+  EXPECT(timed_out);
+  EXPECT(elapsed >= 100 && elapsed < 5000);
+  EXPECT(dct::io::GlobalIoStats().timeouts.load() >= 1u);
+  server.join();
+  if (conn_fd.load() >= 0) ::close(conn_fd.load());
+  ::close(listener);
+}
+
+void TestScopedIoTimeoutIsThreadLocal() {
+  dct::io::SetIoTimeoutMs(0);
+  const int base = dct::io::IoTimeoutMs();
+  {
+    dct::io::ScopedIoTimeout scoped(123);
+    EXPECT(dct::io::IoTimeoutMs() == 123);
+    int other_thread_value = -1;
+    std::thread peer(
+        [&] { other_thread_value = dct::io::IoTimeoutMs(); });
+    peer.join();
+    EXPECT(other_thread_value == base);  // override is per-thread
+    {
+      dct::io::ScopedIoTimeout inner(0);  // <=0: no-op, keeps 123
+      EXPECT(dct::io::IoTimeoutMs() == 123);
+    }
+  }
+  EXPECT(dct::io::IoTimeoutMs() == base);
+}
+
+void RunIoResilienceSuite() {
+  TestCheckedEnvParse();
+  TestRetryPolicyFromEnvLayering();
+  TestExtractUriRetryArgs();
+  TestRetryBackoffDeterministicAndBounded();
+  TestRetryDeadlineExhaustion();
+  TestFaultPlanParseAndDeterministicTick();
+  TestFaultPlanThreadSafety();
+  TestHttpRecvTimeoutOnStalledServer();
+  TestScopedIoTimeoutIsThreadLocal();
+  dct::io::ResetIoStats();
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
   if (argc > 1 && std::string(argv[1]) == "--stdin") {
     TestStdinSplit();
     return 0;
+  }
+  if (argc > 1 && std::string(argv[1]) == "--io") {
+    // the remote-I/O resilience suite alone — the cpp/Makefile tsan-io
+    // lane runs exactly this under ThreadSanitizer (the fault hook and
+    // io-retry counters are shared mutable state)
+    RunIoResilienceSuite();
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
   }
   if (argc > 1 && std::string(argv[1]) == "--pipeline") {
     // the parse-pipeline concurrency suite alone — the cpp/Makefile
@@ -1217,6 +1522,7 @@ int main(int argc, char** argv) {
   TestParsePipelineReaderThrow();
   TestThreadedTextParse();
   TestThreadedRecParse();
+  RunIoResilienceSuite();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;

@@ -25,7 +25,7 @@ from typing import BinaryIO, Callable, Dict, Iterator, Optional
 
 import numpy as np
 
-from dmlc_core_tpu.base import DMLCError, log_info
+from dmlc_core_tpu.base import DMLCError, log_info, log_warning
 from dmlc_core_tpu.io.native import NativeParser, RowBlock
 from dmlc_core_tpu.registry import Registry
 from dmlc_core_tpu.serializer import BinaryReader, BinaryWriter
@@ -358,22 +358,62 @@ class RowBlockIter:
     yields that single block (reference src/data/basic_row_iter.h). With
     ``#cachefile`` the native DiskCacheParser serves blocks from its binary
     cache and iteration is page-at-a-time (reference disk_row_iter.h).
-    For the TPU path use dmlc_core_tpu.tpu.DeviceRowBlockIter instead."""
+    For the TPU path use dmlc_core_tpu.tpu.DeviceRowBlockIter instead.
 
-    def __init__(self, parser, eager: bool):
+    ``on_error`` is the graceful-degradation knob for remote sources that
+    stay broken past the native retry budget (cpp/src/retry.h): ``"raise"``
+    (default) propagates, ``"skip"`` logs the error, counts it in
+    ``skipped_batches``, and keeps pulling blocks — after
+    ``_MAX_CONSECUTIVE_ERRORS`` consecutive failures the shard is treated
+    as exhausted so a training loop rides through a transiently bad shard
+    instead of dying mid-epoch. ``io_stats()`` exposes the retry/fault
+    counters plus the skip count (see doc/robustness.md)."""
+
+    _MAX_CONSECUTIVE_ERRORS = 3
+
+    def __init__(self, parser, eager: bool, on_error: str = "raise"):
+        if on_error not in ("raise", "skip"):
+            raise DMLCError(
+                f"on_error must be 'raise' or 'skip', got {on_error!r}")
         self._parser = parser
         self._eager = eager
+        self._on_error = on_error
         self._block: Optional[RowBlockContainer] = None
+        self.skipped_batches = 0
+        self.last_error: Optional[str] = None
 
     @staticmethod
     def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
                nthread: int = 0, index64: bool = False,
-               chunks_in_flight: int = 0) -> "RowBlockIter":
-        """Factory matching reference RowBlockIter<I>::Create (data.h:267)."""
+               chunks_in_flight: int = 0,
+               on_error: str = "raise") -> "RowBlockIter":
+        """Factory matching reference RowBlockIter<I>::Create (data.h:267);
+        ``on_error="skip"`` enables graceful degradation (class doc)."""
         parser = Parser.create(uri, part, npart, fmt, nthread=nthread,
                                index64=index64,
                                chunks_in_flight=chunks_in_flight)
-        return RowBlockIter(parser, eager="#" not in uri)
+        return RowBlockIter(parser, eager="#" not in uri, on_error=on_error)
+
+    def _next_block_degradable(self):
+        """next_block() honoring on_error: with "skip", a failing pull is
+        retried on the next block up to _MAX_CONSECUTIVE_ERRORS times
+        before the source counts as exhausted (returns None)."""
+        consecutive = 0
+        while True:
+            try:
+                return self._parser.next_block()
+            except DMLCError as e:
+                if self._on_error != "skip":
+                    raise
+                self.skipped_batches += 1
+                self.last_error = str(e)
+                consecutive += 1
+                log_warning(
+                    "row-block pull failed (%d consecutive, %d skipped "
+                    "total); on_error=skip: %s",
+                    consecutive, self.skipped_batches, e)
+                if consecutive >= self._MAX_CONSECUTIVE_ERRORS:
+                    return None  # shard is gone; end the epoch cleanly
 
     def _load_eager(self) -> RowBlockContainer:
         if self._block is None:
@@ -385,7 +425,7 @@ class RowBlockIter:
             t0 = time.time()
             next_log = 10 << 20  # MB/s every 10 MB (basic_row_iter.h:70-73)
             while True:
-                b = self._parser.next_block()
+                b = self._next_block_degradable()
                 if b is None:
                     break
                 blocks.append(RowBlockContainer.from_blocks([b]))
@@ -404,7 +444,7 @@ class RowBlockIter:
             return
         self._parser.before_first()
         while True:
-            b = self._parser.next_block()
+            b = self._next_block_degradable()
             if b is None:
                 return
             yield RowBlockContainer.from_blocks([b])
@@ -434,6 +474,15 @@ class RowBlockIter:
         formats / unpipelined parsers."""
         stats = getattr(self._parser, "pipeline_stats", None)
         return stats() if stats is not None else None
+
+    def io_stats(self) -> dict:
+        """Remote-I/O resilience counters (io.native.io_retry_stats —
+        process-global retries/timeouts/faults across all native streams)
+        plus this iterator's ``skipped_batches`` from on_error="skip"."""
+        from dmlc_core_tpu.io.native import io_retry_stats
+        out = io_retry_stats()
+        out["skipped_batches"] = self.skipped_batches
+        return out
 
     def close(self) -> None:
         """Release the native parser handle (idempotent)."""

@@ -4,6 +4,11 @@ The reference is consumed as a C++ library; here the native core carries the
 hot host path (streams, record-aligned InputSplit, RecordIO, multithreaded
 parsers — reference L3-L5 layers) and Python/JAX ride on this binding. The
 shared library is auto-built from cpp/ on first import when missing or stale.
+
+Remote-I/O resilience (retries with decorrelated-jitter backoff, deadlines,
+per-attempt socket timeouts, fault injection) is configured through the
+``DMLC_IO_*`` env knobs / ``?io_*=`` URI args and observed through
+:func:`io_retry_stats`; see [robustness.md](robustness.md) for the model.
 """
 
 from __future__ import annotations
@@ -68,6 +73,19 @@ class ParsePipelineStatsC(ctypes.Structure):
         ("inflight_sum", ctypes.c_uint64),
         ("capacity", ctypes.c_uint64),
         ("workers", ctypes.c_uint64),
+    ]
+
+
+class IoRetryStatsC(ctypes.Structure):
+    """Mirror of dct_io_retry_stats_t in cpp/src/capi.cc."""
+    _fields_ = [
+        ("requests", ctypes.c_uint64),
+        ("retries", ctypes.c_uint64),
+        ("backoff_ms_total", ctypes.c_uint64),
+        ("timeouts", ctypes.c_uint64),
+        ("faults_injected", ctypes.c_uint64),
+        ("giveups", ctypes.c_uint64),
+        ("deadline_exhausted", ctypes.c_uint64),
     ]
 
 
@@ -144,6 +162,10 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_webhdfs_set_delegation_token": [c.c_char_p],
         "dct_webhdfs_set_auth_header": [c.c_char_p],
         "dct_set_tls_proxy": [c.c_char_p],
+        "dct_io_retry_stats": [c.POINTER(IoRetryStatsC)],
+        "dct_io_stats_reset": [],
+        "dct_io_set_fault_plan": [c.c_char_p],
+        "dct_io_set_timeout_ms": [i],
         "dct_parser_formats_doc": [c.POINTER(c.c_char_p)],
         "dct_batcher_create": [c.c_char_p, u, u, c.c_char_p, i, i,
                                c.c_uint64, c.c_uint32, c.c_uint64,
@@ -350,6 +372,52 @@ def parser_formats_doc() -> str:
         return ctypes.string_at(out).decode()
     finally:
         lib().dct_str_free(out)
+
+
+# -- remote-I/O resilience ---------------------------------------------------
+def io_retry_stats() -> dict:
+    """Process-global remote-I/O resilience counters (cpp/src/retry.h
+    IoStats, shared by every s3/azure/hdfs/http request): ``requests``
+    (HTTP requests sent), ``retries`` (backoff sleeps taken),
+    ``backoff_ms_total``, ``timeouts`` (per-attempt socket timeout
+    expiries), ``faults_injected`` (fault-plan firings), ``giveups``
+    (retry loops that exhausted their budget) and ``deadline_exhausted``
+    (the subset of giveups caused by the per-operation deadline). See
+    [robustness.md](robustness.md) for the retry model."""
+    s = IoRetryStatsC()
+    _check(lib().dct_io_retry_stats(ctypes.byref(s)))
+    return {name: int(getattr(s, name)) for name, _ in s._fields_}
+
+
+def reset_io_retry_stats() -> None:
+    """Zero the global io_retry_stats() counters (test isolation / epoch
+    accounting)."""
+    _check(lib().dct_io_stats_reset())
+
+
+def set_io_fault_plan(plan: str) -> None:
+    """Install a deterministic fault-injection plan inside the native HTTP
+    client — BELOW every mock server and every backend, so chaos tests
+    exercise the real retry machinery. Grammar (cpp/src/retry.h), rules
+    ';'-separated::
+
+        reset:every=3;stall:every=5,ms=80;5xx:every=7,status=503
+
+    kinds: ``reset`` (transport drop), ``stall`` (sleep ``ms`` then time
+    out), ``5xx`` (HTTP ``status``); ``every=N`` fires on every Nth
+    request, ``p=0.1`` fires with seeded probability (DMLC_IO_FAULT_SEED).
+    Empty string clears. Raises on bad grammar. Prefer this setter over
+    mutating DMLC_IO_FAULT_PLAN after native threads exist (same race rule
+    as the TLS-proxy override)."""
+    _check(lib().dct_io_set_fault_plan(plan.encode()))
+
+
+def set_io_timeout_ms(ms: int) -> None:
+    """Override the per-attempt socket timeout (connect/recv/send bound in
+    milliseconds) for all native remote I/O; ``ms <= 0`` reverts to
+    DMLC_IO_TIMEOUT_MS / the 60 s default. Per-open ``?io_timeout_ms=``
+    URI args override this for one stream."""
+    _check(lib().dct_io_set_timeout_ms(ms))
 
 
 def set_webhdfs_delegation_token(token: str) -> None:
@@ -673,6 +741,12 @@ class NativeParser:
         out["occupancy_avg"] = (round(s.inflight_sum / s.chunks_read, 3)
                                 if s.chunks_read else 0.0)
         return out
+
+    def io_stats(self) -> dict:
+        """Remote-I/O resilience counters (module-level io_retry_stats —
+        the counters are process-global across all native streams; local
+        files never touch them)."""
+        return io_retry_stats()
 
     def close(self) -> None:
         """Free the native parser handle (idempotent)."""

@@ -246,6 +246,8 @@ def gen_index() -> str:
         "strategies (DP/SP/TP/EP/PP) and their oracles |",
         "| [pipeline.md](pipeline.md) | the multi-chunk parse pipeline: "
         "stages, knobs, occupancy counters |",
+        "| [robustness.md](robustness.md) | remote-I/O resilience: retry "
+        "model, env/URI knobs, fault-plan grammar, io_stats() |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
         "",

@@ -21,9 +21,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # fast retry loops for the fault-injection suites (the S3 config singleton
-# reads these once, at first native S3 use — set them before any test runs)
+# reads these once, at first native S3 use — set them before any test runs).
+# The backoff cap + jitter seed keep the decorrelated-jitter sleeps tiny and
+# reproducible under test (cpp/src/retry.h RetryPolicy).
 os.environ.setdefault("S3_MAX_RETRY", "10")
 os.environ.setdefault("S3_RETRY_SLEEP_MS", "5")
+os.environ.setdefault("DMLC_IO_BACKOFF_CAP_MS", "50")
+os.environ.setdefault("DMLC_IO_JITTER_SEED", "7")
 
 import jax  # noqa: E402
 

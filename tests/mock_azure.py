@@ -19,17 +19,29 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tests.mock_s3 import (FaultCounterMixin, reset_connection,
+                           stall_connection, truncate_body)
+
 ACCOUNT = "testaccount"
 KEY_B64 = base64.b64encode(b"super-secret-azure-key-0123456789").decode()
 
 
-class MockAzureState:
+class MockAzureState(FaultCounterMixin):
     def __init__(self):
         self.blobs = {}          # (container, name) -> bytes
         self.blocks = {}         # (container, name) -> {block_id: bytes}
         self.fail_reads_after = None
         self.reject_writes = False    # 403 every PUT (close-error test)
         self.requests = []       # (method, path) log
+        # fault plan matching mock_s3's knobs (blob GETs only; listings
+        # stay healthy — the metadata probe shares the retry policy but the
+        # chaos suites schedule faults on the data path)
+        self.get_truncate_every = 0   # every Nth GET: body cut mid-stream
+        self.get_500_every = 0        # every Nth GET: 500 before body
+        self.stall_every = 0          # accept, sleep past client deadline
+        self.stall_seconds = 3.0
+        self.reset_every = 0          # RST mid-header
+        self._init_fault_counters("get500", "gettrunc", "stall", "reset")
 
 
 class MockAzureHandler(BaseHTTPRequestHandler):
@@ -113,6 +125,14 @@ class MockAzureHandler(BaseHTTPRequestHandler):
             hi = int(m.group(2)) + 1 if m.group(2) else len(data)
             data = data[lo:hi]
             status = 206
+        if st._tick("stall", st.stall_every):
+            return stall_connection(self, st.stall_seconds)
+        if st._tick("reset", st.reset_every):
+            return reset_connection(self)
+        if st._tick("get500", st.get_500_every):
+            return self._reject(500, "InternalError")
+        if st._tick("gettrunc", st.get_truncate_every):
+            return truncate_body(self, status, data)
         if st.fail_reads_after is not None and len(data) > st.fail_reads_after:
             out = data[: st.fail_reads_after]
             self.send_response(status)

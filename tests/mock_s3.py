@@ -14,7 +14,10 @@ from __future__ import annotations
 import hashlib
 import hmac
 import re
+import socket
+import struct
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -32,7 +35,24 @@ def _sign(secret, date, region, string_to_sign):
     return hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
 
 
-class MockS3State:
+class FaultCounterMixin:
+    """Every-Nth fault scheduling shared by the backend mocks: each fault
+    kind keeps a lock-guarded counter; ``_tick(kind, every)`` says whether
+    this request draws the fault."""
+
+    def _init_fault_counters(self, *kinds):
+        self._fault_lock = threading.Lock()
+        self._counters = {k: 0 for k in kinds}
+
+    def _tick(self, kind, every):
+        if not every:
+            return False
+        with self._fault_lock:
+            self._counters[kind] += 1
+            return self._counters[kind] % every == 0
+
+
+class MockS3State(FaultCounterMixin):
     def __init__(self):
         self.objects = {}        # (bucket, key) -> bytes
         self.uploads = {}        # upload_id -> {num: bytes}
@@ -45,15 +65,47 @@ class MockS3State:
         self.get_500_every = 0        # every Nth GET: 500 before body
         self.part_500_every = 0       # every Nth part PUT: 500
         self.complete_truncate_once = False  # one truncated Complete XML
-        self.lock = threading.Lock()
-        self._counters = {"get500": 0, "gettrunc": 0, "part": 0}
+        # hung-server faults (object GETs only, like the knobs above):
+        # stall_every: accept, then sleep stall_seconds — past the client's
+        # per-attempt timeout — before closing without a response;
+        # reset_every: RST the connection mid-header (SO_LINGER 0)
+        self.stall_every = 0
+        self.stall_seconds = 3.0
+        self.reset_every = 0
+        self._init_fault_counters("get500", "gettrunc", "part", "stall",
+                                  "reset")
 
-    def _tick(self, kind, every):
-        if not every:
-            return False
-        with self.lock:
-            self._counters[kind] += 1
-            return self._counters[kind] % every == 0
+
+def truncate_body(handler, status, data):
+    """Mid-stream truncation: declared full length, half the body, then
+    the connection is cut — the client must reconnect at offset."""
+    out = data[: max(len(data) // 2, 1)]
+    handler.send_response(status)
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(out)
+    handler.close_connection = True
+
+
+def stall_connection(handler, seconds):
+    """Hold the accepted connection silent past the client deadline, then
+    close with no response — the hung-server shape the socket timeouts in
+    cpp/src/http.cc exist for."""
+    time.sleep(seconds)
+    handler.close_connection = True
+
+
+def reset_connection(handler):
+    """Close the socket mid-header with RST (SO_LINGER 0): the client sees
+    a partial response head and a hard transport error."""
+    try:
+        handler.wfile.write(b"HTTP/1.1 200 OK\r\nContent-Le")
+        handler.wfile.flush()
+        handler.connection.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                      struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    handler.close_connection = True
 
 
 class MockS3Handler(BaseHTTPRequestHandler):
@@ -142,17 +194,14 @@ class MockS3Handler(BaseHTTPRequestHandler):
             hi = int(m.group(2)) + 1 if m.group(2) else len(data)
             data = data[lo:hi]
             status = 206
+        if st._tick("stall", st.stall_every):
+            return stall_connection(self, st.stall_seconds)
+        if st._tick("reset", st.reset_every):
+            return reset_connection(self)
         if st._tick("get500", st.get_500_every):
             return self._reject(500, "InternalError")
         if st._tick("gettrunc", st.get_truncate_every):
-            # mid-stream drop: declared length, half the body, connection cut
-            out = data[: max(len(data) // 2, 1)]
-            self.send_response(status)
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(out)
-            self.close_connection = True
-            return
+            return truncate_body(self, status, data)
         if st.fail_reads_after is not None and len(data) > st.fail_reads_after:
             # simulate a flaky connection: send a truncated body
             out = data[: st.fail_reads_after]

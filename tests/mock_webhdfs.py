@@ -15,8 +15,11 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tests.mock_s3 import (FaultCounterMixin, reset_connection,
+                           stall_connection, truncate_body)
 
-class MockHdfsState:
+
+class MockHdfsState(FaultCounterMixin):
     def __init__(self):
         self.files = {}          # absolute path -> bytes
         self.fail_reads_after = None  # int: truncate OPEN bodies (retry test)
@@ -32,17 +35,18 @@ class MockHdfsState:
         # WWW-Authenticate challenge otherwise, like a secured namenode
         self.require_auth_header = None
         self.seen_auth_headers = []   # Authorization values received
-        # fault injection (VERDICT r1 item 6): every Nth GET 500s
+        # fault injection (VERDICT r1 item 6): every Nth OPEN 500s; the
+        # stall/reset/truncate knobs mirror mock_s3's and likewise hit only
+        # the retried OPEN data path
         self.get_500_every = 0
-        self._get_count = 0
-        self._lock = threading.Lock()
+        self.get_truncate_every = 0   # every Nth OPEN body: cut mid-stream
+        self.stall_every = 0          # accept, sleep past client deadline
+        self.stall_seconds = 3.0
+        self.reset_every = 0          # RST mid-header
+        self._init_fault_counters("get500", "gettrunc", "stall", "reset")
 
     def tick_500(self) -> bool:
-        if not self.get_500_every:
-            return False
-        with self._lock:
-            self._get_count += 1
-            return self._get_count % self.get_500_every == 0
+        return self._tick("get500", self.get_500_every)
 
 
 class MockHdfsHandler(BaseHTTPRequestHandler):
@@ -153,10 +157,15 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
         if not self._check_spnego(q):
             return
         op = q.get("op", "").upper()
-        # inject 5xx only on the (retried) OPEN data path; metadata ops are
-        # deliberately one-shot in the client
-        if op == "OPEN" and st.tick_500():
-            return self._remote_exc(500, "Internal Server Error")
+        # inject faults only on the (retried) OPEN data path so chaos runs
+        # schedule every failure against the reconnect-at-offset machinery
+        if op == "OPEN":
+            if st._tick("stall", st.stall_every):
+                return stall_connection(self, st.stall_seconds)
+            if st._tick("reset", st.reset_every):
+                return reset_connection(self)
+            if st.tick_500():
+                return self._remote_exc(500, "Internal Server Error")
         if op == "GETFILESTATUS":
             status = self._status_obj(path)
             if status is None:
@@ -195,6 +204,8 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
                 return self._remote_exc(404, f"File does not exist: {path}")
             off = int(q.get("offset", "0"))
             data = data[off:]
+            if st._tick("gettrunc", st.get_truncate_every):
+                return truncate_body(self, 200, data)
             if (st.fail_reads_after is not None
                     and len(data) > st.fail_reads_after):
                 out = data[: st.fail_reads_after]
