@@ -2,14 +2,19 @@
 # on every push: .github/workflows/githubci.yml, scripts/test_script.sh).
 # `make ci` runs every lane; each lane is also callable alone.
 
-.PHONY: ci lint native-test tsan-test asan-test pytest bench-smoke dryrun \
+.PHONY: ci lint native-test tsan-test asan-test parse-lanes pytest bench-smoke dryrun \
         doc clean
 
-ci: lint native-test tsan-test asan-test pytest dryrun doc
+ci: lint native-test tsan-test asan-test parse-lanes pytest dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
 	$(MAKE) -C cpp asan-test
+
+# SIMD text-ingest lanes: benchparse correctness smoke + the --parse suite
+# under ASan/TSan at every dispatch-tier override (cpp/Makefile)
+parse-lanes:
+	$(MAKE) -C cpp benchparse-check asan-parse tsan-parse
 
 lint:
 	python3 scripts/lint.py

@@ -452,6 +452,10 @@ int dct_parser_bytes_read(dct_parser_t h, size_t* out) {
 
 // Mirror of dct::ParsePipelineStats (parser.h) — occupancy/stall counters
 // of the multi-chunk parse pipeline, for bench/ops introspection.
+// APPEND-ONLY contract: the struct is caller-allocated and versionless
+// (the in-tree ctypes mirror in dmlc_core_tpu/io/native.py ships in
+// lockstep with this .so); new fields go at the END only, and out-of-tree
+// consumers must rebuild against the matching header.
 typedef struct {
   uint64_t chunks_read;
   uint64_t blocks_delivered;
@@ -463,6 +467,8 @@ typedef struct {
   uint64_t inflight_sum;
   uint64_t capacity;
   uint64_t workers;
+  uint64_t simd_tier;  // structural-scan lane: 0 scalar, 1 swar, 2 sse2,
+                       // 3 avx2 (simd_scan.h SimdTier)
 } dct_parse_pipeline_stats_t;
 
 // *has = 0 when the handle carries no pipeline (threaded=0 parsers).
@@ -485,6 +491,7 @@ int dct_parser_pipeline_stats(dct_parser_t h, dct_parse_pipeline_stats_t* out,
       out->inflight_sum = s.inflight_sum;
       out->capacity = s.capacity;
       out->workers = s.workers;
+      out->simd_tier = s.simd_tier;
     }
   });
 }

@@ -10,8 +10,9 @@
 //     reference csv_parser.h:100). The fast path delegates anything
 //     outside its exactness envelope, so acceptance never changes a parsed
 //     value, only which code computes it.
-// Plus the pair/triple helpers the parsers consume (reference strtonum.h
-// ParsePair semantics: returns how many ':'-separated components parsed).
+// The pair/triple helpers the parsers consume (reference strtonum.h
+// ParsePair semantics) live in simd_scan.h (ParsePairF/ParseTripleF),
+// shared by the scalar and fused decode lanes.
 #ifndef DCT_NUMPARSE_H_
 #define DCT_NUMPARSE_H_
 
@@ -96,8 +97,22 @@ inline uint32_t DigitRunValue8(uint64_t chunk, int k) {
 }
 
 inline constexpr uint64_t kPow10U64[] = {
-    1ull,       10ull,       100ull,       1000ull,     10000ull,
-    100000ull,  1000000ull,  10000000ull,  100000000ull};
+    1ull,
+    10ull,
+    100ull,
+    1000ull,
+    10000ull,
+    100000ull,
+    1000000ull,
+    10000000ull,
+    100000000ull,
+    1000000000ull,
+    10000000000ull,
+    100000000000ull,
+    1000000000000ull,
+    10000000000000ull,
+    100000000000000ull,
+    1000000000000000ull};  // 10^0..10^15: the 15-digit exact-mantissa cap
 
 // Fast decimal float scan: when the total digit count fits 15 (mantissa
 // < 2^53, every step exact) and the scale is within 10^±22, mant * 10^e is
@@ -268,54 +283,11 @@ inline bool ParseNum(const char* p, const char* end, const char** out, T* v) {
   return true;
 }
 
-// Parse "a[:b]" starting at p (leading blanks skipped).
-// Returns 0 when the region is empty/blank, 1 when only `a` parsed,
-// 2 when "a:b" parsed. *out advances past what was consumed; on return 0 it
-// points at end (the reference ParsePair contract the libsvm parser relies
-// on, libsvm_parser.h:135-143).
-template <typename TA, typename TB>
-inline int ParsePair(const char* p, const char* end, const char** out,
-                     TA* a, TB* b) {
-  while (p != end && IsBlankChar(*p)) ++p;
-  if (p == end) {
-    *out = end;
-    return 0;
-  }
-  const char* q;
-  if (!ParseNum(p, end, &q, a)) {
-    *out = end;
-    return 0;
-  }
-  if (q == end || *q != ':') {
-    *out = q;
-    return 1;
-  }
-  const char* r;
-  if (!ParseNum(q + 1, end, &r, b)) {
-    *out = q;
-    return 1;
-  }
-  *out = r;
-  return 2;
-}
-
-// Parse "a:b:c" (libfm triples). Returns number of components parsed (0-3).
-template <typename TA, typename TB, typename TC>
-inline int ParseTriple(const char* p, const char* end, const char** out,
-                       TA* a, TB* b, TC* c) {
-  TA ta;
-  TB tb;
-  int n = ParsePair<TA, TB>(p, end, out, &ta, &tb);
-  if (n >= 1) *a = ta;
-  if (n >= 2) *b = tb;
-  if (n < 2) return n;
-  const char* q = *out;
-  if (q == end || *q != ':') return 2;
-  const char* r;
-  if (!ParseNum(q + 1, end, &r, c)) return 2;
-  *out = r;
-  return 3;
-}
+// The "a[:b]" / "a:b:c" pair/triple helpers (reference strtonum.h
+// ParsePair semantics) live in simd_scan.h as ParsePairF/ParseTripleF,
+// templated on the fused-vs-scalar numeric primitives — the kFused=false
+// instantiation IS the historical scalar contract, kept in one place so
+// the r==1-then-fail line-discard sequence cannot drift between lanes.
 
 }  // namespace dct
 

@@ -141,7 +141,12 @@ struct LibFMParserParam : public Parameter<LibFMParserParam> {
 // --------------------------------------------------------------------------
 template <typename IndexType>
 TextParserBase<IndexType>::TextParserBase(InputSplit* source, int nthread)
-    : source_(source), nthread_(DefaultThreads(nthread)) {}
+    : source_(source),
+      nthread_(DefaultThreads(nthread)),
+      // per-construction resolve (not a process-global): the differential
+      // lanes flip DMLC_PARSE_SIMD between parser constructions to compare
+      // SIMD and scalar output in one process
+      simd_tier_(ResolveSimdTier()) {}
 
 template <typename IndexType>
 TextParserBase<IndexType>::~TextParserBase() {
@@ -224,7 +229,6 @@ void TextParserBase<IndexType>::WorkerLoop(int i) {
     try {
       this->ParseBlock(b, e, out);
       ValidateBlock(*out);
-      out->UpdateMax();
     } catch (...) {
       *err = std::current_exception();
     }
@@ -293,7 +297,6 @@ bool TextParserBase<IndexType>::FillBlocks(
   if (nworker == 1) {
     ParseBlock(begin, end, &(*blocks)[0]);
     ValidateBlock((*blocks)[0]);
-    (*blocks)[0].UpdateMax();
     return true;
   }
   std::vector<const char*> cuts;
@@ -317,7 +320,6 @@ bool TextParserBase<IndexType>::FillBlocks(
   try {
     ParseBlock(cuts[0], cuts[1], &(*blocks)[0]);
     ValidateBlock((*blocks)[0]);
-    (*blocks)[0].UpdateMax();
   } catch (...) {
     my_error = std::current_exception();
   }
@@ -393,112 +395,190 @@ inline const char* SkipToEol(const char* p, const char* end) {
 inline bool IsEolChar(char c) { return c == '\n' || c == '\r'; }
 }  // namespace
 
-// reference src/data/libsvm_parser.h:87-169. Single-pass tokenizer: rows
-// and tokens are recognized in the same scan (newlines terminate the token
-// loop directly), instead of pre-scanning each line for its end and then
-// re-walking it — one traversal of the chunk instead of three. Semantics
-// (comment/blank lines, label[:weight], qid:, bare-index features,
-// discard-line-on-garbage, CRLF/CR/NOEOL) match the line-oriented form;
-// tests/test_native_parser.py pins them.
-template <typename IndexType>
-void LibSVMParser<IndexType>::ParseBlock(const char* begin, const char* end,
-                                         RowBlockContainer<IndexType>* out) {
+namespace {
+// One libsvm row starting at p (a non-blank, non-EOL char); returns the
+// cursor past the row's line terminator (or end). This IS the scalar
+// tokenizer (reference src/data/libsvm_parser.h:87-169 semantics:
+// comment/garbage lines discard, label[:weight], qid:, bare-index
+// features, ':'-garbage discards the line tail). kFused=false compiles
+// to exactly the scalar byte loops; kFused=true swaps the numeric
+// primitives for the fused SWAR field decoders (simd_scan.h), which
+// accept only shapes whose value AND consumption provably equal the
+// scalar ops' — so both instantiations are byte-identical by
+// construction.
+//
+// `dec` (0 or 1) is subtracted from every feature id as it is written:
+// the decode-path hoist of the old O(nnz) 1-based post-pass for forced
+// indexing_mode=1. *min_feat tracks the RAW (pre-decrement) ids for the
+// indexing_mode=auto heuristic, which still needs one deferred pass (the
+// minimum over the block is only known once the block ends).
+template <typename IndexType, bool kFused>
+const char* ParseLibSVMRow(const char* p, const char* end,
+                           RowBlockContainer<IndexType>* out,
+                           IndexType* min_feat, IndexType dec) {
   // feature ids below 10 digits accumulate in a u64 without overflow; wider
   // tokens delegate to ParseNum for exact from_chars overflow semantics
   constexpr int kFastIdxDigits = sizeof(IndexType) == 8 ? 19 : 9;
-  out->Clear();
-  IndexType min_feat = std::numeric_limits<IndexType>::max();
-  const char* p = SkipUTF8BOM(begin, end);
-  while (p != end) {
-    // between rows: swallow blanks and empty lines in one skip
-    while (p != end && (IsBlankChar(*p) || IsEolChar(*p))) ++p;
+  if (*p == '#') return SkipToEol(p, end);  // comment-only line
+  // label[:weight] — the parse stops at any non-numeric char, so the
+  // chunk end doubles as the line bound here
+  float label;
+  if (!ParseNumF<kFused, float>(p, end, &p, &label)) {
+    return SkipToEol(p, end);  // garbage line: discard (ParsePair contract)
+  }
+  if (p != end && *p == ':') {
+    float weight;
+    const char* wp;
+    if (ParseNumF<kFused, float>(p + 1, end, &wp, &weight)) {
+      out->weight.push_back(weight);
+      p = wp;
+    }
+    // ":garbage" leaves p at ':' — the token loop below then discards
+    // the rest of the line, matching the line-oriented behavior
+  }
+  out->label.push_back(label);
+  // optional qid:n (space-separated, reference libsvm_parser.h:116-126)
+  while (p != end && *p == ' ') ++p;
+  if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
+    uint64_t qid = 0;
+    const char* qp;
+    if (ParseNumF<kFused, uint64_t>(p + 4, end, &qp, &qid)) {
+      out->qid.push_back(qid);
+      p = qp;
+    }
+  }
+  // index[:value] tokens until end of line
+  while (true) {
+    while (p != end && IsBlankChar(*p)) ++p;
     if (p == end) break;
-    if (*p == '#') {  // comment-only line
+    const char c = *p;
+    if (IsEolChar(c)) {
+      ++p;
+      break;
+    }
+    if (c == '#') {
       p = SkipToEol(p, end);
-      continue;
+      break;
     }
-    // label[:weight] — ParseNum stops at any non-numeric char, so the
-    // chunk end doubles as the line bound here
-    float label;
-    if (!ParseNum<float>(p, end, &p, &label)) {
-      p = SkipToEol(p, end);  // garbage line: discard (ParsePair contract)
-      continue;
-    }
-    if (p != end && *p == ':') {
-      float weight;
-      const char* wp;
-      if (ParseNum<float>(p + 1, end, &wp, &weight)) {
-        out->weight.push_back(weight);
-        p = wp;
+    // feature id: fused digit-run scan (one or two 8-byte loads) or the
+    // inline digit loop — identical consumption and value either way
+    uint64_t idx = 0;
+    int nd = 0;
+    const char* tok = p;
+    if constexpr (kFused) {
+      const int il = FusedDigitScan(p, end, &idx);
+      if (il >= 1 && il <= kFastIdxDigits) {
+        nd = il;
+        p += il;
+      } else if (il != 0) {
+        // overflow-length run or too close to the chunk end: force the
+        // exact ParseNum delegate below (same as the scalar lane's
+        // kFastIdxDigits+1 bail-out)
+        nd = kFastIdxDigits + 1;
       }
-      // ":garbage" leaves p at ':' — the token loop below then discards
-      // the rest of the line, matching the line-oriented behavior
-    }
-    out->label.push_back(label);
-    // optional qid:n (space-separated, reference libsvm_parser.h:116-126)
-    while (p != end && *p == ' ') ++p;
-    if (end - p > 4 && std::memcmp(p, "qid:", 4) == 0) {
-      uint64_t qid = 0;
-      const char* qp;
-      if (ParseNum<uint64_t>(p + 4, end, &qp, &qid)) {
-        out->qid.push_back(qid);
-        p = qp;
-      }
-    }
-    // index[:value] tokens until end of line
-    while (true) {
-      while (p != end && IsBlankChar(*p)) ++p;
-      if (p == end) break;
-      const char c = *p;
-      if (IsEolChar(c)) {
-        ++p;
-        break;
-      }
-      if (c == '#') {
-        p = SkipToEol(p, end);
-        break;
-      }
-      // feature id: inline digit loop for the short common case
-      uint64_t idx = 0;
-      int nd = 0;
-      const char* tok = p;
+    } else {
       while (p != end && IsDigitChar(*p)) {
         idx = idx * 10 + static_cast<uint64_t>(*p - '0');
         ++p;
         if (++nd > kFastIdxDigits) break;
       }
-      IndexType idx_t;
-      if (nd == 0 || nd > kFastIdxDigits) {
-        // '+'-prefixed, overflowing, or non-numeric token: exact fallback
-        if (!ParseNum<IndexType>(tok, end, &p, &idx_t)) {
-          p = SkipToEol(tok, end);  // discard rest of line
-          break;
-        }
-      } else {
-        idx_t = static_cast<IndexType>(idx);
-      }
-      out->index.push_back(idx_t);
-      min_feat = std::min(min_feat, idx_t);
-      if (p != end && *p == ':') {
-        float value;
-        const char* vp;
-        if (ParseNum<float>(p + 1, end, &vp, &value)) {
-          out->value.push_back(value);
-          p = vp;
-        }
-        // ":garbage": p stays at ':' and the next iteration discards the
-        // line, matching ParsePair's r==1-then-fail sequence
-      }
     }
-    out->offset.push_back(out->index.size());
+    IndexType idx_t;
+    if (nd == 0 || nd > kFastIdxDigits) {
+      // '+'-prefixed, overflowing, or non-numeric token: exact fallback
+      if (!ParseNum<IndexType>(tok, end, &p, &idx_t)) {
+        p = SkipToEol(tok, end);  // discard rest of line
+        break;
+      }
+    } else {
+      idx_t = static_cast<IndexType>(idx);
+    }
+    const IndexType written = static_cast<IndexType>(idx_t - dec);
+    out->index.push_back(written);
+    // inline max tracking replaces the old post-parse UpdateMax pass (an
+    // O(nnz) re-walk of the index array per block)
+    out->max_index = std::max<uint64_t>(out->max_index, written);
+    *min_feat = std::min(*min_feat, idx_t);
+    if (p != end && *p == ':') {
+      float value;
+      const char* vp;
+      if (ParseNumF<kFused, float>(p + 1, end, &vp, &value)) {
+        out->value.push_back(value);
+        p = vp;
+      }
+      // ":garbage": p stays at ':' and the next iteration discards the
+      // line, matching ParsePair's r==1-then-fail sequence
+    }
+  }
+  out->offset.push_back(out->index.size());
+  return p;
+}
+
+// reference src/data/libsvm_parser.h:87-169. Single-pass tokenizer: rows
+// and tokens are recognized in the same scan (newlines terminate the token
+// loop directly), instead of pre-scanning each line for its end and then
+// re-walking it. Semantics (comment/blank lines, label[:weight], qid:,
+// bare-index features, discard-line-on-garbage, CRLF/CR/NOEOL) match the
+// line-oriented form; tests/test_native_parser.py pins them and
+// tests/test_parse_simd.py pins kFused=true == kFused=false.
+template <bool kFused, typename IndexType>
+void ParseLibSVMBlockImpl(const char* begin, const char* end,
+                          int indexing_mode,
+                          RowBlockContainer<IndexType>* out) {
+  IndexType min_feat = std::numeric_limits<IndexType>::max();
+  const IndexType dec = indexing_mode > 0 ? 1 : 0;
+  const char* p = SkipUTF8BOM(begin, end);
+  while (p != end) {
+    // between rows: swallow blanks and empty lines in one skip
+    while (p != end && (IsBlankChar(*p) || IsEolChar(*p))) ++p;
+    if (p == end) break;
+    p = ParseLibSVMRow<IndexType, kFused>(p, end, out, &min_feat, dec);
   }
   DCT_CHECK_EQ(out->label.size() + 1, out->offset.size());
-  // 0/1-based indexing heuristic (sklearn-compatible,
-  // reference libsvm_parser.h:155-168): >0 forces 1-based, <0 auto-detects
-  if (indexing_mode_ > 0 ||
-      (indexing_mode_ < 0 && !out->index.empty() && min_feat > 0)) {
+  // 0/1-based auto heuristic (sklearn-compatible, reference
+  // libsvm_parser.h:155-168); the forced >0 mode decrements at decode time
+  // (dec above), so only auto-detect still re-walks the index array
+  if (indexing_mode < 0 && !out->index.empty() && min_feat > 0) {
     for (IndexType& e : out->index) --e;
+    --out->max_index;  // min_feat > 0 keeps the decrement wrap-free
   }
+}
+}  // namespace
+
+template <typename IndexType>
+void LibSVMParser<IndexType>::ParseBlock(const char* begin, const char* end,
+                                         RowBlockContainer<IndexType>* out) {
+  if (this->simd_tier_ != kSimdScalar) {
+    ParseBlockSimd(begin, end, out);
+  } else {
+    ParseBlockScalar(begin, end, out);
+  }
+}
+
+template <typename IndexType>
+void LibSVMParser<IndexType>::ParseBlockScalar(
+    const char* begin, const char* end, RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  ParseLibSVMBlockImpl<false>(begin, end, indexing_mode_, out);
+}
+
+// The SIMD lane: stage 1 runs the tier kernels over the chunk for the
+// reserve hints (every valued feature owns one ':', every row one EOL),
+// stage 2 is the SAME tokenizer instantiated with the fused SWAR field
+// decoders (see simd_scan.h for why fused decode beats per-token tape
+// walking on real corpora).
+template <typename IndexType>
+void LibSVMParser<IndexType>::ParseBlockSimd(
+    const char* begin, const char* end, RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  size_t n_sep = 0, n_eol = 0;
+  CountSepEol(begin, end, ':',
+              static_cast<SimdTier>(this->simd_tier_), &n_sep, &n_eol);
+  out->index.reserve(n_sep);
+  out->value.reserve(n_sep);
+  out->label.reserve(n_eol + 1);
+  out->offset.reserve(n_eol + 2);
+  ParseLibSVMBlockImpl<true>(begin, end, indexing_mode_, out);
 }
 
 // --------------------------------------------------------------------------
@@ -533,31 +613,31 @@ CSVParser<IndexType>::CSVParser(InputSplit* source,
 
 namespace {
 // value-cell sink per csv dtype: parses a number at vp into `values` and
-// advances *out past it (the caller then skips any cell residue)
-template <typename VT>
-bool ParseCell(const char* vp, const char* end, const char** out,
-               std::vector<VT>* values) {
+// advances *out past it (the caller then skips any cell residue).
+// kFused selects the fused numeric primitives (simd_scan.h) — identical
+// values and consumption, fewer per-character loops.
+template <bool kFused, typename VT>
+bool ParseCellF(const char* vp, const char* end, const char** out,
+                std::vector<VT>* values) {
   VT v;
   const char* after;
-  if (!ParseNum<VT>(vp, end, &after, &v)) return false;
+  if (!ParseNumF<kFused, VT>(vp, end, &after, &v)) return false;
   *out = after;
   values->push_back(v);
   return true;
 }
-}  // namespace
 
-// reference src/data/csv_parser.h:76-147. Single-pass tokenizer (same
-// rationale as the libsvm ParseBlock above): cells are parsed where the
-// cursor stands and EOL characters double as cell terminators, instead of
-// pre-scanning each line and then each cell for its end — one traversal
-// instead of three. Semantics (missing values keep their column index,
+// reference src/data/csv_parser.h:76-147. Single-pass tokenizer: cells
+// are parsed where the cursor stands and EOL characters double as cell
+// terminators. Semantics (missing values keep their column index,
 // label/weight columns, blank-only lines emit empty rows, delimiter
-// presence check) match the line-oriented form; tests pin them.
-template <typename IndexType>
-void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
-                                      RowBlockContainer<IndexType>* out) {
-  out->Clear();
-  out->value_dtype = value_dtype_;
+// presence check) match the line-oriented form; tests pin them, and
+// tests/test_parse_simd.py pins kFused=true == kFused=false.
+template <bool kFused, typename IndexType>
+void ParseCSVBlockImpl(const char* begin, const char* end, int label_column,
+                       int weight_column, char delimiter, int value_dtype,
+                       RowBlockContainer<IndexType>* out) {
+  out->value_dtype = value_dtype;
   const char* p = SkipUTF8BOM(begin, end);
   while (p != end) {
     if (IsEolChar(*p)) {  // empty line (also the LF of a CRLF pair)
@@ -574,32 +654,37 @@ void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
     while (!line_done) {
       // leading blanks of the cell — but never across a blank DELIMITER
       // (tab-separated files: '\t' both blank and delimiter)
-      while (p != end && IsBlankChar(*p) && *p != delimiter_) ++p;
-      if (column == label_column_ || column == weight_column_) {
+      while (p != end && IsBlankChar(*p) && *p != delimiter) ++p;
+      if (column == label_column || column == weight_column) {
         float v;
         const char* after;
-        if (ParseNum<float>(p, end, &after, &v)) {
-          (column == label_column_ ? label : weight) = v;
+        if (ParseNumF<kFused, float>(p, end, &after, &v)) {
+          (column == label_column ? label : weight) = v;
           p = after;
         }
       } else {
         bool parsed =
-            value_dtype_ == 0 ? ParseCell(p, end, &p, &out->value)
-            : value_dtype_ == 1 ? ParseCell(p, end, &p, &out->value_i32)
-                                : ParseCell(p, end, &p, &out->value_i64);
+            value_dtype == 0
+                ? ParseCellF<kFused>(p, end, &p, &out->value)
+            : value_dtype == 1
+                ? ParseCellF<kFused>(p, end, &p, &out->value_i32)
+                : ParseCellF<kFused>(p, end, &p, &out->value_i64);
         if (parsed) {
-          out->index.push_back(idx++);
+          out->index.push_back(idx);
+          // inline max tracking replaces the old UpdateMax pass
+          out->max_index = std::max<uint64_t>(out->max_index, idx);
+          ++idx;
         } else {
           ++idx;  // missing value: skip but keep the column index
         }
       }
       // cell residue (trailing garbage/blanks) up to the next delimiter
       // or end of line
-      while (p != end && *p != delimiter_ && !IsEolChar(*p)) ++p;
+      while (p != end && *p != delimiter && !IsEolChar(*p)) ++p;
       ++column;
       if (p == end) {
         line_done = true;  // NOEOL final line
-      } else if (*p == delimiter_) {
+      } else if (*p == delimiter) {
         any_delim = true;
         ++p;
       } else {
@@ -608,7 +693,7 @@ void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
       }
     }
     DCT_CHECK(any_delim || column <= 1 || idx > 0)
-        << "delimiter '" << delimiter_ << "' not found in csv line";
+        << "delimiter '" << delimiter << "' not found in csv line";
     out->label.push_back(label);
     if (!std::isnan(weight)) out->weight.push_back(weight);
     out->offset.push_back(out->index.size());
@@ -616,6 +701,48 @@ void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
   DCT_CHECK_EQ(out->label.size() + 1, out->offset.size());
   DCT_CHECK(out->weight.empty() || out->weight.size() == out->label.size())
       << "weight_column missing on some csv rows";
+}
+}  // namespace
+
+template <typename IndexType>
+void CSVParser<IndexType>::ParseBlock(const char* begin, const char* end,
+                                      RowBlockContainer<IndexType>* out) {
+  if (this->simd_tier_ != kSimdScalar) {
+    ParseBlockSimd(begin, end, out);
+  } else {
+    ParseBlockScalar(begin, end, out);
+  }
+}
+
+template <typename IndexType>
+void CSVParser<IndexType>::ParseBlockScalar(
+    const char* begin, const char* end, RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  ParseCSVBlockImpl<false>(begin, end, label_column_, weight_column_,
+                           delimiter_, value_dtype_, out);
+}
+
+template <typename IndexType>
+void CSVParser<IndexType>::ParseBlockSimd(
+    const char* begin, const char* end, RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  size_t n_sep = 0, n_eol = 0;
+  CountSepEol(begin, end, delimiter_,
+              static_cast<SimdTier>(this->simd_tier_), &n_sep, &n_eol);
+  // cells <= delimiters + rows; every row owns one EOL (+1 NOEOL tail)
+  const size_t cells_hint = n_sep + n_eol + 1;
+  out->index.reserve(cells_hint);
+  if (value_dtype_ == 1) {
+    out->value_i32.reserve(cells_hint);
+  } else if (value_dtype_ == 2) {
+    out->value_i64.reserve(cells_hint);
+  } else {
+    out->value.reserve(cells_hint);
+  }
+  out->label.reserve(n_eol + 1);
+  out->offset.reserve(n_eol + 2);
+  ParseCSVBlockImpl<true>(begin, end, label_column_, weight_column_,
+                          delimiter_, value_dtype_, out);
 }
 
 // --------------------------------------------------------------------------
@@ -630,83 +757,137 @@ LibFMParser<IndexType>::LibFMParser(
   indexing_mode_ = param.indexing_mode;
 }
 
+namespace {
+// One libfm row starting at p (a non-blank, non-EOL char); same
+// fused/scalar contract as ParseLibSVMRow above. `dec`/`dec_field` hoist
+// the forced 1-based decrement into the decode path; mins track RAW ids
+// for the auto heuristic.
+template <typename IndexType, bool kFused>
+const char* ParseLibFMRow(const char* p, const char* end,
+                          RowBlockContainer<IndexType>* out,
+                          uint32_t* min_field, IndexType* min_feat,
+                          IndexType dec) {
+  const uint32_t dec_field = static_cast<uint32_t>(dec);
+  if (*p == '#') return SkipToEol(p, end);  // comment-only line
+  float label;
+  if (!ParseNumF<kFused, float>(p, end, &p, &label)) {
+    return SkipToEol(p, end);  // garbage line: discard (ParsePair contract)
+  }
+  if (p != end && *p == ':') {
+    float weight;
+    const char* wp;
+    if (ParseNumF<kFused, float>(p + 1, end, &wp, &weight)) {
+      out->weight.push_back(weight);
+      p = wp;
+    }
+  }
+  out->label.push_back(label);
+  // field:feature[:value] triples until end of line
+  while (true) {
+    while (p != end && IsBlankChar(*p)) ++p;
+    if (p == end) break;
+    const char c = *p;
+    if (IsEolChar(c)) {
+      ++p;
+      break;
+    }
+    if (c == '#') {
+      p = SkipToEol(p, end);
+      break;
+    }
+    uint32_t field;
+    IndexType feat;
+    float value;
+    const char* after;
+    // a triple shares the pair grammar; ParseTriple's rr<=1 cases
+    // (bare number, no second ':') keep the line-oriented semantics
+    int rr = ParseTripleF<kFused, uint32_t, IndexType, float>(
+        p, end, &after, &field, &feat, &value);
+    if (rr == 0) {
+      p = SkipToEol(p, end);  // non-numeric token: discard the line
+      break;
+    }
+    p = after;
+    if (rr == 1) continue;  // bare number token: skipped (reference)
+    const uint32_t wfield = field - dec_field;
+    const IndexType wfeat = static_cast<IndexType>(feat - dec);
+    out->field.push_back(wfield);
+    out->index.push_back(wfeat);
+    // inline max tracking replaces the old post-parse UpdateMax pass
+    out->max_field = std::max(out->max_field, wfield);
+    out->max_index = std::max<uint64_t>(out->max_index, wfeat);
+    *min_field = std::min(*min_field, field);
+    *min_feat = std::min(*min_feat, feat);
+    if (rr == 3) out->value.push_back(value);
+  }
+  out->offset.push_back(out->index.size());
+  return p;
+}
+
 // reference src/data/libfm_parser.h:67-144. Single-pass tokenizer (same
-// structure as the libsvm ParseBlock: rows and `field:feature[:value]`
-// triples recognized in one scan, newlines terminate the token loop).
-template <typename IndexType>
-void LibFMParser<IndexType>::ParseBlock(const char* begin, const char* end,
-                                        RowBlockContainer<IndexType>* out) {
-  out->Clear();
+// structure as the libsvm impl: rows and `field:feature[:value]` triples
+// recognized in one scan, newlines terminate the token loop).
+template <bool kFused, typename IndexType>
+void ParseLibFMBlockImpl(const char* begin, const char* end,
+                         int indexing_mode,
+                         RowBlockContainer<IndexType>* out) {
   uint32_t min_field = std::numeric_limits<uint32_t>::max();
   IndexType min_feat = std::numeric_limits<IndexType>::max();
+  const IndexType dec = indexing_mode > 0 ? 1 : 0;
   const char* p = SkipUTF8BOM(begin, end);
   while (p != end) {
     while (p != end && (IsBlankChar(*p) || IsEolChar(*p))) ++p;
     if (p == end) break;
-    if (*p == '#') {  // comment-only line
-      p = SkipToEol(p, end);
-      continue;
-    }
-    float label;
-    if (!ParseNum<float>(p, end, &p, &label)) {
-      p = SkipToEol(p, end);  // garbage line: discard (ParsePair contract)
-      continue;
-    }
-    if (p != end && *p == ':') {
-      float weight;
-      const char* wp;
-      if (ParseNum<float>(p + 1, end, &wp, &weight)) {
-        out->weight.push_back(weight);
-        p = wp;
-      }
-    }
-    out->label.push_back(label);
-    // field:feature[:value] triples until end of line
-    while (true) {
-      while (p != end && IsBlankChar(*p)) ++p;
-      if (p == end) break;
-      const char c = *p;
-      if (IsEolChar(c)) {
-        ++p;
-        break;
-      }
-      if (c == '#') {
-        p = SkipToEol(p, end);
-        break;
-      }
-      uint32_t field;
-      IndexType feat;
-      float value;
-      const char* after;
-      // a triple shares the pair grammar; ParseTriple's rr<=1 cases
-      // (bare number, no second ':') keep the line-oriented semantics
-      int rr = ParseTriple<uint32_t, IndexType, float>(p, end, &after,
-                                                       &field, &feat,
-                                                       &value);
-      if (rr == 0) {
-        p = SkipToEol(p, end);  // non-numeric token: discard the line
-        break;
-      }
-      p = after;
-      if (rr == 1) continue;  // bare number token: skipped (reference)
-      out->field.push_back(field);
-      out->index.push_back(feat);
-      min_field = std::min(min_field, field);
-      min_feat = std::min(min_feat, feat);
-      if (rr == 3) out->value.push_back(value);
-    }
-    out->offset.push_back(out->index.size());
+    p = ParseLibFMRow<IndexType, kFused>(p, end, out, &min_field,
+                                         &min_feat, dec);
   }
   DCT_CHECK_EQ(out->field.size(), out->index.size());
   DCT_CHECK_EQ(out->label.size() + 1, out->offset.size());
-  // 1-based detection requires BOTH field and feature ids to exceed 0
-  // (reference libfm_parser.h:130-143)
-  if (indexing_mode_ > 0 ||
-      (indexing_mode_ < 0 && !out->index.empty() && min_feat > 0 &&
-       !out->field.empty() && min_field > 0)) {
+  // 1-based auto detection requires BOTH field and feature ids to exceed 0
+  // (reference libfm_parser.h:130-143); forced >0 mode decrements at
+  // decode time (dec above)
+  if (indexing_mode < 0 && !out->index.empty() && min_feat > 0 &&
+      !out->field.empty() && min_field > 0) {
     for (IndexType& e : out->index) --e;
     for (uint32_t& e : out->field) --e;
+    --out->max_index;  // both mins > 0 keep the decrements wrap-free
+    --out->max_field;
   }
+}
+}  // namespace
+
+template <typename IndexType>
+void LibFMParser<IndexType>::ParseBlock(const char* begin, const char* end,
+                                        RowBlockContainer<IndexType>* out) {
+  if (this->simd_tier_ != kSimdScalar) {
+    ParseBlockSimd(begin, end, out);
+  } else {
+    ParseBlockScalar(begin, end, out);
+  }
+}
+
+template <typename IndexType>
+void LibFMParser<IndexType>::ParseBlockScalar(
+    const char* begin, const char* end, RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  ParseLibFMBlockImpl<false>(begin, end, indexing_mode_, out);
+}
+
+template <typename IndexType>
+void LibFMParser<IndexType>::ParseBlockSimd(
+    const char* begin, const char* end, RowBlockContainer<IndexType>* out) {
+  out->Clear();
+  size_t n_sep = 0, n_eol = 0;
+  CountSepEol(begin, end, ':',
+              static_cast<SimdTier>(this->simd_tier_), &n_sep, &n_eol);
+  // every full triple owns two ':'
+  const size_t nnz_hint = n_sep / 2 + 1;
+  out->index.reserve(nnz_hint);
+  out->field.reserve(nnz_hint);
+  out->value.reserve(nnz_hint);
+  out->label.reserve(n_eol + 1);
+  out->offset.reserve(n_eol + 2);
+  ParseLibFMBlockImpl<true>(begin, end, indexing_mode_, out);
 }
 
 // --------------------------------------------------------------------------
@@ -1085,7 +1266,6 @@ void PipelinedParser<IndexType>::WorkerLoop() {
       RowBlockContainer<IndexType>* out = &t->blocks[slice];
       base_->ParseBlock(t->cuts[slice], t->cuts[slice + 1], out);
       ValidateBlock(*out);
-      out->UpdateMax();
     } catch (...) {
       t->errors[slice] = std::current_exception();
     }
@@ -1219,6 +1399,7 @@ bool PipelinedParser<IndexType>::GetPipelineStats(
   out->inflight_sum = inflight_sum_.load(std::memory_order_relaxed);
   out->capacity = capacity_;
   out->workers = static_cast<uint64_t>(nworker_);
+  out->simd_tier = static_cast<uint64_t>(base_->simd_tier());
   return true;
 }
 

@@ -24,6 +24,7 @@
 #include "input_split.h"
 #include "registry.h"
 #include "rowblock.h"
+#include "simd_scan.h"
 
 namespace dct {
 
@@ -46,6 +47,9 @@ struct ParsePipelineStats {
   uint64_t inflight_sum = 0;     // summed at each admit; avg = sum/chunks
   uint64_t capacity = 0;         // configured chunks-in-flight bound
   uint64_t workers = 0;          // parse worker thread count
+  uint64_t simd_tier = 0;        // structural-scan lane (simd_scan.h
+                                 // SimdTier: 0 scalar, 1 swar, 2 sse2,
+                                 // 3 avx2)
 };
 
 // Parser factory registry entry (reference ParserFactoryReg +
@@ -150,6 +154,10 @@ class TextParserBase : public Parser<IndexType> {
     return size < (size_t(1) << 16) ? 1 : nthread_;
   }
   int num_threads() const { return nthread_; }
+  // Structural-scan lane this parser decodes with (simd_scan.h SimdTier;
+  // resolved from DMLC_PARSE_SIMD + CPUID at construction, reported
+  // through ParsePipelineStats). The rec binary lane never consults it.
+  int simd_tier() const { return simd_tier_; }
 
  protected:
   // Worker-tiling resync: the first parse-unit head at/after `hint` in
@@ -162,6 +170,7 @@ class TextParserBase : public Parser<IndexType> {
 
   std::unique_ptr<InputSplit> source_;
   int nthread_;
+  SimdTier simd_tier_ = kSimdScalar;
   // read from the consumer thread while the pipeline reader fills
   std::atomic<size_t> bytes_read_{0};
   // direct chunk-producer view of source_ when its top layer exposes one
@@ -196,7 +205,12 @@ class TextParserBase : public Parser<IndexType> {
 };
 
 // libsvm: `label[:weight] [qid:n] index[:value]...`, '#' comments
-// (reference src/data/libsvm_parser.h:87-169).
+// (reference src/data/libsvm_parser.h:87-169). Two decode lanes sharing
+// ONE tokenizer template: ParseBlockScalar instantiates it with the
+// byte-loop numeric primitives, ParseBlockSimd with the fused SWAR field
+// decoders plus the stage-1 reserve-hint scan (simd_scan.h); outputs are
+// byte-identical by construction (tests/test_parse_simd.py pins it over
+// adversarial corpora, DMLC_PARSE_SIMD=0 forces the scalar lane).
 template <typename IndexType>
 class LibSVMParser : public TextParserBase<IndexType> {
  public:
@@ -206,6 +220,10 @@ class LibSVMParser : public TextParserBase<IndexType> {
                   RowBlockContainer<IndexType>* out) override;
 
  private:
+  void ParseBlockScalar(const char* begin, const char* end,
+                        RowBlockContainer<IndexType>* out);
+  void ParseBlockSimd(const char* begin, const char* end,
+                      RowBlockContainer<IndexType>* out);
   int indexing_mode_;  // >0: 1-based, 0: 0-based, <0: heuristic
 };
 
@@ -221,6 +239,10 @@ class CSVParser : public TextParserBase<IndexType> {
                   RowBlockContainer<IndexType>* out) override;
 
  private:
+  void ParseBlockScalar(const char* begin, const char* end,
+                        RowBlockContainer<IndexType>* out);
+  void ParseBlockSimd(const char* begin, const char* end,
+                      RowBlockContainer<IndexType>* out);
   int label_column_ = -1;
   int weight_column_ = -1;
   char delimiter_ = ',';
@@ -238,6 +260,10 @@ class LibFMParser : public TextParserBase<IndexType> {
                   RowBlockContainer<IndexType>* out) override;
 
  private:
+  void ParseBlockScalar(const char* begin, const char* end,
+                        RowBlockContainer<IndexType>* out);
+  void ParseBlockSimd(const char* begin, const char* end,
+                      RowBlockContainer<IndexType>* out);
   int indexing_mode_;
 };
 

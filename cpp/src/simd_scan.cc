@@ -1,0 +1,375 @@
+// Structural-scan kernels and runtime tier dispatch (simd_scan.h).
+//
+// Three kernels produce identical per-64-byte-block bitmasks:
+//   AVX2  two 32-byte compares per block (runtime CPUID gate)
+//   SSE2  four 16-byte compares per block (x86-64 baseline, always there)
+//   SWAR  eight 64-bit loads per block, per-byte tricks (portable LE)
+// A brute-force cross-check lives in cpp/test/test_core.cc (--parse suite)
+// and runs every supported tier against a scalar classifier.
+#include "simd_scan.h"
+
+#include <cstdlib>
+#include <string>
+
+#if defined(__x86_64__) || defined(__i386__)
+#define DCT_SIMD_X86 1
+#include <immintrin.h>
+#else
+#define DCT_SIMD_X86 0
+#endif
+
+namespace dct {
+
+namespace {
+
+// ---- SWAR kernel (portable little-endian fallback) -----------------------
+
+constexpr uint64_t kLo7 = 0x7F7F7F7F7F7F7F7Full;
+constexpr uint64_t kHiBits = 0x8080808080808080ull;
+constexpr uint64_t kOnes = 0x0101010101010101ull;
+
+// High bit set in each byte of x that equals c. Borrow-free (every byte of
+// (y | 0x80..) is >= 0x80 before the subtract), so the result is exact
+// per byte — the classic haszero() shortcut is NOT (borrows from a lower
+// matching byte can flag its neighbor).
+inline uint64_t SwarEq(uint64_t x, char c) {
+  const uint64_t y = x ^ (kOnes * static_cast<uint8_t>(c));
+  return ~(((y | kHiBits) - kOnes) | y | kLo7) & kHiBits;
+}
+
+// High bit set in each byte of x holding an ASCII digit.
+inline uint64_t SwarDigit(uint64_t x) {
+  // byte is a digit iff high nibble == 3 and low nibble <= 9 (same
+  // classification as numparse.h DigitRunLen8, applied per byte)
+  const uint64_t hi = (x & 0xF0F0F0F0F0F0F0F0ull) ^ 0x3030303030303030ull;
+  const uint64_t lo = ((x & 0x0F0F0F0F0F0F0F0Full) +
+                      0x0606060606060606ull) & 0x1010101010101010ull;
+  const uint64_t bad = hi | lo;  // nonzero byte <=> not a digit
+  return ~(((bad | kHiBits) - kOnes) | bad | kLo7) & kHiBits;
+}
+
+// Compress 0x80-per-byte hits into an 8-bit mask, bit i <=> byte i (LE
+// byte order — the tape addresses bytes by offset, so bit i of a block
+// word must classify byte base + w*64 + i).
+inline uint32_t SwarMask8(uint64_t hits) {
+  return static_cast<uint32_t>((hits * 0x0002040810204081ull) >> 56);
+}
+
+struct BlockMasks {
+  uint64_t blank, sep, eol, digit;
+};
+
+inline BlockMasks ClassifySWAR(const uint8_t* p, char b0, char b1,
+                               char sep) {
+  BlockMasks m{0, 0, 0, 0};
+  for (int i = 0; i < 8; ++i) {
+    uint64_t x;
+    std::memcpy(&x, p + i * 8, 8);
+    const unsigned sh = static_cast<unsigned>(i * 8);
+    uint64_t blank = SwarEq(x, b0) | SwarEq(x, b1);
+    m.blank |= static_cast<uint64_t>(SwarMask8(blank)) << sh;
+    m.sep |= static_cast<uint64_t>(SwarMask8(SwarEq(x, sep))) << sh;
+    m.eol |= static_cast<uint64_t>(
+                 SwarMask8(SwarEq(x, '\n') | SwarEq(x, '\r'))) << sh;
+    m.digit |= static_cast<uint64_t>(SwarMask8(SwarDigit(x))) << sh;
+  }
+  return m;
+}
+
+#if DCT_SIMD_X86
+
+// ---- SSE2 kernel (x86-64 baseline) ---------------------------------------
+
+inline BlockMasks ClassifySSE2(const uint8_t* p, char b0, char b1,
+                               char sep) {
+  BlockMasks m{0, 0, 0, 0};
+  const __m128i vb0 = _mm_set1_epi8(b0);
+  const __m128i vb1 = _mm_set1_epi8(b1);
+  const __m128i vsep = _mm_set1_epi8(sep);
+  const __m128i vnl = _mm_set1_epi8('\n');
+  const __m128i vcr = _mm_set1_epi8('\r');
+  const __m128i lo = _mm_set1_epi8('0' - 1);
+  const __m128i hi = _mm_set1_epi8('9' + 1);
+  for (int i = 0; i < 4; ++i) {
+    const __m128i x =
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(p + i * 16));
+    const unsigned sh = static_cast<unsigned>(i * 16);
+    const __m128i blank = _mm_or_si128(_mm_cmpeq_epi8(x, vb0),
+                                       _mm_cmpeq_epi8(x, vb1));
+    const __m128i eol = _mm_or_si128(_mm_cmpeq_epi8(x, vnl),
+                                     _mm_cmpeq_epi8(x, vcr));
+    // '0'..'9' are 0x30..0x39: positive as signed bytes, so the signed
+    // compares classify correctly (>= 0x80 bytes are negative -> excluded)
+    const __m128i digit = _mm_and_si128(_mm_cmpgt_epi8(x, lo),
+                                        _mm_cmpgt_epi8(hi, x));
+    m.blank |= static_cast<uint64_t>(
+                   static_cast<uint32_t>(_mm_movemask_epi8(blank))) << sh;
+    m.sep |= static_cast<uint64_t>(static_cast<uint32_t>(
+                 _mm_movemask_epi8(_mm_cmpeq_epi8(x, vsep)))) << sh;
+    m.eol |= static_cast<uint64_t>(
+                 static_cast<uint32_t>(_mm_movemask_epi8(eol))) << sh;
+    m.digit |= static_cast<uint64_t>(
+                   static_cast<uint32_t>(_mm_movemask_epi8(digit))) << sh;
+  }
+  return m;
+}
+
+// ---- AVX2 kernel (runtime-dispatched) ------------------------------------
+
+// named helper, not a lambda: lambdas do not inherit the enclosing
+// function's target attribute, which breaks always_inline intrinsics
+__attribute__((target("avx2")))
+inline uint64_t Movemask64AVX2(__m256i a, __m256i b) {
+  return static_cast<uint64_t>(
+             static_cast<uint32_t>(_mm256_movemask_epi8(a))) |
+         (static_cast<uint64_t>(
+              static_cast<uint32_t>(_mm256_movemask_epi8(b))) << 32);
+}
+
+__attribute__((target("avx2")))
+inline BlockMasks ClassifyAVX2(const uint8_t* p, char b0, char b1,
+                               char sep) {
+  BlockMasks m;
+  const __m256i vb0 = _mm256_set1_epi8(b0);
+  const __m256i vb1 = _mm256_set1_epi8(b1);
+  const __m256i vsep = _mm256_set1_epi8(sep);
+  const __m256i vnl = _mm256_set1_epi8('\n');
+  const __m256i vcr = _mm256_set1_epi8('\r');
+  const __m256i lo = _mm256_set1_epi8('0' - 1);
+  const __m256i hi = _mm256_set1_epi8('9' + 1);
+  const __m256i x0 = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(p));
+  const __m256i x1 =
+      _mm256_loadu_si256(reinterpret_cast<const __m256i*>(p + 32));
+  m.blank = Movemask64AVX2(
+      _mm256_or_si256(_mm256_cmpeq_epi8(x0, vb0),
+                      _mm256_cmpeq_epi8(x0, vb1)),
+      _mm256_or_si256(_mm256_cmpeq_epi8(x1, vb0),
+                      _mm256_cmpeq_epi8(x1, vb1)));
+  m.sep = Movemask64AVX2(_mm256_cmpeq_epi8(x0, vsep),
+                         _mm256_cmpeq_epi8(x1, vsep));
+  m.eol = Movemask64AVX2(
+      _mm256_or_si256(_mm256_cmpeq_epi8(x0, vnl),
+                      _mm256_cmpeq_epi8(x0, vcr)),
+      _mm256_or_si256(_mm256_cmpeq_epi8(x1, vnl),
+                      _mm256_cmpeq_epi8(x1, vcr)));
+  m.digit = Movemask64AVX2(
+      _mm256_and_si256(_mm256_cmpgt_epi8(x0, lo),
+                       _mm256_cmpgt_epi8(hi, x0)),
+      _mm256_and_si256(_mm256_cmpgt_epi8(x1, lo),
+                       _mm256_cmpgt_epi8(hi, x1)));
+  return m;
+}
+
+#endif  // DCT_SIMD_X86
+
+// one body per tier so the hot loop's kernel call inlines tier-free;
+// the tail block is zero-padded ('\0' lands in no class)
+template <typename Classify>
+void BuildLoop(const uint8_t* p, size_t n, char b0, char b1, char sep,
+               ScanTape* t, Classify classify) {
+  const size_t full = n / 64;
+  for (size_t w = 0; w < full; ++w) {
+    const BlockMasks m = classify(p + w * 64, b0, b1, sep);
+    t->PushBlock(m.blank, m.sep, m.eol, m.digit, w);
+  }
+  const size_t rem = n - full * 64;
+  if (rem != 0) {
+    uint8_t tail[64] = {0};
+    std::memcpy(tail, p + full * 64, rem);
+    const BlockMasks m = classify(tail, b0, b1, sep);
+    // mask off the padding lanes ('\0' classifies to nothing, but a
+    // blank0/blank1/sep of '\0' — the disabled-class sentinel — must not
+    // turn padding into structurals)
+    const uint64_t live = rem == 64 ? ~0ull : ((1ull << rem) - 1);
+    t->PushBlock(m.blank & live, m.sep & live, m.eol & live,
+                 m.digit & live, full);
+  }
+}
+
+}  // namespace
+
+void BuildTapeSWAR(const uint8_t* p, size_t n, char b0, char b1, char sep,
+                   ScanTape* t) {
+  BuildLoop(p, n, b0, b1, sep, t, ClassifySWAR);
+}
+
+#if DCT_SIMD_X86
+void BuildTapeSSE2(const uint8_t* p, size_t n, char b0, char b1, char sep,
+                   ScanTape* t) {
+  BuildLoop(p, n, b0, b1, sep, t, ClassifySSE2);
+}
+
+// the whole loop (not just the classifier) carries the avx2 target so the
+// per-block kernel inlines into it — a cross-target call per 64 bytes
+// would eat the stage-1 budget
+__attribute__((target("avx2")))
+void BuildTapeAVX2(const uint8_t* p, size_t n, char b0, char b1, char sep,
+                   ScanTape* t) {
+  const size_t full = n / 64;
+  for (size_t w = 0; w < full; ++w) {
+    const BlockMasks m = ClassifyAVX2(p + w * 64, b0, b1, sep);
+    t->PushBlock(m.blank, m.sep, m.eol, m.digit, w);
+  }
+  const size_t rem = n - full * 64;
+  if (rem != 0) {
+    uint8_t tail[64] = {0};
+    std::memcpy(tail, p + full * 64, rem);
+    const BlockMasks m = ClassifyAVX2(tail, b0, b1, sep);
+    const uint64_t live = (1ull << rem) - 1;
+    t->PushBlock(m.blank & live, m.sep & live, m.eol & live,
+                 m.digit & live, full);
+  }
+}
+#else
+void BuildTapeSSE2(const uint8_t* p, size_t n, char b0, char b1, char sep,
+                   ScanTape* t) {
+  BuildTapeSWAR(p, n, b0, b1, sep, t);
+}
+void BuildTapeAVX2(const uint8_t* p, size_t n, char b0, char b1, char sep,
+                   ScanTape* t) {
+  BuildTapeSWAR(p, n, b0, b1, sep, t);
+}
+#endif
+
+void ScanTape::Build(const char* begin, const char* end, char blank0,
+                     char blank1, char sep, SimdTier tier) {
+  size_ = static_cast<size_t>(end - begin);
+  words_ = (size_ + 63) / 64;
+  n_sep_ = n_eol_ = 0;
+  all_.resize(words_);
+  sep_.resize(words_);
+  eol_.resize(words_);
+  digit_.resize(words_);
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(begin);
+  switch (tier) {
+    case kSimdAVX2:
+      BuildTapeAVX2(p, size_, blank0, blank1, sep, this);
+      break;
+    case kSimdSSE2:
+      BuildTapeSSE2(p, size_, blank0, blank1, sep, this);
+      break;
+    default:
+      BuildTapeSWAR(p, size_, blank0, blank1, sep, this);
+      break;
+  }
+}
+
+// ---- count-only scan (reserve hints) -------------------------------------
+// Same classifiers, popcount accumulation only — no mask stores. The tail
+// (< 64 bytes) runs scalar: cheaper than a masked block for a one-off.
+
+namespace {
+
+template <typename Classify>
+void CountLoop(const uint8_t* p, size_t n, char sep, size_t* n_sep,
+               size_t* n_eol, Classify classify) {
+  size_t seps = 0, eols = 0;
+  const size_t full = n / 64;
+  for (size_t w = 0; w < full; ++w) {
+    const BlockMasks m = classify(p + w * 64, '\0', '\0', sep);
+    seps += static_cast<size_t>(__builtin_popcountll(m.sep));
+    eols += static_cast<size_t>(__builtin_popcountll(m.eol));
+  }
+  for (size_t i = full * 64; i < n; ++i) {
+    const char c = static_cast<char>(p[i]);
+    seps += c == sep;
+    eols += c == '\n' || c == '\r';
+  }
+  *n_sep = seps;
+  *n_eol = eols;
+}
+
+#if DCT_SIMD_X86
+__attribute__((target("avx2")))
+void CountAVX2(const uint8_t* p, size_t n, char sep, size_t* n_sep,
+               size_t* n_eol) {
+  size_t seps = 0, eols = 0;
+  const size_t full = n / 64;
+  for (size_t w = 0; w < full; ++w) {
+    const BlockMasks m = ClassifyAVX2(p + w * 64, '\0', '\0', sep);
+    seps += static_cast<size_t>(__builtin_popcountll(m.sep));
+    eols += static_cast<size_t>(__builtin_popcountll(m.eol));
+  }
+  for (size_t i = full * 64; i < n; ++i) {
+    const char c = static_cast<char>(p[i]);
+    seps += c == sep;
+    eols += c == '\n' || c == '\r';
+  }
+  *n_sep = seps;
+  *n_eol = eols;
+}
+#endif
+
+}  // namespace
+
+void CountSepEol(const char* begin, const char* end, char sep,
+                 SimdTier tier, size_t* n_sep, size_t* n_eol) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(begin);
+  const size_t n = static_cast<size_t>(end - begin);
+  switch (tier) {
+#if DCT_SIMD_X86
+    case kSimdAVX2:
+      CountAVX2(p, n, sep, n_sep, n_eol);
+      break;
+    case kSimdSSE2:
+      CountLoop(p, n, sep, n_sep, n_eol, ClassifySSE2);
+      break;
+#endif
+    default:
+      CountLoop(p, n, sep, n_sep, n_eol, ClassifySWAR);
+      break;
+  }
+}
+
+// ---- tier detection ------------------------------------------------------
+
+SimdTier BestSupportedSimdTier() {
+  static const SimdTier best = [] {
+#if DCT_SIMD_X86
+    if (__builtin_cpu_supports("avx2")) return kSimdAVX2;
+    return kSimdSSE2;  // baseline of the x86-64 ABI
+#else
+    // SWAR kernels interpret 8-byte loads little-endian (bit i of a mask
+    // word must classify byte i); big-endian hosts keep the scalar lane —
+    // same compile-time discipline as numparse.h kSwarLE
+    return detail::kSwarLE ? kSimdSWAR : kSimdScalar;
+#endif
+  }();
+  return best;
+}
+
+SimdTier ResolveSimdTier() {
+  const char* env = std::getenv("DMLC_PARSE_SIMD");
+  const SimdTier best = BestSupportedSimdTier();
+  if (env == nullptr || *env == '\0') return best;
+  const std::string v(env);
+  if (v == "0" || v == "off" || v == "scalar") return kSimdScalar;
+  SimdTier want = best;
+  if (v == "swar") {
+    want = kSimdSWAR;
+  } else if (v == "sse2") {
+    want = kSimdSSE2;
+  } else if (v == "avx2") {
+    want = kSimdAVX2;
+  } else {
+    // "1"/"auto"/anything else: best supported (never error on an env
+    // knob — the parsers must keep working under a typo'd override)
+    return best;
+  }
+  // clamp a pinned tier to hardware support so CI override loops can list
+  // every tier on any host
+  if (want > best) want = best;
+  if (want == kSimdSWAR && !detail::kSwarLE) want = kSimdScalar;
+  return want;
+}
+
+const char* SimdTierName(int tier) {
+  switch (tier) {
+    case kSimdAVX2: return "avx2";
+    case kSimdSSE2: return "sse2";
+    case kSimdSWAR: return "swar";
+    default: return "scalar";
+  }
+}
+
+}  // namespace dct

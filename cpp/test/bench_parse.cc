@@ -89,14 +89,84 @@ void BenchFormat(const char* name, const std::string& corpus,
     double dt = Secs(t0, t1);
     if (dt < best) best = dt;
   }
-  printf("%-8s %7.1f MB/s  %9.0f rows/s  (%zu rows, %.1f MB, best of %d)\n",
+  printf("%-8s %7.1f MB/s  %9.0f rows/s  (%zu rows, %.1f MB, best of %d, "
+         "%s lane)\n",
          name, corpus.size() / best / 1e6, rows / best, rows,
-         corpus.size() / 1e6, reps);
+         corpus.size() / 1e6, reps, dct::SimdTierName(parser.simd_tier()));
+}
+
+// --check: correctness-mode smoke (make -C cpp ci): the SIMD decode lane
+// must reproduce the scalar lane's containers on every format corpus, for
+// every supported dispatch tier. No timing asserts — the throughput floor
+// lives in tests/test_parse_scaling.py where noise is budgeted for.
+template <typename ParserT>
+int CheckFormat(const char* name, const std::string& corpus,
+                const std::map<std::string, std::string>& args) {
+  // save/restore any ambient tier pin instead of erasing it
+  const char* ambient = ::getenv("DMLC_PARSE_SIMD");
+  const std::string saved = ambient != nullptr ? ambient : "";
+  const bool had = ambient != nullptr;
+  auto restore = [&] {
+    if (had) {
+      ::setenv("DMLC_PARSE_SIMD", saved.c_str(), 1);
+    } else {
+      ::unsetenv("DMLC_PARSE_SIMD");
+    }
+  };
+  ::setenv("DMLC_PARSE_SIMD", "0", 1);
+  ParserT scalar(nullptr, args, 1);
+  restore();
+  dct::RowBlockContainer<uint32_t> want;
+  scalar.ParseBlock(corpus.data(), corpus.data() + corpus.size(), &want);
+  int failures = 0;
+  for (int t = dct::kSimdSWAR; t <= dct::BestSupportedSimdTier(); ++t) {
+    ::setenv("DMLC_PARSE_SIMD", dct::SimdTierName(t), 1);
+    ParserT simd(nullptr, args, 1);
+    restore();
+    dct::RowBlockContainer<uint32_t> got;
+    simd.ParseBlock(corpus.data(), corpus.data() + corpus.size(), &got);
+    const bool same =
+        want.offset == got.offset && want.label == got.label &&
+        want.weight == got.weight && want.qid == got.qid &&
+        want.field == got.field && want.index == got.index &&
+        want.value == got.value && want.max_index == got.max_index &&
+        want.max_field == got.max_field;
+    if (!same) {
+      fprintf(stderr, "MISMATCH: %s lane %s != scalar\n", name,
+              dct::SimdTierName(t));
+      ++failures;
+    }
+  }
+  printf("%-8s ok (%zu rows, scalar == swar..%s)\n", name, want.Size(),
+         dct::SimdTierName(dct::BestSupportedSimdTier()));
+  return failures;
 }
 
 }  // namespace
 
 int main(int argc, char** argv) {
+  if (argc > 1 && std::string(argv[1]) == "--check") {
+    const int rows = argc > 2 ? atoi(argv[2]) : 20000;
+    int failures = 0;
+    {
+      std::string c = MakeLibsvm(rows, 28, 7);
+      failures += CheckFormat<dct::LibSVMParser<uint32_t>>("libsvm", c, {});
+    }
+    {
+      std::string c = MakeCSV(rows, 28, 7);
+      failures += CheckFormat<dct::CSVParser<uint32_t>>("csv", c, {});
+    }
+    {
+      std::string c = MakeLibfm(rows, 28, 7);
+      failures += CheckFormat<dct::LibFMParser<uint32_t>>("libfm", c, {});
+    }
+    if (failures != 0) {
+      fprintf(stderr, "%d lane mismatch(es)\n", failures);
+      return 1;
+    }
+    printf("OK\n");
+    return 0;
+  }
   int rows = argc > 1 ? atoi(argv[1]) : 100000;
   int reps = argc > 2 ? atoi(argv[2]) : 7;
   {

@@ -15,6 +15,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <memory>
+#include <random>
 #include <fstream>
 #include <sstream>
 #include <string>
@@ -1173,6 +1174,410 @@ void TestThreadedRecParse() {
   ExpectSummariesMatch(serial, fanout);
 }
 
+// ---- SIMD text-ingest engine (simd_scan.h) -- the `--parse` suite --------
+// Run standalone (test_core --parse) by the cpp/Makefile asan-parse /
+// tsan-parse lanes, with DMLC_PARSE_SIMD pinning each dispatch tier.
+
+// save/restore the ambient DMLC_PARSE_SIMD pin around tests that set it
+// (a caller running the whole binary pinned must keep its pin afterwards)
+struct ScopedParseSimdEnv {
+  ScopedParseSimdEnv() {
+    const char* cur = ::getenv("DMLC_PARSE_SIMD");
+    had_ = cur != nullptr;
+    if (had_) saved_ = cur;
+  }
+  ~ScopedParseSimdEnv() {
+    if (had_) {
+      ::setenv("DMLC_PARSE_SIMD", saved_.c_str(), 1);
+    } else {
+      ::unsetenv("DMLC_PARSE_SIMD");
+    }
+  }
+  bool had_ = false;
+  std::string saved_;
+};
+
+std::vector<dct::SimdTier> SupportedTiers() {
+  std::vector<dct::SimdTier> tiers{dct::kSimdSWAR};
+  if (dct::BestSupportedSimdTier() >= dct::kSimdSSE2) {
+    tiers.push_back(dct::kSimdSSE2);
+  }
+  if (dct::BestSupportedSimdTier() >= dct::kSimdAVX2) {
+    tiers.push_back(dct::kSimdAVX2);
+  }
+  return tiers;
+}
+
+void TestScanTapeKernelsAgree() {
+  // every kernel tier must classify byte-for-byte like a scalar oracle,
+  // including block tails, runs crossing 64-byte boundaries, and bytes
+  // >= 0x80 (signed-compare traps)
+  std::mt19937 rng(41);
+  const char pool[] = "0123456789 \t:\n\r#abcZ.-+\xEF\xBB\x80\xFF";
+  for (int round = 0; round < 8; ++round) {
+    const size_t n = 1 + static_cast<size_t>(rng() % 300);
+    std::string buf(n, '\0');
+    for (auto& c : buf) c = pool[rng() % (sizeof(pool) - 1)];
+    for (dct::SimdTier tier : SupportedTiers()) {
+      dct::ScanTape tape;
+      tape.Build(buf.data(), buf.data() + n, ' ', '\t', ':', tier);
+      size_t seps = 0, eols = 0;
+      for (size_t i = 0; i < n; ++i) {
+        const char c = buf[i];
+        const bool sep = c == ':';
+        const bool eol = c == '\n' || c == '\r';
+        const bool blank = c == ' ' || c == '\t';
+        const bool digit = c >= '0' && c <= '9';
+        EXPECT(tape.IsStructural(i) == (sep || eol || blank));
+        EXPECT(tape.IsSep(i) == sep);
+        EXPECT(tape.IsEol(i) == eol);
+        EXPECT(tape.IsBlankKind(i) == blank);
+        EXPECT((tape.DigitRunAt(i, 1) == 1) == digit);
+        seps += sep;
+        eols += eol;
+      }
+      EXPECT(tape.sep_count() == seps);
+      EXPECT(tape.eol_count() == eols);
+      // digit-run extents across word boundaries
+      for (size_t i = 0; i < n; ++i) {
+        int want = 0;
+        while (i + want < n && buf[i + want] >= '0' &&
+               buf[i + want] <= '9' && want < 20) {
+          ++want;
+        }
+        EXPECT(tape.DigitRunAt(i, 20) == want);
+      }
+      // the count-only scan matches the materialized tape
+      size_t cn_sep = 0, cn_eol = 0;
+      dct::CountSepEol(buf.data(), buf.data() + n, ':', tier, &cn_sep,
+                       &cn_eol);
+      EXPECT(cn_sep == seps && cn_eol == eols);
+    }
+  }
+}
+
+void TestStructCursorWalk() {
+  std::mt19937 rng(43);
+  const char pool[] = "01 :\n\raz";
+  for (int round = 0; round < 6; ++round) {
+    const size_t n = 1 + static_cast<size_t>(rng() % 200);
+    std::string buf(n, '\0');
+    for (auto& c : buf) c = pool[rng() % (sizeof(pool) - 1)];
+    dct::ScanTape tape;
+    tape.Build(buf.data(), buf.data() + n, ' ', '\t', ':',
+               dct::BestSupportedSimdTier());
+    // the cursor must enumerate exactly the structural bytes, in order,
+    // with the right classes
+    dct::StructCursor sc(tape);
+    for (size_t i = 0; i < n; ++i) {
+      if (!tape.IsStructural(i)) continue;
+      EXPECT(sc.pos == i);
+      EXPECT(sc.kind == tape.KindOf(i));
+      sc.Advance();
+    }
+    EXPECT(sc.pos == n && sc.kind == dct::ScanTape::kNone);
+    // SeekTo resyncs mid-stream
+    const size_t mid = n / 2;
+    dct::ScanTape::Kind k;
+    const size_t want = tape.NextStructural(mid, &k);
+    sc.SeekTo(mid);
+    EXPECT(sc.pos == want && sc.kind == k);
+  }
+}
+
+// fuzz corpus of numeric-ish tokens: whenever a fused primitive accepts,
+// its value must be BIT-identical to ParseNum's and its consumption equal
+std::vector<std::string> FusedFuzzTokens() {
+  std::vector<std::string> toks = {
+      "0",        "1",      "9",       "42",        "007",
+      "123456",   "12345678901234567890",           "4294967296",
+      "2.5",      "-2.5",   "+2.5",    "0.500000",  "-0.000001",
+      ".5",       "5.",     ".",       "-",         "+",
+      "1e4",      "1E-4",   "2.5e3",   "1e",        "1e+",
+      "3.14159265358979",   "123456789.123456789",  "0x10",
+      "nan",      "inf",    "-inf",    "NaN",       "abc",
+      "12ab",     "1.2.3",  "--5",     "9999999999999999999999",
+      "0.12345678",         "12345678.9",           "00000000000000001",
+  };
+  std::mt19937 rng(47);
+  std::uniform_real_distribution<double> val(-1e6, 1e6);
+  char buf[64];
+  for (int i = 0; i < 400; ++i) {
+    switch (rng() % 4) {
+      case 0:
+        snprintf(buf, sizeof buf, "%.*f", static_cast<int>(rng() % 12),
+                 val(rng));
+        break;
+      case 1:
+        snprintf(buf, sizeof buf, "%g", val(rng) * 1e-8);
+        break;
+      case 2:
+        snprintf(buf, sizeof buf, "%llu",
+                 static_cast<unsigned long long>(rng()) * rng());
+        break;
+      default:
+        snprintf(buf, sizeof buf, "%d", static_cast<int>(rng()));
+        break;
+    }
+    toks.push_back(buf);
+  }
+  return toks;
+}
+
+void TestFusedDecodersMatchScalar() {
+  for (const std::string& tok : FusedFuzzTokens()) {
+    for (const char* suffix : {"", " tail", ":3", "\n1 2:3", "…"}) {
+      const std::string s = tok + suffix;
+      const char* p = s.data();
+      const char* end = p + s.size();
+      // float: fused acceptance implies bit-identical value + consumption
+      float fv = 0.0f;
+      const char* fa = dct::DecodeFloatAuto(p, end, &fv);
+      float sv = 0.0f;
+      const char* sp = p;
+      const bool sok = dct::ParseNum<float>(p, end, &sp, &sv);
+      if (fa != nullptr) {
+        EXPECT(sok);
+        EXPECT(fa == sp);
+        EXPECT(std::memcmp(&fv, &sv, sizeof fv) == 0);
+      }
+      // the composed wrapper must EQUAL ParseNum on every input
+      float wv = 0.0f;
+      const char* wp = p;
+      const bool wok = dct::ParseNumF<true, float>(p, end, &wp, &wv);
+      EXPECT(wok == sok);
+      if (sok) {
+        EXPECT(wp == sp);
+        EXPECT(std::memcmp(&wv, &sv, sizeof wv) == 0);
+      }
+      // unsigned and signed integral wrappers likewise
+      uint64_t u_f = 0, u_s = 0;
+      const char *up_f = p, *up_s = p;
+      const bool uok_f = dct::ParseNumF<true, uint64_t>(p, end, &up_f, &u_f);
+      const bool uok_s = dct::ParseNum<uint64_t>(p, end, &up_s, &u_s);
+      EXPECT(uok_f == uok_s);
+      if (uok_s) EXPECT(up_f == up_s && u_f == u_s);
+      int32_t i_f = 0, i_s = 0;
+      const char *ip_f = p, *ip_s = p;
+      const bool iok_f = dct::ParseNumF<true, int32_t>(p, end, &ip_f, &i_f);
+      const bool iok_s = dct::ParseNum<int32_t>(p, end, &ip_s, &i_s);
+      EXPECT(iok_f == iok_s);
+      if (iok_s) EXPECT(ip_f == ip_s && i_f == i_s);
+    }
+  }
+  // FusedDigitScan: verified digit runs with exact values at every length
+  std::string digits = "12345678901234567890123";
+  for (size_t len = 1; len <= digits.size(); ++len) {
+    // trailing padding keeps the 8/16-byte load guards satisfied, so only
+    // genuine 16+ digit runs may defer to the exact path
+    std::string s = digits.substr(0, len) + ":" + std::string(16, ' ');
+    uint64_t v = 0;
+    const int il = dct::FusedDigitScan(s.data(), s.data() + s.size(), &v);
+    if (il != dct::kFusedOverflow) {
+      EXPECT(il == static_cast<int>(len));
+      uint64_t want = 0;
+      for (size_t i = 0; i < len; ++i) want = want * 10 + (digits[i] - '0');
+      EXPECT(v == want);
+    } else {
+      EXPECT(len >= 16);  // only 16+ digit runs may defer to the exact path
+    }
+  }
+}
+
+// adversarial text corpora: every dispatch tier must produce containers
+// byte-identical to the scalar lane, for every format and index width
+const char* kAdversarialLibSVM =
+    "\xEF\xBB\xBF"
+    "1 0:2.5 3:-0.75 7:1e-4\r\n"
+    "0\r"
+    "# a comment line with 5:5 inside\n"
+    "   \t \n"
+    "2:0.5 3:9.25 11:3\n"
+    "1:1.5 2 qid:7 4:4\n"
+    "-1 qid:9 1:0.5 2:0.25\n"
+    "3.5:2.25 1:1 2:2\n"
+    "1 12345678901:3.5 2:2\n"
+    "1 4294967296:1 1:1\n"
+    "1 1:0.123456789012345678 2:2.5\n"
+    "1 3:nan 4:inf 5:0x10\n"
+    "1 +5:2.5 6:+0.5\n"
+    "garbage line here\n"
+    "1 2:3 trailing junk\n"
+    "1 1:2.5e309 2:1\n"
+    "0 1:.5 2:5. 3:.\n"
+    "1 000000000000001:2 2:3\n"
+    "1 7:1.25 # trailing comment\n"
+    "1 8:";
+
+const char* kAdversarialCSV =
+    "\xEF\xBB\xBF"
+    "1,2.5,,-0.75,1e-4\r\n"
+    "\r"
+    ",,,\n"
+    "0, .5 ,5.,nan\n"
+    "1,0x10,inf,-inf\n"
+    "3,  2.25,junk,4.5trailing\n"
+    "9,123456789012345678901,0.123456789012345,+7\n"
+    "2,-3.5,1.25,";
+
+const char* kAdversarialLibFM =
+    "\xEF\xBB\xBF"
+    "1 0:1:0.5 2:3:-0.25\r\n"
+    "0\r"
+    "# comment 1:2:3\n"
+    "  \t\n"
+    "1:0.5 2:3:1e-4 7\n"
+    "-1 1:2 3:4:5.5 12345678901:2:3\n"
+    "1 4294967296:1:1 1:1:1\n"
+    "1 1:2:3:4 5:6:7\n"
+    "garbage 1:2:3\n"
+    "1 2:+3:0.5 4:5:+1.5\n"
+    "0 1:.5:.25 2:5.:1\n"
+    "1 3:4:";
+
+template <typename IndexType, typename ParserT>
+dct::RowBlockContainer<IndexType> ParseWithTier(
+    ParserT* parser, const std::string& corpus) {
+  dct::RowBlockContainer<IndexType> out;
+  parser->ParseBlock(corpus.data(), corpus.data() + corpus.size(), &out);
+  return out;
+}
+
+template <typename T>
+bool VecBitsEqual(const std::vector<T>& a, const std::vector<T>& b) {
+  // bitwise compare: float vectors may legitimately hold NaN
+  return a.size() == b.size() &&
+         (a.empty() ||
+          std::memcmp(a.data(), b.data(), a.size() * sizeof(T)) == 0);
+}
+
+template <typename IndexType>
+bool ContainersEqual(const dct::RowBlockContainer<IndexType>& a,
+                     const dct::RowBlockContainer<IndexType>& b) {
+  return a.offset == b.offset && VecBitsEqual(a.label, b.label) &&
+         VecBitsEqual(a.weight, b.weight) && a.qid == b.qid &&
+         a.field == b.field && a.index == b.index &&
+         VecBitsEqual(a.value, b.value) && a.value_i32 == b.value_i32 &&
+         a.value_i64 == b.value_i64 && a.value_dtype == b.value_dtype &&
+         a.max_index == b.max_index && a.max_field == b.max_field;
+}
+
+template <typename IndexType>
+void DifferentialOneWidth() {
+  ScopedParseSimdEnv scoped_env;
+  const std::map<std::string, std::string> no_args;
+  for (int mode : {0, 1, -1}) {
+    std::map<std::string, std::string> margs;
+    margs["indexing_mode"] =
+        mode == 0 ? "zero_based" : mode == 1 ? "one_based" : "auto";
+    for (dct::SimdTier tier : SupportedTiers()) {
+      ::setenv("DMLC_PARSE_SIMD", "0", 1);
+      dct::LibSVMParser<IndexType> svm_s(nullptr, margs, 1);
+      dct::LibFMParser<IndexType> fm_s(nullptr, margs, 1);
+      ::setenv("DMLC_PARSE_SIMD", dct::SimdTierName(tier), 1);
+      dct::LibSVMParser<IndexType> svm_v(nullptr, margs, 1);
+      dct::LibFMParser<IndexType> fm_v(nullptr, margs, 1);
+      ::unsetenv("DMLC_PARSE_SIMD");
+      EXPECT(ContainersEqual(
+          ParseWithTier<IndexType>(&svm_s, kAdversarialLibSVM),
+          ParseWithTier<IndexType>(&svm_v, kAdversarialLibSVM)));
+      EXPECT(ContainersEqual(
+          ParseWithTier<IndexType>(&fm_s, kAdversarialLibFM),
+          ParseWithTier<IndexType>(&fm_v, kAdversarialLibFM)));
+    }
+  }
+  for (int dtype : {0, 1, 2}) {
+    std::map<std::string, std::string> cargs;
+    cargs["label_column"] = "0";
+    cargs["dtype"] = dtype == 0 ? "float32" : dtype == 1 ? "int32" : "int64";
+    for (dct::SimdTier tier : SupportedTiers()) {
+      ::setenv("DMLC_PARSE_SIMD", "0", 1);
+      dct::CSVParser<IndexType> csv_s(nullptr, cargs, 1);
+      ::setenv("DMLC_PARSE_SIMD", dct::SimdTierName(tier), 1);
+      dct::CSVParser<IndexType> csv_v(nullptr, cargs, 1);
+      ::unsetenv("DMLC_PARSE_SIMD");
+      EXPECT(ContainersEqual(
+          ParseWithTier<IndexType>(&csv_s, kAdversarialCSV),
+          ParseWithTier<IndexType>(&csv_v, kAdversarialCSV)));
+    }
+  }
+  (void)no_args;
+}
+
+void TestParseSimdDifferential() {
+  ScopedParseSimdEnv scoped_env;
+  DifferentialOneWidth<uint32_t>();
+  DifferentialOneWidth<uint64_t>();
+  // randomized rows, truncated at every offset near the end so chunk
+  // boundaries land mid-token (the tail token then crosses load guards)
+  std::mt19937 rng(53);
+  std::uniform_real_distribution<double> val(-100.0, 100.0);
+  std::string corpus;
+  char buf[96];
+  for (int r = 0; r < 200; ++r) {
+    corpus += std::to_string(r % 3);
+    const int feats = static_cast<int>(rng() % 6);
+    for (int f = 0; f < feats; ++f) {
+      snprintf(buf, sizeof buf, " %u:%.*f",
+               static_cast<unsigned>(rng() % 100000000),
+               static_cast<int>(rng() % 10), val(rng));
+      corpus += buf;
+    }
+    corpus += (rng() % 8) == 0 ? "\r\n" : "\n";
+  }
+  const std::map<std::string, std::string> args;
+  ::setenv("DMLC_PARSE_SIMD", "0", 1);
+  dct::LibSVMParser<uint32_t> scalar(nullptr, args, 1);
+  ::unsetenv("DMLC_PARSE_SIMD");
+  dct::LibSVMParser<uint32_t> simd(nullptr, args, 1);
+  for (size_t cut = corpus.size() > 64 ? corpus.size() - 64 : 0;
+       cut <= corpus.size(); ++cut) {
+    const std::string part = corpus.substr(0, cut);
+    EXPECT(ContainersEqual(ParseWithTier<uint32_t>(&scalar, part),
+                           ParseWithTier<uint32_t>(&simd, part)));
+  }
+}
+
+void TestSimdTierResolution() {
+  ScopedParseSimdEnv scoped_env;
+  // the kill switch and the tier overrides must resolve predictably
+  ::setenv("DMLC_PARSE_SIMD", "0", 1);
+  EXPECT(dct::ResolveSimdTier() == dct::kSimdScalar);
+  ::setenv("DMLC_PARSE_SIMD", "off", 1);
+  EXPECT(dct::ResolveSimdTier() == dct::kSimdScalar);
+  ::setenv("DMLC_PARSE_SIMD", "swar", 1);
+  EXPECT(dct::ResolveSimdTier() == dct::kSimdSWAR);
+  ::setenv("DMLC_PARSE_SIMD", "avx2", 1);
+  EXPECT(dct::ResolveSimdTier() <= dct::kSimdAVX2);  // clamped to support
+  ::setenv("DMLC_PARSE_SIMD", "definitely-a-typo", 1);
+  EXPECT(dct::ResolveSimdTier() == dct::BestSupportedSimdTier());
+  ::unsetenv("DMLC_PARSE_SIMD");
+  EXPECT(dct::ResolveSimdTier() == dct::BestSupportedSimdTier());
+  // the pipeline reports the lane through its stats struct
+  dct::TemporaryDirectory tmp;
+  std::string path = tmp.path() + "/t.libsvm";
+  {
+    std::ofstream f(path);
+    for (int i = 0; i < 1000; ++i) f << "1 0:1 1:2\n";
+  }
+  std::unique_ptr<dct::Parser<uint32_t>> p(
+      dct::Parser<uint32_t>::Create(path, 0, 1, "libsvm", 2, true, 2));
+  while (p->NextBlock() != nullptr) {
+  }
+  dct::ParsePipelineStats st;
+  EXPECT(p->GetPipelineStats(&st));
+  EXPECT(st.simd_tier ==
+         static_cast<uint64_t>(dct::BestSupportedSimdTier()));
+}
+
+void RunParseSimdSuite() {
+  TestScanTapeKernelsAgree();
+  TestStructCursorWalk();
+  TestFusedDecodersMatchScalar();
+  TestParseSimdDifferential();
+  TestSimdTierResolution();
+}
+
 // ---- remote-I/O resilience layer (retry.h) -- the `--io` / tsan-io suite --
 
 void TestCheckedEnvParse() {
@@ -1478,6 +1883,18 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;
   }
+  if (argc > 1 && std::string(argv[1]) == "--parse") {
+    // the SIMD text-ingest suite alone — the cpp/Makefile asan-parse /
+    // tsan-parse lanes run exactly this under sanitizers, with
+    // DMLC_PARSE_SIMD pinning each dispatch tier
+    RunParseSimdSuite();
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
   if (argc > 1 && std::string(argv[1]) == "--pipeline") {
     // the parse-pipeline concurrency suite alone — the cpp/Makefile
     // tsan-pipeline lane runs exactly this under ThreadSanitizer
@@ -1522,6 +1939,7 @@ int main(int argc, char** argv) {
   TestParsePipelineReaderThrow();
   TestThreadedTextParse();
   TestThreadedRecParse();
+  RunParseSimdSuite();
   RunIoResilienceSuite();
   if (g_failures == 0) {
     std::printf("OK\n");

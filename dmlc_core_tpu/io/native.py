@@ -73,6 +73,9 @@ class ParsePipelineStatsC(ctypes.Structure):
         ("inflight_sum", ctypes.c_uint64),
         ("capacity", ctypes.c_uint64),
         ("workers", ctypes.c_uint64),
+        # structural-scan lane (cpp/src/simd_scan.h SimdTier):
+        # 0 scalar, 1 swar, 2 sse2, 3 avx2
+        ("simd_tier", ctypes.c_uint64),
     ]
 
 
@@ -740,6 +743,10 @@ class NativeParser:
         out = {name: int(getattr(s, name)) for name, _ in s._fields_}
         out["occupancy_avg"] = (round(s.inflight_sum / s.chunks_read, 3)
                                 if s.chunks_read else 0.0)
+        # structural-scan lane by name (doc/parsing.md): which decode tier
+        # the text parsers run — scalar / swar / sse2 / avx2
+        out["simd_lane"] = {0: "scalar", 1: "swar", 2: "sse2",
+                            3: "avx2"}.get(int(s.simd_tier), "scalar")
         return out
 
     def io_stats(self) -> dict:

@@ -246,6 +246,9 @@ def gen_index() -> str:
         "strategies (DP/SP/TP/EP/PP) and their oracles |",
         "| [pipeline.md](pipeline.md) | the multi-chunk parse pipeline: "
         "stages, knobs, occupancy counters |",
+        "| [parsing.md](parsing.md) | SIMD text ingest: structural "
+        "scanner tiers, fused field decoders, DMLC_PARSE_SIMD, the "
+        "byte-identical guarantee |",
         "| [robustness.md](robustness.md) | remote-I/O resilience: retry "
         "model, env/URI knobs, fault-plan grammar, io_stats() |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
