@@ -2,10 +2,10 @@
 # on every push: .github/workflows/githubci.yml, scripts/test_script.sh).
 # `make ci` runs every lane; each lane is also callable alone.
 
-.PHONY: ci lint native-test tsan-test asan-test parse-lanes pytest bench-smoke dryrun \
-        doc clean
+.PHONY: ci lint native-test tsan-test asan-test parse-lanes pytest liveness \
+        bench-smoke dryrun doc clean
 
-ci: lint native-test tsan-test asan-test parse-lanes pytest dryrun doc
+ci: lint native-test tsan-test asan-test parse-lanes pytest liveness dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -36,6 +36,13 @@ tsan-test:
 
 pytest:
 	python3 -m pytest tests/ -q
+
+# distributed-job liveness chaos suite (doc/robustness.md): SIGKILL'd
+# workers must recover (supervised) or abort the job within the deadline
+# (unsupervised). The hard timeout makes a liveness regression a fast
+# red instead of a hung CI job -- the exact failure mode the suite pins.
+liveness:
+	timeout -k 10 300 python3 -m pytest tests/test_tracker_liveness.py -q
 
 dryrun:
 	python3 -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -45,6 +45,15 @@ def build_env(base: Dict[str, str],
     if cluster is None:
         raise RuntimeError("need DMLC_JOB_CLUSTER in the environment")
 
+    # liveness knobs (doc/robustness.md) ride the same env ABI; a typo'd
+    # value must fail HERE, in the container bootstrap, not silently
+    # disable the heartbeat and let the job hang the old way
+    from dmlc_core_tpu.tracker.wire import env_int
+    for key in ("DMLC_TRACKER_HEARTBEAT_MS", "DMLC_TRACKER_DEAD_AFTER_MS",
+                "DMLC_TRACKER_RECOVER_GRACE_MS"):
+        if env.get(key):
+            env_int(key, 0, env=env)  # raises RuntimeError on garbage
+
     if cluster == "sge" and "DMLC_TASK_ID" in env:
         # array jobs carry no role: first num_worker tasks are workers
         num_worker = int(env.get("DMLC_NUM_WORKER", "0"))

@@ -6,15 +6,63 @@ same wire protocol so that (a) the tracker is testable in-process with N
 fake workers — the single-process multi-"host" simulation strategy the
 reference applies to InputSplit (SURVEY §4) — and (b) Python workers can
 join a legacy Rabit rendezvous without the C++ library.
+
+Liveness (doc/robustness.md "Distributed job liveness"): when the tracker
+exports DMLC_TRACKER_HEARTBEAT_MS (or ``start(heartbeat=True)``), the
+client opens a persistent heartbeat channel after learning its rank. The
+HeartbeatMonitor pings on the announced interval and listens for the
+tracker's abort broadcast; on abort it slams every guarded socket so a
+worker blocked in a peer link raises TrackerAbortedError within the
+deadline instead of hanging forever. Every client-side socket op also
+carries a timeout — a hung tracker or a peer that never dials fails the
+worker within DMLC_TRACKER_CLIENT_TIMEOUT seconds, not never.
 """
 
 from __future__ import annotations
 
+import os
 import socket
+import struct
+import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
-from dmlc_core_tpu.tracker.wire import MAGIC, WireSocket
+from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
+                                        HEARTBEAT_BYE, HEARTBEAT_PING, MAGIC,
+                                        TrackerAbortedError, WireSocket,
+                                        env_int)
+
+
+def _default_timeout() -> float:
+    """Deadline for every client-side blocking socket op (seconds).
+    `0` disables the deadline (the PR 2 convention) — returned as inf,
+    which `_sock_timeout` maps back to blocking mode."""
+    raw = os.environ.get("DMLC_TRACKER_CLIENT_TIMEOUT", "300")
+    try:
+        t = float(raw)
+    except ValueError:
+        raise RuntimeError(
+            f"DMLC_TRACKER_CLIENT_TIMEOUT={raw!r} is not a number")
+    return float("inf") if t <= 0 else t
+
+
+def _sock_timeout(timeout: float):
+    """A socket-API timeout for our deadline value: None (block forever)
+    when the deadline is disabled — settimeout(0) would mean NON-BLOCKING
+    and fail every op instantly."""
+    return None if timeout == float("inf") else timeout
+
+
+def _default_jobid() -> str:
+    """The reference tracker's jobid convention: workers report their
+    launcher task id on the wire (tracker.py job_map), which (a) lets a
+    restarted task reclaim its old rank and (b) lets the tracker tell
+    the supervisor WHICH task a dead rank belongs to — ranks are
+    assigned by host-sorted arrival, so rank != DMLC_TASK_ID in
+    general."""
+    task = os.environ.get("DMLC_TASK_ID")
+    return f"task{task}" if task else "NULL"
 
 
 @dataclass
@@ -29,23 +77,207 @@ class TopologyAssignment:
     links: Dict[int, WireSocket] = field(default_factory=dict)
 
 
+class HeartbeatMonitor:
+    """The worker half of the liveness protocol: one daemon thread that
+    pings the tracker on the announced interval and listens for the abort
+    broadcast on the same channel.
+
+    Blocking sockets registered with :meth:`guard` are closed when an
+    abort lands, so a worker stuck in a peer accept()/recv() raises
+    immediately; the caller then turns that OSError into the structured
+    TrackerAbortedError via :meth:`check`."""
+
+    def __init__(self, tracker_host: str, tracker_port: int, rank: int,
+                 jobid: str = "NULL", timeout: Optional[float] = None):
+        self.rank = rank
+        self.aborted: Optional[str] = None
+        self._closing = False
+        self._lock = threading.Lock()
+        self._guarded: List[socket.socket] = []
+        timeout = _default_timeout() if timeout is None else timeout
+        sock = socket.create_connection((tracker_host, tracker_port),
+                                        timeout=_sock_timeout(timeout))
+        sock.settimeout(_sock_timeout(timeout))
+        ws = WireSocket(sock)
+        try:
+            ws.send_int(MAGIC)
+            got = ws.recv_int()
+            if got != MAGIC:
+                raise ConnectionError(f"bad tracker magic {got:#x}")
+            ws.send_int(rank)
+            ws.send_int(-1)
+            ws.send_str(jobid)
+            ws.send_str(CMD_HEARTBEAT)
+            interval_ms = ws.recv_int()
+            if interval_ms <= 0:
+                raise ConnectionError(
+                    f"tracker announced invalid heartbeat interval "
+                    f"{interval_ms} ms")
+        except BaseException:
+            # no thread owns the socket yet: a failed handshake (tracker
+            # rejecting the rank, bad magic) must not leak the fd —
+            # retry loops would accumulate one per attempt up to EMFILE
+            ws.close()
+            raise
+        self.interval = interval_ms / 1000.0
+        self._ws = ws
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"heartbeat-rank{rank}")
+        self._thread.start()
+
+    def guard(self, sock: socket.socket) -> None:
+        """Close `sock` if the job aborts (unblocks whoever is blocked on
+        it). Already-aborted monitors close it immediately."""
+        with self._lock:
+            if self.aborted is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            self._guarded.append(sock)
+
+    def unguard(self, sock: socket.socket) -> None:
+        """Stop tracking `sock` (it outlived the risky blocking phase)."""
+        with self._lock:
+            if sock in self._guarded:
+                self._guarded.remove(sock)
+
+    def check(self) -> None:
+        """Raise TrackerAbortedError if the tracker aborted the job —
+        call this when a guarded socket op fails, and periodically from
+        long compute loops."""
+        if self.aborted is not None:
+            raise TrackerAbortedError(self.aborted)
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Block until the job aborts (or `timeout` elapses); returns the
+        abort reason or None. Also returns when the channel closes."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.aborted is None and self._thread.is_alive():
+            step = 0.05
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                step = min(step, left)
+            self._thread.join(step)
+        return self.aborted
+
+    def close(self, graceful: bool = True) -> None:
+        """Stop pinging and close the channel — never the abort path.
+
+        `graceful=True` (normal job end) says BYE first, so the tracker
+        disarms liveness for this rank instead of logging a lost
+        channel. `graceful=False` (this worker is dying abnormally)
+        closes abruptly: the tracker's dead-after clock MUST keep
+        running so the failure is detected and the job aborted — a BYE
+        here would silently untrack the dying rank and hang the job."""
+        self._closing = True
+        if graceful:
+            try:
+                self._ws.send_int(HEARTBEAT_BYE)
+            except OSError:
+                pass
+        try:
+            self._ws.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+
+    def _trip(self, reason: str) -> None:
+        with self._lock:
+            if self.aborted is None:
+                self.aborted = reason
+            guarded, self._guarded = self._guarded, []
+        for s in guarded:
+            # shutdown() first: close() alone does NOT unblock a thread
+            # already parked in accept()/recv() on this fd (Linux keeps
+            # the syscall waiting on the orphaned descriptor); shutdown
+            # forces those to return immediately
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _run(self) -> None:
+        sock = self._ws.sock
+        try:
+            sock.settimeout(self.interval)
+        except OSError:
+            return
+        # partial frames survive across interval timeouts: recv_all would
+        # DROP bytes it already buffered when the ping clock fires, and a
+        # tracker abort word split across TCP segments would desync the
+        # channel forever — exactly when the abort matters most
+        buf = b""
+        while not self._closing:
+            try:
+                chunk = sock.recv(4 - len(buf))
+                if not chunk:
+                    if not self._closing:
+                        self._trip("heartbeat channel to the tracker lost")
+                    return
+                buf += chunk
+                if len(buf) < 4:
+                    continue
+                val = struct.unpack("@i", buf)[0]
+                buf = b""
+                if val == HEARTBEAT_ABORT:
+                    sock.settimeout(5.0)
+                    reason = self._ws.recv_str()
+                    self._trip(reason)
+                    return
+                # any other tracker->worker frame is unexpected; ignore
+            except socket.timeout:
+                # the quiet interval elapsed: time to ping
+                try:
+                    self._ws.send_int(HEARTBEAT_PING)
+                except OSError:
+                    if not self._closing:
+                        self._trip("heartbeat channel to the tracker lost")
+                    return
+            except (OSError, ConnectionError):
+                if not self._closing:
+                    self._trip("heartbeat channel to the tracker lost")
+                return
+
+
 class RendezvousClient:
     """Speaks the tracker protocol end-to-end, including peer-link setup."""
 
     def __init__(self, tracker_host: str, tracker_port: int,
-                 jobid: str = "NULL"):
+                 jobid: Optional[str] = None,
+                 timeout: Optional[float] = None):
         self.tracker_host = tracker_host
         self.tracker_port = tracker_port
-        self.jobid = jobid
+        # default jobid = the launcher's DMLC_TASK_ID (reference
+        # convention): reclaims the old rank on restart and maps a dead
+        # rank back to its supervised task
+        self.jobid = _default_jobid() if jobid is None else jobid
+        self.timeout = _default_timeout() if timeout is None else timeout
+        self.heartbeat: Optional[HeartbeatMonitor] = None
 
     def _dial_tracker(self, cmd: str, rank: int = -1,
                       world_size: int = -1) -> WireSocket:
         sock = socket.create_connection(
-            (self.tracker_host, self.tracker_port))
+            (self.tracker_host, self.tracker_port),
+            timeout=_sock_timeout(self.timeout))
+        # every subsequent op inherits the deadline: a tracker that
+        # accepts and goes mute must fail this worker, not hang it
+        sock.settimeout(_sock_timeout(self.timeout))
         ws = WireSocket(sock)
         ws.send_int(MAGIC)
         got = ws.recv_int()
-        assert got == MAGIC, f"bad tracker magic {got:#x}"
+        if got != MAGIC:
+            # a real error, not an assert — `python -O` strips asserts and
+            # would let a protocol mismatch continue on garbage
+            ws.close()
+            raise ConnectionError(f"bad tracker magic {got:#x}")
         ws.send_int(rank)
         ws.send_int(world_size)
         ws.send_str(self.jobid)
@@ -61,12 +293,35 @@ class RendezvousClient:
 
     def shutdown(self, rank: int) -> None:
         """Send the shutdown handshake and close the tracker connection."""
+        if self.heartbeat is not None:
+            # stop the monitor first so the tracker-side channel EOF is
+            # unambiguous teardown, never a liveness trip mid-shutdown
+            self.heartbeat.close()
+            self.heartbeat = None
         ws = self._dial_tracker("shutdown", rank=rank)
         ws.close()
 
+    def _maybe_start_heartbeat(self, rank: int,
+                               heartbeat: Optional[bool]) -> None:
+        if heartbeat is None:
+            heartbeat = env_int("DMLC_TRACKER_HEARTBEAT_MS", 0) > 0
+        if not heartbeat:
+            return
+        if self.heartbeat is not None:
+            self.heartbeat.close()
+        self.heartbeat = HeartbeatMonitor(
+            self.tracker_host, self.tracker_port, rank, jobid=self.jobid,
+            timeout=self.timeout)
+
     def start(self, rank: int = -1, world_size: int = -1,
-              recover: bool = False) -> TopologyAssignment:
-        """Join the rendezvous: receive topology, establish peer links."""
+              recover: bool = False,
+              heartbeat: Optional[bool] = None) -> TopologyAssignment:
+        """Join the rendezvous: receive topology, open the heartbeat
+        channel (env-gated, see module docstring), establish peer links.
+
+        Raises TrackerAbortedError when the tracker aborts the job while
+        this worker is mid-link, and ConnectionError/OSError within
+        `timeout` when the tracker or a peer hangs."""
         ws = self._dial_tracker("recover" if recover else "start",
                                 rank=rank, world_size=world_size)
         my_rank = ws.recv_int()
@@ -84,45 +339,142 @@ class RendezvousClient:
         if rnext != -1:
             expected.add(rnext)
 
+        # rank is known: liveness starts BEFORE the link dance, so a hang
+        # anywhere below is abortable by the tracker's broadcast
+        self._maybe_start_heartbeat(my_rank, heartbeat)
+        monitor = self.heartbeat
+        if monitor is not None:
+            monitor.guard(ws.sock)
+
         # listen for peers that will dial us
         listener = socket.socket()
         listener.bind(("", 0))  # all interfaces: peers dial our tracker-seen IP
         listener.listen(16)
-        my_port = listener.getsockname()[1]
+        if monitor is not None:
+            monitor.guard(listener)
 
         good: Dict[int, WireSocket] = {}
-        while True:
-            ws.send_int(len(good))
-            for r in good:
-                ws.send_int(r)
-            num_dial = ws.recv_int()
-            num_wait = ws.recv_int()
-            errors = 0
-            for _ in range(num_dial):
-                host = ws.recv_str()
-                port = ws.recv_int()
-                peer_rank = ws.recv_int()
+        # one deadline spans the whole link dance: a peer that never
+        # dials must fail this worker in bounded time, not hang the
+        # previously-untimed accept loop
+        deadline = time.monotonic() + self.timeout
+        try:
+            links = self._link_dance(
+                ws, assign, expected, good, listener, monitor, deadline)
+        except BaseException:
+            # a failed rendezvous must not leave a zombie: stop
+            # heartbeating (a never-linked worker reporting "alive"
+            # forever would defeat the dead-rank deadline) and close the
+            # half-built peer links and the dance socket
+            for ps in good.values():
                 try:
-                    ps = WireSocket(socket.create_connection((host, port),
-                                                             timeout=10))
-                    ps.send_int(assign.rank)  # identify ourselves
-                    good[peer_rank] = ps
+                    ps.close()
                 except OSError:
-                    errors += 1
-            ws.send_int(errors)
-            if errors:
-                continue
-            ws.send_int(my_port)
-            break
-
-        # accept the peers the tracker told to dial us
-        for _ in range(num_wait):
-            fd, _ = listener.accept()
-            ps = WireSocket(fd)
-            peer_rank = ps.recv_int()
-            good[peer_rank] = ps
-        listener.close()
-        assert set(good) == expected, (set(good), expected)
-        assign.links = good
+                    pass
+            try:
+                ws.close()
+            except OSError:
+                pass
+            if monitor is not None:
+                # abrupt, NOT graceful: this worker failed rendezvous
+                # and is about to die — the tracker's dead-after clock
+                # must keep running so the job aborts instead of
+                # waiting forever on a rank that never linked
+                monitor.close(graceful=False)
+                self.heartbeat = None
+            raise
+        # the rendezvous deadline must not outlive the rendezvous: a
+        # healthy peer may legitimately stay quiet longer than the dance
+        # timeout during compute — links block indefinitely (the abort
+        # broadcast, not a timer, is what unblocks them on failure)
+        for ps in links.values():
+            try:
+                ps.sock.settimeout(None)
+            except OSError:
+                pass
+        assign.links = links
+        if monitor is not None:
+            monitor.unguard(ws.sock)
         ws.close()
         return assign
+
+    def _link_dance(self, ws, assign, expected, good, listener, monitor,
+                    deadline) -> Dict[int, WireSocket]:
+        """The dial/accept rounds of the rendezvous (split from start()
+        so its failure cleanup is one place)."""
+        try:
+            while True:
+                # the dial rounds honor the same dance deadline as the
+                # accept loop: a peer advertising a blackholed address
+                # must not keep the worker in retry rounds forever
+                if monitor is not None:
+                    monitor.check()
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"rank {assign.rank}: peer links not established "
+                        f"within {self.timeout:.0f}s")
+                ws.send_int(len(good))
+                for r in good:
+                    ws.send_int(r)
+                num_dial = ws.recv_int()
+                num_wait = ws.recv_int()
+                errors = 0
+                for _ in range(num_dial):
+                    host = ws.recv_str()
+                    port = ws.recv_int()
+                    peer_rank = ws.recv_int()
+                    try:
+                        ps = WireSocket(socket.create_connection(
+                            (host, port), timeout=10))
+                        ps.send_int(assign.rank)  # identify ourselves
+                        good[peer_rank] = ps
+                        if monitor is not None:
+                            monitor.guard(ps.sock)
+                    except OSError:
+                        errors += 1
+                ws.send_int(errors)
+                if errors:
+                    continue
+                ws.send_int(listener.getsockname()[1])  # our accept port
+                break
+
+            # accept the peers the tracker told to dial us. The accept
+            # timeout is SHORT and looped: old kernels do not wake a
+            # blocked accept() even on shutdown()/close() of the listener
+            # fd (verified on 4.4 — only connected sockets wake), so the
+            # abort broadcast is observed between attempts instead
+            for _ in range(num_wait):
+                while True:
+                    if monitor is not None:
+                        monitor.check()  # abort -> structured error
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise ConnectionError(
+                            f"rank {assign.rank}: peers never dialed "
+                            f"within {self.timeout:.0f}s")
+                    listener.settimeout(min(0.1, left))
+                    try:
+                        fd, _ = listener.accept()
+                        break
+                    except socket.timeout:
+                        continue
+                fd.settimeout(_sock_timeout(self.timeout))
+                ps = WireSocket(fd)
+                peer_rank = ps.recv_int()
+                good[peer_rank] = ps
+                if monitor is not None:
+                    monitor.guard(fd)
+        except (OSError, ConnectionError):
+            if monitor is not None:
+                monitor.check()  # abort broadcast -> structured error
+            raise
+        finally:
+            if monitor is not None:
+                monitor.unguard(listener)
+            listener.close()
+
+        if set(good) != expected:
+            raise ConnectionError(
+                f"rank {assign.rank}: linked peers {sorted(good)} != "
+                f"assigned {sorted(expected)}")
+        return good

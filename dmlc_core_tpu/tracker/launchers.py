@@ -33,6 +33,11 @@ PASSTHROUGH_ENV_KEYS = [
     "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY", "DMLC_INTERFACE",
     # TPU additions
     "JAX_PLATFORMS", "TPU_WORKER_ID", "PYTHONPATH",
+    # liveness knobs (doc/robustness.md): workers read these to open the
+    # heartbeat channel; the tracker's worker_envs() also exports them,
+    # but env-launched trackers (standalone/ssh) rely on pass-through
+    "DMLC_TRACKER_HEARTBEAT_MS", "DMLC_TRACKER_DEAD_AFTER_MS",
+    "DMLC_TRACKER_RECOVER_GRACE_MS", "DMLC_TRACKER_CLIENT_TIMEOUT",
 ]
 
 
@@ -80,16 +85,21 @@ def submit_local(args) -> None:
     """Local backend under WorkerSupervisor: worker exit is detected and
     the task relaunched under its old id (the restarted worker rejoins the
     tracker with cmd=recover) — AppMaster-style supervision instead of the
-    reference's in-line retry loop (local.py:12-49)."""
+    reference's in-line retry loop (local.py:12-49). With liveness enabled
+    the supervisor is wired to the tracker both ways: dead ranks trigger a
+    proactive relaunch, exhausted attempts abort the job."""
     from dmlc_core_tpu.tracker.supervisor import (WorkerSupervisor,
                                                   popen_start_fn)
 
-    def launch(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+    def launch(nworker: int, nserver: int, envs: Dict[str, object],
+               tracker=None) -> None:
         sup = WorkerSupervisor(max_attempts=args.num_attempt)
         for i in range(nworker + nserver):
             role = "worker" if i < nworker else "server"
             sup.add(i, role, popen_start_fn(args.command, role, i,
                                             dict(envs)))
+        if tracker is not None:
+            sup.attach_tracker(tracker)
         sup.launch()  # spawn errors raise here, in the submitting caller
         sup.watch_in_thread()
 

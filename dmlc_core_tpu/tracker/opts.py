@@ -42,6 +42,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="remote working dir (ssh/tpu-pod rsync target)")
     p.add_argument("--num-attempt", default=0, type=int,
                    help="retry attempts per worker (local backend)")
+    p.add_argument("--heartbeat-ms", default=None, type=int,
+                   help="enable worker liveness: heartbeat interval in ms "
+                        "(exported as DMLC_TRACKER_HEARTBEAT_MS; 0 keeps "
+                        "the legacy wait-forever tracker)")
+    p.add_argument("--dead-after-ms", default=None, type=int,
+                   help="mark a rank dead after this many ms without a "
+                        "heartbeat (DMLC_TRACKER_DEAD_AFTER_MS; default "
+                        "4x --heartbeat-ms)")
+    p.add_argument("--recover-grace-ms", default=None, type=int,
+                   help="grace window for cmd=recover after a rank is "
+                        "marked dead before the job aborts "
+                        "(DMLC_TRACKER_RECOVER_GRACE_MS; default half of "
+                        "--dead-after-ms)")
     p.add_argument("--archives", default=[], action="append",
                    help="archive (.zip/.tar*) the in-container bootstrap "
                         "unpacks before exec (reference opts.py archives); "

@@ -9,117 +9,142 @@ Behavior-compatible rebuild of reference tracker/dmlc_tracker/tracker.py:
   recovery path, SURVEY §5).
 - PSTracker spawns the parameter-server scheduler process with
   DMLC_ROLE=scheduler + DMLC_PS_ROOT_URI/PORT (tracker.py:336-386).
+
+Unlike the reference (and the previous build here), the serve loop is
+EVENT-DRIVEN: a `selectors` loop pumps one protocol coroutine per
+connection, so a slow or hung handshake no longer serializes the whole
+rendezvous and the tracker observes time passing instead of blocking in
+`accept()`. On top of that loop sits the liveness layer (doc/robustness.md
+"Distributed job liveness"):
+
+- workers hold a persistent heartbeat channel (wire.CMD_HEARTBEAT — a new
+  command, so legacy start/recover/shutdown/print clients stay
+  byte-compatible and are simply not liveness-tracked);
+- a rank whose heartbeats stop for DMLC_TRACKER_DEAD_AFTER_MS is marked
+  dead, dead-rank subscribers (WorkerSupervisor) are notified for
+  proactive relaunch, and after a DMLC_TRACKER_RECOVER_GRACE_MS window
+  with no cmd=recover the job is ABORTED: every live heartbeat channel
+  receives the abort broadcast (workers raise instead of hanging in peer
+  links), the tracker closes down, and join() raises a structured
+  TrackerAbortedError naming the dead ranks;
+- `state()` returns a thread-safe per-rank snapshot and `events` / the
+  DMLC_TRACKER_EVENT_LOG JSONL file record assign/heartbeat/dead/recover/
+  abort transitions for observability.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import queue
+import selectors
+import socket
+import struct
 import subprocess
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 from dmlc_core_tpu.tracker import topology
-from dmlc_core_tpu.tracker.wire import (MAGIC, WireSocket, bind_free_port,
-                                        guess_host_ip, resolve_ip)
+from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
+                                        HEARTBEAT_BYE, MAGIC,
+                                        TrackerAbortedError, bind_free_port,
+                                        env_int, guess_host_ip, resolve_ip)
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
 
+__all__ = ["RabitTracker", "PSTracker", "TrackerAbortedError", "run_job",
+           "start_standalone_tracker"]
 
-class WorkerConn:
-    """One accepted worker connection (reference SlaveEntry)."""
+# a protocol coroutine yields either an int (bytes it needs next) or _WAIT
+# (parked until the tracker resumes it with a value: a batch-assigned rank,
+# or None for a recomputation wake-up)
+_WAIT = object()
 
-    def __init__(self, sock, addr, timeout: Optional[float] = None):
-        # a client that connects and goes silent must not stall the
-        # single-threaded accept loop forever; socket.timeout is an
-        # OSError, which every caller already treats as a dead peer
-        sock.settimeout(timeout)
-        self.sock = WireSocket(sock)
-        self.host = resolve_ip(addr[0])
-        magic = self.sock.recv_int()
-        if magic != MAGIC:
-            raise ConnectionError(
-                f"invalid magic {magic:#x} from {self.host}")
-        self.sock.send_int(MAGIC)
-        self.rank = self.sock.recv_int()
-        self.world_size = self.sock.recv_int()
-        self.jobid = self.sock.recv_str()
-        self.cmd = self.sock.recv_str()
-        self.wait_accept = 0
-        self.port: Optional[int] = None
 
-    def decide_rank(self, job_map: Dict[str, int]) -> int:
-        """Assign this connection's rank (recovered old rank, else next free)."""
-        if self.rank >= 0:
-            return self.rank
-        if self.jobid != "NULL" and self.jobid in job_map:
-            return job_map[self.jobid]
-        return -1
+class _Reject(Exception):
+    """A protocol violation by one peer: log, close ITS socket, keep
+    serving everyone else (never an assert — tracker.py:254-320's flaw)."""
 
-    def assign_rank(self, rank: int, wait_conn: Dict[int, "WorkerConn"],
-                    tree_map, parent_map, ring_map) -> List[int]:
-        """Send the topology assignment and broker peer connections.
 
-        Returns ranks whose pending-accept count dropped to zero."""
-        self.rank = rank
-        neighbors = set(tree_map[rank])
-        rprev, rnext = ring_map[rank]
-        out = self.sock
-        out.send_int(rank)
-        out.send_int(parent_map[rank])
-        out.send_int(len(tree_map))  # world size
-        out.send_int(len(neighbors))
-        for r in neighbors:
-            out.send_int(r)
-        for ring_peer in (rprev, rnext):
-            if ring_peer != -1 and ring_peer != rank:
-                neighbors.add(ring_peer)
-                out.send_int(ring_peer)
-            else:
-                out.send_int(-1)
-        while True:
-            ngood = out.recv_int()
-            if ngood < 0 or ngood > len(tree_map):
-                raise ConnectionError(
-                    f"rank {rank} reported {ngood} good links "
-                    f"(world is {len(tree_map)})")
-            good = {out.recv_int() for _ in range(ngood)}
-            if not good.issubset(neighbors):
-                # a peer claiming links it was never assigned is a protocol
-                # violation — drop IT, not the tracker thread
-                raise ConnectionError(
-                    f"rank {rank} reported links {sorted(good - neighbors)} "
-                    f"outside its neighbor set")
-            bad = neighbors - good
-            # peers already listening that this worker should dial
-            dial = [r for r in bad if r in wait_conn]
-            out.send_int(len(dial))
-            out.send_int(len(bad) - len(dial))
-            for r in dial:
-                out.send_str(wait_conn[r].host)
-                out.send_int(wait_conn[r].port)
-                out.send_int(r)
-            nerr = out.recv_int()
-            if nerr != 0:
-                continue  # worker retries the handshake round
-            self.port = out.recv_int()
-            done = []
-            for r in dial:
-                wait_conn[r].wait_accept -= 1
-                if wait_conn[r].wait_accept == 0:
-                    done.append(r)
-            for r in done:
-                wait_conn.pop(r, None)
-            self.wait_accept = len(bad) - len(dial)
-            return done
+def _r_int():
+    data = yield 4
+    return struct.unpack("@i", data)[0]
+
+
+def _r_str(max_len: int = 1 << 20):
+    n = yield from _r_int()
+    if n < 0 or n > max_len:
+        # without the cap a bogus 2 GB prefix would balloon the read
+        # buffer; strings here are hostnames/job ids/log lines
+        raise _Reject(f"invalid string length {n} on tracker wire")
+    data = yield n
+    return data.decode()
+
+
+class _Conn:
+    """One accepted connection: buffers + the protocol coroutine."""
+
+    __slots__ = ("sock", "host", "inbuf", "outbuf", "gen", "want", "kind",
+                 "rank", "jobid", "last_activity", "closed", "registered")
+
+    def __init__(self, sock: socket.socket, host: str):
+        self.sock = sock
+        self.host = host
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+        self.gen = None
+        self.want = None            # int bytes needed, or _WAIT when parked
+        self.kind = "proto"         # "proto" | "heartbeat"
+        self.rank: Optional[int] = None
+        self.jobid = "NULL"
+        self.last_activity = time.monotonic()
+        self.closed = False
+        self.registered = False
+
+
+class _WaitEntry:
+    """A worker awaiting inbound peer dials (the old wait_conn record)."""
+
+    __slots__ = ("host", "port", "wait_accept")
+
+    def __init__(self, host: str, port: int, wait_accept: int):
+        self.host = host
+        self.port = port
+        self.wait_accept = wait_accept
+
+
+class _RankState:
+    """Per-rank liveness/observability record behind state()."""
+
+    __slots__ = ("phase", "last_beat", "dead_since", "restarts", "host",
+                 "hb", "attempts", "jobid")
+
+    def __init__(self, host: str = ""):
+        self.phase = "assigned"     # assigned|alive|dead|shutdown
+        self.last_beat: Optional[float] = None
+        self.dead_since: Optional[float] = None
+        self.restarts = 0
+        self.attempts = 0           # assignment handshakes served
+        self.host = host
+        self.hb: Optional[_Conn] = None
+        self.jobid = "NULL"         # the wire-reported launcher task id
 
 
 class RabitTracker:
-    """The rendezvous server legacy Rabit workers dial into."""
+    """The rendezvous server legacy Rabit workers dial into.
+
+    Usable as a context manager: ``with RabitTracker(...) as t: ...`` —
+    exit stops the serve loop and releases the port.
+    """
 
     def __init__(self, host_ip: str, num_workers: int, port: int = 9091,
-                 port_end: int = 9999):
+                 port_end: int = 9999,
+                 heartbeat_ms: Optional[int] = None,
+                 dead_after_ms: Optional[int] = None,
+                 recover_grace_ms: Optional[int] = None,
+                 event_log: Optional[str] = None):
         self.host_ip = host_ip
         self.num_workers = num_workers
         self.listener = bind_free_port(host_ip, port, port_end)
@@ -129,182 +154,218 @@ class RabitTracker:
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
         self.fatal_error: Optional[BaseException] = None
+
+        # liveness knobs: ctor beats env; heartbeat_ms == 0 means the
+        # tracker never asks workers to heartbeat (legacy behavior), but
+        # a client that opens a channel anyway is still tracked
+        self.heartbeat_ms = heartbeat_ms if heartbeat_ms is not None \
+            else env_int("DMLC_TRACKER_HEARTBEAT_MS", 0)
+        self.dead_after_ms = dead_after_ms if dead_after_ms is not None \
+            else env_int("DMLC_TRACKER_DEAD_AFTER_MS",
+                          4 * self.heartbeat_ms if self.heartbeat_ms else 0)
+        # default grace must cover a realistic supervised relaunch (a
+        # fresh Python worker needs ~1 s to rejoin; containers more) —
+        # dead_after/2 alone would abort jobs the supervisor was about
+        # to heal whenever dead_after is tuned aggressively low
+        self.recover_grace_ms = recover_grace_ms \
+            if recover_grace_ms is not None \
+            else env_int("DMLC_TRACKER_RECOVER_GRACE_MS",
+                          max(self.dead_after_ms // 2, 5000)
+                          if self.dead_after_ms else 0)
+
+        # observability
+        self._lock = threading.Lock()
+        self.events: List[Dict[str, object]] = []
+        self._event_fp = None
+        path = event_log if event_log is not None \
+            else os.environ.get("DMLC_TRACKER_EVENT_LOG")
+        if path:
+            self._event_fp = open(path, "a", buffering=1)
+        self._ranks: Dict[int, _RankState] = {}
+        self._dead_callbacks: List[Callable[[int, Dict[str, object]], None]] \
+            = []
+        self._notify_q: "queue.Queue" = queue.Queue()
+        self._notify_thread: Optional[threading.Thread] = None
+
+        # serve-loop state (only the loop thread mutates these)
+        self._sel: Optional[selectors.BaseSelector] = None
+        self._conns: Set[_Conn] = set()
+        self._shutdown_ranks: Set[int] = set()
+        self._wait_conn: Dict[int, _WaitEntry] = {}
+        self._job_map: Dict[str, int] = {}
+        self._pending: List[_Conn] = []
+        self._todo: List[int] = []
+        self._assigned: Set[int] = set()
+        self._maps = None
+        self._pending_ports: Set[int] = set()
+        self._port_waiters: List[_Conn] = []
+        self._later: List[Callable[[], None]] = []
+        self._stop_requested = False
+        self._abort_request: Optional[TrackerAbortedError] = None
+        self._finished = False
+        # self-pipe so stop()/abort() wake the selector immediately
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
+    # -- observability -------------------------------------------------------
+    def _emit(self, event: str, **fields) -> None:
+        rec = {"ts": time.time(), "event": event}
+        rec.update(fields)
+        with self._lock:
+            self.events.append(rec)
+            if self._event_fp is not None:
+                try:
+                    self._event_fp.write(json.dumps(rec) + "\n")
+                except OSError:  # a full disk must not kill the rendezvous
+                    pass
+
+    def state(self) -> Dict[str, object]:
+        """Thread-safe snapshot: per-rank phase / last-heartbeat age /
+        restart counts plus job-level status."""
+        now = time.monotonic()
+        with self._lock:
+            ranks = {}
+            for r, st in self._ranks.items():
+                ranks[r] = {
+                    "phase": st.phase,
+                    "host": st.host,
+                    "jobid": st.jobid,
+                    "restarts": st.restarts,
+                    "attempts": st.attempts,
+                    "last_heartbeat_age_s":
+                        None if st.last_beat is None else now - st.last_beat,
+                }
+            return {
+                "num_workers": self.num_workers,
+                "port": self.port,
+                "alive": self.alive(),
+                "finished": self._finished,
+                "aborted": self._abort_request is not None
+                or isinstance(self.fatal_error, TrackerAbortedError),
+                "heartbeat_ms": self.heartbeat_ms,
+                "dead_after_ms": self.dead_after_ms,
+                "recover_grace_ms": self.recover_grace_ms,
+                "ranks": ranks,
+            }
+
+    def on_rank_dead(self, callback: Callable[[int, Dict[str, object]], None]
+                     ) -> None:
+        """Subscribe to dead-rank notifications. The callback runs on a
+        dedicated notifier thread (never the serve loop) with
+        (rank, info_dict) — WorkerSupervisor uses this for proactive
+        relaunch ahead of its own CLI status poll."""
+        self._dead_callbacks.append(callback)
+
+    def _notify_dead(self, rank: int) -> None:
+        if not self._dead_callbacks:
+            return
+        st = self._ranks.get(rank)
+        info = {"rank": rank, "host": st.host if st else "",
+                "restarts": st.restarts if st else 0,
+                "jobid": st.jobid if st else "NULL",
+                # same-process monotonic timestamp of the dead
+                # incarnation's last heartbeat: lets the supervisor tell
+                # a stale signal from a live one (_on_rank_dead)
+                "last_beat_monotonic": st.last_beat if st else None}
+        # ranks are assigned by host-sorted arrival, so rank !=
+        # DMLC_TASK_ID in general; the wire-reported jobid ("task<N>",
+        # RendezvousClient's default) is the authoritative mapping back
+        # to the supervised task
+        jobid = info["jobid"]
+        if isinstance(jobid, str) and jobid.startswith("task") \
+                and jobid[4:].isdigit():
+            info["task_id"] = int(jobid[4:])
+        if self._notify_thread is None:
+            def drain():
+                while True:
+                    cb, r, inf = self._notify_q.get()
+                    try:
+                        cb(r, inf)
+                    except Exception:
+                        logger.exception("dead-rank callback failed")
+            self._notify_thread = threading.Thread(target=drain, daemon=True)
+            self._notify_thread.start()
+        for cb in self._dead_callbacks:
+            self._notify_q.put((cb, rank, info))
+
+    # -- env / lifecycle -----------------------------------------------------
     def worker_envs(self) -> Dict[str, object]:
         """Env vars every worker needs (reference slave_envs,
-        tracker.py:177-183)."""
-        return {"DMLC_TRACKER_URI": self.host_ip,
-                "DMLC_TRACKER_PORT": self.port}
-
-    def _serve(self, num_workers: int) -> None:
-        shutdown: Dict[int, WorkerConn] = {}
-        wait_conn: Dict[int, WorkerConn] = {}
-        job_map: Dict[str, int] = {}
-        pending: List[WorkerConn] = []
-        todo: List[int] = []
-        assigned: set = set()  # ranks actually handed to a worker
-        maps = None
-
-        # Every malformed or adversarial input below is rejected with a
-        # log line and a closed socket — never an assert: a protocol
-        # violation from one worker must not kill the rendezvous for the
-        # rest (the reference tracker.py:254-320 has the assert flaw;
-        # tests/test_tracker_fuzz.py pins the hardened behavior).
-        handshake_timeout = float(
-            os.environ.get("DMLC_TRACKER_HANDSHAKE_TIMEOUT", "300"))
-        max_world = int(os.environ.get("DMLC_TRACKER_MAX_WORLD",
-                                       str(1 << 20)))
-        while len(shutdown) != num_workers:
-            fd, addr = self.listener.accept()
-            try:
-                conn = WorkerConn(fd, addr, timeout=handshake_timeout)
-            except (ConnectionError, OSError, UnicodeDecodeError,
-                    ValueError) as e:
-                logger.warning("rejected connection: %s", e)
-                fd.close()
-                continue
-            if conn.cmd == "print":
-                try:
-                    logger.info("%s", conn.sock.recv_str().strip())
-                except (ConnectionError, OSError, UnicodeDecodeError) as e:
-                    logger.warning("bad print from %s: %s", conn.host, e)
-                continue
-            if conn.cmd == "shutdown":
-                # only ranks that were actually handed out may check out:
-                # a spoofed shutdown for a merely in-range rank would
-                # otherwise end the rendezvous under live workers
-                if conn.rank not in assigned or conn.rank in shutdown:
-                    logger.warning(
-                        "rejecting shutdown from %s: rank %d is %s",
-                        conn.host, conn.rank,
-                        "already shut down" if conn.rank in shutdown
-                        else "not an assigned rank")
-                    conn.sock.close()
-                    continue
-                shutdown[conn.rank] = conn
-                logger.debug("rank %d shut down", conn.rank)
-                continue
-            if conn.cmd not in ("start", "recover"):
-                logger.warning("unknown command %r from %s", conn.cmd,
-                               conn.host)
-                conn.sock.close()
-                continue
-            if maps is None:
-                if conn.cmd != "start":
-                    logger.warning(
-                        "rejecting %s from %s: no worker has started yet",
-                        conn.cmd, conn.host)
-                    conn.sock.close()
-                    continue
-                if conn.world_size > max_world:
-                    # the first start frame pins the world size; an
-                    # unbounded value would feed build_link_maps an O(n)
-                    # allocation and make the job unfinishable
-                    logger.warning(
-                        "rejecting start from %s: world_size %d exceeds "
-                        "DMLC_TRACKER_MAX_WORLD=%d", conn.host,
-                        conn.world_size, max_world)
-                    conn.sock.close()
-                    continue
-                if conn.world_size > 0:
-                    num_workers = conn.world_size
-                maps = topology.build_link_maps(num_workers)
-                todo = list(range(num_workers))
-            elif conn.world_size not in (-1, num_workers):
-                logger.warning(
-                    "rejecting %s from %s: world_size %d does not match "
-                    "the job's %d", conn.cmd, conn.host, conn.world_size,
-                    num_workers)
-                conn.sock.close()
-                continue
-            if conn.rank >= 0 and conn.rank not in assigned:
-                # a preset rank (recover, or start claiming one) is only
-                # honored for ranks this tracker actually handed out — an
-                # unauthenticated claim would hijack the rank's topology
-                # slot and reroute its peers' links
-                logger.warning(
-                    "rejecting %s from %s: rank %d was never assigned",
-                    conn.cmd, conn.host, conn.rank)
-                conn.sock.close()
-                continue
-
-            rank = conn.decide_rank(job_map)
-            if rank >= num_workers:
-                logger.warning(
-                    "rejecting %s from %s: rank %d out of range",
-                    conn.cmd, conn.host, rank)
-                conn.sock.close()
-                continue
-            if rank == -1:
-                todo_pending = len(todo)
-                pending.append(conn)
-                if len(pending) == todo_pending:
-                    # batch assignment sorted by host for locality
-                    # (reference tracker.py:292-304)
-                    pending.sort(key=lambda c: c.host)
-                    for c in pending:
-                        r = todo.pop(0)
-                        # the rank is handed out from here on (a worker
-                        # dying mid-handshake below reclaims it via
-                        # recover, which requires membership here)
-                        assigned.add(r)
-                        if c.jobid != "NULL":
-                            job_map[c.jobid] = r
-                        # a worker dying mid-handshake must not kill the
-                        # tracker: it can reconnect with cmd=recover
-                        try:
-                            c.assign_rank(r, wait_conn, *maps)
-                        except (ConnectionError, OSError) as e:
-                            logger.warning(
-                                "worker %s died during rank %d handshake: "
-                                "%s (awaiting recover)", c.host, r, e)
-                            c.sock.close()  # violators see a clean drop
-                            continue
-                        if c.wait_accept > 0:
-                            wait_conn[r] = c
-                        logger.debug("assigned rank %d to %s", r, c.host)
-                    pending.clear()
-                if not todo:
-                    logger.info("@tracker all %d workers started",
-                                num_workers)
-                    self.start_time = time.time()
-            else:
-                try:
-                    conn.assign_rank(rank, wait_conn, *maps)
-                except (ConnectionError, OSError) as e:
-                    logger.warning(
-                        "worker %s died during %s of rank %d: %s",
-                        conn.host, conn.cmd, rank, e)
-                    conn.sock.close()  # violators see a clean drop
-                    continue
-                if conn.wait_accept > 0:
-                    wait_conn[rank] = conn
-                logger.debug("%s rank %d re-linked", conn.cmd, rank)
-        self.end_time = time.time()
-        logger.info("@tracker all workers finished")
-        if self.start_time is not None:
-            logger.info("@tracker %.3f secs between start and finish",
-                        self.end_time - self.start_time)
+        tracker.py:177-183), plus the liveness knobs when enabled so
+        RendezvousClient auto-opens its heartbeat channel."""
+        envs: Dict[str, object] = {"DMLC_TRACKER_URI": self.host_ip,
+                                   "DMLC_TRACKER_PORT": self.port}
+        if self.heartbeat_ms > 0:
+            envs["DMLC_TRACKER_HEARTBEAT_MS"] = self.heartbeat_ms
+            envs["DMLC_TRACKER_DEAD_AFTER_MS"] = self.dead_after_ms
+        return envs
 
     def start(self) -> None:
-        """Begin accepting worker connections on the tracker thread."""
+        """Begin serving worker connections on the tracker thread."""
         def guarded():
             try:
                 self._serve(self.num_workers)
             except BaseException as e:  # surfaced by join()
                 self.fatal_error = e
                 logger.error("tracker failed: %s", e)
+            finally:
+                self._close_all()
         self.thread = threading.Thread(target=guarded, daemon=True)
         self.thread.start()
 
+    def stop(self) -> None:
+        """Unblock the serve loop and release the listener/port. Safe from
+        any thread, idempotent, works whether or not start() was called —
+        join() after stop() returns instead of raising TimeoutError with a
+        leaked thread and port."""
+        self._stop_requested = True
+        self._wake()
+        if self.thread is None:
+            # never started: the bound port must still be released
+            self._close_all()
+
+    def abort(self, reason: str,
+              dead_ranks: Optional[List[int]] = None) -> None:
+        """Abort the job from any thread: broadcast to every live
+        heartbeat channel, close down, and make join() raise a structured
+        TrackerAbortedError. A supervisor that exhausted max_attempts
+        calls this instead of leaving the tracker waiting on a rank that
+        will never return."""
+        if self._abort_request is None:
+            self._abort_request = TrackerAbortedError(reason, dead_ranks)
+        self._wake()
+        if self.thread is None:
+            self.fatal_error = self._abort_request
+            self._close_all()
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    def __enter__(self) -> "RabitTracker":
+        if self.thread is None:
+            self.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+        if self.thread is not None:
+            self.thread.join(timeout=10)
+
     def join(self, timeout: Optional[float] = None) -> None:
-        """Block until every worker has shut down (job end)."""
+        """Block until every worker has shut down (job end). Raises
+        TrackerAbortedError if the liveness layer (or a supervisor) gave
+        the job up."""
         deadline = None if timeout is None else time.time() + timeout
         while self.thread is not None and self.thread.is_alive():
             self.thread.join(0.1)
             if deadline is not None and time.time() > deadline:
                 raise TimeoutError("tracker did not finish in time")
+        if isinstance(self.fatal_error, TrackerAbortedError):
+            raise self.fatal_error
         if self.fatal_error is not None:
             raise RuntimeError("tracker serve loop failed") \
                 from self.fatal_error
@@ -312,6 +373,588 @@ class RabitTracker:
     def alive(self) -> bool:
         """True while the tracker thread is serving."""
         return self.thread is not None and self.thread.is_alive()
+
+    # -- the event loop ------------------------------------------------------
+    def _serve(self, num_workers: int) -> None:
+        self._num_workers = num_workers
+        handshake_timeout = float(
+            os.environ.get("DMLC_TRACKER_HANDSHAKE_TIMEOUT", "300"))
+        self._max_world = env_int("DMLC_TRACKER_MAX_WORLD", 1 << 20)
+
+        sel = selectors.DefaultSelector()
+        self._sel = sel
+        self.listener.setblocking(False)
+        sel.register(self.listener, selectors.EVENT_READ, "listener")
+        sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+
+        while not self._finished:
+            if self._stop_requested:
+                logger.info("tracker stopped by request")
+                return
+            if self._abort_request is not None:
+                self._do_abort(self._abort_request)
+            for key, mask in sel.select(self._next_timeout(handshake_timeout)):
+                if key.data == "listener":
+                    self._accept_all()
+                elif key.data == "wake":
+                    try:
+                        self._wake_r.recv(4096)
+                    except OSError:
+                        pass
+                else:
+                    conn = key.data
+                    if mask & selectors.EVENT_WRITE:
+                        self._flush(conn)
+                    if mask & selectors.EVENT_READ and not conn.closed:
+                        self._on_readable(conn)
+            self._run_later()
+            self._run_timers(handshake_timeout)
+
+        self.end_time = time.time()
+        logger.info("@tracker all workers finished")
+        if self.start_time is not None:
+            logger.info("@tracker %.3f secs between start and finish",
+                        self.end_time - self.start_time)
+        self._emit("finish", num_workers=self._num_workers)
+
+    def _next_timeout(self, handshake_timeout: float) -> float:
+        now = time.monotonic()
+        deadline = now + 30.0
+        with self._lock:
+            items = list(self._ranks.items())
+        for _, st in items:
+            if st.phase == "alive" and self.dead_after_ms > 0 \
+                    and st.last_beat is not None:
+                deadline = min(deadline,
+                               st.last_beat + self.dead_after_ms / 1000.0)
+            elif st.phase == "dead" and st.dead_since is not None:
+                deadline = min(deadline,
+                               st.dead_since + self.recover_grace_ms / 1000.0)
+        for conn in self._conns:
+            if conn.kind == "proto" and isinstance(conn.want, int):
+                deadline = min(deadline,
+                               conn.last_activity + handshake_timeout)
+        return max(0.0, deadline - now)
+
+    def _run_later(self) -> None:
+        while self._later:
+            todo, self._later = self._later, []
+            for fn in todo:
+                fn()
+
+    def _run_timers(self, handshake_timeout: float) -> None:
+        now = time.monotonic()
+        # a client that connected and went silent must not hold its rank
+        # slot (or fds) forever; parked conns (awaiting the batch or a
+        # peer's port) are exempt — they are waiting on the JOB, not
+        # failing to speak
+        for conn in [c for c in self._conns
+                     if c.kind == "proto" and isinstance(c.want, int)
+                     and now - c.last_activity > handshake_timeout]:
+            self._drop(conn, f"handshake timed out after "
+                             f"{handshake_timeout:.0f}s")
+        if self.dead_after_ms <= 0:
+            return
+        with self._lock:
+            items = list(self._ranks.items())
+        dead_now = []
+        for rank, st in items:
+            if st.phase == "alive" and st.last_beat is not None and \
+                    now - st.last_beat > self.dead_after_ms / 1000.0:
+                dead_now.append(rank)
+        for rank in dead_now:
+            self._mark_dead(rank, now)
+        expired = [r for r, st in items
+                   if st.phase == "dead" and st.dead_since is not None
+                   and now - st.dead_since > self.recover_grace_ms / 1000.0]
+        if expired:
+            with self._lock:
+                all_dead = [r for r, st in self._ranks.items()
+                            if st.phase == "dead"]
+            self._do_abort(TrackerAbortedError(
+                f"rank(s) {sorted(expired)} missed the heartbeat deadline "
+                f"({self.dead_after_ms} ms) and did not recover within the "
+                f"grace window ({self.recover_grace_ms} ms)", all_dead))
+
+    def _mark_dead(self, rank: int, now: float) -> None:
+        st = self._ranks[rank]
+        with self._lock:
+            st.phase = "dead"
+            st.dead_since = now
+        age = (now - st.last_beat) * 1000.0 if st.last_beat else -1.0
+        logger.warning("rank %d marked dead (no heartbeat for %.0f ms); "
+                       "awaiting recover for %d ms", rank, age,
+                       self.recover_grace_ms)
+        self._emit("heartbeat-miss", rank=rank, age_ms=age)
+        self._emit("dead", rank=rank, host=st.host)
+        self._notify_dead(rank)
+
+    def _do_abort(self, err: TrackerAbortedError) -> None:
+        """Broadcast the abort to every live heartbeat channel, close
+        down, and surface the structured error through join()."""
+        logger.error("aborting job: %s", err)
+        self._emit("abort", reason=err.reason, dead_ranks=err.dead_ranks)
+        reason = err.reason.encode()
+        frame = struct.pack("@i", HEARTBEAT_ABORT) + \
+            struct.pack("@i", len(reason)) + reason
+        for conn in list(self._conns):
+            if conn.kind != "heartbeat" or conn.closed:
+                continue
+            try:
+                # best-effort synchronous flush: the loop is about to exit,
+                # so buffered-writes bookkeeping no longer applies
+                conn.sock.setblocking(True)
+                conn.sock.settimeout(1.0)
+                conn.sock.sendall(bytes(conn.outbuf) + frame)
+            except OSError:
+                pass
+        raise err
+
+    # -- connection plumbing -------------------------------------------------
+    def _accept_all(self) -> None:
+        while True:
+            try:
+                fd, addr = self.listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            try:
+                host = resolve_ip(addr[0])
+            except OSError:
+                host = addr[0]
+            fd.setblocking(False)
+            conn = _Conn(fd, host)
+            conn.gen = self._proto(conn)
+            self._conns.add(conn)
+            self._sel.register(fd, selectors.EVENT_READ, conn)
+            conn.registered = True
+            self._advance(conn, None)  # run to the first `yield n`
+
+    def _on_readable(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(65536)
+        except BlockingIOError:
+            return
+        except OSError as e:
+            self._conn_eof(conn, e)
+            return
+        if not data:
+            self._conn_eof(conn, None)
+            return
+        conn.inbuf += data
+        conn.last_activity = time.monotonic()
+        self._pump(conn)
+
+    def _pump(self, conn: _Conn) -> None:
+        while not conn.closed and isinstance(conn.want, int) \
+                and len(conn.inbuf) >= conn.want:
+            chunk = bytes(conn.inbuf[:conn.want])
+            del conn.inbuf[:conn.want]
+            self._step(conn, chunk)
+
+    def _advance(self, conn: _Conn, value) -> None:
+        """Resume a coroutine from outside the read path (initial start,
+        batch assignment, port-waiter wake-up), then keep pumping: the
+        bytes the resumed coroutine needs next may ALREADY be buffered —
+        no further read event will announce them."""
+        self._step(conn, value)
+        self._pump(conn)
+
+    def _step(self, conn: _Conn, value) -> None:
+        try:
+            conn.want = conn.gen.send(value)
+        except StopIteration:
+            self._close_conn(conn)
+        except _Reject as e:
+            self._drop(conn, str(e))
+        except (ConnectionError, OSError, UnicodeDecodeError,
+                ValueError) as e:
+            self._drop(conn, str(e))
+
+    def _send_bytes(self, conn: _Conn, data: bytes) -> None:
+        conn.outbuf += data
+        self._flush(conn)
+
+    def _send_int(self, conn: _Conn, v: int) -> None:
+        self._send_bytes(conn, struct.pack("@i", v))
+
+    def _send_str(self, conn: _Conn, s: str) -> None:
+        data = s.encode()
+        self._send_bytes(conn, struct.pack("@i", len(data)) + data)
+
+    def _flush(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        try:
+            while conn.outbuf:
+                sent = conn.sock.send(conn.outbuf)
+                del conn.outbuf[:sent]
+        except BlockingIOError:
+            pass
+        except OSError as e:
+            self._conn_eof(conn, e)
+            return
+        mask = selectors.EVENT_READ
+        if conn.outbuf:
+            mask |= selectors.EVENT_WRITE
+        try:
+            self._sel.modify(conn.sock, mask, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _conn_eof(self, conn: _Conn, err: Optional[OSError]) -> None:
+        if conn.kind == "heartbeat" and conn.rank is not None:
+            st = self._ranks.get(conn.rank)
+            if st is not None and st.hb is conn:
+                st.hb = None
+                if conn.rank not in self._shutdown_ranks and \
+                        st.phase == "alive":
+                    # no more beats will arrive; the dead-after clock keeps
+                    # running from the last one (a SIGKILLed worker's OS
+                    # sends this FIN immediately — detection starts now,
+                    # not at the next poll)
+                    logger.warning(
+                        "heartbeat channel of rank %d closed unexpectedly",
+                        conn.rank)
+                    self._emit("heartbeat-lost", rank=conn.rank)
+            self._close_conn(conn)
+            return
+        if conn.rank is not None and not self._finished:
+            logger.warning(
+                "worker %s died during rank %d handshake: %s "
+                "(awaiting recover)", conn.host, conn.rank,
+                err or "peer closed")
+        elif err is not None:
+            logger.warning("connection from %s failed: %s", conn.host, err)
+        self._close_conn(conn)
+
+    def _drop(self, conn: _Conn, why: str) -> None:
+        if conn.rank is not None:
+            logger.warning("worker %s died during rank %d handshake: %s "
+                           "(awaiting recover)", conn.host, conn.rank, why)
+        else:
+            logger.warning("rejected connection from %s: %s", conn.host, why)
+        self._close_conn(conn)
+
+    def _close_conn(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        self._conns.discard(conn)
+        if conn in self._pending:
+            self._pending.remove(conn)
+        if conn in self._port_waiters:
+            self._port_waiters.remove(conn)
+        if conn.rank is not None and conn.kind == "proto":
+            # a decision parked on this rank's port must not wait forever
+            self._pending_ports.discard(conn.rank)
+            self._later.append(self._resume_port_waiters)
+        if conn.registered:
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            conn.registered = False
+        try:
+            # drain already-arrived bytes so close() sends FIN, not RST —
+            # closing with unread data in the kernel buffer resets the
+            # peer, and tests asserting a clean drop would flake on the
+            # race (the PR 3 tracker flake's root cause)
+            conn.sock.setblocking(False)
+            while conn.sock.recv(4096):
+                pass
+        except OSError:
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _close_all(self) -> None:
+        for conn in list(self._conns):
+            self._close_conn(conn)
+        for s in (self.listener, self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        with self._lock:
+            if self._event_fp is not None:
+                try:
+                    self._event_fp.close()
+                except OSError:
+                    pass
+                self._event_fp = None
+
+    # -- the tracker protocol, as one coroutine per connection ---------------
+    def _proto(self, conn: _Conn):
+        magic = yield from _r_int()
+        if magic != MAGIC:
+            raise _Reject(f"invalid magic {magic:#x}")
+        self._send_int(conn, MAGIC)
+        rank = yield from _r_int()
+        world = yield from _r_int()
+        jobid = yield from _r_str()
+        cmd = yield from _r_str()
+        conn.jobid = jobid
+
+        if cmd == "print":
+            msg = yield from _r_str()
+            logger.info("%s", msg.strip())
+            return
+        if cmd == "shutdown":
+            # only ranks that were actually handed out may check out: a
+            # spoofed shutdown for a merely in-range rank would otherwise
+            # end the rendezvous under live workers
+            if rank not in self._assigned or rank in self._shutdown_ranks:
+                raise _Reject(
+                    f"rejecting shutdown: rank {rank} is " +
+                    ("already shut down" if rank in self._shutdown_ranks
+                     else "not an assigned rank"))
+            self._shutdown_ranks.add(rank)
+            self._rank_shutdown(rank)
+            logger.debug("rank %d shut down", rank)
+            if len(self._shutdown_ranks) == self._num_workers:
+                self._finished = True
+            return
+        if cmd == CMD_HEARTBEAT:
+            if rank not in self._assigned:
+                raise _Reject(
+                    f"rejecting heartbeat: rank {rank} was never assigned")
+            yield from self._hb_loop(conn, rank)
+            return
+        if cmd not in ("start", "recover"):
+            raise _Reject(f"unknown command {cmd!r}")
+
+        if self._maps is None:
+            if cmd != "start":
+                raise _Reject(f"rejecting {cmd}: no worker has started yet")
+            if world > self._max_world:
+                # the first start frame pins the world size; an unbounded
+                # value would feed build_link_maps an O(n) allocation and
+                # make the job unfinishable
+                raise _Reject(
+                    f"rejecting start: world_size {world} exceeds "
+                    f"DMLC_TRACKER_MAX_WORLD={self._max_world}")
+            if world > 0:
+                self._num_workers = world
+                self.num_workers = world
+            self._maps = topology.build_link_maps(self._num_workers)
+            self._todo = list(range(self._num_workers))
+        elif world not in (-1, self._num_workers):
+            raise _Reject(
+                f"rejecting {cmd}: world_size {world} does not match "
+                f"the job's {self._num_workers}")
+        if rank >= 0 and rank not in self._assigned:
+            # a preset rank (recover, or start claiming one) is only
+            # honored for ranks this tracker actually handed out — an
+            # unauthenticated claim would hijack the rank's topology slot
+            # and reroute its peers' links
+            raise _Reject(
+                f"rejecting {cmd}: rank {rank} was never assigned")
+
+        if rank < 0 and jobid != "NULL" and jobid in self._job_map:
+            rank = self._job_map[jobid]
+        if rank >= self._num_workers:
+            raise _Reject(f"rejecting {cmd}: rank {rank} out of range")
+
+        if rank == -1:
+            self._pending.append(conn)
+            self._later.append(self._maybe_assign_batch)
+            rank = yield _WAIT  # resumed with the batch-assigned rank
+            if jobid != "NULL":
+                self._job_map[jobid] = rank
+        else:
+            self._rank_recovering(rank, cmd)
+        yield from self._assign_dance(conn, rank)
+        logger.debug("%s rank %d linked (%s)", cmd, rank, conn.host)
+
+    def _maybe_assign_batch(self) -> None:
+        if self._maps is None or not self._todo or \
+                len(self._pending) != len(self._todo):
+            return
+        # batch assignment sorted by host for locality (reference
+        # tracker.py:292-304)
+        batch, self._pending = self._pending, []
+        batch.sort(key=lambda c: c.host)
+        for conn in batch:
+            r = self._todo.pop(0)
+            # the rank is handed out from here on (a worker dying
+            # mid-handshake reclaims it via recover, which requires
+            # membership in _assigned)
+            self._assigned.add(r)
+            with self._lock:
+                st = self._ranks.setdefault(r, _RankState(conn.host))
+                st.host = conn.host
+            self._emit("assign", rank=r, host=conn.host)
+            logger.debug("assigned rank %d to %s", r, conn.host)
+            self._advance(conn, r)
+        if not self._todo:
+            logger.info("@tracker all %d workers started", self._num_workers)
+            self.start_time = time.time()
+
+    def _rank_recovering(self, rank: int, cmd: str) -> None:
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState())
+            was_dead = st.phase == "dead"
+            if cmd == "recover":
+                st.restarts += 1
+            # liveness re-arms when the restarted worker opens its new
+            # heartbeat channel; until then the rank is merely assigned
+            st.phase = "assigned"
+            st.dead_since = None
+            st.last_beat = None
+        if cmd == "recover":
+            self._emit("recover", rank=rank, was_dead=was_dead)
+
+    def _rank_shutdown(self, rank: int) -> None:
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState())
+            st.phase = "shutdown"
+            st.dead_since = None
+            hb = st.hb
+            st.hb = None
+        if hb is not None:
+            self._close_conn(hb)
+        self._emit("shutdown", rank=rank)
+
+    def _hb_loop(self, conn: _Conn, rank: int):
+        conn.kind = "heartbeat"
+        conn.rank = rank
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState(conn.host))
+            old = st.hb
+            st.hb = conn
+            st.last_beat = time.monotonic()
+            st.phase = "alive"
+        if old is not None:
+            self._close_conn(old)
+        self._emit("heartbeat-open", rank=rank, host=conn.host)
+        # announce the ping interval the worker should hold
+        self._send_int(conn, self.heartbeat_ms if self.heartbeat_ms > 0
+                       else 1000)
+        while True:
+            word = yield 4  # one int32 ping (or a graceful BYE)
+            if struct.unpack("@i", word)[0] == HEARTBEAT_BYE:
+                # graceful channel close (normal shutdown path): disarm
+                # liveness for this rank — a BYE is teardown, never a
+                # death, so no heartbeat-lost noise and no dead clock
+                # left ticking between BYE and the shutdown cmd. Only
+                # the CURRENT channel may disarm: a stale channel's
+                # buffered BYE processed after its replacement opened
+                # (the recover path) must not untrack the live rank.
+                with self._lock:
+                    if st.hb is conn:
+                        st.hb = None
+                        if st.phase in ("alive", "dead"):
+                            st.phase = "assigned"
+                            st.dead_since = None
+                            st.last_beat = None
+                self._emit("heartbeat-bye", rank=rank)
+                return
+            revived = False
+            with self._lock:
+                st.last_beat = time.monotonic()
+                if st.phase == "dead":
+                    # beats resumed inside the grace window (network blip,
+                    # paused VM): the rank is alive after all
+                    st.phase = "alive"
+                    st.dead_since = None
+                    revived = True
+            if revived:  # _emit takes the lock itself — never nest it
+                self._emit("revived", rank=rank)
+
+    def _assign_dance(self, conn: _Conn, rank: int):
+        """Send the topology assignment and broker peer connections (the
+        reference assign_rank handshake), concurrently with every other
+        connection's dance."""
+        tree_map, parent_map, ring_map = self._maps
+        conn.rank = rank
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState(conn.host))
+            st.host = conn.host
+            st.attempts += 1
+            if conn.jobid != "NULL":
+                st.jobid = conn.jobid
+        neighbors = set(tree_map[rank])
+        rprev, rnext = ring_map[rank]
+        out = bytearray()
+        out += struct.pack("@i", rank)
+        out += struct.pack("@i", parent_map[rank])
+        out += struct.pack("@i", len(tree_map))  # world size
+        out += struct.pack("@i", len(neighbors))
+        for r in neighbors:
+            out += struct.pack("@i", r)
+        for ring_peer in (rprev, rnext):
+            if ring_peer != -1 and ring_peer != rank:
+                neighbors.add(ring_peer)
+                out += struct.pack("@i", ring_peer)
+            else:
+                out += struct.pack("@i", -1)
+        self._send_bytes(conn, bytes(out))
+        while True:
+            ngood = yield from _r_int()
+            if ngood < 0 or ngood > len(tree_map):
+                raise _Reject(
+                    f"rank {rank} reported {ngood} good links "
+                    f"(world is {len(tree_map)})")
+            good = set()
+            for _ in range(ngood):
+                good.add((yield from _r_int()))
+            if not good.issubset(neighbors):
+                # a peer claiming links it was never assigned is a
+                # protocol violation — drop IT, not the tracker thread
+                raise _Reject(
+                    f"rank {rank} reported links {sorted(good - neighbors)} "
+                    f"outside its neighbor set")
+            bad = neighbors - good
+            # Concurrency guard the blocking tracker never needed: a peer
+            # whose decision said "await dials" but whose listen port has
+            # not arrived yet is invisible in wait_conn — deciding THIS
+            # worker now could tell both sides to wait for each other.
+            # Park until every such peer's port lands, then recompute.
+            while bad & self._pending_ports:
+                self._port_waiters.append(conn)
+                yield _WAIT
+            dial = [r for r in bad if r in self._wait_conn]
+            nwait = len(bad) - len(dial)
+            out = bytearray()
+            out += struct.pack("@i", len(dial))
+            out += struct.pack("@i", nwait)
+            for r in dial:
+                e = self._wait_conn[r]
+                host = e.host.encode()
+                out += struct.pack("@i", len(host)) + host
+                out += struct.pack("@i", e.port)
+                out += struct.pack("@i", r)
+            self._send_bytes(conn, bytes(out))
+            if nwait > 0:
+                self._pending_ports.add(rank)
+            nerr = yield from _r_int()
+            if nerr != 0:
+                # worker retries the handshake round; this round's
+                # decision is void
+                self._pending_ports.discard(rank)
+                self._later.append(self._resume_port_waiters)
+                continue
+            port = yield from _r_int()
+            for r in dial:
+                e = self._wait_conn.get(r)
+                if e is None:
+                    continue
+                e.wait_accept -= 1
+                if e.wait_accept == 0:
+                    del self._wait_conn[r]
+            if nwait > 0:
+                self._wait_conn[rank] = _WaitEntry(conn.host, port, nwait)
+            self._pending_ports.discard(rank)
+            self._later.append(self._resume_port_waiters)
+            return
+
+    def _resume_port_waiters(self) -> None:
+        waiters, self._port_waiters = self._port_waiters, []
+        for conn in waiters:
+            if not conn.closed:
+                self._advance(conn, None)  # recompute its round decision
 
 
 class PSTracker:
@@ -359,18 +1002,37 @@ class PSTracker:
 
 
 def run_job(num_workers: int, num_servers: int, launch_fn, host_ip="auto",
-            ps_cmd: Optional[str] = None) -> None:
+            ps_cmd: Optional[str] = None,
+            heartbeat_ms: Optional[int] = None,
+            dead_after_ms: Optional[int] = None) -> None:
     """Start the right tracker and hand worker envs to a cluster launcher
-    (reference tracker.submit, tracker.py:410-433)."""
+    (reference tracker.submit, tracker.py:410-433). A launch_fn accepting
+    a 4th argument receives the RabitTracker so supervising backends can
+    wire dead-rank notifications both ways (supervisor.attach_tracker)."""
     host_ip = guess_host_ip(host_ip)
     envs = {"DMLC_NUM_WORKER": num_workers,
             "DMLC_NUM_SERVER": num_servers}
     if num_servers == 0:
-        tracker = RabitTracker(host_ip, num_workers)
+        tracker = RabitTracker(host_ip, num_workers,
+                               heartbeat_ms=heartbeat_ms,
+                               dead_after_ms=dead_after_ms)
         envs.update(tracker.worker_envs())
         tracker.start()
         if tracker.alive():
-            launch_fn(num_workers, num_servers, envs)
+            import inspect
+            # pass the tracker only if launch_fn can BIND a 4th positional
+            # arg — counting raw parameters would miscount keyword-only /
+            # **kwargs signatures and crash previously-working callbacks
+            try:
+                inspect.signature(launch_fn).bind(
+                    num_workers, num_servers, envs, tracker)
+                takes_tracker = True
+            except (TypeError, ValueError):
+                takes_tracker = False
+            if takes_tracker:
+                launch_fn(num_workers, num_servers, envs, tracker)
+            else:
+                launch_fn(num_workers, num_servers, envs)
         tracker.join()
     else:
         ps = PSTracker(host_ip, ps_cmd, envs=envs)

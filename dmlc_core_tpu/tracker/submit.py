@@ -9,6 +9,7 @@ Usage::
 from __future__ import annotations
 
 import logging
+import os
 from typing import List, Optional
 
 from dmlc_core_tpu.tracker.launchers import BACKENDS
@@ -22,6 +23,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     logging.basicConfig(
         format="%(asctime)s %(levelname)s %(message)s",
         level=getattr(logging, args.log_level))
+    # liveness flags become the env knobs every backend (and the tracker
+    # itself) reads — one export point covers local/ssh/k8s/yarn/... alike
+    for flag, env in (("heartbeat_ms", "DMLC_TRACKER_HEARTBEAT_MS"),
+                      ("dead_after_ms", "DMLC_TRACKER_DEAD_AFTER_MS"),
+                      ("recover_grace_ms", "DMLC_TRACKER_RECOVER_GRACE_MS")):
+        v = getattr(args, flag, None)
+        if v is not None:
+            os.environ[env] = str(v)
     backend = BACKENDS.get(args.cluster)
     if backend is None:
         raise SystemExit(f"unknown cluster backend {args.cluster!r}")

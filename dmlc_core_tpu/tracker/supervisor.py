@@ -22,6 +22,16 @@ the restarted worker is expected to rejoin the rendezvous with
 cmd=recover + its old rank (dmlc_core_tpu/tracker/client.py `start(
 recover=True)`), which the tracker re-links without disturbing the rest of
 the job (tested in tests/test_tracker.py).
+
+Liveness integration (doc/robustness.md "Distributed job liveness") is
+two-way via `attach_tracker`:
+
+- tracker -> supervisor: the tracker's dead-rank notification triggers a
+  PROACTIVE relaunch — a segfaulted container whose CLI status lags is
+  restarted from the heartbeat signal, not the slow poll;
+- supervisor -> tracker: a task that exhausts max_attempts tells the
+  tracker to abort the job instead of leaving it waiting forever on a
+  rank that will never return.
 """
 
 from __future__ import annotations
@@ -46,6 +56,10 @@ class _TaskState:
     attempt: int = 0
     handle: object = None
     done: bool = False
+    # monotonic launch time of the CURRENT incarnation — lets the
+    # dead-rank callback tell "this incarnation is the dead one" from
+    # "the dead one was already replaced" (see _on_rank_dead)
+    started_at: Optional[float] = None
 
 
 class WorkerSupervisor:
@@ -65,16 +79,136 @@ class WorkerSupervisor:
         self._tasks: List[_TaskState] = []
         self._stop = threading.Event()
         # (task_id, attempt, returncode) log of observed failures — lets
-        # tests and callers audit the restart history
+        # tests and callers audit the restart history (returncode is None
+        # when the restart came from a tracker dead-rank signal whose CLI
+        # status had not caught up yet)
         self.failures: List[tuple] = []
+        # task mutation happens on the watch thread AND the tracker's
+        # dead-rank notifier thread once attach_tracker is used
+        self._lock = threading.Lock()
+        self._tracker = None
+        self._rank_to_task: Callable[[int], int] = lambda rank: rank
 
     def add(self, task_id: int, role: str,
             start: Callable[[int], object]) -> None:
         """Register a task: (task_id, role, start(attempt) -> handle)."""
         self._tasks.append(_TaskState(task_id, role, start))
 
+    def attach_tracker(self, tracker,
+                       rank_to_task: Optional[Callable[[int], int]] = None
+                       ) -> None:
+        """Wire liveness both ways with a RabitTracker: subscribe to its
+        dead-rank notifications for proactive relaunch, and report
+        attempt exhaustion back as a job abort.
+
+        The dead rank is mapped to a task by, in order: the task id the
+        worker itself reported on the wire (RendezvousClient defaults
+        its jobid to "task<DMLC_TASK_ID>", carried in the notification
+        as info["task_id"] — authoritative, since ranks are assigned by
+        host-sorted arrival and need NOT equal task ids), then
+        `rank_to_task` (default: identity) for legacy workers that
+        report no jobid."""
+        self._tracker = tracker
+        if rank_to_task is not None:
+            self._rank_to_task = rank_to_task
+        tracker.on_rank_dead(self._on_rank_dead)
+
+    def _find(self, task_id: int) -> Optional[_TaskState]:
+        for t in self._tasks:
+            if t.task_id == task_id:
+                return t
+        return None
+
+    def _abort_tracker(self, reason: str) -> None:
+        if self._tracker is not None:
+            try:
+                self._tracker.abort(reason)
+            except Exception:
+                logger.exception("tracker abort failed")
+
+    def _relaunch_locked(self, t: _TaskState, rc, why: str) -> bool:
+        """Record the failure and relaunch `t` under the next attempt
+        (caller holds self._lock). Returns False when max_attempts is
+        exhausted: supervision stops and the tracker is told to abort
+        instead of waiting forever on the rank. The single copy of the
+        restart bookkeeping shared by the status-poll path (watch) and
+        the dead-rank-signal path (_on_rank_dead)."""
+        self.failures.append((t.task_id, t.attempt, rc))
+        t.attempt += 1
+        if t.attempt > self.max_attempts:
+            self._stop_locked()
+            self._abort_tracker(
+                f"task {t.task_id} ({t.role}) exhausted {t.attempt} "
+                f"attempts ({why})")
+            return False
+        # tear the failed incarnation down before resubmitting — remote
+        # backends may still have live pieces (a surviving container of a
+        # partially-failed group, a foreground mesos-execute client); a
+        # dead local Popen ignores it
+        try:
+            t.handle.terminate()
+        except Exception:
+            pass
+        logger.warning("task %d (%s) %s; relaunching (attempt %d)",
+                       t.task_id, t.role, why, t.attempt)
+        t.handle = t.start(t.attempt)
+        t.started_at = time.monotonic()
+        return True
+
+    def _on_rank_dead(self, rank: int, info: Dict[str, object]) -> None:
+        """Tracker liveness callback: relaunch the dead rank's task NOW —
+        ahead of the (possibly minutes-slow) status poll."""
+        task_id = info.get("task_id")  # wire-reported: authoritative
+        if not isinstance(task_id, int):
+            try:
+                task_id = self._rank_to_task(rank)
+            except Exception:
+                logger.exception("rank_to_task mapping failed for rank %d",
+                                 rank)
+                return
+        with self._lock:
+            t = self._find(task_id)
+            if t is None or t.done or self._stop.is_set():
+                return
+            # If the current incarnation was launched AFTER the dead
+            # rank's last heartbeat, the dead incarnation is already
+            # replaced (the watch loop's poll won the race) — relaunching
+            # again would kill the healthy replacement mid-recover. A
+            # CommandTask whose CLI status lags keeps its old started_at,
+            # so the genuinely-dead case still relaunches.
+            last_beat = info.get("last_beat_monotonic")
+            if isinstance(last_beat, float) and t.started_at is not None \
+                    and t.started_at > last_beat:
+                logger.info(
+                    "rank %d dead signal ignored: task %d already "
+                    "relaunched since its last heartbeat", rank, t.task_id)
+                return
+            handle = t.handle
+        # poll outside the lock (same rule as watch(): on CLI backends
+        # this execs a status command that can hang)
+        rc = None
+        try:
+            rc = handle.poll() if handle is not None else None
+        except Exception:
+            pass
+        with self._lock:
+            if t.done or self._stop.is_set() or t.handle is not handle:
+                return  # resolved or replaced while we were polling
+            try:
+                self._relaunch_locked(t, rc, f"rank {rank} marked dead")
+            except Exception:
+                logger.exception("proactive relaunch of task %d failed",
+                                 t.task_id)
+                self._stop_locked()
+                self._abort_tracker(
+                    f"relaunch of task {t.task_id} failed")
+
     def stop(self) -> None:
         """Stop watching and terminate every live handle."""
+        with self._lock:
+            self._stop_locked()
+
+    def _stop_locked(self) -> None:
         self._stop.set()
         for t in self._tasks:
             if t.handle is not None and not t.done:
@@ -89,43 +223,46 @@ class WorkerSupervisor:
         background watch thread."""
         for t in self._tasks:
             t.handle = t.start(t.attempt)
+            t.started_at = time.monotonic()
 
     def watch(self) -> None:
         """Poll launched handles until all complete; relaunch failures."""
         while not self._stop.is_set():
             all_done = True
             for t in self._tasks:
-                if t.done:
-                    continue
-                rc = t.handle.poll()
-                if rc is None:
+                with self._lock:
+                    if t.done or self._stop.is_set():
+                        continue
+                    handle = t.handle
+                # poll OUTSIDE the lock: on CLI backends it execs a
+                # status command that can block for seconds (a hung
+                # kubectl) — holding the lock would serialize stop() and
+                # the tracker's dead-rank callback behind exactly the
+                # slow poll the proactive path exists to bypass
+                rc = handle.poll()
+                with self._lock:
+                    if t.done or self._stop.is_set():
+                        continue
+                    if t.handle is not handle:
+                        # replaced meanwhile by a proactive relaunch; the
+                        # rc belongs to the dead incarnation it already
+                        # accounted for
+                        all_done = False
+                        continue
+                    if rc is None:
+                        all_done = False
+                        continue
+                    if rc == 0:
+                        t.done = True
+                        continue
+                    # failed: relaunch under the same task id — the worker
+                    # rejoins with cmd=recover and keeps its old rank
+                    if not self._relaunch_locked(
+                            t, rc, f"exited with code {rc}"):
+                        raise RuntimeError(
+                            f"task {t.task_id} ({t.role}) failed with code "
+                            f"{rc} after {t.attempt} attempts")
                     all_done = False
-                    continue
-                if rc == 0:
-                    t.done = True
-                    continue
-                # failed: relaunch under the same task id — the worker
-                # rejoins with cmd=recover and keeps its old rank
-                self.failures.append((t.task_id, t.attempt, rc))
-                t.attempt += 1
-                if t.attempt > self.max_attempts:
-                    self.stop()
-                    raise RuntimeError(
-                        f"task {t.task_id} ({t.role}) failed with code "
-                        f"{rc} after {t.attempt} attempts")
-                # tear the failed incarnation down before resubmitting —
-                # remote backends may still have live pieces (a surviving
-                # container of a partially-failed group, a foreground
-                # mesos-execute client); a dead local Popen ignores it
-                try:
-                    t.handle.terminate()
-                except Exception:
-                    pass
-                logger.warning(
-                    "task %d (%s) exited with code %d; relaunching "
-                    "(attempt %d)", t.task_id, t.role, rc, t.attempt)
-                t.handle = t.start(t.attempt)
-                all_done = False
             if all_done:
                 return
             time.sleep(self.poll_interval)

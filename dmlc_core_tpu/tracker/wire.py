@@ -10,8 +10,57 @@ from __future__ import annotations
 
 import socket
 import struct
+from typing import List, Optional
 
 MAGIC = 0xFF99
+
+# -- liveness protocol constants ---------------------------------------------
+# A worker that opts into liveness opens a SECOND tracker connection with
+# cmd="heartbeat" after receiving its rank. The channel carries int32 pings
+# (worker -> tracker, any non-negative value) on the interval the tracker
+# announces right after the handshake; the only tracker -> worker frame is
+# HEARTBEAT_ABORT followed by a length-prefixed reason string, broadcast
+# when the job is being torn down so workers raise instead of hanging in
+# peer links. Legacy clients never send cmd="heartbeat", so the original
+# start/recover/shutdown/print byte stream is untouched.
+CMD_HEARTBEAT = "heartbeat"
+HEARTBEAT_PING = 1
+HEARTBEAT_BYE = 2   # graceful channel close: disarms liveness, not a death
+HEARTBEAT_ABORT = -86
+
+
+def env_int(name: str, default: int, env=None) -> int:
+    """Checked env parse shared by tracker/client/bootstrap: garbage text
+    raises instead of silently becoming a value that disables a liveness
+    deadline (the retry.h CheckedEnvInt rule, applied to the control
+    plane). `env` defaults to os.environ (bootstrap validates its own
+    computed dict)."""
+    import os
+    raw = (os.environ if env is None else env).get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise RuntimeError(f"{name}={raw!r} is not an integer")
+
+
+class TrackerAbortedError(RuntimeError):
+    """The tracker gave up on the job (dead ranks past their deadline, a
+    supervisor that exhausted its attempts, or an explicit abort()).
+
+    Raised by ``RabitTracker.join()`` on the launcher side and by
+    ``RendezvousClient`` operations unblocked by the abort broadcast on the
+    worker side — the structured, loud end the liveness layer guarantees
+    instead of an indefinite hang."""
+
+    def __init__(self, reason: str, dead_ranks: Optional[List[int]] = None):
+        self.reason = reason
+        self.dead_ranks = sorted(dead_ranks or [])
+        msg = reason
+        if self.dead_ranks:
+            msg = f"{reason} (dead ranks: {self.dead_ranks})"
+        super().__init__(msg)
 
 
 class WireSocket:
@@ -59,6 +108,10 @@ class WireSocket:
         data = s.encode()
         self.send_int(len(data))  # byte count, not character count
         self.sock.sendall(data)
+
+    def settimeout(self, timeout) -> None:
+        """Bound every subsequent blocking op on the underlying socket."""
+        self.sock.settimeout(timeout)
 
     def close(self) -> None:
         """Close the underlying socket (idempotent)."""

@@ -25,6 +25,7 @@ doesn't reach and keeps the on-disk format the framework's own
 from __future__ import annotations
 
 import io
+import os
 from typing import Any, Dict, Iterable, Optional, Tuple  # noqa: F401
 
 import numpy as np
@@ -45,26 +46,84 @@ def _flatten(params: Any) -> list:
             jax.tree_util.tree_flatten_with_path(params)[0]]
 
 
-def save_checkpoint(uri: str, params: Any, step: int = 0,
-                    extra: Optional[Dict[str, str]] = None) -> None:
-    """Write a pytree checkpoint to any stream URI; atomic for file://
-    via write-then-rename is the caller's concern on remote stores."""
+def _local_path(uri: str) -> Optional[str]:
+    """The filesystem path for a local URI, else None. `file://` and
+    scheme-less paths are local; everything with another scheme (s3://,
+    hdfs://, azure://, http(s)://...) is remote."""
+    if uri.startswith("file://"):
+        return uri[len("file://"):]
+    if "://" not in uri:
+        return uri
+    return None
+
+
+def _write_body(stream, params: Any, step: int,
+                extra: Optional[Dict[str, str]]) -> None:
     flat = _flatten(params)
     # stream leaf-by-leaf: peak extra memory is O(largest leaf), not
     # O(model) — the BinaryWriter only needs .write, which NativeStream has
-    with NativeStream(uri, "w") as s:
-        w = BinaryWriter(s)
-        w.write_bytes(_MAGIC)
-        w.write_scalar(step, "int64")
-        w.write_str_map(extra or {})
-        w.write_scalar(len(flat), "int64")
-        for key, arr in flat:
-            w.write_string(key)
-            w.write_string(str(arr.dtype))
-            w.write_scalar(arr.ndim, "int32")
-            for d in arr.shape:
-                w.write_scalar(int(d), "int64")
-            w.write_bytes(arr.tobytes())
+    w = BinaryWriter(stream)
+    w.write_bytes(_MAGIC)
+    w.write_scalar(step, "int64")
+    w.write_str_map(extra or {})
+    w.write_scalar(len(flat), "int64")
+    for key, arr in flat:
+        w.write_string(key)
+        w.write_string(str(arr.dtype))
+        w.write_scalar(arr.ndim, "int32")
+        for d in arr.shape:
+            w.write_scalar(int(d), "int64")
+        w.write_bytes(arr.tobytes())
+
+
+def save_checkpoint(uri: str, params: Any, step: int = 0,
+                    extra: Optional[Dict[str, str]] = None) -> None:
+    """Write a pytree checkpoint to any stream URI.
+
+    Local URIs (plain paths and ``file://``) are written ATOMICALLY:
+    temp name in the same directory, fsync, then rename over the target —
+    a worker killed mid-checkpoint (exactly what the liveness layer's
+    supervisor does, doc/robustness.md) leaves either the old complete
+    checkpoint or the new complete one, never a truncated file that
+    restore_checkpoint then trusts. Remote object stores (s3://,
+    azure://...) already commit whole objects on close; hdfs:// writers
+    should checkpoint to a temp path and rename via their own tooling."""
+    path = _local_path(uri)
+    if path is None:
+        with NativeStream(uri, "w") as s:
+            _write_body(s, params, step, extra)
+        return
+    # same directory (rename() stays within one fs); unique per pid AND
+    # per call — a periodic-checkpoint thread racing a shutdown save in
+    # the same process must not interleave bodies into one temp file
+    import uuid
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    try:
+        with NativeStream(tmp, "w") as s:
+            _write_body(s, params, step, extra)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed/interrupted save must not leave temp litter that a
+        # later glob of the checkpoint dir would pick up
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # best-effort directory fsync so the rename itself survives a crash
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
 
 
 def _read_all(uri: str) -> bytes:

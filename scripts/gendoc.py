@@ -249,8 +249,10 @@ def gen_index() -> str:
         "| [parsing.md](parsing.md) | SIMD text ingest: structural "
         "scanner tiers, fused field decoders, DMLC_PARSE_SIMD, the "
         "byte-identical guarantee |",
-        "| [robustness.md](robustness.md) | remote-I/O resilience: retry "
-        "model, env/URI knobs, fault-plan grammar, io_stats() |",
+        "| [robustness.md](robustness.md) | remote-I/O resilience (retry "
+        "model, env/URI knobs, fault-plan grammar, io_stats()) + "
+        "distributed job liveness (heartbeats, dead-rank deadlines, "
+        "abort broadcast, state()/event-log schema) |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
         "",

@@ -7,10 +7,18 @@ protocol (tracker/launchers.py build_tpu_pod_env), initializes
 jax.distributed against a real coordination service, shards input with
 process_part(), and allreduces shard statistics across OS processes.
 
+Liveness mirror (doc/robustness.md "Distributed job liveness"): when the
+launcher also exports DMLC_TRACKER_URI/PORT the worker checks into the
+rabit rendezvous and — with DMLC_TRACKER_HEARTBEAT_MS set — holds the
+heartbeat channel for the duration of the compute phase, so chaos tests
+can SIGKILL a worker and watch the tracker's dead-rank/abort machinery
+end-to-end around a real jax.distributed workload.
+
 Usage: python distributed_worker.py <repo_root> <data_path> <out_json>
 """
 
 import json
+import os
 import sys
 
 
@@ -26,6 +34,15 @@ def main() -> None:
     from dmlc_core_tpu.parallel import distributed
     from dmlc_core_tpu.tpu.sharding import process_part
 
+    # optional tracker check-in: heartbeat liveness rides alongside the
+    # JAX coordination service when the launcher provides a tracker
+    client = assignment = None
+    if os.environ.get("DMLC_TRACKER_URI"):
+        from dmlc_core_tpu.tracker.client import RendezvousClient
+        client = RendezvousClient(os.environ["DMLC_TRACKER_URI"],
+                                  int(os.environ["DMLC_TRACKER_PORT"]))
+        assignment = client.start()
+
     distributed.init_from_env()
 
     part, npart = process_part()
@@ -35,6 +52,10 @@ def main() -> None:
         for b in p:
             rows += b.num_rows
             label_sum += float(b.label.sum())
+            if client is not None and client.heartbeat is not None:
+                # long compute loops surface the abort broadcast between
+                # batches instead of finishing doomed work
+                client.heartbeat.check()
 
     total_rows = int(distributed.allreduce(rows))
     total_label = float(distributed.allreduce(label_sum))
@@ -54,6 +75,9 @@ def main() -> None:
             "max_rows": max_rows,
             "bcast": bcast,
         }, f)
+
+    if client is not None and assignment is not None:
+        client.shutdown(assignment.rank)
 
 
 if __name__ == "__main__":

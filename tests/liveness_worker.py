@@ -1,0 +1,97 @@
+"""Chaos worker driven by tests/test_tracker_liveness.py.
+
+A real OS process that joins the tracker rendezvous with the heartbeat
+channel open (env-gated via DMLC_TRACKER_HEARTBEAT_MS). All
+synchronization is sockets and process exits, never sleeps. The same
+script serves both chaos drills — supervision is external:
+
+- unsupervised: DMLC_TASK_ID 0 SIGKILLs itself right after rendezvous;
+  every other worker notices the dead peer link (EOF), attempts the
+  two-sided recover, and HANGS awaiting the victim's dial — until the
+  tracker's liveness abort unblocks it with a structured
+  TrackerAbortedError (exit code 3, reason dropped in a file).
+
+- supervised: same SIGKILL, but a WorkerSupervisor is watching. The
+  survivor rides EOF -> recover -> re-link; the relaunched victim
+  (DMLC_NUM_ATTEMPT > 0) rejoins under its OLD rank via cmd=recover,
+  proves the new link with a byte exchange, and everyone shuts down
+  cleanly (exit 0).
+
+Usage: python liveness_worker.py <repo_root> <scratch_dir>
+"""
+
+import os
+import signal
+import sys
+
+
+def main() -> None:
+    repo, scratch = sys.argv[1], sys.argv[2]
+    sys.path.insert(0, repo)
+    from dmlc_core_tpu.tracker.client import RendezvousClient
+    from dmlc_core_tpu.tracker.wire import TrackerAbortedError
+
+    task = int(os.environ["DMLC_TASK_ID"])
+    attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
+    client = RendezvousClient(os.environ["DMLC_TRACKER_URI"],
+                              int(os.environ["DMLC_TRACKER_PORT"]))
+    rank_file = os.path.join(scratch, f"rank_{task}")
+
+    if attempt > 0:
+        # relaunched victim: rejoin under the OLD rank via cmd=recover
+        old_rank = int(open(rank_file).read())
+        assign = client.start(rank=old_rank, recover=True)
+        with open(os.path.join(scratch, "recovered"), "w") as f:
+            f.write(f"{assign.rank} {attempt}")
+        # prove the re-established links end-to-end: greet every peer,
+        # wait for their ack — THIS is the synchronization point
+        for peer in assign.links.values():
+            peer.sock.sendall(b"R")
+        for peer in assign.links.values():
+            if peer.recv_all(1) != b"K":
+                sys.exit(7)
+        client.shutdown(assign.rank)
+        return
+
+    assign = client.start()
+    with open(rank_file, "w") as f:
+        f.write(str(assign.rank))
+    with open(os.path.join(scratch, f"pid_rank{assign.rank}"), "w") as f:
+        f.write(str(os.getpid()))
+
+    if task == 0:
+        # the victim: die the hard way, post-rendezvous — no atexit, no
+        # FIN on the peer links' behalf beyond what the OS sends
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    # survivor: the victim's death surfaces as EOF on the peer link
+    try:
+        peer = next(iter(assign.links.values()))
+        data = peer.recv_all(1)
+        # a byte here would mean the victim spoke before dying — only
+        # possible if the test script changed; treat as protocol error
+        sys.exit(6)
+    except (ConnectionError, OSError):
+        pass  # EOF/RST: the victim is gone
+
+    try:
+        # two-sided recovery: re-enter the rendezvous under our own rank.
+        # Unsupervised, nobody relaunches the victim: this blocks in the
+        # peer-accept until the tracker aborts and the HeartbeatMonitor
+        # slams the guarded listener.
+        assign2 = client.start(rank=assign.rank, recover=True)
+    except TrackerAbortedError as e:
+        with open(os.path.join(scratch, f"aborted_{task}"), "w") as f:
+            f.write(str(e))
+        sys.exit(3)
+
+    # supervised: the relaunched victim re-linked with us — ack its greet
+    for peer in assign2.links.values():
+        if peer.recv_all(1) != b"R":
+            sys.exit(7)
+        peer.sock.sendall(b"K")
+    client.shutdown(assign2.rank)
+
+
+if __name__ == "__main__":
+    main()

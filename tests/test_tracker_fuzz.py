@@ -214,9 +214,19 @@ def test_neighbor_set_violation_drops_peer_not_tracker():
     ws.send_int(2)  # claim two good links...
     ws.send_int(40)  # ...to ranks that were never assigned
     ws.send_int(41)
-    # the tracker drops this connection rather than dying
-    got = ws.sock.recv(4)
-    assert got == b""  # peer saw a clean close
+    # The tracker drops this connection rather than dying. Deflaked
+    # (CHANGES.md PR 3): the violation fires on the COUNT (2 > world 1),
+    # so the two link ints may still be in flight when the tracker
+    # closes; close-with-unread-kernel-data sends RST, and recv() then
+    # races between b"" (FIN) and ECONNRESET. The tracker now drains
+    # buffered bytes before closing (rendezvous._close_conn), which
+    # removes the common case, but bytes still on the wire at close time
+    # are unfixable by either side — a reset IS a drop, assert it as one.
+    try:
+        got = ws.sock.recv(4)
+        assert got == b""  # clean close
+    except ConnectionResetError:
+        pass  # dropped before our last ints were consumed
     assert tracker.alive()
     # the burned rank recovers and finishes
     c = RendezvousClient("127.0.0.1", tracker.port)

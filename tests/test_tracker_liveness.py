@@ -1,0 +1,416 @@
+"""Distributed job liveness chaos suite (doc/robustness.md).
+
+Pins the bound the liveness layer guarantees: a distributed job either
+finishes, recovers, or fails loudly within a deadline — never hangs.
+Wall-clock asserted, all synchronization via sockets / process exits /
+events (no sleeps-as-synchronization):
+
+- SIGKILL a worker post-rendezvous WITHOUT supervision: every surviving
+  worker unblocks with the structured TrackerAbortedError and
+  tracker.join() raises it, both within 2x DMLC_TRACKER_DEAD_AFTER_MS of
+  the kill, naming the dead rank.
+- Same kill WITH supervision: the job completes — the relaunched worker
+  re-links under its old rank and state() shows the restart.
+- Legacy clients that never heartbeat still rendezvous and shut down.
+- stop()/context-manager, state()/event-log schema, client-side
+  timeouts, and the supervisor's proactive-relaunch/abort unit paths.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dmlc_core_tpu.tracker.client import HeartbeatMonitor, RendezvousClient
+from dmlc_core_tpu.tracker.rendezvous import RabitTracker
+from dmlc_core_tpu.tracker.supervisor import WorkerSupervisor, popen_start_fn
+from dmlc_core_tpu.tracker.wire import TrackerAbortedError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "liveness_worker.py")
+
+# chaos timings: heartbeat every 100 ms, dead after 1 s of silence, 300 ms
+# recover grace -> the abort must land well inside the 2x dead-after bound
+HB_MS, DEAD_MS, GRACE_MS = 100, 1000, 300
+
+
+def _worker_env(tracker, task_id, attempt=0):
+    env = dict(os.environ)
+    env.update({str(k): str(v) for k, v in tracker.worker_envs().items()})
+    env.update({
+        "DMLC_TASK_ID": str(task_id),
+        "DMLC_NUM_ATTEMPT": str(attempt),
+        "DMLC_TRACKER_RECOVER_GRACE_MS": str(tracker.recover_grace_ms),
+        # a liveness bug must fail via these asserts, not via a worker
+        # hanging for the 300 s default and eating the suite timeout
+        "DMLC_TRACKER_CLIENT_TIMEOUT": "60",
+    })
+    return env
+
+
+def _spawn(tracker, tmp_path, task_id, attempt=0):
+    return subprocess.Popen(
+        [sys.executable, WORKER, REPO, str(tmp_path)],
+        env=_worker_env(tracker, task_id, attempt),
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+# -- the liveness bound, end to end ------------------------------------------
+def test_unsupervised_sigkill_aborts_within_deadline(tmp_path):
+    """The acceptance bound: SIGKILL post-rendezvous, nobody relaunches
+    -> the job fails LOUDLY on every side within 2x dead-after."""
+    tracker = RabitTracker("127.0.0.1", 2, heartbeat_ms=HB_MS,
+                           dead_after_ms=DEAD_MS, recover_grace_ms=GRACE_MS)
+    tracker.start()
+    victim = _spawn(tracker, tmp_path, task_id=0)
+    survivor = _spawn(tracker, tmp_path, task_id=1)
+
+    victim.wait(timeout=60)  # SIGKILLs itself right after rendezvous
+    t_kill = time.monotonic()
+    assert victim.returncode == -9
+
+    bound = 2 * DEAD_MS / 1000.0
+
+    # the tracker's join() raises the structured error within the bound
+    with pytest.raises(TrackerAbortedError) as excinfo:
+        tracker.join(timeout=bound + 30)
+    join_latency = time.monotonic() - t_kill
+    victim_rank = int((tmp_path / "rank_0").read_text())
+    assert excinfo.value.dead_ranks == [victim_rank]
+    assert join_latency <= bound, \
+        f"join() took {join_latency:.2f}s > {bound:.2f}s after the kill"
+
+    # the surviving worker — hung in the recover peer-accept — was
+    # unblocked by the abort broadcast, raised TrackerAbortedError
+    # (exit 3), and named the reason
+    survivor.wait(timeout=30)
+    survivor_latency = time.monotonic() - t_kill
+    stderr = survivor.stderr.read().decode()
+    assert survivor.returncode == 3, stderr
+    assert survivor_latency <= bound, \
+        f"survivor unblocked after {survivor_latency:.2f}s > {bound:.2f}s"
+    reason = (tmp_path / "aborted_1").read_text()
+    assert str(victim_rank) in reason  # the error names the dead rank
+
+
+def test_supervised_sigkill_recovers_under_old_rank(tmp_path):
+    """Same kill, but supervised: the tracker's dead-rank signal (or the
+    supervisor's own poll — whichever wins) relaunches the victim, which
+    rejoins via cmd=recover under its OLD rank; the job completes and
+    state() records the restart."""
+    tracker = RabitTracker("127.0.0.1", 2, heartbeat_ms=HB_MS,
+                           dead_after_ms=DEAD_MS,
+                           recover_grace_ms=30000)  # relaunch needs time
+    tracker.start()
+    sup = WorkerSupervisor(max_attempts=2, poll_interval=0.05)
+    for i in range(2):
+        sup.add(i, "worker",
+                popen_start_fn([sys.executable, WORKER, REPO, str(tmp_path)],
+                               "worker", i,
+                               dict(_worker_env(tracker, i),
+                                    DMLC_TRACKER_RECOVER_GRACE_MS="30000")))
+    sup.attach_tracker(tracker)
+    sup.run()  # raises if attempts are exhausted
+    tracker.join(timeout=60)
+
+    # exactly one task died (the self-SIGKILL) and was relaunched
+    assert sup.failures and sup.failures[0][0] == 0
+    victim_rank = int((tmp_path / "rank_0").read_text())
+    recovered = (tmp_path / "recovered").read_text().split()
+    assert int(recovered[0]) == victim_rank  # rejoined under the old rank
+    assert int(recovered[1]) >= 1            # on a relaunched attempt
+
+    state = tracker.state()
+    assert state["finished"] and not state["aborted"]
+    assert state["ranks"][victim_rank]["restarts"] >= 1
+    assert state["ranks"][victim_rank]["phase"] == "shutdown"
+    events = [e["event"] for e in tracker.events]
+    assert "recover" in events and "abort" not in events
+
+
+# -- legacy compatibility ----------------------------------------------------
+def test_legacy_clients_without_heartbeat_are_untracked():
+    """A liveness-enabled tracker serves heartbeat-less legacy clients
+    byte-compatibly: they rendezvous, shut down, and are never
+    dead-marked — even though the deadline machinery is armed."""
+    tracker = RabitTracker("127.0.0.1", 2, heartbeat_ms=50,
+                           dead_after_ms=200, recover_grace_ms=100)
+    tracker.start()
+    results = {}
+
+    def worker():
+        c = RendezvousClient("127.0.0.1", tracker.port)
+        a = c.start(heartbeat=False)  # a legacy client never opens one
+        results[a.rank] = a
+        c.shutdown(a.rank)
+
+    ths = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=30)
+    tracker.join(timeout=30)  # must NOT raise TrackerAbortedError
+    assert sorted(results) == [0, 1]
+    assert not tracker.state()["aborted"]
+
+
+# -- observability: state(), events, JSONL log -------------------------------
+def test_state_snapshot_and_event_log(tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    tracker = RabitTracker("127.0.0.1", 1, heartbeat_ms=50,
+                           dead_after_ms=5000, event_log=log_path)
+    tracker.start()
+    c = RendezvousClient("127.0.0.1", tracker.port)
+    a = c.start()  # env-independent: tracker announces, client monitors
+    assert c.heartbeat is None  # env not set in this process
+    # opt in explicitly
+    mon = HeartbeatMonitor("127.0.0.1", tracker.port, a.rank)
+    assert mon.interval == 0.05  # the tracker-announced cadence
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st = tracker.state()
+        if st["ranks"].get(a.rank, {}).get("phase") == "alive":
+            break
+        time.sleep(0.01)
+    st = tracker.state()
+    assert st["ranks"][a.rank]["phase"] == "alive"
+    assert st["ranks"][a.rank]["last_heartbeat_age_s"] is not None
+    assert st["heartbeat_ms"] == 50 and st["dead_after_ms"] == 5000
+
+    mon.close()
+    c.shutdown(a.rank)
+    tracker.join(timeout=30)
+    events = [e["event"] for e in tracker.events]
+    for expected in ("assign", "heartbeat-open", "shutdown", "finish"):
+        assert expected in events, events
+    # the JSONL mirror parses line-by-line with the same schema
+    with open(log_path) as f:
+        lines = [json.loads(line) for line in f]
+    assert [e["event"] for e in lines] == events
+    assert all("ts" in e for e in lines)
+
+
+def test_heartbeat_revival_within_grace_cancels_death(tmp_path):
+    """Beats resuming inside the grace window (network blip) revive the
+    rank instead of aborting the job."""
+    tracker = RabitTracker("127.0.0.1", 1, heartbeat_ms=50,
+                           dead_after_ms=300, recover_grace_ms=30000)
+    tracker.start()
+    c = RendezvousClient("127.0.0.1", tracker.port)
+    a = c.start(heartbeat=True)
+    # silence the monitor long enough to be marked dead, but keep the
+    # socket open (a stall, not a death)
+    mon = c.heartbeat
+    mon._closing = True  # stop pings without closing the channel
+    mon._thread.join(timeout=5)
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if tracker.state()["ranks"][a.rank]["phase"] == "dead":
+            break
+        time.sleep(0.02)
+    assert tracker.state()["ranks"][a.rank]["phase"] == "dead"
+
+    # beats resume on the SAME channel -> revived, job completes
+    mon._ws.send_int(1)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if tracker.state()["ranks"][a.rank]["phase"] == "alive":
+            break
+        mon._ws.send_int(1)
+        time.sleep(0.02)
+    assert tracker.state()["ranks"][a.rank]["phase"] == "alive"
+    assert "revived" in [e["event"] for e in tracker.events]
+    c.heartbeat = None  # monitor thread already stopped; shut down plain
+    mon._ws.close()
+    c.shutdown(a.rank)
+    tracker.join(timeout=30)
+
+
+# -- stop() / context manager ------------------------------------------------
+def test_stop_unblocks_serve_loop_and_releases_port():
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start()
+    port = tracker.port
+    tracker.stop()
+    tracker.join(timeout=10)  # returns instead of TimeoutError
+    assert not tracker.alive()
+    # the port is actually free again (the old leak): rebind it
+    s = socket.socket()
+    s.bind(("127.0.0.1", port))
+    s.close()
+
+
+def test_stop_without_start_releases_port():
+    tracker = RabitTracker("127.0.0.1", 2)
+    port = tracker.port
+    tracker.stop()
+    s = socket.socket()
+    s.bind(("127.0.0.1", port))
+    s.close()
+
+
+def test_context_manager_round_trip():
+    with RabitTracker("127.0.0.1", 2) as tracker:
+        assert tracker.alive()
+        port = tracker.port
+    assert not tracker.alive()
+    s = socket.socket()
+    s.bind(("127.0.0.1", port))
+    s.close()
+
+
+def test_abort_api_raises_structured_error_from_join():
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start()
+    tracker.abort("operator gave up", dead_ranks=[1])
+    with pytest.raises(TrackerAbortedError) as excinfo:
+        tracker.join(timeout=10)
+    assert excinfo.value.dead_ranks == [1]
+    assert "operator gave up" in str(excinfo.value)
+
+
+# -- client-side deadlines ---------------------------------------------------
+def test_client_fails_fast_on_mute_tracker():
+    """A tracker that accepts and goes silent must fail the worker within
+    its deadline — the old client hung forever."""
+    mute = socket.socket()
+    mute.bind(("127.0.0.1", 0))
+    mute.listen(4)
+    port = mute.getsockname()[1]
+    try:
+        c = RendezvousClient("127.0.0.1", port, timeout=0.5)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            c.start()
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        mute.close()
+
+
+def test_client_rejects_bad_magic_without_asserts():
+    """The magic check must survive `python -O`: a real ConnectionError,
+    not an assert."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def answer_bad_magic():
+        fd, _ = srv.accept()
+        fd.recv(4)
+        fd.sendall((0xDEAD).to_bytes(4, sys.byteorder))
+        fd.close()
+
+    th = threading.Thread(target=answer_bad_magic, daemon=True)
+    th.start()
+    try:
+        c = RendezvousClient("127.0.0.1", port, timeout=5)
+        with pytest.raises(ConnectionError, match="magic"):
+            c.start()
+    finally:
+        srv.close()
+
+
+# -- supervisor integration units --------------------------------------------
+class FakeTracker:
+    def __init__(self):
+        self.callback = None
+        self.aborts = []
+
+    def on_rank_dead(self, cb):
+        self.callback = cb
+
+    def abort(self, reason, dead_ranks=None):
+        self.aborts.append(reason)
+
+
+class AliveHandle:
+    """poll() lags (None) — the segfaulted-container-with-slow-CLI case."""
+
+    def __init__(self):
+        self.terminated = False
+
+    def poll(self):
+        return None
+
+    def terminate(self):
+        self.terminated = True
+
+
+def test_dead_rank_signal_proactively_relaunches():
+    launches = []
+
+    def start(attempt):
+        launches.append(attempt)
+        return AliveHandle()
+
+    tracker = FakeTracker()
+    sup = WorkerSupervisor(max_attempts=2, poll_interval=0.01)
+    sup.add(0, "worker", start)
+    sup.attach_tracker(tracker)
+    sup.launch()
+    first = sup._tasks[0].handle
+    # the incarnation predates the (stale) last heartbeat -> it IS the
+    # dead one: relaunch now, even though poll() still says "running"
+    tracker.callback(0, {"rank": 0,
+                         "last_beat_monotonic": time.monotonic() + 1})
+    assert launches == [0, 1]
+    assert first.terminated  # dead incarnation torn down first
+    assert sup.failures == [(0, 0, None)]  # CLI status had not caught up
+
+
+def test_stale_dead_rank_signal_is_ignored_after_relaunch():
+    launches = []
+
+    def start(attempt):
+        launches.append(attempt)
+        return AliveHandle()
+
+    tracker = FakeTracker()
+    sup = WorkerSupervisor(max_attempts=2, poll_interval=0.01)
+    sup.add(0, "worker", start)
+    sup.attach_tracker(tracker)
+    sup.launch()
+    # the current incarnation started AFTER the dead one's last beat:
+    # the watch loop already replaced it — a second kill would murder
+    # the healthy replacement mid-recover
+    tracker.callback(0, {"rank": 0,
+                         "last_beat_monotonic": time.monotonic() - 60})
+    assert launches == [0]
+    assert sup.failures == []
+
+
+def test_exhausted_attempts_abort_the_tracker():
+    tracker = FakeTracker()
+    sup = WorkerSupervisor(max_attempts=0, poll_interval=0.01)
+    sup.add(0, "worker", lambda attempt: AliveHandle())
+    sup.attach_tracker(tracker)
+    sup.launch()
+    tracker.callback(0, {"rank": 0,
+                         "last_beat_monotonic": time.monotonic() + 1})
+    assert tracker.aborts and "exhausted" in tracker.aborts[0]
+
+
+def test_watch_exhaustion_aborts_tracker_too():
+    class DeadHandle:
+        def poll(self):
+            return 1
+
+        def terminate(self):
+            pass
+
+    tracker = FakeTracker()
+    sup = WorkerSupervisor(max_attempts=0, poll_interval=0.01)
+    sup.add(0, "worker", lambda attempt: DeadHandle())
+    sup.attach_tracker(tracker)
+    with pytest.raises(RuntimeError, match="after 1 attempts"):
+        sup.run()
+    assert tracker.aborts  # the tracker was told, not left waiting

@@ -32,6 +32,49 @@ def test_checkpoint_roundtrip_local(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_checkpoint_save_is_atomic_on_kill(tmp_path, monkeypatch):
+    """A worker killed mid-checkpoint (exactly what the liveness layer's
+    supervisor does) must leave the OLD complete checkpoint in place —
+    never a truncated file restore_checkpoint then trusts."""
+    import dmlc_core_tpu.utils.checkpoint as ckpt
+
+    uri = str(tmp_path / "ckpt.bin")
+    p = params_tree()
+    save_checkpoint(uri, p, step=1)
+
+    # simulate the kill: the write dies partway through the body
+    real = ckpt._write_body
+
+    def dying_write(stream, params, step, extra):
+        stream.write(b"PARTIAL GARBAGE")
+        raise KeyboardInterrupt("killed mid-checkpoint")
+
+    monkeypatch.setattr(ckpt, "_write_body", dying_write)
+    with pytest.raises(KeyboardInterrupt):
+        save_checkpoint(uri, p, step=2)
+    monkeypatch.setattr(ckpt, "_write_body", real)
+
+    # the target was never touched (still step 1, fully restorable) and
+    # no temp litter remains for a checkpoint-dir glob to pick up
+    restored, step, _ = restore_checkpoint(uri, like=p)
+    assert step == 1
+    assert [f.name for f in tmp_path.iterdir()] == ["ckpt.bin"]
+
+    # a healthy save over it still lands
+    save_checkpoint(uri, p, step=3)
+    _, step, _ = restore_checkpoint(uri, like=p)
+    assert step == 3
+
+
+def test_checkpoint_atomic_applies_to_file_scheme(tmp_path):
+    """file:// URIs take the same temp+rename path as plain paths."""
+    uri = "file://" + str(tmp_path / "ckpt.bin")
+    save_checkpoint(uri, {"x": np.arange(3)}, step=7)
+    flat, step, _ = restore_checkpoint(uri)
+    assert step == 7
+    assert [f.name for f in tmp_path.iterdir()] == ["ckpt.bin"]
+
+
 def test_checkpoint_without_template_returns_dict(tmp_path):
     uri = str(tmp_path / "ckpt.bin")
     save_checkpoint(uri, {"x": np.arange(3)}, step=1)
