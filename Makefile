@@ -2,10 +2,11 @@
 # on every push: .github/workflows/githubci.yml, scripts/test_script.sh).
 # `make ci` runs every lane; each lane is also callable alone.
 
-.PHONY: ci lint native-test tsan-test asan-test parse-lanes pytest liveness \
-        bench-smoke dryrun doc clean
+.PHONY: ci lint native-test tsan-test asan-test parse-lanes telemetry \
+        pytest liveness bench-smoke dryrun doc clean
 
-ci: lint native-test tsan-test asan-test parse-lanes pytest liveness dryrun doc
+ci: lint native-test tsan-test asan-test parse-lanes telemetry pytest \
+    liveness dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -15,6 +16,15 @@ asan-test:
 # under ASan/TSan at every dispatch-tier override (cpp/Makefile)
 parse-lanes:
 	$(MAKE) -C cpp benchparse-check asan-parse tsan-parse
+
+# Unified telemetry lane (doc/observability.md): the C++ registry suite
+# under TSan (concurrent metric writers vs snapshot/reset walkers), then
+# the full Python suite INCLUDING the slow-marked overhead guard that pins
+# the instrumented parse path within 2% of DMLC_TELEMETRY=0 (CPU-time,
+# interleaved A/B)
+telemetry:
+	$(MAKE) -C cpp tsan-telemetry
+	python3 -m pytest tests/test_telemetry.py -q
 
 lint:
 	python3 scripts/lint.py

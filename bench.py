@@ -884,13 +884,27 @@ def main() -> None:
         # size-stable)
         vs = round(rps / baseline["reference_rows_per_sec"], 3)
 
-    # remote-I/O resilience counters (cpp/src/retry.h): local-file runs
-    # report zeros, but remote-source runs record the retry noise behind
-    # the throughput number so the perf trajectory distinguishes "slower
-    # code" from "flakier storage" (doc/robustness.md)
+    # observability extras come from ONE unified telemetry snapshot
+    # (doc/observability.md) instead of bespoke per-subsystem plumbing:
+    # io_retry keeps its legacy key spelling (derived from the io_*_total
+    # counters — local-file runs report zeros, remote runs record the
+    # retry noise behind the throughput number), and the per-stage parse
+    # latency means name where this run's host time went.
     try:
-        from dmlc_core_tpu.io.native import io_retry_stats
-        extras["io_retry"] = io_retry_stats()
+        from dmlc_core_tpu import telemetry
+        from dmlc_core_tpu.io.native import _LEGACY_IO_STAT_NAMES
+        snap = telemetry.snapshot(native=True)
+        counters = {c["name"]: c["value"] for c in snap["counters"]
+                    if not c["labels"]}
+        extras["io_retry"] = {legacy: int(counters.get(name, 0))
+                              for legacy, name in _LEGACY_IO_STAT_NAMES}
+        stage_mean_ms = {}
+        for h in snap["histograms"]:
+            if h["name"].startswith("parse_stage_") and h["count"]:
+                stage = h["name"][len("parse_stage_"):-len("_us")]
+                stage_mean_ms[stage] = round(h["sum"] / h["count"] / 1e3, 3)
+        if stage_mean_ms:
+            extras["parse_stage_mean_ms"] = stage_mean_ms
     except Exception as e:  # never let observability sink the benchmark
         extras["io_retry"] = {"error": str(e)[-200:]}
 

@@ -125,7 +125,7 @@ Target ResolveTarget(const AzureConfig& cfg) {
 
 // Socket route for a resolved target (via the TLS helper for https).
 HttpRoute RouteOf(const Target& t) {
-  return ResolveHttpRoute(t.scheme, t.host, t.port);
+  return ResolveHttpRoute(t.scheme, t.host, t.port, "azure");
 }
 
 // azure://container/blob-path -> ("/container", "/blob/path")

@@ -23,6 +23,7 @@
 #include "retry.h"
 #include "rowblock.h"
 #include "stream.h"
+#include "telemetry.h"
 
 namespace {
 thread_local std::string g_last_error;
@@ -126,6 +127,35 @@ int dct_webhdfs_set_auth_header(const char* header) {
 int dct_set_tls_proxy(const char* addr) {
   return Guard(
       [&] { dct::SetTlsProxyOverride(addr == nullptr ? "" : addr); });
+}
+
+// --------------------------------------------------------------- telemetry --
+// The unified telemetry plane (cpp/src/telemetry.h). dct_telemetry_snapshot
+// returns the versioned JSON document (schema doc/observability.md; caller
+// frees with dct_str_free) that dmlc_core_tpu.telemetry.snapshot() merges
+// and the tracker's /metrics scrape serves — one snapshot, three surfaces.
+int dct_telemetry_snapshot(char** out) {
+  return Guard([&] {
+    // touch the io-stats singleton so its counters are registered even in
+    // processes that have not issued a remote request yet: the snapshot's
+    // metric SET must be stable, not dependent on call order
+    dct::io::GlobalIoStats();
+    const std::string s = dct::telemetry::SnapshotJson();
+    char* buf = new char[s.size() + 1];
+    std::memcpy(buf, s.c_str(), s.size() + 1);
+    *out = buf;
+  });
+}
+
+// Zero every registered metric (owned and adopted-external alike).
+int dct_telemetry_reset() {
+  return Guard([&] { dct::telemetry::Reset(); });
+}
+
+// Runtime override of the DMLC_TELEMETRY gate for timed spans (counters
+// keep counting either way — they are cheaper than the branch).
+int dct_telemetry_enable(int on) {
+  return Guard([&] { dct::telemetry::SetEnabled(on != 0); });
 }
 
 // ----------------------------------------------------------- io resilience --

@@ -136,7 +136,7 @@ class WebHdfsReadStream : public RetryingHttpReadStream {
     // follow namenode -> datanode redirects (bounded; gateways may serve
     // the body directly with 200)
     for (int hop = 0; hop < 5; ++hop) {
-      conn_.reset(new HttpConnection(ResolveHttpRoute(scheme, host, port)));
+      conn_.reset(new HttpConnection(ResolveHttpRoute(scheme, host, port, "webhdfs")));
       conn_->SendRequest("GET", path, AuthHeaders(cfg_), "");
       HttpResponse head;
       conn_->ReadResponseHead(&head);
@@ -224,14 +224,14 @@ class WebHdfsWriteStream : public Stream {
     // step 1: namenode; expect redirect to a datanode (send no body, per
     // the WebHDFS two-step protocol)
     HttpResponse head = HttpRequest(
-        ResolveHttpRoute(target_.scheme, target_.host, target_.port), method,
+        ResolveHttpRoute(target_.scheme, target_.host, target_.port, "webhdfs"), method,
         path, AuthHeaders(cfg_), "");
     if (head.status == 307 || head.status == 302) {
       auto it = head.headers.find("location");
       DCT_CHECK(it != head.headers.end())
           << "webhdfs redirect without Location header";
       webhdfs::HttpUrl next = webhdfs::ParseHttpUrl(it->second);
-      head = HttpRequest(ResolveHttpRoute(next.scheme, next.host, next.port),
+      head = HttpRequest(ResolveHttpRoute(next.scheme, next.host, next.port, "webhdfs"),
                          method, next.path_query, AuthHeaders(cfg_), part);
     } else if (head.status >= 200 && head.status < 300 && !part.empty()) {
       // One-step gateway (HttpFS style): the empty step-1 request was
@@ -239,7 +239,7 @@ class WebHdfsWriteStream : public Stream {
       // with the body: CREATE&overwrite=true is idempotent and the empty
       // APPEND appended nothing, so exactly one copy of `part` lands.
       head = HttpRequest(
-          ResolveHttpRoute(target_.scheme, target_.host, target_.port),
+          ResolveHttpRoute(target_.scheme, target_.host, target_.port, "webhdfs"),
           method, path, AuthHeaders(cfg_), part);
     }
     CheckStatus(head, created_ ? 200 : 201,
@@ -299,7 +299,7 @@ FileInfo WebHdfsFileSystem::PathInfoUnderPolicy(
   std::string p = webhdfs::OpPath(cfg, path.path, "GETFILESTATUS", "");
   // metadata ops ride the shared resilience policy (idempotent GET)
   HttpResponse resp = RetryingHttpRequest(
-      ResolveHttpRoute(t.scheme, t.host, t.port), "GET", p,
+      ResolveHttpRoute(t.scheme, t.host, t.port, "webhdfs"), "GET", p,
       webhdfs::AuthHeaders(cfg), "", policy);
   webhdfs::CheckStatus(resp, 200, "GETFILESTATUS", path);
   FileInfo info;
@@ -324,7 +324,7 @@ void WebHdfsFileSystem::ListDirectory(const URI& path,
   webhdfs::Target t = webhdfs::ResolveTarget(cfg, path);
   std::string p = webhdfs::OpPath(cfg, path.path, "LISTSTATUS", "");
   HttpResponse resp = RetryingHttpRequest(
-      ResolveHttpRoute(t.scheme, t.host, t.port), "GET", p,
+      ResolveHttpRoute(t.scheme, t.host, t.port, "webhdfs"), "GET", p,
       webhdfs::AuthHeaders(cfg), "", cfg.retry);
   webhdfs::CheckStatus(resp, 200, "LISTSTATUS", path);
   std::string dir = path.path.empty() ? "/" : path.path;

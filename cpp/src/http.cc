@@ -15,6 +15,7 @@
 #include <mutex>
 
 #include "retry.h"
+#include "telemetry.h"
 
 namespace dct {
 
@@ -125,13 +126,17 @@ int ConnectSocket(const std::string& host, int port) {
 
 HttpConnection::HttpConnection(const std::string& host, int port)
     : default_host_header_(port == 80 ? host
-                                      : host + ":" + std::to_string(port)) {
+                                      : host + ":" + std::to_string(port)),
+      io_hists_(telemetry::IoHistsFor("http")) {
+  telemetry::ScopedTimerUs t(io_hists_->connect_us);
   fd_ = ConnectSocket(host, port);
 }
 
 HttpConnection::HttpConnection(const HttpRoute& route)
     : default_host_header_(route.host_header),
-      path_prefix_(route.path_prefix) {
+      path_prefix_(route.path_prefix),
+      io_hists_(telemetry::IoHistsFor(route.backend)) {
+  telemetry::ScopedTimerUs t(io_hists_->connect_us);
   fd_ = ConnectSocket(route.connect_host, route.connect_port);
 }
 
@@ -170,6 +175,11 @@ void HttpConnection::SendRequest(
     DCT_CHECK(n > 0) << "http send failed";
     sent += static_cast<size_t>(n);
   }
+  // anchor for the time-to-first-header-byte span (ReadResponseHead)
+  if (telemetry::Enabled()) {
+    request_sent_us_ = telemetry::NowUs();
+    ttfb_observed_ = false;
+  }
 }
 
 size_t HttpConnection::RawRead(void* buf, size_t size) {
@@ -202,6 +212,11 @@ bool HttpConnection::ReadLine(std::string* line) {
 void HttpConnection::ReadResponseHead(HttpResponse* out) {
   std::string line;
   DCT_CHECK(ReadLine(&line)) << "empty http response";
+  // first response bytes are in: observe time-to-first-byte once per request
+  if (!ttfb_observed_ && request_sent_us_ != 0 && telemetry::Enabled()) {
+    ttfb_observed_ = true;
+    io_hists_->ttfb_us->Observe(telemetry::NowUs() - request_sent_us_);
+  }
   // "HTTP/1.1 200 OK"
   size_t sp = line.find(' ');
   DCT_CHECK(sp != std::string::npos) << "bad http status line: " << line;
@@ -225,6 +240,9 @@ void HttpConnection::ReadResponseHead(HttpResponse* out) {
 
 size_t HttpConnection::ReadBody(void* buf, size_t size) {
   if (body_done_) return 0;
+  // one span per body pull (~16-64 KB granularity — two clock reads per
+  // call, never per byte); both branches below RawRead inside it
+  telemetry::ScopedTimerUs recv_span(io_hists_->recv_us);
   if (chunked_) {
     if (chunk_remaining_ == 0) {
       std::string line;
@@ -359,8 +377,9 @@ std::string TlsProxyAddress() {
 }
 
 HttpRoute ResolveHttpRoute(const std::string& scheme, const std::string& host,
-                           int port) {
+                           int port, const std::string& backend) {
   HttpRoute r;
+  r.backend = backend;
   r.host_header = DefaultHostHeader(scheme, host, port);
   if (scheme != "https") {
     r.connect_host = host;

@@ -19,6 +19,10 @@
 
 namespace dct {
 
+namespace telemetry {
+struct IoHists;  // per-backend io latency histograms (telemetry.h)
+}  // namespace telemetry
+
 struct HttpResponse {
   int status = 0;
   std::map<std::string, std::string> headers;  // lower-cased keys
@@ -62,12 +66,17 @@ struct HttpRoute {
   int connect_port = 0;
   std::string path_prefix;  // "" direct; "https://host[:port]" via helper
   std::string host_header;  // origin Host (survives the helper unchanged)
+  // telemetry label for the backend issuing requests along this route
+  // ("s3"/"azure"/"webhdfs"/"http"); selects the io_{connect,ttfb,recv}_us
+  // histogram set (telemetry.h IoHistsFor)
+  std::string backend = "http";
 };
 
 // Resolve (scheme, host, port) to a route. Throws for https origins when
 // no TLS helper is published (the built-in socket client is plain-HTTP).
+// `backend` tags the route's telemetry label (HttpRoute::backend).
 HttpRoute ResolveHttpRoute(const std::string& scheme, const std::string& host,
-                           int port);
+                           int port, const std::string& backend = "http");
 
 // Publish the TLS helper address ("host:port"; empty clears) explicitly —
 // the race-free alternative to mutating DCT_TLS_PROXY after native threads
@@ -123,6 +132,11 @@ class HttpConnection {
   bool chunked_ = false;
   int64_t chunk_remaining_ = 0;
   bool body_done_ = false;
+  // per-backend latency histograms (telemetry.h): connect is observed by
+  // the ctor, ttfb by the first ReadResponseHead line, recv per ReadBody
+  const telemetry::IoHists* io_hists_ = nullptr;
+  uint64_t request_sent_us_ = 0;  // end of SendRequest (ttfb anchor)
+  bool ttfb_observed_ = false;
 };
 
 // One-shot request helper.

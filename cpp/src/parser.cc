@@ -15,10 +15,43 @@
 #include "parameter.h"
 #include "recordio.h"
 #include "registry.h"
+#include "telemetry.h"
 
 namespace dct {
 
 namespace {
+
+// Process-wide pipeline telemetry (telemetry.h): totals across every
+// PipelinedParser instance plus per-stage latency histograms. The
+// per-handle ParsePipelineStats struct stays the per-parser view; these
+// are what dct_telemetry_snapshot / /metrics serve. Pointers resolved
+// once (registry lookup), then every touch is one relaxed atomic op.
+struct PipeTelemetry {
+  telemetry::Counter* chunks_read;
+  telemetry::Counter* blocks_delivered;
+  telemetry::Counter* reader_waits;
+  telemetry::Counter* worker_waits;
+  telemetry::Counter* consumer_waits;
+  telemetry::Hist* fill_us;             // ReadChunk (source -> owned bytes)
+  telemetry::Hist* scan_us;             // TileCuts slice pre-tiling
+  telemetry::Hist* parse_us;            // one worker slice decode
+  telemetry::Hist* reassemble_wait_us;  // consumer head-of-line wait
+};
+
+const PipeTelemetry& PipeTel() {
+  static const PipeTelemetry t = {
+      telemetry::GetCounter("parse_chunks_read_total"),
+      telemetry::GetCounter("parse_blocks_delivered_total"),
+      telemetry::GetCounter("parse_reader_waits_total"),
+      telemetry::GetCounter("parse_worker_waits_total"),
+      telemetry::GetCounter("parse_consumer_waits_total"),
+      telemetry::GetHist("parse_stage_fill_us"),
+      telemetry::GetHist("parse_stage_scan_us"),
+      telemetry::GetHist("parse_stage_parse_us"),
+      telemetry::GetHist("parse_stage_reassemble_wait_us"),
+  };
+  return t;
+}
 
 // Skip blanks; a '#' means the rest of the line is a comment
 // (reference libsvm_parser.h IgnoreCommentAndBlank).
@@ -1174,6 +1207,7 @@ void PipelinedParser<IndexType>::ReaderLoop() {
         std::unique_lock<std::mutex> lk(mu_);
         if (inflight_.size() >= capacity_) {
           reader_waits_.fetch_add(1, std::memory_order_relaxed);
+          PipeTel().reader_waits->Add(1);
           space_cv_.wait(lk, [&] {
             return stop_ || inflight_.size() < capacity_;
           });
@@ -1187,7 +1221,10 @@ void PipelinedParser<IndexType>::ReaderLoop() {
       if (t == nullptr) t = new ChunkTask();
       bool more;
       try {
-        more = base_->ReadChunk(&t->data);
+        {
+          telemetry::ScopedTimerUs fill_span(PipeTel().fill_us);
+          more = base_->ReadChunk(&t->data);
+        }
         if (more) {
           const int nslice = base_->SlicesFor(t->data.size());
           t->nslice = nslice;
@@ -1200,6 +1237,7 @@ void PipelinedParser<IndexType>::ReaderLoop() {
             t->blocks.resize(nslice);
           }
           t->errors.assign(nslice, nullptr);
+          telemetry::ScopedTimerUs scan_span(PipeTel().scan_us);
           base_->TileCuts(t->data.data(), t->data.data() + t->data.size(),
                           nslice, &t->cuts);
         }
@@ -1226,6 +1264,7 @@ void PipelinedParser<IndexType>::ReaderLoop() {
         inflight_.push_back(t);
         claim_.push_back(t);
         chunks_read_.fetch_add(1, std::memory_order_relaxed);
+        PipeTel().chunks_read->Add(1);
         inflight_sum_.fetch_add(inflight_.size(),
                                 std::memory_order_relaxed);
         // single writer (this thread, under mu_); atomic only for the
@@ -1253,6 +1292,7 @@ void PipelinedParser<IndexType>::WorkerLoop() {
       std::unique_lock<std::mutex> lk(mu_);
       if (claim_.empty() && !stop_) {
         worker_waits_.fetch_add(1, std::memory_order_relaxed);
+        PipeTel().worker_waits->Add(1);
         work_cv_.wait(lk, [&] { return stop_ || !claim_.empty(); });
       }
       if (stop_) return;
@@ -1263,6 +1303,7 @@ void PipelinedParser<IndexType>::WorkerLoop() {
       if (t->next_slice == t->nslice) claim_.pop_front();
     }
     try {
+      telemetry::ScopedTimerUs parse_span(PipeTel().parse_us);
       RowBlockContainer<IndexType>* out = &t->blocks[slice];
       base_->ParseBlock(t->cuts[slice], t->cuts[slice + 1], out);
       ValidateBlock(*out);
@@ -1310,6 +1351,7 @@ RowBlockContainer<IndexType>* PipelinedParser<IndexType>::NextMutable() {
         RowBlockContainer<IndexType>* b = &current_->blocks[i];
         if (b->Size() != 0) {
           blocks_delivered_.fetch_add(1, std::memory_order_relaxed);
+          PipeTel().blocks_delivered->Add(1);
           return b;
         }
       }
@@ -1318,6 +1360,8 @@ RowBlockContainer<IndexType>* PipelinedParser<IndexType>::NextMutable() {
     {
       std::unique_lock<std::mutex> lk(mu_);
       bool waited = false;
+      const uint64_t wait_from =
+          telemetry::Enabled() ? telemetry::NowUs() : 0;
       done_cv_.wait(lk, [&] {
         if (stop_) return true;
         if (!inflight_.empty()) {
@@ -1329,7 +1373,14 @@ RowBlockContainer<IndexType>* PipelinedParser<IndexType>::NextMutable() {
         waited = true;
         return false;
       });
-      if (waited) consumer_waits_.fetch_add(1, std::memory_order_relaxed);
+      if (waited) {
+        consumer_waits_.fetch_add(1, std::memory_order_relaxed);
+        PipeTel().consumer_waits->Add(1);
+        if (wait_from != 0) {
+          PipeTel().reassemble_wait_us->Observe(telemetry::NowUs() -
+                                                wait_from);
+        }
+      }
       if (!inflight_.empty() && inflight_.front()->remaining == 0) {
         current_ = inflight_.front();
         inflight_.pop_front();

@@ -9,6 +9,8 @@
 #include <thread>
 #include <vector>
 
+#include "telemetry.h"
+
 namespace dct {
 namespace io {
 
@@ -192,8 +194,26 @@ bool RetryController::BackoffOrGiveUp() {
 
 // ----------------------------------------------------------------- stats --
 IoStats& GlobalIoStats() {
-  static IoStats stats;
-  return stats;
+  // Migrated into the process-wide telemetry registry (telemetry.h): the
+  // atomics stay HERE (every retry/timeout site keeps its one relaxed
+  // fetch_add), but the registry adopts them as external counters under
+  // their canonical names, so dct_telemetry_snapshot / /metrics serve the
+  // same storage dct_io_retry_stats always has.
+  static IoStats* stats = [] {
+    auto* s = new IoStats();
+    telemetry::RegisterExternalCounter("io_requests_total", &s->requests);
+    telemetry::RegisterExternalCounter("io_retries_total", &s->retries);
+    telemetry::RegisterExternalCounter("io_backoff_ms_total",
+                                       &s->backoff_ms_total);
+    telemetry::RegisterExternalCounter("io_timeouts_total", &s->timeouts);
+    telemetry::RegisterExternalCounter("io_faults_injected_total",
+                                       &s->faults_injected);
+    telemetry::RegisterExternalCounter("io_giveups_total", &s->giveups);
+    telemetry::RegisterExternalCounter("io_deadline_exhausted_total",
+                                       &s->deadline_exhausted);
+    return s;
+  }();
+  return *stats;
 }
 
 void ResetIoStats() {

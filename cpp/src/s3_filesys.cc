@@ -196,7 +196,7 @@ Target ResolveTarget(const S3Config& cfg, const std::string& bucket) {
 
 // Socket route for a resolved target (via the TLS helper for https).
 HttpRoute RouteOf(const S3Config& cfg, const Target& t) {
-  return ResolveHttpRoute(cfg.scheme, t.host, t.port);
+  return ResolveHttpRoute(cfg.scheme, t.host, t.port, "s3");
 }
 
 std::map<std::string, std::string> SignedHeaders(

@@ -1,0 +1,253 @@
+// Telemetry registry implementation (see telemetry.h).
+#include "telemetry.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <sstream>
+#include <vector>
+
+namespace dct {
+namespace telemetry {
+
+namespace {
+
+std::atomic<int> g_enabled{-1};  // -1: unresolved (read env on first use)
+
+struct CounterEntry {
+  std::string name;
+  Counter owned;
+  std::atomic<uint64_t>* external = nullptr;  // wins over `owned` when set
+  uint64_t value() const {
+    return external != nullptr
+               ? external->load(std::memory_order_relaxed)
+               : owned.value();
+  }
+  void Zero() {
+    if (external != nullptr) {
+      external->store(0, std::memory_order_relaxed);
+    } else {
+      owned.Zero();
+    }
+  }
+};
+
+struct GaugeEntry {
+  std::string name;
+  Gauge gauge;
+};
+
+struct HistEntry {
+  std::string name;
+  std::map<std::string, std::string> labels;
+  Hist hist;
+};
+
+// Entries live in deques for pointer stability and are never removed; the
+// mutex guards registration and the snapshot/reset walks only.
+struct Registry {
+  std::mutex mu;
+  std::deque<CounterEntry> counters;
+  std::deque<GaugeEntry> gauges;
+  std::deque<HistEntry> hists;
+};
+
+Registry& Reg() {
+  static Registry* r = new Registry();  // leaked: outlive every static dtor
+  return *r;
+}
+
+void EscapeJson(const std::string& s, std::string* out) {
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          *out += c;
+        }
+    }
+  }
+}
+
+void AppendNameLabels(const std::string& name,
+                      const std::map<std::string, std::string>& labels,
+                      std::string* out) {
+  *out += "\"name\":\"";
+  EscapeJson(name, out);
+  *out += "\",\"labels\":{";
+  bool first = true;
+  for (const auto& kv : labels) {
+    if (!first) *out += ',';
+    first = false;
+    *out += '"';
+    EscapeJson(kv.first, out);
+    *out += "\":\"";
+    EscapeJson(kv.second, out);
+    *out += '"';
+  }
+  *out += '}';
+}
+
+}  // namespace
+
+bool Enabled() {
+  int v = g_enabled.load(std::memory_order_relaxed);
+  if (v < 0) {
+    const char* env = std::getenv("DMLC_TELEMETRY");
+    v = (env != nullptr &&
+         (std::strcmp(env, "0") == 0 || std::strcmp(env, "off") == 0))
+            ? 0
+            : 1;
+    g_enabled.store(v, std::memory_order_relaxed);
+  }
+  return v != 0;
+}
+
+void SetEnabled(bool on) {
+  g_enabled.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+Counter* GetCounter(const std::string& name) {
+  Registry& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  for (auto& e : r.counters) {
+    // an externally-backed entry still hands out its owned counter: adds
+    // to it are shadowed in the snapshot (external wins), never a crash
+    if (e.name == name) return &e.owned;
+  }
+  r.counters.emplace_back();
+  r.counters.back().name = name;
+  return &r.counters.back().owned;
+}
+
+void RegisterExternalCounter(const std::string& name,
+                             std::atomic<uint64_t>* v) {
+  Registry& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  for (auto& e : r.counters) {
+    if (e.name == name) {
+      e.external = v;
+      return;
+    }
+  }
+  r.counters.emplace_back();
+  r.counters.back().name = name;
+  r.counters.back().external = v;
+}
+
+Gauge* GetGauge(const std::string& name) {
+  Registry& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  for (auto& e : r.gauges) {
+    if (e.name == name) return &e.gauge;
+  }
+  r.gauges.emplace_back();
+  r.gauges.back().name = name;
+  return &r.gauges.back().gauge;
+}
+
+Hist* GetHist(const std::string& name,
+              const std::map<std::string, std::string>& labels) {
+  Registry& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  for (auto& e : r.hists) {
+    if (e.name == name && e.labels == labels) return &e.hist;
+  }
+  r.hists.emplace_back();
+  r.hists.back().name = name;
+  r.hists.back().labels = labels;
+  return &r.hists.back().hist;
+}
+
+const IoHists* IoHistsFor(const std::string& backend) {
+  // small leaked cache: one IoHists per backend, resolved under its own
+  // mutex (called once per HttpConnection, never per byte)
+  static std::mutex* mu = new std::mutex();
+  static std::map<std::string, IoHists>* cache =
+      new std::map<std::string, IoHists>();
+  std::lock_guard<std::mutex> lk(*mu);
+  auto it = cache->find(backend);
+  if (it != cache->end()) return &it->second;
+  std::map<std::string, std::string> labels{{"backend", backend}};
+  IoHists h;
+  h.connect_us = GetHist("io_connect_us", labels);
+  h.ttfb_us = GetHist("io_ttfb_us", labels);
+  h.recv_us = GetHist("io_recv_us", labels);
+  return &((*cache)[backend] = h);
+}
+
+std::string SnapshotJson() {
+  Registry& r = Reg();
+  std::string out;
+  out.reserve(4096);
+  out += "{\"version\":";
+  out += std::to_string(kSnapshotVersion);
+  out += ",\"enabled\":";
+  out += Enabled() ? "true" : "false";
+  out += ",\"counters\":[";
+  {
+    std::lock_guard<std::mutex> lk(r.mu);
+    bool first = true;
+    for (const auto& e : r.counters) {
+      if (!first) out += ',';
+      first = false;
+      out += '{';
+      AppendNameLabels(e.name, {}, &out);
+      out += ",\"value\":";
+      out += std::to_string(e.value());
+      out += '}';
+    }
+    out += "],\"gauges\":[";
+    first = true;
+    for (const auto& e : r.gauges) {
+      if (!first) out += ',';
+      first = false;
+      out += '{';
+      AppendNameLabels(e.name, {}, &out);
+      out += ",\"value\":";
+      out += std::to_string(e.gauge.value());
+      out += '}';
+    }
+    out += "],\"histograms\":[";
+    first = true;
+    for (const auto& e : r.hists) {
+      if (!first) out += ',';
+      first = false;
+      out += '{';
+      AppendNameLabels(e.name, e.labels, &out);
+      out += ",\"count\":";
+      out += std::to_string(e.hist.count());
+      out += ",\"sum\":";
+      out += std::to_string(e.hist.sum());
+      out += ",\"buckets\":[";
+      for (int i = 0; i <= kHistBuckets; ++i) {
+        if (i) out += ',';
+        out += std::to_string(e.hist.bucket(i));
+      }
+      out += "]}";
+    }
+  }
+  out += "]}";
+  return out;
+}
+
+void Reset() {
+  Registry& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  for (auto& e : r.counters) e.Zero();
+  for (auto& e : r.gauges) e.gauge.Zero();
+  for (auto& e : r.hists) e.hist.Zero();
+}
+
+}  // namespace telemetry
+}  // namespace dct

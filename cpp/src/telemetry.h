@@ -1,0 +1,168 @@
+// Process-wide telemetry registry: one metrics plane for the native core.
+//
+// Before this layer the repo grew three disjoint observability side-channels
+// (IoStats counters in retry.h, per-parser ParsePipelineStats, the Python
+// tracker's ad-hoc event list) that shared no naming, no units, and no reset
+// semantics. This registry is the single source the C ABI
+// (dct_telemetry_snapshot), dmlc_core_tpu.telemetry.snapshot(), and the
+// tracker's HTTP /metrics scrape all read from.
+//
+// Design rules:
+//   - NO locks on the hot path. Counters/gauges/histogram buckets are plain
+//     relaxed atomics; the registry mutex guards only metric REGISTRATION
+//     (first lookup of a name) and the snapshot's walk of the entry list.
+//     Metric objects are pointer-stable forever (never unregistered), so a
+//     site resolves its pointer once and then only does atomic adds.
+//   - Histograms are fixed-bucket log2: bucket i counts observations
+//     v <= 2^i (i = 0..kHistBuckets-1), plus one overflow (+Inf) bucket.
+//     Units are microseconds for every *_us histogram. Non-cumulative
+//     counts are stored; exposition layers cumulate for Prometheus.
+//   - DMLC_TELEMETRY=0 (or dct_telemetry_enable(0)) disables timed spans:
+//     Enabled() is one relaxed atomic load, checked before any clock read.
+//     Pure counters keep counting — they are cheaper than the branch.
+//   - The snapshot is a stable, versioned JSON document (kSnapshotVersion);
+//     fields are append-only across releases.
+//
+// Existing stats surfaces migrate in rather than duplicate: retry.cc
+// registers the IoStats atomics as external counters (same storage, new
+// canonical names), and parser.cc feeds process-wide pipeline counters and
+// per-stage latency histograms alongside its per-handle struct.
+#ifndef DCT_TELEMETRY_H_
+#define DCT_TELEMETRY_H_
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <map>
+#include <string>
+
+namespace dct {
+namespace telemetry {
+
+constexpr int kSnapshotVersion = 1;
+
+// ---------------------------------------------------------------- enable --
+// Span (clock-reading) instrumentation gate: DMLC_TELEMETRY env at first
+// use (default on), overridable at runtime through the C ABI
+// (dct_telemetry_enable). One relaxed load.
+bool Enabled();
+void SetEnabled(bool on);
+
+// ---------------------------------------------------------------- metrics --
+class Counter {
+ public:
+  void Add(uint64_t n = 1) { v_.fetch_add(n, std::memory_order_relaxed); }
+  uint64_t value() const { return v_.load(std::memory_order_relaxed); }
+  void Zero() { v_.store(0, std::memory_order_relaxed); }
+
+ private:
+  std::atomic<uint64_t> v_{0};
+};
+
+class Gauge {
+ public:
+  void Set(int64_t v) { v_.store(v, std::memory_order_relaxed); }
+  int64_t value() const { return v_.load(std::memory_order_relaxed); }
+  void Zero() { v_.store(0, std::memory_order_relaxed); }
+
+ private:
+  std::atomic<int64_t> v_{0};
+};
+
+// log2 latency histogram; all writers relaxed-atomic, safe from any thread
+constexpr int kHistBuckets = 28;  // le 1,2,4,...,2^27 us (~134 s), then +Inf
+
+class Hist {
+ public:
+  void Observe(uint64_t v) {
+    count_.fetch_add(1, std::memory_order_relaxed);
+    sum_.fetch_add(v, std::memory_order_relaxed);
+    buckets_[BucketOf(v)].fetch_add(1, std::memory_order_relaxed);
+  }
+  // first bucket whose upper bound 2^i holds v; kHistBuckets = overflow
+  static int BucketOf(uint64_t v) {
+    if (v <= 1) return 0;
+    int w = 64 - __builtin_clzll(v - 1);  // ceil(log2(v))
+    return w < kHistBuckets ? w : kHistBuckets;
+  }
+  uint64_t count() const { return count_.load(std::memory_order_relaxed); }
+  uint64_t sum() const { return sum_.load(std::memory_order_relaxed); }
+  uint64_t bucket(int i) const {
+    return buckets_[i].load(std::memory_order_relaxed);
+  }
+  void Zero() {
+    count_.store(0, std::memory_order_relaxed);
+    sum_.store(0, std::memory_order_relaxed);
+    for (auto& b : buckets_) b.store(0, std::memory_order_relaxed);
+  }
+
+ private:
+  std::atomic<uint64_t> count_{0};
+  std::atomic<uint64_t> sum_{0};
+  std::atomic<uint64_t> buckets_[kHistBuckets + 1] = {};
+};
+
+// --------------------------------------------------------------- registry --
+// Resolve-or-register by (name, labels). Returned pointers are stable for
+// the process lifetime; resolve once, keep the pointer. Names follow the
+// Prometheus convention (snake_case, *_total counters, unit suffix).
+Counter* GetCounter(const std::string& name);
+Gauge* GetGauge(const std::string& name);
+Hist* GetHist(const std::string& name,
+              const std::map<std::string, std::string>& labels = {});
+
+// Adopt an atomic that lives elsewhere (the IoStats migration path: the
+// storage stays where its writers already are, the registry snapshots and
+// resets it). The atomic must outlive the process' last snapshot.
+void RegisterExternalCounter(const std::string& name,
+                             std::atomic<uint64_t>* v);
+
+// The versioned JSON document every surface serves (schema documented in
+// doc/observability.md): {"version","enabled","counters":[{name,labels,
+// value}],"gauges":[...],"histograms":[{name,labels,count,sum,buckets}]}.
+std::string SnapshotJson();
+
+// Zero every registered metric (owned and external).
+void Reset();
+
+// -------------------------------------------------------------- io spans --
+// Per-backend remote-I/O latency histograms (connect / time-to-first-
+// header-byte / per-ReadBody recv), labeled {backend="s3"|...}. Resolved
+// once per HttpConnection (one connection per request), cached per backend.
+struct IoHists {
+  Hist* connect_us;
+  Hist* ttfb_us;
+  Hist* recv_us;
+};
+const IoHists* IoHistsFor(const std::string& backend);
+
+// ----------------------------------------------------------------- timing --
+inline uint64_t NowUs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+// Observe the scope's wall time into `h` (microseconds); both the clock
+// reads and the observe vanish when telemetry is disabled or h is null.
+class ScopedTimerUs {
+ public:
+  explicit ScopedTimerUs(Hist* h) : h_(Enabled() ? h : nullptr) {
+    if (h_ != nullptr) start_ = NowUs();
+  }
+  ~ScopedTimerUs() {
+    if (h_ != nullptr) h_->Observe(NowUs() - start_);
+  }
+  ScopedTimerUs(const ScopedTimerUs&) = delete;
+  ScopedTimerUs& operator=(const ScopedTimerUs&) = delete;
+
+ private:
+  Hist* h_;
+  uint64_t start_ = 0;
+};
+
+}  // namespace telemetry
+}  // namespace dct
+
+#endif  // DCT_TELEMETRY_H_

@@ -47,6 +47,7 @@
 #include "../src/s3_filesys.h"
 #include "../src/serializer.h"
 #include "../src/stream.h"
+#include "../src/telemetry.h"
 
 namespace {
 
@@ -1864,12 +1865,196 @@ void RunIoResilienceSuite() {
   dct::io::ResetIoStats();
 }
 
+// ---- telemetry registry (telemetry.h) -- the `--telemetry` suite ---------
+// Run standalone (test_core --telemetry) by the cpp/Makefile
+// tsan-telemetry lane: concurrent metric writers against snapshot/reset
+// walkers is the registry's race surface.
+
+void TestHistBucketBoundaries() {
+  using dct::telemetry::Hist;
+  using dct::telemetry::kHistBuckets;
+  // bucket i holds v <= 2^i: exact powers stay in their own bucket,
+  // power+1 spills into the next
+  EXPECT(Hist::BucketOf(0) == 0);
+  EXPECT(Hist::BucketOf(1) == 0);
+  EXPECT(Hist::BucketOf(2) == 1);
+  EXPECT(Hist::BucketOf(3) == 2);
+  EXPECT(Hist::BucketOf(4) == 2);
+  EXPECT(Hist::BucketOf(5) == 3);
+  EXPECT(Hist::BucketOf(1024) == 10);
+  EXPECT(Hist::BucketOf(1025) == 11);
+  EXPECT(Hist::BucketOf(1ull << (kHistBuckets - 1)) == kHistBuckets - 1);
+  EXPECT(Hist::BucketOf((1ull << (kHistBuckets - 1)) + 1) == kHistBuckets);
+  EXPECT(Hist::BucketOf(~0ull) == kHistBuckets);  // overflow -> +Inf
+
+  Hist h;
+  h.Observe(1);
+  h.Observe(3);
+  h.Observe(1ull << 40);  // overflow bucket
+  EXPECT(h.count() == 3);
+  EXPECT(h.sum() == 1 + 3 + (1ull << 40));
+  EXPECT(h.bucket(0) == 1);
+  EXPECT(h.bucket(2) == 1);
+  EXPECT(h.bucket(kHistBuckets) == 1);
+  uint64_t total = 0;
+  for (int i = 0; i <= kHistBuckets; ++i) total += h.bucket(i);
+  EXPECT(total == h.count());  // every observation lands in one bucket
+  h.Zero();
+  EXPECT(h.count() == 0 && h.sum() == 0 && h.bucket(0) == 0);
+}
+
+void TestTelemetryRegistryAndSnapshot() {
+  namespace tl = dct::telemetry;
+  tl::Counter* c = tl::GetCounter("test_snapshot_counter_total");
+  EXPECT(c == tl::GetCounter("test_snapshot_counter_total"));  // stable
+  c->Add(7);
+  tl::Gauge* g = tl::GetGauge("test_snapshot_gauge");
+  g->Set(-3);
+  tl::Hist* h = tl::GetHist("test_snapshot_us", {{"backend", "t\"est"}});
+  h->Observe(5);
+  static std::atomic<uint64_t> ext{41};
+  tl::RegisterExternalCounter("test_snapshot_external_total", &ext);
+  ext.fetch_add(1);
+
+  const std::string s = tl::SnapshotJson();
+  // the document must parse as JSON (the Python side consumes it raw)
+  std::istringstream is(s);
+  dct::JSONReader r(&is);
+  std::map<std::string, int> seen;
+  r.BeginObject();
+  std::string key;
+  int version = 0;
+  while (r.NextObjectItem(&key)) {
+    seen[key] = 1;
+    if (key == "version") {
+      r.Read(&version);
+    } else if (key == "enabled") {
+      bool b;
+      r.Read(&b);
+    } else {
+      // counters/gauges/histograms arrays: skip through generically
+      r.SkipValue();
+    }
+  }
+  EXPECT(version == tl::kSnapshotVersion);
+  EXPECT(seen.count("counters") == 1);
+  EXPECT(seen.count("gauges") == 1);
+  EXPECT(seen.count("histograms") == 1);
+  EXPECT(s.find("\"test_snapshot_counter_total\"") != std::string::npos);
+  EXPECT(s.find("\"value\":7") != std::string::npos);
+  EXPECT(s.find("\"test_snapshot_gauge\"") != std::string::npos);
+  EXPECT(s.find("\"value\":-3") != std::string::npos);
+  EXPECT(s.find("\"test_snapshot_external_total\"") != std::string::npos);
+  EXPECT(s.find("\"value\":42") != std::string::npos);
+  // label values are JSON-escaped
+  EXPECT(s.find("\"backend\":\"t\\\"est\"") != std::string::npos);
+
+  tl::Reset();
+  EXPECT(c->value() == 0);
+  EXPECT(ext.load() == 0);  // external counters reset too
+  EXPECT(h->count() == 0);
+}
+
+void TestTelemetryEnabledGate() {
+  namespace tl = dct::telemetry;
+  tl::Hist* h = tl::GetHist("test_gate_us");
+  h->Zero();
+  tl::SetEnabled(false);
+  { tl::ScopedTimerUs t(h); }
+  EXPECT(h->count() == 0);  // disabled: no clock read, no observation
+  tl::SetEnabled(true);
+  { tl::ScopedTimerUs t(h); }
+  EXPECT(h->count() == 1);
+}
+
+void TestIoHistsPerBackend() {
+  namespace tl = dct::telemetry;
+  const tl::IoHists* s3 = tl::IoHistsFor("s3");
+  EXPECT(s3 == tl::IoHistsFor("s3"));  // cached, pointer-stable
+  const tl::IoHists* az = tl::IoHistsFor("azure");
+  EXPECT(s3->connect_us != az->connect_us);  // distinct label sets
+  s3->connect_us->Observe(9);
+  const std::string s = tl::SnapshotJson();
+  EXPECT(s.find("\"io_connect_us\"") != std::string::npos);
+  EXPECT(s.find("\"backend\":\"s3\"") != std::string::npos);
+  EXPECT(s.find("\"backend\":\"azure\"") != std::string::npos);
+  tl::Reset();
+}
+
+void TestTelemetryConcurrentWritersAndSnapshot() {
+  // the TSan target: writers ticking counters/hists + snapshotters walking
+  // the registry + a resetter zeroing mid-flight must all be race-free
+  namespace tl = dct::telemetry;
+  tl::Counter* c = tl::GetCounter("test_conc_total");
+  tl::Hist* h = tl::GetHist("test_conc_us");
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> writers;
+  for (int i = 0; i < 4; ++i) {
+    writers.emplace_back([&] {
+      for (int k = 0; k < 20000; ++k) {
+        c->Add(1);
+        h->Observe(static_cast<uint64_t>(k));
+        // registration races registration: same names resolve to the
+        // same objects from every thread
+        tl::GetCounter("test_conc_total")->Add(0);
+      }
+    });
+  }
+  std::vector<std::thread> readers;
+  for (int i = 0; i < 2; ++i) {
+    readers.emplace_back([&] {
+      while (!stop.load(std::memory_order_relaxed)) {
+        const std::string s = tl::SnapshotJson();
+        EXPECT(!s.empty());
+      }
+    });
+  }
+  std::thread resetter([&] {
+    while (!stop.load(std::memory_order_relaxed)) {
+      tl::Reset();
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  for (auto& w : writers) w.join();
+  stop.store(true);
+  for (auto& r : readers) r.join();
+  resetter.join();
+  // quiesced determinism: after a final reset + known adds, the snapshot
+  // reflects exactly those adds
+  tl::Reset();
+  c->Add(5);
+  EXPECT(c->value() == 5);
+  const std::string s = tl::SnapshotJson();
+  EXPECT(s.find("\"test_conc_total\"") != std::string::npos);
+  tl::Reset();
+}
+
+void RunTelemetrySuite() {
+  TestHistBucketBoundaries();
+  TestTelemetryRegistryAndSnapshot();
+  TestTelemetryEnabledGate();
+  TestIoHistsPerBackend();
+  TestTelemetryConcurrentWritersAndSnapshot();
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
   if (argc > 1 && std::string(argv[1]) == "--stdin") {
     TestStdinSplit();
     return 0;
+  }
+  if (argc > 1 && std::string(argv[1]) == "--telemetry") {
+    // the telemetry-registry suite alone — the cpp/Makefile tsan-telemetry
+    // lane runs exactly this under ThreadSanitizer (concurrent writers +
+    // snapshot/reset walkers)
+    RunTelemetrySuite();
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
   }
   if (argc > 1 && std::string(argv[1]) == "--io") {
     // the remote-I/O resilience suite alone — the cpp/Makefile tsan-io
@@ -1941,6 +2126,7 @@ int main(int argc, char** argv) {
   TestThreadedRecParse();
   RunParseSimdSuite();
   RunIoResilienceSuite();
+  RunTelemetrySuite();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;

@@ -21,10 +21,12 @@ Differences from the reference are deliberate:
 
 from __future__ import annotations
 
+import time
 from typing import BinaryIO, Callable, Dict, Iterator, Optional
 
 import numpy as np
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.base import DMLCError, log_info, log_warning
 from dmlc_core_tpu.io.native import NativeParser, RowBlock
 from dmlc_core_tpu.registry import Registry
@@ -300,6 +302,20 @@ PARSER_REGISTRY: Registry = Registry.get("data_parser")
 
 _NATIVE_FORMATS = ("libsvm", "csv", "libfm")
 
+# batch-path metric objects resolved ONCE (the registry contract: resolve,
+# keep the pointer — per-batch re-resolution would take the registry lock
+# on every pull); lazy so importing this module registers nothing
+_batch_metrics = None
+
+
+def _get_batch_metrics():
+    global _batch_metrics
+    if _batch_metrics is None:
+        _batch_metrics = (telemetry.histogram("rowblock_batch_us"),
+                          telemetry.counter("rowblock_batches_total"),
+                          telemetry.counter("rowblock_skipped_batches_total"))
+    return _batch_metrics
+
 
 def register_parser(name: str) -> Callable:
     """Register a custom format: factory(uri, part, npart, **kwargs) ->
@@ -397,15 +413,26 @@ class RowBlockIter:
     def _next_block_degradable(self):
         """next_block() honoring on_error: with "skip", a failing pull is
         retried on the next block up to _MAX_CONSECUTIVE_ERRORS times
-        before the source counts as exhausted (returns None)."""
+        before the source counts as exhausted (returns None). Each pull
+        feeds the unified telemetry plane: ``rowblock_batch_us`` latency,
+        ``rowblock_batches_total``, ``rowblock_skipped_batches_total``
+        (doc/observability.md)."""
         consecutive = 0
+        batch_us, batches, skips = _get_batch_metrics()
         while True:
             try:
-                return self._parser.next_block()
+                t0 = time.perf_counter() if telemetry.enabled() else None
+                b = self._parser.next_block()
+                if t0 is not None:
+                    batch_us.observe((time.perf_counter() - t0) * 1e6)
+                if b is not None:
+                    batches.inc()
+                return b
             except DMLCError as e:
                 if self._on_error != "skip":
                     raise
                 self.skipped_batches += 1
+                skips.inc()
                 self.last_error = str(e)
                 consecutive += 1
                 log_warning(
@@ -420,7 +447,6 @@ class RowBlockIter:
             # native block views are only valid until the next next_block()
             # call, so snapshot each into a single-block container, then
             # merge once (O(n) total)
-            import time
             blocks = []
             t0 = time.time()
             next_log = 10 << 20  # MB/s every 10 MB (basic_row_iter.h:70-73)

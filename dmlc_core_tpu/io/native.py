@@ -165,6 +165,9 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_webhdfs_set_delegation_token": [c.c_char_p],
         "dct_webhdfs_set_auth_header": [c.c_char_p],
         "dct_set_tls_proxy": [c.c_char_p],
+        "dct_telemetry_snapshot": [c.POINTER(c.c_char_p)],
+        "dct_telemetry_reset": [],
+        "dct_telemetry_enable": [i],
         "dct_io_retry_stats": [c.POINTER(IoRetryStatsC)],
         "dct_io_stats_reset": [],
         "dct_io_set_fault_plan": [c.c_char_p],
@@ -377,7 +380,50 @@ def parser_formats_doc() -> str:
         lib().dct_str_free(out)
 
 
+# -- telemetry ---------------------------------------------------------------
+def native_telemetry_snapshot() -> dict:
+    """The native registry's versioned snapshot document
+    (``dct_telemetry_snapshot``, cpp/src/telemetry.h): ``{"version",
+    "enabled", "counters": [{"name", "labels", "value"}], "gauges": [...],
+    "histograms": [{"name", "labels", "count", "sum", "buckets"}]}``.
+    Prefer :func:`dmlc_core_tpu.telemetry.snapshot`, which merges this
+    with the Python-side registry; metric catalog in
+    [observability.md](observability.md)."""
+    import json
+    out = ctypes.c_char_p()
+    _check(lib().dct_telemetry_snapshot(ctypes.byref(out)))
+    try:
+        return json.loads(ctypes.string_at(out).decode())
+    finally:
+        lib().dct_str_free(out)
+
+
+def native_telemetry_reset() -> None:
+    """Zero every metric in the native registry (owned and adopted IoStats
+    counters alike; ``dct_telemetry_reset``)."""
+    _check(lib().dct_telemetry_reset())
+
+
+def native_telemetry_enable(on: bool) -> None:
+    """Gate the native side's timed-span instrumentation at runtime
+    (``dct_telemetry_enable``; overrides DMLC_TELEMETRY). Counters keep
+    counting either way."""
+    _check(lib().dct_telemetry_enable(1 if on else 0))
+
+
 # -- remote-I/O resilience ---------------------------------------------------
+# legacy io_retry_stats() key -> canonical telemetry counter name
+_LEGACY_IO_STAT_NAMES = (
+    ("requests", "io_requests_total"),
+    ("retries", "io_retries_total"),
+    ("backoff_ms_total", "io_backoff_ms_total"),
+    ("timeouts", "io_timeouts_total"),
+    ("faults_injected", "io_faults_injected_total"),
+    ("giveups", "io_giveups_total"),
+    ("deadline_exhausted", "io_deadline_exhausted_total"),
+)
+
+
 def io_retry_stats() -> dict:
     """Process-global remote-I/O resilience counters (cpp/src/retry.h
     IoStats, shared by every s3/azure/hdfs/http request): ``requests``
@@ -386,10 +432,18 @@ def io_retry_stats() -> dict:
     expiries), ``faults_injected`` (fault-plan firings), ``giveups``
     (retry loops that exhausted their budget) and ``deadline_exhausted``
     (the subset of giveups caused by the per-operation deadline). See
-    [robustness.md](robustness.md) for the retry model."""
-    s = IoRetryStatsC()
-    _check(lib().dct_io_retry_stats(ctypes.byref(s)))
-    return {name: int(getattr(s, name)) for name, _ in s._fields_}
+    [robustness.md](robustness.md) for the retry model.
+
+    Deprecation shim (one release of back-compat): since the telemetry
+    layer these counters live in the unified registry under ``io_*_total``
+    names and this dict is a THIN VIEW over the native snapshot — same
+    storage, legacy key spelling. New code should read
+    ``dmlc_core_tpu.telemetry.snapshot()`` /
+    [observability.md](observability.md) instead."""
+    counters = {c["name"]: c["value"]
+                for c in native_telemetry_snapshot().get("counters", [])}
+    return {legacy: int(counters.get(name, 0))
+            for legacy, name in _LEGACY_IO_STAT_NAMES}
 
 
 def reset_io_retry_stats() -> None:
@@ -733,7 +787,14 @@ class NativeParser:
         (cpp/src/parser.h ParsePipelineStats), or None for threaded=False
         parsers. ``occupancy_avg`` is the mean chunks-in-flight sampled at
         each admit; high ``reader_waits`` means the consumer binds, high
-        ``consumer_waits`` means parsing binds."""
+        ``consumer_waits`` means parsing binds.
+
+        Back-compat note: this per-HANDLE struct stays, but the same
+        counters aggregate process-wide in the unified telemetry registry
+        (``parse_*_total``) alongside per-stage latency histograms
+        (``parse_stage_*_us``) — see
+        [observability.md](observability.md) and
+        ``dmlc_core_tpu.telemetry.snapshot()``."""
         s = ParsePipelineStatsC()
         has = ctypes.c_int()
         _check(lib().dct_parser_pipeline_stats(self._h, ctypes.byref(s),

@@ -1,0 +1,410 @@
+"""Unified telemetry: one metrics plane across C++, Python, and the tracker.
+
+Before this layer, observability lived in three disjoint side-channels —
+``io_retry_stats()`` (native IoStats counters), per-parser
+``pipeline_stats()`` structs, and the tracker's ad-hoc event list — with no
+shared naming, units, or reset semantics. This module is the Python half of
+the unified plane (the native half is ``cpp/src/telemetry.h``):
+
+- a process-wide registry of counters / gauges / log2-bucket latency
+  histograms (same bucket scheme as the native side: bucket *i* counts
+  observations ``v <= 2**i``, plus one +Inf overflow bucket);
+- :func:`snapshot` merges the Python registry with the native registry's
+  versioned JSON document (``dct_telemetry_snapshot``) into ONE document —
+  the same metric names and values are retrievable through the C ABI,
+  through this function, and through a live tracker's HTTP ``GET /metrics``
+  scrape;
+- two export formats from that one snapshot: Prometheus text exposition
+  (:func:`prometheus_text`) and the tracker's JSONL event schema
+  (:func:`events_jsonl` — tracker events are just another telemetry
+  stream, ring-buffered by :func:`emit_event`).
+
+Metric catalog, units, and env knobs: ``doc/observability.md``. Hot-path
+cost: Python metrics are touched at batch granularity (never per row), and
+:func:`enabled` gates timed spans; ``DMLC_TELEMETRY=0`` disables spans in
+both halves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "HIST_BUCKETS",
+           "SNAPSHOT_VERSION", "counter", "gauge", "histogram",
+           "register_collector", "unregister_collector", "enabled",
+           "enable", "reset", "emit_event", "events", "snapshot",
+           "prometheus_text", "events_jsonl"]
+
+SNAPSHOT_VERSION = 1
+# must match cpp/src/telemetry.h kHistBuckets (le 2^0..2^27, then +Inf)
+HIST_BUCKETS = 28
+
+_lock = threading.Lock()
+_counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "Counter"] = {}
+_gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "Gauge"] = {}
+_hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "Histogram"] = {}
+_collectors: List[Callable[[], None]] = []
+_events: List[dict] = []
+_EVENTS_MAX = 4096
+_enabled: Optional[bool] = None
+
+
+def _labels_key(labels: Optional[Dict[str, str]]
+                ) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Counter:
+    """A monotonically increasing value (Prometheus ``counter``). Thread-safe
+    under the GIL plus a per-instance lock for the read-modify-write."""
+
+    __slots__ = ("name", "labels", "_v", "_mu")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._v = 0
+        self._mu = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        """Add ``n`` (default 1)."""
+        with self._mu:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        """Current count."""
+        return self._v
+
+    def zero(self) -> None:
+        """Reset to 0 (registry-wide :func:`reset` calls this)."""
+        with self._mu:
+            self._v = 0
+
+
+class Gauge:
+    """A point-in-time value that can go up or down (Prometheus
+    ``gauge``)."""
+
+    __slots__ = ("name", "labels", "_v")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        """Set the current value."""
+        self._v = v
+
+    @property
+    def value(self) -> float:
+        """Current value."""
+        return self._v
+
+    def zero(self) -> None:
+        """Reset to 0 (registry-wide :func:`reset` calls this)."""
+        self._v = 0.0
+
+
+class Histogram:
+    """Fixed-bucket log2 latency histogram, bucket-compatible with the
+    native side (cpp/src/telemetry.h Hist): bucket ``i`` counts
+    observations ``v <= 2**i`` for ``i < HIST_BUCKETS``, the last bucket is
+    +Inf overflow. Observe integer microseconds for ``*_us`` metrics."""
+
+    __slots__ = ("name", "labels", "count", "sum", "buckets", "_mu")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self.count = 0
+        self.sum = 0
+        self.buckets = [0] * (HIST_BUCKETS + 1)
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def bucket_of(v: int) -> int:
+        """Index of the first bucket whose upper bound ``2**i`` holds
+        ``v``; ``HIST_BUCKETS`` is the overflow bucket."""
+        if v <= 1:
+            return 0
+        w = int(v - 1).bit_length()  # ceil(log2(v))
+        return w if w < HIST_BUCKETS else HIST_BUCKETS
+
+    def observe(self, v: float) -> None:
+        """Record one observation (non-negative; fractions are truncated
+        for the bucket choice, summed exactly — sub-unit observations must
+        not read as zero-cost in sum/count means)."""
+        if v < 0:
+            v = 0
+        with self._mu:
+            self.count += 1
+            self.sum += v
+            self.buckets[self.bucket_of(int(v))] += 1
+
+    def zero(self) -> None:
+        """Reset all counts (registry-wide :func:`reset` calls this)."""
+        with self._mu:
+            self.count = 0
+            self.sum = 0
+            self.buckets = [0] * (HIST_BUCKETS + 1)
+
+
+def counter(name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
+    """Resolve-or-register the counter ``(name, labels)``; the returned
+    object is stable for the process lifetime — resolve once, keep it."""
+    key = (name, _labels_key(labels))
+    with _lock:
+        c = _counters.get(key)
+        if c is None:
+            c = _counters[key] = Counter(name, dict(key[1]))
+        return c
+
+
+def gauge(name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
+    """Resolve-or-register the gauge ``(name, labels)`` (see
+    :func:`counter`)."""
+    key = (name, _labels_key(labels))
+    with _lock:
+        g = _gauges.get(key)
+        if g is None:
+            g = _gauges[key] = Gauge(name, dict(key[1]))
+        return g
+
+
+def histogram(name: str, labels: Optional[Dict[str, str]] = None
+              ) -> Histogram:
+    """Resolve-or-register the histogram ``(name, labels)`` (see
+    :func:`counter`)."""
+    key = (name, _labels_key(labels))
+    with _lock:
+        h = _hists.get(key)
+        if h is None:
+            h = _hists[key] = Histogram(name, dict(key[1]))
+        return h
+
+
+def register_collector(fn: Callable[[], None]) -> None:
+    """Register a callback run at every :func:`snapshot` before the
+    registry is read — how components with derived state (the tracker's
+    per-rank heartbeat ages) refresh their gauges lazily instead of on a
+    timer. Collectors must be fast and must not raise (exceptions are
+    swallowed so one broken collector cannot sink a scrape)."""
+    with _lock:
+        if fn not in _collectors:
+            _collectors.append(fn)
+
+
+def unregister_collector(fn: Callable[[], None]) -> None:
+    """Remove a collector registered with :func:`register_collector`
+    (no-op when absent) — call on component shutdown so a dead tracker
+    does not keep publishing."""
+    with _lock:
+        if fn in _collectors:
+            _collectors.remove(fn)
+
+
+def enabled() -> bool:
+    """Whether timed-span instrumentation is on: ``DMLC_TELEMETRY`` env at
+    first use (default on), overridable via :func:`enable`. Counters keep
+    counting either way."""
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get("DMLC_TELEMETRY", "1") not in ("0", "off")
+    return _enabled
+
+
+def enable(on: bool) -> None:
+    """Set the span gate for BOTH halves: the Python registry and — when
+    the native library is already loaded — the native registry
+    (``dct_telemetry_enable``)."""
+    global _enabled
+    _enabled = bool(on)
+    lib = _native_lib_if_loaded()
+    if lib is not None:
+        lib.dct_telemetry_enable(1 if on else 0)
+
+
+def reset(native: bool = True) -> None:
+    """Zero every Python-registered metric and drop buffered events; with
+    ``native=True`` (default) also zero the native registry when its
+    library is loaded (``dct_telemetry_reset``)."""
+    with _lock:
+        for c in _counters.values():
+            c.zero()
+        for g in _gauges.values():
+            g.zero()
+        for h in _hists.values():
+            h.zero()
+        del _events[:]
+    if native:
+        lib = _native_lib_if_loaded()
+        if lib is not None:
+            lib.dct_telemetry_reset()
+
+
+def emit_event(event: str, **fields) -> None:
+    """Append one event to the telemetry event stream (the PR-4 tracker
+    JSONL schema: ``{"ts": ..., "event": ..., **fields}``; pass ``ts=`` to
+    preserve an already-stamped time). The stream is a ring buffer of the
+    most recent ``4096`` events; exposition via :func:`events_jsonl`. Also
+    bumps ``telemetry_events_total{event=...}``."""
+    rec = {"ts": fields.pop("ts", None) or time.time(), "event": event}
+    rec.update(fields)
+    with _lock:
+        _events.append(rec)
+        if len(_events) > _EVENTS_MAX:
+            del _events[: len(_events) - _EVENTS_MAX]
+    counter("telemetry_events_total", {"event": event}).inc()
+
+
+def events() -> List[dict]:
+    """A copy of the buffered event stream (most recent ``4096``)."""
+    with _lock:
+        return list(_events)
+
+
+def _native_lib_if_loaded():
+    """The loaded ctypes library, or None. NEVER triggers the native
+    build: a tracker-only process (or a scrape) must not block minutes on
+    a C++ compile just to report its own metrics."""
+    try:
+        from dmlc_core_tpu.io import native as _native
+    except Exception:  # jax/numpy missing in a minimal tracker venv
+        return None
+    return _native._lib
+
+
+def _native_snapshot_dict(force: bool) -> Optional[dict]:
+    if force:
+        from dmlc_core_tpu.io import native as _native
+        _native.lib()
+    lib = _native_lib_if_loaded()
+    if lib is None:
+        return None
+    import ctypes
+    out = ctypes.c_char_p()
+    if lib.dct_telemetry_snapshot(ctypes.byref(out)) != 0:
+        return None
+    try:
+        doc = json.loads(ctypes.string_at(out).decode())
+    finally:
+        lib.dct_str_free(out)
+    return doc
+
+
+def snapshot(native: Optional[bool] = None) -> dict:
+    """The merged telemetry document — the single source every surface
+    serves (C ABI consumers read the native half directly; the tracker's
+    ``GET /metrics`` renders this via :func:`prometheus_text`).
+
+    ``native``: ``None`` (default) merges the native registry only when
+    the library is ALREADY loaded (never triggers a build); ``True``
+    forces loading/building it; ``False`` excludes it.
+
+    Schema (version 1, append-only): ``{"version", "enabled", "native":
+    bool, "counters": [{"name", "labels", "value"}], "gauges": [...],
+    "histograms": [{"name", "labels", "count", "sum", "buckets":
+    [HIST_BUCKETS+1 counts]}], "events": [...]}``."""
+    with _lock:
+        collectors = list(_collectors)
+    for fn in collectors:
+        try:
+            fn()
+        except Exception:
+            pass  # a broken collector must not sink the scrape
+    doc = {"version": SNAPSHOT_VERSION, "enabled": enabled(),
+           "native": False, "counters": [], "gauges": [],
+           "histograms": [], "events": []}
+    if native is not False:
+        nat = _native_snapshot_dict(force=bool(native))
+        if nat is not None:
+            doc["native"] = True
+            doc["counters"] += nat.get("counters", [])
+            doc["gauges"] += nat.get("gauges", [])
+            doc["histograms"] += nat.get("histograms", [])
+    with _lock:
+        for c in _counters.values():
+            doc["counters"].append({"name": c.name, "labels": c.labels,
+                                    "value": c.value})
+        for g in _gauges.values():
+            doc["gauges"].append({"name": g.name, "labels": g.labels,
+                                  "value": g.value})
+        for h in _hists.values():
+            doc["histograms"].append(
+                {"name": h.name, "labels": h.labels, "count": h.count,
+                 "sum": h.sum, "buckets": list(h.buckets)})
+        doc["events"] = list(_events)
+    return doc
+
+
+def _escape_label(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float) and not v.is_integer():
+        return repr(v)
+    return str(int(v))
+
+
+def prometheus_text(snap: Optional[dict] = None) -> str:
+    """Render a snapshot (default: take one now) in the Prometheus text
+    exposition format (version 0.0.4): one ``# TYPE`` line per metric
+    name, label escaping, histograms as cumulative ``_bucket{le=...}``
+    series ending in ``le="+Inf"`` plus ``_sum``/``_count``."""
+    if snap is None:
+        snap = snapshot()
+    lines: List[str] = []
+    typed: set = set()
+
+    def type_line(name: str, kind: str) -> None:
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for c in snap["counters"]:
+        type_line(c["name"], "counter")
+        lines.append(f"{c['name']}{_fmt_labels(c['labels'])} "
+                     f"{_fmt_value(c['value'])}")
+    for g in snap["gauges"]:
+        type_line(g["name"], "gauge")
+        lines.append(f"{g['name']}{_fmt_labels(g['labels'])} "
+                     f"{_fmt_value(g['value'])}")
+    for h in snap["histograms"]:
+        type_line(h["name"], "histogram")
+        cum = 0
+        for i, n in enumerate(h["buckets"]):
+            cum += n
+            le = "+Inf" if i == len(h["buckets"]) - 1 else str(1 << i)
+            le_label = 'le="' + le + '"'
+            labels = _fmt_labels(h["labels"], le_label)
+            lines.append(f"{h['name']}_bucket{labels} {cum}")
+        lines.append(f"{h['name']}_sum{_fmt_labels(h['labels'])} "
+                     f"{_fmt_value(h['sum'])}")
+        lines.append(f"{h['name']}_count{_fmt_labels(h['labels'])} "
+                     f"{_fmt_value(h['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+def events_jsonl(snap: Optional[dict] = None) -> str:
+    """Render a snapshot's event stream (default: take one now) as JSONL —
+    the PR-4 ``DMLC_TRACKER_EVENT_LOG`` schema, one ``{"ts", "event",
+    ...}`` object per line."""
+    if snap is None:
+        snap = snapshot()
+    return "".join(json.dumps(rec) + "\n" for rec in snap.get("events", []))

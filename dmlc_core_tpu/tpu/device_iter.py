@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, Optional
 
@@ -40,11 +41,28 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.base import DMLCError
 from dmlc_core_tpu.io.native import (NativeBatcher, NativeCsrRecBatcher,
                                      NativeDenseRecBatcher, NativeParser,
                                      _bf16_dtype)
 from dmlc_core_tpu.tpu.sharding import (batch_sharding, packed_batch_sharding)
+
+# transfer-path metric objects resolved ONCE (the registry contract:
+# resolve, keep the pointer — per-batch re-resolution would take the
+# registry lock on the transfer thread); lazy so importing this module
+# registers nothing
+_transfer_metrics = None
+
+
+def _get_transfer_metrics():
+    global _transfer_metrics
+    if _transfer_metrics is None:
+        _transfer_metrics = (
+            telemetry.histogram("device_transfer_us"),
+            telemetry.counter("device_batches_total"),
+            telemetry.counter("device_transfer_bytes_total"))
+    return _transfer_metrics
 
 
 def _dense_dtype_of(d) -> np.dtype:
@@ -1082,6 +1100,10 @@ class DeviceRowBlockIter:
         if not self.to_device:
             return batch
         tree = batch.tree()
+        # host->HBM dispatch span for the unified telemetry plane
+        # (doc/observability.md): batch granularity, gated so
+        # DMLC_TELEMETRY=0 costs nothing on the transfer thread
+        t0 = time.perf_counter() if telemetry.enabled() else None
         if self._leading_sharding is not None:
             if self.sharding is None or set(self.sharding) != set(tree):
                 self.sharding = {
@@ -1090,6 +1112,11 @@ class DeviceRowBlockIter:
             tree = jax.device_put(tree, self.sharding)
         else:
             tree = jax.device_put(tree)
+        if t0 is not None:
+            xfer_us, batches, xfer_bytes = _get_transfer_metrics()
+            xfer_us.observe((time.perf_counter() - t0) * 1e6)
+            batches.inc()
+            xfer_bytes.inc(sum(int(v.nbytes) for v in batch.tree().values()))
         cls = type(batch)
         return cls(total_rows=batch.total_rows, **tree)
 

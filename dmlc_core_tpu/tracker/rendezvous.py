@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.tracker import topology
 from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
                                         HEARTBEAT_BYE, MAGIC,
@@ -87,7 +88,8 @@ class _Conn:
     """One accepted connection: buffers + the protocol coroutine."""
 
     __slots__ = ("sock", "host", "inbuf", "outbuf", "gen", "want", "kind",
-                 "rank", "jobid", "last_activity", "closed", "registered")
+                 "rank", "jobid", "last_activity", "closed", "registered",
+                 "drain_close")
 
     def __init__(self, sock: socket.socket, host: str):
         self.sock = sock
@@ -96,12 +98,13 @@ class _Conn:
         self.outbuf = bytearray()
         self.gen = None
         self.want = None            # int bytes needed, or _WAIT when parked
-        self.kind = "proto"         # "proto" | "heartbeat"
+        self.kind = "proto"         # "proto" | "heartbeat" | "http"
         self.rank: Optional[int] = None
         self.jobid = "NULL"
         self.last_activity = time.monotonic()
         self.closed = False
         self.registered = False
+        self.drain_close = False    # close as soon as outbuf drains (http)
 
 
 class _WaitEntry:
@@ -113,6 +116,54 @@ class _WaitEntry:
         self.host = host
         self.port = port
         self.wait_accept = wait_accept
+
+
+class _EventLog:
+    """The hardened DMLC_TRACKER_EVENT_LOG JSONL sink: size-capped
+    rotation (current file moves to ``<path>.1`` at the cap, so a
+    long-running job holds at most ~2x the cap on disk instead of filling
+    it) and an fsync'd flush for the abort path (a crashing job must not
+    lose its last events to userspace buffering)."""
+
+    def __init__(self, path: str, max_bytes: int):
+        self._path = path
+        self._max_bytes = max_bytes  # 0 = rotation off
+        self._fp = open(path, "a", buffering=1)
+        try:
+            self._size = os.path.getsize(path)
+        except OSError:
+            self._size = 0
+
+    def write(self, line: str) -> None:
+        """Append one JSONL line, rotating first when it would cross the
+        cap. I/O errors are swallowed — a full disk must not kill the
+        rendezvous (same contract the un-hardened sink had)."""
+        try:
+            if self._max_bytes > 0 and self._size + len(line) > \
+                    self._max_bytes and self._size > 0:
+                self._fp.close()
+                os.replace(self._path, self._path + ".1")
+                self._fp = open(self._path, "a", buffering=1)
+                self._size = 0
+            self._fp.write(line)
+            self._size += len(line)
+        except (OSError, ValueError):
+            pass
+
+    def flush(self) -> None:
+        """Flush through to disk (flush + fsync, best effort) — called on
+        abort so the final dead/abort events survive the process."""
+        try:
+            self._fp.flush()
+            os.fsync(self._fp.fileno())
+        except (OSError, ValueError):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._fp.close()
+        except OSError:
+            pass
 
 
 class _RankState:
@@ -176,11 +227,15 @@ class RabitTracker:
         # observability
         self._lock = threading.Lock()
         self.events: List[Dict[str, object]] = []
-        self._event_fp = None
+        self._event_log = None
         path = event_log if event_log is not None \
             else os.environ.get("DMLC_TRACKER_EVENT_LOG")
         if path:
-            self._event_fp = open(path, "a", buffering=1)
+            self._event_log = _EventLog(
+                path, env_int("DMLC_TRACKER_EVENT_LOG_MAX_BYTES", 16 << 20))
+        # the tracker publishes into the unified telemetry plane: per-rank
+        # gauges refresh lazily at snapshot/scrape time (doc/observability.md)
+        telemetry.register_collector(self._publish_telemetry)
         self._ranks: Dict[int, _RankState] = {}
         self._dead_callbacks: List[Callable[[int, Dict[str, object]], None]] \
             = []
@@ -214,11 +269,34 @@ class RabitTracker:
         rec.update(fields)
         with self._lock:
             self.events.append(rec)
-            if self._event_fp is not None:
-                try:
-                    self._event_fp.write(json.dumps(rec) + "\n")
-                except OSError:  # a full disk must not kill the rendezvous
-                    pass
+            if self._event_log is not None:
+                self._event_log.write(json.dumps(rec) + "\n")
+        # tracker events are just another telemetry stream: the same record
+        # rides the snapshot's `events` list / events_jsonl() exposition
+        telemetry.emit_event(event,
+                             **{k: v for k, v in rec.items() if k != "event"})
+
+    def _publish_telemetry(self) -> None:
+        """Telemetry collector (runs at snapshot/scrape time): job-level
+        gauges + per-rank phase / heartbeat-age / restart gauges, labeled
+        ``{rank="<r>"}`` (doc/observability.md catalog)."""
+        st = self.state()
+        telemetry.gauge("tracker_num_workers").set(st["num_workers"])
+        telemetry.gauge("tracker_alive").set(1 if st["alive"] else 0)
+        telemetry.gauge("tracker_finished").set(1 if st["finished"] else 0)
+        telemetry.gauge("tracker_aborted").set(1 if st["aborted"] else 0)
+        phase_code = {"assigned": 0, "alive": 1, "dead": 2, "shutdown": 3}
+        for rank, info in st["ranks"].items():
+            labels = {"rank": str(rank)}
+            telemetry.gauge("tracker_rank_phase_code", labels).set(
+                phase_code.get(info["phase"], -1))
+            age = info["last_heartbeat_age_s"]
+            telemetry.gauge("tracker_rank_heartbeat_age_seconds",
+                            labels).set(-1 if age is None else age)
+            telemetry.gauge("tracker_rank_restarts", labels).set(
+                info["restarts"])
+            telemetry.gauge("tracker_rank_attempts", labels).set(
+                info["attempts"])
 
     def state(self) -> Dict[str, object]:
         """Thread-safe snapshot: per-rank phase / last-heartbeat age /
@@ -431,7 +509,10 @@ class RabitTracker:
                 deadline = min(deadline,
                                st.dead_since + self.recover_grace_ms / 1000.0)
         for conn in self._conns:
-            if conn.kind == "proto" and isinstance(conn.want, int):
+            # http conns are bounded in EVERY state (a scraper that never
+            # reads its response parks at _WAIT and must still be swept)
+            if conn.kind == "http" or (conn.kind == "proto"
+                                       and isinstance(conn.want, int)):
                 deadline = min(deadline,
                                conn.last_activity + handshake_timeout)
         return max(0.0, deadline - now)
@@ -448,8 +529,12 @@ class RabitTracker:
         # slot (or fds) forever; parked conns (awaiting the batch or a
         # peer's port) are exempt — they are waiting on the JOB, not
         # failing to speak
+        # http conns time out in every state — including parked at _WAIT
+        # awaiting response drain, where a stalled scraper would otherwise
+        # hold its fd for the tracker's lifetime
         for conn in [c for c in self._conns
-                     if c.kind == "proto" and isinstance(c.want, int)
+                     if (c.kind == "http" or (c.kind == "proto"
+                                              and isinstance(c.want, int)))
                      and now - c.last_activity > handshake_timeout]:
             self._drop(conn, f"handshake timed out after "
                              f"{handshake_timeout:.0f}s")
@@ -494,6 +579,11 @@ class RabitTracker:
         down, and surface the structured error through join()."""
         logger.error("aborting job: %s", err)
         self._emit("abort", reason=err.reason, dead_ranks=err.dead_ranks)
+        with self._lock:
+            if self._event_log is not None:
+                # fsync through to disk NOW: the abort path is exactly when
+                # the process (or its node) is likeliest to die next
+                self._event_log.flush()
         reason = err.reason.encode()
         frame = struct.pack("@i", HEARTBEAT_ABORT) + \
             struct.pack("@i", len(reason)) + reason
@@ -541,6 +631,12 @@ class RabitTracker:
             self._conn_eof(conn, None)
             return
         conn.inbuf += data
+        if conn.kind == "http" and len(conn.inbuf) > 8192:
+            # a scrape client has no business sending more than one small
+            # request; unconsumed bytes on a parked conn would otherwise
+            # buffer unboundedly
+            self._drop(conn, "http client kept sending after its request")
+            return
         conn.last_activity = time.monotonic()
         self._pump(conn)
 
@@ -592,6 +688,12 @@ class RabitTracker:
             pass
         except OSError as e:
             self._conn_eof(conn, e)
+            return
+        if conn.drain_close and not conn.outbuf:
+            # an http response fully on the wire: close now (the scrape
+            # coroutine parked itself instead of returning, so the close
+            # happens here — AFTER the bytes left, not before)
+            self._close_conn(conn)
             return
         mask = selectors.EVENT_READ
         if conn.outbuf:
@@ -678,16 +780,24 @@ class RabitTracker:
             except OSError:
                 pass
         with self._lock:
-            if self._event_fp is not None:
-                try:
-                    self._event_fp.close()
-                except OSError:
-                    pass
-                self._event_fp = None
+            if self._event_log is not None:
+                self._event_log.close()
+                self._event_log = None
+        # a closed tracker must stop publishing gauges into scrapes
+        telemetry.unregister_collector(self._publish_telemetry)
 
     # -- the tracker protocol, as one coroutine per connection ---------------
     def _proto(self, conn: _Conn):
-        magic = yield from _r_int()
+        head = yield 4
+        if head == b"GET ":
+            # content-sniffed read-only scrape surface on the SAME port
+            # (doc/observability.md): a legitimate worker frame starts with
+            # the little-endian MAGIC int, never ASCII "GET ". The scrape
+            # runs inside this coroutine like any other connection — it can
+            # never block the rendezvous.
+            yield from self._http_get(conn, head)
+            return
+        magic = struct.unpack("@i", head)[0]
         if magic != MAGIC:
             raise _Reject(f"invalid magic {magic:#x}")
         self._send_int(conn, MAGIC)
@@ -949,6 +1059,43 @@ class RabitTracker:
             self._pending_ports.discard(rank)
             self._later.append(self._resume_port_waiters)
             return
+
+    def _http_get(self, conn: _Conn, head: bytes):
+        """Read-only HTTP scrape served from the rendezvous port (content-
+        sniffed ``GET``): ``/metrics`` renders the merged telemetry
+        snapshot in Prometheus text exposition, ``/state`` the thread-safe
+        state() JSON. Runs as a normal connection coroutine — byte-at-a-
+        time header reads through the selectors loop, response buffered
+        through outbuf, socket closed once it drains (drain_close)."""
+        conn.kind = "http"
+        req = bytearray(head)
+        while b"\r\n\r\n" not in req:
+            if len(req) > 8192:
+                raise _Reject("oversized http request")
+            req += yield 1
+        line = bytes(req).split(b"\r\n", 1)[0].decode("latin-1", "replace")
+        parts = line.split()
+        path = (parts[1] if len(parts) >= 2 else "/").split("?", 1)[0]
+        if path == "/metrics":
+            # never triggers a native build: telemetry.snapshot merges the
+            # native registry only when its library is already loaded
+            body = telemetry.prometheus_text().encode()
+            status, ctype = "200 OK", \
+                "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/state":
+            body = (json.dumps(self.state()) + "\n").encode()
+            status, ctype = "200 OK", "application/json"
+        else:
+            body = b"not found; scrape /metrics or /state\n"
+            status, ctype = "404 Not Found", "text/plain"
+        resp = (f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n").encode("latin-1") + body
+        conn.drain_close = True
+        self._send_bytes(conn, resp)
+        # park (never returns): _flush closes the socket once the response
+        # drains — returning here would close it with bytes still buffered
+        yield _WAIT
 
     def _resume_port_waiters(self) -> None:
         waiters, self._port_waiters = self._port_waiters, []

@@ -28,6 +28,7 @@ MODULE_GROUPS = [
         "dmlc_core_tpu.registry",
         "dmlc_core_tpu.config",
         "dmlc_core_tpu.serializer",
+        "dmlc_core_tpu.telemetry",
     ]),
     ("Data & I/O", [
         "dmlc_core_tpu.data",
@@ -253,6 +254,10 @@ def gen_index() -> str:
         "model, env/URI knobs, fault-plan grammar, io_stats()) + "
         "distributed job liveness (heartbeats, dead-rank deadlines, "
         "abort broadcast, state()/event-log schema) |",
+        "| [observability.md](observability.md) | the unified telemetry "
+        "plane: metric catalog (names/types/units), the three snapshot "
+        "surfaces (C ABI / Python / tracker HTTP scrape), Prometheus + "
+        "JSONL exposition, env knobs, overhead bounds |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
         "",
