@@ -3,10 +3,10 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint native-test tsan-test asan-test parse-lanes telemetry \
-        pytest liveness bench-smoke dryrun doc clean
+        pytest liveness elastic bench-smoke dryrun doc clean
 
 ci: lint native-test tsan-test asan-test parse-lanes telemetry pytest \
-    liveness dryrun doc
+    liveness elastic dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -53,6 +53,14 @@ pytest:
 # red instead of a hung CI job -- the exact failure mode the suite pins.
 liveness:
 	timeout -k 10 300 python3 -m pytest tests/test_tracker_liveness.py -q
+
+# elastic data-plane chaos suite (doc/robustness.md "Elastic data-plane"):
+# SIGKILL a lease-holding worker with no relaunch -- survivors must absorb
+# its shards within the dead_after + grace bound and every worker set must
+# replay the same seed-deterministic global stream. Hard timeout for the
+# same reason as the liveness lane.
+elastic:
+	timeout -k 10 300 python3 -m pytest tests/test_elastic_data_plane.py -q
 
 dryrun:
 	python3 -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -17,12 +17,17 @@ Differences from the reference are deliberate:
 - Custom formats register with ``@register_parser`` (reference
   DMLC_REGISTER_DATA_PARSER, data.h:358); the built-in libsvm/csv/libfm
   formats dispatch to the multithreaded native parsers.
+- Elastic data-plane (doc/robustness.md): ``ElasticRowBlockIter`` iterates
+  tracker-granted shard leases instead of a static part index —
+  ``DMLC_ELASTIC_SHARDS=1`` / ``?elastic=1`` opt in through
+  ``RowBlockIter.create``; ``LocalLeases`` is the in-process lease source.
 """
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import BinaryIO, Callable, Dict, Iterator, Optional
+from typing import BinaryIO, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -33,7 +38,8 @@ from dmlc_core_tpu.registry import Registry
 from dmlc_core_tpu.serializer import BinaryReader, BinaryWriter
 
 __all__ = ["Row", "RowBlock", "RowBlockContainer", "Parser", "RowBlockIter",
-           "register_parser", "PARSER_REGISTRY"]
+           "ElasticRowBlockIter", "LocalLeases", "register_parser",
+           "PARSER_REGISTRY"]
 
 
 class Row:
@@ -157,6 +163,48 @@ class RowBlockContainer:
             out.max_index = int(out.index.max())
             if len(out.field):
                 out.max_field = int(out.field.max())
+        return out
+
+    def take(self, rows) -> "RowBlockContainer":
+        """Gather the given row ids (any order, repeats allowed) into a
+        new container — the windowed-shuffle primitive of the elastic
+        iterator. Vectorized: one fancy-index gather per array, no
+        per-row Python loop."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if rows.size and (rows.min() < 0 or rows.max() >= self.size):
+            raise DMLCError(f"take rows out of range [0, {self.size})")
+        out = RowBlockContainer(index64=self.index.dtype == np.uint64)
+        starts = self.offset[rows].astype(np.int64)
+        lens = (self.offset[rows + 1] - self.offset[rows]).astype(np.int64)
+        total = int(lens.sum())
+        if total:
+            # per selected row i: starts[i] + [0, lens[i]) — expressed as
+            # one repeat + arange re-basing, no loop
+            ends = np.cumsum(lens)
+            gather = (np.repeat(starts, lens)
+                      + np.arange(total, dtype=np.int64)
+                      - np.repeat(ends - lens, lens))
+        else:
+            gather = np.empty(0, np.int64)
+        out.offset = np.concatenate(
+            [np.zeros(1, np.uint64), np.cumsum(lens).astype(np.uint64)])
+        out.label = self.label[rows]
+        if len(self.weight):
+            out.weight = self.weight[rows]
+        if len(self.qid):
+            out.qid = self.qid[rows]
+        if len(self.field):
+            out.field = self.field[gather]
+        out.index = self.index[gather]
+        for name in ("value", "value_i32", "value_i64"):
+            arr = getattr(self, name)
+            if len(arr):
+                setattr(out, name, arr[gather])
+        out.value_dtype = self.value_dtype
+        if out.nnz:
+            out.max_index = int(out.index.max())
+        if len(out.field):
+            out.max_field = int(out.field.max())
         return out
 
     # -- growth ---------------------------------------------------------------
@@ -339,13 +387,7 @@ class Parser:
         outstanding (0 = auto; native formats only — see
         cpp/src/parser.h PipelinedParser). The returned native parser
         exposes ``pipeline_stats()`` with per-stage occupancy counters."""
-        base = uri.split("#", 1)[0]
-        args: Dict[str, str] = {}
-        if "?" in base:
-            for kv in base.split("?", 1)[1].split("&"):
-                if kv:
-                    k, _, v = kv.partition("=")
-                    args[k] = v
+        args = _uri_query_args(uri)
         resolved = args.get("format", "libsvm") if fmt == "auto" else fmt
         if resolved in _NATIVE_FORMATS:
             if kwargs:
@@ -402,13 +444,71 @@ class RowBlockIter:
     def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
                nthread: int = 0, index64: bool = False,
                chunks_in_flight: int = 0,
-               on_error: str = "raise") -> "RowBlockIter":
+               on_error: str = "raise", elastic: Optional[bool] = None,
+               leases=None, num_shards: int = 0, shuffle_window: int = 0,
+               run_id: Optional[int] = None, epoch: int = 0):
         """Factory matching reference RowBlockIter<I>::Create (data.h:267);
-        ``on_error="skip"`` enables graceful degradation (class doc)."""
-        parser = Parser.create(uri, part, npart, fmt, nthread=nthread,
-                               index64=index64,
-                               chunks_in_flight=chunks_in_flight)
-        return RowBlockIter(parser, eager="#" not in uri, on_error=on_error)
+        ``on_error="skip"`` enables graceful degradation (class doc).
+
+        Elastic opt-in (doc/robustness.md "Elastic data-plane"):
+        ``DMLC_ELASTIC_SHARDS=1`` in the environment (exported by an
+        elastic tracker's ``worker_envs``) or a ``?elastic=1`` URI arg
+        switches to lease-driven iteration and returns an
+        :class:`ElasticRowBlockIter` consuming tracker-granted shards
+        (``num_shards`` / ``?num_shards=`` / ``DMLC_TRACKER_NUM_SHARDS``),
+        with ``leases`` defaulting to the process's active
+        HeartbeatMonitor. The env opt-in only applies to calls with the
+        default ``part=0, npart=1`` — an explicit static split (a side
+        dataset opened with its own ``part``/``npart``) stays static
+        rather than silently joining the tracker's one shard pool; the
+        ``?elastic=1`` URI arg always wins. The legacy static
+        ``(part, npart)`` contract is the untouched default."""
+        from dmlc_core_tpu.tracker.wire import env_int
+        uri_args = _uri_query_args(uri)
+        if elastic is None:
+            if uri_args.get("elastic", "") not in ("", "0"):
+                elastic = True
+            elif part == 0 and npart == 1:
+                elastic = env_int("DMLC_ELASTIC_SHARDS", 0) > 0
+            else:
+                # an explicit static (part, npart) split beats the
+                # process-wide env opt-in: a side dataset (validation
+                # set, feature file) opened with its own split must not
+                # silently join the tracker's ONE shard pool and have
+                # part/npart ignored
+                elastic = False
+        if not elastic:
+            parser = Parser.create(uri, part, npart, fmt, nthread=nthread,
+                                   index64=index64,
+                                   chunks_in_flight=chunks_in_flight)
+            return RowBlockIter(parser, eager="#" not in uri,
+                                on_error=on_error)
+        if "#" in uri:
+            raise DMLCError(
+                "elastic mode does not compose with #cachefile (the disk "
+                "cache is keyed by a static part index)")
+        num_shards = num_shards or _uri_int(uri_args, "num_shards") or \
+            env_int("DMLC_TRACKER_NUM_SHARDS", 0)
+        if num_shards <= 0:
+            raise DMLCError(
+                "elastic mode needs num_shards > 0 (argument, ?num_shards= "
+                "URI arg, or DMLC_TRACKER_NUM_SHARDS)")
+        shuffle_window = shuffle_window or _uri_int(uri_args,
+                                                    "shuffle_window")
+        if run_id is None and "run_id" in uri_args:
+            run_id = _uri_int(uri_args, "run_id")
+        if leases is None:
+            from dmlc_core_tpu.tracker.client import current_monitor
+            leases = current_monitor()
+            if leases is None:
+                raise DMLCError(
+                    "elastic mode needs a lease source: join a rendezvous "
+                    "with heartbeats (RendezvousClient.start) or pass "
+                    "leases=LocalLeases(num_shards)")
+        return ElasticRowBlockIter(
+            _strip_uri_args(uri, _ELASTIC_URI_KEYS), leases, num_shards,
+            fmt=fmt, nthread=nthread, index64=index64, epoch=epoch,
+            run_id=run_id, shuffle_window=shuffle_window, on_error=on_error)
 
     def _next_block_degradable(self):
         """next_block() honoring on_error: with "skip", a failing pull is
@@ -515,6 +615,279 @@ class RowBlockIter:
         close = getattr(self._parser, "close", None)
         if close is not None:
             close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- elastic data-plane (doc/robustness.md "Elastic data-plane") --------------
+_ELASTIC_URI_KEYS = ("elastic", "num_shards", "shuffle_window", "run_id")
+
+
+def _uri_query_args(uri: str) -> Dict[str, str]:
+    base = uri.split("#", 1)[0]
+    args: Dict[str, str] = {}
+    if "?" in base:
+        for kv in base.split("?", 1)[1].split("&"):
+            if kv:
+                k, _, v = kv.partition("=")
+                args[k] = v
+    return args
+
+
+def _uri_int(args: Dict[str, str], key: str) -> int:
+    raw = args.get(key, "")
+    if raw == "":
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        raise DMLCError(f"?{key}={raw!r} is not an integer")
+
+
+def _strip_uri_args(uri: str, keys) -> str:
+    """Drop the given query keys from `uri` (the elastic sugar must not
+    reach the native parser, which would reject unknown parameters)."""
+    base, sep, frag = uri.partition("#")
+    path, qmark, q = base.partition("?")
+    if not qmark:
+        return uri
+    kept = [kv for kv in q.split("&")
+            if kv and kv.partition("=")[0] not in keys]
+    return path + ("?" + "&".join(kept) if kept else "") + sep + frag
+
+
+class LocalLeases:
+    """In-process lease source mirroring the tracker's pool/held/done
+    accounting — the single-host / test-harness counterpart of
+    ``HeartbeatMonitor.acquire_lease``.
+
+    ``completed`` seeds every epoch's done set: that is how a resumed run
+    skips the shards an interrupted run already checked out (shard-
+    granular resume — the distributed equivalent is the tracker's own
+    done set, which survives worker churn). Thread-safe; concurrent
+    local workers (threads) share one instance."""
+
+    def __init__(self, num_shards: int, completed=()):
+        if num_shards <= 0:
+            raise DMLCError("num_shards must be > 0")
+        self.num_shards = num_shards
+        self._completed0 = set(completed)
+        self._cond = threading.Condition()
+        self._epochs: Dict[int, dict] = {}
+
+    def _epoch(self, epoch: int) -> dict:
+        ep = self._epochs.get(epoch)
+        if ep is None:
+            done = set(self._completed0)
+            ep = self._epochs[epoch] = {
+                "pool": [s for s in range(self.num_shards)
+                         if s not in done],
+                "held": set(), "done": done}
+        return ep
+
+    def acquire_lease(self, epoch: int,
+                      timeout: Optional[float] = None) -> Optional[int]:
+        """Lowest free shard of `epoch`; None once every shard is done.
+        Blocks while the pool is empty but undrained (another worker may
+        release), up to `timeout` → TimeoutError."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                ep = self._epoch(epoch)
+                if ep["pool"]:
+                    shard = ep["pool"].pop(0)
+                    ep["held"].add(shard)
+                    return shard
+                if len(ep["done"]) >= self.num_shards:
+                    return None
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    raise TimeoutError(
+                        "lease pool stayed empty past the deadline "
+                        "(a shard is held but never completed/released)")
+                self._cond.wait(0.05 if left is None else min(left, 0.05))
+
+    def complete_lease(self, epoch: int, shard: int) -> None:
+        """Mark a fully-consumed shard done (exactly-once checkout)."""
+        with self._cond:
+            ep = self._epoch(epoch)
+            ep["held"].discard(shard)
+            ep["done"].add(shard)
+            self._cond.notify_all()
+
+    def release_lease(self, epoch: int, shard: int) -> None:
+        """Return an unfinished shard to the pool."""
+        with self._cond:
+            ep = self._epoch(epoch)
+            if shard in ep["held"]:
+                ep["held"].discard(shard)
+                ep["pool"].append(shard)
+                self._cond.notify_all()
+
+
+class ElasticRowBlockIter:
+    """Elastic mode of RowBlockIter (doc/robustness.md "Elastic
+    data-plane"): instead of a static ``(part_index, num_parts)`` fixed at
+    open time, iteration consumes tracker-granted SHARD LEASES — the
+    dataset is pre-split into ``num_shards`` logical shards (S >> world
+    size), each worker pulls the next free shard from the lease source,
+    parses it, and checks it out. A dead worker's shards return to the
+    pool and are absorbed by the survivors, so the epoch completes without
+    a relaunch; a late-joining worker simply starts acquiring.
+
+    Determinism contract: each shard's batch stream depends only on the
+    source bytes, ``num_shards``, and the shard id — the windowed shuffle
+    is seeded by ``(run_id, epoch, shard_id)``, NEVER by the rank that
+    happens to consume it — so the global batch stream (the shard-ordered
+    union) is byte-identical for ANY worker set, including sets that
+    change mid-epoch. ``leases`` is a ``HeartbeatMonitor`` (distributed)
+    or :class:`LocalLeases` (single-host / tests)."""
+
+    def __init__(self, uri: str, leases, num_shards: int, fmt: str = "auto",
+                 nthread: int = 0, index64: bool = False, epoch: int = 0,
+                 run_id: Optional[int] = None, shuffle_window: int = 0,
+                 on_error: str = "raise",
+                 acquire_timeout: Optional[float] = None):
+        if num_shards <= 0:
+            raise DMLCError("elastic mode needs num_shards > 0")
+        if on_error not in ("raise", "skip"):
+            raise DMLCError(
+                f"on_error must be 'raise' or 'skip', got {on_error!r}")
+        if run_id is None:
+            from dmlc_core_tpu.tracker.wire import env_int
+            run_id = env_int("DMLC_RUN_ID", 0)
+        if run_id < 0 or epoch < 0:
+            raise DMLCError("run_id and epoch must be non-negative "
+                            "(they seed the windowed shuffle)")
+        self._uri = uri
+        self._leases = leases
+        self.num_shards = num_shards
+        self._fmt = fmt
+        self._nthread = nthread
+        self._index64 = index64
+        self.epoch = epoch
+        self.run_id = run_id
+        self.shuffle_window = shuffle_window
+        self._on_error = on_error
+        self._acquire_timeout = acquire_timeout
+        self.consumed: List[int] = []
+        self.skipped_shards = 0
+        self.last_error: Optional[str] = None
+        self._bytes = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance to a new epoch: subsequent acquires lease the new
+        epoch's pool and the shuffle reseeds on (run_id, epoch, shard)."""
+        if epoch < 0:
+            raise DMLCError("epoch must be non-negative")
+        self.epoch = epoch
+        self.consumed = []
+
+    def _load_shard(self, shard: int) -> RowBlockContainer:
+        parser = Parser.create(self._uri, part=shard,
+                               npart=self.num_shards, fmt=self._fmt,
+                               nthread=self._nthread, index64=self._index64)
+        try:
+            blocks = []
+            while True:
+                b = parser.next_block()
+                if b is None:
+                    break
+                blocks.append(RowBlockContainer.from_blocks([b]))
+            self._bytes += parser.bytes_read()
+            return RowBlockContainer.from_blocks(blocks)
+        finally:
+            close = getattr(parser, "close", None)
+            if close is not None:
+                close()
+
+    def _shard_batches(self, shard: int,
+                       block: RowBlockContainer) -> List[RowBlockContainer]:
+        """The shard's batch list: the whole shard as one batch, or — with
+        ``shuffle_window`` — fixed windows of rows, each permuted by an
+        rng seeded by (run_id, epoch, shard_id). Deterministic in the
+        shard, never in the consuming rank."""
+        if block.size == 0:
+            return []
+        if self.shuffle_window <= 0:
+            return [block]
+        w = self.shuffle_window
+        rng = np.random.default_rng([self.run_id, self.epoch, shard])
+        order = np.arange(block.size)
+        for s in range(0, block.size, w):
+            rng.shuffle(order[s:s + w])
+        return [block.take(order[s:s + w])
+                for s in range(0, block.size, w)]
+
+    def shards(self) -> Iterator[tuple]:
+        """Generator of ``(shard_id, [batch containers])`` in grant order.
+        The lease is checked out (complete) only after the consumer
+        advances PAST the shard — a worker dying mid-shard leaves it in
+        the pool for another worker, preserving exactly-once coverage."""
+        while True:
+            shard = self._leases.acquire_lease(self.epoch,
+                                               self._acquire_timeout)
+            if shard is None:
+                return
+            try:
+                batches = self._shard_batches(shard,
+                                              self._load_shard(shard))
+            except DMLCError as e:
+                if self._on_error != "skip":
+                    # hand the shard back: this worker is failing on it,
+                    # but another worker (or a retry) may still manage
+                    try:
+                        self._leases.release_lease(self.epoch, shard)
+                    except Exception:
+                        pass
+                    raise
+                self.skipped_shards += 1
+                self.last_error = str(e)
+                log_warning(
+                    "shard %d failed (%d skipped total); on_error=skip: %s",
+                    shard, self.skipped_shards, e)
+                # consumed-with-errors: completing (not releasing) avoids
+                # an infinite regrant loop on a genuinely bad shard
+                self._leases.complete_lease(self.epoch, shard)
+                continue
+            yield shard, batches
+            self._leases.complete_lease(self.epoch, shard)
+            self.consumed.append(shard)
+
+    def __iter__(self) -> Iterator[RowBlockContainer]:
+        for _shard, batches in self.shards():
+            for b in batches:
+                yield b
+
+    def state(self) -> dict:
+        """Shard-granular resume state: feed ``completed`` into
+        ``LocalLeases(num_shards, completed=...)`` (single-host) — the
+        distributed equivalent is the tracker's own per-epoch done set,
+        which survives worker churn."""
+        return {"epoch": self.epoch, "num_shards": self.num_shards,
+                "run_id": self.run_id, "completed": sorted(self.consumed)}
+
+    def bytes_read(self) -> int:
+        """Bytes consumed across every shard leased so far."""
+        return self._bytes
+
+    def io_stats(self) -> dict:
+        """Remote-I/O resilience counters plus this iterator's
+        ``skipped_shards`` (on_error="skip")."""
+        from dmlc_core_tpu.io.native import io_retry_stats
+        out = io_retry_stats()
+        out["skipped_shards"] = self.skipped_shards
+        return out
+
+    def close(self) -> None:
+        """Per-shard parsers are closed as each shard completes; kept for
+        RowBlockIter context-manager parity."""
 
     def __enter__(self):
         return self

@@ -599,6 +599,64 @@ class NativeInputSplit:
             pass
 
 
+class LeasedSplit:
+    """Elastic InputSplit (doc/robustness.md "Elastic data-plane"): yields
+    the records of tracker-granted shard leases instead of one static
+    ``(part_index, num_parts)`` fixed at open time.
+
+    One NativeInputSplit is opened over the source and re-pointed per
+    granted shard via ``reset_partition(shard, num_shards)`` — the
+    reference InputSplit contract, with the partition decided by the lease
+    plane at run time. ``leases`` is a ``tracker.client.HeartbeatMonitor``
+    (distributed) or ``data.LocalLeases`` (single-host); each shard is
+    checked out (complete) only after its records are fully drained, so a
+    worker dying mid-shard leaves it for another worker."""
+
+    def __init__(self, uri: str, leases, num_shards: int,
+                 split_type: str = "text", epoch: int = 0,
+                 acquire_timeout: Optional[float] = None, **split_kwargs):
+        if num_shards <= 0:
+            raise DMLCError("LeasedSplit needs num_shards > 0")
+        self._split = NativeInputSplit(uri, 0, num_shards, split_type,
+                                       **split_kwargs)
+        self._leases = leases
+        self.num_shards = num_shards
+        self.epoch = epoch
+        self._acquire_timeout = acquire_timeout
+        self.consumed: list = []
+
+    def __iter__(self) -> Iterator[bytes]:
+        """Records of every shard this worker wins, shard by shard."""
+        while True:
+            shard = self._leases.acquire_lease(self.epoch,
+                                               self._acquire_timeout)
+            if shard is None:
+                return
+            self._split.reset_partition(shard, self.num_shards)
+            while True:
+                rec = self._split.next_record()
+                if rec is None:
+                    break
+                yield rec
+            self._leases.complete_lease(self.epoch, shard)
+            self.consumed.append(shard)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance to a new epoch's lease pool."""
+        self.epoch = epoch
+        self.consumed = []
+
+    def close(self) -> None:
+        """Free the underlying native split handle (idempotent)."""
+        self._split.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 # -- recordio ---------------------------------------------------------------
 class NativeRecordIOWriter:
     """reference RecordIOWriter (recordio.h:38); format spec in recordio.h."""

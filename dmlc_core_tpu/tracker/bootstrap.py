@@ -41,23 +41,28 @@ def build_env(base: Dict[str, str],
     LD_LIBRARY_PATH extension (:73-74).
     """
     env = dict(base)
-    cluster = env.get("DMLC_JOB_CLUSTER")
+    from dmlc_core_tpu.tracker.wire import env_enum, env_int
+    # a typo'd backend name must fail here too, not select nothing
+    cluster = env_enum("DMLC_JOB_CLUSTER",
+                       ("local", "ssh", "mpi", "sge", "slurm", "tpu-pod",
+                        "kubernetes", "yarn", "mesos"), env=env)
     if cluster is None:
         raise RuntimeError("need DMLC_JOB_CLUSTER in the environment")
 
-    # liveness knobs (doc/robustness.md) ride the same env ABI; a typo'd
-    # value must fail HERE, in the container bootstrap, not silently
-    # disable the heartbeat and let the job hang the old way
-    from dmlc_core_tpu.tracker.wire import env_int
+    # liveness + elastic data-plane knobs (doc/robustness.md) ride the
+    # same env ABI; a typo'd value must fail HERE, in the container
+    # bootstrap, not silently disable the heartbeat (or the lease TTL)
+    # and let the job hang the old way
     for key in ("DMLC_TRACKER_HEARTBEAT_MS", "DMLC_TRACKER_DEAD_AFTER_MS",
-                "DMLC_TRACKER_RECOVER_GRACE_MS"):
+                "DMLC_TRACKER_RECOVER_GRACE_MS", "DMLC_TRACKER_NUM_SHARDS",
+                "DMLC_TRACKER_LEASE_TTL_MS", "DMLC_ELASTIC_SHARDS"):
         if env.get(key):
             env_int(key, 0, env=env)  # raises RuntimeError on garbage
 
     if cluster == "sge" and "DMLC_TASK_ID" in env:
         # array jobs carry no role: first num_worker tasks are workers
-        num_worker = int(env.get("DMLC_NUM_WORKER", "0"))
-        task_id = int(env["DMLC_TASK_ID"])
+        num_worker = env_int("DMLC_NUM_WORKER", 0, env=env)
+        task_id = env_int("DMLC_TASK_ID", 0, env=env)
         env["DMLC_ROLE"] = "worker" if task_id < num_worker else "server"
 
     hadoop_home = env.get("HADOOP_HOME") or env.get("HADOOP_PREFIX")

@@ -21,6 +21,7 @@ worker within DMLC_TRACKER_CLIENT_TIMEOUT seconds, not never.
 from __future__ import annotations
 
 import os
+import queue
 import socket
 import struct
 import threading
@@ -28,22 +29,21 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
-                                        HEARTBEAT_BYE, HEARTBEAT_PING, MAGIC,
+                                        HEARTBEAT_BYE, HEARTBEAT_PING,
+                                        LEASE_ACQUIRE, LEASE_COMPLETE,
+                                        LEASE_DRAINED, LEASE_EMPTY,
+                                        LEASE_GRANT, LEASE_RELEASE, MAGIC,
                                         TrackerAbortedError, WireSocket,
-                                        env_int)
+                                        env_float, env_int)
 
 
 def _default_timeout() -> float:
     """Deadline for every client-side blocking socket op (seconds).
     `0` disables the deadline (the PR 2 convention) — returned as inf,
     which `_sock_timeout` maps back to blocking mode."""
-    raw = os.environ.get("DMLC_TRACKER_CLIENT_TIMEOUT", "300")
-    try:
-        t = float(raw)
-    except ValueError:
-        raise RuntimeError(
-            f"DMLC_TRACKER_CLIENT_TIMEOUT={raw!r} is not a number")
+    t = env_float("DMLC_TRACKER_CLIENT_TIMEOUT", 300.0)
     return float("inf") if t <= 0 else t
 
 
@@ -63,6 +63,25 @@ def _default_jobid() -> str:
     general."""
     task = os.environ.get("DMLC_TASK_ID")
     return f"task{task}" if task else "NULL"
+
+
+# the process's active HeartbeatMonitor — the lease endpoint the elastic
+# data layer (data.RowBlockIter.create with DMLC_ELASTIC_SHARDS=1) resolves
+# without threading the monitor through every constructor
+_active_monitor: Optional["HeartbeatMonitor"] = None
+
+
+def current_monitor() -> Optional["HeartbeatMonitor"]:
+    """The HeartbeatMonitor of this process's most recent rendezvous (set
+    by RendezvousClient when it opens the heartbeat channel, cleared on
+    shutdown), or None. The elastic data layer uses it as the default
+    lease source."""
+    return _active_monitor
+
+
+def _set_active_monitor(mon: Optional["HeartbeatMonitor"]) -> None:
+    global _active_monitor
+    _active_monitor = mon
 
 
 @dataclass
@@ -85,7 +104,14 @@ class HeartbeatMonitor:
     Blocking sockets registered with :meth:`guard` are closed when an
     abort lands, so a worker stuck in a peer accept()/recv() raises
     immediately; the caller then turns that OSError into the structured
-    TrackerAbortedError via :meth:`check`."""
+    TrackerAbortedError via :meth:`check`.
+
+    The elastic data-plane's lease RPCs (doc/robustness.md "Elastic
+    data-plane") ride THIS channel — :meth:`acquire_lease` /
+    :meth:`complete_lease` / :meth:`release_lease` frame onto the same
+    socket (writes serialized against the ping thread), and renewal is
+    implicit in every ping, so no second connection is ever opened per
+    renewal."""
 
     def __init__(self, tracker_host: str, tracker_port: int, rank: int,
                  jobid: str = "NULL", timeout: Optional[float] = None):
@@ -94,7 +120,17 @@ class HeartbeatMonitor:
         self._closing = False
         self._lock = threading.Lock()
         self._guarded: List[socket.socket] = []
+        # lease plumbing: sends interleave with pings under _send_lock;
+        # LEASE_GRANT payloads parsed by the monitor thread land here
+        self._send_lock = threading.Lock()
+        self._grants: "queue.Queue[int]" = queue.Queue()
+        self._lease_lock = threading.Lock()  # one in-flight acquire
+        # epoch of the last LEASE_ACQUIRE sent: a grant that lands after
+        # its ask timed out is an orphan — it must be RELEASED on drain,
+        # or the tracker keeps it held (and every ping renews it) forever
+        self._inflight_epoch: Optional[int] = None
         timeout = _default_timeout() if timeout is None else timeout
+        self.timeout = timeout
         sock = socket.create_connection((tracker_host, tracker_port),
                                         timeout=_sock_timeout(timeout))
         sock.settimeout(_sock_timeout(timeout))
@@ -176,7 +212,8 @@ class HeartbeatMonitor:
         self._closing = True
         if graceful:
             try:
-                self._ws.send_int(HEARTBEAT_BYE)
+                with self._send_lock:
+                    self._ws.send_int(HEARTBEAT_BYE)
             except OSError:
                 pass
         try:
@@ -185,11 +222,90 @@ class HeartbeatMonitor:
             pass
         self._thread.join(timeout=2)
 
+    # -- elastic data-plane lease RPCs (same socket as the pings) ------------
+    def _send_words(self, *vals: int) -> None:
+        with self._send_lock:
+            self._ws.sock.sendall(struct.pack(f"@{len(vals)}i", *vals))
+
+    def acquire_lease(self, epoch: int,
+                      timeout: Optional[float] = None) -> Optional[int]:
+        """Request one shard lease for `epoch` from the tracker.
+
+        Returns the granted shard id, or None when the epoch is drained
+        (every shard complete — end of epoch). While the pool is merely
+        empty (held shards may return if their holder dies), the request
+        is retried until `timeout` (default: the monitor's deadline)
+        elapses, then TimeoutError. Raises TrackerAbortedError when the
+        job aborts mid-wait."""
+        deadline = time.monotonic() + \
+            (self.timeout if timeout is None else timeout)
+        acquire_us = telemetry.histogram("lease_acquire_us")
+        with self._lease_lock:
+            while True:
+                self.check()
+                while True:  # drain grants a timed-out earlier ask orphaned
+                    try:
+                        orphan = self._grants.get_nowait()
+                    except queue.Empty:
+                        break
+                    if orphan >= 0 and self._inflight_epoch is not None:
+                        # a real shard granted to an ask we gave up on:
+                        # hand it straight back or it stays held by this
+                        # (live, pinging, renewing) rank and the epoch
+                        # can never drain. Acquires are serialized under
+                        # _lease_lock, so the orphan belongs to the LAST
+                        # sent ask's epoch; a mismatch is ignored
+                        # tracker-side as stale.
+                        self._send_words(LEASE_RELEASE,
+                                         self._inflight_epoch, orphan)
+                t0 = time.perf_counter()
+                self._inflight_epoch = epoch
+                self._send_words(LEASE_ACQUIRE, epoch)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"rank {self.rank}: no lease grant within the "
+                        f"deadline")
+                try:
+                    grant = self._grants.get(timeout=left)
+                except queue.Empty:
+                    self.check()
+                    raise TimeoutError(
+                        f"rank {self.rank}: tracker answered no lease "
+                        f"request within the deadline")
+                acquire_us.observe((time.perf_counter() - t0) * 1e6)
+                if grant >= 0:
+                    return grant
+                if grant == LEASE_DRAINED:
+                    return None
+                # LEASE_EMPTY: nothing free NOW — a held shard may return
+                # if its holder dies; poll again shortly
+                self.check()
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"rank {self.rank}: lease pool stayed empty past "
+                        f"the deadline")
+                time.sleep(min(0.05, max(deadline - time.monotonic(), 0)))
+
+    def complete_lease(self, epoch: int, shard: int) -> None:
+        """Mark a fully-consumed shard done (the exactly-once checkout)."""
+        self.check()
+        self._send_words(LEASE_COMPLETE, epoch, shard)
+
+    def release_lease(self, epoch: int, shard: int) -> None:
+        """Return an unfinished shard to the pool (this worker is bailing
+        out of it; another worker will pick it up)."""
+        self.check()
+        self._send_words(LEASE_RELEASE, epoch, shard)
+
     def _trip(self, reason: str) -> None:
         with self._lock:
             if self.aborted is None:
                 self.aborted = reason
             guarded, self._guarded = self._guarded, []
+        # wake a lease waiter parked on the grant queue: its next loop
+        # round turns the sentinel into the structured abort via check()
+        self._grants.put(LEASE_EMPTY)
         for s in guarded:
             # shutdown() first: close() alone does NOT unblock a thread
             # already parked in accept()/recv() on this fd (Linux keeps
@@ -213,8 +329,12 @@ class HeartbeatMonitor:
         # partial frames survive across interval timeouts: recv_all would
         # DROP bytes it already buffered when the ping clock fires, and a
         # tracker abort word split across TCP segments would desync the
-        # channel forever — exactly when the abort matters most
+        # channel forever — exactly when the abort matters most. The same
+        # buffering covers the LEASE_GRANT payload word: a grant split
+        # across segments parks in `buf` while pings keep flowing (lease
+        # renewal must not stall on a slow grant).
         buf = b""
+        grant_pending = False  # next word is a LEASE_GRANT payload
         while not self._closing:
             try:
                 chunk = sock.recv(4 - len(buf))
@@ -227,16 +347,25 @@ class HeartbeatMonitor:
                     continue
                 val = struct.unpack("@i", buf)[0]
                 buf = b""
+                if grant_pending:
+                    grant_pending = False
+                    self._grants.put(val)
+                    continue
                 if val == HEARTBEAT_ABORT:
                     sock.settimeout(5.0)
                     reason = self._ws.recv_str()
                     self._trip(reason)
                     return
+                if val == LEASE_GRANT:
+                    grant_pending = True
+                    continue
                 # any other tracker->worker frame is unexpected; ignore
             except socket.timeout:
-                # the quiet interval elapsed: time to ping
+                # the quiet interval elapsed: time to ping (which also
+                # renews every lease this rank holds, tracker-side)
                 try:
-                    self._ws.send_int(HEARTBEAT_PING)
+                    with self._send_lock:
+                        self._ws.send_int(HEARTBEAT_PING)
                 except OSError:
                     if not self._closing:
                         self._trip("heartbeat channel to the tracker lost")
@@ -297,6 +426,8 @@ class RendezvousClient:
             # stop the monitor first so the tracker-side channel EOF is
             # unambiguous teardown, never a liveness trip mid-shutdown
             self.heartbeat.close()
+            if current_monitor() is self.heartbeat:
+                _set_active_monitor(None)
             self.heartbeat = None
         ws = self._dial_tracker("shutdown", rank=rank)
         ws.close()
@@ -312,6 +443,7 @@ class RendezvousClient:
         self.heartbeat = HeartbeatMonitor(
             self.tracker_host, self.tracker_port, rank, jobid=self.jobid,
             timeout=self.timeout)
+        _set_active_monitor(self.heartbeat)
 
     def start(self, rank: int = -1, world_size: int = -1,
               recover: bool = False,
@@ -381,6 +513,8 @@ class RendezvousClient:
                 # must keep running so the job aborts instead of
                 # waiting forever on a rank that never linked
                 monitor.close(graceful=False)
+                if current_monitor() is monitor:
+                    _set_active_monitor(None)
                 self.heartbeat = None
             raise
         # the rendezvous deadline must not outlive the rendezvous: a

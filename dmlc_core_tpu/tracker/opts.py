@@ -55,6 +55,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "marked dead before the job aborts "
                         "(DMLC_TRACKER_RECOVER_GRACE_MS; default half of "
                         "--dead-after-ms)")
+    p.add_argument("--num-shards", default=None, type=int,
+                   help="enable the elastic data-plane: pre-split the "
+                        "dataset into this many logical shard leases "
+                        "(exported as DMLC_TRACKER_NUM_SHARDS + "
+                        "DMLC_ELASTIC_SHARDS=1; pick S >> --num-workers; "
+                        "unset keeps the static num_parts/part_index "
+                        "contract)")
+    p.add_argument("--lease-ttl-ms", default=None, type=int,
+                   help="shard-lease time-to-live without a renewal "
+                        "(DMLC_TRACKER_LEASE_TTL_MS; renewal piggybacks "
+                        "on every heartbeat; default --dead-after-ms + "
+                        "--recover-grace-ms)")
     p.add_argument("--archives", default=[], action="append",
                    help="archive (.zip/.tar*) the in-container bootstrap "
                         "unpacks before exec (reference opts.py archives); "

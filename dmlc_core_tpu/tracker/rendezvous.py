@@ -30,6 +30,18 @@ rendezvous and the tracker observes time passing instead of blocking in
 - `state()` returns a thread-safe per-rank snapshot and `events` / the
   DMLC_TRACKER_EVENT_LOG JSONL file record assign/heartbeat/dead/recover/
   abort transitions for observability.
+
+On top of liveness sits the ELASTIC DATA-PLANE (doc/robustness.md
+"Elastic data-plane"): with ``num_shards > 0`` (DMLC_TRACKER_NUM_SHARDS /
+``dmlc-submit --num-shards``) the dataset is pre-split into S logical
+shards and workers lease them over the existing heartbeat channel
+(wire.LEASE_* frames; every ping implicitly renews). When a rank dies and
+its grace window expires, the tracker — instead of aborting — writes the
+rank off as ``lost``, returns its leases to the pool for the survivors,
+and finishes the job once every rank is shut down or lost; the epoch
+completes without a relaunch. ``state()`` snapshots the lease table
+atomically with the rank table under one lock, so a scrape during
+reassignment can never observe a shard as both pooled and held.
 """
 
 from __future__ import annotations
@@ -49,9 +61,13 @@ from typing import Callable, Dict, List, Optional, Set
 from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.tracker import topology
 from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
-                                        HEARTBEAT_BYE, MAGIC,
+                                        HEARTBEAT_BYE, LEASE_ACQUIRE,
+                                        LEASE_COMPLETE, LEASE_DRAINED,
+                                        LEASE_EMPTY, LEASE_GRANT,
+                                        LEASE_RELEASE, MAGIC,
                                         TrackerAbortedError, bind_free_port,
-                                        env_int, guess_host_ip, resolve_ip)
+                                        env_float, env_int, guess_host_ip,
+                                        resolve_ip)
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
 
@@ -183,6 +199,142 @@ class _RankState:
         self.jobid = "NULL"         # the wire-reported launcher task id
 
 
+class _EpochLeases:
+    """One epoch's shard accounting: every shard is in EXACTLY one of
+    pool / held / done at any instant (the invariant the lease-table
+    snapshot exposes and the chaos suite asserts)."""
+
+    __slots__ = ("pool", "held", "done", "reassigned")
+
+    def __init__(self, num_shards: int):
+        self.pool: List[int] = list(range(num_shards))  # FIFO, lowest first
+        self.held: Dict[int, list] = {}   # shard -> [rank, expires_monotonic]
+        self.done: Dict[int, int] = {}    # shard -> completing rank
+        self.reassigned = 0               # leases reclaimed from their holder
+
+
+class _LeaseManager:
+    """Shard-lease bookkeeping for the elastic data-plane.
+
+    All mutation happens under the TRACKER's lock — the same lock
+    ``state()`` snapshots under, so the rank table and the lease table are
+    always observed atomically (a scrape during reassignment can never see
+    a shard as both pooled and held). Methods take the lock themselves;
+    only :meth:`snapshot_locked` expects the caller to already hold it.
+
+    Exactly-once contract: a shard counts as consumed only when its
+    CURRENT holder completes it. A complete (or release) from a rank whose
+    lease was already reclaimed and regranted is stale and ignored — the
+    new holder's completion is the one that counts."""
+
+    _KEEP_EPOCHS = 4  # stale epoch tables are GC'd as new epochs open
+
+    def __init__(self, num_shards: int, ttl_ms: int, lock: threading.Lock):
+        self.num_shards = num_shards
+        self.ttl_ms = ttl_ms
+        self._lock = lock
+        self._epochs: Dict[int, _EpochLeases] = {}
+        # rank -> {(epoch, shard)} it currently holds (renewal/reclaim index)
+        self._by_rank: Dict[int, set] = {}
+
+    def _epoch(self, epoch: int) -> _EpochLeases:
+        ep = self._epochs.get(epoch)
+        if ep is None:
+            ep = self._epochs[epoch] = _EpochLeases(self.num_shards)
+            for old in [e for e in self._epochs
+                        if e <= epoch - self._KEEP_EPOCHS]:
+                del self._epochs[old]
+                for held in self._by_rank.values():
+                    held.difference_update(
+                        {p for p in held if p[0] == old})
+        return ep
+
+    def acquire(self, rank: int, epoch: int, now: float) -> int:
+        """Grant the lowest pooled shard of `epoch` to `rank`; LEASE_EMPTY
+        when nothing is free NOW (held shards may return if their holder
+        dies — retry), LEASE_DRAINED when every shard is complete."""
+        with self._lock:
+            ep = self._epoch(epoch)
+            if not ep.pool:
+                return (LEASE_DRAINED if len(ep.done) >= self.num_shards
+                        else LEASE_EMPTY)
+            shard = ep.pool.pop(0)
+            ep.held[shard] = [rank, now + self.ttl_ms / 1000.0]
+            self._by_rank.setdefault(rank, set()).add((epoch, shard))
+            return shard
+
+    def renew(self, rank: int, now: float) -> None:
+        """Extend every lease `rank` holds (piggybacked on its ping)."""
+        with self._lock:
+            for epoch, shard in self._by_rank.get(rank, ()):
+                ep = self._epochs.get(epoch)
+                if ep is not None and shard in ep.held \
+                        and ep.held[shard][0] == rank:
+                    ep.held[shard][1] = now + self.ttl_ms / 1000.0
+
+    def release(self, rank: int, epoch: int, shard: int) -> bool:
+        """Return an unfinished shard to the pool (False when stale)."""
+        with self._lock:
+            ep = self._epochs.get(epoch)
+            if ep is None or ep.held.get(shard, [None])[0] != rank:
+                return False
+            del ep.held[shard]
+            ep.pool.append(shard)
+            self._by_rank.get(rank, set()).discard((epoch, shard))
+            return True
+
+    def complete(self, rank: int, epoch: int, shard: int):
+        """Mark a shard consumed. Returns (ok, epoch_drained); ok=False
+        means the lease was reclaimed meanwhile (stale completion)."""
+        with self._lock:
+            ep = self._epochs.get(epoch)
+            if ep is None or ep.held.get(shard, [None])[0] != rank:
+                return False, False
+            del ep.held[shard]
+            ep.done[shard] = rank
+            self._by_rank.get(rank, set()).discard((epoch, shard))
+            return True, len(ep.done) >= self.num_shards
+
+    def reclaim_rank(self, rank: int) -> List[tuple]:
+        """A rank written off (dead past its grace): every lease it holds
+        returns to the pool. Returns the reclaimed (epoch, shard) pairs."""
+        with self._lock:
+            out = []
+            for epoch, shard in sorted(self._by_rank.pop(rank, ())):
+                ep = self._epochs.get(epoch)
+                if ep is not None and ep.held.get(shard, [None])[0] == rank:
+                    del ep.held[shard]
+                    ep.pool.append(shard)
+                    ep.reassigned += 1
+                    out.append((epoch, shard))
+            return out
+
+    def reclaim_expired(self, now: float) -> List[tuple]:
+        """TTL backstop: leases whose holder stopped renewing (silent
+        channel — it would also be dead-marked when liveness is armed)
+        return to the pool. Returns [(epoch, shard, rank)]."""
+        with self._lock:
+            out = []
+            for epoch, ep in self._epochs.items():
+                for shard in [s for s, h in ep.held.items() if now > h[1]]:
+                    rank = ep.held.pop(shard)[0]
+                    ep.pool.append(shard)
+                    ep.reassigned += 1
+                    self._by_rank.get(rank, set()).discard((epoch, shard))
+                    out.append((epoch, shard, rank))
+            return out
+
+    def snapshot_locked(self) -> Dict[str, dict]:
+        """Lease table for state() — the CALLER holds the tracker lock,
+        so ranks and leases snapshot atomically."""
+        return {str(epoch): {
+                    "pool": sorted(ep.pool),
+                    "held": {str(s): h[0] for s, h in ep.held.items()},
+                    "done": sorted(ep.done),
+                    "reassigned": ep.reassigned,
+                } for epoch, ep in sorted(self._epochs.items())}
+
+
 class RabitTracker:
     """The rendezvous server legacy Rabit workers dial into.
 
@@ -195,7 +347,9 @@ class RabitTracker:
                  heartbeat_ms: Optional[int] = None,
                  dead_after_ms: Optional[int] = None,
                  recover_grace_ms: Optional[int] = None,
-                 event_log: Optional[str] = None):
+                 event_log: Optional[str] = None,
+                 num_shards: Optional[int] = None,
+                 lease_ttl_ms: Optional[int] = None):
         self.host_ip = host_ip
         self.num_workers = num_workers
         self.listener = bind_free_port(host_ip, port, port_end)
@@ -237,6 +391,24 @@ class RabitTracker:
         # gauges refresh lazily at snapshot/scrape time (doc/observability.md)
         telemetry.register_collector(self._publish_telemetry)
         self._ranks: Dict[int, _RankState] = {}
+
+        # elastic data-plane: num_shards > 0 pre-splits the dataset into S
+        # logical shard leases served over the heartbeat channel; ctor
+        # beats env, 0 keeps the legacy static num_parts/part_index plane
+        self.num_shards = num_shards if num_shards is not None \
+            else env_int("DMLC_TRACKER_NUM_SHARDS", 0)
+        # default TTL is the backstop for silent channels and must be
+        # strictly LONGER than the primary dead+grace reclaim path, so a
+        # dying rank's leases return via the lost-rank write-off (one
+        # atomic reclaim per rank), not the per-lease expiry sweep
+        self.lease_ttl_ms = lease_ttl_ms if lease_ttl_ms is not None \
+            else env_int("DMLC_TRACKER_LEASE_TTL_MS",
+                         2 * (self.dead_after_ms + self.recover_grace_ms)
+                         if self.dead_after_ms else 30000)
+        self._leases: Optional[_LeaseManager] = \
+            _LeaseManager(self.num_shards, self.lease_ttl_ms, self._lock) \
+            if self.num_shards > 0 else None
+        self._lost_ranks: Set[int] = set()
         self._dead_callbacks: List[Callable[[int, Dict[str, object]], None]] \
             = []
         self._notify_q: "queue.Queue" = queue.Queue()
@@ -251,6 +423,11 @@ class RabitTracker:
         self._pending: List[_Conn] = []
         self._todo: List[int] = []
         self._assigned: Set[int] = set()
+        # ranks whose link dance COMPLETED (set after _assign_dance
+        # returns): the elastic write-off is only safe once every dance
+        # is done — a rank dying mid-dance leaves survivors parked in
+        # peer accept()/recv() that only the abort broadcast unblocks
+        self._linked: Set[int] = set()
         self._maps = None
         self._pending_ports: Set[int] = set()
         self._port_waiters: List[_Conn] = []
@@ -285,7 +462,18 @@ class RabitTracker:
         telemetry.gauge("tracker_alive").set(1 if st["alive"] else 0)
         telemetry.gauge("tracker_finished").set(1 if st["finished"] else 0)
         telemetry.gauge("tracker_aborted").set(1 if st["aborted"] else 0)
-        phase_code = {"assigned": 0, "alive": 1, "dead": 2, "shutdown": 3}
+        for epoch, tbl in (st.get("leases") or {}).items():
+            labels = {"epoch": epoch}
+            telemetry.gauge("tracker_lease_pool", labels).set(
+                len(tbl["pool"]))
+            telemetry.gauge("tracker_lease_held", labels).set(
+                len(tbl["held"]))
+            telemetry.gauge("tracker_lease_done", labels).set(
+                len(tbl["done"]))
+            telemetry.gauge("tracker_lease_reassigned", labels).set(
+                tbl["reassigned"])
+        phase_code = {"assigned": 0, "alive": 1, "dead": 2, "shutdown": 3,
+                      "lost": 4}
         for rank, info in st["ranks"].items():
             labels = {"rank": str(rank)}
             telemetry.gauge("tracker_rank_phase_code", labels).set(
@@ -298,9 +486,17 @@ class RabitTracker:
             telemetry.gauge("tracker_rank_attempts", labels).set(
                 info["attempts"])
 
+    @property
+    def elastic(self) -> bool:
+        """True when the elastic data-plane (shard leases) is enabled."""
+        return self._leases is not None
+
     def state(self) -> Dict[str, object]:
         """Thread-safe snapshot: per-rank phase / last-heartbeat age /
-        restart counts plus job-level status."""
+        restart counts plus job-level status. With the elastic data-plane
+        enabled it also carries the live lease table — snapshotted under
+        the SAME lock acquisition as the rank table, so a scrape during
+        reassignment can never observe a shard as both pooled and held."""
         now = time.monotonic()
         with self._lock:
             ranks = {}
@@ -314,7 +510,7 @@ class RabitTracker:
                     "last_heartbeat_age_s":
                         None if st.last_beat is None else now - st.last_beat,
                 }
-            return {
+            out = {
                 "num_workers": self.num_workers,
                 "port": self.port,
                 "alive": self.alive(),
@@ -324,8 +520,15 @@ class RabitTracker:
                 "heartbeat_ms": self.heartbeat_ms,
                 "dead_after_ms": self.dead_after_ms,
                 "recover_grace_ms": self.recover_grace_ms,
+                "elastic": self._leases is not None,
+                "num_shards": self.num_shards,
+                "lost_ranks": sorted(self._lost_ranks),
                 "ranks": ranks,
             }
+            if self._leases is not None:
+                out["lease_ttl_ms"] = self.lease_ttl_ms
+                out["leases"] = self._leases.snapshot_locked()
+            return out
 
     def on_rank_dead(self, callback: Callable[[int, Dict[str, object]], None]
                      ) -> None:
@@ -377,6 +580,11 @@ class RabitTracker:
         if self.heartbeat_ms > 0:
             envs["DMLC_TRACKER_HEARTBEAT_MS"] = self.heartbeat_ms
             envs["DMLC_TRACKER_DEAD_AFTER_MS"] = self.dead_after_ms
+        if self.num_shards > 0:
+            # the data layer's elastic opt-in rides the same env ABI:
+            # RowBlockIter.create switches to lease-driven iteration
+            envs["DMLC_ELASTIC_SHARDS"] = 1
+            envs["DMLC_TRACKER_NUM_SHARDS"] = self.num_shards
         return envs
 
     def start(self) -> None:
@@ -455,8 +663,7 @@ class RabitTracker:
     # -- the event loop ------------------------------------------------------
     def _serve(self, num_workers: int) -> None:
         self._num_workers = num_workers
-        handshake_timeout = float(
-            os.environ.get("DMLC_TRACKER_HANDSHAKE_TIMEOUT", "300"))
+        handshake_timeout = env_float("DMLC_TRACKER_HANDSHAKE_TIMEOUT", 300.0)
         self._max_world = env_int("DMLC_TRACKER_MAX_WORLD", 1 << 20)
 
         sel = selectors.DefaultSelector()
@@ -538,6 +745,13 @@ class RabitTracker:
                      and now - c.last_activity > handshake_timeout]:
             self._drop(conn, f"handshake timed out after "
                              f"{handshake_timeout:.0f}s")
+        if self._leases is not None:
+            # TTL backstop (runs even with liveness disarmed): a holder
+            # that stopped renewing — silent channel — forfeits its shards
+            for epoch, shard, rank in self._leases.reclaim_expired(now):
+                telemetry.counter("tracker_lease_reassigned_total").inc()
+                self._emit("lease-expired", rank=rank, epoch=epoch,
+                           shard=shard)
         if self.dead_after_ms <= 0:
             return
         with self._lock:
@@ -552,14 +766,30 @@ class RabitTracker:
         expired = [r for r, st in items
                    if st.phase == "dead" and st.dead_since is not None
                    and now - st.dead_since > self.recover_grace_ms / 1000.0]
-        if expired:
+        if not expired:
+            return
+        if self._leases is not None:
             with self._lock:
-                all_dead = [r for r, st in self._ranks.items()
-                            if st.phase == "dead"]
-            self._do_abort(TrackerAbortedError(
-                f"rank(s) {sorted(expired)} missed the heartbeat deadline "
-                f"({self.dead_after_ms} ms) and did not recover within the "
-                f"grace window ({self.recover_grace_ms} ms)", all_dead))
+                every_dance_done = len(self._linked) >= self._num_workers
+            if every_dance_done:
+                # elastic: degrade gracefully instead of failing loudly —
+                # the rank is written off, its leases migrate to the
+                # survivors, and the epoch completes without a relaunch
+                for rank in expired:
+                    self._mark_lost(rank)
+                self._check_finished()
+                return
+            # a rank died before the rendezvous completed: survivors may
+            # be parked in peer accept()/recv() waits that only the abort
+            # broadcast unblocks — graceful degradation applies to the
+            # data plane, never to a half-built link topology
+        with self._lock:
+            all_dead = [r for r, st in self._ranks.items()
+                        if st.phase == "dead"]
+        self._do_abort(TrackerAbortedError(
+            f"rank(s) {sorted(expired)} missed the heartbeat deadline "
+            f"({self.dead_after_ms} ms) and did not recover within the "
+            f"grace window ({self.recover_grace_ms} ms)", all_dead))
 
     def _mark_dead(self, rank: int, now: float) -> None:
         st = self._ranks[rank]
@@ -573,6 +803,61 @@ class RabitTracker:
         self._emit("heartbeat-miss", rank=rank, age_ms=age)
         self._emit("dead", rank=rank, host=st.host)
         self._notify_dead(rank)
+
+    def _mark_lost(self, rank: int) -> None:
+        """Elastic write-off: a dead rank past its grace window stops
+        blocking the job — its leases return to the pool for the
+        survivors and the rank no longer owes a shutdown."""
+        with self._lock:
+            st = self._ranks.get(rank)
+            if st is None or st.phase != "dead":
+                return
+            st.phase = "lost"
+            st.dead_since = None
+            self._lost_ranks.add(rank)
+        reclaimed = self._leases.reclaim_rank(rank)
+        telemetry.counter("tracker_lease_reassigned_total").inc(
+            len(reclaimed))
+        logger.warning(
+            "rank %d written off (elastic): %d lease(s) returned to the "
+            "pool; the job continues on the surviving workers", rank,
+            len(reclaimed))
+        self._emit("lost", rank=rank, reclaimed=len(reclaimed))
+        for epoch, shard in reclaimed:
+            self._emit("lease-reclaim", rank=rank, epoch=epoch, shard=shard)
+
+    def _check_finished(self) -> None:
+        """Elastic finish rule (serve loop only): the job completes once
+        every rank is checked out OR written off as lost — unless EVERY
+        rank is lost, in which case nobody can finish the epoch and the
+        job aborts loudly instead of idling forever."""
+        if self._leases is None:
+            return
+        with self._lock:
+            lost = set(self._lost_ranks)
+        if len(lost) >= self._num_workers:
+            self._do_abort(TrackerAbortedError(
+                "every rank was written off as lost — no surviving worker "
+                "can finish the epoch", sorted(lost)))
+            return  # aborted is terminal: never also mark finished
+        if self._maps is not None and not self._todo and \
+                len(self._shutdown_ranks | lost) >= self._num_workers:
+            self._finished = True
+
+    def _beat(self, st: _RankState, rank: int) -> bool:
+        """Record a liveness proof from `rank` (a ping or any lease
+        frame); True when the beat revived a dead- or lost-marked rank."""
+        with self._lock:
+            st.last_beat = time.monotonic()
+            if st.phase in ("dead", "lost"):
+                # beats resumed inside (dead) or even after (lost) the
+                # grace window: the rank is back — a lost rank's leases
+                # were already reassigned, it simply resumes acquiring
+                st.phase = "alive"
+                st.dead_since = None
+                self._lost_ranks.discard(rank)
+                return True
+            return False
 
     def _do_abort(self, err: TrackerAbortedError) -> None:
         """Broadcast the abort to every live heartbeat channel, close
@@ -825,6 +1110,10 @@ class RabitTracker:
             logger.debug("rank %d shut down", rank)
             if len(self._shutdown_ranks) == self._num_workers:
                 self._finished = True
+            else:
+                # elastic: lost ranks owe no shutdown — this checkout may
+                # have been the last one the job was waiting for
+                self._check_finished()
             return
         if cmd == CMD_HEARTBEAT:
             if rank not in self._assigned:
@@ -876,6 +1165,8 @@ class RabitTracker:
         else:
             self._rank_recovering(rank, cmd)
         yield from self._assign_dance(conn, rank)
+        with self._lock:
+            self._linked.add(rank)
         logger.debug("%s rank %d linked (%s)", cmd, rank, conn.host)
 
     def _maybe_assign_batch(self) -> None:
@@ -904,6 +1195,8 @@ class RabitTracker:
 
     def _rank_recovering(self, rank: int, cmd: str) -> None:
         with self._lock:
+            # about to re-dance: the rank is unlinked until it completes
+            self._linked.discard(rank)
             st = self._ranks.setdefault(rank, _RankState())
             was_dead = st.phase == "dead"
             if cmd == "recover":
@@ -913,6 +1206,9 @@ class RabitTracker:
             st.phase = "assigned"
             st.dead_since = None
             st.last_beat = None
+            # a written-off rank that recovers is tracked again (its old
+            # leases were already reassigned; it resumes acquiring fresh)
+            self._lost_ranks.discard(rank)
         if cmd == "recover":
             self._emit("recover", rank=rank, was_dead=was_dead)
 
@@ -942,9 +1238,48 @@ class RabitTracker:
         # announce the ping interval the worker should hold
         self._send_int(conn, self.heartbeat_ms if self.heartbeat_ms > 0
                        else 1000)
+        # the lease RPCs ride THIS channel (doc/robustness.md "Elastic
+        # data-plane"): no second connection per renewal, and every lease
+        # frame doubles as a liveness proof. Metric resolved once per
+        # channel (registry contract: resolve, keep the pointer).
+        renew_us = telemetry.histogram("lease_renew_us")
         while True:
-            word = yield 4  # one int32 ping (or a graceful BYE)
-            if struct.unpack("@i", word)[0] == HEARTBEAT_BYE:
+            word = yield 4  # one int32 ping / lease command / graceful BYE
+            val = struct.unpack("@i", word)[0]
+            if val == LEASE_ACQUIRE:
+                epoch = yield from _r_int()
+                revived = self._beat(st, rank)
+                grant = (self._leases.acquire(rank, epoch, time.monotonic())
+                         if self._leases is not None else LEASE_DRAINED)
+                self._send_bytes(conn, struct.pack("@ii", LEASE_GRANT,
+                                                   grant))
+                if grant >= 0:
+                    self._emit("lease-grant", rank=rank, epoch=epoch,
+                               shard=grant)
+                if revived:
+                    self._emit("revived", rank=rank)
+                continue
+            if val in (LEASE_RELEASE, LEASE_COMPLETE):
+                epoch = yield from _r_int()
+                shard = yield from _r_int()
+                revived = self._beat(st, rank)
+                if self._leases is not None:
+                    if val == LEASE_RELEASE:
+                        if self._leases.release(rank, epoch, shard):
+                            self._emit("lease-release", rank=rank,
+                                       epoch=epoch, shard=shard)
+                    else:
+                        ok, drained = self._leases.complete(rank, epoch,
+                                                            shard)
+                        self._emit("lease-complete" if ok
+                                   else "lease-stale-complete",
+                                   rank=rank, epoch=epoch, shard=shard)
+                        if ok and drained:
+                            self._emit("epoch-drained", epoch=epoch)
+                if revived:
+                    self._emit("revived", rank=rank)
+                continue
+            if val == HEARTBEAT_BYE:
                 # graceful channel close (normal shutdown path): disarm
                 # liveness for this rank — a BYE is teardown, never a
                 # death, so no heartbeat-lost noise and no dead clock
@@ -961,15 +1296,14 @@ class RabitTracker:
                             st.last_beat = None
                 self._emit("heartbeat-bye", rank=rank)
                 return
-            revived = False
-            with self._lock:
-                st.last_beat = time.monotonic()
-                if st.phase == "dead":
-                    # beats resumed inside the grace window (network blip,
-                    # paused VM): the rank is alive after all
-                    st.phase = "alive"
-                    st.dead_since = None
-                    revived = True
+            # a plain ping (any non-negative value): liveness proof plus
+            # implicit renewal of every lease this rank holds
+            revived = self._beat(st, rank)
+            if self._leases is not None:
+                t0 = time.perf_counter() if telemetry.enabled() else None
+                self._leases.renew(rank, time.monotonic())
+                if t0 is not None:
+                    renew_us.observe((time.perf_counter() - t0) * 1e6)
             if revived:  # _emit takes the lock itself — never nest it
                 self._emit("revived", rank=rank)
 
@@ -1151,7 +1485,8 @@ class PSTracker:
 def run_job(num_workers: int, num_servers: int, launch_fn, host_ip="auto",
             ps_cmd: Optional[str] = None,
             heartbeat_ms: Optional[int] = None,
-            dead_after_ms: Optional[int] = None) -> None:
+            dead_after_ms: Optional[int] = None,
+            num_shards: Optional[int] = None) -> None:
     """Start the right tracker and hand worker envs to a cluster launcher
     (reference tracker.submit, tracker.py:410-433). A launch_fn accepting
     a 4th argument receives the RabitTracker so supervising backends can
@@ -1162,7 +1497,8 @@ def run_job(num_workers: int, num_servers: int, launch_fn, host_ip="auto",
     if num_servers == 0:
         tracker = RabitTracker(host_ip, num_workers,
                                heartbeat_ms=heartbeat_ms,
-                               dead_after_ms=dead_after_ms)
+                               dead_after_ms=dead_after_ms,
+                               num_shards=num_shards)
         envs.update(tracker.worker_envs())
         tracker.start()
         if tracker.alive():

@@ -27,10 +27,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     # itself) reads — one export point covers local/ssh/k8s/yarn/... alike
     for flag, env in (("heartbeat_ms", "DMLC_TRACKER_HEARTBEAT_MS"),
                       ("dead_after_ms", "DMLC_TRACKER_DEAD_AFTER_MS"),
-                      ("recover_grace_ms", "DMLC_TRACKER_RECOVER_GRACE_MS")):
+                      ("recover_grace_ms", "DMLC_TRACKER_RECOVER_GRACE_MS"),
+                      ("num_shards", "DMLC_TRACKER_NUM_SHARDS"),
+                      ("lease_ttl_ms", "DMLC_TRACKER_LEASE_TTL_MS")):
         v = getattr(args, flag, None)
         if v is not None:
             os.environ[env] = str(v)
+    if getattr(args, "num_shards", None):
+        # the worker-side data layer's elastic opt-in rides the env ABI
+        os.environ["DMLC_ELASTIC_SHARDS"] = "1"
     backend = BACKENDS.get(args.cluster)
     if backend is None:
         raise SystemExit(f"unknown cluster backend {args.cluster!r}")

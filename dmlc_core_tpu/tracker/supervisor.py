@@ -87,6 +87,7 @@ class WorkerSupervisor:
         # dead-rank notifier thread once attach_tracker is used
         self._lock = threading.Lock()
         self._tracker = None
+        self._proactive_relaunch: Optional[bool] = None
         self._rank_to_task: Callable[[int], int] = lambda rank: rank
 
     def add(self, task_id: int, role: str,
@@ -95,8 +96,8 @@ class WorkerSupervisor:
         self._tasks.append(_TaskState(task_id, role, start))
 
     def attach_tracker(self, tracker,
-                       rank_to_task: Optional[Callable[[int], int]] = None
-                       ) -> None:
+                       rank_to_task: Optional[Callable[[int], int]] = None,
+                       proactive_relaunch: Optional[bool] = None) -> None:
         """Wire liveness both ways with a RabitTracker: subscribe to its
         dead-rank notifications for proactive relaunch, and report
         attempt exhaustion back as a job abort.
@@ -107,8 +108,17 @@ class WorkerSupervisor:
         as info["task_id"] — authoritative, since ranks are assigned by
         host-sorted arrival and need NOT equal task ids), then
         `rank_to_task` (default: identity) for legacy workers that
-        report no jobid."""
+        report no jobid.
+
+        `proactive_relaunch=None` (default) relaunches on a dead-rank
+        signal UNLESS the tracker runs the elastic data-plane — there the
+        dead rank's shard leases migrate to the survivors and the epoch
+        completes without the replacement, so a relaunch is optional
+        capacity restoration, not a liveness requirement. Pass True/False
+        to override either way (the watch loop's relaunch of nonzero
+        exits is unaffected)."""
         self._tracker = tracker
+        self._proactive_relaunch = proactive_relaunch
         if rank_to_task is not None:
             self._rank_to_task = rank_to_task
         tracker.on_rank_dead(self._on_rank_dead)
@@ -158,6 +168,16 @@ class WorkerSupervisor:
     def _on_rank_dead(self, rank: int, info: Dict[str, object]) -> None:
         """Tracker liveness callback: relaunch the dead rank's task NOW —
         ahead of the (possibly minutes-slow) status poll."""
+        proactive = self._proactive_relaunch
+        if proactive is None:
+            # elastic tracker: the dead rank's leases migrate after the
+            # grace window — the job completes without the relaunch
+            proactive = not getattr(self._tracker, "elastic", False)
+        if not proactive:
+            logger.info(
+                "rank %d dead signal: proactive relaunch skipped (elastic "
+                "data-plane — leases migrate to the survivors)", rank)
+            return
         task_id = info.get("task_id")  # wire-reported: authoritative
         if not isinstance(task_id, int):
             try:

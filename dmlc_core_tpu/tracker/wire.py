@@ -18,15 +18,65 @@ MAGIC = 0xFF99
 # A worker that opts into liveness opens a SECOND tracker connection with
 # cmd="heartbeat" after receiving its rank. The channel carries int32 pings
 # (worker -> tracker, any non-negative value) on the interval the tracker
-# announces right after the handshake; the only tracker -> worker frame is
+# announces right after the handshake; the only tracker -> worker frames are
 # HEARTBEAT_ABORT followed by a length-prefixed reason string, broadcast
 # when the job is being torn down so workers raise instead of hanging in
-# peer links. Legacy clients never send cmd="heartbeat", so the original
-# start/recover/shutdown/print byte stream is untouched.
+# peer links, and LEASE_GRANT (below). Legacy clients never send
+# cmd="heartbeat", so the original start/recover/shutdown/print byte stream
+# is untouched.
 CMD_HEARTBEAT = "heartbeat"
 HEARTBEAT_PING = 1
 HEARTBEAT_BYE = 2   # graceful channel close: disarms liveness, not a death
 HEARTBEAT_ABORT = -86
+
+# -- elastic data-plane lease frames (doc/robustness.md "Elastic data-plane")
+# Shard leases ride the EXISTING heartbeat channel — no second connection
+# per renewal, and every lease frame doubles as a liveness proof. All
+# command words are negative so they can never collide with a ping (any
+# non-negative int32). Worker -> tracker frames:
+#   [LEASE_ACQUIRE][epoch]          ask for one shard of `epoch`
+#   [LEASE_RELEASE][epoch][shard]   return an unfinished shard to the pool
+#   [LEASE_COMPLETE][epoch][shard]  mark the shard consumed (exactly-once)
+# The tracker answers an acquire with [LEASE_GRANT][shard] where `shard`
+# is a shard id >= 0, LEASE_EMPTY (nothing free NOW — held shards may
+# return if their holder dies; retry), or LEASE_DRAINED (every shard of
+# the epoch is complete: end of epoch). Renewal is implicit: every ping
+# (and every lease frame) extends all leases the rank holds.
+LEASE_ACQUIRE = -90
+LEASE_RELEASE = -91
+LEASE_COMPLETE = -92
+LEASE_GRANT = -93
+LEASE_EMPTY = -1
+LEASE_DRAINED = -2
+
+
+def env_float(name: str, default: float, env=None) -> float:
+    """Checked float env parse (the env_int rule for float-valued knobs
+    like DMLC_TRACKER_HANDSHAKE_TIMEOUT): garbage text raises instead of
+    silently disabling a deadline."""
+    import os
+    raw = (os.environ if env is None else env).get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise RuntimeError(f"{name}={raw!r} is not a number")
+
+
+def env_enum(name: str, choices, default: Optional[str] = None,
+             env=None) -> Optional[str]:
+    """Checked enum env parse: a set value outside `choices` raises with
+    the allowed set named (a typo'd DMLC_JOB_CLUSTER must fail in the
+    container bootstrap, not silently select a default backend)."""
+    import os
+    raw = (os.environ if env is None else env).get(name)
+    if raw is None or raw == "":
+        return default
+    if raw not in choices:
+        raise RuntimeError(
+            f"{name}={raw!r} is not one of {sorted(choices)}")
+    return raw
 
 
 def env_int(name: str, default: int, env=None) -> int:

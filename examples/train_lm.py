@@ -11,10 +11,12 @@ Two flagship configurations, both driven from one script:
   python examples/train_lm.py corpus.txt --model tp --mesh data=2,model=4
 
 The corpus is any text/binary file; tokens are raw bytes (vocab 256), so
-there is no external tokenizer. Windows are sampled deterministically.
-Under dmlc-submit each host trains its own byte range of the corpus
-(process_part — the reference's distributed-read contract, composed with
-the chip-level mesh).
+there is no external tokenizer. Windows are sampled deterministically:
+each step's GLOBAL batch is seeded by (seed, step) over the whole corpus
+and every host takes its contiguous row slice (process_part), so the
+global batch stream is identical no matter when the run was resumed —
+the elastic data-plane determinism rule (doc/robustness.md), applied to
+the example's sampler.
 
 Smoke-testable on CPU:  JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -47,25 +49,30 @@ def parse_mesh(spec: str):
     return tuple(out)
 
 
-def load_part(path: str, part: int, npart: int, seq: int) -> np.ndarray:
-    """This host's byte slice of the corpus (read once, sampled per step)."""
-    size = os.path.getsize(path)
-    lo = size * part // npart
-    hi = size * (part + 1) // npart
-    span = hi - lo
-    if span < seq + 1:
-        raise SystemExit(f"corpus part {part}/{npart} has {span} bytes; "
+def load_corpus(path: str, seq: int) -> np.ndarray:
+    """The whole corpus, memory-mapped (each host reads only the window
+    bytes it samples — no per-host byte-slice copy)."""
+    if os.path.getsize(path) < seq + 1:
+        raise SystemExit(f"corpus has {os.path.getsize(path)} bytes; "
                          f"need at least seq+1={seq + 1}")
-    with open(path, "rb") as f:
-        f.seek(lo)
-        return np.frombuffer(f.read(span), np.uint8)
+    return np.memmap(path, np.uint8, mode="r")
 
 
-def byte_windows(data: np.ndarray, seq: int, batch: int, rng) -> np.ndarray:
-    """[batch, seq+1] int32 windows sampled uniformly (the final window,
-    ending on the slice's last byte, included)."""
-    starts = rng.integers(0, data.size - seq, size=batch)
-    return np.stack([data[s:s + seq + 1] for s in starts]).astype(np.int32)
+def byte_windows(data: np.ndarray, seq: int, batch: int, seed: int,
+                 step: int, part: int = 0, npart: int = 1) -> np.ndarray:
+    """[batch, seq+1] int32 windows for THIS host at `step`.
+
+    The GLOBAL stream of npart*batch windows per step is seeded by
+    (seed, step) alone and sampled over the whole corpus — never by which
+    host draws it (the elastic data-plane determinism rule,
+    doc/robustness.md): a resumed run continues the identical stream from
+    any step with no sampler replay, and every host slices its contiguous
+    rows out of the same global batch."""
+    rng = np.random.default_rng([seed, step])
+    starts = rng.integers(0, data.size - seq, size=npart * batch)
+    mine = starts[part * batch:(part + 1) * batch]
+    return np.stack([np.asarray(data[s:s + seq + 1])
+                     for s in mine]).astype(np.int32)
 
 
 def main() -> int:
@@ -180,15 +187,13 @@ def main() -> int:
                 f"checkpoint was written under a different run identity "
                 f"(stored vs now): {mismatch}")
         print(f"resumed from {args.resume}{suffix} at step {start}")
-    data = load_part(args.corpus, part, npart, args.seq)
-    rng = np.random.default_rng(args.seed + part)
-    # replay the sampler to the resume point so the data stream continues
-    # where the interrupted run left off (windows are rng-driven)
-    for _ in range(start):
-        rng.integers(0, data.size - args.seq, size=batch)
+    data = load_corpus(args.corpus, args.seq)
     first = last = None
     for step in range(start, args.steps):
-        w = byte_windows(data, args.seq, batch, rng)
+        # per-step seeding: no sampler replay needed on resume — step s
+        # draws the same global windows whether or not the run restarted
+        w = byte_windows(data, args.seq, batch, args.seed, step,
+                         part, npart)
         params, loss = model.step(params, w[:, :-1], w[:, 1:])
         last = float(loss)
         if first is None:

@@ -1,0 +1,83 @@
+"""Chaos worker driven by tests/test_elastic_data_plane.py.
+
+A real OS process that joins the rendezvous with heartbeats and consumes
+the shared dataset through ElasticRowBlockIter (tracker-granted shard
+leases). Every shard it checks out is appended — one fsync'd line of
+``<shard> <sha256-of-batches>`` per shard — to ``consumed_<task>`` in the
+scratch dir, so the test can assert exactly-once coverage and
+byte-identical global streams across runs.
+
+The victim (ELASTIC_VICTIM=1) completes its first shard, acquires a
+second, and SIGKILLs itself while HOLDING that lease — no BYE, no
+release: the tracker's liveness layer must mark it dead, write it off as
+lost after the grace window, and return the shard to the pool for the
+survivors. Everyone else drains the epoch and shuts down cleanly.
+
+Usage: python elastic_worker.py <repo_root> <scratch_dir> <data_uri>
+"""
+
+import hashlib
+import io
+import os
+import signal
+import sys
+
+
+def main() -> None:
+    repo, scratch, uri = sys.argv[1], sys.argv[2], sys.argv[3]
+    sys.path.insert(0, repo)
+    from dmlc_core_tpu.data import ElasticRowBlockIter
+    from dmlc_core_tpu.tracker.client import RendezvousClient
+    from dmlc_core_tpu.tracker.wire import env_int
+
+    task = int(os.environ["DMLC_TASK_ID"])
+    victim = os.environ.get("ELASTIC_VICTIM") == "1"
+    num_shards = env_int("DMLC_TRACKER_NUM_SHARDS", 0)
+
+    client = RendezvousClient(os.environ["DMLC_TRACKER_URI"],
+                              int(os.environ["DMLC_TRACKER_PORT"]))
+    assign = client.start(heartbeat=True)
+    with open(os.path.join(scratch, f"rank_{task}"), "w") as f:
+        f.write(str(assign.rank))
+
+    # sync point (files, not sleeps): survivors hold off consuming until
+    # the victim is armed — i.e. actually HOLDS a lease — so the chaos is
+    # deterministic instead of racing the pool drain
+    armed = os.path.join(scratch, "victim_armed")
+    if not victim and os.environ.get("ELASTIC_WAIT_ARMED") == "1":
+        import time
+        deadline = time.monotonic() + 60
+        while not os.path.exists(armed):
+            if time.monotonic() > deadline:
+                sys.exit(5)
+            time.sleep(0.01)
+
+    it = ElasticRowBlockIter(uri, client.heartbeat, num_shards,
+                             shuffle_window=32, run_id=7,
+                             acquire_timeout=60)
+    out = open(os.path.join(scratch, f"consumed_{task}"), "a")
+    n = 0
+    for shard, batches in it.shards():
+        if victim and n == 1:
+            # die the hard way, HOLDING this shard's lease: no release,
+            # no BYE — only the liveness layer can return it to the pool
+            with open(armed, "w") as f:
+                f.write(str(shard))
+                f.flush()
+                os.fsync(f.fileno())
+            os.kill(os.getpid(), signal.SIGKILL)
+        h = hashlib.sha256()
+        for b in batches:
+            buf = io.BytesIO()
+            b.save(buf)
+            h.update(buf.getvalue())
+        out.write(f"{shard} {h.hexdigest()}\n")
+        out.flush()
+        os.fsync(out.fileno())
+        n += 1
+    out.close()
+    client.shutdown(assign.rank)
+
+
+if __name__ == "__main__":
+    main()

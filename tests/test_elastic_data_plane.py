@@ -1,0 +1,490 @@
+"""Elastic data-plane chaos suite (doc/robustness.md "Elastic data-plane").
+
+Pins the two properties the lease layer promises:
+
+- **Exactly-once coverage under churn**: SIGKILL a worker mid-epoch while
+  it HOLDS a shard lease, with no supervisor relaunch — the job still
+  completes, the dead rank's shards migrate to the survivors within a
+  wall-clock bound derived from DMLC_TRACKER_DEAD_AFTER_MS + the grace
+  window, and the union of consumed shards covers the dataset exactly
+  once (no loss, no double-read).
+- **Seed-deterministic global stream**: worker sets of size {1, 2, 4} —
+  including one with a mid-epoch death and one with a late joiner —
+  produce byte-identical global batch streams, because every shard's
+  batches are seeded by (run_id, epoch, shard_id), never by rank.
+
+Plus the satellites: the `/state` lease table snapshots atomically with
+the rank table (a scrape during reassignment can never see a shard as
+both pooled and held), legacy static mode stays the untouched default,
+and the dmlc-submit / bootstrap knob validation.
+"""
+
+import hashlib
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data import (ElasticRowBlockIter, LocalLeases,
+                                RowBlockIter)
+from dmlc_core_tpu.tracker.client import RendezvousClient
+from dmlc_core_tpu.tracker.rendezvous import RabitTracker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "elastic_worker.py")
+
+# chaos timings: heartbeat every 100 ms, dead after 800 ms of silence,
+# 400 ms grace -> reclaim must land within dead_after + grace (+ slack)
+HB_MS, DEAD_MS, GRACE_MS = 100, 800, 400
+NUM_SHARDS = 8
+
+
+def write_libsvm(path, rows=640, features=4, seed=5):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(rows):
+            feats = " ".join(
+                f"{j}:{rng.uniform():.5f}" for j in range(1, features))
+            f.write(f"{i % 2} 0:{float(i):.1f} {feats}\n")
+    return str(path)
+
+
+def digest_batches(batches):
+    h = hashlib.sha256()
+    for b in batches:
+        buf = io.BytesIO()
+        b.save(buf)
+        h.update(buf.getvalue())
+    return h.hexdigest()
+
+
+# -- the acceptance bound, end to end (real processes) ------------------------
+def test_sigkill_mid_epoch_completes_without_relaunch(tmp_path):
+    """SIGKILL one worker mid-epoch while it HOLDS a lease, nobody
+    relaunches -> the job COMPLETES (no abort), the union of consumed
+    shards covers the dataset exactly once, and the tail (kill -> finish)
+    fits the dead_after + grace reclaim bound."""
+    data = write_libsvm(tmp_path / "chaos.libsvm")
+    tracker = RabitTracker("127.0.0.1", 2, heartbeat_ms=HB_MS,
+                           dead_after_ms=DEAD_MS, recover_grace_ms=GRACE_MS,
+                           num_shards=NUM_SHARDS)
+    tracker.start()
+
+    def spawn(task, extra):
+        env = dict(os.environ)
+        env.update({str(k): str(v)
+                    for k, v in tracker.worker_envs().items()})
+        env.update({"DMLC_TASK_ID": str(task),
+                    "DMLC_TRACKER_CLIENT_TIMEOUT": "60"})
+        env.update(extra)
+        return subprocess.Popen(
+            [sys.executable, WORKER, REPO, str(tmp_path), data],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+    victim = spawn(0, {"ELASTIC_VICTIM": "1"})
+    survivor = spawn(1, {"ELASTIC_WAIT_ARMED": "1"})
+
+    victim.wait(timeout=60)  # SIGKILLs itself holding its second lease
+    t_kill = time.monotonic()
+    assert victim.returncode == -9
+
+    # detection starts at the channel EOF; the shard returns to the pool
+    # at dead_after + grace; the survivor then drains a few tiny shards —
+    # 2x the reclaim latency plus fixed slack bounds the whole tail
+    bound = 2 * (DEAD_MS + GRACE_MS) / 1000.0 + 2.0
+    survivor.wait(timeout=bound + 30)
+    stderr = survivor.stderr.read().decode()
+    assert survivor.returncode == 0, stderr
+    tracker.join(timeout=30)  # must NOT raise: completed, not aborted
+    tail = time.monotonic() - t_kill
+    assert tail <= bound, f"kill -> finish took {tail:.2f}s > {bound:.2f}s"
+
+    # exactly-once: every shard consumed by exactly one worker
+    consumed = []
+    for task in (0, 1):
+        path = tmp_path / f"consumed_{task}"
+        if path.exists():
+            consumed += [int(line.split()[0])
+                         for line in path.read_text().splitlines()]
+    assert sorted(consumed) == list(range(NUM_SHARDS)), consumed
+    # the shard the victim died holding was reassigned and re-consumed
+    held_at_death = int((tmp_path / "victim_armed").read_text())
+    survivor_shards = [int(line.split()[0]) for line in
+                       (tmp_path / "consumed_1").read_text().splitlines()]
+    assert held_at_death in survivor_shards
+
+    st = tracker.state()
+    assert st["finished"] and not st["aborted"]
+    victim_rank = int((tmp_path / "rank_0").read_text())
+    assert st["lost_ranks"] == [victim_rank]
+    assert st["ranks"][victim_rank]["phase"] == "lost"
+    assert st["leases"]["0"]["done"] == list(range(NUM_SHARDS))
+    assert st["leases"]["0"]["reassigned"] >= 1
+    events = [e["event"] for e in tracker.events]
+    assert "lost" in events and "lease-reclaim" in events
+    assert "abort" not in events
+
+
+# -- the determinism property (in-process worker sets) ------------------------
+def _run_worker_set(data, size, dying=None, late=None):
+    """One elastic job with `size` workers (threads); worker `dying`
+    acquires a lease then dies abruptly without completing it, worker
+    `late` starts consuming only after a delay. Returns the global
+    stream {shard: batch-stream digest}."""
+    tracker = RabitTracker("127.0.0.1", size, heartbeat_ms=50,
+                           dead_after_ms=400, recover_grace_ms=200,
+                           num_shards=NUM_SHARDS)
+    tracker.start()
+    streams = {}
+    lock = threading.Lock()
+    armed = threading.Event()
+    errors = []
+
+    def worker(i):
+        try:
+            c = RendezvousClient("127.0.0.1", tracker.port,
+                                 jobid=f"task{i}")
+            a = c.start(heartbeat=True)
+            mon = c.heartbeat
+            if i == dying:
+                # die mid-epoch HOLDING a lease: abrupt channel close, no
+                # complete — the tracker must reassign the shard
+                mon.acquire_lease(0, timeout=30)
+                armed.set()
+                mon.close(graceful=False)
+                for ws in a.links.values():
+                    ws.close()
+                return
+            if dying is not None:
+                armed.wait(timeout=30)  # deterministic: victim holds first
+            if i == late:
+                time.sleep(0.4)  # late joiner: starts consuming mid-epoch
+            it = ElasticRowBlockIter(data, mon, NUM_SHARDS,
+                                     shuffle_window=32, run_id=7,
+                                     acquire_timeout=60)
+            for shard, batches in it.shards():
+                with lock:
+                    assert shard not in streams, "double-consumed shard"
+                    streams[shard] = digest_batches(batches)
+            for ws in a.links.values():
+                ws.close()
+            c.shutdown(a.rank)
+        except BaseException as e:  # surfaced by the main thread
+            errors.append(e)
+
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(size)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=60)
+    tracker.join(timeout=60)  # completes — also with the dead worker
+    assert not errors, errors
+    st = tracker.state()
+    assert st["finished"] and not st["aborted"]
+    return streams
+
+
+def test_worker_sets_produce_identical_global_stream(tmp_path):
+    """The determinism acceptance: worker sets of size {1, 2, 4} —
+    including a mid-epoch death and a late joiner — all produce the
+    byte-identical global batch stream (keyed by shard: the canonical
+    order), because batches are seeded by (run_id, epoch, shard_id)."""
+    data = write_libsvm(tmp_path / "det.libsvm")
+    solo = _run_worker_set(data, 1)
+    with_death = _run_worker_set(data, 2, dying=0)
+    with_late = _run_worker_set(data, 4, late=3)
+    assert sorted(solo) == list(range(NUM_SHARDS))
+    assert with_death == solo
+    assert with_late == solo
+
+
+# -- /state lease-table atomicity (the satellite bugfix) ----------------------
+def test_state_lease_table_atomic_under_reassignment(tmp_path):
+    """Hammer state() while a worker dies and its shards are reclaimed:
+    no snapshot may ever show a shard as both pooled and held, or missing
+    from all three buckets — rank liveness and lease ownership move under
+    ONE lock. The HTTP /state scrape serves the same table."""
+    tracker = RabitTracker("127.0.0.1", 2, heartbeat_ms=50,
+                           dead_after_ms=300, recover_grace_ms=150,
+                           num_shards=6)
+    tracker.start()
+    violations = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            st = tracker.state()
+            for tbl in (st.get("leases") or {}).values():
+                pool = set(tbl["pool"])
+                held = {int(s) for s in tbl["held"]}
+                done = set(tbl["done"])
+                if pool & held or pool & done or held & done:
+                    violations.append(("overlap", tbl))
+                if pool | held | done != set(range(6)):
+                    violations.append(("not-partition", tbl))
+
+    th = threading.Thread(target=scraper, daemon=True)
+    th.start()
+
+    legacy_done = threading.Event()
+
+    def legacy():  # second rank: rendezvous without heartbeats, check out
+        c = RendezvousClient("127.0.0.1", tracker.port, jobid="task1")
+        a = c.start(heartbeat=False)
+        legacy_done.wait(timeout=30)
+        c.shutdown(a.rank)
+
+    lt = threading.Thread(target=legacy)
+    lt.start()
+    c = RendezvousClient("127.0.0.1", tracker.port, jobid="task0")
+    a = c.start(heartbeat=True)
+    mon = c.heartbeat
+    held = [mon.acquire_lease(0, timeout=10) for _ in range(3)]
+    assert sorted(held) == [0, 1, 2]
+
+    # live HTTP scrape shows them held
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{tracker.port}/state", timeout=10) as resp:
+        scraped = json.loads(resp.read())
+    assert sorted(int(s) for s in scraped["leases"]["0"]["held"]) == held
+
+    mon.close(graceful=False)  # die holding all three
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        tbl = tracker.state().get("leases", {}).get("0", {})
+        if tbl.get("reassigned") == 3:
+            break
+        time.sleep(0.02)
+    tbl = tracker.state()["leases"]["0"]
+    # all three reclaimed: the pool holds them again plus the never-leased
+    assert tbl["reassigned"] == 3 and not tbl["held"]
+    assert sorted(tbl["pool"]) == list(range(6))
+    legacy_done.set()
+    lt.join(timeout=30)
+    stop.set()
+    th.join(timeout=10)
+    assert not violations, violations[:3]
+    tracker.join(timeout=30)  # rank 0 lost + rank 1 shutdown -> finished
+    assert tracker.state()["finished"]
+
+
+def test_rank_dead_mid_dance_aborts_even_when_elastic():
+    """A rank that opened its heartbeat channel but died BEFORE finishing
+    the link dance must still abort the job (elastic or not): survivors
+    are parked in peer accept()/recv() waits that only the abort
+    broadcast unblocks — the graceful lease write-off applies to the
+    data plane, never to a half-built link topology."""
+    from dmlc_core_tpu.tracker.wire import TrackerAbortedError
+    tracker = RabitTracker("127.0.0.1", 2, heartbeat_ms=50,
+                           dead_after_ms=300, recover_grace_ms=150,
+                           num_shards=NUM_SHARDS)
+    tracker.start()
+    result = {}
+
+    def full_worker():  # parks in its link dance waiting for the victim
+        c = RendezvousClient("127.0.0.1", tracker.port, jobid="task0",
+                             timeout=60)
+        try:
+            a = c.start(heartbeat=True)
+            result["assign"] = a
+        except BaseException as e:
+            result["error"] = e
+
+    th = threading.Thread(target=full_worker)
+    th.start()
+
+    # the victim: handshake + heartbeat channel, then die mid-dance
+    c = RendezvousClient("127.0.0.1", tracker.port, jobid="task1")
+    ws = c._dial_tracker("start")
+    my_rank = ws.recv_int()
+    for _ in range(2):
+        ws.recv_int()  # parent, world
+    num_tree = ws.recv_int()
+    for _ in range(num_tree):
+        ws.recv_int()
+    ws.recv_int(), ws.recv_int()  # rprev, rnext
+    c._maybe_start_heartbeat(my_rank, True)  # liveness armed, pings flow
+    t_kill = time.monotonic()
+    c.heartbeat.close(graceful=False)  # abrupt: dead clock starts
+    ws.close()
+
+    th.join(timeout=30)
+    assert isinstance(result.get("error"), TrackerAbortedError), result
+    with pytest.raises(TrackerAbortedError):
+        tracker.join(timeout=30)
+    # bounded: detection + grace + slack, never the survivor's 60 s dial
+    assert time.monotonic() - t_kill < 2 * (300 + 150) / 1000.0 + 5.0
+    st = tracker.state()
+    assert st["aborted"] and not st["finished"]
+
+
+def test_all_ranks_lost_aborts_and_is_not_finished():
+    """Every rank written off as lost -> abort; state() must never
+    report the contradictory finished=True on top of aborted=True."""
+    from dmlc_core_tpu.tracker.wire import TrackerAbortedError
+    tracker = RabitTracker("127.0.0.1", 1, heartbeat_ms=50,
+                           dead_after_ms=300, recover_grace_ms=150,
+                           num_shards=4)
+    tracker.start()
+    c = RendezvousClient("127.0.0.1", tracker.port, jobid="task0")
+    a = c.start(heartbeat=True)
+    assert c.heartbeat.acquire_lease(0, timeout=10) == 0
+    c.heartbeat.close(graceful=False)  # die holding a lease, post-dance
+    for ws in a.links.values():
+        ws.close()
+    with pytest.raises(TrackerAbortedError):
+        tracker.join(timeout=30)
+    st = tracker.state()
+    assert st["aborted"] and not st["finished"]
+    assert st["lost_ranks"] == [0]
+
+
+def test_orphaned_late_grant_is_released_not_leaked():
+    """A grant that lands AFTER its ask timed out is an orphan: the next
+    acquire's drain loop must hand it back (LEASE_RELEASE), or the shard
+    stays held by a live, pinging, renewing rank forever and the epoch
+    can never drain."""
+    tracker = RabitTracker("127.0.0.1", 1, heartbeat_ms=50,
+                           dead_after_ms=5000, num_shards=3)
+    tracker.start()
+    c = RendezvousClient("127.0.0.1", tracker.port, jobid="task0")
+    a = c.start(heartbeat=True)
+    mon = c.heartbeat
+
+    shard = mon.acquire_lease(0, timeout=10)
+    assert shard == 0
+    # simulate the timeout race: the grant for shard 0 landed late, the
+    # asking call already raised, and nobody owns the grant
+    mon._grants.put(shard)
+    mon._inflight_epoch = 0
+
+    # the epoch must still drain completely — including shard 0
+    consumed = []
+    while True:
+        s = mon.acquire_lease(0, timeout=10)  # drain loop releases 0 first
+        if s is None:
+            break
+        consumed.append(s)
+        mon.complete_lease(0, s)
+    assert sorted(consumed) == [0, 1, 2]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        tbl = tracker.state()["leases"]["0"]
+        if tbl["done"] == [0, 1, 2] and not tbl["held"]:
+            break
+        time.sleep(0.02)
+    tbl = tracker.state()["leases"]["0"]
+    assert tbl["done"] == [0, 1, 2] and not tbl["held"], tbl
+    c.shutdown(a.rank)
+    tracker.join(timeout=30)
+
+
+# -- legacy compatibility -----------------------------------------------------
+def test_legacy_static_mode_is_untouched_default(tmp_path, monkeypatch):
+    """Without the opt-in, RowBlockIter.create returns the classic static
+    iterator; DMLC_ELASTIC_SHARDS=0 stays static too."""
+    data = write_libsvm(tmp_path / "leg.libsvm", rows=64)
+    monkeypatch.delenv("DMLC_ELASTIC_SHARDS", raising=False)
+    it = RowBlockIter.create(data)
+    assert isinstance(it, RowBlockIter)
+    monkeypatch.setenv("DMLC_ELASTIC_SHARDS", "0")
+    assert isinstance(RowBlockIter.create(data), RowBlockIter)
+    monkeypatch.setenv("DMLC_ELASTIC_SHARDS", "1")
+    it2 = RowBlockIter.create(data, leases=LocalLeases(4), num_shards=4)
+    assert isinstance(it2, ElasticRowBlockIter)
+    # an EXPLICIT static split beats the process-wide env opt-in: a side
+    # dataset (validation set) must not silently join the one shard pool
+    it3 = RowBlockIter.create(data, part=1, npart=2)
+    assert isinstance(it3, RowBlockIter)
+
+
+def test_lease_acquire_on_static_tracker_reports_drained():
+    """A lease-speaking client against a NON-elastic tracker gets a clean
+    end-of-epoch (drained), never a hang or a protocol error; legacy
+    heartbeat-only behavior is unchanged."""
+    tracker = RabitTracker("127.0.0.1", 1, heartbeat_ms=50,
+                           dead_after_ms=5000)
+    tracker.start()
+    c = RendezvousClient("127.0.0.1", tracker.port)
+    a = c.start(heartbeat=True)
+    assert c.heartbeat.acquire_lease(0, timeout=10) is None
+    c.shutdown(a.rank)
+    tracker.join(timeout=30)
+    st = tracker.state()
+    assert not st["elastic"] and "leases" not in st
+
+
+def test_elastic_tracker_serves_legacy_no_heartbeat_clients():
+    """An elastic tracker still rendezvouses heartbeat-less legacy
+    clients byte-compatibly (they just never lease)."""
+    tracker = RabitTracker("127.0.0.1", 2, heartbeat_ms=50,
+                           dead_after_ms=2000, num_shards=4)
+    tracker.start()
+    ranks = []
+
+    def worker():
+        c = RendezvousClient("127.0.0.1", tracker.port)
+        a = c.start(heartbeat=False)
+        ranks.append(a.rank)
+        c.shutdown(a.rank)
+
+    ths = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=30)
+    tracker.join(timeout=30)
+    assert sorted(ranks) == [0, 1]
+
+
+# -- LeasedSplit (record-level elastic reads) ---------------------------------
+def test_leased_split_covers_records_exactly_once(tmp_path):
+    from dmlc_core_tpu.io.native import LeasedSplit
+    data = write_libsvm(tmp_path / "ls.libsvm", rows=200)
+    want = sorted(open(data, "rb").read().splitlines())
+    got = []
+    with LeasedSplit(data, LocalLeases(5), 5,
+                     acquire_timeout=30) as split:
+        for rec in split:
+            got.append(rec.rstrip(b"\n"))
+        assert sorted(split.consumed) == list(range(5))
+    assert sorted(got) == want
+
+
+# -- dmlc-submit flags + in-container validation ------------------------------
+def test_submit_flags_and_bootstrap_validation():
+    from dmlc_core_tpu.tracker import bootstrap
+    from dmlc_core_tpu.tracker.opts import get_opts
+    from dmlc_core_tpu.tracker.wire import env_enum, env_float
+
+    args = get_opts(["--cluster", "local", "--num-workers", "2",
+                     "--num-shards", "16", "--lease-ttl-ms", "5000",
+                     "--", "echo", "hi"])
+    assert args.num_shards == 16 and args.lease_ttl_ms == 5000
+
+    base = {"DMLC_JOB_CLUSTER": "local"}
+    # the elastic knobs validate in-container like the heartbeat flags
+    for key in ("DMLC_TRACKER_NUM_SHARDS", "DMLC_TRACKER_LEASE_TTL_MS",
+                "DMLC_ELASTIC_SHARDS"):
+        with pytest.raises(RuntimeError, match=key):
+            bootstrap.build_env(dict(base, **{key: "garbage"}))
+    bootstrap.build_env(dict(base, DMLC_TRACKER_NUM_SHARDS="8"))
+    with pytest.raises(RuntimeError, match="DMLC_JOB_CLUSTER"):
+        bootstrap.build_env({"DMLC_JOB_CLUSTER": "kubernets"})  # typo
+
+    # the new checked parsers themselves
+    assert env_float("X_F", 1.5, env={}) == 1.5
+    assert env_float("X_F", 1.5, env={"X_F": "2.5"}) == 2.5
+    with pytest.raises(RuntimeError, match="X_F"):
+        env_float("X_F", 1.5, env={"X_F": "nope"})
+    assert env_enum("X_E", ("a", "b"), "a", env={"X_E": "b"}) == "b"
+    with pytest.raises(RuntimeError, match="X_E"):
+        env_enum("X_E", ("a", "b"), "a", env={"X_E": "c"})
