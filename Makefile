@@ -3,10 +3,10 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint native-test tsan-test asan-test parse-lanes telemetry \
-        pytest liveness elastic bench-smoke dryrun doc clean
+        cache pytest liveness elastic bench-smoke dryrun doc clean
 
-ci: lint native-test tsan-test asan-test parse-lanes telemetry pytest \
-    liveness elastic dryrun doc
+ci: lint native-test tsan-test asan-test parse-lanes telemetry cache \
+    pytest liveness elastic dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -25,6 +25,15 @@ parse-lanes:
 telemetry:
 	$(MAKE) -C cpp tsan-telemetry
 	python3 -m pytest tests/test_telemetry.py -q
+
+# Shard-cache lane (doc/caching.md): the C++ suite under BOTH sanitizers
+# (concurrent readers during transcode, crash-recovery/corruption
+# validation) plus the Python invalidation-edge + byte-identity matrix
+# (all three text formats x both index widths, static and elastic
+# iterators)
+cache:
+	$(MAKE) -C cpp asan-cache tsan-cache
+	python3 -m pytest tests/test_shard_cache.py -q
 
 lint:
 	python3 scripts/lint.py

@@ -75,24 +75,26 @@ struct ParserHandle {
     delete p64;
   }
 
+  // dct_rowblock_t is exactly the RowBlockView shape: the parser's view
+  // lane (Parser::NextBlockView) fills it with NO intermediate container —
+  // on a shard-cache replay the pointers go straight into the mmap.
   template <typename T>
-  static void FillBlock(const dct::RowBlockContainer<T>* b,
-                        dct_rowblock_t* out) {
-    out->num_rows = b->Size();
-    out->nnz = b->index.size();
-    out->offset = b->offset.data();
-    out->label = b->label.data();
-    out->weight = b->weight.empty() ? nullptr : b->weight.data();
-    out->qid = b->qid.empty() ? nullptr : b->qid.data();
-    out->field = b->field.empty() ? nullptr : b->field.data();
-    out->index = b->index.data();
-    out->value = b->value.empty() ? nullptr : b->value.data();
-    out->max_index = b->max_index;
-    out->max_field = b->max_field;
+  static void FillView(const dct::RowBlockView<T>& v, dct_rowblock_t* out) {
+    out->num_rows = v.num_rows;
+    out->nnz = v.nnz;
+    out->offset = v.offset;
+    out->label = v.label;
+    out->weight = v.weight;
+    out->qid = v.qid;
+    out->field = v.field;
+    out->index = v.index;
+    out->value = v.value;
+    out->max_index = v.max_index;
+    out->max_field = v.max_field;
     out->index_is_64 = sizeof(T) == 8 ? 1 : 0;
-    out->value_i32 = b->value_i32.empty() ? nullptr : b->value_i32.data();
-    out->value_i64 = b->value_i64.empty() ? nullptr : b->value_i64.data();
-    out->value_dtype = b->value_dtype;
+    out->value_i32 = v.value_i32;
+    out->value_i64 = v.value_i64;
+    out->value_dtype = v.value_dtype;
   }
 };
 }  // namespace
@@ -423,18 +425,26 @@ typedef void* dct_parser_t;
 
 // chunks_in_flight bounds the threaded pipeline's outstanding chunks
 // (0 = auto-size to the worker count; parser.cc DefaultChunksInFlight).
+// cache_dir/cache_mode (NULL/"" = URI sugar + env only) opt into the
+// transcoding shard cache (cpp/src/shard_cache.h, doc/caching.md):
+// cache_dir names the shard directory, cache_mode is never|auto|refresh.
 int dct_parser_create_ex(const char* uri, unsigned part, unsigned npart,
                          const char* format, int nthread, int threaded,
                          int index64, int chunks_in_flight,
+                         const char* cache_dir, const char* cache_mode,
                          dct_parser_t* out) {
   return Guard([&] {
+    const std::string cdir = cache_dir == nullptr ? "" : cache_dir;
+    const std::string cmode = cache_mode == nullptr ? "" : cache_mode;
     auto* h = new ParserHandle();
     if (index64 != 0) {
       h->p64 = dct::Parser<uint64_t>::Create(uri, part, npart, format, nthread,
-                                             threaded != 0, chunks_in_flight);
+                                             threaded != 0, chunks_in_flight,
+                                             cdir, cmode);
     } else {
       h->p32 = dct::Parser<uint32_t>::Create(uri, part, npart, format, nthread,
-                                             threaded != 0, chunks_in_flight);
+                                             threaded != 0, chunks_in_flight,
+                                             cdir, cmode);
     }
     *out = h;
   });
@@ -444,20 +454,23 @@ int dct_parser_create(const char* uri, unsigned part, unsigned npart,
                       const char* format, int nthread, int threaded,
                       int index64, dct_parser_t* out) {
   return dct_parser_create_ex(uri, part, npart, format, nthread, threaded,
-                              index64, 0, out);
+                              index64, 0, nullptr, nullptr, out);
 }
 
 int dct_parser_next_block(dct_parser_t h, dct_rowblock_t* out, int* has) {
   return Guard([&] {
     auto* ph = static_cast<ParserHandle*>(h);
+    // the view lane: pointers into the producer's storage (a container's
+    // vectors, or the shard cache's mmap — zero copies either way),
+    // valid until the next call on this handle
     if (ph->p64 != nullptr) {
-      const auto* b = ph->p64->NextBlock();
-      *has = b != nullptr ? 1 : 0;
-      if (b != nullptr) ParserHandle::FillBlock(b, out);
+      dct::RowBlockView<uint64_t> v;
+      *has = ph->p64->NextBlockView(&v) ? 1 : 0;
+      if (*has) ParserHandle::FillView(v, out);
     } else {
-      const auto* b = ph->p32->NextBlock();
-      *has = b != nullptr ? 1 : 0;
-      if (b != nullptr) ParserHandle::FillBlock(b, out);
+      dct::RowBlockView<uint32_t> v;
+      *has = ph->p32->NextBlockView(&v) ? 1 : 0;
+      if (*has) ParserHandle::FillView(v, out);
     }
   });
 }

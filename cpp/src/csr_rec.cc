@@ -36,6 +36,13 @@ CsrRecBatcher::CsrRecBatcher(const std::string& uri, unsigned part,
   // bounds CONSECUTIVE rows, and a coarse shuffle would compose batches
   // from two windows' tails
   spec.RejectUnknownArgs("csr rec lane", {"format"});
+  // already-binary lanes keep the legacy `#<path>` chunk cache; the
+  // `#cachefile=<dir>` shard cache re-encodes parsed row blocks and
+  // would be a silent no-op here (URI sugar must error, not no-op)
+  DCT_CHECK(spec.cache_dir.empty())
+      << "the csr rec lane takes the legacy `#<path>` chunk cache, not a "
+         "`#cachefile=<dir>` shard-cache directory (the data is already "
+         "binary)";
   split_.reset(InputSplit::Create(spec.uri, part, npart, "recordio", "",
                                   false, 0, 256, false, /*threaded=*/true,
                                   spec.cache_file));

@@ -71,6 +71,12 @@ DenseRecBatcher::DenseRecBatcher(const std::string& uri, unsigned part,
       << num_shards_;
   URISpec spec(uri, part, npart);
   spec.RejectUnknownArgs("dense rec lane", {"format"});
+  // same rule as the csr rec lane: the shard cache re-encodes PARSED row
+  // blocks; on already-binary data it would be a silent no-op
+  DCT_CHECK(spec.cache_dir.empty())
+      << "the dense rec lane takes the legacy `#<path>` chunk cache, not "
+         "a `#cachefile=<dir>` shard-cache directory (the data is already "
+         "binary)";
   split_.reset(InputSplit::Create(spec.uri, part, npart, "recordio", "",
                                   false, 0, 256, false, /*threaded=*/true,
                                   spec.cache_file));

@@ -15,6 +15,7 @@
 #include "parameter.h"
 #include "recordio.h"
 #include "registry.h"
+#include "shard_cache.h"
 #include "telemetry.h"
 
 namespace dct {
@@ -1460,7 +1461,9 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
                                              unsigned part, unsigned npart,
                                              const std::string& format,
                                              int nthread, bool threaded,
-                                             int chunks_in_flight) {
+                                             int chunks_in_flight,
+                                             const std::string& cache_dir,
+                                             const std::string& cache_mode) {
   URISpec spec(uri, part, npart);
   std::string fmt = format;
   if (fmt == "auto" || fmt.empty()) {
@@ -1530,6 +1533,33 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
       << "shuffle_parts cannot combine with #cachefile: the cache "
          "replays epoch 1's order and would silently disable the "
          "per-epoch reshuffle";
+  // shard cache (shard_cache.h, doc/caching.md): explicit args > URI
+  // sugar (#cachefile=<dir>, ?cache=) > env (DMLC_DATA_CACHE_DIR,
+  // DMLC_DATA_CACHE)
+  ShardCacheConfig ccfg = ShardCacheConfig::Resolve(
+      spec.cache_dir, GetArg(spec.args, "cache", ""), cache_dir, cache_mode);
+  if (ccfg.enabled() && !spec.cache_file.empty()) {
+    // same env-vs-explicit rule as the shuffle_parts guard below: an
+    // explicit double opt-in is a contradiction and must error, but a
+    // process-wide DMLC_DATA_CACHE_DIR must not break a job already
+    // using the legacy cache — the legacy cache wins for this parser
+    DCT_CHECK(!ccfg.explicit_opt_in)
+        << "pass either the legacy `#<path>` row-block cache or the "
+           "`#cachefile=<dir>` shard cache, not both";
+    ccfg.dir.clear();
+  }
+  if (ccfg.enabled() && shuffle_parts != 0) {
+    // the shard cache replays epoch 1's parsed order, like the legacy
+    // cache above. An explicit opt-in conflicting with shuffling must
+    // error (URI sugar never silently no-ops); a process-wide
+    // DMLC_DATA_CACHE_DIR, though, must not break unrelated shuffled
+    // lanes — shuffling wins and the cache stands down for this parser.
+    DCT_CHECK(!ccfg.explicit_opt_in)
+        << "?shuffle_parts= cannot combine with the shard cache: the "
+           "cache replays epoch 1's order and would silently disable "
+           "the per-epoch reshuffle";
+    ccfg.dir.clear();
+  }
 
   // `?index=1` (the conventional <uri>.idx) or `?index=<path>` switches a
   // rec stream onto the indexed_recordio splitter: record-count
@@ -1548,6 +1578,13 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
       DCT_CHECK(spec.cache_file.empty())
           << "?index= cannot combine with #cachefile (the cache replays "
              "epoch 1's order)";
+      if (ccfg.enabled()) {
+        // same env-vs-explicit rule as the shuffle_parts guard above
+        DCT_CHECK(!ccfg.explicit_opt_in)
+            << "?index= cannot combine with the shard cache (the cache "
+               "replays epoch 1's order)";
+        ccfg.dir.clear();
+      }
       index_uri = it->second == "1" ? spec.uri + ".idx" : it->second;
     }
   }
@@ -1572,22 +1609,40 @@ Parser<IndexType>* Parser<IndexType>::Create(const std::string& uri,
   // a thread hop (ReadChunk then fills task buffers directly through the
   // RecordChunkSource fast lane). The synchronous parser keeps the
   // prefetch wrapper — it is its only read/parse overlap.
+  //
+  // The base chain is a FACTORY so the shard-cache wrapper can defer it:
+  // on a cache hit the whole epoch is an mmap replay and the source —
+  // including any remote filesystem open — is never touched.
   const bool split_threaded = !threaded;
-  InputSplit* split =
-      index_uri.empty()
-          ? InputSplit::Create(spec.uri, part, npart, split_type, "", false,
-                               shuffle_seed, 256, false, split_threaded,
-                               "", shuffle_parts)
-          : InputSplit::Create(spec.uri, part, npart, "indexed_recordio",
-                               index_uri, rec_shuffle, shuffle_seed,
-                               shuffle_batch, false, split_threaded, "");
-  // ownership of split passes into the parser's base immediately; a throwing
-  // constructor body unwinds through the already-built base, which frees it
-  TextParserBase<IndexType>* parser = entry->body(split, args, nthread);
-  Parser<IndexType>* out =
-      threaded ? static_cast<Parser<IndexType>*>(
-                     new PipelinedParser<IndexType>(parser, chunks_in_flight))
-               : parser;
+  const std::string base_uri = spec.uri;
+  auto build_base = [base_uri, part, npart, split_type, index_uri,
+                     rec_shuffle, shuffle_seed, shuffle_batch,
+                     split_threaded, shuffle_parts, entry, args, nthread,
+                     threaded, chunks_in_flight]() -> Parser<IndexType>* {
+    InputSplit* split =
+        index_uri.empty()
+            ? InputSplit::Create(base_uri, part, npart, split_type, "",
+                                 false, shuffle_seed, 256, false,
+                                 split_threaded, "", shuffle_parts)
+            : InputSplit::Create(base_uri, part, npart, "indexed_recordio",
+                                 index_uri, rec_shuffle, shuffle_seed,
+                                 shuffle_batch, false, split_threaded, "");
+    // ownership of split passes into the parser's base immediately; a
+    // throwing constructor body unwinds through the already-built base,
+    // which frees it
+    TextParserBase<IndexType>* parser = entry->body(split, args, nthread);
+    return threaded ? static_cast<Parser<IndexType>*>(
+                          new PipelinedParser<IndexType>(parser,
+                                                         chunks_in_flight))
+                    : parser;
+  };
+  if (ccfg.enabled()) {
+    const std::string key = ShardCacheKeyText(
+        spec.uri, part, npart, fmt, sizeof(IndexType) == 8, spec.args);
+    return new ShardCacheParser<IndexType>(
+        build_base, ccfg, ShardCacheStem(ccfg.dir, key, part, npart), key);
+  }
+  Parser<IndexType>* out = build_base();
   if (!spec.cache_file.empty()) {
     std::string fingerprint = spec.uri + "|" + std::to_string(part) + "|" +
                               std::to_string(npart) + "|" + fmt + "|dtype=" +

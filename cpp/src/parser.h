@@ -83,6 +83,17 @@ class Parser {
     *out = *b;
     return true;
   }
+  // Borrowed view of the next block; false at end of data. The default
+  // aliases NextBlock()'s container (valid until the next call, like the
+  // C-ABI contract). The shard cache's mmap replay (shard_cache.h)
+  // overrides this to serve pointers straight into the mapping — the
+  // zero-copy lane dct_parser_next_block rides.
+  virtual bool NextBlockView(RowBlockView<IndexType>* out) {
+    const RowBlockContainer<IndexType>* b = NextBlock();
+    if (b == nullptr) return false;
+    out->FromContainer(*b);
+    return true;
+  }
   virtual size_t BytesRead() const = 0;
   // Pin the shuffle permutation the next BeforeFirst samples (mid-epoch
   // resume across restarts; InputSplit::SetShuffleEpoch). False when the
@@ -102,12 +113,18 @@ class Parser {
   // "libsvm" | "csv" | "libfm" | "auto" (resolved from ?format= URI arg).
   // `threaded` pipelines parsing against consumption (PipelinedParser).
   // `chunks_in_flight` bounds the pipeline's outstanding chunks (0 = auto;
-  // also settable per-URI via `?chunks_in_flight=K`). `#cachefile` URI
-  // sugar enables DiskCacheParser row-block caching (reference
-  // uri_spec.h:42-57, src/data.cc:97-103).
+  // also settable per-URI via `?chunks_in_flight=K`). Caching sugar
+  // (reference uri_spec.h:42-57, src/data.cc:97-103): a legacy `#<path>`
+  // fragment enables the DiskCacheParser single-file row-block cache;
+  // `#cachefile=<dir>` (or `cache_dir` here / DMLC_DATA_CACHE_DIR) enables
+  // the manifest-keyed transcoding shard cache with mmap zero-copy replay
+  // (shard_cache.h, doc/caching.md). `cache_mode` / `?cache=` /
+  // DMLC_DATA_CACHE is never|auto|refresh.
   static Parser* Create(const std::string& uri, unsigned part, unsigned npart,
                         const std::string& format, int nthread = 0,
-                        bool threaded = true, int chunks_in_flight = 0);
+                        bool threaded = true, int chunks_in_flight = 0,
+                        const std::string& cache_dir = "",
+                        const std::string& cache_mode = "");
 };
 
 // --------------------------------------------------------------------------

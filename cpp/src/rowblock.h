@@ -202,6 +202,86 @@ struct RowBlockContainer {
   }
 };
 
+// Borrowed, layout-free view of one CSR row block — the zero-copy unit the
+// shard cache's mmap replay serves (shard_cache.h) and the shape the C ABI
+// (dct_rowblock_t) exposes. Pointers reference memory owned by the producer
+// (a container's vectors, or an mmap'd cache shard) and stay valid until
+// the producer's next Next* call at minimum.
+template <typename IndexType>
+struct RowBlockView {
+  uint64_t num_rows = 0;
+  uint64_t nnz = 0;
+  const uint64_t* offset = nullptr;  // num_rows + 1
+  const float* label = nullptr;      // num_rows
+  const float* weight = nullptr;     // num_rows or null
+  const uint64_t* qid = nullptr;     // num_rows or null
+  const uint32_t* field = nullptr;   // nnz or null
+  const IndexType* index = nullptr;  // nnz
+  const float* value = nullptr;      // nnz or null (implicit 1.0)
+  const int32_t* value_i32 = nullptr;
+  const int64_t* value_i64 = nullptr;
+  int32_t value_dtype = 0;
+  uint64_t max_index = 0;
+  uint32_t max_field = 0;
+
+  void FromContainer(const RowBlockContainer<IndexType>& b) {
+    num_rows = b.Size();
+    nnz = b.index.size();
+    offset = b.offset.data();
+    label = b.label.data();
+    weight = b.weight.empty() ? nullptr : b.weight.data();
+    qid = b.qid.empty() ? nullptr : b.qid.data();
+    field = b.field.empty() ? nullptr : b.field.data();
+    index = b.index.data();
+    value = b.value.empty() ? nullptr : b.value.data();
+    value_i32 = b.value_i32.empty() ? nullptr : b.value_i32.data();
+    value_i64 = b.value_i64.empty() ? nullptr : b.value_i64.data();
+    value_dtype = b.value_dtype;
+    max_index = b.max_index;
+    max_field = b.max_field;
+  }
+
+  // Materialize into an owned container (bulk assigns — memcpy speed).
+  void ToContainer(RowBlockContainer<IndexType>* out) const {
+    out->offset.assign(offset, offset + num_rows + 1);
+    out->label.assign(label, label + num_rows);
+    if (weight != nullptr) {
+      out->weight.assign(weight, weight + num_rows);
+    } else {
+      out->weight.clear();
+    }
+    if (qid != nullptr) {
+      out->qid.assign(qid, qid + num_rows);
+    } else {
+      out->qid.clear();
+    }
+    if (field != nullptr) {
+      out->field.assign(field, field + nnz);
+    } else {
+      out->field.clear();
+    }
+    out->index.assign(index, index + nnz);
+    if (value != nullptr) {
+      out->value.assign(value, value + nnz);
+    } else {
+      out->value.clear();
+    }
+    if (value_i32 != nullptr) {
+      out->value_i32.assign(value_i32, value_i32 + nnz);
+    } else {
+      out->value_i32.clear();
+    }
+    if (value_i64 != nullptr) {
+      out->value_i64.assign(value_i64, value_i64 + nnz);
+    } else {
+      out->value_i64.clear();
+    }
+    out->value_dtype = value_dtype;
+    out->max_index = max_index;
+    out->max_field = max_field;
+  }
+};
+
 }  // namespace dct
 
 #endif  // DCT_ROWBLOCK_H_

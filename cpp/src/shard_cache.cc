@@ -1,0 +1,888 @@
+// Transcoding binary shard cache (see shard_cache.h for the format and
+// the crash/validation model).
+#include "shard_cache.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+#include "serializer.h"
+#include "sha256.h"
+#include "telemetry.h"
+
+namespace dct {
+
+namespace {
+
+// Process-wide cache telemetry (doc/observability.md): hits/misses count
+// EPOCH lane decisions (one per epoch served from cache / from text),
+// transcodes count completed, published passes. Pointers resolved once.
+struct CacheTelemetry {
+  telemetry::Counter* hits;
+  telemetry::Counter* misses;
+  telemetry::Counter* transcodes;
+  telemetry::Hist* read_us;   // one replay block (view hand-out)
+  telemetry::Hist* write_us;  // one transcoded block append
+};
+
+const CacheTelemetry& CacheTel() {
+  static const CacheTelemetry t = {
+      telemetry::GetCounter("cache_hits_total"),
+      telemetry::GetCounter("cache_misses_total"),
+      telemetry::GetCounter("cache_transcodes_total"),
+      telemetry::GetHist("cache_read_us"),
+      telemetry::GetHist("cache_write_us"),
+  };
+  return t;
+}
+
+constexpr size_t kHeaderBytes = 80;
+constexpr size_t kBlockHeaderBytes = 40;
+
+inline size_t Pad8(size_t n) { return (n + 7) & ~size_t(7); }
+
+void MkdirRecursive(const std::string& dir) {
+  std::string path;
+  for (size_t i = 0; i <= dir.size(); ++i) {
+    if (i == dir.size() || dir[i] == '/') {
+      path = dir.substr(0, i == dir.size() ? i : i + 1);
+      if (path.empty() || path == "/") continue;
+      if (mkdir(path.c_str(), 0755) != 0 && errno != EEXIST) {
+        throw Error("cannot create cache directory " + path + ": " +
+                    std::strerror(errno));
+      }
+    }
+  }
+}
+
+// fsync the containing directory so the rename itself is durable
+// (same discipline as utils/checkpoint.py save_checkpoint). Best-effort:
+// some filesystems reject directory fsync.
+void FsyncDirOf(const std::string& path) {
+  size_t slash = path.find_last_of('/');
+  std::string dir = slash == std::string::npos ? "." : path.substr(0, slash);
+  int fd = open(dir.c_str(), O_RDONLY);
+  if (fd >= 0) {
+    fsync(fd);
+    close(fd);
+  }
+}
+
+void WriteAll(int fd, const void* data, size_t size, const char* what) {
+  const char* p = static_cast<const char*>(data);
+  while (size != 0) {
+    ssize_t n = write(fd, p, size);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      throw Error(std::string("shard cache write failed (") + what +
+                  "): " + std::strerror(errno));
+    }
+    p += n;
+    size -= static_cast<size_t>(n);
+  }
+}
+
+void RawKeyDigest(const std::string& key_text, uint8_t out[32]) {
+  crypto::SHA256 s;
+  s.Update(key_text.data(), key_text.size());
+  s.Final(out);
+}
+
+// Streaming 64-bit payload checksum (mix-rotate-multiply over 8-byte
+// words). Not cryptographic — it guards against bit-rot and truncation
+// inside a published shard, which the structural pre-walk alone cannot
+// see (a flipped byte in the middle of an offset/value plane keeps every
+// length consistent). Runs at memory bandwidth, so validating a shard at
+// open costs far less than the text parse it replaces; SHA-256 here
+// (~hundreds of MB/s scalar) would eat most of the replay win. All shard
+// writes are 8-byte padded, so the stream is always whole words.
+struct PayloadHash {
+  uint64_t h = 0x9E3779B97F4A7C15ull;
+  uint64_t n = 0;
+
+  void Update(const char* p, size_t len) {
+    DCT_CHECK(len % 8 == 0) << "shard payload writes are 8-byte padded";
+    for (size_t i = 0; i + 8 <= len; i += 8) {
+      uint64_t w;
+      std::memcpy(&w, p + i, 8);
+      h ^= w * 0x9DDFEA08EB382D69ull;
+      h = ((h << 31) | (h >> 33)) * 0xC2B2AE3D27D4EB4Full;
+    }
+    n += len;
+  }
+
+  uint64_t Final() const {
+    uint64_t out = h ^ n;
+    out = ((out << 29) | (out >> 35)) * 0x165667B19E3779F9ull;
+    return out ^ (out >> 32);
+  }
+};
+
+template <typename T>
+void AppendPod(std::vector<char>* buf, T v) {
+  const char* p = reinterpret_cast<const char*>(&v);
+  buf->insert(buf->end(), p, p + sizeof(T));
+}
+
+template <typename T>
+void AppendArray(std::vector<char>* buf, const std::vector<T>& v) {
+  const char* p = reinterpret_cast<const char*>(v.data());
+  buf->insert(buf->end(), p, p + v.size() * sizeof(T));
+  buf->resize(Pad8(buf->size()), '\0');
+}
+
+// block flags
+constexpr uint32_t kFlagWeight = 1u << 0;
+constexpr uint32_t kFlagQid = 1u << 1;
+constexpr uint32_t kFlagField = 1u << 2;
+constexpr uint32_t kFlagHasValue = 1u << 10;
+constexpr uint32_t kDtypeShift = 8;  // bits 8..9: value_dtype
+
+}  // namespace
+
+// ------------------------------------------------------------------ config --
+ShardCacheMode ParseShardCacheMode(const std::string& what,
+                                   const std::string& text,
+                                   ShardCacheMode dflt) {
+  if (text.empty()) return dflt;
+  if (text == "never") return ShardCacheMode::kNever;
+  if (text == "auto") return ShardCacheMode::kAuto;
+  if (text == "refresh") return ShardCacheMode::kRefresh;
+  // the checked-env/checked-arg rule (retry.h CheckedEnvInt): a typo'd
+  // cache knob must error, not silently pick a lane
+  throw Error(what + "=" + text + " is not one of never|auto|refresh");
+}
+
+ShardCacheConfig ShardCacheConfig::Resolve(const std::string& uri_cache_dir,
+                                           const std::string& uri_cache_mode,
+                                           const std::string& arg_cache_dir,
+                                           const std::string& arg_cache_mode) {
+  ShardCacheConfig cfg;
+  cfg.explicit_opt_in = !uri_cache_dir.empty() || !uri_cache_mode.empty() ||
+                        !arg_cache_dir.empty() || !arg_cache_mode.empty();
+  if (!arg_cache_dir.empty()) {
+    cfg.dir = arg_cache_dir;
+  } else if (!uri_cache_dir.empty()) {
+    cfg.dir = uri_cache_dir;
+  } else {
+    const char* env = std::getenv("DMLC_DATA_CACHE_DIR");
+    if (env != nullptr) cfg.dir = env;
+  }
+  std::string env_mode;
+  if (const char* env = std::getenv("DMLC_DATA_CACHE")) env_mode = env;
+  // layered like RetryPolicy::FromEnv: env < URI sugar < explicit arg
+  ShardCacheMode mode =
+      ParseShardCacheMode("DMLC_DATA_CACHE", env_mode, ShardCacheMode::kAuto);
+  mode = ParseShardCacheMode("?cache", uri_cache_mode, mode);
+  mode = ParseShardCacheMode("cache_mode", arg_cache_mode, mode);
+  cfg.mode = mode;
+  // the on-disk format is little-endian and replay is mmap (no byte-swap
+  // pass is possible on a borrowed view): big-endian hosts always take
+  // the text lane
+  if (!serial::NativeIsLE()) cfg.dir.clear();
+  return cfg;
+}
+
+std::string ShardCacheKeyText(
+    const std::string& uri, unsigned part, unsigned npart,
+    const std::string& format, bool index64,
+    const std::map<std::string, std::string>& args) {
+  std::ostringstream os;
+  os << "dshard-v" << kShardCacheVersion << "|uri=" << uri
+     << "|part=" << part << "|npart=" << npart << "|fmt=" << format
+     << "|index64=" << (index64 ? 1 : 0) << "|args=";
+  bool first = true;
+  for (const auto& kv : args) {  // std::map: deterministic order
+    // knobs that select the cache lane or tune pipeline depth do not
+    // change the parsed bytes — including them would fragment the cache
+    if (kv.first == "cache" || kv.first == "chunks_in_flight") continue;
+    if (!first) os << '&';
+    os << kv.first << '=' << kv.second;
+    first = false;
+  }
+  return os.str();
+}
+
+std::string ShardCacheStem(const std::string& dir, const std::string& key,
+                           unsigned part, unsigned npart) {
+  std::string sha = crypto::Sha256Hex(key).substr(0, 20);
+  std::string d = dir;
+  if (!d.empty() && d.back() == '/') d.pop_back();
+  return d + "/" + sha + ".p" + std::to_string(part) + ".n" +
+         std::to_string(npart);
+}
+
+// -------------------------------------------------------------- writer -----
+class ShardCacheWriterImpl {
+ public:
+  ShardCacheWriterImpl(const std::string& stem, const std::string& key_text)
+      : stem_(stem), key_text_(key_text) {
+    size_t slash = stem.find_last_of('/');
+    if (slash != std::string::npos) MkdirRecursive(stem.substr(0, slash));
+    // unique per WRITER, not just per pid: concurrent transcoders of the
+    // same unit inside one process (threads) must never share a temp
+    static std::atomic<uint64_t> seq{0};
+    uniq_ = std::to_string(getpid()) + "." +
+            std::to_string(seq.fetch_add(1));
+    tmp_ = stem + ".dshard.tmp." + uniq_;
+    fd_ = open(tmp_.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd_ < 0) {
+      throw Error("cannot create shard cache temp " + tmp_ + ": " +
+                  std::strerror(errno));
+    }
+    // header placeholder; counts patched in at Finalize
+    char zero[kHeaderBytes] = {0};
+    WriteAll(fd_, zero, sizeof(zero), "header");
+    bytes_ = kHeaderBytes;
+  }
+
+  ~ShardCacheWriterImpl() { Abandon(); }
+
+  template <typename IndexType>
+  void Append(const RowBlockContainer<IndexType>& b) {
+    DCT_CHECK(fd_ >= 0) << "shard cache writer used after finalize/abandon";
+    telemetry::ScopedTimerUs span(CacheTel().write_us);
+    const uint64_t nrows = b.Size();
+    const uint64_t nnz = b.index.size();
+    uint32_t flags = 0;
+    if (!b.weight.empty()) flags |= kFlagWeight;
+    if (!b.qid.empty()) flags |= kFlagQid;
+    if (!b.field.empty()) flags |= kFlagField;
+    if (b.ValueCount() != 0) flags |= kFlagHasValue;
+    flags |= static_cast<uint32_t>(b.value_dtype) << kDtypeShift;
+    buf_.clear();
+    AppendPod<uint32_t>(&buf_, kShardBlockMagic);
+    AppendPod<uint32_t>(&buf_, flags);
+    AppendPod<uint64_t>(&buf_, nrows);
+    AppendPod<uint64_t>(&buf_, nnz);
+    AppendPod<uint64_t>(&buf_, b.max_index);
+    AppendPod<uint32_t>(&buf_, b.max_field);
+    AppendPod<uint32_t>(&buf_, 0);  // reserved
+    AppendArray(&buf_, b.offset);
+    AppendArray(&buf_, b.label);
+    if (!b.weight.empty()) AppendArray(&buf_, b.weight);
+    if (!b.qid.empty()) AppendArray(&buf_, b.qid);
+    if (!b.field.empty()) AppendArray(&buf_, b.field);
+    AppendArray(&buf_, b.index);
+    if (b.ValueCount() != 0) {
+      if (b.value_dtype == 1) {
+        AppendArray(&buf_, b.value_i32);
+      } else if (b.value_dtype == 2) {
+        AppendArray(&buf_, b.value_i64);
+      } else {
+        AppendArray(&buf_, b.value);
+      }
+    }
+    WriteAll(fd_, buf_.data(), buf_.size(), "block");
+    hash_.Update(buf_.data(), buf_.size());
+    bytes_ += buf_.size();
+    ++blocks_;
+    rows_ += nrows;
+    nnz_ += nnz;
+    index64_ = sizeof(IndexType) == 8;
+  }
+
+  void Finalize(bool index64) {
+    if (fd_ < 0) return;
+    // patch the real header
+    std::vector<char> hdr;
+    hdr.reserve(kHeaderBytes);
+    AppendPod<uint64_t>(&hdr, kShardCacheMagic);
+    AppendPod<uint32_t>(&hdr, kShardCacheVersion);
+    AppendPod<uint32_t>(&hdr, (blocks_ != 0 ? index64_ : index64) ? 1u : 0u);
+    AppendPod<uint64_t>(&hdr, blocks_);
+    AppendPod<uint64_t>(&hdr, rows_);
+    AppendPod<uint64_t>(&hdr, nnz_);
+    uint8_t digest[32];
+    RawKeyDigest(key_text_, digest);
+    hdr.insert(hdr.end(), digest, digest + 32);
+    hdr.resize(kHeaderBytes, '\0');
+    if (pwrite(fd_, hdr.data(), hdr.size(), 0) !=
+        static_cast<ssize_t>(hdr.size())) {
+      throw Error("cannot write shard cache header: " +
+                  std::string(std::strerror(errno)));
+    }
+    // durability dance: file fsync -> atomic rename -> dir fsync, and the
+    // manifest only AFTER the shard is durable (a crash between the two
+    // leaves shard-without-manifest = a clean miss)
+    DCT_CHECK(fsync(fd_) == 0) << "shard cache fsync failed";
+    close(fd_);
+    fd_ = -1;
+    const std::string shard_path = stem_ + ".dshard";
+    DCT_CHECK(std::rename(tmp_.c_str(), shard_path.c_str()) == 0)
+        << "cannot publish shard cache " << shard_path;
+    FsyncDirOf(shard_path);
+    // manifest: same temp+fsync+rename dance
+    size_t slash = shard_path.find_last_of('/');
+    const std::string shard_name = slash == std::string::npos
+                                       ? shard_path
+                                       : shard_path.substr(slash + 1);
+    char hash_hex[24];
+    std::snprintf(hash_hex, sizeof(hash_hex), "%016llx",
+                  static_cast<unsigned long long>(hash_.Final()));
+    std::ostringstream m;
+    m << "dshard-manifest-v" << kShardCacheVersion << "\n"
+      << "sha256=" << crypto::Sha256Hex(key_text_) << "\n"
+      << "shard=" << shard_name << "\n"
+      << "bytes=" << bytes_ << "\n"
+      << "payload_hash=" << hash_hex << "\n"
+      << "blocks=" << blocks_ << "\n"
+      << "rows=" << rows_ << "\n"
+      << "nnz=" << nnz_ << "\n"
+      << "key=" << key_text_ << "\n";
+    const std::string mtmp = stem_ + ".manifest.tmp." + uniq_;
+    int mfd = open(mtmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    DCT_CHECK(mfd >= 0) << "cannot create manifest temp " << mtmp;
+    try {
+      const std::string ms = m.str();
+      WriteAll(mfd, ms.data(), ms.size(), "manifest");
+      DCT_CHECK(fsync(mfd) == 0) << "manifest fsync failed";
+      close(mfd);
+      mfd = -1;
+      const std::string mpath = stem_ + ".manifest";
+      DCT_CHECK(std::rename(mtmp.c_str(), mpath.c_str()) == 0)
+          << "cannot publish shard cache manifest " << mpath;
+      FsyncDirOf(mpath);
+    } catch (...) {
+      if (mfd >= 0) close(mfd);
+      std::remove(mtmp.c_str());
+      throw;
+    }
+    CacheTel().transcodes->Add(1);
+  }
+
+  void Abandon() {
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+    // unconditional: Finalize can fail AFTER closing the fd (rename),
+    // leaving the temp behind; uniq_ makes the name this writer's own,
+    // and after a successful publish the remove is a harmless no-op
+    std::remove(tmp_.c_str());
+  }
+
+  uint64_t blocks() const { return blocks_; }
+
+ private:
+  std::string stem_, key_text_, tmp_, uniq_;
+  int fd_ = -1;
+  std::vector<char> buf_;
+  PayloadHash hash_;
+  uint64_t bytes_ = 0, blocks_ = 0, rows_ = 0, nnz_ = 0;
+  bool index64_ = false;
+};
+
+template <typename IndexType>
+ShardCacheWriter<IndexType>::ShardCacheWriter(const std::string& stem,
+                                              const std::string& key_text)
+    : impl_(new ShardCacheWriterImpl(stem, key_text)) {}
+
+template <typename IndexType>
+ShardCacheWriter<IndexType>::~ShardCacheWriter() = default;
+
+template <typename IndexType>
+void ShardCacheWriter<IndexType>::Append(
+    const RowBlockContainer<IndexType>& b) {
+  impl_->Append(b);
+}
+
+template <typename IndexType>
+void ShardCacheWriter<IndexType>::Finalize() {
+  impl_->Finalize(sizeof(IndexType) == 8);
+}
+
+template <typename IndexType>
+void ShardCacheWriter<IndexType>::Abandon() {
+  impl_->Abandon();
+}
+
+template <typename IndexType>
+uint64_t ShardCacheWriter<IndexType>::blocks() const {
+  return impl_->blocks();
+}
+
+// -------------------------------------------------------------- reader -----
+namespace {
+// one parsed block's pointer table, precomputed at open so a corrupt
+// shard is a MISS (TryOpen fails) rather than a mid-epoch fault
+struct BlockLayout {
+  uint64_t rows, nnz;
+  uint32_t flags;
+  uint64_t max_index;
+  uint32_t max_field;
+  size_t offset_at, label_at, weight_at, qid_at, field_at, index_at,
+      value_at;
+};
+}  // namespace
+
+class MmapShardReaderImpl {
+ public:
+  ~MmapShardReaderImpl() {
+    if (map_ != MAP_FAILED) munmap(map_, map_size_);
+  }
+
+  // returns false on any validation miss (never throws for corruption)
+  bool Open(const std::string& stem, const std::string& key_text,
+            bool index64) {
+    // 1. manifest: k=v lines, first line is the version sentinel
+    std::ifstream mf(stem + ".manifest");
+    if (!mf.is_open()) return false;
+    std::string line;
+    if (!std::getline(mf, line) ||
+        line != "dshard-manifest-v" + std::to_string(kShardCacheVersion)) {
+      return false;
+    }
+    std::map<std::string, std::string> kv;
+    while (std::getline(mf, line)) {
+      size_t eq = line.find('=');
+      if (eq != std::string::npos) {
+        kv[line.substr(0, eq)] = line.substr(eq + 1);
+      }
+    }
+    if (kv["sha256"] != crypto::Sha256Hex(key_text)) return false;
+    if (kv["key"] != key_text) return false;  // belt to the digest
+    const std::string shard_path = stem + ".dshard";
+    char* endp = nullptr;
+    const unsigned long long want_bytes =
+        strtoull(kv["bytes"].c_str(), &endp, 10);
+    if (endp == kv["bytes"].c_str() || *endp != '\0') return false;
+    // 2. map the shard. Size from fstat of the OPENED fd, never a
+    //    stat-by-path before open: a concurrent publish rename()ing a
+    //    different shard over the path between the two would map the
+    //    new file with the old length and SIGBUS on the checksum walk
+    int fd = open(shard_path.c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0 ||
+        static_cast<unsigned long long>(st.st_size) != want_bytes) {
+      close(fd);
+      return false;
+    }
+    map_size_ = static_cast<size_t>(st.st_size);
+    map_ = mmap(nullptr, map_size_, PROT_READ, MAP_PRIVATE, fd, 0);
+    close(fd);  // the mapping outlives the descriptor
+    if (map_ == MAP_FAILED) return false;
+    madvise(map_, map_size_, MADV_SEQUENTIAL);
+    // 3. header
+    if (map_size_ < kHeaderBytes) return false;
+    const char* p = static_cast<const char*>(map_);
+    if (Load<uint64_t>(p) != kShardCacheMagic) return false;
+    if (Load<uint32_t>(p + 8) != kShardCacheVersion) return false;
+    if ((Load<uint32_t>(p + 12) != 0) != index64) return false;
+    const uint64_t blocks = Load<uint64_t>(p + 16);
+    const uint64_t rows = Load<uint64_t>(p + 24);
+    const uint64_t nnz = Load<uint64_t>(p + 32);
+    uint8_t digest[32];
+    RawKeyDigest(key_text, digest);
+    if (std::memcmp(p + 40, digest, 32) != 0) return false;
+    // 4. payload checksum: the structural pre-walk below cannot see a
+    //    flipped byte INSIDE a plane (all the lengths stay consistent);
+    //    the wordwise hash does, at memory bandwidth, once per open —
+    //    epochs reuse the validated mapping without re-hashing
+    {
+      if ((map_size_ - kHeaderBytes) % 8 != 0) return false;
+      PayloadHash ph;
+      ph.Update(p + kHeaderBytes, map_size_ - kHeaderBytes);
+      char want[24];
+      std::snprintf(want, sizeof(want), "%016llx",
+                    static_cast<unsigned long long>(ph.Final()));
+      auto it = kv.find("payload_hash");
+      if (it == kv.end() || it->second != want) return false;
+    }
+    // 5. pre-walk every block header: bounds-check the whole layout so a
+    //    bit-flipped length cannot send a view pointer past the mapping
+    const size_t idx_w = index64 ? 8 : 4;
+    size_t pos = kHeaderBytes;
+    uint64_t sum_rows = 0, sum_nnz = 0;
+    // untrusted count: bound it by what the bytes could possibly hold so
+    // a bit-flipped header cannot drive a multi-GB reserve
+    if (blocks > map_size_ / kBlockHeaderBytes) return false;
+    layouts_.reserve(blocks);
+    for (uint64_t i = 0; i < blocks; ++i) {
+      if (pos + kBlockHeaderBytes > map_size_) return false;
+      BlockLayout L;
+      L.flags = Load<uint32_t>(p + pos + 4);
+      if (Load<uint32_t>(p + pos) != kShardBlockMagic) return false;
+      L.rows = Load<uint64_t>(p + pos + 8);
+      L.nnz = Load<uint64_t>(p + pos + 16);
+      L.max_index = Load<uint64_t>(p + pos + 24);
+      L.max_field = Load<uint32_t>(p + pos + 32);
+      size_t at = pos + kBlockHeaderBytes;
+      auto take = [&](size_t elems, size_t width) -> size_t {
+        size_t here = at;
+        // overflow-safe: elems comes from an untrusted u64
+        if (elems != 0 && width != 0 &&
+            elems > (map_size_ - at) / width) {
+          here = SIZE_MAX;
+        } else {
+          at = Pad8(at + elems * width);
+        }
+        return here;
+      };
+      L.offset_at = take(L.rows + 1, 8);
+      L.label_at = take(L.rows, 4);
+      L.weight_at = (L.flags & kFlagWeight) ? take(L.rows, 4) : 0;
+      L.qid_at = (L.flags & kFlagQid) ? take(L.rows, 8) : 0;
+      L.field_at = (L.flags & kFlagField) ? take(L.nnz, 4) : 0;
+      L.index_at = take(L.nnz, idx_w);
+      const uint32_t dt = (L.flags >> kDtypeShift) & 3u;
+      L.value_at = (L.flags & kFlagHasValue)
+                       ? take(L.nnz, dt == 2 ? 8 : 4)
+                       : 0;
+      if (L.offset_at == SIZE_MAX || L.label_at == SIZE_MAX ||
+          L.weight_at == SIZE_MAX || L.qid_at == SIZE_MAX ||
+          L.field_at == SIZE_MAX || L.index_at == SIZE_MAX ||
+          L.value_at == SIZE_MAX || at > map_size_) {
+        return false;
+      }
+      // the offsets must agree with the declared nnz (they are what the
+      // batcher fills index with)
+      const uint64_t* off =
+          reinterpret_cast<const uint64_t*>(p + L.offset_at);
+      if (off[0] != 0 || off[L.rows] != L.nnz) return false;
+      sum_rows += L.rows;
+      sum_nnz += L.nnz;
+      layouts_.push_back(L);
+      pos = at;
+    }
+    if (pos != map_size_ || sum_rows != rows || sum_nnz != nnz) {
+      return false;
+    }
+    return true;
+  }
+
+  template <typename T>
+  static T Load(const char* p) {
+    T v;
+    std::memcpy(&v, p, sizeof(T));
+    return v;
+  }
+
+  template <typename IndexType>
+  bool NextView(RowBlockView<IndexType>* out) {
+    if (cur_ >= layouts_.size()) return false;
+    telemetry::ScopedTimerUs span(CacheTel().read_us);
+    const BlockLayout& L = layouts_[cur_++];
+    const char* p = static_cast<const char*>(map_);
+    out->num_rows = L.rows;
+    out->nnz = L.nnz;
+    out->offset = reinterpret_cast<const uint64_t*>(p + L.offset_at);
+    out->label = reinterpret_cast<const float*>(p + L.label_at);
+    out->weight = (L.flags & kFlagWeight)
+                      ? reinterpret_cast<const float*>(p + L.weight_at)
+                      : nullptr;
+    out->qid = (L.flags & kFlagQid)
+                   ? reinterpret_cast<const uint64_t*>(p + L.qid_at)
+                   : nullptr;
+    out->field = (L.flags & kFlagField)
+                     ? reinterpret_cast<const uint32_t*>(p + L.field_at)
+                     : nullptr;
+    out->index = reinterpret_cast<const IndexType*>(p + L.index_at);
+    const uint32_t dt = (L.flags >> kDtypeShift) & 3u;
+    out->value_dtype = static_cast<int32_t>(dt);
+    out->value = nullptr;
+    out->value_i32 = nullptr;
+    out->value_i64 = nullptr;
+    if (L.flags & kFlagHasValue) {
+      if (dt == 1) {
+        out->value_i32 = reinterpret_cast<const int32_t*>(p + L.value_at);
+      } else if (dt == 2) {
+        out->value_i64 = reinterpret_cast<const int64_t*>(p + L.value_at);
+      } else {
+        out->value = reinterpret_cast<const float*>(p + L.value_at);
+      }
+    }
+    out->max_index = L.max_index;
+    out->max_field = L.max_field;
+    // consumed = bytes up to the end of this block's arrays
+    consumed_ = cur_ < layouts_.size() ? layouts_[cur_].offset_at
+                                       : map_size_;
+    return true;
+  }
+
+  void BeforeFirst() {
+    cur_ = 0;
+    consumed_ = 0;
+  }
+  uint64_t blocks() const { return layouts_.size(); }
+  size_t bytes_consumed() const { return consumed_; }
+  size_t total_bytes() const { return map_size_; }
+
+ private:
+  void* map_ = MAP_FAILED;
+  size_t map_size_ = 0;
+  std::vector<BlockLayout> layouts_;
+  size_t cur_ = 0;
+  size_t consumed_ = 0;
+};
+
+template <typename IndexType>
+MmapShardReader<IndexType>::MmapShardReader() = default;
+
+template <typename IndexType>
+MmapShardReader<IndexType>::~MmapShardReader() = default;
+
+template <typename IndexType>
+MmapShardReader<IndexType>* MmapShardReader<IndexType>::TryOpen(
+    const std::string& stem, const std::string& key_text) {
+  auto impl = std::unique_ptr<MmapShardReaderImpl>(new MmapShardReaderImpl());
+  if (!impl->Open(stem, key_text, sizeof(IndexType) == 8)) return nullptr;
+  auto* r = new MmapShardReader<IndexType>();
+  r->impl_ = std::move(impl);
+  return r;
+}
+
+template <typename IndexType>
+bool MmapShardReader<IndexType>::NextView(RowBlockView<IndexType>* out) {
+  return impl_->NextView(out);
+}
+
+template <typename IndexType>
+void MmapShardReader<IndexType>::BeforeFirst() {
+  impl_->BeforeFirst();
+}
+
+template <typename IndexType>
+uint64_t MmapShardReader<IndexType>::blocks() const {
+  return impl_->blocks();
+}
+
+template <typename IndexType>
+size_t MmapShardReader<IndexType>::bytes_consumed() const {
+  return impl_->bytes_consumed();
+}
+
+template <typename IndexType>
+size_t MmapShardReader<IndexType>::total_bytes() const {
+  return impl_->total_bytes();
+}
+
+// ------------------------------------------------------- parser wrapper ----
+template <typename IndexType>
+ShardCacheParser<IndexType>::ShardCacheParser(BaseFactory factory,
+                                              const ShardCacheConfig& cfg,
+                                              const std::string& stem,
+                                              const std::string& key_text)
+    : factory_(std::move(factory)),
+      cfg_(cfg),
+      stem_(stem),
+      key_text_(key_text),
+      refresh_pending_(cfg.mode == ShardCacheMode::kRefresh) {
+  if (!refresh_pending_) {
+    reader_.reset(MmapShardReader<IndexType>::TryOpen(stem_, key_text_));
+  }
+  if (reader_ != nullptr) {
+    CacheTel().hits->Add(1);
+  } else {
+    CacheTel().misses->Add(1);
+  }
+}
+
+template <typename IndexType>
+ShardCacheParser<IndexType>::~ShardCacheParser() = default;
+
+template <typename IndexType>
+Parser<IndexType>* ShardCacheParser<IndexType>::EnsureBase() {
+  if (base_ == nullptr) base_.reset(factory_());
+  if (writer_ == nullptr && !write_complete_) {
+    try {
+      writer_.reset(new ShardCacheWriter<IndexType>(stem_, key_text_));
+    } catch (...) {
+      // an unusable cache dir (read-only, uncreatable): an EXPLICIT
+      // opt-in must error loudly (the URI-sugar no-op rule), but a
+      // process-wide env dir must not break unrelated text lanes —
+      // degrade to "no cache" for this pass
+      if (cfg_.explicit_opt_in) throw;
+      PoisonTranscode();
+    }
+  }
+  return base_.get();
+}
+
+template <typename IndexType>
+void ShardCacheParser<IndexType>::FinishTranscode() {
+  write_complete_ = true;
+  if (writer_ == nullptr) return;
+  try {
+    writer_->Finalize();
+  } catch (...) {
+    // a failed PUBLISH (disk fills at the header patch, cache dir
+    // removed mid-run): the text lane already served every block of
+    // this epoch correctly, so an env-only opt-in degrades to "no
+    // cache" (the next pass re-tees from the start); an explicit
+    // opt-in surfaces the error — the caller asked for a cache it
+    // will not get. refresh_pending_ stays set so a later BeforeFirst
+    // cannot replay the stale pre-refresh shard.
+    writer_->Abandon();
+    writer_.reset();
+    if (cfg_.explicit_opt_in) throw;
+    return;
+  }
+  writer_.reset();
+  refresh_pending_ = false;
+}
+
+template <typename IndexType>
+void ShardCacheParser<IndexType>::PoisonTranscode() {
+  // write_complete_=true keeps EnsureBase from re-teeing mid-pass (the
+  // stream already has a hole); the next BeforeFirst resets it and a
+  // fresh pass re-tees from the start
+  if (writer_ != nullptr) {
+    writer_->Abandon();
+    writer_.reset();
+  }
+  write_complete_ = true;
+}
+
+template <typename IndexType>
+const RowBlockContainer<IndexType>* ShardCacheParser<IndexType>::PullBase() {
+  // a throwing pull may be SKIPPED by the consumer (on_error="skip"
+  // keeps pulling) — this pass can no longer prove completeness and
+  // must never publish
+  try {
+    return base_->NextBlock();
+  } catch (...) {
+    PoisonTranscode();
+    throw;
+  }
+}
+
+template <typename IndexType>
+void ShardCacheParser<IndexType>::TeeBlock(
+    const RowBlockContainer<IndexType>& b) {
+  if (writer_ == nullptr) return;
+  // a failed tee (disk full, unwritable cache dir) degrades to "no
+  // cache" for this pass — it never breaks the text lane
+  try {
+    writer_->Append(b);
+  } catch (...) {
+    PoisonTranscode();
+  }
+}
+
+template <typename IndexType>
+bool ShardCacheParser<IndexType>::NextBlockView(
+    RowBlockView<IndexType>* out) {
+  iterated_ = true;
+  if (reader_ != nullptr) return reader_->NextView(out);
+  EnsureBase();
+  const RowBlockContainer<IndexType>* b = PullBase();
+  if (b == nullptr) {
+    FinishTranscode();
+    return false;
+  }
+  TeeBlock(*b);
+  out->FromContainer(*b);
+  return true;
+}
+
+template <typename IndexType>
+const RowBlockContainer<IndexType>* ShardCacheParser<IndexType>::NextBlock() {
+  iterated_ = true;
+  if (reader_ != nullptr) {
+    RowBlockView<IndexType> v;
+    if (!reader_->NextView(&v)) return nullptr;
+    v.ToContainer(&scratch_);
+    return &scratch_;
+  }
+  EnsureBase();
+  const RowBlockContainer<IndexType>* b = PullBase();
+  if (b == nullptr) {
+    FinishTranscode();
+    return nullptr;
+  }
+  TeeBlock(*b);
+  return b;
+}
+
+template <typename IndexType>
+bool ShardCacheParser<IndexType>::NextBlockMove(
+    RowBlockContainer<IndexType>* out) {
+  iterated_ = true;
+  if (reader_ != nullptr) {
+    RowBlockView<IndexType> v;
+    if (!reader_->NextView(&v)) return false;
+    // one bulk-assign copy out of the mapping (memcpy speed) — the
+    // container lanes (PaddedBatcher) need owned bytes because batches
+    // outlive the per-block cursor
+    v.ToContainer(out);
+    return true;
+  }
+  EnsureBase();
+  bool got;
+  try {
+    got = base_->NextBlockMove(out);
+  } catch (...) {
+    PoisonTranscode();
+    throw;
+  }
+  if (!got) {
+    FinishTranscode();
+    return false;
+  }
+  TeeBlock(*out);
+  return true;
+}
+
+template <typename IndexType>
+void ShardCacheParser<IndexType>::BeforeFirst() {
+  // hits/misses count EPOCH lane decisions. The constructor already
+  // counted this parser's first decision; a BeforeFirst with no Next*
+  // in between (RowBlockIter calls it before the very first pull) is
+  // the SAME epoch, not a new one — only a real restart re-counts.
+  const bool new_epoch = iterated_;
+  iterated_ = false;
+  if (reader_ != nullptr) {
+    reader_->BeforeFirst();
+    if (new_epoch) CacheTel().hits->Add(1);  // one per replay epoch
+    return;
+  }
+  // a transcode pass abandoned mid-epoch must not publish a truncated
+  // shard: drop the temp and re-tee from the start
+  if (writer_ != nullptr && !write_complete_) {
+    writer_->Abandon();
+    writer_.reset();
+  }
+  write_complete_ = false;
+  if (!refresh_pending_) {
+    // re-probe: the pass THIS parser just finished (or a concurrent
+    // process) may have published the shard since the last decision
+    reader_.reset(MmapShardReader<IndexType>::TryOpen(stem_, key_text_));
+  }
+  if (reader_ != nullptr) {
+    if (new_epoch) CacheTel().hits->Add(1);
+    // the transcode machinery can never be used again: drop the
+    // pipelined workers / chunk buffers / source handles instead of
+    // keeping them resident for every replay epoch of a long run (a
+    // fresh handle on the same cache never builds them at all)
+    base_.reset();
+  } else {
+    if (new_epoch) CacheTel().misses->Add(1);
+    if (base_ != nullptr) base_->BeforeFirst();
+  }
+}
+
+template <typename IndexType>
+size_t ShardCacheParser<IndexType>::BytesRead() const {
+  if (reader_ != nullptr) return reader_->bytes_consumed();
+  return base_ != nullptr ? base_->BytesRead() : 0;
+}
+
+template class ShardCacheWriter<uint32_t>;
+template class ShardCacheWriter<uint64_t>;
+template class MmapShardReader<uint32_t>;
+template class MmapShardReader<uint64_t>;
+template class ShardCacheParser<uint32_t>;
+template class ShardCacheParser<uint64_t>;
+
+}  // namespace dct

@@ -1,0 +1,212 @@
+// Parse-once, serve-many: the transcoding binary shard cache.
+//
+// Counterpart of reference src/io/cached_input_split.h taken one layer up
+// the stack (ROADMAP "Parse-once, serve-many"): instead of caching raw
+// record CHUNKS (the split-level CachedSplit, input_split.h) or re-loading
+// serialized containers through a stream (DiskCacheParser, parser.h), the
+// first pass through any text source tees the DECODED row blocks into a
+// binary shard file laid out for mmap — every array 8-byte aligned in
+// final plane order (the csr_rec/dense_rec discipline of fixing the device
+// layout on disk, extended to full row-block fidelity so cache-vs-text
+// byte-identity holds for every format). Later epochs mmap the shard and
+// serve RowBlockView pointers straight into the mapping: zero copies on
+// the C-ABI lane, one bulk memcpy on the container lanes — either way the
+// text tokenizer never runs again.
+//
+// Shard file layout (`<key>.p<part>.n<npart>.dshard`, little-endian):
+//   header (80 B): u64 magic  u32 version  u32 index_is_64
+//                  u64 blocks u64 rows     u64 nnz
+//                  u8 key_digest[32] (SHA-256 of the manifest key text)
+//                  u8 pad[8]
+//   per block:     u32 block magic 'DSB1'   u32 flags (bit0 weight,
+//                  bit1 qid, bit2 field; bits 8..9 value_dtype;
+//                  bit10 has_value)
+//                  u64 rows   u64 nnz   u64 max_index
+//                  u32 max_field   u32 reserved
+//                  then the arrays, each padded to 8-byte alignment:
+//                  offset[rows+1] u64, label[rows] f32, [weight f32],
+//                  [qid u64], [field u32], index[nnz] u32|u64,
+//                  [value f32 | value_i32 | value_i64]
+//
+// Manifest (`<stem>.manifest`, plain `k=v` lines) is written ONLY after
+// the shard file has been fsync'd and atomically renamed into place, so a
+// crash mid-transcode leaves no manifest and the next open re-transcodes
+// instead of serving a truncated dataset. Validation on open re-derives
+// the key text (URI + split params + parser args + format version),
+// compares its SHA-256 against both the manifest and the shard header,
+// and checks the recorded byte size — any mismatch (changed parser args,
+// partial write, foreign file) is a MISS, never an error: the text lane
+// is always the fallback. Writers stage under `.tmp.<pid>` names, so
+// concurrent transcoders of the same unit never corrupt each other (last
+// publish wins; both are byte-identical by construction).
+//
+// Telemetry (doc/observability.md): cache_hits_total / cache_misses_total
+// / cache_transcodes_total counters, cache_read_us / cache_write_us
+// per-block histograms.
+#ifndef DCT_SHARD_CACHE_H_
+#define DCT_SHARD_CACHE_H_
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "parser.h"
+#include "rowblock.h"
+
+namespace dct {
+
+constexpr uint64_t kShardCacheMagic = 0x0A31445241485344ull;  // "DSHARD1\n"
+constexpr uint32_t kShardCacheVersion = 1;
+constexpr uint32_t kShardBlockMagic = 0x31425344;  // 'DSB1'
+
+// ------------------------------------------------------------------ config --
+// never: cache layer disabled; auto: replay when valid, else transcode;
+// refresh: force one re-transcode, then replay.
+enum class ShardCacheMode { kNever = 0, kAuto = 1, kRefresh = 2 };
+
+struct ShardCacheConfig {
+  std::string dir;  // empty = disabled
+  ShardCacheMode mode = ShardCacheMode::kAuto;
+  bool explicit_opt_in = false;  // URI sugar / API arg (vs env-only)
+
+  bool enabled() const {
+    return !dir.empty() && mode != ShardCacheMode::kNever;
+  }
+
+  // Layered resolution: explicit args > URI sugar (#cachefile=<dir>,
+  // ?cache=) > env (DMLC_DATA_CACHE_DIR, DMLC_DATA_CACHE). Throws Error on
+  // an unknown mode word (a typo'd knob must not silently disable the
+  // cache — the checked-env rule, retry.h CheckedEnvInt).
+  static ShardCacheConfig Resolve(const std::string& uri_cache_dir,
+                                  const std::string& uri_cache_mode,
+                                  const std::string& arg_cache_dir,
+                                  const std::string& arg_cache_mode);
+};
+
+// Parse one of never|auto|refresh ("" = dflt). Throws on anything else.
+ShardCacheMode ParseShardCacheMode(const std::string& what,
+                                   const std::string& text,
+                                   ShardCacheMode dflt);
+
+// Deterministic manifest key text for one cache unit. `args` is the
+// parser's URI-arg map minus the cache knobs themselves (they select the
+// cache, they do not change the parsed bytes).
+std::string ShardCacheKeyText(const std::string& uri, unsigned part,
+                              unsigned npart, const std::string& format,
+                              bool index64,
+                              const std::map<std::string, std::string>& args);
+
+// `<dir>/<sha16>.p<part>.n<npart>` — the shard/manifest filename stem.
+std::string ShardCacheStem(const std::string& dir, const std::string& key,
+                           unsigned part, unsigned npart);
+
+// -------------------------------------------------------------- writer -----
+// Appends row blocks to `<stem>.dshard.tmp.<pid>`; Finalize() fsyncs,
+// atomically renames the shard into place, then publishes the manifest
+// (same temp+fsync+rename dance). Abandon() (or destruction without
+// Finalize) deletes the temp — a partial transcode is never visible.
+class ShardCacheWriterImpl;
+
+template <typename IndexType>
+class ShardCacheWriter {
+ public:
+  ShardCacheWriter(const std::string& stem, const std::string& key_text);
+  ~ShardCacheWriter();
+
+  void Append(const RowBlockContainer<IndexType>& b);
+  void Finalize();
+  void Abandon();
+  uint64_t blocks() const;
+
+ private:
+  std::unique_ptr<ShardCacheWriterImpl> impl_;
+};
+
+// -------------------------------------------------------------- reader -----
+// mmap-backed zero-copy replay. TryOpen returns nullptr on any validation
+// miss (absent/stale/corrupt manifest or shard). Views point into the
+// mapping and stay valid for the reader's lifetime.
+class MmapShardReaderImpl;
+
+template <typename IndexType>
+class MmapShardReader {
+ public:
+  static MmapShardReader* TryOpen(const std::string& stem,
+                                  const std::string& key_text);
+  ~MmapShardReader();
+
+  bool NextView(RowBlockView<IndexType>* out);
+  void BeforeFirst();
+  uint64_t blocks() const;
+  size_t bytes_consumed() const;  // mapped bytes walked so far
+  size_t total_bytes() const;
+
+ private:
+  MmapShardReader();
+  std::unique_ptr<MmapShardReaderImpl> impl_;
+};
+
+// ------------------------------------------------------- parser wrapper ----
+// The cache layer of Parser::Create: on construction (mode=auto) a valid
+// shard makes the whole epoch an mmap replay and the base parser chain —
+// including any remote filesystem open — is NEVER built; otherwise the
+// base is built lazily from `factory`, every block it parses is teed into
+// the writer, and the completed pass publishes the shard so the NEXT
+// BeforeFirst flips to replay.
+template <typename IndexType>
+class ShardCacheParser : public Parser<IndexType> {
+ public:
+  using BaseFactory = std::function<Parser<IndexType>*()>;
+
+  ShardCacheParser(BaseFactory factory, const ShardCacheConfig& cfg,
+                   const std::string& stem, const std::string& key_text);
+  ~ShardCacheParser() override;
+
+  void BeforeFirst() override;
+  const RowBlockContainer<IndexType>* NextBlock() override;
+  bool NextBlockMove(RowBlockContainer<IndexType>* out) override;
+  bool NextBlockView(RowBlockView<IndexType>* out) override;
+  size_t BytesRead() const override;
+  bool SetShuffleEpoch(unsigned epoch) override {
+    // unreachable in practice: Create forbids shuffle + caching
+    return base_ != nullptr && base_->SetShuffleEpoch(epoch);
+  }
+  bool GetPipelineStats(ParsePipelineStats* out) const override {
+    // meaningful during the transcode epoch; replay bypasses the parse
+    // pipeline entirely (same contract as DiskCacheParser)
+    return base_ != nullptr && base_->GetPipelineStats(out);
+  }
+
+  bool replaying() const { return reader_ != nullptr; }
+
+ private:
+  Parser<IndexType>* EnsureBase();
+  void FinishTranscode();  // publish a completed pass
+  // A pull that threw may have dropped blocks the consumer will skip
+  // over (RowBlockIter on_error="skip" keeps pulling): the pass can no
+  // longer prove completeness, so it must never publish — abandon the
+  // temp and stop teeing until the next BeforeFirst re-tees from the
+  // start. Also the landing for a failed tee itself (disk full): the
+  // cache degrades to "no cache", it never breaks the text lane.
+  void PoisonTranscode();
+  const RowBlockContainer<IndexType>* PullBase();  // NextBlock + poison
+  void TeeBlock(const RowBlockContainer<IndexType>& b);
+
+  BaseFactory factory_;
+  ShardCacheConfig cfg_;
+  std::string stem_;
+  std::string key_text_;
+  std::unique_ptr<Parser<IndexType>> base_;
+  std::unique_ptr<MmapShardReader<IndexType>> reader_;
+  std::unique_ptr<ShardCacheWriter<IndexType>> writer_;
+  RowBlockContainer<IndexType> scratch_;  // NextBlock materialization
+  bool write_complete_ = false;
+  bool refresh_pending_ = false;  // mode=refresh: one forced re-transcode
+  bool iterated_ = false;  // any Next* since the last lane decision
+};
+
+}  // namespace dct
+
+#endif  // DCT_SHARD_CACHE_H_

@@ -158,22 +158,35 @@ struct URI {
 };
 
 // URI sugar: `realuri?key=value&...#cachefile` with per-part cache naming
-// (reference src/io/uri_spec.h:28-76).
+// (reference src/io/uri_spec.h:28-76). Two fragment conventions:
+//   - `#<path>` (legacy): a single cache FILE for this exact (part, npart)
+//     unit; per-part `.splitN.partK` suffixing keeps units distinct.
+//   - `#cachefile=<dir>` (the reference's spelling): a shard-cache
+//     DIRECTORY (shard_cache.h) that keys each (part, npart) unit by a
+//     SHA-256 manifest itself — no filename mangling.
 struct URISpec {
   std::string uri;
   std::map<std::string, std::string> args;
-  std::string cache_file;
+  std::string cache_file;  // legacy single-file cache path ("" = none)
+  std::string cache_dir;   // shard-cache directory ("" = none)
 
   URISpec(const std::string& raw, unsigned part_index, unsigned num_parts) {
     std::string rest = raw;
     size_t hash = rest.find('#');
     if (hash != std::string::npos) {
-      cache_file = rest.substr(hash + 1);
-      DCT_CHECK(cache_file.find('#') == std::string::npos)
+      std::string frag = rest.substr(hash + 1);
+      DCT_CHECK(frag.find('#') == std::string::npos)
           << "only one `#` allowed in uri: " << raw;
-      if (num_parts != 1) {
-        cache_file += ".split" + std::to_string(num_parts) + ".part" +
-                      std::to_string(part_index);
+      if (frag.compare(0, 10, "cachefile=") == 0) {
+        cache_dir = frag.substr(10);
+        DCT_CHECK(!cache_dir.empty())
+            << "`#cachefile=` needs a directory: " << raw;
+      } else {
+        cache_file = frag;
+        if (num_parts != 1) {
+          cache_file += ".split" + std::to_string(num_parts) + ".part" +
+                        std::to_string(part_index);
+        }
       }
       rest = rest.substr(0, hash);
     }

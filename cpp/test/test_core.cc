@@ -46,6 +46,7 @@
 #include "../src/retry.h"
 #include "../src/s3_filesys.h"
 #include "../src/serializer.h"
+#include "../src/shard_cache.h"
 #include "../src/stream.h"
 #include "../src/telemetry.h"
 
@@ -2037,6 +2038,228 @@ void RunTelemetrySuite() {
   TestTelemetryConcurrentWritersAndSnapshot();
 }
 
+// ---- transcoding shard cache (shard_cache.h) -- the `--cache` suite ------
+// Run standalone (test_core --cache) by the cpp/Makefile asan-cache /
+// tsan-cache lanes: concurrent transcoders/readers over one cache unit,
+// and the crash-recovery path (temp debris, missing manifest, corrupt
+// payload) — the rename/mmap/validate machinery under sanitizers.
+
+std::string WriteCacheCorpus(const std::string& dir, int rows) {
+  std::string path = dir + "/corpus.libsvm";
+  std::ofstream f(path);
+  unsigned s = 12345;
+  for (int i = 0; i < rows; ++i) {
+    f << (i % 2) << ":" << 1.5 << " qid:" << (i / 8);
+    for (int j = 0; j < 10; ++j) {
+      s = s * 1664525u + 1013904223u;
+      f << ' ' << (j + 1) << ':' << (s % 1000) / 250.0;
+    }
+    f << '\n';
+  }
+  return path;
+}
+
+// drain a parser into one flat container (the byte-identity probe)
+dct::RowBlockContainer<uint32_t> DrainParser(dct::Parser<uint32_t>* p) {
+  dct::RowBlockContainer<uint32_t> all;
+  dct::RowBlockContainer<uint32_t> block;
+  while (p->NextBlockMove(&block)) {
+    all.Append(block);
+  }
+  return all;
+}
+
+bool SameBlocks(const dct::RowBlockContainer<uint32_t>& a,
+                const dct::RowBlockContainer<uint32_t>& b) {
+  return a.offset == b.offset && a.label == b.label &&
+         a.weight == b.weight && a.qid == b.qid && a.field == b.field &&
+         a.index == b.index && a.value == b.value &&
+         a.value_i32 == b.value_i32 && a.value_i64 == b.value_i64 &&
+         a.value_dtype == b.value_dtype;
+}
+
+dct::ShardCacheParser<uint32_t>* MakeCacheParser(const std::string& uri,
+                                                 const std::string& dir,
+                                                 dct::ShardCacheMode mode) {
+  dct::ShardCacheConfig cfg;
+  cfg.dir = dir;
+  cfg.mode = mode;
+  cfg.explicit_opt_in = true;
+  const std::string key = dct::ShardCacheKeyText(uri, 0, 1, "libsvm",
+                                                 false, {});
+  return new dct::ShardCacheParser<uint32_t>(
+      [uri]() {
+        return dct::Parser<uint32_t>::Create(uri, 0, 1, "libsvm", 2, true);
+      },
+      cfg, dct::ShardCacheStem(dir, key, 0, 1), key);
+}
+
+void TestShardCacheTranscodeThenReplay() {
+  dct::TemporaryDirectory tmp;
+  const std::string uri = WriteCacheCorpus(tmp.path(), 4000);
+  const std::string cdir = tmp.path() + "/cache";
+  std::unique_ptr<dct::Parser<uint32_t>> plain(
+      dct::Parser<uint32_t>::Create(uri, 0, 1, "libsvm", 2, true));
+  auto text = DrainParser(plain.get());
+  {
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+    EXPECT(!p->replaying());
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+    // same handle: the completed pass published; epoch 2 replays
+    p->BeforeFirst();
+    EXPECT(p->replaying());
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+  }
+  {
+    // fresh handle: replay from construction, base never built
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+    EXPECT(p->replaying());
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+    // the zero-copy view lane agrees with the container lane
+    p->BeforeFirst();
+    dct::RowBlockView<uint32_t> v;
+    uint64_t rows = 0;
+    while (p->NextBlockView(&v)) rows += v.num_rows;
+    EXPECT(rows == text.Size());
+  }
+  {
+    // refresh: forced re-transcode, then replay
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kRefresh));
+    EXPECT(!p->replaying());
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+    p->BeforeFirst();
+    EXPECT(p->replaying());
+  }
+}
+
+void TestShardCacheConcurrentTranscodersAndReaders() {
+  // N parsers over the SAME cache unit, started together: several
+  // transcode to their own temp simultaneously (atomic rename, last
+  // publish wins), stragglers may open the just-published shard — every
+  // drain must be byte-identical regardless of which lane it rode
+  dct::TemporaryDirectory tmp;
+  const std::string uri = WriteCacheCorpus(tmp.path(), 2500);
+  const std::string cdir = tmp.path() + "/cache";
+  std::unique_ptr<dct::Parser<uint32_t>> plain(
+      dct::Parser<uint32_t>::Create(uri, 0, 1, "libsvm", 2, true));
+  auto text = DrainParser(plain.get());
+  for (int round = 0; round < 2; ++round) {  // round 2: all replay
+    constexpr int kWorkers = 4;
+    std::vector<std::thread> threads;
+    std::atomic<int> ok{0};
+    for (int i = 0; i < kWorkers; ++i) {
+      threads.emplace_back([&, i] {
+        std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+            MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+        auto got = DrainParser(p.get());
+        // epoch 2 on the same handle flips to replay
+        p->BeforeFirst();
+        auto again = DrainParser(p.get());
+        if (SameBlocks(text, got) && SameBlocks(text, again)) {
+          ok.fetch_add(1);
+        }
+        (void)i;
+      });
+    }
+    for (auto& t : threads) t.join();
+    EXPECT(ok.load() == kWorkers);
+  }
+}
+
+void TestShardCacheCrashRecoveryAndCorruption() {
+  dct::TemporaryDirectory tmp;
+  const std::string uri = WriteCacheCorpus(tmp.path(), 1500);
+  const std::string cdir = tmp.path() + "/cache";
+  const std::string key = dct::ShardCacheKeyText(uri, 0, 1, "libsvm",
+                                                 false, {});
+  const std::string stem = dct::ShardCacheStem(cdir, key, 0, 1);
+  // owned probe: TryOpen hands out a new'd reader and a discarded
+  // success would leak under the asan lane
+  auto opens = [](const std::string& s, const std::string& k) {
+    return std::unique_ptr<dct::MmapShardReader<uint32_t>>(
+               dct::MmapShardReader<uint32_t>::TryOpen(s, k)) != nullptr;
+  };
+  std::unique_ptr<dct::Parser<uint32_t>> plain(
+      dct::Parser<uint32_t>::Create(uri, 0, 1, "libsvm", 2, true));
+  auto text = DrainParser(plain.get());
+  // crash debris: a partial temp shard, NO manifest (the writer dies
+  // before Finalize) — must be a miss, then a clean re-transcode
+  {
+    mkdir(cdir.c_str(), 0755);
+    std::ofstream(stem + ".dshard.tmp.9999",
+                  std::ios::binary) << "partial garbage";
+    EXPECT(!opens(stem, key));
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+    EXPECT(!p->replaying());
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+  }
+  // a published, valid unit replays
+  EXPECT(opens(stem, key));
+  // corrupt payload byte (size unchanged): checksum miss
+  {
+    std::fstream f(stem + ".dshard",
+                   std::ios::in | std::ios::out | std::ios::binary);
+    f.seekp(300);
+    f.put('\xff');
+  }
+  EXPECT(!opens(stem, key));
+  // the next parser re-transcodes over it and republishes
+  {
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+    EXPECT(!p->replaying());
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+  }
+  EXPECT(opens(stem, key));
+  // a different key (changed parser args) never opens this unit
+  const std::string other = dct::ShardCacheKeyText(
+      uri, 0, 1, "libsvm", false, {{"indexing_mode", "one_based"}});
+  EXPECT(other != key);
+  EXPECT(!opens(stem, other));
+  // truncation: recorded size mismatch
+  truncate((stem + ".dshard").c_str(), 64);
+  EXPECT(!opens(stem, key));
+  // manifest gone: miss even with a shard present
+  std::remove((stem + ".manifest").c_str());
+  EXPECT(!opens(stem, key));
+}
+
+void TestShardCacheKeyText() {
+  using dct::ShardCacheKeyText;
+  const std::string a = ShardCacheKeyText("u", 0, 4, "libsvm", false, {});
+  // part/npart/format/index width all key
+  EXPECT(a != ShardCacheKeyText("u", 1, 4, "libsvm", false, {}));
+  EXPECT(a != ShardCacheKeyText("u", 0, 2, "libsvm", false, {}));
+  EXPECT(a != ShardCacheKeyText("u", 0, 4, "csv", false, {}));
+  EXPECT(a != ShardCacheKeyText("u", 0, 4, "libsvm", true, {}));
+  EXPECT(a != ShardCacheKeyText(
+      "u", 0, 4, "libsvm", false, {{"indexing_mode", "one_based"}}));
+  // cache-lane selectors and pipeline depth do NOT fragment the key
+  EXPECT(a == ShardCacheKeyText("u", 0, 4, "libsvm", false,
+                                {{"cache", "refresh"}}));
+  EXPECT(a == ShardCacheKeyText("u", 0, 4, "libsvm", false,
+                                {{"chunks_in_flight", "7"}}));
+  // mode parsing: the checked-arg rule
+  bool threw = false;
+  try {
+    dct::ParseShardCacheMode("?cache", "fresh", dct::ShardCacheMode::kAuto);
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  EXPECT(threw);
+}
+
+void RunShardCacheSuite() {
+  TestShardCacheKeyText();
+  TestShardCacheTranscodeThenReplay();
+  TestShardCacheConcurrentTranscodersAndReaders();
+  TestShardCacheCrashRecoveryAndCorruption();
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -2073,6 +2296,18 @@ int main(int argc, char** argv) {
     // tsan-parse lanes run exactly this under sanitizers, with
     // DMLC_PARSE_SIMD pinning each dispatch tier
     RunParseSimdSuite();
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
+  if (argc > 1 && std::string(argv[1]) == "--cache") {
+    // the shard-cache suite alone — the cpp/Makefile asan-cache /
+    // tsan-cache lanes run exactly this under sanitizers (concurrent
+    // transcoders + readers over one unit, crash-recovery validation)
+    RunShardCacheSuite();
     if (g_failures == 0) {
       std::printf("OK\n");
       return 0;
@@ -2127,6 +2362,7 @@ int main(int argc, char** argv) {
   RunParseSimdSuite();
   RunIoResilienceSuite();
   RunTelemetrySuite();
+  RunShardCacheSuite();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;

@@ -1,4 +1,7 @@
-"""Host-side data layer: the reference L5 API surface.
+"""Host-side data layer: the reference L5 API surface. Parsers and
+iterators opt into the parse-once/serve-many shard cache — epoch 1 tees
+parsed row blocks into binary shards, epoch 2+ replays them zero-copy
+via mmap ([caching.md](caching.md)).
 
 TPU-native counterpart of reference ``include/dmlc/data.h`` (Row / RowBlock /
 RowBlockIter / Parser, data.h:74-312) and ``src/data/row_block.h``
@@ -378,7 +381,8 @@ class Parser:
     @staticmethod
     def create(uri: str, part: int = 0, npart: int = 1, fmt: str = "auto",
                nthread: int = 0, index64: bool = False,
-               chunks_in_flight: int = 0, **kwargs):
+               chunks_in_flight: int = 0, cache_dir: str = "",
+               cache: str = "", **kwargs):
         """Instantiate a parser for `uri` by format name via the registry
         (reference Parser<I>::Create, data.h:307).
 
@@ -386,7 +390,15 @@ class Parser:
         ``chunks_in_flight`` bounds the chunks the pipelined reader keeps
         outstanding (0 = auto; native formats only — see
         cpp/src/parser.h PipelinedParser). The returned native parser
-        exposes ``pipeline_stats()`` with per-stage occupancy counters."""
+        exposes ``pipeline_stats()`` with per-stage occupancy counters.
+
+        ``cache_dir``/``cache`` opt into the transcoding shard cache
+        ([caching.md](caching.md)): the first pass tees parsed row blocks
+        into a manifest-keyed binary shard under ``cache_dir``, later
+        epochs replay it zero-copy via mmap. ``cache`` is
+        never|auto|refresh; both also ride URI sugar
+        (``#cachefile=<dir>``, ``?cache=``) and env
+        (DMLC_DATA_CACHE_DIR, DMLC_DATA_CACHE)."""
         args = _uri_query_args(uri)
         resolved = args.get("format", "libsvm") if fmt == "auto" else fmt
         if resolved in _NATIVE_FORMATS:
@@ -398,12 +410,26 @@ class Parser:
                     f"(e.g. ?label_column=0), got kwargs {sorted(kwargs)}")
             return NativeParser(uri, part=part, npart=npart, fmt=fmt,
                                 nthread=nthread, index64=index64,
-                                chunks_in_flight=chunks_in_flight)
+                                chunks_in_flight=chunks_in_flight,
+                                cache_dir=cache_dir, cache=cache)
         entry = PARSER_REGISTRY.find(resolved)
         if entry is None:
             raise DMLCError(
                 f"unknown data format {resolved!r}; known: "
                 f"{list(_NATIVE_FORMATS) + PARSER_REGISTRY.list_names()}")
+        uri_cache = args.get("cache", "")
+        frag = uri.split("#", 1)[1] if "#" in uri else ""
+        if (cache_dir or (cache and cache != "never")
+                or frag.startswith("cachefile=")
+                or (uri_cache and uri_cache != "never")):
+            # a cache knob a lane does not implement must error, not
+            # silently parse text every epoch (the URI-sugar no-op rule)
+            # — via kwargs AND via ?cache=/#cachefile= URI sugar alike;
+            # "never" explicitly asks for no caching, which this lane
+            # already delivers
+            raise DMLCError(
+                f"format {resolved!r} is a Python-registered parser; the "
+                f"shard cache covers the native formats only")
         return entry(uri, part, npart, **kwargs)
 
 
@@ -411,12 +437,16 @@ class RowBlockIter:
     """Host row-block iterator (reference RowBlockIter<I,D>::Create,
     data.h:267).
 
-    Without a ``#cachefile`` URI suffix this is the BasicRowIter shape: the
-    whole split is loaded eagerly into ONE RowBlockContainer and iteration
-    yields that single block (reference src/data/basic_row_iter.h). With
-    ``#cachefile`` the native DiskCacheParser serves blocks from its binary
-    cache and iteration is page-at-a-time (reference disk_row_iter.h).
-    For the TPU path use dmlc_core_tpu.tpu.DeviceRowBlockIter instead.
+    Without caching sugar this is the BasicRowIter shape: the whole
+    split is loaded eagerly into ONE RowBlockContainer and iteration
+    yields that single block (reference src/data/basic_row_iter.h). A
+    ``#cachefile=<dir>`` suffix (or ``cache_dir=``) opts into the
+    transcoding shard cache — epoch 1 parses text and tees binary
+    shards, epoch 2+ replays them zero-copy via mmap
+    ([caching.md](caching.md)); a legacy ``#<path>`` fragment selects
+    the native DiskCacheParser single-file cache, page-at-a-time
+    (reference disk_row_iter.h). For the TPU path use
+    dmlc_core_tpu.tpu.DeviceRowBlockIter instead.
 
     ``on_error`` is the graceful-degradation knob for remote sources that
     stay broken past the native retry budget (cpp/src/retry.h): ``"raise"``
@@ -446,9 +476,17 @@ class RowBlockIter:
                chunks_in_flight: int = 0,
                on_error: str = "raise", elastic: Optional[bool] = None,
                leases=None, num_shards: int = 0, shuffle_window: int = 0,
-               run_id: Optional[int] = None, epoch: int = 0):
+               run_id: Optional[int] = None, epoch: int = 0,
+               cache_dir: str = "", cache: str = ""):
         """Factory matching reference RowBlockIter<I>::Create (data.h:267);
         ``on_error="skip"`` enables graceful degradation (class doc).
+
+        ``cache_dir``/``cache`` (never|auto|refresh) opt into the
+        transcoding shard cache ([caching.md](caching.md)): epoch 1
+        parses text and tees binary shards, epoch 2+ replays them
+        zero-copy via mmap. Also reachable via ``#cachefile=<dir>`` /
+        ``?cache=`` URI sugar and the DMLC_DATA_CACHE_DIR /
+        DMLC_DATA_CACHE env knobs.
 
         Elastic opt-in (doc/robustness.md "Elastic data-plane"):
         ``DMLC_ELASTIC_SHARDS=1`` in the environment (exported by an
@@ -462,7 +500,11 @@ class RowBlockIter:
         dataset opened with its own ``part``/``npart``) stays static
         rather than silently joining the tracker's one shard pool; the
         ``?elastic=1`` URI arg always wins. The legacy static
-        ``(part, npart)`` contract is the untouched default."""
+        ``(part, npart)`` contract is the untouched default. Elastic
+        composes with the SHARD cache (each leased shard is keyed as its
+        own ``(shard, num_shards)`` unit, so a reassigned shard replays
+        from binary on any worker sharing the cache dir) but not with
+        the legacy single-file ``#<path>`` cache."""
         from dmlc_core_tpu.tracker.wire import env_int
         uri_args = _uri_query_args(uri)
         if elastic is None:
@@ -480,13 +522,18 @@ class RowBlockIter:
         if not elastic:
             parser = Parser.create(uri, part, npart, fmt, nthread=nthread,
                                    index64=index64,
-                                   chunks_in_flight=chunks_in_flight)
-            return RowBlockIter(parser, eager="#" not in uri,
-                                on_error=on_error)
-        if "#" in uri:
+                                   chunks_in_flight=chunks_in_flight,
+                                   cache_dir=cache_dir, cache=cache)
+            eager = "#" not in uri and not (
+                cache_dir and cache != "never")
+            return RowBlockIter(parser, eager=eager, on_error=on_error)
+        frag = uri.split("#", 1)[1] if "#" in uri else ""
+        if frag and not frag.startswith("cachefile="):
             raise DMLCError(
-                "elastic mode does not compose with #cachefile (the disk "
-                "cache is keyed by a static part index)")
+                "elastic mode does not compose with the legacy `#<path>` "
+                "row-block cache (it is keyed by a static part index); "
+                "use the `#cachefile=<dir>` shard cache, which keys "
+                "each leased shard independently")
         num_shards = num_shards or _uri_int(uri_args, "num_shards") or \
             env_int("DMLC_TRACKER_NUM_SHARDS", 0)
         if num_shards <= 0:
@@ -508,7 +555,8 @@ class RowBlockIter:
         return ElasticRowBlockIter(
             _strip_uri_args(uri, _ELASTIC_URI_KEYS), leases, num_shards,
             fmt=fmt, nthread=nthread, index64=index64, epoch=epoch,
-            run_id=run_id, shuffle_window=shuffle_window, on_error=on_error)
+            run_id=run_id, shuffle_window=shuffle_window, on_error=on_error,
+            cache_dir=cache_dir, cache=cache)
 
     def _next_block_degradable(self):
         """next_block() honoring on_error: with "skip", a failing pull is
@@ -753,7 +801,8 @@ class ElasticRowBlockIter:
                  nthread: int = 0, index64: bool = False, epoch: int = 0,
                  run_id: Optional[int] = None, shuffle_window: int = 0,
                  on_error: str = "raise",
-                 acquire_timeout: Optional[float] = None):
+                 acquire_timeout: Optional[float] = None,
+                 cache_dir: str = "", cache: str = ""):
         if num_shards <= 0:
             raise DMLCError("elastic mode needs num_shards > 0")
         if on_error not in ("raise", "skip"):
@@ -776,6 +825,13 @@ class ElasticRowBlockIter:
         self.shuffle_window = shuffle_window
         self._on_error = on_error
         self._acquire_timeout = acquire_timeout
+        # shard-cache knobs: each leased shard parses as its own
+        # (shard, num_shards) unit, so the cache keys shards
+        # independently — after a lease reassignment the new holder
+        # replays the dead worker's shards from binary when the cache
+        # dir is shared (or re-transcodes them once when it is not)
+        self._cache_dir = cache_dir
+        self._cache = cache
         self.consumed: List[int] = []
         self.skipped_shards = 0
         self.last_error: Optional[str] = None
@@ -792,7 +848,8 @@ class ElasticRowBlockIter:
     def _load_shard(self, shard: int) -> RowBlockContainer:
         parser = Parser.create(self._uri, part=shard,
                                npart=self.num_shards, fmt=self._fmt,
-                               nthread=self._nthread, index64=self._index64)
+                               nthread=self._nthread, index64=self._index64,
+                               cache_dir=self._cache_dir, cache=self._cache)
         try:
             blocks = []
             while True:

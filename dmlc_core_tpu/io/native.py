@@ -154,7 +154,7 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_parser_create": [c.c_char_p, u, u, c.c_char_p, i, i, i,
                               c.POINTER(vp)],
         "dct_parser_create_ex": [c.c_char_p, u, u, c.c_char_p, i, i, i, i,
-                                 c.POINTER(vp)],
+                                 c.c_char_p, c.c_char_p, c.POINTER(vp)],
         "dct_parser_pipeline_stats": [vp, c.POINTER(ParsePipelineStatsC),
                                       c.POINTER(i)],
         "dct_parser_next_block": [vp, c.POINTER(RowBlockC), c.POINTER(i)],
@@ -794,13 +794,23 @@ class NativeParser:
 
     def __init__(self, uri: str, part: int = 0, npart: int = 1,
                  fmt: str = "auto", nthread: int = 0, threaded: bool = True,
-                 index64: bool = False, chunks_in_flight: int = 0):
+                 index64: bool = False, chunks_in_flight: int = 0,
+                 cache_dir: str = "", cache: str = ""):
+        # shard-cache knobs (doc/caching.md): cache_dir names the shard
+        # directory (also reachable via `#cachefile=<dir>` URI sugar /
+        # DMLC_DATA_CACHE_DIR), cache is never|auto|refresh (also
+        # `?cache=` / DMLC_DATA_CACHE). Validated natively via the
+        # checked-parse rule; the Python check here just fails earlier
+        # with the same vocabulary.
+        if cache not in ("", "never", "auto", "refresh"):
+            raise DMLCError(
+                f"cache must be one of never|auto|refresh, got {cache!r}")
         uri = _route_https(uri)
         self._h = ctypes.c_void_p()
         _check(lib().dct_parser_create_ex(
             uri.encode(), part, npart, fmt.encode(), nthread,
             1 if threaded else 0, 1 if index64 else 0, chunks_in_flight,
-            ctypes.byref(self._h)))
+            cache_dir.encode(), cache.encode(), ctypes.byref(self._h)))
 
     def next_block(self) -> Optional[RowBlock]:
         """Next parsed RowBlock view, or None at end of data; the view stays

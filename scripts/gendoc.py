@@ -221,8 +221,11 @@ def gen_parameters() -> str:
         "argument select one; `.rec`/`.drec` files are auto-detected by "
         "suffix.",
         "",
-        "URI sugar shared by every format: `#cachefile` caches parsed "
-        "row blocks on disk for later epochs, and "
+        "URI sugar shared by every format: `#cachefile=<dir>` opts into "
+        "the transcoding shard cache — epoch 1 parses text and tees "
+        "binary shards, epoch 2+ replays them zero-copy via mmap "
+        "([caching.md](caching.md)); a legacy `#<path>` fragment selects "
+        "the single-file row-block cache; and "
         "`?shuffle_parts=K[&shuffle_seed=S]` subdivides each partition "
         "into K byte ranges visited in a freshly shuffled order every "
         "epoch (the coarse-grained training shuffle, reference "
@@ -250,6 +253,10 @@ def gen_index() -> str:
         "| [parsing.md](parsing.md) | SIMD text ingest: structural "
         "scanner tiers, fused field decoders, DMLC_PARSE_SIMD, the "
         "byte-identical guarantee |",
+        "| [caching.md](caching.md) | parse-once/serve-many shard cache: "
+        "manifest keying, shard format, mmap zero-copy replay, "
+        "never/auto/refresh knobs, failure semantics, elastic "
+        "interaction |",
         "| [robustness.md](robustness.md) | remote-I/O resilience (retry "
         "model, env/URI knobs, fault-plan grammar, io_stats()) + "
         "distributed job liveness (heartbeats, dead-rank deadlines, "

@@ -77,40 +77,48 @@ def test_simd_lane_single_thread_floor(tmp_path):
     so a regression of the SIMD text-ingest lane (doc/parsing.md) fails
     tier-1 instead of only showing in bench.
 
-    Two assertions, both robust to the host's minute-to-minute clock
-    swings because they compare lanes measured back-to-back in THIS run:
+    Measured in PROCESS CPU TIME with interleaved A/B batches and bounded
+    re-measure — the PR 5 overhead-guard recipe (tests/test_telemetry.py).
+    The previous wall-clock best-of-3 version was the suite's known flake:
+    this host's wall clock swings ±40% minute-to-minute under full runs
+    (passes in isolation, fails in the pack), far above the 0.85x ratio
+    it asserts. CPU ticks drift ~10% here, so each sample is a BATCH of
+    passes, lanes alternate order so neither always pays the post-switch
+    sample, and the guard re-measures up to 4 times, passing on the first
+    in-bound result — noise clears within an attempt or two, while the
+    regression class this exists to catch (a fused-decode bug or an
+    always-delegate storm at ~0.5x) fails every attempt.
+
+    Two assertions:
       - the SIMD lane is actually engaged (not silently scalar);
-      - SIMD throughput >= 0.85x scalar (a fused-decode regression or an
-        accidental always-delegate storm lands at ~0.5x and fails loudly;
-        the healthy ratio measures 1.05-1.35x), plus a loose absolute
-        floor that catches catastrophic slowdowns without tripping on a
-        throttled CI neighbor.
+      - SIMD CPU cost per pass <= scalar/0.85 (ratio >= 0.85; the healthy
+        ratio measures 1.05-1.35x), plus a loose absolute CPU-throughput
+        floor for catastrophic slowdowns.
     """
     rng = np.random.default_rng(17)
     path = tmp_path / "floor.libsvm"
     with open(path, "w") as f:
-        for i in range(60000):
+        for i in range(30000):
             feats = " ".join(
                 f"{j}:{rng.uniform(-3, 3):.6f}" for j in range(16))
             f.write(f"{i % 2} {feats}\n")
     size_mb = os.path.getsize(path) / 1e6
 
-    def lane_secs(env_tier: str) -> float:
+    def batch_cpu(env_tier: str, n: int = 8) -> float:
+        # CPU accounting is tick-granular (~10 ms) and one pass costs
+        # ~30 ms; an 8-pass batch keeps the quantization under ~5%
         old = os.environ.get("DMLC_PARSE_SIMD")
         os.environ["DMLC_PARSE_SIMD"] = env_tier
         try:
-            best = None
-            for _ in range(3):
-                t0 = time.time()
+            t0 = time.process_time()
+            for _ in range(n):
                 got = 0
                 with NativeParser(str(path), nthread=1,
                                   threaded=False) as p:
                     for b in p:
                         got += b.num_rows
-                dt = time.time() - t0
-                assert got == 60000
-                best = dt if best is None else min(best, dt)
-            return best
+                assert got == 30000
+            return (time.process_time() - t0) / n
         finally:
             if old is None:
                 os.environ.pop("DMLC_PARSE_SIMD", None)
@@ -123,20 +131,31 @@ def test_simd_lane_single_thread_floor(tmp_path):
     if lane == "scalar":
         pytest.skip("no SIMD tier on this host (big-endian or forced off)")
 
-    # interleaved to share whatever clock the host is giving right now
-    scalar_s, simd_s = [], []
-    for _ in range(2):
-        scalar_s.append(lane_secs("0"))
-        simd_s.append(lane_secs("1"))
-    scalar_t, simd_t = min(scalar_s), min(simd_s)
-    ratio = scalar_t / simd_t
-    assert ratio >= 0.85, (
-        f"SIMD lane ({lane}) regressed below the scalar lane: "
-        f"{size_mb / simd_t:.0f} MB/s vs scalar {size_mb / scalar_t:.0f} "
-        f"MB/s ({ratio:.2f}x)")
-    assert size_mb / simd_t >= 60.0, (
+    batch_cpu("1", n=1)  # warm the page cache outside the measured reps
+
+    def measure():
+        best = {"0": float("inf"), "1": float("inf")}
+        for rep in range(2):
+            order = ("0", "1") if rep % 2 == 0 else ("1", "0")
+            for tier in order:
+                best[tier] = min(best[tier], batch_cpu(tier))
+        return best
+
+    ratios = []
+    for _ in range(4):
+        best = measure()
+        ratios.append(best["0"] / best["1"])  # scalar CPU / simd CPU
+        if ratios[-1] >= 0.85 and size_mb / best["1"] >= 40.0:
+            break
+    scalar_t, simd_t = best["0"], best["1"]
+    assert ratios[-1] >= 0.85, (
+        f"SIMD lane ({lane}) regressed below the scalar lane across "
+        f"{len(ratios)} interleaved CPU-time measurements: ratios "
+        f"{[round(r, 3) for r in ratios]} ({size_mb / simd_t:.0f} "
+        f"MB/cpu-s vs scalar {size_mb / scalar_t:.0f} MB/cpu-s)")
+    assert size_mb / simd_t >= 40.0, (
         f"catastrophic single-thread parse slowdown: "
-        f"{size_mb / simd_t:.0f} MB/s")
+        f"{size_mb / simd_t:.0f} MB/cpu-s across {len(ratios)} attempts")
 
 
 @pytest.mark.skipif(_usable_cpus() < 4,

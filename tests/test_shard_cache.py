@@ -1,0 +1,437 @@
+"""Transcoding binary shard cache (cpp/src/shard_cache.h, doc/caching.md).
+
+The one invariant everything here pins: the cache lane is INVISIBLE to the
+consumer — every row block served from an mmap replay is byte-identical to
+what the text lane parses, and every way a cache can be wrong (crash
+mid-transcode, changed parser args, corrupt/truncated bytes, foreign file
+under the same name) is a MISS that falls back to text, never wrong data.
+
+Covers the ISSUE 7 edge list: crash mid-transcode (kill the writer, next
+open re-transcodes), parser-arg change misses, ``cache=refresh``, and
+mmap-reader-vs-text-lane byte-identity across all three text formats and
+both index widths, plus the elastic iterator's per-shard caching.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io.native import NativeParser, native_telemetry_snapshot
+
+
+def _write_libsvm(path, rows=4000, seed=5):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(rows):
+            feats = " ".join(
+                f"{j + 1}:{rng.uniform(-3, 3):.6f}" for j in range(12))
+            f.write(f"{i % 2}:{1.5} qid:{i // 10} {feats}\n")
+    return str(path)
+
+
+def _write_csv(path, rows=4000, seed=5):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(rows):
+            # a missing cell per row exercises sparse csv offsets
+            cells = [f"{rng.uniform(-3, 3):.6f}" for _ in range(8)]
+            cells[(i % 7) + 1] = ""
+            f.write(f"{i % 2}," + ",".join(cells) + "\n")
+    return str(path)
+
+
+def _write_libfm(path, rows=4000, seed=5):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(rows):
+            feats = " ".join(
+                f"{j % 5}:{j}:{rng.uniform(-3, 3):.6f}" for j in range(10))
+            f.write(f"{i % 2} {feats}\n")
+    return str(path)
+
+
+def _drain(uri, **kw):
+    """Concatenated arrays of every block — the byte-identity probe."""
+    out = {"offset_deltas": [], "label": [], "weight": [], "qid": [],
+           "field": [], "index": [], "value": []}
+    with NativeParser(uri, **kw) as p:
+        for b in p:
+            out["offset_deltas"].append(np.diff(b.offset))
+            out["label"].append(b.label.copy())
+            out["index"].append(b.index.copy())
+            for name in ("weight", "qid", "field", "value"):
+                arr = getattr(b, name)
+                if arr is not None:
+                    out[name].append(arr.copy())
+    return {k: (np.concatenate(v) if v else None) for k, v in out.items()}
+
+
+def _assert_identical(a, b, what):
+    assert set(k for k, v in a.items() if v is not None) == \
+        set(k for k, v in b.items() if v is not None), what
+    for k, v in a.items():
+        if v is not None:
+            assert np.array_equal(v, b[k]), f"{what}: {k} differs"
+
+
+_FORMATS = [
+    ("libsvm", _write_libsvm, ""),
+    ("csv", _write_csv, "?format=csv&label_column=0"),
+    ("libfm", _write_libfm, "?format=libfm"),
+]
+
+
+@pytest.mark.parametrize("fmt,writer,qargs",
+                         _FORMATS, ids=[f[0] for f in _FORMATS])
+@pytest.mark.parametrize("index64", [False, True], ids=["u32", "u64"])
+def test_cache_byte_identity_all_formats(tmp_path, fmt, writer, qargs,
+                                         index64):
+    """mmap replay == text lane for every format x index width, across
+    a fresh-handle reopen AND a same-handle before_first epoch flip."""
+    path = writer(tmp_path / f"d.{fmt}")
+    cdir = str(tmp_path / "cache")
+    uri = path + qargs
+    text = _drain(uri, index64=index64)
+    ep1 = _drain(uri, index64=index64, cache_dir=cdir)          # transcode
+    ep2 = _drain(uri, index64=index64, cache_dir=cdir)          # replay
+    _assert_identical(text, ep1, f"{fmt} transcode epoch")
+    _assert_identical(text, ep2, f"{fmt} mmap replay")
+    # same handle, multi-epoch: epoch 1 transcodes, epoch 2 replays
+    with NativeParser(uri, index64=index64,
+                      cache_dir=str(tmp_path / "c2")) as p:
+        rows1 = sum(b.num_rows for b in p)
+        p.before_first()
+        rows2 = sum(b.num_rows for b in p)
+    assert rows1 == rows2 == len(text["label"])
+
+
+def test_cache_parser_arg_change_misses(tmp_path):
+    """A changed parser arg keys a DIFFERENT cache unit: the stale shard
+    is never served for the new args (and both stay correct)."""
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    one = path + "?indexing_mode=one_based"
+    zero = path + "?indexing_mode=zero_based"
+    a1 = _drain(one, cache_dir=cdir)
+    assert len(os.listdir(cdir)) == 2  # shard + manifest
+    b1 = _drain(zero, cache_dir=cdir)
+    assert len(os.listdir(cdir)) == 4  # a second keyed unit appeared
+    # replays: each resolves to its own shard, each identical to its lane
+    _assert_identical(a1, _drain(one, cache_dir=cdir), "one_based replay")
+    _assert_identical(b1, _drain(zero, cache_dir=cdir), "zero_based replay")
+    assert int(a1["index"].min()) == int(b1["index"].min()) - 1
+
+
+def test_cache_part_npart_keying(tmp_path):
+    """(part, npart) is part of the key: split units never cross-serve."""
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    p0 = _drain(path, part=0, npart=2, cache_dir=cdir)
+    p1 = _drain(path, part=1, npart=2, cache_dir=cdir)
+    whole = _drain(path, cache_dir=cdir)
+    # replay epochs of each unit
+    _assert_identical(p0, _drain(path, part=0, npart=2, cache_dir=cdir),
+                      "part0 replay")
+    _assert_identical(p1, _drain(path, part=1, npart=2, cache_dir=cdir),
+                      "part1 replay")
+    assert len(p0["label"]) + len(p1["label"]) == len(whole["label"])
+    assert np.array_equal(
+        np.concatenate([p0["label"], p1["label"]]), whole["label"])
+
+
+def test_cache_refresh_retranscodes(tmp_path):
+    """cache=refresh ignores the valid shard, re-transcodes, then the
+    refreshed shard serves later epochs."""
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    base = _drain(path, cache_dir=cdir)
+    shard = [f for f in os.listdir(cdir) if f.endswith(".dshard")][0]
+    ino_before = os.stat(os.path.join(cdir, shard)).st_ino
+    got = _drain(path, cache_dir=cdir, cache="refresh")
+    _assert_identical(base, got, "refresh epoch")
+    ino_after = os.stat(os.path.join(cdir, shard)).st_ino
+    assert ino_before != ino_after, "refresh must rewrite the shard file"
+    # and the refreshed cache replays
+    _assert_identical(base, _drain(path, cache_dir=cdir), "post-refresh")
+
+
+def test_cache_never_disables(tmp_path):
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    _drain(path, cache_dir=cdir, cache="never")
+    assert not os.path.exists(cdir) or not os.listdir(cdir)
+
+
+def test_cache_mode_typo_errors(tmp_path):
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    with pytest.raises(DMLCError):
+        NativeParser(path, cache_dir=str(tmp_path / "c"), cache="fresh")
+    with pytest.raises(DMLCError, match="never|auto|refresh"):
+        NativeParser(path + "?cache=sometimes",
+                     cache_dir=str(tmp_path / "c"))
+
+
+def test_cache_shuffle_combo_errors(tmp_path):
+    """Explicit cache + shuffling must error (the cache would replay
+    epoch 1's order and silently disable the reshuffle)."""
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    with pytest.raises(DMLCError, match="shuffle"):
+        NativeParser(path + "?shuffle_parts=4",
+                     cache_dir=str(tmp_path / "c"))
+
+
+def test_crash_mid_transcode_retranscodes(tmp_path):
+    """SIGKILL the transcoding writer mid-pass: the temp shard exists but
+    no manifest is ever published (finalize is manifest-LAST), so the next
+    open re-transcodes and serves correct bytes.
+
+    Deterministic, not a timing race: the child parks AFTER draining (and
+    teeing) its first block and is killed while parked — the pass is
+    provably mid-flight when it dies."""
+    path = _write_libsvm(tmp_path / "big.libsvm", rows=20000)
+    cdir = str(tmp_path / "cache")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [sys.executable, "-c", f"""
+import sys, os, time
+sys.path.insert(0, {repo!r})
+from dmlc_core_tpu.io.native import NativeParser
+with NativeParser({path!r}, cache_dir={cdir!r}, nthread=1) as p:
+    assert p.next_block() is not None  # first block parsed AND teed
+    open(os.path.join({cdir!r}, "midpass"), "w").close()
+    time.sleep(120)  # park mid-pass; the parent kills us here
+"""],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    marker = os.path.join(cdir, "midpass")
+    deadline = time.time() + 90
+    while not os.path.exists(marker) and time.time() < deadline:
+        assert child.poll() is None, child.stderr.read().decode()
+        time.sleep(0.02)
+    assert os.path.exists(marker), "child never reached mid-pass"
+    child.send_signal(signal.SIGKILL)
+    child.wait()
+    names = os.listdir(cdir)
+    assert not any(n.endswith(".manifest") for n in names), \
+        f"a crashed pass must not publish a manifest: {names}"
+    assert any(".dshard.tmp." in n for n in names), \
+        f"expected the orphaned temp shard: {names}"
+    # the next open must re-transcode (a partial cache is a miss)...
+    text = _drain(path)
+    got = _drain(path, cache_dir=cdir)
+    _assert_identical(text, got, "post-crash transcode")
+    # ...and then replay the now-complete shard
+    _assert_identical(text, _drain(path, cache_dir=cdir),
+                      "post-crash replay")
+
+
+def test_error_skipped_mid_transcode_never_publishes(tmp_path):
+    """A pull that throws mid-pass may be SKIPPED by the consumer
+    (RowBlockIter on_error="skip" keeps pulling to end of stream): the
+    pass has a hole, so it must never publish — else every later epoch
+    (and any process sharing the cache dir, even with on_error="raise")
+    would silently replay the truncated stream as a cache HIT."""
+    path = tmp_path / "badmid.libsvm"
+    rng = np.random.default_rng(11)
+    with open(path, "w") as f:
+        for i in range(30000):
+            feats = " ".join(
+                f"{j}:{rng.uniform(-3, 3):.5f}" for j in range(12))
+            f.write(f"{i % 2} {feats}\n")
+        # explicit-value/no-value mix inside one block: the parser throws
+        f.write("1 5:notanum\n")
+        for i in range(30000):
+            feats = " ".join(
+                f"{j}:{rng.uniform(-3, 3):.5f}" for j in range(12))
+            f.write(f"{i % 2} {feats}\n")
+    cdir = str(tmp_path / "cache")
+
+    def drain_skipping(threaded):
+        rows = errs = 0
+        with NativeParser(str(path), threaded=threaded, nthread=1,
+                          cache_dir=cdir) as p:
+            while True:
+                try:
+                    b = p.next_block()
+                except DMLCError:
+                    errs += 1
+                    if errs > 8:
+                        break  # pipelined lane latches failed; stop
+                    continue
+                if b is None:
+                    break
+                rows += b.num_rows
+        return rows, errs
+
+    # the unpipelined lane reaches a CLEAN end of stream after the
+    # skipped error — exactly the shape that used to publish a shard
+    # with a hole in it
+    rows, errs = drain_skipping(threaded=False)
+    assert errs >= 1 and 0 < rows < 60000
+    names = os.listdir(cdir)
+    assert not any(n.endswith(".manifest") for n in names), \
+        f"an error-skipped pass must not publish: {names}"
+    # the pipelined lane latches failed after the first error; it must
+    # not publish either
+    rows, errs = drain_skipping(threaded=True)
+    assert errs >= 1
+    names = os.listdir(cdir)
+    assert not any(n.endswith(".manifest") for n in names), \
+        f"an error-skipped pipelined pass must not publish: {names}"
+
+
+def test_corrupt_shard_falls_back_to_text(tmp_path):
+    """Flip bytes inside a published shard: validation rejects it (a
+    MISS, not an error) and the epoch parses text — then re-publishes a
+    good shard over it."""
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    text = _drain(path, cache_dir=cdir)
+    shard = [f for f in os.listdir(cdir) if f.endswith(".dshard")][0]
+    spath = os.path.join(cdir, shard)
+    with open(spath, "r+b") as f:
+        f.seek(200)
+        f.write(b"\xff" * 64)  # stomp block internals, size unchanged
+    got = _drain(path, cache_dir=cdir)
+    _assert_identical(text, got, "corrupt-shard fallback")
+    _assert_identical(text, _drain(path, cache_dir=cdir),
+                      "re-published replay")
+
+
+def test_truncated_shard_falls_back_to_text(tmp_path):
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    text = _drain(path, cache_dir=cdir)
+    shard = [f for f in os.listdir(cdir) if f.endswith(".dshard")][0]
+    spath = os.path.join(cdir, shard)
+    os.truncate(spath, os.path.getsize(spath) // 2)
+    _assert_identical(text, _drain(path, cache_dir=cdir),
+                      "truncated-shard fallback")
+
+
+def test_corrupt_manifest_falls_back_to_text(tmp_path):
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    text = _drain(path, cache_dir=cdir)
+    man = [f for f in os.listdir(cdir) if f.endswith(".manifest")][0]
+    with open(os.path.join(cdir, man), "w") as f:
+        f.write("not a manifest\n")
+    _assert_identical(text, _drain(path, cache_dir=cdir),
+                      "corrupt-manifest fallback")
+
+
+def test_cache_env_knobs(tmp_path, monkeypatch):
+    """DMLC_DATA_CACHE_DIR enables the cache process-wide; DMLC_DATA_CACHE
+    gates it; a typo'd mode errors (checked-env rule)."""
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "envcache")
+    monkeypatch.setenv("DMLC_DATA_CACHE_DIR", cdir)
+    text = _drain(path)
+    assert any(f.endswith(".dshard") for f in os.listdir(cdir))
+    _assert_identical(text, _drain(path), "env-enabled replay")
+    monkeypatch.setenv("DMLC_DATA_CACHE", "never")
+    before = sorted(os.listdir(cdir))
+    _drain(path)
+    assert sorted(os.listdir(cdir)) == before
+    monkeypatch.setenv("DMLC_DATA_CACHE", "garbage")
+    with pytest.raises(DMLCError, match="never|auto|refresh"):
+        NativeParser(path)
+    monkeypatch.delenv("DMLC_DATA_CACHE")
+    # env cache + shuffling: shuffling wins silently (a process-wide env
+    # must not break unrelated shuffled lanes)
+    rows = 0
+    with NativeParser(path + "?shuffle_parts=2&shuffle_seed=3") as p:
+        rows = sum(b.num_rows for b in p)
+    assert rows == 4000
+
+
+def test_cache_telemetry_counters(tmp_path):
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+
+    def cache_counters():
+        snap = native_telemetry_snapshot()
+        return {c["name"]: c["value"] for c in snap["counters"]
+                if c["name"].startswith("cache_")}
+
+    c0 = cache_counters()
+    _drain(path, cache_dir=cdir)
+    c1 = cache_counters()
+    assert c1["cache_misses_total"] > c0.get("cache_misses_total", 0)
+    assert c1["cache_transcodes_total"] > c0.get("cache_transcodes_total", 0)
+    _drain(path, cache_dir=cdir)
+    c2 = cache_counters()
+    assert c2["cache_hits_total"] > c1.get("cache_hits_total", 0)
+    snap = native_telemetry_snapshot()
+    hists = {h["name"] for h in snap["histograms"]}
+    assert {"cache_read_us", "cache_write_us"} <= hists
+
+
+# -- iterator surfaces -------------------------------------------------------
+def test_rowblockiter_cache_epochs(tmp_path):
+    """RowBlockIter.create with cache knobs: paged iteration, epoch 2
+    identical to epoch 1."""
+    from dmlc_core_tpu.data import RowBlockIter
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    it = RowBlockIter.create(path, cache_dir=str(tmp_path / "c"))
+    ep1 = [b for b in it]
+    ep2 = [b for b in it]  # restarts via before_first inside __iter__
+    l1 = np.concatenate([b.label for b in ep1])
+    l2 = np.concatenate([b.label for b in ep2])
+    assert np.array_equal(l1, l2) and len(l1) == 4000
+    it.close()
+
+
+def test_elastic_iter_caches_per_shard(tmp_path):
+    """The elastic iterator composes with the shard cache: each leased
+    shard is keyed as its own (shard, num_shards) unit, the global stream
+    is identical to the uncached elastic stream, and a SECOND worker set
+    (the post-reassignment shape) replays from the published shards."""
+    from dmlc_core_tpu.data import ElasticRowBlockIter, LocalLeases
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+
+    def stream(cache_dir=""):
+        it = ElasticRowBlockIter(path, LocalLeases(4), num_shards=4,
+                                 cache_dir=cache_dir)
+        return np.concatenate([b.label for b in it])
+
+    plain = stream()
+    ep1 = stream(cache_dir=cdir)  # transcodes 4 shard units
+    assert np.array_equal(plain, ep1)
+    shards = [f for f in os.listdir(cdir) if f.endswith(".dshard")]
+    assert len(shards) == 4
+    # a fresh worker (post-reassignment / late joiner) replays from binary
+    mtimes = {f: os.stat(os.path.join(cdir, f)).st_mtime_ns
+              for f in shards}
+    ep2 = stream(cache_dir=cdir)
+    assert np.array_equal(plain, ep2)
+    assert mtimes == {f: os.stat(os.path.join(cdir, f)).st_mtime_ns
+                      for f in shards}, "replay must not rewrite shards"
+
+
+def test_elastic_rejects_legacy_cache_fragment(tmp_path):
+    from dmlc_core_tpu.data import RowBlockIter, LocalLeases
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    with pytest.raises(DMLCError, match="legacy"):
+        RowBlockIter.create(path + "#" + str(tmp_path / "x.cache"),
+                            elastic=True, leases=LocalLeases(2),
+                            num_shards=2)
+
+
+def test_elastic_cachefile_dir_fragment_allowed(tmp_path):
+    """PR 6's blanket "no #cachefile in elastic mode" is lifted for the
+    dir form: the shard cache keys each leased shard independently."""
+    from dmlc_core_tpu.data import RowBlockIter, LocalLeases
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    it = RowBlockIter.create(path + "#cachefile=" + cdir, elastic=True,
+                             leases=LocalLeases(2), num_shards=2)
+    total = sum(len(b.label) for b in it)
+    assert total == 4000
+    assert any(f.endswith(".dshard") for f in os.listdir(cdir))
