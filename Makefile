@@ -2,11 +2,12 @@
 # on every push: .github/workflows/githubci.yml, scripts/test_script.sh).
 # `make ci` runs every lane; each lane is also callable alone.
 
-.PHONY: ci lint native-test tsan-test asan-test parse-lanes telemetry \
-        cache pytest liveness elastic bench-smoke dryrun doc clean
+.PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
+        parse-lanes telemetry cache pytest liveness elastic bench-smoke \
+        dryrun doc clean
 
-ci: lint native-test tsan-test asan-test parse-lanes telemetry cache \
-    pytest liveness elastic dryrun doc
+ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
+    telemetry cache pytest liveness elastic dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -37,6 +38,19 @@ cache:
 
 lint:
 	python3 scripts/lint.py
+
+# Concurrency & invariant analysis (doc/analysis.md): the Python
+# lock-discipline pass (blocking calls / re-acquisition under a held
+# lock), the C++ DMLC_GUARDED_BY structural checker, and the
+# checked-env-parse / no-runtime-assert lints. Exit code = finding count.
+analyze:
+	python3 scripts/analyze.py
+
+# gcc UndefinedBehaviorSanitizer lane (doc/analysis.md): the byte-load
+# heavy suites (--parse/--cache/--telemetry) plus the deterministic
+# shard-cache fuzz driver (--fuzz-shard), every finding fatal
+ubsan-test:
+	$(MAKE) -C cpp ubsan-test
 
 # regenerates doc/api.md + doc/parameters.md from the live package; any
 # undocumented public symbol fails the lane (the reference promotes doxygen

@@ -359,6 +359,8 @@ void AzureFileSystem::ListDirectory(const URI& path,
       if (name == prefix) continue;
       FileInfo info;
       info.path = URI("azure://" + container + "/" + name);
+      // env-ok: service XML listing size, not a config knob; an absent
+      // field deliberately degrades to size 0
       info.size = static_cast<size_t>(std::atoll(sz.c_str()));
       info.type = FileType::kFile;
       out->push_back(info);
@@ -417,8 +419,9 @@ FileInfo AzureFileSystem::PathInfoUnderPolicy(
       std::string name, sz;
       if (!s3::XmlNextField(chunk, &cp, "Name", &name)) continue;
       s3::XmlNextField(chunk, &cp, "Content-Length", &sz);
-      page.objects.push_back({s3::XmlUnescape(name),
-                              static_cast<size_t>(std::atoll(sz.c_str()))});
+      // env-ok: service XML listing size, not a config knob
+      const size_t obj_size = static_cast<size_t>(std::atoll(sz.c_str()));
+      page.objects.push_back({s3::XmlUnescape(name), obj_size});
     }
     pos = 0;
     while (s3::XmlNextField(resp.body, &pos, "BlobPrefix", &chunk)) {

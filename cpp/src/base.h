@@ -59,4 +59,32 @@ struct CheckFire {
 #define DCT_CHECK_GT(a, b) DCT_CHECK_BINARY(a, b, >)
 #define DCT_CHECK_GE(a, b) DCT_CHECK_BINARY(a, b, >=)
 
+// ---------------------------------------------------------------------------
+// Thread-safety capability annotations (doc/analysis.md).
+//
+// Under clang these expand to the thread-safety-analysis attributes, so a
+// `clang -Wthread-safety` build checks them natively; under gcc (this
+// image's compiler) they expand to nothing and the structural checker in
+// scripts/analyze.py enforces the same contract: every member declared
+// DMLC_GUARDED_BY(m) may only be touched inside a lock_guard/unique_lock/
+// scoped_lock scope of `m`, or inside a function declared DMLC_REQUIRES(m).
+// Audited exceptions (single-threaded teardown, pre-spawn init) carry a
+// `// lock-ok: <reason>` comment on the touching line.
+//
+//   std::mutex mu_;
+//   std::deque<Task*> q_ DMLC_GUARDED_BY(mu_);
+//   void DrainLocked() DMLC_REQUIRES(mu_);   // caller holds mu_
+//   void Publish() DMLC_EXCLUDES(mu_);       // caller must NOT hold mu_
+#if defined(__clang__) && defined(__has_attribute)
+#if __has_attribute(guarded_by)
+#define DMLC_THREAD_ANNOTATION(x) __attribute__((x))
+#endif
+#endif
+#ifndef DMLC_THREAD_ANNOTATION
+#define DMLC_THREAD_ANNOTATION(x)  // no-op under gcc; analyze.py checks
+#endif
+#define DMLC_GUARDED_BY(m) DMLC_THREAD_ANNOTATION(guarded_by(m))
+#define DMLC_REQUIRES(m) DMLC_THREAD_ANNOTATION(requires_capability(m))
+#define DMLC_EXCLUDES(m) DMLC_THREAD_ANNOTATION(locks_excluded(m))
+
 #endif  // DCT_BASE_H_

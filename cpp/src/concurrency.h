@@ -100,16 +100,16 @@ class ConcurrentBlockingQueue {
       return seq > o.seq;
     }
   };
-  size_t Size() const {
+  size_t Size() const DMLC_REQUIRES(mu_) {
     return kType == QueueType::kFIFO ? fifo_.size() : heap_.size();
   }
 
-  std::mutex mu_;
+  mutable std::mutex mu_;
   std::condition_variable cv_;
-  std::deque<T> fifo_;
-  std::priority_queue<Entry> heap_;
-  uint64_t seq_ = 0;
-  bool killed_ = false;
+  std::deque<T> fifo_ DMLC_GUARDED_BY(mu_);
+  std::priority_queue<Entry> heap_ DMLC_GUARDED_BY(mu_);
+  uint64_t seq_ DMLC_GUARDED_BY(mu_) = 0;
+  bool killed_ DMLC_GUARDED_BY(mu_) = false;
 };
 
 // Manually-reset event gate (reference thread_group.h:32-73).
@@ -146,7 +146,7 @@ class ManualEvent {
  private:
   std::mutex mu_;
   std::condition_variable cv_;
-  bool set_ = false;
+  bool set_ DMLC_GUARDED_BY(mu_) = false;
 };
 
 // Named-thread lifecycle manager (reference thread_group.h ThreadGroup):
@@ -235,7 +235,8 @@ class ThreadGroup {
 
  private:
   std::mutex mu_;
-  std::map<std::string, std::shared_ptr<Thread>> threads_;
+  std::map<std::string, std::shared_ptr<Thread>> threads_
+      DMLC_GUARDED_BY(mu_);
 };
 
 }  // namespace dct

@@ -217,10 +217,17 @@ void HttpConnection::ReadResponseHead(HttpResponse* out) {
     ttfb_observed_ = true;
     io_hists_->ttfb_us->Observe(telemetry::NowUs() - request_sent_us_);
   }
-  // "HTTP/1.1 200 OK"
+  // "HTTP/1.1 200 OK" — checked parse (analyze.py env rule): a garbled
+  // status line is a transport error the retry layer should see, not a
+  // silent status 0
   size_t sp = line.find(' ');
   DCT_CHECK(sp != std::string::npos) << "bad http status line: " << line;
-  out->status = std::atoi(line.c_str() + sp + 1);
+  char* status_end = nullptr;
+  long status = std::strtol(line.c_str() + sp + 1, &status_end, 10);
+  DCT_CHECK(status_end != line.c_str() + sp + 1 && status >= 100 &&
+            status <= 599)
+      << "bad http status line: " << line;
+  out->status = static_cast<int>(status);
   while (ReadLine(&line) && !line.empty()) {
     size_t colon = line.find(':');
     if (colon == std::string::npos) continue;
@@ -231,7 +238,13 @@ void HttpConnection::ReadResponseHead(HttpResponse* out) {
   }
   auto it = out->headers.find("content-length");
   if (it != out->headers.end()) {
-    body_remaining_ = std::atoll(it->second.c_str());
+    char* cl_end = nullptr;
+    errno = 0;  // strtoll reports overflow via ERANGE + LLONG_MAX,
+                // which would otherwise pass the >= 0 check below
+    body_remaining_ = std::strtoll(it->second.c_str(), &cl_end, 10);
+    DCT_CHECK(cl_end != it->second.c_str() && errno != ERANGE &&
+              body_remaining_ >= 0)
+        << "bad content-length: " << it->second;
   }
   auto te = out->headers.find("transfer-encoding");
   chunked_ = te != out->headers.end() &&
@@ -359,7 +372,7 @@ std::string StripUrlScheme(std::string* s) {
 // thread exists.
 namespace {
 std::mutex g_tls_proxy_mu;
-std::string g_tls_proxy_override;
+std::string g_tls_proxy_override DMLC_GUARDED_BY(g_tls_proxy_mu);
 }  // namespace
 
 void SetTlsProxyOverride(const std::string& addr) {

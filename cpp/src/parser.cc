@@ -1156,14 +1156,16 @@ template <typename IndexType>
 PipelinedParser<IndexType>::~PipelinedParser() {
   StopThreads();
   if (current_ != nullptr) delete current_;
+  // lock-ok: StopThreads joined every stage thread; dtor is sole owner
   for (ChunkTask* t : free_) delete t;
 }
 
 template <typename IndexType>
 void PipelinedParser<IndexType>::Start() {
   if (started_) return;
+  // lock-ok: no stage thread exists yet (started_ false, all joined)
   stop_ = false;
-  eof_ = false;
+  eof_ = false;  // lock-ok: pre-spawn init, single-threaded
   reader_ = std::thread([this] { ReaderLoop(); });
   workers_.reserve(nworker_);
   for (int i = 0; i < nworker_; ++i) {
@@ -1186,17 +1188,18 @@ void PipelinedParser<IndexType>::StopThreads() {
   for (auto& w : workers_) w.join();
   workers_.clear();
   started_ = false;
-  stop_ = false;
+  stop_ = false;  // lock-ok: every stage thread joined above
   // reclaim in-flight tasks (buffers kept for the next epoch); claim_ holds
-  // aliases of inflight_ entries, never owned tasks
+  // aliases of inflight_ entries, never owned tasks.
+  // lock-ok: single-threaded after the joins above
   for (ChunkTask* t : inflight_) free_.push_back(t);
-  inflight_.clear();
-  claim_.clear();
+  inflight_.clear();  // lock-ok: single-threaded after the joins above
+  claim_.clear();  // lock-ok: single-threaded after the joins above
   // an unconsumed reader error dies with the round it belongs to: the
   // consumer either already rethrew it (failed_ set, restart forbidden) or
   // abandoned the epoch — a stale pointer here would poison the NEXT
   // epoch's first NextBlock
-  reader_error_ = nullptr;
+  reader_error_ = nullptr;  // lock-ok: single-threaded after the joins
 }
 
 template <typename IndexType>
@@ -1229,8 +1232,10 @@ void PipelinedParser<IndexType>::ReaderLoop() {
         if (more) {
           const int nslice = base_->SlicesFor(t->data.size());
           t->nslice = nslice;
+          // lock-ok: task not yet published to inflight_/claim_ — the
+          // reader is its sole owner until the push under mu_ below
           t->next_slice = 0;
-          t->remaining = nslice;
+          t->remaining = nslice;  // lock-ok: reader-owned until publish
           t->next_serve = 0;
           // keep blocks at their high-water count so a small final chunk
           // does not free the recycled capacity of unused slices
@@ -1427,7 +1432,7 @@ void PipelinedParser<IndexType>::BeforeFirst() {
     free_.push_back(current_);
     current_ = nullptr;
   }
-  eof_ = false;
+  eof_ = false;  // lock-ok: StopThreads joined every stage thread
   // the rewind reaches the split chain synchronously (shuffled splits
   // resample their permutation in BeforeFirst — see
   // PrefetchSplit::BeforeFirst for the same rule); threads respawn lazily

@@ -208,13 +208,16 @@ class TextParserBase : public Parser<IndexType> {
   std::vector<std::thread> pool_;
   std::mutex pool_mu_;
   std::condition_variable pool_cv_, done_cv_;
-  uint64_t pool_generation_ = 0;
-  int pool_done_ = 0;
-  int pool_active_ = 0;
-  bool pool_stop_ = false;
-  const std::vector<const char*>* round_cuts_ = nullptr;
-  std::vector<RowBlockContainer<IndexType>>* round_blocks_ = nullptr;
-  std::vector<std::exception_ptr>* round_errors_ = nullptr;
+  uint64_t pool_generation_ DMLC_GUARDED_BY(pool_mu_) = 0;
+  int pool_done_ DMLC_GUARDED_BY(pool_mu_) = 0;
+  int pool_active_ DMLC_GUARDED_BY(pool_mu_) = 0;
+  bool pool_stop_ DMLC_GUARDED_BY(pool_mu_) = false;
+  const std::vector<const char*>* round_cuts_
+      DMLC_GUARDED_BY(pool_mu_) = nullptr;
+  std::vector<RowBlockContainer<IndexType>>* round_blocks_
+      DMLC_GUARDED_BY(pool_mu_) = nullptr;
+  std::vector<std::exception_ptr>* round_errors_
+      DMLC_GUARDED_BY(pool_mu_) = nullptr;
 
   std::vector<RowBlockContainer<IndexType>> blocks_;
   size_t block_idx_ = 0;
@@ -409,8 +412,11 @@ class PipelinedParser : public Parser<IndexType> {
     std::vector<RowBlockContainer<IndexType>> blocks;
     std::vector<std::exception_ptr> errors;
     int nslice = 0;
-    int next_slice = 0;  // next unclaimed slice (guarded by mu_)
-    int remaining = 0;   // unparsed slices (guarded by mu_); 0 = complete
+    // next_slice/remaining are guarded by the owning parser's mu_ —
+    // documented, not DMLC_GUARDED_BY: clang's thread-safety analysis
+    // cannot name another object's member from a nested struct
+    int next_slice = 0;  // next unclaimed slice
+    int remaining = 0;   // unparsed slices; 0 = complete
     size_t next_serve = 0;  // consumer cursor over blocks[0..nslice)
   };
 
@@ -429,12 +435,14 @@ class PipelinedParser : public Parser<IndexType> {
   std::condition_variable space_cv_;  // reader waits for in-flight room
   std::condition_variable work_cv_;   // workers wait for claimable slices
   std::condition_variable done_cv_;   // consumer waits on head-of-line
-  std::deque<ChunkTask*> inflight_;   // admitted chunks, input order
-  std::deque<ChunkTask*> claim_;      // prefix of inflight_ with free slices
-  std::vector<ChunkTask*> free_;      // recycled tasks
-  bool stop_ = false;
-  bool eof_ = false;
-  std::exception_ptr reader_error_;
+  // admitted chunks, input order
+  std::deque<ChunkTask*> inflight_ DMLC_GUARDED_BY(mu_);
+  // prefix of inflight_ with free slices
+  std::deque<ChunkTask*> claim_ DMLC_GUARDED_BY(mu_);
+  std::vector<ChunkTask*> free_ DMLC_GUARDED_BY(mu_);  // recycled tasks
+  bool stop_ DMLC_GUARDED_BY(mu_) = false;
+  bool eof_ DMLC_GUARDED_BY(mu_) = false;
+  std::exception_ptr reader_error_ DMLC_GUARDED_BY(mu_);
   bool failed_ = false;  // consumer saw an error; restart is forbidden
   bool started_ = false;
   std::thread reader_;

@@ -105,7 +105,7 @@ class PipelineIter {
   }
 
  private:
-  void RethrowIfError() {
+  void RethrowIfError() DMLC_REQUIRES(mu_) {
     if (error_ != nullptr) {
       std::exception_ptr e = error_;
       error_ = nullptr;
@@ -188,11 +188,11 @@ class PipelineIter {
 
   std::mutex mu_;
   std::condition_variable cv_producer_, cv_consumer_;
-  std::deque<T*> ready_;
-  std::vector<T*> free_;
-  size_t total_cells_ = 0;
-  bool produced_all_ = false;
-  bool reset_request_ = false;
+  std::deque<T*> ready_ DMLC_GUARDED_BY(mu_);
+  std::vector<T*> free_ DMLC_GUARDED_BY(mu_);
+  size_t total_cells_ DMLC_GUARDED_BY(mu_) = 0;
+  bool produced_all_ DMLC_GUARDED_BY(mu_) = false;
+  bool reset_request_ DMLC_GUARDED_BY(mu_) = false;
   bool shutdown_ = false;
   std::exception_ptr error_ = nullptr;
 };

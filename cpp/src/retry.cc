@@ -245,16 +245,19 @@ struct FaultPlan {
   // trades a lock for reproducible draws — deterministic every-N rules
   // never touch it)
   std::mutex rng_mu;
-  std::mt19937_64 rng;
+  std::mt19937_64 rng DMLC_GUARDED_BY(rng_mu);
 };
 
 std::mutex g_plan_mu;
-std::shared_ptr<FaultPlan> g_plan;          // null = no faults
-bool g_plan_explicitly_set = false;         // SetFaultPlan called (even "")
+// null = no faults
+std::shared_ptr<FaultPlan> g_plan DMLC_GUARDED_BY(g_plan_mu);
+// SetFaultPlan called (even "")
+bool g_plan_explicitly_set DMLC_GUARDED_BY(g_plan_mu) = false;
 std::once_flag g_env_plan_once;
 
 std::shared_ptr<FaultPlan> ParsePlan(const std::string& plan) {
   auto out = std::make_shared<FaultPlan>();
+  // lock-ok: freshly built plan, not yet published to g_plan
   out->rng.seed(static_cast<uint64_t>(
       CheckedEnvInt("DMLC_IO_FAULT_SEED", 1, INT64_MIN, INT64_MAX)));
   size_t start = 0;

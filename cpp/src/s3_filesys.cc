@@ -424,8 +424,10 @@ S3Config S3Config::FromEnv() {
   } else {
     cfg.scheme = "https";  // real AWS endpoints are TLS-only
   }
-  const char* vs = std::getenv("S3_PATH_STYLE");
-  if (vs != nullptr) cfg.path_style = std::atoi(vs) != 0;
+  // checked parse: a typo'd S3_PATH_STYLE raises instead of silently
+  // selecting virtual-hosted addressing
+  cfg.path_style =
+      io::CheckedEnvInt("S3_PATH_STYLE", cfg.path_style ? 1 : 0, 0, 1) != 0;
   // fault-tolerance knobs: DMLC_IO_* layered under the legacy S3_* names,
   // all through the checked parser (a typo'd S3_MAX_RETRY used to atoi()
   // to a silent 0-retry config; now it throws)
@@ -474,6 +476,8 @@ void S3FileSystem::ListDirectory(const URI& path, std::vector<FileInfo>* out) {
       if (k == prefix) continue;  // the directory placeholder itself
       FileInfo info;
       info.path = URI("s3://" + bucket + "/" + k);
+      // env-ok: service XML listing size, not a config knob; an absent
+      // field deliberately degrades to size 0
       info.size = static_cast<size_t>(std::atoll(sz.c_str()));
       info.type = FileType::kFile;
       out->push_back(info);
@@ -548,8 +552,9 @@ FileInfo S3FileSystem::PathInfoUnderPolicy(const URI& path,
       std::string k, sz;
       if (!s3::XmlNextField(chunk, &cp, "Key", &k)) continue;
       s3::XmlNextField(chunk, &cp, "Size", &sz);
-      page.objects.push_back({s3::XmlUnescape(k),
-                              static_cast<size_t>(std::atoll(sz.c_str()))});
+      // env-ok: service XML listing size, not a config knob
+      const size_t obj_size = static_cast<size_t>(std::atoll(sz.c_str()));
+      page.objects.push_back({s3::XmlUnescape(k), obj_size});
     }
     pos = 0;
     while (s3::XmlNextField(resp.body, &pos, "CommonPrefixes", &chunk)) {

@@ -1,6 +1,8 @@
 // Telemetry registry implementation (see telemetry.h).
 #include "telemetry.h"
 
+#include "base.h"
+
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -49,9 +51,9 @@ struct HistEntry {
 // mutex guards registration and the snapshot/reset walks only.
 struct Registry {
   std::mutex mu;
-  std::deque<CounterEntry> counters;
-  std::deque<GaugeEntry> gauges;
-  std::deque<HistEntry> hists;
+  std::deque<CounterEntry> counters DMLC_GUARDED_BY(mu);
+  std::deque<GaugeEntry> gauges DMLC_GUARDED_BY(mu);
+  std::deque<HistEntry> hists DMLC_GUARDED_BY(mu);
 };
 
 Registry& Reg() {

@@ -13,6 +13,7 @@
 #include <vector>
 
 #include "../src/parser.h"
+#include "../src/retry.h"
 
 namespace {
 
@@ -146,7 +147,10 @@ int CheckFormat(const char* name, const std::string& corpus,
 
 int main(int argc, char** argv) {
   if (argc > 1 && std::string(argv[1]) == "--check") {
-    const int rows = argc > 2 ? atoi(argv[2]) : 20000;
+    const int rows = argc > 2
+        ? static_cast<int>(dct::io::CheckedInt("rows", argv[2], 1,
+                                               1 << 28))
+        : 20000;
     int failures = 0;
     {
       std::string c = MakeLibsvm(rows, 28, 7);
@@ -167,8 +171,11 @@ int main(int argc, char** argv) {
     printf("OK\n");
     return 0;
   }
-  int rows = argc > 1 ? atoi(argv[1]) : 100000;
-  int reps = argc > 2 ? atoi(argv[2]) : 7;
+  // checked CLI parses (analyze.py env rule): garbage args error loudly
+  int rows = argc > 1 ? static_cast<int>(
+      dct::io::CheckedInt("rows", argv[1], 1, 1 << 28)) : 100000;
+  int reps = argc > 2 ? static_cast<int>(
+      dct::io::CheckedInt("reps", argv[2], 1, 1 << 20)) : 7;
   {
     std::string c = MakeLibsvm(rows, 28, 7);
     BenchFormat<dct::LibSVMParser<uint32_t>>("libsvm", c, {}, reps);

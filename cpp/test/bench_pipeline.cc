@@ -12,6 +12,7 @@
 
 #include "../src/parser.h"
 #include "../src/recordio.h"
+#include "../src/retry.h"
 
 namespace {
 
@@ -58,11 +59,18 @@ int main(int argc, char** argv) {
       fprintf(stderr, "usage: %s rt N PAYLOAD PATH\n", argv[0]);
       return 2;
     }
-    return RoundTrip(atoi(argv[2]), atoi(argv[3]), argv[4]);
+    return RoundTrip(
+        static_cast<int>(dct::io::CheckedInt("N", argv[2], 1, 1 << 28)),
+        static_cast<int>(dct::io::CheckedInt("PAYLOAD", argv[3], 1,
+                                             1 << 28)),
+        argv[4]);
   }
   const char* path = argv[1];
-  int nthread = argc > 2 ? atoi(argv[2]) : 1;
-  int reps = argc > 3 ? atoi(argv[3]) : 5;
+  // checked CLI parses (analyze.py env rule): garbage args error loudly
+  int nthread = argc > 2 ? static_cast<int>(
+      dct::io::CheckedInt("nthread", argv[2], 1, 1024)) : 1;
+  int reps = argc > 3 ? static_cast<int>(
+      dct::io::CheckedInt("reps", argv[3], 1, 1 << 20)) : 5;
   using Clock = std::chrono::steady_clock;
   double best = 1e30;
   size_t rows = 0, bytes = 0;

@@ -8,6 +8,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cassert>
 #include <chrono>
 #include <cmath>
@@ -2253,6 +2254,147 @@ void TestShardCacheKeyText() {
   EXPECT(threw);
 }
 
+// ---- deterministic shard-cache fuzz driver (--fuzz-shard) ----------------
+// Seeded mutation of the published shard + manifest bytes: every mutated
+// unit must either be rejected as a clean validation MISS or open into a
+// reader whose every view walks strictly inside the mapping — never a
+// crash, hang, or out-of-bounds read. Runs under the asan-cache and
+// ubsan-test lanes (cpp/Makefile), where an OOB pointer aimed by a corrupt
+// block length dies loudly instead of silently serving garbage.
+
+std::string FuzzSlurp(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream os;
+  os << f.rdbuf();
+  return os.str();
+}
+
+void FuzzSpew(const std::string& path, const std::string& bytes) {
+  std::ofstream f(path, std::ios::binary | std::ios::trunc);
+  f.write(bytes.data(), static_cast<std::streamsize>(bytes.size()));
+}
+
+// touch every byte a view exposes so ASan/UBSan observe the full walk
+uint64_t FuzzWalkReader(dct::MmapShardReader<uint32_t>* r) {
+  uint64_t acc = 0;
+  dct::RowBlockView<uint32_t> v;
+  while (r->NextView(&v)) {
+    for (uint64_t i = 0; i <= v.num_rows; ++i) acc += v.offset[i];
+    for (uint64_t i = 0; i < v.num_rows; ++i) {
+      acc += static_cast<uint64_t>(v.label[i]);
+      if (v.weight != nullptr) acc += static_cast<uint64_t>(v.weight[i]);
+      if (v.qid != nullptr) acc += v.qid[i];
+    }
+    for (uint64_t i = 0; i < v.nnz; ++i) {
+      acc += v.index[i];
+      if (v.field != nullptr) acc += v.field[i];
+      if (v.value != nullptr) acc += static_cast<uint64_t>(v.value[i]);
+      if (v.value_i32 != nullptr) {
+        acc += static_cast<uint64_t>(v.value_i32[i]);
+      }
+      if (v.value_i64 != nullptr) {
+        acc += static_cast<uint64_t>(v.value_i64[i]);
+      }
+    }
+  }
+  return acc;
+}
+
+void FuzzShardCache(int iters) {
+  dct::TemporaryDirectory tmp;
+  const std::string uri = WriteCacheCorpus(tmp.path(), 600);
+  const std::string cdir = tmp.path() + "/cache";
+  const std::string key = dct::ShardCacheKeyText(uri, 0, 1, "libsvm",
+                                                 false, {});
+  const std::string stem = dct::ShardCacheStem(cdir, key, 0, 1);
+  {
+    // publish one valid unit to mutate
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+    DrainParser(p.get());
+  }
+  const std::string shard0 = FuzzSlurp(stem + ".dshard");
+  const std::string mani0 = FuzzSlurp(stem + ".manifest");
+  EXPECT(shard0.size() > 128 && !mani0.empty());
+
+  // fixed-seed splitmix-style generator: the run is fully deterministic
+  uint64_t state = 0x9e3779b97f4a7c15ull;
+  auto rnd = [&state]() {
+    state = state * 6364136223846793005ull + 1442695040888963407ull;
+    return state >> 11;
+  };
+
+  int opened = 0, missed = 0;
+  for (int iter = 0; iter < iters; ++iter) {
+    std::string shard = shard0;
+    std::string mani = mani0;
+    const uint64_t what = rnd() % 10;
+    if (what < 5) {
+      // shard byte flips — half biased into the first 512 B (file header
+      // + first block header, where a corrupt length would aim pointers
+      // past the mapping), half anywhere (checksum coverage)
+      const int flips = 1 + static_cast<int>(rnd() % 4);
+      for (int i = 0; i < flips; ++i) {
+        const size_t zone =
+            rnd() % 2 == 0 ? std::min<size_t>(shard.size(), 512)
+                           : shard.size();
+        const size_t off = rnd() % zone;
+        shard[off] = static_cast<char>(
+            shard[off] ^ static_cast<char>(1u << (rnd() % 8)));
+      }
+    } else if (what < 7) {
+      // truncate or extend the shard (recorded-size mismatch + mappings
+      // shorter than the headers claim)
+      shard.resize(rnd() % (shard0.size() + 64),
+                   static_cast<char>(rnd() % 256));
+    } else if (what < 9) {
+      // manifest mutations: flips or truncation of the k=v lines
+      if (rnd() % 2 == 0 && !mani.empty()) {
+        const int flips = 1 + static_cast<int>(rnd() % 3);
+        for (int i = 0; i < flips; ++i) {
+          const size_t off = rnd() % mani.size();
+          mani[off] = static_cast<char>(
+              mani[off] ^ static_cast<char>(1u << (rnd() % 8)));
+        }
+      } else {
+        mani.resize(rnd() % (mani0.size() + 1));
+      }
+    } else {
+      // cross-unit splice: a valid-looking header over garbage payload
+      const size_t keep = 80 + rnd() % 64;
+      shard = shard0.substr(0, std::min(keep, shard0.size()));
+      shard.resize(shard0.size(), static_cast<char>(rnd() % 256));
+    }
+    FuzzSpew(stem + ".dshard", shard);
+    FuzzSpew(stem + ".manifest", mani);
+    std::unique_ptr<dct::MmapShardReader<uint32_t>> r(
+        dct::MmapShardReader<uint32_t>::TryOpen(stem, key));
+    if (r == nullptr) {
+      ++missed;  // clean miss: the text lane would re-transcode
+      continue;
+    }
+    // a survivor (mutation in don't-care bytes, or didn't change the
+    // payload the checksum covers) must walk fully in bounds
+    ++opened;
+    (void)FuzzWalkReader(r.get());
+    r->BeforeFirst();
+    (void)FuzzWalkReader(r.get());
+  }
+  // pristine bytes restored: the unit must validate and replay again
+  FuzzSpew(stem + ".dshard", shard0);
+  FuzzSpew(stem + ".manifest", mani0);
+  std::unique_ptr<dct::MmapShardReader<uint32_t>> r(
+      dct::MmapShardReader<uint32_t>::TryOpen(stem, key));
+  EXPECT(r != nullptr);
+  EXPECT(FuzzWalkReader(r.get()) != 0);
+  // the overwhelming majority of mutations must be rejected (every flip
+  // of a checksummed byte); a run where most opened would mean validation
+  // stopped looking at the payload
+  EXPECT(missed > opened);
+  std::printf("fuzz-shard: %d mutations, %d clean misses, %d replayed "
+              "in-bounds\n", missed + opened, missed, opened);
+}
+
 void RunShardCacheSuite() {
   TestShardCacheKeyText();
   TestShardCacheTranscodeThenReplay();
@@ -2296,6 +2438,18 @@ int main(int argc, char** argv) {
     // tsan-parse lanes run exactly this under sanitizers, with
     // DMLC_PARSE_SIMD pinning each dispatch tier
     RunParseSimdSuite();
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
+  if (argc > 1 && std::string(argv[1]) == "--fuzz-shard") {
+    // deterministic shard/manifest mutation driver — the asan-cache and
+    // ubsan-test lanes run exactly this (validation must yield a clean
+    // miss or an in-bounds replay, never a crash/OOB)
+    FuzzShardCache(argc > 2 ? std::atoi(argv[2]) : 400);  // env-ok: test CLI
     if (g_failures == 0) {
       std::printf("OK\n");
       return 0;

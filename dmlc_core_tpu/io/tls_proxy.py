@@ -36,6 +36,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import urlsplit
 
+from dmlc_core_tpu.tracker.wire import env_float
+
 __all__ = ["TlsProxy", "ensure_tls_proxy"]
 
 # hop-by-hop headers never forwarded in either direction (RFC 7230 §6.1)
@@ -107,8 +109,7 @@ class _RelayHandler(BaseHTTPRequestHandler):
         try:
             conn = http.client.HTTPSConnection(
                 target.hostname, port, context=_origin_context(),
-                timeout=float(os.environ.get("DCT_TLS_ORIGIN_TIMEOUT",
-                                             "60")))
+                timeout=env_float("DCT_TLS_ORIGIN_TIMEOUT", 60.0))
             conn.putrequest(self.command, path, skip_host=True,
                             skip_accept_encoding=True)
             saw_host = False

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from dmlc_core_tpu.base import log_info
+from dmlc_core_tpu.tracker.wire import env_int_opt
 
 __all__ = ["init_from_env", "allreduce", "broadcast", "rank", "world_size"]
 
@@ -35,23 +36,33 @@ def init_from_env() -> None:
     if os.getenv("JAX_COORDINATOR_ADDRESS"):
         # pass the trio explicitly: bare initialize() only auto-detects
         # managed clusters (Slurm/GKE/TPU metadata), not this env protocol
-        nproc = os.getenv("JAX_NUM_PROCESSES")
-        pid = os.getenv("JAX_PROCESS_ID")
+        # wire.env_int_opt: unset stays None (initialize may infer), but
+        # a SET value — empty, garbage, or a bogus negative — fails
+        # loudly naming the variable (negatives pass through so the
+        # coordinator rejects them) instead of this rank silently
+        # degrading
+        nproc = env_int_opt("JAX_NUM_PROCESSES")
+        pid = env_int_opt("JAX_PROCESS_ID")
         jax.distributed.initialize(
             coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
-            num_processes=None if nproc is None else int(nproc),
-            process_id=None if pid is None else int(pid))
+            num_processes=nproc, process_id=pid)
         return
     # Legacy launchers must export the coordinator address explicitly —
     # DMLC_TRACKER_URI is the *submit* machine, where no worker hosts the
     # JAX coordination service, so it cannot be used as a fallback.
     coord = os.getenv("DMLC_COORDINATOR_ADDRESS")
-    nproc = os.getenv("DMLC_NUM_WORKER")
-    pid = os.getenv("DMLC_TASK_ID")
-    if coord and nproc and pid:
+    nproc = pid = None
+    if coord:
+        # parsed only with the coordinator exported: a SET-but-invalid
+        # DMLC_TASK_ID must fail loudly rather than silently fall back
+        # to single-process mode, but garbage in those vars must not
+        # kill a run that never takes this path
+        nproc = env_int_opt("DMLC_NUM_WORKER")
+        pid = env_int_opt("DMLC_TASK_ID")
+    if coord and nproc is not None and pid is not None:
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(nproc), process_id=int(pid))
+            num_processes=nproc, process_id=pid)
         return
     log_info("init_from_env: no launcher env found; single-process mode "
              "(use cluster=tpu-pod or export DMLC_COORDINATOR_ADDRESS)")

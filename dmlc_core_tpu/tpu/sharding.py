@@ -24,6 +24,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dmlc_core_tpu.tracker.wire import env_int_opt
+
 __all__ = ["data_mesh", "batch_sharding", "packed_batch_sharding",
            "replicated_sharding", "process_part", "local_device_count"]
 
@@ -93,11 +95,20 @@ def process_part(num_parts_per_process: int = 1) -> Tuple[int, int]:
             ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
             ("PMI_RANK", "PMI_SIZE"),
             ("SLURM_PROCID", "SLURM_STEP_NUM_TASKS")):
-        rank = os.environ.get(rank_var)
-        count = os.environ.get(count_var)
-        if rank is None or count is None or int(count) <= 1:
+        # wire.env_int_opt behind a presence gate: a pair that is not
+        # fully exported falls through to the next launcher WITHOUT
+        # being parsed (garbage in an unused pair must not kill the
+        # run), but a fully-exported pair with an empty/garbage/-1 rank
+        # fails loudly instead of mis-sharding
+        if rank_var not in os.environ or count_var not in os.environ:
             continue
-        part, npart = int(rank), int(count)
+        # count first: a single-task pair falls through WITHOUT parsing
+        # its rank (a garbage rank in a pair this function would skip
+        # anyway must not kill the run)
+        npart = env_int_opt(count_var)
+        if npart <= 1:
+            continue
+        part = env_int_opt(rank_var)
         if not 0 <= part < npart:
             raise ValueError(
                 f"{rank_var}={part} out of range for "

@@ -212,6 +212,9 @@ class HeartbeatMonitor:
         self._closing = True
         if graceful:
             try:
+                # lock-ok: BYE serializes with pings; bounded by the
+                # channel timeout, and this lock is worker-side only —
+                # the tracker serve loop never waits on it
                 with self._send_lock:
                     self._ws.send_int(HEARTBEAT_BYE)
             except OSError:
@@ -224,6 +227,9 @@ class HeartbeatMonitor:
 
     # -- elastic data-plane lease RPCs (same socket as the pings) ------------
     def _send_words(self, *vals: int) -> None:
+        # lock-ok: serializing frame writes on the one socket IS this
+        # lock's job; the send is bounded by the channel timeout and the
+        # lock is worker-side only (never held by the tracker serve loop)
         with self._send_lock:
             self._ws.sock.sendall(struct.pack(f"@{len(vals)}i", *vals))
 
@@ -240,6 +246,10 @@ class HeartbeatMonitor:
         deadline = time.monotonic() + \
             (self.timeout if timeout is None else timeout)
         acquire_us = telemetry.histogram("lease_acquire_us")
+        # lock-ok: the guarded operation IS waiting for a grant — one
+        # in-flight acquire per monitor is this lock's contract; every
+        # wait is deadline-bounded and abortable, and the lock is
+        # worker-side only (the tracker serve loop never takes it)
         with self._lease_lock:
             while True:
                 self.check()
@@ -364,6 +374,8 @@ class HeartbeatMonitor:
                 # the quiet interval elapsed: time to ping (which also
                 # renews every lease this rank holds, tracker-side)
                 try:
+                    # lock-ok: ping serialized against lease frames;
+                    # timeout-bounded, worker-side lock only
                     with self._send_lock:
                         self._ws.send_int(HEARTBEAT_PING)
                 except OSError:

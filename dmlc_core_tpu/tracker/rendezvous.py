@@ -447,6 +447,9 @@ class RabitTracker:
         with self._lock:
             self.events.append(rec)
             if self._event_log is not None:
+                # lock-ok: local line-buffered append with OSError
+                # swallowed — bounded by disk latency, never the network;
+                # the lock is what keeps the JSONL mirror in event order
                 self._event_log.write(json.dumps(rec) + "\n")
         # tracker events are just another telemetry stream: the same record
         # rides the snapshot's `events` list / events_jsonl() exposition
@@ -867,7 +870,9 @@ class RabitTracker:
         with self._lock:
             if self._event_log is not None:
                 # fsync through to disk NOW: the abort path is exactly when
-                # the process (or its node) is likeliest to die next
+                # the process (or its node) is likeliest to die next.
+                # lock-ok: terminal abort — the serve loop is the caller
+                # and is about to raise out of _serve anyway
                 self._event_log.flush()
         reason = err.reason.encode()
         frame = struct.pack("@i", HEARTBEAT_ABORT) + \

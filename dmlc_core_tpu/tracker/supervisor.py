@@ -215,16 +215,29 @@ class WorkerSupervisor:
             if t.done or self._stop.is_set() or t.handle is not handle:
                 return  # resolved or replaced while we were polling
             try:
+                # lock-ok: the relaunch must be atomic with the attempt
+                # bookkeeping — two racing observers (watch poll + this
+                # signal) would double-launch over one incarnation. The
+                # tracker serve loop never takes the supervisor lock
+                # (notifications arrive on the dedicated notifier thread),
+                # so a slow submit delays supervision only.
                 self._relaunch_locked(t, rc, f"rank {rank} marked dead")
             except Exception:
                 logger.exception("proactive relaunch of task %d failed",
                                  t.task_id)
+                # lock-ok: terminal teardown; serve loop never holds this
+                # lock and abort() only sets a flag + wakes the self-pipe
                 self._stop_locked()
+                # lock-ok: abort() is flag-set + selector wake, not I/O
                 self._abort_tracker(
                     f"relaunch of task {t.task_id} failed")
 
     def stop(self) -> None:
         """Stop watching and terminate every live handle."""
+        # lock-ok: teardown must be atomic against a racing relaunch (a
+        # handle replaced mid-stop would survive); the tracker serve loop
+        # never holds the supervisor lock, so terminate()'s CLI exec can
+        # delay only supervision, never the rendezvous
         with self._lock:
             self._stop_locked()
 
@@ -276,7 +289,10 @@ class WorkerSupervisor:
                         t.done = True
                         continue
                     # failed: relaunch under the same task id — the worker
-                    # rejoins with cmd=recover and keeps its old rank
+                    # rejoins with cmd=recover and keeps its old rank.
+                    # lock-ok: atomic with the attempt bookkeeping (the
+                    # dead-rank signal path races this poll); the serve
+                    # loop never takes the supervisor lock
                     if not self._relaunch_locked(
                             t, rc, f"exited with code {rc}"):
                         raise RuntimeError(

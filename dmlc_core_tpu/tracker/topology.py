@@ -62,7 +62,11 @@ def build_ring(tree: TreeMap, parent: ParentMap) -> RingMap:
     """Ring order over `n` workers rooted at `r` (reference tracker.py ring
     construction)."""
     order = _dfs_ring(tree, parent, 0)
-    assert len(order) == len(tree)
+    if len(order) != len(tree):
+        # a real error, not an assert: `python -O` strips asserts, and a
+        # malformed tree map must fail the rendezvous loudly
+        raise RuntimeError(
+            f"ring order covers {len(order)} of {len(tree)} workers")
     n = len(tree)
     ring: RingMap = {}
     for i in range(n):

@@ -95,6 +95,23 @@ def env_int(name: str, default: int, env=None) -> int:
         raise RuntimeError(f"{name}={raw!r} is not an integer")
 
 
+def env_int_opt(name: str, env=None):
+    """Presence-gated checked parse for launcher rank/count variables:
+    None when the variable is UNSET (the caller falls through to its next
+    source), but a SET-but-invalid value — empty text included — raises
+    naming the variable. `env_int`'s \"\"→default convention is wrong
+    here: a templating bug exporting RANK=\"\" must kill the job, not
+    silently shard it wrong."""
+    import os
+    e = os.environ if env is None else env
+    if name not in e:
+        return None
+    try:
+        return int(e[name])
+    except ValueError:
+        raise RuntimeError(f"{name}={e[name]!r} is not an integer")
+
+
 class TrackerAbortedError(RuntimeError):
     """The tracker gave up on the job (dead ranks past their deadline, a
     supervisor that exhausted its attempts, or an explicit abort()).

@@ -1,0 +1,909 @@
+#!/usr/bin/env python3
+"""Project-native concurrency & invariant analyzer (doc/analysis.md).
+
+Like scripts/lint.py, this is a self-contained checker (no third-party
+analyzers ship in this image); unlike lint.py's style rules, the passes
+here enforce the concurrency invariants this repo has paid to learn:
+PR 4 shipped an `_emit`-inside-`_lock` self-deadlock in the tracker serve
+loop plus two review findings moving CLI polls outside the supervisor
+lock, and PR 6's headline satellite was an atomic-snapshot fix for state
+read outside the tracker lock. Every rule below turns one of those bug
+classes into a mechanical pre-merge check.
+
+Passes:
+
+1. **Python lock discipline** (`dmlc_core_tpu/tracker/`, `.../data/`):
+   builds a cross-module call graph, models `with <lock>:` regions (and
+   `.acquire()`/`.release()` pairs), and flags
+     - any call that can re-acquire a lock already held (the non-reentrant
+       `threading.Lock` self-deadlock), and
+     - any call reachable while holding a lock that lands in the blocking
+       set: socket send/recv/accept/connect, subprocess, `time.sleep`,
+       file/stream read/write/flush/fsync, thread/process join/wait/poll.
+   Audited sites are allowlisted with `# lock-ok: <reason>` on the call
+   line, the line above it, or the `with` statement that opened the
+   region; the reason is mandatory.
+
+2. **C++ capability check** (`cpp/`): every member declared
+   `DMLC_GUARDED_BY(m)` (cpp/src/base.h) must only be touched inside a
+   `lock_guard`/`unique_lock`/`scoped_lock` scope of `m` or inside a
+   function declared `DMLC_REQUIRES(m)`. Checked structurally per
+   header/source pair; audited exceptions carry `// lock-ok: <reason>`.
+
+3. **Invariant lints**:
+   - checked-env-parse (Python): no raw `int()`/`float()` over
+     `os.environ`/`os.getenv` values outside `tracker/wire.py` — use
+     `wire.env_int`/`env_float`/`env_enum` (`# env-ok: <reason>` escapes);
+   - checked-env-parse (C++): no `atoi`/`atol`/`atoll`, and no `getenv`
+     feeding `strtol`-family/`stoi`-family parses in one statement,
+     outside `retry.{h,cc}`'s checked helpers (`// env-ok:` escapes);
+   - no-`assert`-for-runtime-errors in tracker/data/io runtime code —
+     `python -O` strips asserts (`# assert-ok: <reason>` escapes, e.g.
+     for test-only helpers).
+
+Exit code is the finding count (capped at 125 so it never wraps mod 256;
+0 = clean). `--root DIR` analyzes a fixture tree instead of the repo, with
+every file in scope for every pass (tests/test_analyze.py drives this).
+"""
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from srcwalk import REPO, iter_sources  # noqa: E402 (shared walker)
+
+LOCK_OK_RE = re.compile(r"(?:#|//)\s*lock-ok\s*:?\s*(.*\S)?")
+ENV_OK_RE = re.compile(r"(?:#|//)\s*env-ok\s*:?\s*(.*\S)?")
+ASSERT_OK_RE = re.compile(r"(?:#|//)\s*assert-ok\s*:?\s*(.*\S)?")
+
+# scopes when walking the real repo (relative-path prefixes)
+LOCK_SCOPE = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/data/")
+PY_ENV_SCOPE = ("dmlc_core_tpu/",)
+PY_ENV_ALLOW = ("dmlc_core_tpu/tracker/wire.py",)
+ASSERT_SCOPE = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/data/",
+                "dmlc_core_tpu/io/")
+CPP_SCOPE = ("cpp/",)
+CPP_ENV_ALLOW = ("cpp/src/retry.h", "cpp/src/retry.cc")
+
+# calls considered blocking when reachable with a lock held. Attribute
+# names are matched on ANY receiver (conservative: only sites under lock
+# regions are ever checked, and audited sites annotate) except string
+# literals (" ".join). `close` is deliberately absent: closes are bounded
+# teardown and flagging them would bury the real findings.
+BLOCKING_ATTRS = {
+    "send": "socket send", "sendall": "socket send", "sendto": "socket send",
+    "recv": "socket recv", "recv_into": "socket recv",
+    "recvfrom": "socket recv", "accept": "socket accept",
+    "connect": "socket connect", "connect_ex": "socket connect",
+    "recv_all": "wire recv", "recv_int": "wire recv",
+    "recv_str": "wire recv", "send_int": "wire send",
+    "send_str": "wire send", "makefile": "socket I/O",
+    "sleep": "sleep", "poll": "status poll (may exec a CLI)",
+    "wait": "blocking wait", "join": "thread join",
+    "write": "file/stream write", "read": "file/stream read",
+    "readline": "file/stream read", "flush": "stream flush",
+    "fsync": "fsync", "communicate": "subprocess I/O",
+    "urlopen": "network I/O", "getaddrinfo": "DNS resolution",
+    "gethostbyname": "DNS resolution",
+    "create_connection": "socket connect",
+}
+BLOCKING_MODULE_CALLS = {"subprocess": "subprocess call",
+                         "select": "select wait"}
+BLOCKING_NAME_CALLS = {"open": "open() file I/O", "sleep": "sleep"}
+
+
+def dotted(node):
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def comment_marker(lines, lineno, rx):
+    """The marker's reason if `rx` matches on `lineno` (1-based) or in
+    the contiguous block of pure-comment lines directly above it (so an
+    audited site can carry a multi-line rationale); (found, reason)."""
+    def probe(ln):
+        if 1 <= ln <= len(lines):
+            return rx.search(lines[ln - 1])
+        return None
+
+    m = probe(lineno)
+    ln = lineno - 1
+    while m is None and 1 <= ln <= len(lines) and \
+            lines[ln - 1].lstrip().startswith(("#", "//")):
+        m = probe(ln)
+        ln -= 1
+    if m:
+        return True, (m.group(1) or "").strip()
+    return False, ""
+
+
+class Findings:
+    def __init__(self):
+        self.items = set()
+
+    def add(self, rel, lineno, pass_name, msg):
+        self.items.add((rel, lineno, pass_name, msg))
+
+    def report(self):
+        for rel, lineno, pass_name, msg in sorted(self.items):
+            print(f"{rel}:{lineno}: [{pass_name}] {msg}")
+        return len(self.items)
+
+
+# ===========================================================================
+# Pass 1: Python lock discipline
+# ===========================================================================
+
+class _Func:
+    """One analyzed function/method: its lock regions and call sites."""
+
+    def __init__(self, module, classname, name, node):
+        self.module = module          # module key (relative path)
+        self.classname = classname    # enclosing class or None
+        self.name = name
+        self.node = node
+        self.acquires = set()         # lock ids taken anywhere in the body
+        # (call_node, held_tuple, region_with_lineno) for every call
+        self.calls = []
+        self.reacquires = []          # (lock_id, lineno): taken while held
+        self.qual = f"{classname}.{name}" if classname else name
+
+
+def _lock_id(expr, module, classname):
+    """Stable identity for a lock expression. `self._x` is class-scoped
+    (the same attribute on another instance of the same class IS the same
+    lock for deadlock purposes — conservative), bare names module-scoped."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return f"{module}::{classname}.{expr.attr}"
+    d = dotted(expr)
+    if d is not None:
+        return f"{module}::{d}"
+    return f"{module}::<expr>"
+
+
+def _is_lockish(expr) -> bool:
+    """Heuristic: the expression names a lock (its final component ends
+    with "lock" — the repo convention: _lock, _send_lock, _lease_lock)."""
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    return name is not None and name.lower().endswith(("lock", "mutex"))
+
+
+def _blocking_reason(call):
+    """A direct-blocking description for this Call node, or None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return BLOCKING_NAME_CALLS.get(f.id)
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Constant):
+            return None  # " ".join(...) and friends
+        root = f.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) and \
+                root.id in BLOCKING_MODULE_CALLS and \
+                isinstance(f.value, ast.Name):
+            return f"{BLOCKING_MODULE_CALLS[root.id]} ({root.id}.{f.attr})"
+        if f.attr in BLOCKING_ATTRS:
+            return f"{BLOCKING_ATTRS[f.attr]} (.{f.attr}())"
+    return None
+
+
+class _FuncWalker:
+    """Walks one function body tracking held locks statement-by-statement
+    (with-blocks and acquire/release pairs). Nested defs/lambdas run
+    later, NOT under the current locks — they reset the held set."""
+
+    def __init__(self, func: _Func):
+        self.f = func
+
+    def walk(self):
+        self._suite(self.f.node.body, held=())
+
+    def _suite(self, stmts, held):
+        manual = list(held)  # acquire()/release() adjust within this suite
+        for st in stmts:
+            self._stmt(st, tuple(manual))
+            self._apply_manual(st, manual)
+            if isinstance(st, ast.Try):
+                # the canonical `acquire(); try: ... finally: release()`
+                # idiom: the finally suite ALWAYS runs, so its
+                # acquire/release effects carry into this suite (else
+                # everything after the try would be a false positive)
+                for fst in st.finalbody:
+                    self._apply_manual(fst, manual)
+
+    def _apply_manual(self, st, manual):
+        got = self._manual_acquire(st)
+        if got is not None:
+            if any(h[0] == got[0] for h in manual):
+                self.f.reacquires.append(got)
+            manual.append(got)
+            self.f.acquires.add(got[0])
+        rel = self._manual_release(st)
+        if rel is not None:
+            for i in range(len(manual) - 1, -1, -1):
+                if manual[i][0] == rel:
+                    del manual[i]
+                    break
+
+    def _manual_acquire(self, st):
+        call = self._lock_method_call(st)
+        if call and call[1] == "acquire":
+            return (call[0], getattr(call[2], "lineno", 0))
+        return None
+
+    def _manual_release(self, st):
+        call = self._lock_method_call(st)
+        if call and call[1] == "release":
+            return call[0]
+        return None
+
+    def _lock_method_call(self, st):
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            fn = st.value.func
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in ("acquire", "release") and \
+                    _is_lockish(fn.value):
+                lid = _lock_id(fn.value, self.f.module, self.f.classname)
+                return (lid, fn.attr, st)
+        return None
+
+    def _stmt(self, st, held):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs LATER (often on another thread): neither
+            # its calls nor its locks belong to this function's footprint
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in st.items:
+                if _is_lockish(item.context_expr):
+                    lid = _lock_id(item.context_expr, self.f.module,
+                                   self.f.classname)
+                    self.f.acquires.add(lid)
+                    if any(h[0] == lid for h in inner):
+                        # nested `with` on a lock already held HERE — the
+                        # simplest self-deadlock, no call graph needed
+                        self.f.reacquires.append((lid, st.lineno))
+                    inner.append((lid, st.lineno))
+                else:
+                    self._expr(item.context_expr, held)
+            self._suite(st.body, tuple(inner))
+            return
+        # generic: visit child expressions under `held`, child suites too
+        for field in st._fields:
+            val = getattr(st, field, None)
+            if isinstance(val, list):
+                if val and isinstance(val[0], ast.stmt):
+                    self._suite(val, held)
+                else:
+                    for v in val:
+                        if isinstance(v, ast.expr):
+                            self._expr(v, held)
+                        elif isinstance(v, ast.stmt):
+                            self._suite([v], held)
+                        elif isinstance(v, ast.excepthandler):
+                            self._suite(v.body, held)
+            elif isinstance(val, ast.expr):
+                self._expr(val, held)
+            elif isinstance(val, ast.stmt):
+                self._suite([val], held)
+
+    def _expr(self, expr, held):
+        # walk without descending into lambdas (their bodies run later,
+        # not under the current locks)
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Call):
+                self.f.calls.append((node, held))
+            stack.extend(ast.iter_child_nodes(node))
+
+
+class LockPass:
+    """Cross-module lock-discipline analysis over the scoped .py files."""
+
+    # attribute names never resolved through the name-based call graph:
+    # `close` is ubiquitous teardown (sockets, files, monitors) and
+    # resolving `sock.close()` to an unrelated `Foo.close` method would
+    # drown the pass in cross-class false positives
+    NO_RESOLVE = {"close"}
+
+    def __init__(self, findings: Findings):
+        self.findings = findings
+        self.funcs = []           # every _Func
+        self.by_name = {}         # bare name -> [funcs]
+        self.lines = {}           # module -> source lines
+        self.imports = {}         # module -> imported top-level names
+
+    def add_module(self, path, rel, tree, lines):
+        self.lines[rel] = lines
+        imported = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imported.add((a.asname or a.name).split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    imported.add(a.asname or a.name)
+        self.imports[rel] = imported
+        self._collect(rel, None, tree.body)
+
+    def _collect(self, module, classname, body):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = _Func(module, classname, node.name, node)
+                self.funcs.append(f)
+                self.by_name.setdefault(node.name, []).append(f)
+                _FuncWalker(f).walk()
+                # nested defs inside are walked as reset-held suites but
+                # not registered as call targets (rare; keeps graph small)
+            elif isinstance(node, ast.ClassDef):
+                self._collect(module, node.name, node.body)
+
+    # -- call graph -----------------------------------------------------------
+    def _resolve(self, caller: _Func, call: ast.Call):
+        """Candidate _Funcs this call may land in (name-based, preferring
+        the caller's own class for self.X())."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return [g for g in self.by_name.get(fn.id, ())
+                    if g.classname is None and g.module == caller.module]
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in self.NO_RESOLVE:
+                return []
+            if isinstance(fn.value, ast.Name) and \
+                    fn.value.id in self.imports.get(caller.module, ()):
+                # `subprocess.run(...)` / `telemetry.gauge(...)`: a module
+                # attribute, never one of our methods — the blocking-module
+                # rule already classifies these
+                return []
+            cands = self.by_name.get(fn.attr, ())
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                same = [g for g in cands
+                        if g.classname == caller.classname
+                        and g.module == caller.module]
+                if same:
+                    return same
+            return list(cands)
+        return []
+
+    # Both transitive walks memoize ONLY cycle-free results: a set
+    # computed while a recursion-cycle member was on the stack is missing
+    # that member's contributions, and caching it would silently clear
+    # every later query through the cycle (order-dependent false
+    # negatives). Cycle members are recomputed per top-level query —
+    # fine at this codebase's size.
+
+    def _trans_acquires(self, func: _Func, memo, stack):
+        out, _complete = self._trans_acquires_rec(func, memo, stack)
+        return out
+
+    def _trans_acquires_rec(self, func, memo, stack):
+        if func in memo:
+            return memo[func], True
+        if func in stack:
+            return set(), False
+        stack.add(func)
+        out = set(func.acquires)
+        complete = True
+        for call, _held in func.calls:
+            for g in self._resolve(func, call):
+                sub, ok = self._trans_acquires_rec(g, memo, stack)
+                out |= sub
+                complete = complete and ok
+        stack.discard(func)
+        if complete:
+            memo[func] = out
+        return out, complete
+
+    def _trans_blocking(self, func: _Func, memo, stack):
+        """{description: via-path} of blocking ops reachable from func."""
+        out, _complete = self._trans_blocking_rec(func, memo, stack)
+        return out
+
+    def _trans_blocking_rec(self, func, memo, stack):
+        if func in memo:
+            return memo[func], True
+        if func in stack:
+            return {}, False
+        stack.add(func)
+        out = {}
+        complete = True
+        for call, _held in func.calls:
+            reason = _blocking_reason(call)
+            if reason is not None:
+                out.setdefault(reason, func.qual)
+            for g in self._resolve(func, call):
+                sub, ok = self._trans_blocking_rec(g, memo, stack)
+                for desc, via in sub.items():
+                    out.setdefault(desc, f"{func.qual} -> {via}")
+                complete = complete and ok
+        stack.discard(func)
+        if complete:
+            memo[func] = out
+        return out, complete
+
+    def run(self):
+        acq_memo, blk_memo = {}, {}
+        for f in self.funcs:
+            lines = self.lines[f.module]
+            for lid, ln in f.reacquires:
+                found, reason = comment_marker(lines, ln, LOCK_OK_RE)
+                if found:
+                    if not reason:
+                        self.findings.add(f.module, ln, "lock",
+                                          "lock-ok annotation without a "
+                                          "reason")
+                    continue
+                self.findings.add(
+                    f.module, ln, "lock",
+                    f"{f.qual}() re-acquires non-reentrant lock "
+                    f"`{lid.split('::')[-1]}` already held "
+                    f"(self-deadlock)")
+            for call, held in f.calls:
+                if not held:
+                    continue
+                self._check_site(f, call, held, lines, acq_memo, blk_memo)
+
+    def _suppressed(self, lines, call, held):
+        """lock-ok on the call line / line above, or on the `with` line
+        that opened any held region / its line above."""
+        check = [call.lineno] + [ln for _lid, ln in held if ln]
+        for ln in check:
+            found, reason = comment_marker(lines, ln, LOCK_OK_RE)
+            if found:
+                return True, reason, ln
+        return False, "", 0
+
+    def _check_site(self, f, call, held, lines, acq_memo, blk_memo):
+        held_ids = {lid for lid, _ln in held}
+        msgs = []
+        # (a) re-acquisition self-deadlock
+        for g in self._resolve(f, call):
+            re_acq = self._trans_acquires(g, acq_memo, set()) & held_ids
+            for lid in sorted(re_acq):
+                msgs.append(
+                    f"call to {g.qual}() re-acquires non-reentrant lock "
+                    f"`{lid.split('::')[-1]}` already held (self-deadlock)")
+        # (b) blocking work under the lock
+        direct = _blocking_reason(call)
+        if direct is not None:
+            msgs.append(f"blocking call under lock: {direct}")
+        else:
+            for g in self._resolve(f, call):
+                blk = self._trans_blocking(g, blk_memo, set())
+                for desc, via in sorted(blk.items())[:2]:
+                    msgs.append(f"blocking call under lock: {desc} "
+                                f"via {via}")
+                if blk:
+                    break
+        if not msgs:
+            return
+        ok, reason, ln = self._suppressed(lines, call, held)
+        if ok:
+            if not reason:
+                self.findings.add(f.module, ln, "lock",
+                                  "lock-ok annotation without a reason")
+            return
+        locks = ", ".join(sorted(lid.split("::")[-1] for lid in held_ids))
+        for msg in msgs[:2]:  # at most 2 findings per site: stay readable
+            self.findings.add(f.module, call.lineno, "lock",
+                              f"{f.qual}() holding `{locks}`: {msg}")
+
+
+# ===========================================================================
+# Pass 2: C++ DMLC_GUARDED_BY structural checker
+# ===========================================================================
+
+def strip_cpp(text: str) -> str:
+    """Blank out comments and string/char literals, preserving offsets
+    and newlines, so structural regexes never match inside them."""
+    out = list(text)
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if c == "/" and nxt == "/":
+            while i < n and text[i] != "\n":
+                out[i] = " "
+                i += 1
+        elif c == "/" and nxt == "*":
+            out[i] = out[i + 1] = " "
+            i += 2
+            while i < n and not (text[i] == "*" and i + 1 < n
+                                 and text[i + 1] == "/"):
+                if text[i] != "\n":
+                    out[i] = " "
+                i += 1
+            if i < n:
+                out[i] = " "
+            if i + 1 < n:
+                out[i + 1] = " "
+            i += 2
+        elif c in "\"'":
+            quote = c
+            out[i] = " "
+            i += 1
+            while i < n and text[i] != quote:
+                if text[i] == "\\":
+                    out[i] = " "
+                    i += 1
+                    if i < n and text[i] != "\n":
+                        out[i] = " "
+                    i += 1
+                    continue
+                if text[i] != "\n":
+                    out[i] = " "
+                i += 1
+            if i < n:
+                out[i] = " "
+            i += 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+_GUARDED_RE = re.compile(r"\b(\w+)\s+DMLC_GUARDED_BY\(\s*([\w.:*&>-]+)\s*\)")
+_REQUIRES_RE = re.compile(r"DMLC_REQUIRES\(\s*([\w.:*&>-]+)\s*\)")
+_LOCKDECL_RE = re.compile(
+    r"\b(?:lock_guard|unique_lock|scoped_lock)\s*(?:<[^<>;(]*>)?\s+"
+    r"(\w+)\s*(?:\(|\{)\s*([^,(){};]+?)\s*[,)}]")
+
+
+def _mutex_name(expr: str) -> str:
+    """Normalize `*mu`, `r.mu`, `plan->rng_mu` to the bare mutex name."""
+    idents = re.findall(r"\w+", expr)
+    return idents[-1] if idents else expr.strip()
+
+
+def _brace_pairs(stripped: str):
+    pairs = []
+    stack = []
+    for i, c in enumerate(stripped):
+        if c == "{":
+            stack.append(i)
+        elif c == "}" and stack:
+            pairs.append((stack.pop(), i))
+    return pairs
+
+
+def _enclosing_scope_end(pairs, pos: int) -> int:
+    """End offset of the innermost brace block containing `pos`."""
+    best_open, best_close = -1, None
+    for o, cl in pairs:
+        if o < pos < cl and o > best_open:
+            best_open, best_close = o, cl
+    return best_close if best_close is not None else 10 ** 12
+
+
+class CppGuardPass:
+    """Per header/source pair: collect DMLC_GUARDED_BY annotations, then
+    verify every touch of a guarded member happens inside a lock scope of
+    the named mutex or a DMLC_REQUIRES function."""
+
+    def __init__(self, findings: Findings):
+        self.findings = findings
+
+    def run_unit(self, paths_rels):
+        """`paths_rels`: [(abspath, relpath)] of one stem's .h/.cc pair.
+        Returns the loaded [(rel, text, stripped, lines)] so the driver
+        can feed the other C++ passes without re-reading/re-stripping."""
+        files = []
+        members = {}  # member -> (mutex, decl_file, decl_line_span)
+        for path, rel in paths_rels:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+            stripped = strip_cpp(text)
+            lines = text.split("\n")
+            files.append((rel, text, stripped, lines))
+            for m in _GUARDED_RE.finditer(stripped):
+                # skip the macro machinery itself (#define DMLC_GUARDED_BY
+                # and friends in base.h)
+                bol = stripped.rfind("\n", 0, m.start()) + 1
+                if stripped[bol:m.start()].lstrip().startswith("#"):
+                    continue
+                member, mutex = m.group(1), _mutex_name(m.group(2))
+                semi = stripped.find(";", m.end())
+                end_line = stripped.count("\n", 0, semi if semi >= 0
+                                          else m.end()) + 1
+                start_line = stripped.count("\n", 0, m.start()) + 1
+                members.setdefault(member, (mutex, rel,
+                                            (start_line, end_line)))
+        if members:
+            for rel, _text, stripped, lines in files:
+                self._check_file(rel, stripped, lines, members)
+        return files
+
+    def _check_file(self, rel, stripped, lines, members):
+        pairs = _brace_pairs(stripped)
+        # active-lock spans: (start, end, mutex)
+        spans = []
+        for m in _LOCKDECL_RE.finditer(stripped):
+            scope_end = _enclosing_scope_end(pairs, m.end())
+            # a unique_lock releases at `<var>.unlock()` and re-arms at
+            # `<var>.lock()`: the guarded region is the union of those
+            # intervals, not the whole lexical scope — touches after an
+            # early unlock are exactly the race this pass exists for
+            # (the parse/lock/bookkeep worker-loop shape re-locks)
+            var = re.escape(m.group(1))
+            unlock_rx = re.compile(rf"\b{var}\s*\.\s*unlock\s*\(")
+            relock_rx = re.compile(rf"\b{var}\s*\.\s*lock\s*\(")
+            mx = _mutex_name(m.group(2))
+            start = m.start()
+            pos = m.end()
+            while True:
+                unl = unlock_rx.search(stripped, pos, scope_end)
+                if unl is None:
+                    spans.append((start, scope_end, mx))
+                    break
+                spans.append((start, unl.start(), mx))
+                relk = relock_rx.search(stripped, unl.end(), scope_end)
+                if relk is None:
+                    break
+                start = relk.end()
+                pos = relk.end()
+        for m in _REQUIRES_RE.finditer(stripped):
+            # a REQUIRES on a definition guards its body; on a pure
+            # declaration (`;` before `{`) there is no body here
+            brace = stripped.find("{", m.end())
+            semi = stripped.find(";", m.end())
+            if brace < 0 or (0 <= semi < brace):
+                continue
+            close = _enclosing_scope_end(pairs, brace + 1)
+            spans.append((brace, close, _mutex_name(m.group(1))))
+        decl_lines = {}
+        for member, (_mx, decl_rel, (a, b)) in members.items():
+            if decl_rel == rel:
+                decl_lines[member] = set(range(a, b + 1))
+        for member, (mutex, _decl_rel, _span) in members.items():
+            rx = re.compile(rf"\b{re.escape(member)}\b")
+            for m in rx.finditer(stripped):
+                line = stripped.count("\n", 0, m.start()) + 1
+                if line in decl_lines.get(member, ()):
+                    continue
+                active = {mx for s, e, mx in spans if s <= m.start() < e}
+                if mutex in active:
+                    continue
+                found, reason = comment_marker(lines, line, LOCK_OK_RE)
+                if found:
+                    if not reason:
+                        self.findings.add(rel, line, "guard",
+                                          "lock-ok annotation without a "
+                                          "reason")
+                    continue
+                self.findings.add(
+                    rel, line, "guard",
+                    f"`{member}` is DMLC_GUARDED_BY({mutex}) but touched "
+                    f"outside a lock scope of `{mutex}` (and not in a "
+                    f"DMLC_REQUIRES({mutex}) function)")
+
+
+# ===========================================================================
+# Pass 3: invariant lints
+# ===========================================================================
+
+_CPP_ATOI_RE = re.compile(r"\b(?:atoi|atol|atoll)\s*\(")
+_CPP_NUMPARSE_RE = re.compile(
+    r"\b(?:atoi|atol|atoll|strtol|strtoll|strtoul|strtoull|strtod|"
+    r"stoi|stol|stoll|stoul|stoull|stod|stof)\b")
+
+
+def _env_access(node) -> bool:
+    """True when the expression subtree reads the process environment."""
+    for n in ast.walk(node):
+        d = dotted(n)
+        if d in ("os.environ", "os.getenv"):
+            return True
+    return False
+
+
+class PyEnvAssertPass:
+    """Python halves of the invariant lints: raw env numeric casts and
+    runtime asserts."""
+
+    def __init__(self, findings: Findings):
+        self.findings = findings
+
+    def run(self, rel, tree, lines, check_env: bool, check_assert: bool):
+        if check_env:
+            self._env(rel, tree, lines)
+        if check_assert:
+            self._asserts(rel, tree, lines)
+
+    @staticmethod
+    def _scope_nodes(body):
+        """Document-order nodes of one scope, NOT descending into nested
+        functions/lambdas (each function body is its own taint scope)."""
+        queue = list(body)
+        while queue:
+            node = queue.pop(0)
+            yield node
+            kids = [c for c in ast.iter_child_nodes(node)
+                    if not isinstance(c, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda))]
+            queue[:0] = kids
+
+    def _env(self, rel, tree, lines):
+        scopes = [tree.body]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            self._env_scope(rel, body, lines, set())
+
+    def _env_scope(self, rel, body, lines, tainted):
+        for node in self._scope_nodes(body):
+            if isinstance(node, ast.Assign) and _env_access(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in ("int", "float") and node.args:
+                arg = node.args[0]
+                bad = _env_access(arg) or (
+                    isinstance(arg, ast.Name) and arg.id in tainted)
+                if not bad:
+                    continue
+                found, reason = comment_marker(lines, node.lineno,
+                                               ENV_OK_RE)
+                if found:
+                    if not reason:
+                        self.findings.add(rel, node.lineno, "env",
+                                          "env-ok annotation without "
+                                          "a reason")
+                    continue
+                self.findings.add(
+                    rel, node.lineno, "env",
+                    f"raw {node.func.id}() over an os.environ value — "
+                    f"use wire.env_int/env_float/env_enum (checked "
+                    f"parse: garbage must raise, naming the variable)")
+
+    def _asserts(self, rel, tree, lines):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assert):
+                continue
+            found, reason = comment_marker(lines, node.lineno,
+                                           ASSERT_OK_RE)
+            if found:
+                if not reason:
+                    self.findings.add(rel, node.lineno, "assert",
+                                      "assert-ok annotation without a "
+                                      "reason")
+                continue
+            self.findings.add(
+                rel, node.lineno, "assert",
+                "assert used for a runtime check in tracker/client code — "
+                "raise a real error (`python -O` strips asserts)")
+
+
+class CppEnvPass:
+    """C++ half of the checked-env-parse rule."""
+
+    def __init__(self, findings: Findings):
+        self.findings = findings
+
+    def run(self, rel, text, stripped, lines):
+        for m in _CPP_ATOI_RE.finditer(stripped):
+            line = stripped.count("\n", 0, m.start()) + 1
+            found, reason = comment_marker(lines, line, ENV_OK_RE)
+            if found:
+                if not reason:
+                    self.findings.add(rel, line, "env",
+                                      "env-ok annotation without a reason")
+                continue
+            self.findings.add(
+                rel, line, "env",
+                "raw atoi-family parse — use io::CheckedEnvInt/CheckedInt "
+                "(retry.h) or a strtol with end-pointer validation")
+        # getenv feeding a numeric parse within one statement
+        for m in re.finditer(r"\bgetenv\b", stripped):
+            start = max(stripped.rfind(";", 0, m.start()),
+                        stripped.rfind("{", 0, m.start()),
+                        stripped.rfind("}", 0, m.start()))
+            end = stripped.find(";", m.end())
+            stmt = stripped[start + 1:end if end >= 0 else len(stripped)]
+            if not _CPP_NUMPARSE_RE.search(stmt.replace("getenv", "")):
+                continue
+            line = stripped.count("\n", 0, m.start()) + 1
+            found, reason = comment_marker(lines, line, ENV_OK_RE)
+            if found:
+                if not reason:
+                    self.findings.add(rel, line, "env",
+                                      "env-ok annotation without a reason")
+                continue
+            self.findings.add(
+                rel, line, "env",
+                "getenv value numerically parsed in place — use "
+                "io::CheckedEnvInt (typo'd env knobs must raise, not "
+                "silently become 0)")
+
+
+# ===========================================================================
+# driver
+# ===========================================================================
+
+def _in_scope(rel: str, prefixes) -> bool:
+    return any(rel.startswith(p) for p in prefixes)
+
+
+def analyze(root=None) -> int:
+    """Run every pass; returns the finding count. `root=None` analyzes
+    the repo with per-pass scopes; an explicit fixture root puts every
+    file in scope for every pass."""
+    findings = Findings()
+    lock_pass = LockPass(findings)
+    guard_pass = CppGuardPass(findings)
+    py_pass = PyEnvAssertPass(findings)
+    cppenv_pass = CppEnvPass(findings)
+    base = REPO if root is None else os.path.abspath(root)
+    fixture = root is not None
+
+    cpp_units = {}  # stem -> [(path, rel)]
+    for path in iter_sources(base):
+        rel = os.path.relpath(path, base).replace(os.sep, "/")
+        if path.endswith(".py"):
+            in_lock = fixture or _in_scope(rel, LOCK_SCOPE)
+            in_env = (fixture or _in_scope(rel, PY_ENV_SCOPE)) and \
+                rel not in PY_ENV_ALLOW
+            in_assert = fixture or _in_scope(rel, ASSERT_SCOPE)
+            if not (in_lock or in_env or in_assert):
+                continue
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+            try:
+                tree = ast.parse(text, filename=rel)
+            except SyntaxError:
+                continue  # lint.py owns syntax errors
+            lines = text.split("\n")
+            if in_lock:
+                lock_pass.add_module(path, rel, tree, lines)
+            py_pass.run(rel, tree, lines, in_env, in_assert)
+        elif fixture or _in_scope(rel, CPP_SCOPE):
+            stem = os.path.splitext(path)[0]
+            cpp_units.setdefault(stem, []).append((path, rel))
+
+    lock_pass.run()
+    for stem in sorted(cpp_units):
+        for rel, text, stripped, lines in guard_pass.run_unit(
+                cpp_units[stem]):
+            if rel in CPP_ENV_ALLOW and not fixture:
+                continue  # the checked helpers themselves
+            cppenv_pass.run(rel, text, stripped, lines)
+
+    count = findings.report()
+    return count
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--root", default=None,
+                    help="analyze this tree instead of the repo (every "
+                         "file in scope for every pass; fixture mode)")
+    args = ap.parse_args()
+    count = analyze(args.root)
+    scope = args.root or "repo"
+    print(f"analyze: {scope}: {count} finding(s)")
+    return min(count, 125)  # exit code = finding count, never wraps
+
+
+if __name__ == "__main__":
+    sys.exit(main())

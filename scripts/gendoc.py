@@ -265,6 +265,11 @@ def gen_index() -> str:
         "plane: metric catalog (names/types/units), the three snapshot "
         "surfaces (C ABI / Python / tracker HTTP scrape), Prometheus + "
         "JSONL exposition, env knobs, overhead bounds |",
+        "| [analysis.md](analysis.md) | project-native concurrency & "
+        "invariant analyzer: the Python lock-discipline pass, "
+        "DMLC_GUARDED_BY capability annotations + structural checker, "
+        "checked-env-parse / no-assert lints, the lock-ok/env-ok escape "
+        "hatches, the UBSan lane and the shard-cache fuzz driver |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
         "",

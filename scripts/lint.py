@@ -22,19 +22,11 @@ import os
 import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SKIP_DIRS = {".git", ".bench_cache", "_native", "__pycache__",
-             ".pytest_cache", ".claude", "doc"}
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from srcwalk import REPO, iter_sources  # noqa: E402 (shared walker)
+
 PY_MAX = 88
 CC_MAX = 90
-
-
-def iter_sources():
-    for root, dirs, files in os.walk(REPO):
-        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
-        for f in sorted(files):
-            if f.endswith((".py", ".cc", ".h")):
-                yield os.path.join(root, f)
 
 
 def lint_file(path: str) -> list:

@@ -1,0 +1,548 @@
+"""Tests for scripts/analyze.py — the concurrency & invariant analyzer.
+
+Each violation fixture seeds one bug class the repo has actually shipped
+(doc/analysis.md): the PR 4 `_emit`-inside-`_lock` self-deadlock, the
+supervisor CLI-poll-under-lock review findings, raw env parses, guarded
+C++ members touched outside their mutex. The analyzer must flag every
+seeded violation (exit code = finding count) and pass every clean twin
+(exit code 0) — and must exit 0 on the repo itself.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ANALYZE = os.path.join(REPO, "scripts", "analyze.py")
+
+
+def run_analyze(root):
+    return subprocess.run(
+        [sys.executable, ANALYZE, "--root", str(root)],
+        capture_output=True, text=True)
+
+
+def write_fixture(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(body))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Python lock-discipline pass
+# ---------------------------------------------------------------------------
+
+def test_emit_under_lock_self_deadlock_flagged(tmp_path):
+    """The PR 4 regression: _emit takes self._lock; calling it with the
+    lock already held self-deadlocks the serve loop."""
+    write_fixture(tmp_path, "tracker.py", """\
+        import threading
+
+        class Tracker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.events = []
+
+            def _emit(self, event):
+                with self._lock:
+                    self.events.append(event)
+
+            def serve(self):
+                with self._lock:
+                    self._emit("revived")
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "re-acquires" in out.stdout
+    assert "_emit" in out.stdout
+
+
+def test_emit_outside_lock_is_clean(tmp_path):
+    write_fixture(tmp_path, "tracker.py", """\
+        import threading
+
+        class Tracker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.events = []
+
+            def _emit(self, event):
+                with self._lock:
+                    self.events.append(event)
+
+            def serve(self):
+                with self._lock:
+                    revived = True
+                if revived:
+                    self._emit("revived")
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_blocking_call_under_lock_flagged(tmp_path):
+    write_fixture(tmp_path, "worker.py", """\
+        import threading
+        import time
+
+        class Worker:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self.sock = sock
+
+            def step(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def send(self, data):
+                with self._lock:
+                    self.sock.sendall(data)
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "sleep" in out.stdout
+    assert "sendall" in out.stdout
+
+
+def test_cli_poll_under_lock_flagged_through_call_graph(tmp_path):
+    """The supervisor review finding: a CLI status poll (subprocess under
+    the hood) reachable while the supervisor lock is held."""
+    write_fixture(tmp_path, "supervisor.py", """\
+        import subprocess
+        import threading
+
+        class CommandTask:
+            def poll(self):
+                out = subprocess.run(["kubectl", "get"],
+                                     capture_output=True)
+                return out.returncode
+
+        class Supervisor:
+            def __init__(self, task):
+                self._lock = threading.Lock()
+                self.task = task
+
+            def watch(self):
+                with self._lock:
+                    rc = self.task.poll()
+                return rc
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode >= 1, out.stdout + out.stderr
+    assert "poll" in out.stdout
+
+
+def test_lock_ok_annotation_allowlists_with_reason(tmp_path):
+    write_fixture(tmp_path, "worker.py", """\
+        import threading
+
+        class Worker:
+            def __init__(self, sock):
+                self._send_lock = threading.Lock()
+                self.sock = sock
+
+            def send(self, data):
+                # lock-ok: serializing writes IS this lock's job
+                with self._send_lock:
+                    self.sock.sendall(data)
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_lock_ok_without_reason_is_itself_flagged(tmp_path):
+    write_fixture(tmp_path, "worker.py", """\
+        import threading
+
+        class Worker:
+            def __init__(self, sock):
+                self._send_lock = threading.Lock()
+                self.sock = sock
+
+            def send(self, data):
+                # lock-ok:
+                with self._send_lock:
+                    self.sock.sendall(data)
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "without a reason" in out.stdout
+
+
+def test_acquire_release_pairs_modeled(tmp_path):
+    write_fixture(tmp_path, "manual.py", """\
+        import threading
+        import time
+
+        class M:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                self._lock.acquire()
+                time.sleep(1)
+                self._lock.release()
+
+            def good(self):
+                self._lock.acquire()
+                x = 1
+                self._lock.release()
+                time.sleep(x)
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "bad" in out.stdout and "good" not in out.stdout
+
+
+def test_direct_nested_reacquire_flagged(tmp_path):
+    # the simplest self-deadlock — re-taking a held lock in the SAME
+    # function, no call graph involved — both the `with` and the manual
+    # acquire() spellings
+    write_fixture(tmp_path, "nested.py", """\
+        import threading
+
+        class N:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_with(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+
+            def bad_manual(self):
+                with self._lock:
+                    self._lock.acquire()
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert out.stdout.count("re-acquires") == 2
+
+
+def test_cycle_memo_does_not_hide_findings(tmp_path):
+    # mutually recursive f<->g where only f blocks directly: whichever
+    # locked site is analyzed first, BOTH must be flagged (a cycle-
+    # incomplete transitive set must never be memoized)
+    write_fixture(tmp_path, "cycle.py", """\
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self, n):
+                time.sleep(1)
+                if n:
+                    self.g(n - 1)
+
+            def g(self, n):
+                if n:
+                    self.f(n - 1)
+
+            def h1(self):
+                with self._lock:
+                    self.f(2)
+
+            def h2(self):
+                with self._lock:
+                    self.g(2)
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "h1" in out.stdout and "h2" in out.stdout
+
+
+def test_release_in_finally_clears_the_lock(tmp_path):
+    # the canonical acquire()/try/finally:release() idiom — the release
+    # lives one suite down, but the finally always runs, so the blocking
+    # call AFTER the try must not be flagged (while one INSIDE the try
+    # body still is)
+    write_fixture(tmp_path, "fin.py", """\
+        import threading
+        import time
+
+        class F:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def good(self):
+                self._lock.acquire()
+                try:
+                    x = 1
+                finally:
+                    self._lock.release()
+                time.sleep(x)
+
+            def bad(self):
+                self._lock.acquire()
+                try:
+                    time.sleep(1)
+                finally:
+                    self._lock.release()
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "bad" in out.stdout and "good" not in out.stdout
+
+
+def test_nested_def_not_counted_as_held(tmp_path):
+    """A nested function defined under a lock runs later (often on
+    another thread) — its body must not be treated as under the lock."""
+    write_fixture(tmp_path, "notify.py", """\
+        import threading
+        import time
+
+        class N:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def arm(self):
+                with self._lock:
+                    def later():
+                        time.sleep(1)
+                    self.cb = later
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# invariant lints: env parses and asserts
+# ---------------------------------------------------------------------------
+
+def test_raw_env_parse_python_flagged(tmp_path):
+    write_fixture(tmp_path, "knobs.py", """\
+        import os
+
+        TIMEOUT = int(os.environ.get("MY_TIMEOUT", "60"))
+
+        def read():
+            raw = os.getenv("MY_COUNT")
+            return int(raw) if raw else 0
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "os.environ" in out.stdout
+
+
+def test_env_ok_annotation_allowlists(tmp_path):
+    write_fixture(tmp_path, "knobs.py", """\
+        import os
+
+        # env-ok: bootstrap validates this before any thread starts
+        TIMEOUT = int(os.environ.get("MY_TIMEOUT", "60"))
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_runtime_assert_flagged_and_raise_clean(tmp_path):
+    write_fixture(tmp_path, "proto.py", """\
+        def check_magic(got, want):
+            assert got == want
+        """)
+    write_fixture(tmp_path, "proto_ok.py", """\
+        def check_magic(got, want):
+            if got != want:
+                raise ConnectionError(f"bad magic {got:#x}")
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "proto.py" in out.stdout and "proto_ok.py" not in out.stdout
+
+
+def test_raw_env_parse_cpp_flagged(tmp_path):
+    write_fixture(tmp_path, "knobs.cc", """\
+        #include <cstdlib>
+
+        int ReadRetries() {
+          return std::atoi(std::getenv("MY_RETRIES"));
+        }
+        """)
+    out = run_analyze(tmp_path)
+    # both halves fire: the atoi-family rule and getenv-feeds-parse rule
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "atoi" in out.stdout
+
+
+def test_checked_cpp_parse_clean(tmp_path):
+    write_fixture(tmp_path, "knobs.cc", """\
+        #include <cstdlib>
+
+        long ReadRetries() {
+          const char* v = std::getenv("MY_RETRIES");
+          if (v == nullptr) return 0;
+          char* end = nullptr;
+          long out = std::strtol(v, &end, 10);
+          if (end == v || *end != '\\0') throw "bad";
+          return out;
+        }
+        """)
+    out = run_analyze(tmp_path)
+    # getenv and strtol in SEPARATE statements with end-pointer checking
+    # is the accepted idiom
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# C++ DMLC_GUARDED_BY structural checker
+# ---------------------------------------------------------------------------
+
+GUARDED_HEADER = """\
+    #ifndef FIX_Q_H_
+    #define FIX_Q_H_
+    #include <deque>
+    #include <mutex>
+    #define DMLC_GUARDED_BY(m)
+    #define DMLC_REQUIRES(m)
+
+    class Q {
+     public:
+      void Push(int v);
+      int PopAll();
+      int Peek();
+
+     private:
+      int SizeLocked() DMLC_REQUIRES(mu_) { return (int)q_.size(); }
+      std::mutex mu_;
+      std::deque<int> q_ DMLC_GUARDED_BY(mu_);
+    };
+    #endif  // FIX_Q_H_
+    """
+
+
+def test_guarded_member_unlocked_touch_flagged(tmp_path):
+    write_fixture(tmp_path, "q.h", GUARDED_HEADER)
+    write_fixture(tmp_path, "q.cc", """\
+        #include "q.h"
+
+        void Q::Push(int v) {
+          std::lock_guard<std::mutex> lk(mu_);
+          q_.push_back(v);
+        }
+
+        int Q::PopAll() {
+          int n = (int)q_.size();  // BUG: no lock held
+          q_.clear();
+          return n;
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "DMLC_GUARDED_BY(mu_)" in out.stdout
+    assert "q.cc:9" in out.stdout and "q.cc:10" in out.stdout
+
+
+def test_guarded_touch_after_early_unlock_flagged(tmp_path):
+    # a unique_lock's guarded region ends at lk.unlock(), not the
+    # closing brace — and re-arms at lk.lock() (the worker-loop
+    # parse-outside/bookkeep-inside shape must stay clean)
+    write_fixture(tmp_path, "q.h", GUARDED_HEADER)
+    write_fixture(tmp_path, "q.cc", """\
+        #include "q.h"
+
+        void Q::Push(int v) {
+          std::unique_lock<std::mutex> lk(mu_);
+          q_.push_back(v);
+          lk.unlock();
+          q_.clear();  // BUG: released before this touch
+          lk.lock();
+          q_.push_back(v);  // re-locked: clean
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "q.cc:7" in out.stdout and "q.cc:9" not in out.stdout
+
+
+def test_guarded_member_locked_and_requires_clean(tmp_path):
+    write_fixture(tmp_path, "q.h", GUARDED_HEADER)
+    write_fixture(tmp_path, "q.cc", """\
+        #include "q.h"
+
+        void Q::Push(int v) {
+          std::lock_guard<std::mutex> lk(mu_);
+          q_.push_back(v);
+        }
+
+        int Q::PopAll() {
+          std::unique_lock<std::mutex> lk(mu_);
+          int n = (int)q_.size();
+          q_.clear();
+          return n;
+        }
+
+        int Q::Peek() {
+          std::lock_guard<std::mutex> lk(mu_);
+          return SizeLocked();
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_guarded_touch_with_lock_ok_comment_clean(tmp_path):
+    write_fixture(tmp_path, "q.h", GUARDED_HEADER)
+    write_fixture(tmp_path, "q.cc", """\
+        #include "q.h"
+
+        void Q::Push(int v) {
+          std::lock_guard<std::mutex> lk(mu_);
+          q_.push_back(v);
+        }
+
+        int Q::PopAll() {
+          // lock-ok: destructor path, all threads joined
+          int n = (int)q_.size();
+          q_.clear();  // lock-ok: destructor path, all threads joined
+          return n;
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_mentions_in_comments_and_strings_ignored(tmp_path):
+    write_fixture(tmp_path, "q.h", GUARDED_HEADER)
+    write_fixture(tmp_path, "q.cc", """\
+        #include "q.h"
+        #include <string>
+
+        // q_ is mentioned here in a comment, which is not a touch
+        void Q::Push(int v) {
+          std::lock_guard<std::mutex> lk(mu_);
+          q_.push_back(v);
+        }
+
+        std::string Describe() {
+          return "the q_ deque";  /* q_ in a string/comment is not code */
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# the repo itself
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_repo_is_clean():
+    """Acceptance: `python3 scripts/analyze.py` exits 0 on the tree —
+    every real finding is fixed or carries an audited annotation."""
+    out = subprocess.run([sys.executable, ANALYZE],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 finding(s)" in out.stdout
+
+
+def test_exit_code_is_finding_count(tmp_path):
+    body = "import os\n" + "\n".join(
+        f'V{i} = int(os.environ.get("K{i}", "0"))' for i in range(5)) + "\n"
+    write_fixture(tmp_path, "many.py", body)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 5, out.stdout + out.stderr
