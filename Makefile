@@ -3,11 +3,11 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
-        parse-lanes telemetry cache pytest liveness elastic bench-smoke \
-        dryrun doc clean
+        parse-lanes telemetry cache range pytest liveness elastic \
+        bench-smoke dryrun doc clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
-    telemetry cache pytest liveness elastic dryrun doc
+    telemetry cache range pytest liveness elastic dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -35,6 +35,15 @@ telemetry:
 cache:
 	$(MAKE) -C cpp asan-cache tsan-cache
 	python3 -m pytest tests/test_shard_cache.py -q
+
+# Parallel ranged-read lane (doc/io-ranged.md): the C++ engine suite under
+# BOTH sanitizers (fetch workers racing the consumer, shutdown mid-flight,
+# per-range retry isolation, 200-degrade) plus the Python live-backend
+# matrix (byte-identity across all four mocks, Content-Range regression,
+# degrade, knobs, observable concurrency speedup)
+range:
+	$(MAKE) -C cpp asan-range tsan-range
+	python3 -m pytest tests/test_io_ranged.py -q
 
 lint:
 	python3 scripts/lint.py

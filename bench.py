@@ -224,6 +224,145 @@ def cache_lane_probe(path: str, rows: int, nthread: int) -> dict:
         shutil.rmtree(cdir, ignore_errors=True)
 
 
+def remote_lane_probe(path: str, nthread: int, latency_ms: int = 20,
+                      cap_bytes: int = 8 << 20,
+                      concurrency: int = 12) -> dict:
+    """Parallel ranged remote reads lane (cpp/src/range_reader.h,
+    doc/io-ranged.md): serve the libsvm dataset from the in-process mock
+    S3 server with ``latency_ms`` injected per request AND per 256 KiB
+    body block (a latency-bandwidth-capped origin: one connection tops
+    out at ~256KiB/latency), then parse it sequentially (DMLC_IO_RANGE=0)
+    vs ranged. Reports both rates, the local-file rate for the same
+    bytes, the ratios, and the scheduler's own telemetry (ranges
+    issued/retried, adapted range size/concurrency) — the ROADMAP success
+    metric (remote within ~1.5x of local, ranged >= 2x sequential) as
+    numbers, not prose."""
+    import tempfile
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import tests.mock_s3 as mock_s3
+    from dmlc_core_tpu import telemetry
+    from dmlc_core_tpu.io.native import NativeParser
+
+    with open(path, "rb") as f:
+        blob = f.read(cap_bytes)
+    blob = blob[: blob.rfind(b"\n") + 1]  # whole lines only
+    lane_rows = blob.count(b"\n")
+
+    state, port, shutdown = mock_s3.serve()
+    # env must be set before the native S3 singleton first initializes;
+    # the bench process touches s3:// only here
+    os.environ["S3_ENDPOINT"] = f"http://127.0.0.1:{port}"
+    os.environ["S3_ACCESS_KEY_ID"] = mock_s3.ACCESS_KEY
+    os.environ["S3_SECRET_ACCESS_KEY"] = mock_s3.SECRET_KEY
+    os.environ["S3_REGION"] = mock_s3.REGION
+    state.objects[("bench", "remote/data.libsvm")] = blob
+    # one connection caps at latency_block/latency_ms — the long-haul-link
+    # shape where parallel ranges win; scaled to the payload so a
+    # sequential pass always pays ~8 serialized bursts regardless of size
+    state.latency_block = max(len(blob) // 8, 64 << 10)
+    uri = "s3://bench/remote/data.libsvm"
+
+    def parse_pass(u):
+        t0 = time.time()
+        got = 0
+        with NativeParser(u, nthread=nthread, fmt="libsvm") as p:
+            for blk in p:
+                got += blk.num_rows
+        dt = time.time() - t0
+        assert got == lane_rows, f"row count mismatch: {got} != {lane_rows}"
+        return lane_rows / dt
+
+    def under_env(overrides, fn):
+        old = {k: os.environ.get(k) for k in overrides}
+        os.environ.update({k: str(v) for k, v in overrides.items()})
+        try:
+            return fn()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def snap_counters():
+        out = {}
+        for c in telemetry.snapshot()["counters"]:
+            out[c["name"]] = out.get(c["name"], 0) + c["value"]
+        return out
+
+    tmp = tempfile.NamedTemporaryFile(suffix=".libsvm", delete=False)
+    try:
+        tmp.write(blob)
+        tmp.close()
+        # local parse of the SAME bytes: the vs_local denominator
+        local_rps = max(parse_pass(tmp.name) for _ in range(2))
+        # high concurrency: the per-connection cap is the point of this
+        # lane, and real object stores serve far more than 4 streams
+        ranged_env = {"DMLC_IO_RANGE": "1",
+                      "DMLC_IO_RANGE_CONCURRENCY": str(concurrency)}
+        # the mock's own ceiling: ranged ingest with NO injected latency.
+        # The serving side is a Python (GIL-bound) HTTP server sharing this
+        # host's cores with the fetchers AND the parser, so vs_local is
+        # bounded by the harness, not the engine — this row attributes that.
+        state.latency_ms = 0
+        ceiling_rps = max(
+            under_env(ranged_env, lambda: parse_pass(uri))
+            for _ in range(2))
+        state.latency_ms = latency_ms
+        seq_rps = max(
+            under_env({"DMLC_IO_RANGE": "0"}, lambda: parse_pass(uri))
+            for _ in range(2))
+        before = snap_counters()
+        ranged_rps = max(
+            under_env(ranged_env, lambda: parse_pass(uri))
+            for _ in range(3))
+        after = snap_counters()
+        snap = telemetry.snapshot()
+        gauges = {g["name"]: g["value"] for g in snap["gauges"]}
+        hists = {(h["name"], h["labels"].get("backend")): h
+                 for h in snap["histograms"]}
+        hb = hists.get(("io_range_bytes", "s3"), {})
+        sched = {
+            "ranges_issued": int(after.get("io_range_issued_total", 0)
+                                 - before.get("io_range_issued_total", 0)),
+            "range_retries": int(after.get("io_range_retried_total", 0)
+                                 - before.get("io_range_retried_total", 0)),
+            "degraded_200": int(
+                after.get("io_range_degraded_200_total", 0)
+                - before.get("io_range_degraded_200_total", 0)),
+            "sched_range_kb": round(
+                gauges.get("io_range_sched_bytes", 0) / 1024, 1),
+            "sched_concurrency": int(
+                gauges.get("io_range_sched_concurrency", 0)),
+        }
+        if hb.get("count"):
+            sched["mean_range_kb"] = round(hb["sum"] / hb["count"] / 1024, 1)
+        return {
+            "bytes": len(blob),
+            "rows": lane_rows,
+            "latency_ms": latency_ms,
+            "local_rows_per_sec": round(local_rps, 1),
+            "sequential_rows_per_sec": round(seq_rps, 1),
+            "ranged_rows_per_sec": round(ranged_rps, 1),
+            "mock_ceiling_rows_per_sec": round(ceiling_rps, 1),
+            "ranged_vs_sequential": round(ranged_rps / seq_rps, 2),
+            "ranged_vs_local": round(ranged_rps / local_rps, 3),
+            # the GIL mock's best case vs local: how much of the vs_local
+            # gap is harness, not engine (with ZERO latency the remote
+            # path still tops out here)
+            "ceiling_vs_local": round(ceiling_rps / local_rps, 3),
+            # how much of the injected latency the scheduler hid: ranged
+            # WITH latency vs the same path with NONE (the harness ceiling)
+            "latency_hidden": round(ranged_rps / ceiling_rps, 3),
+            "range_scheduler": sched,
+        }
+    finally:
+        shutdown()
+        os.unlink(tmp.name)
+
+
 def text_lane_probe(path: str, rows: int, nthread: int, fmt: str,
                     fmt_args: str = "") -> dict:
     """Host parse throughput for a text lane (multi-chunk parse pipeline —
@@ -953,6 +1092,26 @@ def main() -> None:
                   + ")", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 - report, don't die
             extras["cache_lane"] = {"error": str(e)[-300:]}
+        # parallel ranged remote reads lane (doc/io-ranged.md): mock-S3
+        # ingest under injected per-request/per-block latency — sequential
+        # vs ranged vs local as ratios, plus what the readahead scheduler
+        # chose. Host-only, so it reports even on a degraded round.
+        try:
+            extras["remote_lane"] = remote_lane_probe(
+                path, args.threads, latency_ms=20,
+                cap_bytes=(2 << 20) if args.smoke else (8 << 20),
+                concurrency=8 if args.smoke else 12)
+            rl = extras["remote_lane"]
+            print(f"# remote lane: local {rl['local_rows_per_sec']:.0f} "
+                  f"rows/s, sequential {rl['sequential_rows_per_sec']:.0f}"
+                  f", ranged {rl['ranged_rows_per_sec']:.0f} "
+                  f"({rl['ranged_vs_sequential']}x seq, "
+                  f"{rl['ranged_vs_local']}x local, latency hidden "
+                  f"{rl['latency_hidden']:.0%} of the mock ceiling "
+                  f"{rl['mock_ceiling_rows_per_sec']:.0f}; "
+                  f"scheduler {rl['range_scheduler']})", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            extras["remote_lane"] = {"error": str(e)[-300:]}
         extras["csv_lane"] = text_lane_probe(
             ensure_csv_dataset(rows), rows, args.threads, "csv",
             "?format=csv&label_column=0")

@@ -12,6 +12,7 @@
 #include "http.h"
 #include "http_stream.h"
 #include "listing.h"
+#include "range_reader.h"
 #include "s3_filesys.h"  // s3::UriEncode / s3::XmlNextField / XmlUnescape
 #include "sha256.h"
 
@@ -187,8 +188,56 @@ class AzureReadStream : public RetryingHttpReadStream {
                                 std::to_string(status) + ": " + head.body,
                             status);
     }
+    if (head.status == 206) {
+      // misaligned Content-Range must retry, never splice silently
+      CheckContentRangeStart(head, pos_, "azure", uri_.Str());
+    }
   }
 
+  AzureConfig cfg_;
+  URI uri_;
+  std::string container_, blob_;
+  Target target_;
+};
+
+// One idempotent bounded ranged GET per call (range_reader.h): each fetch
+// carries its own SharedKey signature (the Range header participates in
+// the string-to-sign) on a fresh connection and verifies the 206's
+// Content-Range offset. A 200 means the gateway ignored Range — degrade
+// to the sequential lane.
+class AzureRangeFetcher : public io::RangeFetcher {
+ public:
+  AzureRangeFetcher(const AzureConfig& cfg, const URI& uri)
+      : cfg_(cfg), uri_(uri) {
+    SplitContainerBlob(uri, &container_, &blob_);
+    target_ = ResolveTarget(cfg_);
+  }
+
+  io::FetchStatus Fetch(size_t off, size_t len, char* buf,
+                        size_t* progress) override {
+    std::string resource = "/" + container_ + blob_;
+    std::map<std::string, std::string> extra = {
+        {"Range", RangeHeader(off, len)}};
+    auto headers = SignedHeaders(cfg_, "GET", resource, {}, 0, extra);
+    HttpConnection conn(RouteOf(target_));
+    conn.SendRequest("GET", s3::UriEncode(resource, true), headers, "");
+    HttpResponse head;
+    conn.ReadResponseHead(&head);
+    if (head.status == 200) return io::FetchStatus::kDegraded;
+    if (head.status != 206) {
+      conn.ReadFullBody(&head);
+      throw HttpStatusError("azure ranged GET " + uri_.Str() +
+                                " failed with status " +
+                                std::to_string(head.status) + ": " +
+                                head.body,
+                            head.status);
+    }
+    CheckContentRangeStart(head, off, "azure", uri_.Str());
+    ReadRangeBody(&conn, buf, len, "azure", uri_.Str(), progress);
+    return io::FetchStatus::kOk;
+  }
+
+ private:
   AzureConfig cfg_;
   URI uri_;
   std::string container_, blob_;
@@ -439,16 +488,25 @@ FileInfo AzureFileSystem::PathInfoUnderPolicy(
 SeekStream* AzureFileSystem::OpenForRead(const URI& path, bool allow_null) {
   URI clean = path;
   io::RetryPolicy policy = config_.retry;
+  io::RangeConfig rcfg = io::RangeConfig::FromEnv();
   int timeout_ms = 0;
-  io::ExtractUriRetryArgs(&clean.path, &policy, &timeout_ms);
+  io::ExtractUriIoArgs(&clean.path, &policy, &timeout_ms, &rcfg);
   // bind the open-time metadata probe to the per-open timeout as well
   io::ScopedIoTimeout scoped_timeout(timeout_ms);
   try {
     FileInfo info = PathInfoUnderPolicy(clean, policy);
     DCT_CHECK(info.type == FileType::kFile)
         << "cannot open azure directory for read: " << clean.Str();
-    return new azure::AzureReadStream(config_, clean, info.size, policy,
-                                      timeout_ms);
+    const AzureConfig cfg = config_;
+    const size_t size = info.size;
+    return io::NewRangedOrSequential(
+        "azure", size,
+        std::make_unique<azure::AzureRangeFetcher>(cfg, clean),
+        [cfg, clean, size, policy, timeout_ms]() -> SeekStream* {
+          return new azure::AzureReadStream(cfg, clean, size, policy,
+                                            timeout_ms);
+        },
+        rcfg, policy, timeout_ms);
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;

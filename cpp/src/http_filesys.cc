@@ -8,6 +8,7 @@
 
 #include "http.h"
 #include "http_stream.h"
+#include "range_reader.h"
 #include "retry.h"
 
 namespace dct {
@@ -101,12 +102,49 @@ class HttpReadStream : public RetryingHttpReadStream {
         left -= n;
       }
       policy_.max_retry = std::min(policy_.max_retry, 2);
-    } else if (head.status != 206 && head.status != 200) {
+    } else if (head.status == 206) {
+      // a 206 whose Content-Range starts elsewhere must be a retryable
+      // error, never silently spliced bytes (doc/io-ranged.md)
+      CheckContentRangeStart(head, pos_, "http", uri_.Str());
+    } else if (head.status != 200) {
       throw HttpStatusError(
           "http GET " + uri_.Str() + " -> status " +
           std::to_string(head.status), head.status);
     }
     conn_ = std::move(conn);
+  }
+
+ private:
+  URI uri_;
+};
+
+// One idempotent bounded ranged GET per call (range_reader.h): fresh
+// connection, `Range: bytes=a-b`, 206 with a verified Content-Range
+// offset. A 200 means the origin ignored Range — degrade to the
+// sequential lane (which knows how to resume-at-offset under 200s,
+// including its tightened retry budget).
+class HttpRangeFetcher : public io::RangeFetcher {
+ public:
+  explicit HttpRangeFetcher(const URI& uri) : uri_(uri) {}
+
+  io::FetchStatus Fetch(size_t off, size_t len, char* buf,
+                        size_t* progress) override {
+    HttpConnection conn(RouteFor(uri_));
+    std::map<std::string, std::string> h;
+    h["Range"] = RangeHeader(off, len);
+    h["Accept-Encoding"] = "identity";
+    conn.SendRequest("GET", uri_.path.empty() ? "/" : uri_.path, h, "");
+    HttpResponse head;
+    conn.ReadResponseHead(&head);
+    if (head.status == 200) return io::FetchStatus::kDegraded;
+    if (head.status != 206) {
+      throw HttpStatusError("http ranged GET " + uri_.Str() +
+                                " -> status " + std::to_string(head.status),
+                            head.status);
+    }
+    CheckContentRangeStart(head, off, "http", uri_.Str());
+    ReadRangeBody(&conn, buf, len, "http", uri_.Str(), progress);
+    return io::FetchStatus::kOk;
   }
 
  private:
@@ -232,17 +270,24 @@ Stream* HttpFileSystem::Open(const URI& path, const char* mode,
 }
 
 SeekStream* HttpFileSystem::OpenForRead(const URI& path, bool allow_null) {
-  // `?io_*=` args are OURS (per-open retry overrides, retry.h) and are
-  // stripped before the path goes on the wire; any other query survives.
+  // `?io_*=` args are OURS (per-open retry + range overrides, retry.h /
+  // range_reader.h) and are stripped before the path goes on the wire;
+  // any other query survives.
   URI clean = path;
   io::RetryPolicy policy = HttpRetryPolicy();
+  io::RangeConfig rcfg = io::RangeConfig::FromEnv();
   int timeout_ms = 0;
-  io::ExtractUriRetryArgs(&clean.path, &policy, &timeout_ms);
+  io::ExtractUriIoArgs(&clean.path, &policy, &timeout_ms, &rcfg);
   bool found = true;
   io::ScopedIoTimeout scoped_timeout(timeout_ms);
   size_t size = RemoteSize(clean, allow_null, &found, policy);
   if (!found) return nullptr;
-  return new HttpReadStream(clean, size, policy, timeout_ms);
+  return io::NewRangedOrSequential(
+      "http", size, std::make_unique<HttpRangeFetcher>(clean),
+      [clean, size, policy, timeout_ms]() -> SeekStream* {
+        return new HttpReadStream(clean, size, policy, timeout_ms);
+      },
+      rcfg, policy, timeout_ms);
 }
 
 namespace {

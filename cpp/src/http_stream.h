@@ -12,6 +12,7 @@
 #ifndef DCT_HTTP_STREAM_H_
 #define DCT_HTTP_STREAM_H_
 
+#include <cstdlib>
 #include <memory>
 #include <string>
 
@@ -44,6 +45,70 @@ inline HttpResponse RetryingHttpRequest(
     } catch (const Error&) {
       if (!ctl.BackoffOrGiveUp()) throw;
     }
+  }
+}
+
+// ---- ranged-GET helpers shared by the sequential readers and the
+// ---- range_reader.h fetchers -----------------------------------------------
+
+// Bounded range header for [off, off+len): "bytes=a-b" (b inclusive).
+inline std::string RangeHeader(size_t off, size_t len) {
+  return "bytes=" + std::to_string(off) + "-" +
+         std::to_string(off + len - 1);
+}
+
+// First byte offset out of a "Content-Range: bytes a-b/total" header, or
+// -1 when the header is absent/unparsable (some mocks and gateways omit
+// it; absence is tolerated, a PRESENT-but-wrong offset is not).
+inline int64_t ContentRangeStart(const HttpResponse& head) {
+  auto it = head.headers.find("content-range");
+  if (it == head.headers.end()) return -1;
+  const std::string& v = it->second;
+  size_t p = v.find_first_of("0123456789");
+  if (p == std::string::npos) return -1;
+  char* end = nullptr;
+  long long start = std::strtoll(v.c_str() + p, &end, 10);
+  if (end == v.c_str() + p || start < 0) return -1;
+  return static_cast<int64_t>(start);
+}
+
+// A 206 whose Content-Range starts at the wrong offset would splice the
+// wrong bytes into the stream SILENTLY — classify it as a retryable
+// transport error (plain Error: the retry ladders back off and reconnect;
+// a persistently wrong origin exhausts the budget and fails loudly).
+inline void CheckContentRangeStart(const HttpResponse& head, size_t expect,
+                                   const char* backend,
+                                   const std::string& what) {
+  const int64_t start = ContentRangeStart(head);
+  if (start >= 0 && static_cast<size_t>(start) != expect) {
+    throw Error(std::string(backend) + " 206 Content-Range offset " +
+                std::to_string(start) + " != requested " +
+                std::to_string(expect) + " for " + what +
+                " (retrying; refusing to splice misaligned bytes)");
+  }
+}
+
+// Drain exactly `len` body bytes into buf; a body that ends short is a
+// transport error (mid-range truncation) the per-range retry absorbs.
+// `*progress` tracks bytes landed so far even when an exception cuts the
+// transfer — the retry resumes WITHIN the range (offset+progress), the
+// ranged twin of the sequential lane's reconnect-at-offset, so a server
+// that truncates every response still converges. Surplus body (origins
+// that honor the start but ignore the end of a bounded range) is simply
+// abandoned with the connection.
+inline void ReadRangeBody(HttpConnection* conn, char* buf, size_t len,
+                          const char* backend, const std::string& what,
+                          size_t* progress = nullptr) {
+  size_t got = 0;
+  while (got < len) {
+    size_t n = conn->ReadBody(buf + got, len - got);
+    if (n == 0) {
+      throw Error(std::string(backend) + " range body ended at " +
+                  std::to_string(got) + " of " + std::to_string(len) +
+                  " bytes for " + what);
+    }
+    got += n;
+    if (progress != nullptr) *progress = got;
   }
 }
 

@@ -159,6 +159,10 @@ size_t ByteSplit::GlobalBoundaryFixup(size_t ofs) {
   std::unique_ptr<SeekStream> s(
       FileSystem::GetInstance(files_[k].path)->OpenForRead(files_[k].path));
   s->Seek(local);
+  // boundary probe: usually scans at most one record — no point letting a
+  // readahead stream prefetch a whole window for it (the hint re-extends
+  // automatically in the rare longer scan)
+  s->HintReadBound(std::min(local + (64 << 10), files_[k].size));
   size_t consumed = SeekRecordHead(s.get(), local, files_[k].size);
   return std::min(file_start_[k] + local + consumed,
                   file_start_[k] + files_[k].size);
@@ -213,6 +217,10 @@ size_t ByteSplit::ReadSpan(char* buf, size_t want) {
       cur_stream_.reset(FileSystem::GetInstance(files_[file_idx_].path)
                             ->OpenForRead(files_[file_idx_].path));
       cur_stream_->Seek(local_pos_);
+      // this partition never reads past end_ in this file: a readahead
+      // stream must not prefetch a window past the partition edge
+      cur_stream_->HintReadBound(std::min(
+          files_[file_idx_].size, end_ - file_start_[file_idx_]));
     }
     size_t to_read = std::min(
         {want - got, files_[file_idx_].size - local_pos_, end_ - global});
@@ -597,6 +605,9 @@ void IndexedRecordIOSplit::ReadSpanAt(size_t global_ofs, char* dst,
     }
     open_stream_->Seek(local);
     size_t take = std::min(size, files_[k].size - local);
+    // record-exact span: prefetching past it would be discarded by the
+    // next (possibly shuffled) seek anyway
+    open_stream_->HintReadBound(local + take);
     open_stream_->ReadExact(dst, take);
     dst += take;
     size -= take;

@@ -1,0 +1,516 @@
+// Concurrent ranged-read engine implementation (see range_reader.h).
+#include "range_reader.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "http.h"
+
+namespace dct {
+namespace io {
+
+namespace {
+
+constexpr int64_t kRangeBytesLo = 4 << 10;    // 4 KiB floor (tests shrink)
+constexpr int64_t kRangeBytesHi = 1 << 30;    // 1 GiB ceiling
+
+// Registry pointers resolved once per process (telemetry.h rule).
+telemetry::Counter* IssuedCounter() {
+  static telemetry::Counter* c =
+      telemetry::GetCounter("io_range_issued_total");
+  return c;
+}
+telemetry::Counter* RetriedCounter() {
+  static telemetry::Counter* c =
+      telemetry::GetCounter("io_range_retried_total");
+  return c;
+}
+telemetry::Counter* DegradedCounter() {
+  static telemetry::Counter* c =
+      telemetry::GetCounter("io_range_degraded_200_total");
+  return c;
+}
+telemetry::Gauge* SchedBytesGauge() {
+  static telemetry::Gauge* g = telemetry::GetGauge("io_range_sched_bytes");
+  return g;
+}
+telemetry::Gauge* SchedConcurrencyGauge() {
+  static telemetry::Gauge* g =
+      telemetry::GetGauge("io_range_sched_concurrency");
+  return g;
+}
+
+// Seed the first range size from the backend's live connect/ttfb
+// histograms (PR 5): size ranges so transfer ~4x the observed per-request
+// setup cost at a conservative ~64 MB/s per connection — bytes =
+// 4 * setup_us * 64 B/us. With no prior traffic, start at the floor and
+// let AIMD grow.
+size_t SeedRangeBytes(const RangeConfig& cfg, const std::string& backend) {
+  const telemetry::IoHists* h = telemetry::IoHistsFor(backend);
+  uint64_t setup_us = 0;
+  if (h->connect_us->count() > 0) {
+    setup_us += h->connect_us->sum() / h->connect_us->count();
+  }
+  if (h->ttfb_us->count() > 0) {
+    setup_us += h->ttfb_us->sum() / h->ttfb_us->count();
+  }
+  size_t seed = cfg.min_bytes;
+  if (setup_us > 0) seed = static_cast<size_t>(setup_us) * 256;
+  return std::min(cfg.max_bytes, std::max(cfg.min_bytes, seed));
+}
+
+RangeConfig Normalized(RangeConfig c) {
+  if (c.max_bytes < c.min_bytes) c.max_bytes = c.min_bytes;
+  if (c.max_concurrency < 1) c.max_concurrency = 1;
+  return c;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------- config --
+RangeConfig RangeConfig::FromEnv() {
+  RangeConfig c;
+  c.enabled = CheckedEnvInt("DMLC_IO_RANGE", 1, 0, 1) != 0;
+  c.min_bytes = static_cast<size_t>(
+      CheckedEnvInt("DMLC_IO_RANGE_MIN_BYTES",
+                    static_cast<int64_t>(c.min_bytes), kRangeBytesLo,
+                    kRangeBytesHi));
+  c.max_bytes = static_cast<size_t>(
+      CheckedEnvInt("DMLC_IO_RANGE_MAX_BYTES",
+                    static_cast<int64_t>(c.max_bytes), kRangeBytesLo,
+                    kRangeBytesHi));
+  c.max_concurrency = static_cast<int>(
+      CheckedEnvInt("DMLC_IO_RANGE_CONCURRENCY", c.max_concurrency, 1, 64));
+  return Normalized(c);
+}
+
+bool RangeConfig::ApplyUriArg(const std::string& key,
+                              const std::string& value) {
+  if (key == "io_range") {
+    enabled = CheckedInt("uri arg io_range", value, 0, 1) != 0;
+  } else if (key == "io_range_min_bytes") {
+    min_bytes = static_cast<size_t>(CheckedInt(
+        "uri arg io_range_min_bytes", value, kRangeBytesLo, kRangeBytesHi));
+    if (max_bytes < min_bytes) max_bytes = min_bytes;
+  } else if (key == "io_range_max_bytes") {
+    max_bytes = static_cast<size_t>(CheckedInt(
+        "uri arg io_range_max_bytes", value, kRangeBytesLo, kRangeBytesHi));
+    if (min_bytes > max_bytes) min_bytes = max_bytes;
+  } else if (key == "io_range_concurrency") {
+    max_concurrency = static_cast<int>(
+        CheckedInt("uri arg io_range_concurrency", value, 1, 64));
+  } else {
+    return false;
+  }
+  return true;
+}
+
+void ExtractUriIoArgs(std::string* path, RetryPolicy* policy,
+                      int* timeout_ms_override, RangeConfig* rcfg) {
+  // one tokenizer for every io_* knob family: the retry walk offers each
+  // key it does not consume to the range config (unknown typos still
+  // error there with the full knob list)
+  ExtractUriRetryArgs(path, policy, timeout_ms_override,
+                      [rcfg](const std::string& key, const std::string& val) {
+                        return rcfg != nullptr && rcfg->ApplyUriArg(key, val);
+                      });
+}
+
+// ----------------------------------------------------------------- reader --
+RangeReader::RangeReader(const char* backend, size_t file_size,
+                         std::unique_ptr<RangeFetcher> fetcher,
+                         std::function<SeekStream*()> sequential_factory,
+                         const RangeConfig& cfg, const RetryPolicy& policy,
+                         int timeout_ms_override)
+    : backend_(backend),
+      file_size_(file_size),
+      fetcher_(std::move(fetcher)),
+      seq_factory_(std::move(sequential_factory)),
+      cfg_(Normalized(cfg)),
+      policy_(policy),
+      timeout_ms_override_(timeout_ms_override),
+      hists_(telemetry::RangeHistsFor(backend_)) {
+  // fair-share clamp: a telemetry-seeded size must still leave one range
+  // per allowed worker in this object, or the seed itself caps the
+  // parallelism it exists to enable (AIMD can still grow past it later);
+  // floored at min_bytes for objects too small to split that finely
+  size_t seed = SeedRangeBytes(cfg_, backend_);
+  const size_t fair =
+      file_size_ / static_cast<size_t>(cfg_.max_concurrency);
+  if (seed > fair) seed = std::max(cfg_.min_bytes, fair);
+  // lock-ok: pre-spawn init — no worker thread exists until the first Read
+  range_bytes_ = seed;
+  // concurrency starts at the configured cap — the operator's stated
+  // connection budget; a slow ramp-up would be paid again on EVERY shard
+  // reopen. AIMD then runs downhill-first: repeated per-range retries
+  // (the congestion signal) halve it, head-of-line waits recover it.
+  // lock-ok: pre-spawn init — no worker thread exists until the first Read
+  concurrency_ = cfg_.max_concurrency;
+}
+
+RangeReader::~RangeReader() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutdown_.store(true);
+  }
+  cv_work_.notify_all();
+  cv_data_.notify_all();
+  for (std::thread& t : workers_) {
+    if (t.joinable()) t.join();
+  }
+}
+
+bool RangeReader::ShouldExitLocked() const DMLC_REQUIRES(mu_) {
+  return shutdown_.load() || degraded_ || error_ != nullptr;
+}
+
+size_t RangeReader::CarveEndLocked() const DMLC_REQUIRES(mu_) {
+  return std::min(file_size_, bound_);
+}
+
+bool RangeReader::WantWorkLocked(int id) const DMLC_REQUIRES(mu_) {
+  if (id >= concurrency_) return false;
+  if (issue_next_ >= CarveEndLocked()) return false;
+  // readahead window from the consumer position bounds buffered + in-
+  // flight bytes; the +2 keeps the pipe full while the head is drained
+  const size_t window =
+      range_bytes_ * static_cast<size_t>(concurrency_ + 2);
+  return issue_next_ - pos_ < window;
+}
+
+bool RangeReader::HeadReadyLocked() const DMLC_REQUIRES(mu_) {
+  auto it = landed_.upper_bound(pos_);
+  if (it == landed_.begin()) return false;
+  --it;
+  return pos_ < it->first + it->second.size;
+}
+
+void RangeReader::TrimConsumedLocked() DMLC_REQUIRES(mu_) {
+  // segments wholly before the consumer position only exist after a
+  // forward seek skipped them: discarded prefetch, counted as waste
+  while (!landed_.empty()) {
+    auto it = landed_.begin();
+    if (it->first + it->second.size <= pos_) {
+      wasted_bytes_ += it->second.size;
+      landed_.erase(it);
+    } else {
+      break;
+    }
+  }
+}
+
+void RangeReader::StartWorkersLocked() DMLC_REQUIRES(mu_) {
+  started_ = true;
+  issue_next_ = pos_;
+  SchedBytesGauge()->Set(static_cast<int64_t>(range_bytes_));
+  SchedConcurrencyGauge()->Set(concurrency_);
+  // never spawn more threads than the remaining bytes can yield ranges at
+  // the minimum size — a small shard under a big concurrency cap must not
+  // pay for a dozen parked threads per open (if the read bound widens
+  // later, parallelism is merely capped at the spawned count, still
+  // correct)
+  const size_t end = CarveEndLocked();
+  const size_t remaining = end - std::min(pos_, end);
+  const size_t yield =
+      std::max<size_t>((remaining + cfg_.min_bytes - 1) / cfg_.min_bytes, 1);
+  const int n = static_cast<int>(std::min<size_t>(
+      static_cast<size_t>(cfg_.max_concurrency), yield));
+  workers_.reserve(static_cast<size_t>(n));
+  for (int i = 0; i < n; ++i) {
+    workers_.emplace_back([this, i] { WorkerLoop(i); });
+  }
+}
+
+void RangeReader::AdaptAfterRangeLocked(
+    size_t len, uint64_t elapsed_us, int retries) DMLC_REQUIRES(mu_) {
+  if (retries > 0) {
+    // multiplicative decrease: a flaky link loses less work per retry on
+    // smaller ranges; 2+ retries on one range also halves concurrency
+    range_bytes_ = std::max(cfg_.min_bytes, range_bytes_ / 2);
+    if (retries >= 2 && concurrency_ > 1) {
+      concurrency_ = std::max(1, concurrency_ / 2);
+      SchedConcurrencyGauge()->Set(concurrency_);
+    }
+  } else if (len >= range_bytes_) {
+    // additive increase while per-range goodput holds up: bigger ranges
+    // keep amortizing the per-request setup cost until transfer dominates
+    // (only full-size ranges inform growth — the EOF tail is smaller)
+    const double gp = static_cast<double>(len) /
+                      static_cast<double>(std::max<uint64_t>(elapsed_us, 1));
+    if (ewma_goodput_ <= 0.0 || gp >= ewma_goodput_ * 0.75) {
+      range_bytes_ = std::min(cfg_.max_bytes, range_bytes_ + cfg_.min_bytes);
+    }
+    ewma_goodput_ =
+        ewma_goodput_ <= 0.0 ? gp : 0.7 * ewma_goodput_ + 0.3 * gp;
+  }
+  SchedBytesGauge()->Set(static_cast<int64_t>(range_bytes_));
+}
+
+void RangeReader::WorkerLoop(int id) {
+  // a per-open ?io_timeout_ms= must bind this worker's socket ops exactly
+  // like it binds the sequential lane (thread-local override, retry.h)
+  ScopedIoTimeout scoped_timeout(timeout_ms_override_);
+  std::unique_lock<std::mutex> lk(mu_);
+  while (true) {
+    cv_work_.wait(lk, [this, id] {
+      return ShouldExitLocked() || WantWorkLocked(id);
+    });
+    if (ShouldExitLocked()) return;
+    const uint64_t gen = generation_;
+    const size_t off = issue_next_;
+    const size_t len = std::min(range_bytes_, CarveEndLocked() - off);
+    issue_next_ += len;
+    inflight_bytes_ += len;
+    lk.unlock();
+
+    IssuedCounter()->Add(1);
+    Segment seg;
+    seg.data.reset(new char[len]);  // default-init: the fetch fills it
+    seg.size = len;
+    int retries = 0;
+    bool degraded_fetch = false;
+    std::exception_ptr err;
+    const uint64_t t0 = telemetry::NowUs();
+    // fresh controller whenever an attempt delivered bytes: the policy
+    // budget bounds a stretch of ZERO progress, exactly like the
+    // sequential lane (one controller per Read call, where any landed
+    // bytes mean the next call starts a fresh budget) — without this, a
+    // server that truncates every response burns the whole ladder on a
+    // range that is in fact converging
+    auto ctl = std::make_unique<RetryController>(policy_);
+    size_t got = 0;  // retries resume WITHIN the range (offset+got)
+    while (true) {
+      size_t step = 0;
+      try {
+        FetchStatus st =
+            fetcher_->Fetch(off + got, len - got, seg.data.get() + got,
+                            &step);
+        got += step;
+        degraded_fetch = st == FetchStatus::kDegraded;
+        break;
+      } catch (const PermanentNetworkError&) {
+        err = std::current_exception();  // backoff cannot fix a typo'd host
+        break;
+      } catch (const HttpStatusError& e) {
+        got += step;
+        if (step > 0) ctl = std::make_unique<RetryController>(policy_);
+        if (!RetryableHttpStatus(e.status) || shutdown_.load() ||
+            !ctl->BackoffOrGiveUp(&shutdown_)) {
+          err = std::current_exception();
+          break;
+        }
+        ++retries;
+      } catch (const Error&) {
+        got += step;
+        if (step > 0) ctl = std::make_unique<RetryController>(policy_);
+        if (shutdown_.load() || !ctl->BackoffOrGiveUp(&shutdown_)) {
+          err = std::current_exception();
+          break;
+        }
+        ++retries;
+      }
+    }
+    const uint64_t elapsed_us = telemetry::NowUs() - t0;
+    if (retries > 0) RetriedCounter()->Add(static_cast<uint64_t>(retries));
+
+    lk.lock();
+    range_retries_ += static_cast<uint64_t>(retries);
+    if (gen != generation_) {
+      // a Seek restarted the carve plan while this fetch was in flight:
+      // the bytes are stale — drop them (inflight accounting was reset)
+      wasted_bytes_ += len;
+      continue;
+    }
+    inflight_bytes_ -= len;
+    if (err != nullptr) {
+      if (shutdown_.load()) return;  // dtor-driven abandon, not an error
+      if (error_ == nullptr) error_ = err;
+      cv_data_.notify_all();
+      cv_work_.notify_all();
+      return;
+    }
+    if (degraded_fetch) {
+      // the origin ignored Range: hand the stream to the sequential lane
+      // (which resumes-at-offset under 200 with its tightened budget);
+      // counted once per stream, not once per racing worker
+      if (!degraded_) DegradedCounter()->Add(1);
+      degraded_ = true;
+      cv_data_.notify_all();
+      cv_work_.notify_all();
+      return;
+    }
+    if (!degraded_ && !shutdown_.load()) {
+      hists_->bytes->Observe(len);
+      ++ranges_fetched_;
+      landed_[off] = std::move(seg);
+      AdaptAfterRangeLocked(len, elapsed_us, retries);
+      cv_data_.notify_all();
+    }
+  }
+}
+
+size_t RangeReader::Read(void* ptr, size_t size) {
+  if (seq_ != nullptr) return seq_->Read(ptr, size);
+  char* out = static_cast<char*>(ptr);
+  size_t copied = 0;
+  bool go_sequential = false;
+  size_t seq_pos = 0;
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (size == 0 || pos_ >= file_size_) return 0;
+    if (!started_) StartWorkersLocked();
+    while (copied < size && pos_ < file_size_) {
+      if (pos_ >= bound_) {
+        // the consumer crossed the hint after all: resume carving
+        bound_ = static_cast<size_t>(-1);
+        cv_work_.notify_all();
+      }
+      TrimConsumedLocked();
+      if (HeadReadyLocked()) {
+        auto it = landed_.upper_bound(pos_);
+        --it;
+        const size_t seg_off = pos_ - it->first;
+        const size_t avail = it->second.size - seg_off;
+        const size_t n = std::min(size - copied, avail);
+        std::memcpy(out + copied, it->second.data.get() + seg_off, n);
+        copied += n;
+        pos_ += n;
+        useful_bytes_ += n;
+        if (n == avail) {
+          landed_.erase(it);
+          cv_work_.notify_all();  // window advanced
+        }
+        continue;
+      }
+      if (copied > 0) break;  // serve what landed; short reads are legal
+      if (error_ != nullptr) std::rethrow_exception(error_);
+      if (degraded_) {
+        go_sequential = true;
+        seq_pos = pos_;
+        break;
+      }
+      // head-of-line wait: the network is behind the consumer — additive
+      // concurrency increase, one step per wait episode
+      if (concurrency_ < cfg_.max_concurrency) {
+        ++concurrency_;
+        SchedConcurrencyGauge()->Set(concurrency_);
+        cv_work_.notify_all();
+      }
+      telemetry::ScopedTimerUs wait_span(hists_->wait_us);
+      cv_data_.wait(lk, [this] {
+        return shutdown_.load() || error_ != nullptr || degraded_ ||
+               HeadReadyLocked();
+      });
+      if (shutdown_.load()) return copied;
+    }
+  }
+  if (go_sequential) {
+    SwitchToSequential(seq_pos);
+    return seq_->Read(out, size);
+  }
+  return copied;
+}
+
+size_t RangeReader::Write(const void*, size_t) {
+  throw Error(backend_ + " ranged read stream is read-only");
+}
+
+void RangeReader::Seek(size_t pos) {
+  if (seq_ != nullptr) {
+    seq_->Seek(pos);
+    return;
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  if (pos >= bound_) bound_ = static_cast<size_t>(-1);  // hint outlived
+  if (pos == pos_) return;
+  if (!started_) {
+    // the open-then-seek-to-partition-start dance: nothing fetched yet
+    pos_ = pos;
+    issue_next_ = pos;
+    return;
+  }
+  // Only FORWARD seeks within the carve plan keep it: every claim from
+  // pos_ to issue_next_ has either landed or will land, so coverage is
+  // contiguous. A backward seek always restarts — a landed segment below
+  // pos_ does NOT prove the bytes after it are still coming (a forward
+  // seek may have trimmed mid segments as waste while a lower in-flight
+  // range landed late; serving from that island would hang the consumer
+  // at its end, waiting for a range nobody will ever re-carve).
+  if (pos >= pos_ && pos <= issue_next_) {
+    pos_ = pos;
+    cv_work_.notify_all();
+    return;
+  }
+  // discontinuity: restart the carve plan at the new position; landed and
+  // in-flight prefetch is stale (in-flight drops on landing via the
+  // generation check)
+  ++generation_;
+  for (const auto& kv : landed_) wasted_bytes_ += kv.second.size;
+  wasted_bytes_ += inflight_bytes_;
+  landed_.clear();
+  inflight_bytes_ = 0;
+  issue_next_ = pos;
+  pos_ = pos;
+  ++discontinuities_;
+  // a seek-thrashing consumer (record-indexed shuffles) turns readahead
+  // into pure waste: once discarded prefetch outweighs delivered bytes,
+  // hand the stream to the sequential lane for good
+  if (discontinuities_ >= 8 && wasted_bytes_ > useful_bytes_) {
+    degraded_ = true;
+    cv_data_.notify_all();
+  }
+  cv_work_.notify_all();
+}
+
+size_t RangeReader::Tell() {
+  if (seq_ != nullptr) return seq_->Tell();
+  std::lock_guard<std::mutex> lk(mu_);
+  return pos_;
+}
+
+void RangeReader::HintReadBound(size_t end) {
+  if (seq_ != nullptr) return;  // plain streams ignore the hint
+  std::lock_guard<std::mutex> lk(mu_);
+  bound_ = end;
+  // a tighter bound stops future claims (in-flight ones land harmlessly);
+  // a wider one opens the carve plan back up
+  cv_work_.notify_all();
+}
+
+void RangeReader::SwitchToSequential(size_t pos) {
+  seq_.reset(seq_factory_());
+  seq_->Seek(pos);
+  std::lock_guard<std::mutex> lk(mu_);
+  landed_.clear();  // free prefetch memory; workers are exiting
+}
+
+RangeReader::Stats RangeReader::stats() {
+  std::lock_guard<std::mutex> lk(mu_);
+  Stats s;
+  s.ranges_fetched = ranges_fetched_;
+  s.range_retries = range_retries_;
+  s.discontinuities = discontinuities_;
+  s.range_bytes = range_bytes_;
+  s.concurrency = concurrency_;
+  s.degraded = degraded_ || seq_ != nullptr;
+  return s;
+}
+
+SeekStream* NewRangedOrSequential(
+    const char* backend, size_t file_size,
+    std::unique_ptr<RangeFetcher> fetcher,
+    std::function<SeekStream*()> sequential_factory, const RangeConfig& cfg,
+    const RetryPolicy& policy, int timeout_ms_override) {
+  if (!cfg.enabled || cfg.max_concurrency <= 1 ||
+      file_size < cfg.min_bytes * 2) {
+    // too small to split (or switched off): the sequential lane is strictly
+    // better — no scheduler, no extra connections
+    return sequential_factory();
+  }
+  return new RangeReader(backend, file_size, std::move(fetcher),
+                         std::move(sequential_factory), cfg, policy,
+                         timeout_ms_override);
+}
+
+}  // namespace io
+}  // namespace dct

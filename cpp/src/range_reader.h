@@ -1,0 +1,208 @@
+// Concurrent ranged-read engine for remote SeekStreams.
+//
+// Every remote backend here (s3/azure/http(s)/webhdfs) serves ranged GETs,
+// but the sequential readers consume one connection per split — so a single
+// connection's latency-bandwidth product caps ingest no matter how fast the
+// parse pipeline runs. RangeReader splits one logical stream into N
+// in-flight ranged fetches that land out of order and are handed to the
+// consumer strictly IN order (head-of-line delivery): the bytes a caller
+// sees are byte-identical to the sequential lane by construction.
+//
+// Design rules:
+//   - Each range fetch is an IDEMPOTENT one-shot riding the shared
+//     RetryPolicy (retry.h): a reset/stall/5xx retries only that range,
+//     never restarts the stream, and a non-retryable status fails the
+//     stream exactly like the sequential lane would.
+//   - An adaptive scheduler picks range size and concurrency per stream:
+//     seeded from the live per-backend io_{connect,ttfb}_us telemetry
+//     (PR 5), then AIMD on observed per-range goodput — additive range
+//     growth while setup cost still shows, multiplicative shrink when a
+//     range had to retry; concurrency ramps up on head-of-line waits and
+//     halves when a range needed 2+ retries.
+//   - Servers that ignore Range and answer 200 degrade cleanly: the reader
+//     falls back to the backend's sequential stream (which already knows
+//     how to resume-at-offset under a 200, including its tightened retry
+//     budget), sought to the current position. Seek-thrashing consumers
+//     (indexed shuffles) degrade the same way once prefetch waste
+//     outweighs delivered bytes.
+//   - DMLC_IO_RANGE=0 is the kill switch; DMLC_IO_RANGE_{MIN,MAX}_BYTES and
+//     DMLC_IO_RANGE_CONCURRENCY clamp the scheduler (checked parses), and
+//     `?io_range*=` URI args override per open (stream opens only — the
+//     parser lane configures through env, same rule as the retry knobs).
+#ifndef DCT_RANGE_READER_H_
+#define DCT_RANGE_READER_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "retry.h"
+#include "stream.h"
+#include "telemetry.h"
+
+namespace dct {
+namespace io {
+
+// ---------------------------------------------------------------- config --
+struct RangeConfig {
+  bool enabled = true;            // DMLC_IO_RANGE=0 falls back to sequential
+  size_t min_bytes = 256 << 10;   // DMLC_IO_RANGE_MIN_BYTES
+  size_t max_bytes = 4 << 20;     // DMLC_IO_RANGE_MAX_BYTES
+  int max_concurrency = 4;        // DMLC_IO_RANGE_CONCURRENCY
+
+  // Defaults <- DMLC_IO_RANGE* env (checked parses; re-read per open so
+  // tests and operators can reshape between streams).
+  static RangeConfig FromEnv();
+
+  // Consume one `io_range*` URI arg (io_range, io_range_min_bytes,
+  // io_range_max_bytes, io_range_concurrency). Returns false when the key
+  // is not a range knob. Throws on non-numeric values.
+  bool ApplyUriArg(const std::string& key, const std::string& value);
+};
+
+// Strip ALL per-open `io_*` URI args from `path`: range knobs into *rcfg,
+// then the retry/timeout family via ExtractUriRetryArgs. The one entry
+// point every remote OpenForRead calls.
+void ExtractUriIoArgs(std::string* path, RetryPolicy* policy,
+                      int* timeout_ms_override, RangeConfig* rcfg);
+
+// ---------------------------------------------------------------- fetcher --
+enum class FetchStatus {
+  kOk,        // buf holds exactly the requested bytes
+  kDegraded,  // origin ignored Range (200 full-body): fall back sequential
+};
+
+// One idempotent ranged GET per call: fetch exactly [offset, offset+len)
+// into buf on a FRESH connection. Implementations throw HttpStatusError /
+// TimeoutError / Error on failure (retryability is the caller's decision,
+// same classification as the sequential lane) and return kDegraded when
+// the origin ignored the Range request. `*progress` (never null) must
+// count the bytes already landed in buf when an exception cuts the
+// transfer mid-body: the caller's retry resumes from offset+progress —
+// the ranged twin of reconnect-at-offset — so truncation faults always
+// converge instead of refetching a range from scratch forever.
+class RangeFetcher {
+ public:
+  virtual ~RangeFetcher() = default;
+  virtual FetchStatus Fetch(size_t offset, size_t len, char* buf,
+                            size_t* progress) = 0;
+};
+
+// ----------------------------------------------------------------- reader --
+class RangeReader : public SeekStream {
+ public:
+  // `sequential_factory` builds the backend's plain reconnect-at-offset
+  // stream — the degrade target (and must inherit that lane's 200-resume
+  // budget rule). `policy` is copied; per-range RetryControllers reference
+  // the copy.
+  RangeReader(const char* backend, size_t file_size,
+              std::unique_ptr<RangeFetcher> fetcher,
+              std::function<SeekStream*()> sequential_factory,
+              const RangeConfig& cfg, const RetryPolicy& policy,
+              int timeout_ms_override);
+  ~RangeReader() override;
+
+  size_t Read(void* ptr, size_t size) override;
+  size_t Write(const void*, size_t) override;
+  void Seek(size_t pos) override;
+  size_t Tell() override;
+  // Stop carving at `end` (partitioned splits end mid-object); a read or
+  // seek reaching `end` clears the hint and carving resumes.
+  void HintReadBound(size_t end) override;
+
+  // Scheduler introspection for tests (test_core --range).
+  struct Stats {
+    uint64_t ranges_fetched = 0;
+    uint64_t range_retries = 0;
+    uint64_t discontinuities = 0;
+    size_t range_bytes = 0;
+    int concurrency = 0;
+    bool degraded = false;
+  };
+  Stats stats();
+
+ private:
+  struct Segment {
+    // raw buffer, NOT std::string: a string resize would zero-fill every
+    // range buffer just for the fetch to overwrite it
+    std::unique_ptr<char[]> data;
+    size_t size = 0;
+  };
+
+  void StartWorkersLocked() DMLC_REQUIRES(mu_);
+  void WorkerLoop(int id);
+  bool ShouldExitLocked() const DMLC_REQUIRES(mu_);
+  bool WantWorkLocked(int id) const DMLC_REQUIRES(mu_);
+  size_t CarveEndLocked() const DMLC_REQUIRES(mu_);
+  bool HeadReadyLocked() const DMLC_REQUIRES(mu_);
+  void TrimConsumedLocked() DMLC_REQUIRES(mu_);
+  void AdaptAfterRangeLocked(size_t len, uint64_t elapsed_us,
+                             int retries) DMLC_REQUIRES(mu_);
+  // consumer-side: build the sequential fallback at the current position
+  // (called outside mu_ — the factory may do network I/O)
+  void SwitchToSequential(size_t pos);
+
+  const std::string backend_;
+  const size_t file_size_;
+  std::unique_ptr<RangeFetcher> fetcher_;
+  std::function<SeekStream*()> seq_factory_;
+  const RangeConfig cfg_;
+  const RetryPolicy policy_;  // stable: per-range controllers reference it
+  const int timeout_ms_override_;
+  const telemetry::RangeHists* hists_;
+
+  // Degraded lane: all calls delegate here once set (consumer thread only;
+  // set before any further reads, never cleared).
+  std::unique_ptr<SeekStream> seq_;
+
+  std::mutex mu_;
+  std::condition_variable cv_work_;  // workers: credit / window / shutdown
+  std::condition_variable cv_data_;  // consumer: head segment / error
+
+  // -- scheduler state ------------------------------------------------------
+  std::map<size_t, Segment> landed_ DMLC_GUARDED_BY(mu_);  // by start offset
+  size_t issue_next_ DMLC_GUARDED_BY(mu_) = 0;  // next offset to carve
+  // HintReadBound: carve no further (cleared when the consumer crosses it)
+  size_t bound_ DMLC_GUARDED_BY(mu_) = static_cast<size_t>(-1);
+  size_t inflight_bytes_ DMLC_GUARDED_BY(mu_) = 0;
+  size_t pos_ DMLC_GUARDED_BY(mu_) = 0;         // consumer position
+  uint64_t generation_ DMLC_GUARDED_BY(mu_) = 0;  // bumped on plan restarts
+  size_t range_bytes_ DMLC_GUARDED_BY(mu_);     // current range size
+  int concurrency_ DMLC_GUARDED_BY(mu_);        // current worker credit
+  double ewma_goodput_ DMLC_GUARDED_BY(mu_) = 0.0;  // bytes/us, smoothed
+  bool degraded_ DMLC_GUARDED_BY(mu_) = false;
+  bool started_ DMLC_GUARDED_BY(mu_) = false;
+  std::exception_ptr error_ DMLC_GUARDED_BY(mu_);
+  // atomic, not guarded: workers poll it between retry attempts AND it is
+  // handed to BackoffOrGiveUp as the abort flag, so destruction cuts even
+  // a late-ladder multi-second backoff sleep short (~100 ms granularity)
+  std::atomic<bool> shutdown_{false};
+  uint64_t ranges_fetched_ DMLC_GUARDED_BY(mu_) = 0;
+  uint64_t range_retries_ DMLC_GUARDED_BY(mu_) = 0;
+  uint64_t discontinuities_ DMLC_GUARDED_BY(mu_) = 0;
+  uint64_t wasted_bytes_ DMLC_GUARDED_BY(mu_) = 0;
+  uint64_t useful_bytes_ DMLC_GUARDED_BY(mu_) = 0;
+
+  std::vector<std::thread> workers_;  // filled under mu_; joined post-
+                                      // shutdown in the dtor
+};
+
+// Open-time decision: a RangeReader when the ranged lane is enabled and the
+// object is big enough to split (>= 2 min-size ranges and more than one
+// worker allowed), else the sequential stream directly.
+SeekStream* NewRangedOrSequential(
+    const char* backend, size_t file_size,
+    std::unique_ptr<RangeFetcher> fetcher,
+    std::function<SeekStream*()> sequential_factory, const RangeConfig& cfg,
+    const RetryPolicy& policy, int timeout_ms_override);
+
+}  // namespace io
+}  // namespace dct
+
+#endif  // DCT_RANGE_READER_H_

@@ -95,7 +95,8 @@ bool RetryPolicy::ApplyUriArg(const std::string& key,
 }
 
 void ExtractUriRetryArgs(std::string* path, RetryPolicy* policy,
-                         int* timeout_ms_override) {
+                         int* timeout_ms_override,
+                         const UriArgConsumer& extra_arg) {
   size_t q = path->find('?');
   if (q == std::string::npos) return;
   std::string query = path->substr(q + 1);
@@ -121,10 +122,15 @@ void ExtractUriRetryArgs(std::string* path, RetryPolicy* policy,
         consumed = true;
       } else if (key.compare(0, 3, "io_") == 0) {
         consumed = policy->ApplyUriArg(key, val);
+        if (!consumed && extra_arg != nullptr) {
+          consumed = extra_arg(key, val);
+        }
         if (!consumed) {
           throw Error("unknown io_* retry uri arg `" + key +
                       "` (known: io_max_retry, io_backoff_base_ms, "
-                      "io_backoff_cap_ms, io_deadline_ms, io_timeout_ms)");
+                      "io_backoff_cap_ms, io_deadline_ms, io_timeout_ms, "
+                      "io_range, io_range_min_bytes, io_range_max_bytes, "
+                      "io_range_concurrency)");
         }
       }
       if (!consumed) {
@@ -151,7 +157,7 @@ int64_t RetryController::elapsed_ms() const {
       .count();
 }
 
-bool RetryController::BackoffOrGiveUp() {
+bool RetryController::BackoffOrGiveUp(const std::atomic<bool>* abort) {
   IoStats& st = GlobalIoStats();
   ++attempts_;
   if (attempts_ > policy_.max_retry) {
@@ -184,9 +190,22 @@ bool RetryController::BackoffOrGiveUp() {
     sleep_ms = std::min(sleep_ms, policy_.deadline_ms - elapsed);
   }
   if (sleep_ms > 0) {
-    std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
-    st.backoff_ms_total.fetch_add(static_cast<uint64_t>(sleep_ms),
+    // sliced sleep so an owner's shutdown flag cuts a late-ladder backoff
+    // short instead of being waited out (~100 ms teardown granularity)
+    int64_t slept = 0;
+    while (slept < sleep_ms) {
+      if (abort != nullptr && abort->load(std::memory_order_relaxed)) break;
+      const int64_t slice =
+          abort != nullptr ? std::min<int64_t>(100, sleep_ms - slept)
+                           : sleep_ms - slept;
+      std::this_thread::sleep_for(std::chrono::milliseconds(slice));
+      slept += slice;
+    }
+    st.backoff_ms_total.fetch_add(static_cast<uint64_t>(slept),
                                   std::memory_order_relaxed);
+  }
+  if (abort != nullptr && abort->load(std::memory_order_relaxed)) {
+    return false;  // shutdown, not exhaustion: no giveup recorded
   }
   st.retries.fetch_add(1, std::memory_order_relaxed);
   return true;

@@ -32,6 +32,7 @@
 #include <atomic>
 #include <chrono>
 #include <cstdint>
+#include <functional>
 #include <random>
 #include <string>
 
@@ -94,9 +95,15 @@ struct RetryPolicy {
 // io_timeout_ms -> policy handling in the stream). Non-io_* args and paths
 // without a query are left untouched; the '?' is dropped when the query
 // empties. Backends call this at Open/OpenForRead entry so the remaining
-// path is the real object key.
+// path is the real object key. `extra_arg` lets another io_* knob family
+// (the range knobs, range_reader.h) ride the SAME tokenizer: it is
+// offered every io_* key the retry family does not consume; returning
+// false falls through to the unknown-knob error.
+using UriArgConsumer =
+    std::function<bool(const std::string& key, const std::string& value)>;
 void ExtractUriRetryArgs(std::string* path, RetryPolicy* policy,
-                         int* timeout_ms_override);
+                         int* timeout_ms_override,
+                         const UriArgConsumer& extra_arg = nullptr);
 
 // --------------------------------------------------------------- runtime --
 // Holds a REFERENCE to its policy (which must outlive it): Connect()
@@ -110,7 +117,11 @@ class RetryController {
   // Call after a retryable failure. Sleeps the next jittered backoff and
   // returns true, or returns false (recording the giveup) when the retry
   // count or the deadline budget is exhausted — the caller then rethrows.
-  bool BackoffOrGiveUp();
+  // `abort` (optional) is polled during the sleep (~100 ms granularity):
+  // when it flips, the sleep is cut short and false is returned WITHOUT
+  // counting a giveup — a shutting-down owner must not wait out a whole
+  // late-ladder backoff (range_reader.h worker teardown).
+  bool BackoffOrGiveUp(const std::atomic<bool>* abort = nullptr);
 
   int attempts() const { return attempts_; }
   int64_t elapsed_ms() const;

@@ -13,6 +13,7 @@
 #include "http.h"
 #include "http_stream.h"
 #include "listing.h"
+#include "range_reader.h"
 #include "sha256.h"
 
 namespace dct {
@@ -266,8 +267,53 @@ class S3ReadStream : public RetryingHttpReadStream {
                                 std::to_string(status) + ": " + head.body,
                             status);
     }
+    if (head.status == 206) {
+      // misaligned Content-Range must retry, never splice silently
+      CheckContentRangeStart(head, pos_, "s3", uri_.Str());
+    }
   }
 
+  S3Config cfg_;
+  URI uri_;
+  std::string bucket_, key_;
+  Target target_;
+};
+
+// One idempotent bounded ranged GET per call (range_reader.h): each fetch
+// signs its own request (fresh SIG4 headers + fresh connection), asks for
+// `Range: bytes=a-b`, and verifies the 206's Content-Range offset. A 200
+// means the endpoint ignored Range — degrade to the sequential lane.
+class S3RangeFetcher : public io::RangeFetcher {
+ public:
+  S3RangeFetcher(const S3Config& cfg, const URI& uri) : cfg_(cfg), uri_(uri) {
+    SplitBucketKey(uri, &bucket_, &key_);
+    target_ = ResolveTarget(cfg_, bucket_);
+  }
+
+  io::FetchStatus Fetch(size_t off, size_t len, char* buf,
+                        size_t* progress) override {
+    std::string path = target_.base_path + key_;
+    auto headers = SignedHeaders(cfg_, target_, "GET", path, {}, kUnsigned);
+    headers["Range"] = RangeHeader(off, len);
+    HttpConnection conn(RouteOf(cfg_, target_));
+    conn.SendRequest("GET", s3::UriEncode(path, true), headers, "");
+    HttpResponse head;
+    conn.ReadResponseHead(&head);
+    if (head.status == 200) return io::FetchStatus::kDegraded;
+    if (head.status != 206) {
+      conn.ReadFullBody(&head);
+      throw HttpStatusError("s3 ranged GET " + uri_.Str() +
+                                " failed with status " +
+                                std::to_string(head.status) + ": " +
+                                head.body,
+                            head.status);
+    }
+    CheckContentRangeStart(head, off, "s3", uri_.Str());
+    ReadRangeBody(&conn, buf, len, "s3", uri_.Str(), progress);
+    return io::FetchStatus::kOk;
+  }
+
+ private:
   S3Config cfg_;
   URI uri_;
   std::string bucket_, key_;
@@ -574,8 +620,9 @@ SeekStream* S3FileSystem::OpenForRead(const URI& path, bool allow_null) {
   // stripped path is the real object key
   URI clean = path;
   io::RetryPolicy policy = config_.retry;
+  io::RangeConfig rcfg = io::RangeConfig::FromEnv();
   int timeout_ms = 0;
-  io::ExtractUriRetryArgs(&clean.path, &policy, &timeout_ms);
+  io::ExtractUriIoArgs(&clean.path, &policy, &timeout_ms, &rcfg);
   // the per-open socket-timeout override must bind the open-time metadata
   // probe too, or a stalled endpoint holds `open` for the global 60 s
   // despite the URI asking for less
@@ -584,8 +631,14 @@ SeekStream* S3FileSystem::OpenForRead(const URI& path, bool allow_null) {
     FileInfo info = PathInfoUnderPolicy(clean, policy);
     DCT_CHECK(info.type == FileType::kFile)
         << "cannot open s3 directory for read: " << clean.Str();
-    return new s3::S3ReadStream(config_, clean, info.size, policy,
-                                timeout_ms);
+    const S3Config cfg = config_;
+    const size_t size = info.size;
+    return io::NewRangedOrSequential(
+        "s3", size, std::make_unique<s3::S3RangeFetcher>(cfg, clean),
+        [cfg, clean, size, policy, timeout_ms]() -> SeekStream* {
+          return new s3::S3ReadStream(cfg, clean, size, policy, timeout_ms);
+        },
+        rcfg, policy, timeout_ms);
   } catch (const Error&) {
     if (allow_null) return nullptr;
     throw;

@@ -54,6 +54,14 @@ class SeekStream : public Stream {
  public:
   virtual void Seek(size_t pos) = 0;
   virtual size_t Tell() = 0;
+  // Prefetch hint: the caller does not expect to read at or past `end`
+  // (absolute offset) until further notice — a partitioned split stops at
+  // its partition edge, not at EOF. Readahead implementations
+  // (range_reader.h) stop carving there instead of prefetching a whole
+  // window past the last byte the consumer will ever ask for; a read or
+  // seek that reaches `end` anyway clears the hint and resumes. Plain
+  // streams ignore it.
+  virtual void HintReadBound(size_t end) { (void)end; }
   static SeekStream* CreateForRead(const std::string& uri,
                                    bool allow_null = false);
 };

@@ -188,6 +188,22 @@ const IoHists* IoHistsFor(const std::string& backend) {
   return &((*cache)[backend] = h);
 }
 
+const RangeHists* RangeHistsFor(const std::string& backend) {
+  // same shape as IoHistsFor: one leaked per-backend cache, resolved once
+  // per RangeReader construction (never per range)
+  static std::mutex* mu = new std::mutex();
+  static std::map<std::string, RangeHists>* cache =
+      new std::map<std::string, RangeHists>();
+  std::lock_guard<std::mutex> lk(*mu);
+  auto it = cache->find(backend);
+  if (it != cache->end()) return &it->second;
+  std::map<std::string, std::string> labels{{"backend", backend}};
+  RangeHists h;
+  h.bytes = GetHist("io_range_bytes", labels);
+  h.wait_us = GetHist("io_range_wait_us", labels);
+  return &((*cache)[backend] = h);
+}
+
 std::string SnapshotJson() {
   Registry& r = Reg();
   std::string out;

@@ -136,6 +136,15 @@ struct IoHists {
 };
 const IoHists* IoHistsFor(const std::string& backend);
 
+// Ranged-read scheduler histograms (range_reader.h), labeled {backend=}:
+// completed range sizes in bytes and the consumer's head-of-line wait.
+// Resolved once per RangeReader, cached per backend like IoHistsFor.
+struct RangeHists {
+  Hist* bytes;
+  Hist* wait_us;
+};
+const RangeHists* RangeHistsFor(const std::string& backend);
+
 // ----------------------------------------------------------------- timing --
 inline uint64_t NowUs() {
   return static_cast<uint64_t>(

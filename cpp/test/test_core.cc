@@ -43,6 +43,8 @@
 #include "../src/parser.h"
 #include "../src/recordio.h"
 #include "../src/http.h"
+#include "../src/http_stream.h"
+#include "../src/range_reader.h"
 #include "../src/registry.h"
 #include "../src/retry.h"
 #include "../src/s3_filesys.h"
@@ -2254,6 +2256,477 @@ void TestShardCacheKeyText() {
   EXPECT(threw);
 }
 
+// ---- concurrent ranged-read engine (range_reader.h) -- `--range` suite ---
+// Run standalone (test_core --range) by the cpp/Makefile asan-range /
+// tsan-range lanes: N worker threads racing claims/deposits against the
+// consumer (and its seeks) is exactly where ordering or shutdown bugs
+// would hide. The fetcher here is in-memory — no sockets — so every case
+// is deterministic; the live-backend coverage is tests/test_io_ranged.py.
+
+std::string RangePseudoPayload(size_t n, uint32_t seed) {
+  std::string s(n, '\0');
+  uint64_t x = seed * 2654435761ULL + 1;
+  for (size_t i = 0; i < n; ++i) {
+    x = x * 6364136223846793005ULL + 1442695040888963407ULL;
+    s[i] = static_cast<char>(x >> 56);
+  }
+  return s;
+}
+
+class ScriptedRangeFetcher : public dct::io::RangeFetcher {
+ public:
+  explicit ScriptedRangeFetcher(std::string payload)
+      : payload_(std::move(payload)) {}
+
+  std::atomic<int> fetches{0};
+  // runs before the copy; may throw or return kDegraded
+  std::function<dct::io::FetchStatus(size_t off, size_t len, int nth)> hook;
+
+  dct::io::FetchStatus Fetch(size_t off, size_t len, char* buf,
+                             size_t* progress) override {
+    int nth = ++fetches;
+    if (hook) {
+      dct::io::FetchStatus st = hook(off, len, nth);
+      if (st != dct::io::FetchStatus::kOk) return st;
+    }
+    EXPECT(off + len <= payload_.size());
+    std::memcpy(buf, payload_.data() + off, len);
+    *progress = len;
+    return dct::io::FetchStatus::kOk;
+  }
+
+ private:
+  std::string payload_;
+};
+
+dct::io::RetryPolicy RangeFastPolicy() {
+  dct::io::RetryPolicy p;
+  p.max_retry = 8;
+  p.backoff_base_ms = 1;
+  p.backoff_cap_ms = 2;
+  p.deadline_ms = 0;
+  p.jitter_seed = 7;
+  return p;
+}
+
+dct::io::RangeConfig RangeSmallCfg() {
+  dct::io::RangeConfig c;
+  c.enabled = true;
+  c.min_bytes = 8 << 10;
+  c.max_bytes = 64 << 10;
+  c.max_concurrency = 4;
+  return c;
+}
+
+std::string RangeReadAll(dct::SeekStream* s, size_t chunk = 37 * 1024) {
+  std::string out;
+  std::vector<char> buf(chunk);
+  while (true) {
+    size_t n = s->Read(buf.data(), buf.size());
+    if (n == 0) break;
+    out.append(buf.data(), n);
+  }
+  return out;
+}
+
+dct::SeekStream* RangeNeverSequential() {
+  // tests that must not degrade hand this factory in: calling it is a bug
+  EXPECT(false);
+  return new dct::MemoryStream(std::string());
+}
+
+void TestRangeConfigEnvAndUriArgs() {
+  setenv("DMLC_IO_RANGE", "0", 1);
+  setenv("DMLC_IO_RANGE_MIN_BYTES", "8192", 1);
+  setenv("DMLC_IO_RANGE_MAX_BYTES", "4096", 1);  // < min: normalized up
+  setenv("DMLC_IO_RANGE_CONCURRENCY", "3", 1);
+  dct::io::RangeConfig c = dct::io::RangeConfig::FromEnv();
+  EXPECT(!c.enabled);
+  EXPECT(c.min_bytes == 8192);
+  EXPECT(c.max_bytes == 8192);
+  EXPECT(c.max_concurrency == 3);
+  setenv("DMLC_IO_RANGE_MIN_BYTES", "banana", 1);
+  bool threw = false;
+  try {
+    dct::io::RangeConfig::FromEnv();
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  EXPECT(threw);  // typo'd knob errors, never silently defaults
+  unsetenv("DMLC_IO_RANGE");
+  unsetenv("DMLC_IO_RANGE_MIN_BYTES");
+  unsetenv("DMLC_IO_RANGE_MAX_BYTES");
+  unsetenv("DMLC_IO_RANGE_CONCURRENCY");
+
+  // per-open URI args: range family peeled, retry family still applied,
+  // non-io args survive
+  std::string path =
+      "/obj?io_range=0&io_range_min_bytes=16384&foo=1&io_max_retry=2";
+  dct::io::RetryPolicy p;
+  dct::io::RangeConfig rc;
+  int tmo = 0;
+  dct::io::ExtractUriIoArgs(&path, &p, &tmo, &rc);
+  EXPECT(path == "/obj?foo=1");
+  EXPECT(!rc.enabled);
+  EXPECT(rc.min_bytes == 16384);
+  EXPECT(p.max_retry == 2);
+
+  threw = false;
+  try {
+    std::string bad = "/o?io_range_concurrency=banana";
+    dct::io::ExtractUriIoArgs(&bad, &p, &tmo, &rc);
+  } catch (const dct::Error&) {
+    threw = true;
+  }
+  EXPECT(threw);
+
+  threw = false;
+  try {
+    std::string bad = "/o?io_rang=1";  // typo'd io_* arg: loud error
+    dct::io::ExtractUriIoArgs(&bad, &p, &tmo, &rc);
+  } catch (const dct::Error& e) {
+    threw = std::string(e.what()).find("io_range") != std::string::npos;
+  }
+  EXPECT(threw);
+}
+
+void TestContentRangeHelpers() {
+  EXPECT(dct::RangeHeader(0, 10) == "bytes=0-9");
+  EXPECT(dct::RangeHeader(4096, 4096) == "bytes=4096-8191");
+  dct::HttpResponse h;
+  EXPECT(dct::ContentRangeStart(h) == -1);  // absent: tolerated
+  h.headers["content-range"] = "bytes 100-199/500";
+  EXPECT(dct::ContentRangeStart(h) == 100);
+  dct::CheckContentRangeStart(h, 100, "http", "x");  // aligned: fine
+  bool threw = false;
+  try {
+    dct::CheckContentRangeStart(h, 50, "http", "x");
+  } catch (const dct::Error&) {
+    threw = true;  // misaligned: retryable error, never a silent splice
+  }
+  EXPECT(threw);
+}
+
+void TestRangeReaderByteIdentical() {
+  const std::string payload = RangePseudoPayload(1 << 20, 3);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  // stagger fetch latency by offset so completions land out of order —
+  // head-of-line delivery must still be byte-identical
+  f->hook = [](size_t off, size_t, int) {
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds((off / (8 << 10)) % 3));
+    return dct::io::FetchStatus::kOk;
+  };
+  dct::io::RangeReader r("rangetest", payload.size(), std::move(f),
+                         &RangeNeverSequential, RangeSmallCfg(),
+                         RangeFastPolicy(), 0);
+  EXPECT(RangeReadAll(&r) == payload);
+  dct::io::RangeReader::Stats st = r.stats();
+  EXPECT(st.ranges_fetched >= 2);
+  EXPECT(!st.degraded);
+}
+
+void TestRangeReaderPerRangeRetryIsolation() {
+  const std::string payload = RangePseudoPayload(256 << 10, 4);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  ScriptedRangeFetcher* fp = f.get();
+  std::atomic<int> faults{0};
+  f->hook = [&faults](size_t off, size_t, int) -> dct::io::FetchStatus {
+    if (off == (16 << 10) && faults.fetch_add(1) == 0) {
+      throw dct::Error("injected mid-range fault");
+    }
+    return dct::io::FetchStatus::kOk;
+  };
+  dct::io::RangeConfig cfg;
+  cfg.min_bytes = 16 << 10;
+  cfg.max_bytes = 16 << 10;  // fixed 16K ranges: exactly 16 over 256K
+  cfg.max_concurrency = 2;
+  dct::io::RangeReader r("rangetest", payload.size(), std::move(f),
+                         &RangeNeverSequential, cfg, RangeFastPolicy(), 0);
+  EXPECT(RangeReadAll(&r) == payload);
+  dct::io::RangeReader::Stats st = r.stats();
+  EXPECT(st.range_retries == 1);   // only the faulted range retried
+  EXPECT(fp->fetches.load() == 17);  // 16 ranges + 1 refetch, no restart
+  EXPECT(!st.degraded);
+}
+
+void TestRangeReaderMidRangeTruncationResumes() {
+  const std::string payload = RangePseudoPayload(128 << 10, 10);
+  // every fetch delivers HALF of what was asked, then dies — the retry
+  // must resume WITHIN the range (offset+progress); refetch-from-scratch
+  // would never converge against this server shape
+  class HalfFetcher : public dct::io::RangeFetcher {
+   public:
+    explicit HalfFetcher(const std::string& p) : p_(p) {}
+    std::atomic<int> fetches{0};
+    dct::io::FetchStatus Fetch(size_t off, size_t len, char* buf,
+                               size_t* progress) override {
+      ++fetches;
+      if (len <= 512) {
+        std::memcpy(buf, p_.data() + off, len);
+        *progress = len;
+        return dct::io::FetchStatus::kOk;
+      }
+      const size_t half = len / 2;
+      std::memcpy(buf, p_.data() + off, half);
+      *progress = half;
+      throw dct::Error("mid-range truncation");
+    }
+
+   private:
+    const std::string& p_;
+  };
+  auto f = std::make_unique<HalfFetcher>(payload);
+  dct::io::RangeConfig cfg;
+  cfg.min_bytes = 16 << 10;
+  cfg.max_bytes = 16 << 10;
+  cfg.max_concurrency = 2;
+  dct::io::RangeReader r("rangetest", payload.size(), std::move(f),
+                         &RangeNeverSequential, cfg, RangeFastPolicy(), 0);
+  EXPECT(RangeReadAll(&r) == payload);
+  dct::io::RangeReader::Stats st = r.stats();
+  EXPECT(st.range_retries > 0);
+  EXPECT(!st.degraded);
+}
+
+void TestRangeReaderDegradeTo200Fallback() {
+  const std::string payload = RangePseudoPayload(200 << 10, 5);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  f->hook = [](size_t off, size_t, int) {
+    // the origin answers 200 (ignores Range) for any non-zero offset
+    return off > 0 ? dct::io::FetchStatus::kDegraded
+                   : dct::io::FetchStatus::kOk;
+  };
+  // the fallback stands in for the backend's sequential stream (which
+  // inherits the 200-resume budget rule by construction)
+  dct::io::RangeReader r(
+      "rangetest", payload.size(), std::move(f),
+      [payload]() -> dct::SeekStream* {
+        return new dct::MemoryStream(payload);
+      },
+      RangeSmallCfg(), RangeFastPolicy(), 0);
+  EXPECT(RangeReadAll(&r) == payload);
+  EXPECT(r.stats().degraded);
+}
+
+void TestRangeReaderSeekReset() {
+  const std::string payload = RangePseudoPayload(512 << 10, 6);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  dct::io::RangeConfig cfg;
+  cfg.min_bytes = 16 << 10;
+  cfg.max_bytes = 32 << 10;
+  cfg.max_concurrency = 3;
+  dct::io::RangeReader r("rangetest", payload.size(), std::move(f),
+                         &RangeNeverSequential, cfg, RangeFastPolicy(), 0);
+  std::vector<char> buf(20000);
+  size_t n = r.Read(buf.data(), 10000);
+  EXPECT(n > 0);
+  EXPECT(std::memcmp(buf.data(), payload.data(), n) == 0);
+  r.Seek(300000);  // forward past the readahead window: plan restart
+  EXPECT(r.Tell() == 300000);
+  size_t m = r.Read(buf.data(), 5000);
+  EXPECT(m > 0);
+  EXPECT(std::memcmp(buf.data(), payload.data() + 300000, m) == 0);
+  r.Seek(100);  // backward: plan restart again
+  std::string tail = RangeReadAll(&r);
+  EXPECT(tail == payload.substr(100));
+  EXPECT(r.stats().discontinuities >= 1);
+}
+
+void TestRangeReaderBackwardSeekIntoLateLanding() {
+  // regression: forward-seek past an IN-FLIGHT low range, read (trimming
+  // the landed mids as waste), let the low range land late, then seek
+  // BACKWARD into it. Treating that island as "within plan" would serve
+  // its bytes and then hang forever at its end — the mid ranges were
+  // trimmed and nobody re-carves them. A backward seek must restart.
+  const std::string payload = RangePseudoPayload(512 << 10, 12);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  std::atomic<int> slow_hits{0};
+  f->hook = [&slow_hits](size_t off, size_t, int) {
+    if (off == (64 << 10) && slow_hits.fetch_add(1) == 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(300));
+    }
+    return dct::io::FetchStatus::kOk;
+  };
+  dct::io::RangeConfig cfg;
+  cfg.min_bytes = 64 << 10;
+  cfg.max_bytes = 64 << 10;
+  cfg.max_concurrency = 4;
+  dct::io::RangeReader r("rangetest", payload.size(), std::move(f),
+                         &RangeNeverSequential, cfg, RangeFastPolicy(), 0);
+  std::vector<char> buf(1024);
+  EXPECT(r.Read(buf.data(), buf.size()) > 0);   // range [0,64K) serves
+  r.Seek(200 << 10);  // forward past the slow in-flight [64K,128K) range
+  EXPECT(r.Read(buf.data(), buf.size()) > 0);   // trims the landed mids
+  std::this_thread::sleep_for(std::chrono::milliseconds(400));
+  r.Seek(80 << 10);   // backward INTO the late-landed island
+  std::string rest = RangeReadAll(&r);          // must not hang
+  EXPECT(rest == payload.substr(80 << 10));
+  // the backward seek restarted the plan (the forward one may or may not
+  // have, depending on how far the carve frontier had run)
+  EXPECT(r.stats().discontinuities >= 1);
+}
+
+void TestRangeReaderReadBoundLimitsCarve() {
+  // a partitioned split reads only to its partition edge: with a
+  // HintReadBound the engine must not prefetch a readahead window past
+  // it (the boundary-waste shape), yet reads beyond must still work
+  const std::string payload = RangePseudoPayload(1 << 20, 11);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  ScriptedRangeFetcher* fp = f.get();
+  dct::io::RangeConfig cfg;
+  cfg.min_bytes = 64 << 10;
+  cfg.max_bytes = 64 << 10;  // fixed 64K ranges
+  cfg.max_concurrency = 4;
+  dct::io::RangeReader r("rangetest", payload.size(), std::move(f),
+                         &RangeNeverSequential, cfg, RangeFastPolicy(), 0);
+  const size_t bound = 256 << 10;  // "partition edge" at 256K = 4 ranges
+  r.HintReadBound(bound);
+  std::string got;
+  std::vector<char> buf(32 << 10);
+  while (got.size() < bound) {
+    size_t n = r.Read(buf.data(),
+                      std::min(buf.size(), bound - got.size()));
+    EXPECT(n > 0);
+    got.append(buf.data(), n);
+  }
+  EXPECT(got == payload.substr(0, bound));
+  // give any (wrongly) carved extra range time to land, then check: only
+  // the 4 in-bound ranges were ever fetched
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  EXPECT(fp->fetches.load() == 4);
+  // reading past the hint clears it and carving resumes
+  std::string rest = RangeReadAll(&r);
+  EXPECT(rest == payload.substr(bound));
+  EXPECT(fp->fetches.load() == 16);
+}
+
+void TestRangeReaderShutdownMidFlight() {
+  const std::string payload = RangePseudoPayload(256 << 10, 7);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  f->hook = [](size_t, size_t, int) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(150));
+    return dct::io::FetchStatus::kOk;
+  };
+  dct::io::RangeConfig cfg = RangeSmallCfg();
+  auto* r = new dct::io::RangeReader("rangetest", payload.size(),
+                                     std::move(f), &RangeNeverSequential,
+                                     cfg, RangeFastPolicy(), 0);
+  char b[1024];
+  size_t n = r->Read(b, sizeof(b));  // starts workers, waits for the head
+  EXPECT(n > 0);
+  auto t0 = std::chrono::steady_clock::now();
+  delete r;  // several fetches in flight: must join promptly, not hang
+  auto dtor_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+  EXPECT(dtor_ms < 2000);
+}
+
+void TestRangeReaderShutdownInterruptsBackoff() {
+  // a worker parked in a multi-second late-ladder backoff must notice
+  // shutdown within the ~100 ms slice, not wait the sleep out — stream
+  // teardown (parser close, next file) happens on the consumer's clock
+  const std::string payload = RangePseudoPayload(256 << 10, 13);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  f->hook = [](size_t off, size_t, int) -> dct::io::FetchStatus {
+    if (off >= (64 << 10)) throw dct::Error("always-failing tail");
+    return dct::io::FetchStatus::kOk;
+  };
+  dct::io::RetryPolicy p = RangeFastPolicy();
+  p.backoff_base_ms = 3000;  // workers park in 3-6 s sleeps
+  p.backoff_cap_ms = 6000;
+  p.max_retry = 50;
+  dct::io::RangeConfig cfg;
+  cfg.min_bytes = 64 << 10;
+  cfg.max_bytes = 64 << 10;
+  cfg.max_concurrency = 3;
+  auto* r = new dct::io::RangeReader("rangetest", payload.size(),
+                                     std::move(f), &RangeNeverSequential,
+                                     cfg, p, 0);
+  char b[1024];
+  EXPECT(r->Read(b, sizeof(b)) > 0);  // head range fine; tail retrying
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  auto t0 = std::chrono::steady_clock::now();
+  delete r;
+  auto dtor_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+  EXPECT(dtor_ms < 1500);
+}
+
+void TestRangeReaderNonRetryableFails() {
+  const std::string payload = RangePseudoPayload(64 << 10, 8);
+  auto f = std::make_unique<ScriptedRangeFetcher>(payload);
+  f->hook = [](size_t, size_t, int) -> dct::io::FetchStatus {
+    throw dct::HttpStatusError("gone", 404);
+  };
+  dct::io::RangeConfig cfg;
+  cfg.min_bytes = 8 << 10;
+  cfg.max_bytes = 8 << 10;
+  cfg.max_concurrency = 2;
+  dct::io::RangeReader r("rangetest", payload.size(), std::move(f),
+                         &RangeNeverSequential, cfg, RangeFastPolicy(), 0);
+  bool threw = false;
+  try {
+    char b[1024];
+    r.Read(b, sizeof(b));
+  } catch (const dct::HttpStatusError& e) {
+    threw = e.status == 404;
+  }
+  EXPECT(threw);  // definitive statuses fail fast, exactly like sequential
+  EXPECT(r.stats().range_retries == 0);
+}
+
+void TestNewRangedOrSequentialGate() {
+  const std::string payload = RangePseudoPayload(64 << 10, 9);
+  dct::io::RangeConfig cfg;
+  cfg.min_bytes = 64 << 10;  // file < 2 ranges: sequential wins
+  cfg.max_bytes = 64 << 10;
+  cfg.max_concurrency = 4;
+  auto seq = [payload]() -> dct::SeekStream* {
+    return new dct::MemoryStream(payload);
+  };
+  std::unique_ptr<dct::SeekStream> small(dct::io::NewRangedOrSequential(
+      "rangetest", payload.size(),
+      std::make_unique<ScriptedRangeFetcher>(payload), seq, cfg,
+      RangeFastPolicy(), 0));
+  EXPECT(dynamic_cast<dct::io::RangeReader*>(small.get()) == nullptr);
+  EXPECT(RangeReadAll(small.get()) == payload);
+
+  cfg.min_bytes = 8 << 10;  // big enough now, but the kill switch is off
+  cfg.enabled = false;
+  std::unique_ptr<dct::SeekStream> killed(dct::io::NewRangedOrSequential(
+      "rangetest", payload.size(),
+      std::make_unique<ScriptedRangeFetcher>(payload), seq, cfg,
+      RangeFastPolicy(), 0));
+  EXPECT(dynamic_cast<dct::io::RangeReader*>(killed.get()) == nullptr);
+
+  cfg.enabled = true;
+  std::unique_ptr<dct::SeekStream> ranged(dct::io::NewRangedOrSequential(
+      "rangetest", payload.size(),
+      std::make_unique<ScriptedRangeFetcher>(payload), seq, cfg,
+      RangeFastPolicy(), 0));
+  EXPECT(dynamic_cast<dct::io::RangeReader*>(ranged.get()) != nullptr);
+  EXPECT(RangeReadAll(ranged.get()) == payload);
+}
+
+void RunRangeReaderSuite() {
+  TestRangeConfigEnvAndUriArgs();
+  TestContentRangeHelpers();
+  TestRangeReaderByteIdentical();
+  TestRangeReaderPerRangeRetryIsolation();
+  TestRangeReaderMidRangeTruncationResumes();
+  TestRangeReaderDegradeTo200Fallback();
+  TestRangeReaderSeekReset();
+  TestRangeReaderBackwardSeekIntoLateLanding();
+  TestRangeReaderReadBoundLimitsCarve();
+  TestRangeReaderShutdownMidFlight();
+  TestRangeReaderShutdownInterruptsBackoff();
+  TestRangeReaderNonRetryableFails();
+  TestNewRangedOrSequentialGate();
+}
+
 // ---- deterministic shard-cache fuzz driver (--fuzz-shard) ----------------
 // Seeded mutation of the published shard + manifest bytes: every mutated
 // unit must either be rejected as a clean validation MISS or open into a
@@ -2433,6 +2906,18 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;
   }
+  if (argc > 1 && std::string(argv[1]) == "--range") {
+    // the concurrent ranged-read suite alone — the cpp/Makefile
+    // asan-range / tsan-range lanes run exactly this under sanitizers
+    // (worker claims/deposits racing the consumer and its seeks)
+    RunRangeReaderSuite();
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
   if (argc > 1 && std::string(argv[1]) == "--parse") {
     // the SIMD text-ingest suite alone — the cpp/Makefile asan-parse /
     // tsan-parse lanes run exactly this under sanitizers, with
@@ -2515,6 +3000,7 @@ int main(int argc, char** argv) {
   TestThreadedRecParse();
   RunParseSimdSuite();
   RunIoResilienceSuite();
+  RunRangeReaderSuite();
   RunTelemetrySuite();
   RunShardCacheSuite();
   if (g_failures == 0) {

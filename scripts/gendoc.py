@@ -257,6 +257,11 @@ def gen_index() -> str:
         "manifest keying, shard format, mmap zero-copy replay, "
         "never/auto/refresh knobs, failure semantics, elastic "
         "interaction |",
+        "| [io-ranged.md](io-ranged.md) | parallel ranged remote reads: "
+        "the concurrent range-reader engine, AIMD readahead scheduler "
+        "(telemetry-seeded range size + concurrency), per-range retry "
+        "isolation, Content-Range verification, 200-degrade to the "
+        "sequential lane, DMLC_IO_RANGE* knobs |",
         "| [robustness.md](robustness.md) | remote-I/O resilience (retry "
         "model, env/URI knobs, fault-plan grammar, io_stats()) + "
         "distributed job liveness (heartbeats, dead-rank deadlines, "

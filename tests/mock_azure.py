@@ -17,10 +17,12 @@ import hmac
 import re
 import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 
-from tests.mock_s3 import (FaultCounterMixin, reset_connection,
-                           stall_connection, truncate_body)
+from tests.mock_s3 import (DeepBacklogHTTPServer, FaultCounterMixin,
+                           reset_connection,
+                           send_with_latency, stall_connection,
+                           truncate_body)
 
 ACCOUNT = "testaccount"
 KEY_B64 = base64.b64encode(b"super-secret-azure-key-0123456789").decode()
@@ -41,6 +43,10 @@ class MockAzureState(FaultCounterMixin):
         self.stall_every = 0          # accept, sleep past client deadline
         self.stall_seconds = 3.0
         self.reset_every = 0          # RST mid-header
+        # ranged-read knobs (mock_s3 parity): per-request/per-block delay
+        # and a gateway that ignores Range (200 full-body)
+        self.latency_ms = 0
+        self.ignore_range = False
         self._init_fault_counters("get500", "gettrunc", "stall", "reset")
 
 
@@ -119,12 +125,17 @@ class MockAzureHandler(BaseHTTPRequestHandler):
             return self._reject(404, "BlobNotFound")
         rng = self.headers.get("Range")
         status = 200
-        if rng:
+        headers = {}
+        total = len(data)
+        if rng and not st.ignore_range:
             m = re.match(r"bytes=(\d+)-(\d*)", rng)
             lo = int(m.group(1))
-            hi = int(m.group(2)) + 1 if m.group(2) else len(data)
-            data = data[lo:hi]
+            hi = int(m.group(2)) + 1 if m.group(2) else total
+            hi = min(hi, total)
             status = 206
+            headers["Content-Range"] = (
+                f"bytes {lo}-{max(hi - 1, lo)}/{total}")
+            data = data[lo:hi]
         if st._tick("stall", st.stall_every):
             return stall_connection(self, st.stall_seconds)
         if st._tick("reset", st.reset_every):
@@ -136,15 +147,14 @@ class MockAzureHandler(BaseHTTPRequestHandler):
         if st.fail_reads_after is not None and len(data) > st.fail_reads_after:
             out = data[: st.fail_reads_after]
             self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, v)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(out)  # truncated on purpose
             self.close_connection = True
             return
-        self.send_response(status)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        send_with_latency(self, status, data, headers, st.latency_ms)
 
     def _list(self, container, q):
         st = self.state
@@ -216,7 +226,7 @@ def serve(ssl_context=None):
     Blob endpoints, which enforce secure transfer."""
     state = MockAzureState()
     handler = type("Handler", (MockAzureHandler,), {"state": state})
-    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    server = DeepBacklogHTTPServer(("127.0.0.1", 0), handler)
     if ssl_context is not None:
         server.socket = ssl_context.wrap_socket(server.socket,
                                                 server_side=True)

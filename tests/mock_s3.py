@@ -35,6 +35,14 @@ def _sign(secret, date, region, string_to_sign):
     return hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
 
 
+class DeepBacklogHTTPServer(ThreadingHTTPServer):
+    """Shared by every backend mock: the parallel ranged readers open many
+    connections at once, and socketserver's default backlog of 5 drops
+    SYNs — each drop costs the client a ~1 s kernel retransmit."""
+
+    request_queue_size = 128
+
+
 class FaultCounterMixin:
     """Every-Nth fault scheduling shared by the backend mocks: each fault
     kind keeps a lock-guarded counter; ``_tick(kind, every)`` says whether
@@ -72,8 +80,48 @@ class MockS3State(FaultCounterMixin):
         self.stall_every = 0
         self.stall_seconds = 3.0
         self.reset_every = 0
+        # -- ranged-read knobs (cpp/src/range_reader.h lane) --
+        self.latency_ms = 0        # per-request + per-block delay
+        self.latency_block = LATENCY_BLOCK  # bytes per latency "burst"
+        self.ignore_range = False  # answer 200 full-body (Range ignored)
+        # every Nth ranged GET: 206 whose Content-Range window (header AND
+        # body, consistent with each other) is shifted +64 bytes from the
+        # REQUEST — a client that skips Content-Range validation splices
+        # wrong bytes silently instead of retrying
+        self.bad_content_range_every = 0
         self._init_fault_counters("get500", "gettrunc", "part", "stall",
-                                  "reset")
+                                  "reset", "badcr")
+
+
+# body bytes per latency "burst": with latency_ms set, a connection's
+# throughput caps at LATENCY_BLOCK / latency_ms — the latency-bandwidth
+# product of a long-haul link, reproduced on localhost
+LATENCY_BLOCK = 256 * 1024
+
+
+def send_with_latency(handler, status, data, headers=None, latency_ms=0,
+                      block=LATENCY_BLOCK):
+    """Send a response; with ``latency_ms`` the mock sleeps once before the
+    response head and once per ``block`` bytes of body, emulating a remote
+    origin whose per-connection throughput is capped by its
+    latency-bandwidth product (block/latency per connection). This is what
+    makes parallel ranged reads (cpp/src/range_reader.h) observable and
+    benchable on localhost: one connection is capped, N concurrent ranges
+    get ~N times the bandwidth."""
+    if latency_ms:
+        time.sleep(latency_ms / 1000.0)
+    handler.send_response(status)
+    for k, v in (headers or {}).items():
+        handler.send_header(k, v)
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    if not latency_ms:
+        handler.wfile.write(data)
+        return
+    for i in range(0, len(data), block):
+        if i:
+            time.sleep(latency_ms / 1000.0)
+        handler.wfile.write(data[i:i + block])
 
 
 def truncate_body(handler, status, data):
@@ -188,12 +236,20 @@ class MockS3Handler(BaseHTTPRequestHandler):
         rng = self.headers.get("Range")
         status = 200
         lo = 0
-        if rng:
+        headers = {}
+        total = len(data)
+        if rng and not st.ignore_range:
             m = re.match(r"bytes=(\d+)-(\d*)", rng)
             lo = int(m.group(1))
-            hi = int(m.group(2)) + 1 if m.group(2) else len(data)
-            data = data[lo:hi]
+            hi = int(m.group(2)) + 1 if m.group(2) else total
+            hi = min(hi, total)
             status = 206
+            if st._tick("badcr", st.bad_content_range_every):
+                lo = min(lo + 64, total)
+                hi = min(hi + 64, total)
+            headers["Content-Range"] = (
+                f"bytes {lo}-{max(hi - 1, lo)}/{total}")
+            data = data[lo:hi]
         if st._tick("stall", st.stall_every):
             return stall_connection(self, st.stall_seconds)
         if st._tick("reset", st.reset_every):
@@ -206,15 +262,15 @@ class MockS3Handler(BaseHTTPRequestHandler):
             # simulate a flaky connection: send a truncated body
             out = data[: st.fail_reads_after]
             self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, v)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(out)
             self.close_connection = True
             return
-        self.send_response(status)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        send_with_latency(self, status, data, headers, st.latency_ms,
+                          st.latency_block)
 
     def _list(self, bucket, q):
         st = self.state
@@ -324,7 +380,7 @@ def serve(ssl_context=None):
     speaks TLS — the S3-over-https lane's stand-in for real AWS."""
     state = MockS3State()
     handler = type("Handler", (MockS3Handler,), {"state": state})
-    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    server = DeepBacklogHTTPServer(("127.0.0.1", 0), handler)
     if ssl_context is not None:
         server.socket = ssl_context.wrap_socket(server.socket,
                                                 server_side=True)

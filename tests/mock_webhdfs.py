@@ -13,10 +13,12 @@ from __future__ import annotations
 import json
 import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 
-from tests.mock_s3 import (FaultCounterMixin, reset_connection,
-                           stall_connection, truncate_body)
+from tests.mock_s3 import (DeepBacklogHTTPServer, FaultCounterMixin,
+                           reset_connection,
+                           send_with_latency, stall_connection,
+                           truncate_body)
 
 
 class MockHdfsState(FaultCounterMixin):
@@ -43,6 +45,10 @@ class MockHdfsState(FaultCounterMixin):
         self.stall_every = 0          # accept, sleep past client deadline
         self.stall_seconds = 3.0
         self.reset_every = 0          # RST mid-header
+        # ranged-read knob (mock_s3 parity): per-request/per-block delay.
+        # WebHDFS ranges ride `offset=`/`length=` OPEN params, not a
+        # Range header, so there is no ignore_range mode here.
+        self.latency_ms = 0
         self._init_fault_counters("get500", "gettrunc", "stall", "reset")
 
     def tick_500(self) -> bool:
@@ -204,6 +210,10 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
                 return self._remote_exc(404, f"File does not exist: {path}")
             off = int(q.get("offset", "0"))
             data = data[off:]
+            if "length" in q:
+                # bounded OPEN (the WebHDFS spelling of a ranged GET,
+                # used by the parallel range readers)
+                data = data[: int(q["length"])]
             if st._tick("gettrunc", st.get_truncate_every):
                 return truncate_body(self, 200, data)
             if (st.fail_reads_after is not None
@@ -215,10 +225,7 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
                 self.wfile.write(out)  # truncated on purpose
                 self.close_connection = True
                 return
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            send_with_latency(self, 200, data, None, st.latency_ms)
             return
         self._remote_exc(400, f"unsupported GET op {op}")
 
@@ -266,7 +273,7 @@ def serve(ssl_context=None):
     Locations — the secure-WebHDFS (swebhdfs) stand-in."""
     state = MockHdfsState()
     handler = type("Handler", (MockHdfsHandler,), {"state": state})
-    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    server = DeepBacklogHTTPServer(("127.0.0.1", 0), handler)
     if ssl_context is not None:
         server.socket = ssl_context.wrap_socket(server.socket,
                                                 server_side=True)
