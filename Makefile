@@ -3,11 +3,11 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
-        parse-lanes telemetry cache range pytest liveness elastic \
+        parse-lanes telemetry cache range fsfault pytest liveness elastic \
         bench-smoke dryrun doc clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
-    telemetry cache range pytest liveness elastic dryrun doc
+    telemetry cache range fsfault pytest liveness elastic dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -44,6 +44,17 @@ cache:
 range:
 	$(MAKE) -C cpp asan-range tsan-range
 	python3 -m pytest tests/test_io_ranged.py -q
+
+# Local-durability chaos lane (doc/robustness.md "Local durability"): the
+# C++ fault-plan matrix under ASan (transcode/publish/replay under
+# eio/enospc/short_write/fsync_fail/torn_rename — every outcome a clean
+# miss, a valid replay, or a structured error) plus the Python gauntlet
+# (checkpoint atomicity local+remote, event-log drop containment, SIGKILL
+# sweep mid-transcode/publish). Hard timeout: a wedged pass is exactly
+# the regression this lane exists to catch.
+fsfault:
+	$(MAKE) -C cpp asan-fsfault
+	timeout -k 10 300 python3 -m pytest tests/test_fs_fault.py -q
 
 lint:
 	python3 scripts/lint.py

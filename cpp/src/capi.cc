@@ -15,6 +15,7 @@
 #include "csr_rec.h"
 #include "dense_rec.h"
 #include "filesys.h"
+#include "fs_fault.h"
 #include "hdfs_filesys.h"
 #include "http.h"
 #include "input_split.h"
@@ -209,6 +210,16 @@ int dct_io_set_fault_plan(const char* plan) {
 // milliseconds); <=0 reverts to DMLC_IO_TIMEOUT_MS / the 60 s default.
 int dct_io_set_timeout_ms(int ms) {
   return Guard([&] { dct::io::SetIoTimeoutMs(ms); });
+}
+
+// Install/replace the LOCAL-filesystem fault plan (fs_fault.h grammar,
+// e.g. "write:fault=enospc,every=3;rename:fault=torn_rename,p=0.5") —
+// evaluated inside the local stream/shard-cache syscall wrappers, below
+// every mock. Empty/NULL clears; an explicit clear beats
+// DMLC_FS_FAULT_PLAN (same race-free-setter rule as the io plan).
+int dct_fs_set_fault_plan(const char* plan) {
+  return Guard(
+      [&] { dct::fsio::SetFsFaultPlan(plan == nullptr ? "" : plan); });
 }
 
 // ---------------------------------------------------------------- streams --

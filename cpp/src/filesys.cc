@@ -7,36 +7,65 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <cstdio>
+#include <cstring>
 #include <map>
 #include <mutex>
 #include <queue>
+
+#include "fs_fault.h"
 
 namespace dct {
 
 namespace {
 
 // stdio-backed seekable stream (reference local_filesys.cc:27-67).
+// Local durability contract (fs_fault.h): every real OR injected I/O
+// failure surfaces as a structured fsio::FsError naming the path and
+// errno — before this, a mid-file EIO read as a short fread, i.e. EOF,
+// i.e. SILENT TRUNCATION, and writes died on a context-free check.
 class StdFileStream : public SeekStream {
  public:
-  StdFileStream(std::FILE* fp, bool own) : fp_(fp), own_(own) {}
+  StdFileStream(std::FILE* fp, bool own, const std::string& path,
+                bool writable)
+      : fp_(fp), own_(own), path_(path), writable_(writable) {}
   ~StdFileStream() override {
     if (own_ && fp_ != nullptr) std::fclose(fp_);
   }
   size_t Read(void* ptr, size_t size) override {
-    return std::fread(ptr, 1, size, fp_);
+    fsio::InjectThrow(fsio::FsOp::kRead, path_);
+    size_t n = std::fread(ptr, 1, size, fp_);
+    if (n != size && std::ferror(fp_)) {
+      const int err = errno != 0 ? errno : EIO;
+      std::clearerr(fp_);
+      throw fsio::FsError(fsio::FsOp::kRead, path_, err);
+    }
+    return n;
   }
   size_t Write(const void* ptr, size_t size) override {
+    fsio::InjectStdioWrite(fp_, ptr, size, path_);
     size_t n = std::fwrite(ptr, 1, size, fp_);
-    DCT_CHECK_EQ(n, size) << "write failed (disk full?)";
+    if (n != size) {
+      const int err = errno != 0 ? errno : ENOSPC;
+      std::clearerr(fp_);
+      throw fsio::FsError(fsio::FsOp::kWrite, path_, err);
+    }
     return n;
   }
   void Finish() override {
     // surface deferred stdio write errors (ENOSPC etc.) at explicit close,
-    // matching the buffered remote writers (stream.h Finish contract)
-    if (fp_ != nullptr) {
-      DCT_CHECK(std::fflush(fp_) == 0 && std::ferror(fp_) == 0)
-          << "flush failed (disk full?)";
+    // matching the buffered remote writers (stream.h Finish contract).
+    // Read-only streams skip both the probe and the flush check: a
+    // reader's close has nothing to make durable, and an injected fsync
+    // fault firing there would model a failure real disks cannot have.
+    if (fp_ != nullptr && writable_) {
+      fsio::InjectThrow(fsio::FsOp::kFsync, path_);
+      if (std::fflush(fp_) != 0 || std::ferror(fp_) != 0) {
+        const int err = errno != 0 ? errno : EIO;
+        std::clearerr(fp_);
+        throw fsio::FsError(fsio::FsOp::kFsync, path_, err);
+      }
     }
   }
   void Seek(size_t pos) override {
@@ -62,6 +91,8 @@ class StdFileStream : public SeekStream {
  private:
   std::FILE* fp_;
   bool own_;
+  std::string path_;  // error/injection context
+  bool writable_;     // read-only streams skip the Finish durability check
 };
 
 }  // namespace
@@ -148,26 +179,38 @@ void LocalFileSystem::ListDirectory(const URI& path,
 Stream* LocalFileSystem::Open(const URI& path, const char* mode,
                               bool allow_null) {
   // stdin/stdout passthrough (reference local_filesys.cc, io.cc:94-96)
-  if (path.path == "stdin") return new StdFileStream(stdin, false);
-  if (path.path == "stdout") return new StdFileStream(stdout, false);
+  if (path.path == "stdin") {
+    return new StdFileStream(stdin, false, "stdin", false);
+  }
+  if (path.path == "stdout") {
+    return new StdFileStream(stdout, false, "stdout", true);
+  }
   std::string m = mode;
   if (m.find('b') == std::string::npos) m += 'b';
-  std::FILE* fp = std::fopen(path.path.c_str(), m.c_str());
+  std::FILE* fp = fsio::InjectOpenFail(path.path)
+                      ? nullptr
+                      : std::fopen(path.path.c_str(), m.c_str());
   if (fp == nullptr) {
+    const int err = errno;
     DCT_CHECK(allow_null) << "cannot open file " << path.path << " mode "
-                          << mode;
+                          << mode << ": " << std::strerror(err);
     return nullptr;
   }
-  return new StdFileStream(fp, true);
+  const bool writable = m.find_first_of("wa+") != std::string::npos;
+  return new StdFileStream(fp, true, path.path, writable);
 }
 
 SeekStream* LocalFileSystem::OpenForRead(const URI& path, bool allow_null) {
-  std::FILE* fp = std::fopen(path.path.c_str(), "rb");
+  std::FILE* fp = fsio::InjectOpenFail(path.path)
+                      ? nullptr
+                      : std::fopen(path.path.c_str(), "rb");
   if (fp == nullptr) {
-    DCT_CHECK(allow_null) << "cannot open file " << path.path;
+    const int err = errno;
+    DCT_CHECK(allow_null) << "cannot open file " << path.path << ": "
+                          << std::strerror(err);
     return nullptr;
   }
-  return new StdFileStream(fp, true);
+  return new StdFileStream(fp, true, path.path, false);
 }
 
 void FileSystem::ListDirectoryRecursive(const URI& path,

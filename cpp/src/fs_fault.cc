@@ -1,0 +1,460 @@
+// Local-filesystem fault injection + durability wrappers (see fs_fault.h).
+#include "fs_fault.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <vector>
+
+#include "retry.h"
+#include "telemetry.h"
+
+namespace dct {
+namespace fsio {
+
+const char* FsOpName(FsOp op) {
+  switch (op) {
+    case FsOp::kOpen: return "open";
+    case FsOp::kRead: return "read";
+    case FsOp::kWrite: return "write";
+    case FsOp::kFsync: return "fsync";
+    case FsOp::kRename: return "rename";
+    case FsOp::kMmap: return "mmap";
+  }
+  return "?";
+}
+
+FsError::FsError(FsOp op, const std::string& path, int err)
+    : Error(std::string("local fs ") + FsOpName(op) + " failed: " + path +
+            ": " + std::strerror(err)),
+      op_(op),
+      err_(err) {}
+
+namespace {
+
+enum class Kind { kNone = 0, kEio, kEnospc, kShortWrite, kFsyncFail,
+                  kTornRename };
+
+struct FsRule {
+  FsOp op;
+  Kind kind;
+  uint64_t every = 0;
+  double probability = 0.0;
+  std::atomic<uint64_t> count{0};
+};
+
+struct FsPlan {
+  std::vector<std::unique_ptr<FsRule>> rules;
+  std::mutex rng_mu;
+  std::mt19937_64 rng DMLC_GUARDED_BY(rng_mu);
+};
+
+std::mutex g_plan_mu;
+std::shared_ptr<FsPlan> g_plan DMLC_GUARDED_BY(g_plan_mu);  // null = off
+bool g_plan_explicitly_set DMLC_GUARDED_BY(g_plan_mu) = false;
+std::once_flag g_env_plan_once;
+// fast-path gate: wrappers sit on per-record read paths, so the no-plan
+// case must be one relaxed load, not a mutex acquisition
+std::atomic<bool> g_plan_active{false};
+
+FsOp ParseOp(const std::string& word, const std::string& plan) {
+  if (word == "open") return FsOp::kOpen;
+  if (word == "read") return FsOp::kRead;
+  if (word == "write") return FsOp::kWrite;
+  if (word == "fsync") return FsOp::kFsync;
+  if (word == "rename") return FsOp::kRename;
+  if (word == "mmap") return FsOp::kMmap;
+  throw Error("fs fault plan: unknown op '" + word +
+              "' (known: open, read, write, fsync, rename, mmap) in '" +
+              plan + "'");
+}
+
+Kind ParseKind(const std::string& word, const std::string& plan) {
+  if (word == "eio") return Kind::kEio;
+  if (word == "enospc") return Kind::kEnospc;
+  if (word == "short_write") return Kind::kShortWrite;
+  if (word == "fsync_fail") return Kind::kFsyncFail;
+  if (word == "torn_rename") return Kind::kTornRename;
+  throw Error("fs fault plan: unknown fault '" + word +
+              "' (known: eio, enospc, short_write, fsync_fail, "
+              "torn_rename) in '" + plan + "'");
+}
+
+// The op/fault validity matrix: a plan that could never fire (or would
+// fire nonsense) must error at parse, not silently no-op mid-gauntlet.
+void CheckCombo(FsOp op, Kind kind, const std::string& plan) {
+  bool ok = false;
+  switch (kind) {
+    case Kind::kEio: ok = true; break;
+    case Kind::kEnospc:
+      ok = op == FsOp::kOpen || op == FsOp::kWrite || op == FsOp::kFsync;
+      break;
+    case Kind::kShortWrite: ok = op == FsOp::kWrite; break;
+    case Kind::kFsyncFail: ok = op == FsOp::kFsync; break;
+    case Kind::kTornRename: ok = op == FsOp::kRename; break;
+    case Kind::kNone: break;
+  }
+  if (!ok) {
+    throw Error(std::string("fs fault plan: fault cannot apply to op '") +
+                FsOpName(op) + "' in '" + plan + "'");
+  }
+}
+
+std::shared_ptr<FsPlan> ParseFsPlan(const std::string& plan) {
+  auto out = std::make_shared<FsPlan>();
+  // lock-ok: freshly built plan, not yet published to g_plan
+  out->rng.seed(static_cast<uint64_t>(
+      io::CheckedEnvInt("DMLC_FS_FAULT_SEED", 1, INT64_MIN, INT64_MAX)));
+  size_t start = 0;
+  while (start <= plan.size()) {
+    size_t semi = plan.find(';', start);
+    std::string rule_text = plan.substr(
+        start, semi == std::string::npos ? std::string::npos : semi - start);
+    if (!rule_text.empty()) {
+      size_t colon = rule_text.find(':');
+      if (colon == std::string::npos) {
+        throw Error("fs fault plan: rule '" + rule_text +
+                    "' needs <op>:fault=<kind>,every=N|p=<prob>");
+      }
+      auto rule = std::make_unique<FsRule>();
+      rule->op = ParseOp(rule_text.substr(0, colon), plan);
+      rule->kind = Kind::kNone;
+      std::string params = rule_text.substr(colon + 1);
+      size_t p = 0;
+      while (p <= params.size()) {
+        size_t comma = params.find(',', p);
+        std::string kv = params.substr(
+            p, comma == std::string::npos ? std::string::npos : comma - p);
+        if (!kv.empty()) {
+          size_t eq = kv.find('=');
+          if (eq == std::string::npos) {
+            throw Error("fs fault plan: malformed param '" + kv + "' in '" +
+                        plan + "'");
+          }
+          std::string key = kv.substr(0, eq);
+          std::string val = kv.substr(eq + 1);
+          if (key == "fault") {
+            rule->kind = ParseKind(val, plan);
+          } else if (key == "every") {
+            // no clamp: every=0 must ERROR, not silently become every=1
+            const int64_t ev =
+                io::CheckedInt("fs fault plan every", val, INT64_MIN,
+                               INT64_MAX);
+            if (ev < 1) {
+              throw Error("fs fault plan: every must be >= 1, got '" + val +
+                          "'");
+            }
+            rule->every = static_cast<uint64_t>(ev);
+          } else if (key == "p") {
+            char* end = nullptr;
+            rule->probability = std::strtod(val.c_str(), &end);
+            if (end == val.c_str() || *end != '\0' ||
+                rule->probability < 0.0 || rule->probability > 1.0) {
+              throw Error("fs fault plan: p must be in [0,1], got '" + val +
+                          "'");
+            }
+          } else {
+            throw Error("fs fault plan: unknown param '" + key + "' in '" +
+                        plan + "'");
+          }
+        }
+        if (comma == std::string::npos) break;
+        p = comma + 1;
+      }
+      if (rule->kind == Kind::kNone) {
+        throw Error("fs fault plan: rule '" + rule_text +
+                    "' needs fault=<kind>");
+      }
+      if (rule->every == 0 && rule->probability == 0.0) {
+        throw Error("fs fault plan: rule '" + rule_text +
+                    "' needs every=N or p=<prob>");
+      }
+      if (rule->every != 0 && rule->probability != 0.0) {
+        // only one selector can drive a rule; accepting both and
+        // silently preferring every= would inject differently than
+        // written (the checked-parse rule)
+        throw Error("fs fault plan: rule '" + rule_text +
+                    "' has BOTH every=N and p= — pick one selector");
+      }
+      CheckCombo(rule->op, rule->kind, plan);
+      out->rules.push_back(std::move(rule));
+    }
+    if (semi == std::string::npos) break;
+    start = semi + 1;
+  }
+  return out->rules.empty() ? nullptr : out;
+}
+
+// Per-op firing counters, resolved once (fs_fault_injected_total{op=}).
+telemetry::Counter* FiredCounter(FsOp op) {
+  static telemetry::Counter* counters[6] = {
+      telemetry::GetCounter("fs_fault_injected_total", {{"op", "open"}}),
+      telemetry::GetCounter("fs_fault_injected_total", {{"op", "read"}}),
+      telemetry::GetCounter("fs_fault_injected_total", {{"op", "write"}}),
+      telemetry::GetCounter("fs_fault_injected_total", {{"op", "fsync"}}),
+      telemetry::GetCounter("fs_fault_injected_total", {{"op", "rename"}}),
+      telemetry::GetCounter("fs_fault_injected_total", {{"op", "mmap"}}),
+  };
+  return counters[static_cast<int>(op)];
+}
+
+// Evaluate the plan for one `op` call: tick every matching rule, return
+// the first fired kind (counted), kNone otherwise.
+Kind Probe(FsOp op) {
+  EnsureFsFaultPlanFromEnv();
+  if (!g_plan_active.load(std::memory_order_relaxed)) return Kind::kNone;
+  std::shared_ptr<FsPlan> plan;
+  {
+    std::lock_guard<std::mutex> lk(g_plan_mu);
+    plan = g_plan;
+  }
+  if (plan == nullptr) return Kind::kNone;
+  const FsRule* fire = nullptr;
+  for (auto& rule : plan->rules) {
+    if (rule->op != op) continue;
+    bool hit = false;
+    if (rule->every > 0) {
+      uint64_t n = rule->count.fetch_add(1, std::memory_order_relaxed) + 1;
+      hit = n % rule->every == 0;
+    } else if (rule->probability > 0.0) {
+      double draw;
+      {
+        std::lock_guard<std::mutex> lk(plan->rng_mu);
+        draw = std::uniform_real_distribution<double>(0.0, 1.0)(plan->rng);
+      }
+      hit = draw < rule->probability;
+    }
+    if (hit && fire == nullptr) fire = rule.get();
+  }
+  if (fire == nullptr) return Kind::kNone;
+  FiredCounter(op)->Add(1);
+  return fire->kind;
+}
+
+int KindErrno(Kind k) {
+  switch (k) {
+    case Kind::kEnospc:
+    case Kind::kShortWrite:
+      return ENOSPC;
+    default:
+      return EIO;
+  }
+}
+
+// The torn-rename artifact: destination holds a TRUNCATED half-copy, the
+// source is gone — what a crash between a non-atomic rename's data and
+// metadata halves could expose. Built with raw syscalls on purpose: the
+// fault path must never recurse into injection.
+void TearRename(const char* from, const char* to) {
+  int in = ::open(from, O_RDONLY);
+  if (in >= 0) {
+    struct stat st;
+    if (fstat(in, &st) == 0 && S_ISREG(st.st_mode)) {
+      int out = ::open(to, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+      if (out >= 0) {
+        size_t half = static_cast<size_t>(st.st_size) / 2;
+        std::vector<char> buf(64 * 1024);
+        size_t moved = 0;
+        while (moved < half) {
+          ssize_t n = ::read(in, buf.data(),
+                             std::min(buf.size(), half - moved));
+          if (n <= 0) break;
+          ssize_t w = ::write(out, buf.data(), static_cast<size_t>(n));
+          if (w != n) break;
+          moved += static_cast<size_t>(n);
+        }
+        ::close(out);
+      }
+    }
+    ::close(in);
+  }
+  ::unlink(from);
+}
+
+}  // namespace
+
+void SetFsFaultPlan(const std::string& plan) {
+  std::shared_ptr<FsPlan> parsed =
+      plan.empty() ? nullptr : ParseFsPlan(plan);
+  std::lock_guard<std::mutex> lk(g_plan_mu);
+  g_plan = std::move(parsed);
+  g_plan_explicitly_set = true;  // an explicit CLEAR also beats the env
+  g_plan_active.store(g_plan != nullptr, std::memory_order_relaxed);
+}
+
+void EnsureFsFaultPlanFromEnv() {
+  std::call_once(g_env_plan_once, [] {
+    const char* env = std::getenv("DMLC_FS_FAULT_PLAN");
+    if (env == nullptr || *env == '\0') return;
+    std::shared_ptr<FsPlan> parsed = ParseFsPlan(env);
+    std::lock_guard<std::mutex> lk(g_plan_mu);
+    if (!g_plan_explicitly_set) {
+      g_plan = std::move(parsed);
+      g_plan_active.store(g_plan != nullptr, std::memory_order_relaxed);
+    }
+  });
+}
+
+// ------------------------------------------------------------- wrappers --
+int Open(const char* path, int flags, unsigned mode) {
+  Kind k = Probe(FsOp::kOpen);
+  if (k != Kind::kNone) {
+    errno = KindErrno(k);
+    return -1;
+  }
+  return ::open(path, flags, static_cast<mode_t>(mode));
+}
+
+long Write(int fd, const void* buf, size_t n) {
+  Kind k = Probe(FsOp::kWrite);
+  if (k == Kind::kShortWrite) {
+    // really land half the bytes — the torn artifact the crash-consistency
+    // machinery must quarantine, not just an error code
+    if (n > 1) {
+      ssize_t w = ::write(fd, buf, n / 2);
+      (void)w;  // the call reports failure regardless of the partial
+    }
+    errno = ENOSPC;
+    return -1;
+  }
+  if (k != Kind::kNone) {
+    errno = KindErrno(k);
+    return -1;
+  }
+  return ::write(fd, buf, n);
+}
+
+long Pwrite(int fd, const void* buf, size_t n, long long off) {
+  Kind k = Probe(FsOp::kWrite);
+  if (k == Kind::kShortWrite) {
+    // same contract as Write: half the bytes REALLY land (the torn
+    // header-patch artifact the shard cache's Finalize must survive)
+    if (n > 1) {
+      ssize_t w = ::pwrite(fd, buf, n / 2, static_cast<off_t>(off));
+      (void)w;
+    }
+    errno = ENOSPC;
+    return -1;
+  }
+  if (k != Kind::kNone) {
+    errno = KindErrno(k);
+    return -1;
+  }
+  return ::pwrite(fd, buf, n, static_cast<off_t>(off));
+}
+
+int Fsync(int fd) {
+  Kind k = Probe(FsOp::kFsync);
+  if (k != Kind::kNone) {
+    errno = KindErrno(k);
+    return -1;
+  }
+  return ::fsync(fd);
+}
+
+int Rename(const char* from, const char* to) {
+  Kind k = Probe(FsOp::kRename);
+  if (k == Kind::kTornRename) {
+    TearRename(from, to);
+    errno = EIO;
+    return -1;
+  }
+  if (k != Kind::kNone) {
+    errno = KindErrno(k);
+    return -1;
+  }
+  return std::rename(from, to);
+}
+
+void* Mmap(size_t len, int prot, int flags, int fd) {
+  Kind k = Probe(FsOp::kMmap);
+  if (k != Kind::kNone) {
+    errno = KindErrno(k);
+    return MAP_FAILED;
+  }
+  return ::mmap(nullptr, len, prot, flags, fd, 0);
+}
+
+void WriteAllFd(int fd, const void* data, size_t size,
+                const std::string& path) {
+  const char* p = static_cast<const char*>(data);
+  while (size != 0) {
+    long n = Write(fd, p, size);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      throw FsError(FsOp::kWrite, path, errno);
+    }
+    p += n;
+    size -= static_cast<size_t>(n);
+  }
+}
+
+void FsyncDirOf(const std::string& path) {
+  size_t slash = path.find_last_of('/');
+  std::string dir = slash == std::string::npos ? "." : path.substr(0, slash);
+  int fd = ::open(dir.c_str(), O_RDONLY);
+  if (fd >= 0) {
+    ::fsync(fd);  // best-effort: some filesystems reject directory fsync
+    ::close(fd);
+  }
+}
+
+bool ReadFileToString(const std::string& path, std::string* out) {
+  int fd = Open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  out->clear();
+  char buf[16 * 1024];
+  bool ok = true;
+  while (true) {
+    Kind k = Probe(FsOp::kRead);
+    if (k != Kind::kNone) {
+      ok = false;
+      break;
+    }
+    ssize_t n = ::read(fd, buf, sizeof(buf));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      ok = false;
+      break;
+    }
+    if (n == 0) break;
+    out->append(buf, static_cast<size_t>(n));
+  }
+  ::close(fd);
+  return ok;
+}
+
+// ------------------------------------------------------ stdio helpers ----
+void InjectThrow(FsOp op, const std::string& path) {
+  Kind k = Probe(op);
+  if (k != Kind::kNone) throw FsError(op, path, KindErrno(k));
+}
+
+void InjectStdioWrite(std::FILE* fp, const void* p, size_t n,
+                      const std::string& path) {
+  Kind k = Probe(FsOp::kWrite);
+  if (k == Kind::kNone) return;
+  if (k == Kind::kShortWrite && n > 1) {
+    std::fwrite(p, 1, n / 2, fp);  // the real partial lands, then the error
+  }
+  throw FsError(FsOp::kWrite, path, KindErrno(k));
+}
+
+bool InjectOpenFail(const std::string& path) {
+  (void)path;
+  Kind k = Probe(FsOp::kOpen);
+  if (k == Kind::kNone) return false;
+  errno = KindErrno(k);
+  return true;
+}
+
+}  // namespace fsio
+}  // namespace dct

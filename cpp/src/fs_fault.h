@@ -1,0 +1,122 @@
+// Local-filesystem durability layer: deterministic fault injection +
+// syscall wrappers shared by every LOCAL write path (the network twin is
+// retry.h's DMLC_IO_FAULT_PLAN).
+//
+// The reference's local I/O story (src/io/local_filesys.cc) assumes the
+// disk is reliable: EIO/ENOSPC/failed fsync either fire a hard CHECK or
+// are silently ignored (fread's error flag was never looked at — a mid-
+// file EIO read as EOF, i.e. silent truncation). This layer gives the
+// local plane the same two properties the remote plane got in PR 2:
+//
+//   1. Every failure is OBSERVABLE and STRUCTURED: the wrappers keep the
+//      raw syscall contract (-1 + errno / nullptr / MAP_FAILED) so call
+//      sites keep one error path, and the throwing helpers raise FsError
+//      (op + errno + path) instead of a bare CHECK string.
+//   2. Every failure is INJECTABLE below every mock: DMLC_FS_FAULT_PLAN /
+//      dct_fs_set_fault_plan installs a deterministic plan evaluated
+//      inside the wrappers themselves, so the chaos suites prove the real
+//      degradation machinery (quarantine, text-lane stand-down, atomic
+//      checkpoint cleanup), not a test harness.
+//
+// Plan grammar (';'-separated rules, checked parse — a typo errors, the
+// retry.h CheckedEnvInt rule):
+//
+//   <op>:fault=<kind>,(every=N | p=<prob>)
+//
+//   op:    open | read | write | fsync | rename | mmap
+//   kind:  eio          (fail with EIO — any op)
+//          enospc       (fail with ENOSPC — open/write/fsync)
+//          short_write  (write REALLY writes half, then fails ENOSPC —
+//                        the torn-bytes disk-full artifact; write only)
+//          fsync_fail   (fsync returns EIO — fsync only)
+//          torn_rename  (destination receives a TRUNCATED half-copy, the
+//                        source is gone, the call fails EIO — the crash-
+//                        mid-publish artifact a non-atomic filesystem
+//                        could expose; rename only)
+//
+// Selectors mirror retry.cc: every=N keeps a per-rule atomic counter of
+// the ops it OBSERVES (ops of its own kind only) and fires on every Nth;
+// p= draws from one RNG seeded by DMLC_FS_FAULT_SEED (default 1) so runs
+// replay. Each firing bumps fs_fault_injected_total{op=} (telemetry.h).
+#ifndef DCT_FS_FAULT_H_
+#define DCT_FS_FAULT_H_
+
+#include <cstdio>
+#include <string>
+
+#include "base.h"
+
+namespace dct {
+namespace fsio {
+
+enum class FsOp { kOpen = 0, kRead, kWrite, kFsync, kRename, kMmap };
+const char* FsOpName(FsOp op);
+
+// Structured local-filesystem error: what failed, on which path, with
+// which errno — so a full disk surfaces as "write failed (No space left
+// on device)" instead of a context-free check string.
+class FsError : public Error {
+ public:
+  FsError(FsOp op, const std::string& path, int err);
+  FsOp op() const { return op_; }
+  int error_number() const { return err_; }
+
+ private:
+  FsOp op_;
+  int err_;
+};
+
+// Install/replace the plan ("" clears; explicit set — even clear — beats
+// the env, same rule as io::SetFaultPlan). Throws Error on bad grammar or
+// an op/fault combination that cannot happen (read:fault=torn_rename).
+void SetFsFaultPlan(const std::string& plan);
+
+// Lazily installs DMLC_FS_FAULT_PLAN from the env on first wrapper use.
+void EnsureFsFaultPlanFromEnv();
+
+// ------------------------------------------------------------- wrappers --
+// Syscall-compatible: injected faults return the call's failure value
+// with errno set, exactly like the real failure would, so every call
+// site keeps ONE error path. The short_write/torn_rename kinds perform
+// their real partial side effect first.
+int Open(const char* path, int flags, unsigned mode = 0644);
+long Write(int fd, const void* buf, size_t n);                // ssize_t
+long Pwrite(int fd, const void* buf, size_t n, long long off);
+int Fsync(int fd);
+int Rename(const char* from, const char* to);
+void* Mmap(size_t len, int prot, int flags, int fd);          // MAP_FAILED
+
+// Write all of `data` through Write(); throws FsError naming `path` on
+// any failure (EINTR retried). The shared loop the shard cache and any
+// future local writer ride, so the partial-write handling cannot drift.
+void WriteAllFd(int fd, const void* data, size_t size,
+                const std::string& path);
+
+// Best-effort fsync of the directory containing `path` so a rename into
+// it survives a crash (some filesystems reject directory fsync; that is
+// not an error). The one deliberate unchecked-fsync site.
+void FsyncDirOf(const std::string& path);
+
+// Read a whole local file; false on ANY failure (absent, injected or
+// real open/read fault) — the validation-miss shape: replay validators
+// must fall back to the text lane, never throw.
+bool ReadFileToString(const std::string& path, std::string* out);
+
+// ------------------------------------------------------ stdio helpers ----
+// For FILE*-backed streams (filesys.cc StdFileStream), where the failure
+// contract is throwing: evaluate the plan for `op` and throw FsError on a
+// fired simple fault (eio/enospc/fsync_fail). short_write against a
+// FILE* is handled by InjectStdioWrite, which really fwrites half before
+// throwing. Call BEFORE the real stdio op.
+void InjectThrow(FsOp op, const std::string& path);
+void InjectStdioWrite(std::FILE* fp, const void* p, size_t n,
+                      const std::string& path);
+
+// True (with errno set) when an injected open fault fired — lets
+// allow_null open sites treat injection exactly like a failed fopen.
+bool InjectOpenFail(const std::string& path);
+
+}  // namespace fsio
+}  // namespace dct
+
+#endif  // DCT_FS_FAULT_H_

@@ -11,6 +11,7 @@
 #include <limits>
 #include <thread>
 
+#include "fs_fault.h"
 #include "numparse.h"
 #include "parameter.h"
 #include "recordio.h"
@@ -1051,8 +1052,19 @@ void DiskCacheParser<IndexType>::FinalizeCache() {
     std::remove(tmp.c_str());
     return;
   }
-  DCT_CHECK(std::rename(tmp.c_str(), cache_file_.c_str()) == 0)
-      << "cannot publish row-block cache " << cache_file_;
+  // injectable publish (fs_fault.h): a failed/torn rename surfaces as a
+  // structured error with errno instead of a bare check string. The
+  // DESTINATION is removed first: a torn half-copy keeps the magic+
+  // fingerprint probe valid, so leaving it would wedge every later
+  // epoch/process mid-replay — deleting it makes the failure a clean
+  // first-pass re-parse instead (the shard cache gets this from
+  // manifest-last publishing; this single-file format has no manifest).
+  if (fsio::Rename(tmp.c_str(), cache_file_.c_str()) != 0) {
+    const int err = errno != 0 ? errno : EIO;
+    std::remove(cache_file_.c_str());
+    std::remove(tmp.c_str());
+    throw fsio::FsError(fsio::FsOp::kRename, cache_file_, err);
+  }
 }
 
 template <typename IndexType>

@@ -80,25 +80,50 @@ bool RecordIOReader::NextRecord(std::string* out) {
   if (eof_) return false;
   out->clear();
   while (true) {
+    // header fill loop: Stream::Read may legally return short (buffered/
+    // ranged remote streams at a chunk boundary) — only got==0 at a
+    // record boundary is EOF; got==0 mid-header is a torn file
     char header[8];
-    size_t n = stream_->Read(header, 8);
-    if (n == 0 && out->empty()) {
+    size_t hfill = 0;
+    while (hfill < 8) {
+      size_t n = stream_->Read(header + hfill, 8 - hfill);
+      if (n == 0) break;
+      hfill += n;
+    }
+    if (hfill == 0 && out->empty()) {
       eof_ = true;
       return false;
     }
-    DCT_CHECK_EQ(n, size_t(8)) << "truncated recordio header";
-    DCT_CHECK_EQ(LoadWordLE(header), kMagic) << "bad recordio magic";
+    // structured corruption errors: a torn file (crash mid-append, short
+    // write) must name WHERE the stream broke, not just that it did —
+    // the operator's first question is "how much survived"
+    DCT_CHECK_EQ(hfill, size_t(8))
+        << "truncated recordio header after record " << records_
+        << " at byte offset " << bytes_in_;
+    DCT_CHECK_EQ(LoadWordLE(header), kMagic)
+        << "bad recordio magic after record " << records_
+        << " at byte offset " << bytes_in_;
     uint32_t lrec = LoadWordLE(header + 4);
     uint32_t cflag = HeaderFlag(lrec);
     uint32_t len = HeaderLen(lrec);
     size_t padded = AlignUp4(len);
     size_t old = out->size();
     out->resize(old + padded);
-    if (padded != 0) {
-      stream_->ReadExact(&(*out)[old], padded);
+    size_t filled = 0;
+    while (filled < padded) {
+      size_t got = stream_->Read(&(*out)[old + filled], padded - filled);
+      DCT_CHECK_GT(got, size_t(0))
+          << "truncated recordio payload (" << (padded - filled)
+          << " of " << padded << " bytes missing) after record "
+          << records_ << " at byte offset " << bytes_in_;
+      filled += got;
     }
+    bytes_in_ += 8 + padded;
     out->resize(old + len);  // drop pad
-    if (cflag == 0 || cflag == 3) return true;
+    if (cflag == 0 || cflag == 3) {
+      ++records_;
+      return true;
+    }
     // re-insert the elided magic between parts
     char magic_bytes[4];
     uint32_t m = kMagic;

@@ -106,12 +106,17 @@ class RecordIOWriter {
 class RecordIOReader {
  public:
   explicit RecordIOReader(Stream* stream) : stream_(stream) {}
-  // Read the next record into *out; false at end of stream.
+  // Read the next record into *out; false at end of stream. A truncated
+  // or corrupt frame throws a structured Error naming the record index
+  // and byte offset (never a silent short record) — local-disk EIO below
+  // this surfaces as fsio::FsError from the stream itself (filesys.cc).
   bool NextRecord(std::string* out);
 
  private:
   Stream* stream_;
   bool eof_ = false;
+  uint64_t records_ = 0;     // completed records (error context)
+  uint64_t bytes_in_ = 0;    // bytes consumed (error context)
 };
 
 // Sub-partitions an in-memory chunk of recordio bytes for multithreaded

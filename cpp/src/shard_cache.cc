@@ -2,6 +2,7 @@
 // the crash/validation model).
 #include "shard_cache.h"
 
+#include <dirent.h>
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -12,9 +13,11 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
-#include <fstream>
+#include <ctime>
 #include <sstream>
 
+#include "fs_fault.h"
+#include "retry.h"
 #include "serializer.h"
 #include "sha256.h"
 #include "telemetry.h"
@@ -30,6 +33,7 @@ struct CacheTelemetry {
   telemetry::Counter* hits;
   telemetry::Counter* misses;
   telemetry::Counter* transcodes;
+  telemetry::Counter* write_errors;  // teed/published passes lost to I/O
   telemetry::Hist* read_us;   // one replay block (view hand-out)
   telemetry::Hist* write_us;  // one transcoded block append
 };
@@ -39,6 +43,7 @@ const CacheTelemetry& CacheTel() {
       telemetry::GetCounter("cache_hits_total"),
       telemetry::GetCounter("cache_misses_total"),
       telemetry::GetCounter("cache_transcodes_total"),
+      telemetry::GetCounter("cache_write_errors_total"),
       telemetry::GetHist("cache_read_us"),
       telemetry::GetHist("cache_write_us"),
   };
@@ -64,31 +69,39 @@ void MkdirRecursive(const std::string& dir) {
   }
 }
 
-// fsync the containing directory so the rename itself is durable
-// (same discipline as utils/checkpoint.py save_checkpoint). Best-effort:
-// some filesystems reject directory fsync.
-void FsyncDirOf(const std::string& path) {
-  size_t slash = path.find_last_of('/');
-  std::string dir = slash == std::string::npos ? "." : path.substr(0, slash);
-  int fd = open(dir.c_str(), O_RDONLY);
-  if (fd >= 0) {
-    fsync(fd);
-    close(fd);
+// True for the byproduct names THIS cache stages or quarantines — the GC
+// sweep must never touch anything else in a (possibly shared) cache dir.
+bool IsCacheByproduct(const std::string& name) {
+  if (name.size() > 12 &&
+      name.compare(name.size() - 12, 12, ".quarantined") == 0) {
+    return true;
   }
+  return name.find(".dshard.tmp.") != std::string::npos ||
+         name.find(".manifest.tmp.") != std::string::npos;
 }
 
-void WriteAll(int fd, const void* data, size_t size, const char* what) {
-  const char* p = static_cast<const char*>(data);
-  while (size != 0) {
-    ssize_t n = write(fd, p, size);
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      throw Error(std::string("shard cache write failed (") + what +
-                  "): " + std::strerror(errno));
-    }
-    p += n;
-    size -= static_cast<size_t>(n);
+// Reap age-expired temps/quarantined files left by crashed or faulted
+// transcodes (they used to accumulate forever). Runs at WRITER
+// construction — the only moment the dir is known to be in active use —
+// and only deletes byproducts older than DMLC_DATA_CACHE_GC_AGE_S
+// (default 24 h), so a LIVE concurrent transcoder's fresh temp is never
+// reaped. Best-effort: GC failures must not fail the transcode.
+void SweepStaleTemps(const std::string& dir) {
+  const int64_t age_s = io::CheckedEnvInt("DMLC_DATA_CACHE_GC_AGE_S",
+                                          86400, 60, 365LL * 86400);
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return;
+  const time_t now = time(nullptr);
+  struct dirent* ent;
+  while ((ent = readdir(d)) != nullptr) {
+    const std::string name = ent->d_name;
+    if (!IsCacheByproduct(name)) continue;
+    const std::string full = dir + "/" + name;
+    struct stat st;
+    if (lstat(full.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) continue;
+    if (now - st.st_mtime > age_s) unlink(full.c_str());
   }
+  closedir(d);
 }
 
 void RawKeyDigest(const std::string& key_text, uint8_t out[32]) {
@@ -227,21 +240,33 @@ class ShardCacheWriterImpl {
   ShardCacheWriterImpl(const std::string& stem, const std::string& key_text)
       : stem_(stem), key_text_(key_text) {
     size_t slash = stem.find_last_of('/');
-    if (slash != std::string::npos) MkdirRecursive(stem.substr(0, slash));
+    if (slash != std::string::npos) {
+      MkdirRecursive(stem.substr(0, slash));
+      SweepStaleTemps(stem.substr(0, slash));
+    }
     // unique per WRITER, not just per pid: concurrent transcoders of the
     // same unit inside one process (threads) must never share a temp
     static std::atomic<uint64_t> seq{0};
     uniq_ = std::to_string(getpid()) + "." +
             std::to_string(seq.fetch_add(1));
     tmp_ = stem + ".dshard.tmp." + uniq_;
-    fd_ = open(tmp_.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    fd_ = fsio::Open(tmp_.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
     if (fd_ < 0) {
       throw Error("cannot create shard cache temp " + tmp_ + ": " +
                   std::strerror(errno));
     }
-    // header placeholder; counts patched in at Finalize
-    char zero[kHeaderBytes] = {0};
-    WriteAll(fd_, zero, sizeof(zero), "header");
+    try {
+      // header placeholder; counts patched in at Finalize
+      char zero[kHeaderBytes] = {0};
+      fsio::WriteAllFd(fd_, zero, sizeof(zero), tmp_);
+    } catch (...) {
+      // a half-constructed writer owns its fd/temp: release the fd and
+      // QUARANTINE the partial (the impl destructor never runs when the
+      // constructor throws) — same I/O-fault landing as a failed tee,
+      // so the documented degradation matrix holds for this path too
+      Quarantine();
+      throw;
+    }
     bytes_ = kHeaderBytes;
   }
 
@@ -282,7 +307,7 @@ class ShardCacheWriterImpl {
         AppendArray(&buf_, b.value);
       }
     }
-    WriteAll(fd_, buf_.data(), buf_.size(), "block");
+    fsio::WriteAllFd(fd_, buf_.data(), buf_.size(), tmp_);
     hash_.Update(buf_.data(), buf_.size());
     bytes_ += buf_.size();
     ++blocks_;
@@ -306,21 +331,26 @@ class ShardCacheWriterImpl {
     RawKeyDigest(key_text_, digest);
     hdr.insert(hdr.end(), digest, digest + 32);
     hdr.resize(kHeaderBytes, '\0');
-    if (pwrite(fd_, hdr.data(), hdr.size(), 0) !=
-        static_cast<ssize_t>(hdr.size())) {
-      throw Error("cannot write shard cache header: " +
-                  std::string(std::strerror(errno)));
+    if (fsio::Pwrite(fd_, hdr.data(), hdr.size(), 0) !=
+        static_cast<long>(hdr.size())) {
+      throw fsio::FsError(fsio::FsOp::kWrite, tmp_,
+                          errno != 0 ? errno : EIO);
     }
     // durability dance: file fsync -> atomic rename -> dir fsync, and the
     // manifest only AFTER the shard is durable (a crash between the two
     // leaves shard-without-manifest = a clean miss)
-    DCT_CHECK(fsync(fd_) == 0) << "shard cache fsync failed";
+    if (fsio::Fsync(fd_) != 0) {
+      throw fsio::FsError(fsio::FsOp::kFsync, tmp_,
+                          errno != 0 ? errno : EIO);
+    }
     close(fd_);
     fd_ = -1;
     const std::string shard_path = stem_ + ".dshard";
-    DCT_CHECK(std::rename(tmp_.c_str(), shard_path.c_str()) == 0)
-        << "cannot publish shard cache " << shard_path;
-    FsyncDirOf(shard_path);
+    if (fsio::Rename(tmp_.c_str(), shard_path.c_str()) != 0) {
+      throw fsio::FsError(fsio::FsOp::kRename, shard_path,
+                          errno != 0 ? errno : EIO);
+    }
+    fsio::FsyncDirOf(shard_path);
     // manifest: same temp+fsync+rename dance
     size_t slash = shard_path.find_last_of('/');
     const std::string shard_name = slash == std::string::npos
@@ -340,18 +370,24 @@ class ShardCacheWriterImpl {
       << "nnz=" << nnz_ << "\n"
       << "key=" << key_text_ << "\n";
     const std::string mtmp = stem_ + ".manifest.tmp." + uniq_;
-    int mfd = open(mtmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
-    DCT_CHECK(mfd >= 0) << "cannot create manifest temp " << mtmp;
+    int mfd = fsio::Open(mtmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    DCT_CHECK(mfd >= 0) << "cannot create manifest temp " << mtmp << ": "
+                        << std::strerror(errno);
     try {
       const std::string ms = m.str();
-      WriteAll(mfd, ms.data(), ms.size(), "manifest");
-      DCT_CHECK(fsync(mfd) == 0) << "manifest fsync failed";
+      fsio::WriteAllFd(mfd, ms.data(), ms.size(), mtmp);
+      if (fsio::Fsync(mfd) != 0) {
+        throw fsio::FsError(fsio::FsOp::kFsync, mtmp,
+                            errno != 0 ? errno : EIO);
+      }
       close(mfd);
       mfd = -1;
       const std::string mpath = stem_ + ".manifest";
-      DCT_CHECK(std::rename(mtmp.c_str(), mpath.c_str()) == 0)
-          << "cannot publish shard cache manifest " << mpath;
-      FsyncDirOf(mpath);
+      if (fsio::Rename(mtmp.c_str(), mpath.c_str()) != 0) {
+        throw fsio::FsError(fsio::FsOp::kRename, mpath,
+                            errno != 0 ? errno : EIO);
+      }
+      fsio::FsyncDirOf(mpath);
     } catch (...) {
       if (mfd >= 0) close(mfd);
       std::remove(mtmp.c_str());
@@ -369,6 +405,22 @@ class ShardCacheWriterImpl {
     // leaving the temp behind; uniq_ makes the name this writer's own,
     // and after a successful publish the remove is a harmless no-op
     std::remove(tmp_.c_str());
+  }
+
+  void Quarantine() {
+    // The I/O-fault landing: keep the partial bytes for inspection under
+    // a name the age-based sweep will eventually reap, instead of
+    // destroying the evidence of WHAT got torn. Raw rename on purpose —
+    // the error path must never recurse into injection; if even that
+    // fails, fall back to deleting the temp.
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+    const std::string q = tmp_ + ".quarantined";
+    if (std::rename(tmp_.c_str(), q.c_str()) != 0) {
+      std::remove(tmp_.c_str());
+    }
   }
 
   uint64_t blocks() const { return blocks_; }
@@ -407,6 +459,11 @@ void ShardCacheWriter<IndexType>::Abandon() {
 }
 
 template <typename IndexType>
+void ShardCacheWriter<IndexType>::Quarantine() {
+  impl_->Quarantine();
+}
+
+template <typename IndexType>
 uint64_t ShardCacheWriter<IndexType>::blocks() const {
   return impl_->blocks();
 }
@@ -431,12 +488,15 @@ class MmapShardReaderImpl {
     if (map_ != MAP_FAILED) munmap(map_, map_size_);
   }
 
-  // returns false on any validation miss (never throws for corruption)
+  // returns false on any validation miss (never throws for corruption —
+  // and injected/real read faults here are misses too: replay validation
+  // must stand down to the text lane, never wedge the epoch)
   bool Open(const std::string& stem, const std::string& key_text,
             bool index64) {
     // 1. manifest: k=v lines, first line is the version sentinel
-    std::ifstream mf(stem + ".manifest");
-    if (!mf.is_open()) return false;
+    std::string mtext;
+    if (!fsio::ReadFileToString(stem + ".manifest", &mtext)) return false;
+    std::istringstream mf(mtext);
     std::string line;
     if (!std::getline(mf, line) ||
         line != "dshard-manifest-v" + std::to_string(kShardCacheVersion)) {
@@ -460,7 +520,7 @@ class MmapShardReaderImpl {
     //    stat-by-path before open: a concurrent publish rename()ing a
     //    different shard over the path between the two would map the
     //    new file with the old length and SIGBUS on the checksum walk
-    int fd = open(shard_path.c_str(), O_RDONLY);
+    int fd = fsio::Open(shard_path.c_str(), O_RDONLY);
     if (fd < 0) return false;
     struct stat st;
     if (fstat(fd, &st) != 0 ||
@@ -469,7 +529,7 @@ class MmapShardReaderImpl {
       return false;
     }
     map_size_ = static_cast<size_t>(st.st_size);
-    map_ = mmap(nullptr, map_size_, PROT_READ, MAP_PRIVATE, fd, 0);
+    map_ = fsio::Mmap(map_size_, PROT_READ, MAP_PRIVATE, fd);
     close(fd);  // the mapping outlives the descriptor
     if (map_ == MAP_FAILED) return false;
     madvise(map_, map_size_, MADV_SEQUENTIAL);
@@ -698,10 +758,11 @@ Parser<IndexType>* ShardCacheParser<IndexType>::EnsureBase() {
     try {
       writer_.reset(new ShardCacheWriter<IndexType>(stem_, key_text_));
     } catch (...) {
-      // an unusable cache dir (read-only, uncreatable): an EXPLICIT
-      // opt-in must error loudly (the URI-sugar no-op rule), but a
-      // process-wide env dir must not break unrelated text lanes —
-      // degrade to "no cache" for this pass
+      // an unusable cache dir (read-only, uncreatable, ENOSPC at the
+      // header): an EXPLICIT opt-in must error loudly (the URI-sugar
+      // no-op rule), but a process-wide env dir must not break unrelated
+      // text lanes — degrade to "no cache" for this pass
+      CacheTel().write_errors->Add(1);
       if (cfg_.explicit_opt_in) throw;
       PoisonTranscode();
     }
@@ -717,13 +778,16 @@ void ShardCacheParser<IndexType>::FinishTranscode() {
     writer_->Finalize();
   } catch (...) {
     // a failed PUBLISH (disk fills at the header patch, cache dir
-    // removed mid-run): the text lane already served every block of
-    // this epoch correctly, so an env-only opt-in degrades to "no
-    // cache" (the next pass re-tees from the start); an explicit
+    // removed mid-run, torn rename): the text lane already served every
+    // block of this epoch correctly, so an env-only opt-in degrades to
+    // "no cache" (the next pass re-tees from the start); an explicit
     // opt-in surfaces the error — the caller asked for a cache it
     // will not get. refresh_pending_ stays set so a later BeforeFirst
-    // cannot replay the stale pre-refresh shard.
-    writer_->Abandon();
+    // cannot replay the stale pre-refresh shard. The partial temp is
+    // QUARANTINED (kept for inspection under a swept name), never
+    // published.
+    CacheTel().write_errors->Add(1);
+    writer_->Quarantine();
     writer_.reset();
     if (cfg_.explicit_opt_in) throw;
     return;
@@ -733,12 +797,16 @@ void ShardCacheParser<IndexType>::FinishTranscode() {
 }
 
 template <typename IndexType>
-void ShardCacheParser<IndexType>::PoisonTranscode() {
+void ShardCacheParser<IndexType>::PoisonTranscode(bool quarantine) {
   // write_complete_=true keeps EnsureBase from re-teeing mid-pass (the
   // stream already has a hole); the next BeforeFirst resets it and a
   // fresh pass re-tees from the start
   if (writer_ != nullptr) {
-    writer_->Abandon();
+    if (quarantine) {
+      writer_->Quarantine();
+    } else {
+      writer_->Abandon();
+    }
     writer_.reset();
   }
   write_complete_ = true;
@@ -761,12 +829,18 @@ template <typename IndexType>
 void ShardCacheParser<IndexType>::TeeBlock(
     const RowBlockContainer<IndexType>& b) {
   if (writer_ == nullptr) return;
-  // a failed tee (disk full, unwritable cache dir) degrades to "no
-  // cache" for this pass — it never breaks the text lane
+  // a failed tee (disk full, EIO, short write): the partial temp is
+  // QUARANTINED and counted; an env-enabled cache stands down to the
+  // text lane for the rest of the epoch (the consumer already has this
+  // block — the stream is unaffected), while an EXPLICIT ?cache=/
+  // #cachefile=/API opt-in errors loudly — the caller asked for a cache
+  // this epoch will not produce (doc/robustness.md "Local durability")
   try {
     writer_->Append(b);
   } catch (...) {
-    PoisonTranscode();
+    CacheTel().write_errors->Add(1);
+    PoisonTranscode(/*quarantine=*/true);
+    if (cfg_.explicit_opt_in) throw;
   }
 }
 

@@ -118,6 +118,10 @@ class ShardCacheWriter {
   void Append(const RowBlockContainer<IndexType>& b);
   void Finalize();
   void Abandon();
+  // Like Abandon, but the partial temp is kept under `.quarantined` (the
+  // I/O-fault landing — doc/robustness.md "Local durability"); the
+  // age-based sweep at writer construction reaps it later.
+  void Quarantine();
   uint64_t blocks() const;
 
  private:
@@ -190,7 +194,9 @@ class ShardCacheParser : public Parser<IndexType> {
   // temp and stop teeing until the next BeforeFirst re-tees from the
   // start. Also the landing for a failed tee itself (disk full): the
   // cache degrades to "no cache", it never breaks the text lane.
-  void PoisonTranscode();
+  // `quarantine` keeps the partial temp under `.quarantined` (the cache
+  // I/O-fault path) instead of deleting it (the parse-error path).
+  void PoisonTranscode(bool quarantine = false);
   const RowBlockContainer<IndexType>* PullBase();  // NextBlock + poison
   void TeeBlock(const RowBlockContainer<IndexType>& b);
 

@@ -20,6 +20,7 @@ std::atomic<int> g_enabled{-1};  // -1: unresolved (read env on first use)
 
 struct CounterEntry {
   std::string name;
+  std::map<std::string, std::string> labels;
   Counter owned;
   std::atomic<uint64_t>* external = nullptr;  // wins over `owned` when set
   uint64_t value() const {
@@ -120,15 +121,21 @@ void SetEnabled(bool on) {
 }
 
 Counter* GetCounter(const std::string& name) {
+  return GetCounter(name, {});
+}
+
+Counter* GetCounter(const std::string& name,
+                    const std::map<std::string, std::string>& labels) {
   Registry& r = Reg();
   std::lock_guard<std::mutex> lk(r.mu);
   for (auto& e : r.counters) {
     // an externally-backed entry still hands out its owned counter: adds
     // to it are shadowed in the snapshot (external wins), never a crash
-    if (e.name == name) return &e.owned;
+    if (e.name == name && e.labels == labels) return &e.owned;
   }
   r.counters.emplace_back();
   r.counters.back().name = name;
+  r.counters.back().labels = labels;
   return &r.counters.back().owned;
 }
 
@@ -137,7 +144,7 @@ void RegisterExternalCounter(const std::string& name,
   Registry& r = Reg();
   std::lock_guard<std::mutex> lk(r.mu);
   for (auto& e : r.counters) {
-    if (e.name == name) {
+    if (e.name == name && e.labels.empty()) {
       e.external = v;
       return;
     }
@@ -220,7 +227,7 @@ std::string SnapshotJson() {
       if (!first) out += ',';
       first = false;
       out += '{';
-      AppendNameLabels(e.name, {}, &out);
+      AppendNameLabels(e.name, e.labels, &out);
       out += ",\"value\":";
       out += std::to_string(e.value());
       out += '}';

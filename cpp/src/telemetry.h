@@ -107,6 +107,10 @@ class Hist {
 // the process lifetime; resolve once, keep the pointer. Names follow the
 // Prometheus convention (snake_case, *_total counters, unit suffix).
 Counter* GetCounter(const std::string& name);
+// Labeled counter variant (fs_fault_injected_total{op=} et al.) — same
+// stability contract; the unlabeled overload is (name, {}).
+Counter* GetCounter(const std::string& name,
+                    const std::map<std::string, std::string>& labels);
 Gauge* GetGauge(const std::string& name);
 Hist* GetHist(const std::string& name,
               const std::map<std::string, std::string>& labels = {});

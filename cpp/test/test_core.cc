@@ -21,6 +21,7 @@
 #include <sstream>
 #include <string>
 #include <sys/stat.h>
+#include <utime.h>
 
 #include <atomic>
 #include <list>
@@ -36,6 +37,7 @@
 #include "../src/memory.h"
 #include "../src/pipeline.h"
 #include "../src/filesys.h"
+#include "../src/fs_fault.h"
 #include "../src/input_split.h"
 #include "../src/iostream_bridge.h"
 #include "../src/json.h"
@@ -2081,13 +2083,13 @@ bool SameBlocks(const dct::RowBlockContainer<uint32_t>& a,
          a.value_dtype == b.value_dtype;
 }
 
-dct::ShardCacheParser<uint32_t>* MakeCacheParser(const std::string& uri,
-                                                 const std::string& dir,
-                                                 dct::ShardCacheMode mode) {
+dct::ShardCacheParser<uint32_t>* MakeCacheParser(
+    const std::string& uri, const std::string& dir, dct::ShardCacheMode mode,
+    bool explicit_opt_in = true) {
   dct::ShardCacheConfig cfg;
   cfg.dir = dir;
   cfg.mode = mode;
-  cfg.explicit_opt_in = true;
+  cfg.explicit_opt_in = explicit_opt_in;
   const std::string key = dct::ShardCacheKeyText(uri, 0, 1, "libsvm",
                                                  false, {});
   return new dct::ShardCacheParser<uint32_t>(
@@ -2875,6 +2877,413 @@ void RunShardCacheSuite() {
   TestShardCacheCrashRecoveryAndCorruption();
 }
 
+// ---- local-durability plane (fs_fault.h) -- the `--fsfault` suite --------
+// Run standalone (test_core --fsfault) by the cpp/Makefile asan-fsfault
+// lane: the DMLC_FS_FAULT_PLAN matrix across transcode / publish / replay
+// / local streams, asserting every outcome is exactly one of {clean miss
+// + re-transcode, byte-identical replay, structured loud error} — never
+// corrupt bytes, never a wedged pass. Each case clears the plan on exit
+// (an explicit clear beats the env forever).
+
+// RAII plan guard: a failing EXPECT mid-case must not leak a plan into
+// the next case.
+struct ScopedFsPlan {
+  explicit ScopedFsPlan(const std::string& plan) {
+    dct::fsio::SetFsFaultPlan(plan);
+  }
+  ~ScopedFsPlan() { dct::fsio::SetFsFaultPlan(""); }
+};
+
+uint64_t FsFaultCount(const char* op) {
+  return dct::telemetry::GetCounter("fs_fault_injected_total",
+                                    {{"op", op}})->value();
+}
+
+uint64_t CacheWriteErrors() {
+  return dct::telemetry::GetCounter("cache_write_errors_total")->value();
+}
+
+bool DirHas(const std::string& dir, const std::string& needle,
+            bool suffix = false) {
+  std::vector<dct::FileInfo> items;
+  dct::FileSystem::GetInstance(dct::URI(dir.c_str()))
+      ->ListDirectory(dct::URI(dir.c_str()), &items);
+  for (const auto& fi : items) {
+    const std::string& p = fi.path.path;
+    if (suffix) {
+      if (p.size() >= needle.size() &&
+          p.compare(p.size() - needle.size(), needle.size(), needle) == 0) {
+        return true;
+      }
+    } else if (p.find(needle) != std::string::npos) {
+      return true;
+    }
+  }
+  return false;
+}
+
+void TestFsFaultPlanGrammar() {
+  const char* bad[] = {
+      "write",                           // no params
+      "write:every=2",                   // no fault
+      "write:fault=eio",                 // no selector
+      "write:fault=bogus,every=2",       // unknown fault
+      "frobnicate:fault=eio,every=2",    // unknown op
+      "read:fault=torn_rename,every=1",  // impossible combo
+      "mmap:fault=short_write,every=1",  // impossible combo
+      "write:fault=eio,every=0",         // every < 1
+      "write:fault=eio,p=1.5",           // p out of range
+      "write:fault=eio,garbage",         // malformed param
+      "write:fault=eio,every=5,p=1.0",   // both selectors (ambiguous)
+  };
+  for (const char* plan : bad) {
+    bool threw = false;
+    try {
+      dct::fsio::SetFsFaultPlan(plan);
+    } catch (const dct::Error&) {
+      threw = true;
+    }
+    EXPECT(threw);
+  }
+  // good plans parse (and clear cleanly)
+  dct::fsio::SetFsFaultPlan(
+      "write:fault=enospc,every=3;rename:fault=torn_rename,p=0.5;"
+      "fsync:fault=fsync_fail,every=1;open:fault=eio,p=1.0;"
+      "read:fault=eio,every=7;mmap:fault=eio,every=2");
+  dct::fsio::SetFsFaultPlan("");
+}
+
+void TestFsFaultLocalStreamStructuredErrors() {
+  dct::TemporaryDirectory tmp;
+  const std::string path = tmp.path() + "/f.bin";
+  // injected ENOSPC on write: FsError naming the path + errno text
+  {
+    ScopedFsPlan plan("write:fault=enospc,every=1");
+    std::unique_ptr<dct::Stream> s(dct::Stream::Create(path.c_str(), "w"));
+    bool threw = false;
+    try {
+      s->Write("abcdefgh", 8);
+    } catch (const dct::fsio::FsError& e) {
+      threw = true;
+      EXPECT(std::string(e.what()).find(path) != std::string::npos);
+      EXPECT(e.error_number() == ENOSPC);
+    }
+    EXPECT(threw);
+    EXPECT(FsFaultCount("write") >= 1);
+  }
+  // short_write: HALF the bytes really land before the error — the torn
+  // artifact crash-consistent callers must clean up
+  {
+    ScopedFsPlan plan("write:fault=short_write,every=2");
+    std::unique_ptr<dct::Stream> s(dct::Stream::Create(path.c_str(), "w"));
+    s->Write("12345678", 8);  // op 1: clean
+    bool threw = false;
+    try {
+      s->Write("abcdefgh", 8);  // op 2: half lands, then ENOSPC
+    } catch (const dct::fsio::FsError&) {
+      threw = true;
+    }
+    EXPECT(threw);
+    s->Finish();
+  }
+  {
+    std::unique_ptr<dct::SeekStream> r(
+        dct::SeekStream::CreateForRead(path.c_str()));
+    char buf[32];
+    size_t n = r->Read(buf, sizeof(buf));
+    EXPECT(n == 12);  // 8 clean + 4 torn
+    EXPECT(std::memcmp(buf, "12345678abcd", 12) == 0);
+  }
+  // injected EIO on read: structured throw, never a silent short read
+  {
+    ScopedFsPlan plan("read:fault=eio,every=1");
+    std::unique_ptr<dct::SeekStream> r(
+        dct::SeekStream::CreateForRead(path.c_str()));
+    bool threw = false;
+    char buf[8];
+    try {
+      r->Read(buf, sizeof(buf));
+    } catch (const dct::fsio::FsError& e) {
+      threw = true;
+      EXPECT(e.op() == dct::fsio::FsOp::kRead);
+    }
+    EXPECT(threw);
+  }
+  // injected open fault honors allow_null (probe shape) and errors
+  // loudly otherwise
+  {
+    ScopedFsPlan plan("open:fault=eio,p=1.0");
+    EXPECT(dct::SeekStream::CreateForRead(path.c_str(), true) == nullptr);
+    bool threw = false;
+    try {
+      delete dct::SeekStream::CreateForRead(path.c_str(), false);
+    } catch (const dct::Error& e) {
+      threw = true;
+      EXPECT(std::string(e.what()).find("Input/output") !=
+             std::string::npos);
+    }
+    EXPECT(threw);
+  }
+}
+
+void TestFsFaultTranscodeDegradesEnvOnlyAndQuarantines() {
+  dct::TemporaryDirectory tmp;
+  const std::string uri = WriteCacheCorpus(tmp.path(), 3000);
+  const std::string cdir = tmp.path() + "/cache";
+  std::unique_ptr<dct::Parser<uint32_t>> plain(
+      dct::Parser<uint32_t>::Create(uri, 0, 1, "libsvm", 2, true));
+  auto text = DrainParser(plain.get());
+  const uint64_t errs0 = CacheWriteErrors();
+  {
+    // ENOSPC mid-tee under an ENV-ONLY cache: the epoch completes on the
+    // text lane byte-identically, the partial temp is QUARANTINED, and
+    // nothing is published
+    ScopedFsPlan plan("write:fault=enospc,every=2");
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(MakeCacheParser(
+        uri, cdir, dct::ShardCacheMode::kAuto, /*explicit_opt_in=*/false));
+    EXPECT(!p->replaying());
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+  }
+  EXPECT(CacheWriteErrors() > errs0);
+  EXPECT(DirHas(cdir, ".quarantined", /*suffix=*/true));
+  EXPECT(!DirHas(cdir, ".manifest", /*suffix=*/true));
+  {
+    // the SAME fault under an EXPLICIT opt-in errors loudly
+    ScopedFsPlan plan("write:fault=enospc,every=2");
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(MakeCacheParser(
+        uri, cdir, dct::ShardCacheMode::kAuto, /*explicit_opt_in=*/true));
+    bool threw = false;
+    try {
+      DrainParser(p.get());
+    } catch (const dct::Error&) {
+      threw = true;
+    }
+    EXPECT(threw);
+  }
+  // plan cleared: transcode publishes and replays byte-identical
+  {
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+    p->BeforeFirst();
+    EXPECT(p->replaying());
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+  }
+}
+
+void TestFsFaultPublishFaultsNeverCorrupt() {
+  dct::TemporaryDirectory tmp;
+  const std::string uri = WriteCacheCorpus(tmp.path(), 2000);
+  const std::string cdir = tmp.path() + "/cache";
+  std::unique_ptr<dct::Parser<uint32_t>> plain(
+      dct::Parser<uint32_t>::Create(uri, 0, 1, "libsvm", 2, true));
+  auto text = DrainParser(plain.get());
+  const char* publish_plans[] = {
+      "fsync:fault=fsync_fail,every=1",   // durability cut at the fsync
+      "rename:fault=torn_rename,every=1", // crash-mid-publish artifact
+      "rename:fault=eio,every=1",         // plain rename failure
+  };
+  for (const char* text_plan : publish_plans) {
+    // env-only: the pass degrades (text bytes already served), nothing
+    // VALID is ever visible under the published names
+    {
+      ScopedFsPlan plan(text_plan);
+      std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(MakeCacheParser(
+          uri, cdir, dct::ShardCacheMode::kAuto, /*explicit_opt_in=*/false));
+      EXPECT(SameBlocks(text, DrainParser(p.get())));
+    }
+    // whatever debris the fault left (torn shard, temp, no manifest):
+    // the next open is a clean miss that re-transcodes byte-identically,
+    // then replays
+    {
+      std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+          MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+      EXPECT(SameBlocks(text, DrainParser(p.get())));
+      p->BeforeFirst();
+      EXPECT(p->replaying());
+      EXPECT(SameBlocks(text, DrainParser(p.get())));
+    }
+    // explicit opt-in on the same publish fault errors loudly (refresh
+    // forces the re-transcode so the publish path actually runs)
+    {
+      ScopedFsPlan plan(text_plan);
+      std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(MakeCacheParser(
+          uri, cdir, dct::ShardCacheMode::kRefresh,
+          /*explicit_opt_in=*/true));
+      bool threw = false;
+      try {
+        DrainParser(p.get());
+      } catch (const dct::Error&) {
+        threw = true;
+      }
+      EXPECT(threw);
+    }
+    // clean up for the next plan: re-publish a valid unit
+    {
+      std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(MakeCacheParser(
+          uri, cdir, dct::ShardCacheMode::kRefresh));
+      EXPECT(SameBlocks(text, DrainParser(p.get())));
+    }
+  }
+}
+
+void TestFsFaultReplayReadFaultsMissCleanly() {
+  dct::TemporaryDirectory tmp;
+  const std::string uri = WriteCacheCorpus(tmp.path(), 2000);
+  const std::string cdir = tmp.path() + "/cache";
+  std::unique_ptr<dct::Parser<uint32_t>> plain(
+      dct::Parser<uint32_t>::Create(uri, 0, 1, "libsvm", 2, true));
+  auto text = DrainParser(plain.get());
+  {
+    // publish a valid unit
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+    EXPECT(SameBlocks(text, DrainParser(p.get())));
+  }
+  const char* read_plans[] = {
+      "mmap:fault=eio,every=1",
+      "open:fault=eio,every=2",  // every=2: the text-source fopen draws
+                                 // op 1, the shard open draws op 2
+      "read:fault=eio,every=1",  // manifest read
+  };
+  for (const char* text_plan : read_plans) {
+    ScopedFsPlan plan(text_plan);
+    // validation must MISS (never throw) and the epoch must re-serve
+    // correct bytes — from text, re-transcoding when the writes survive
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(MakeCacheParser(
+        uri, cdir, dct::ShardCacheMode::kAuto, /*explicit_opt_in=*/false));
+    EXPECT(!p->replaying());
+    bool served = false;
+    try {
+      served = SameBlocks(text, DrainParser(p.get()));
+    } catch (const dct::Error&) {
+      // read faults can also hit the text source itself (open/read
+      // plans): a structured error is an allowed gauntlet outcome —
+      // never corrupt bytes
+      served = true;
+    }
+    EXPECT(served);
+  }
+  // plans cleared: the published (or re-published) unit still replays
+  std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+      MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+  EXPECT(p->replaying());
+  EXPECT(SameBlocks(text, DrainParser(p.get())));
+}
+
+void TestFsFaultGcSweepsStaleTempsOnly() {
+  dct::TemporaryDirectory tmp;
+  const std::string uri = WriteCacheCorpus(tmp.path(), 600);
+  const std::string cdir = tmp.path() + "/cache";
+  mkdir(cdir.c_str(), 0755);
+  // debris of three ages/shapes: an ancient temp (reap), an ancient
+  // quarantined partial (reap), a FRESH temp — a live concurrent
+  // transcoder's staging file (keep) — and a foreign user file (keep)
+  const std::string old_tmp = cdir + "/deadbeef.p0.n1.dshard.tmp.1.0";
+  const std::string old_q =
+      cdir + "/deadbeef.p0.n1.dshard.tmp.2.0.quarantined";
+  const std::string fresh_tmp = cdir + "/cafe.p0.n1.dshard.tmp.3.0";
+  const std::string foreign = cdir + "/users-notes.txt";
+  for (const std::string& p : {old_tmp, old_q, fresh_tmp, foreign}) {
+    std::ofstream(p) << "x";
+  }
+  struct utimbuf ancient;
+  ancient.actime = ancient.modtime = time(nullptr) - 3 * 86400;
+  EXPECT(utime(old_tmp.c_str(), &ancient) == 0);
+  EXPECT(utime(old_q.c_str(), &ancient) == 0);
+  {
+    // writer construction sweeps (the transcode is incidental)
+    std::unique_ptr<dct::ShardCacheParser<uint32_t>> p(
+        MakeCacheParser(uri, cdir, dct::ShardCacheMode::kAuto));
+    DrainParser(p.get());
+  }
+  EXPECT(!DirHas(cdir, "dshard.tmp.1.0", /*suffix=*/true));
+  EXPECT(!DirHas(cdir, ".quarantined", /*suffix=*/true));
+  EXPECT(DirHas(cdir, "cafe.p0.n1.dshard.tmp.3.0", /*suffix=*/true));
+  EXPECT(DirHas(cdir, "users-notes.txt", /*suffix=*/true));
+}
+
+void TestFsFaultRecordIOStructuredTruncation() {
+  dct::TemporaryDirectory tmp;
+  const std::string path = tmp.path() + "/r.rec";
+  {
+    std::unique_ptr<dct::Stream> s(dct::Stream::Create(path.c_str(), "w"));
+    dct::RecordIOWriter w(s.get());
+    for (int i = 0; i < 8; ++i) {
+      std::string rec(64 + i, static_cast<char>('a' + i));
+      w.WriteRecord(rec.data(), rec.size());
+    }
+    s->Finish();
+  }
+  // cut mid-record: the reader must name WHERE the stream broke
+  struct stat st;
+  EXPECT(stat(path.c_str(), &st) == 0);
+  EXPECT(truncate(path.c_str(), st.st_size - 30) == 0);
+  {
+    std::unique_ptr<dct::SeekStream> s(
+        dct::SeekStream::CreateForRead(path.c_str()));
+    dct::RecordIOReader r(s.get());
+    std::string rec;
+    bool threw = false;
+    int got = 0;
+    try {
+      while (r.NextRecord(&rec)) ++got;
+    } catch (const dct::Error& e) {
+      threw = true;
+      EXPECT(std::string(e.what()).find("record 7") != std::string::npos ||
+             std::string(e.what()).find("truncated") != std::string::npos);
+    }
+    EXPECT(threw);
+    EXPECT(got == 7);  // every complete record before the tear survives
+  }
+  // injected EIO below the reader surfaces as a structured FsError
+  {
+    ScopedFsPlan plan("read:fault=eio,every=2");
+    std::unique_ptr<dct::SeekStream> s(
+        dct::SeekStream::CreateForRead(path.c_str()));
+    dct::RecordIOReader r(s.get());
+    std::string rec;
+    bool threw = false;
+    try {
+      while (r.NextRecord(&rec)) {
+      }
+    } catch (const dct::fsio::FsError& e) {
+      threw = true;
+      EXPECT(e.op() == dct::fsio::FsOp::kRead);
+    }
+    EXPECT(threw);
+  }
+}
+
+void TestFsFaultEveryNDeterminism() {
+  dct::TemporaryDirectory tmp;
+  const std::string path = tmp.path() + "/n.bin";
+  const uint64_t fired0 = FsFaultCount("write");
+  ScopedFsPlan plan("write:fault=eio,every=3");
+  std::unique_ptr<dct::Stream> s(dct::Stream::Create(path.c_str(), "w"));
+  int threw = 0;
+  for (int i = 0; i < 12; ++i) {
+    try {
+      s->Write("x", 1);
+    } catch (const dct::fsio::FsError&) {
+      ++threw;
+    }
+  }
+  EXPECT(threw == 4);  // ops 3, 6, 9, 12 — exact, not approximate
+  EXPECT(FsFaultCount("write") - fired0 == 4);
+}
+
+void RunFsFaultSuite() {
+  TestFsFaultPlanGrammar();
+  TestFsFaultLocalStreamStructuredErrors();
+  TestFsFaultTranscodeDegradesEnvOnlyAndQuarantines();
+  TestFsFaultPublishFaultsNeverCorrupt();
+  TestFsFaultReplayReadFaultsMissCleanly();
+  TestFsFaultGcSweepsStaleTempsOnly();
+  TestFsFaultRecordIOStructuredTruncation();
+  TestFsFaultEveryNDeterminism();
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -2935,6 +3344,18 @@ int main(int argc, char** argv) {
     // ubsan-test lanes run exactly this (validation must yield a clean
     // miss or an in-bounds replay, never a crash/OOB)
     FuzzShardCache(argc > 2 ? std::atoi(argv[2]) : 400);  // env-ok: test CLI
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
+  if (argc > 1 && std::string(argv[1]) == "--fsfault") {
+    // the local-durability suite alone — the cpp/Makefile asan-fsfault
+    // lane runs exactly this under AddressSanitizer (the quarantine/
+    // degrade paths walk mmap pointers and partial buffers)
+    RunFsFaultSuite();
     if (g_failures == 0) {
       std::printf("OK\n");
       return 0;
@@ -3003,6 +3424,7 @@ int main(int argc, char** argv) {
   RunRangeReaderSuite();
   RunTelemetrySuite();
   RunShardCacheSuite();
+  RunFsFaultSuite();
   if (g_failures == 0) {
     std::printf("OK\n");
     return 0;

@@ -172,6 +172,7 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_io_stats_reset": [],
         "dct_io_set_fault_plan": [c.c_char_p],
         "dct_io_set_timeout_ms": [i],
+        "dct_fs_set_fault_plan": [c.c_char_p],
         "dct_parser_formats_doc": [c.POINTER(c.c_char_p)],
         "dct_batcher_create": [c.c_char_p, u, u, c.c_char_p, i, i,
                                c.c_uint64, c.c_uint32, c.c_uint64,
@@ -467,6 +468,27 @@ def set_io_fault_plan(plan: str) -> None:
     mutating DMLC_IO_FAULT_PLAN after native threads exist (same race rule
     as the TLS-proxy override)."""
     _check(lib().dct_io_set_fault_plan(plan.encode()))
+
+
+def set_fs_fault_plan(plan: str) -> None:
+    """Install a deterministic LOCAL-filesystem fault plan inside the
+    native syscall wrappers (cpp/src/fs_fault.h) — below every mock, so
+    the durability chaos suites exercise the real quarantine/degradation
+    machinery. Grammar, rules ';'-separated::
+
+        write:fault=enospc,every=3;rename:fault=torn_rename,p=0.5
+
+    ops: ``open``, ``read``, ``write``, ``fsync``, ``rename``, ``mmap``;
+    faults: ``eio``, ``enospc``, ``short_write`` (half the bytes really
+    land, then ENOSPC), ``fsync_fail``, ``torn_rename`` (destination gets
+    a truncated half-copy, source is gone, call fails); selectors
+    ``every=N`` or seeded ``p=`` (DMLC_FS_FAULT_SEED). Empty string
+    clears; an explicit clear beats DMLC_FS_FAULT_PLAN. Raises on bad
+    grammar or an impossible op/fault combination. The PYTHON-side file
+    ops (checkpoint, tracker event log) share this grammar via
+    :mod:`dmlc_core_tpu.utils.fs_fault`; this setter drives the native
+    half only."""
+    _check(lib().dct_fs_set_fault_plan(plan.encode()))
 
 
 def set_io_timeout_ms(ms: int) -> None:

@@ -59,7 +59,9 @@ import time
 from typing import Callable, Dict, List, Optional, Set
 
 from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.base import DMLCError as _DMLCError
 from dmlc_core_tpu.tracker import topology
+from dmlc_core_tpu.utils import fs_fault as _fs_fault
 from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
                                         HEARTBEAT_BYE, LEASE_ACQUIRE,
                                         LEASE_COMPLETE, LEASE_DRAINED,
@@ -139,11 +141,20 @@ class _EventLog:
     rotation (current file moves to ``<path>.1`` at the cap, so a
     long-running job holds at most ~2x the cap on disk instead of filling
     it) and an fsync'd flush for the abort path (a crashing job must not
-    lose its last events to userspace buffering)."""
+    lose its last events to userspace buffering).
+
+    Local-durability contract (doc/robustness.md): a write or rotation
+    failure — full disk, EIO, torn rename — is CONTAINED here: the line
+    is dropped and counted in ``event_log_dropped_total``, the serve loop
+    never sees the exception. Every file op is injectable through the
+    Python fault plan (utils.fs_fault), which the containment tests
+    drive."""
 
     def __init__(self, path: str, max_bytes: int):
         self._path = path
         self._max_bytes = max_bytes  # 0 = rotation off
+        self._dropped = telemetry.counter("event_log_dropped_total")
+        self._warned_bad_plan = False
         self._fp = open(path, "a", buffering=1)
         try:
             self._size = os.path.getsize(path)
@@ -152,19 +163,48 @@ class _EventLog:
 
     def write(self, line: str) -> None:
         """Append one JSONL line, rotating first when it would cross the
-        cap. I/O errors are swallowed — a full disk must not kill the
-        rendezvous (same contract the un-hardened sink had)."""
+        cap. I/O errors drop the line and bump the counter — a full disk
+        must not kill the rendezvous, and a silent drop must not read as
+        a healthy log. A MALFORMED DMLC_FS_FAULT_PLAN (which the lazy
+        env parse surfaces as DMLCError on the first probe) is contained
+        the same way — warned once, never propagated: every other
+        surface still errors loudly on the typo'd plan, but the serve
+        loop is exactly what this sink exists to protect."""
         try:
+            _fs_fault.maybe_inject("write", self._path)
             if self._max_bytes > 0 and self._size + len(line) > \
                     self._max_bytes and self._size > 0:
                 self._fp.close()
-                os.replace(self._path, self._path + ".1")
+                _fs_fault.checked_replace(self._path, self._path + ".1")
                 self._fp = open(self._path, "a", buffering=1)
                 self._size = 0
             self._fp.write(line)
             self._size += len(line)
-        except (OSError, ValueError):
-            pass
+        except (OSError, ValueError, _DMLCError) as e:
+            self._dropped.inc()
+            if isinstance(e, _DMLCError) and not self._warned_bad_plan:
+                # a typo'd DMLC_FS_FAULT_PLAN surfaces from the lazy env
+                # parse as DMLCError on the first probe: contain it here
+                # (warned once, dropped-and-counted like any I/O fault) —
+                # every OTHER surface still raises on the bad plan
+                self._warned_bad_plan = True
+                logger.warning("event log fault-plan error contained: %s",
+                               e)
+            # a failed ROTATION may have closed/lost the handle: reopen
+            # once so one bad rename does not silence the log forever.
+            # Re-stat for the tracked size — a failed rename leaves the
+            # ~cap-sized file in place, and restarting the count at 0
+            # would defer the next rotation attempt by a whole cap per
+            # failure (unbounded growth on a persistently sick dir).
+            try:
+                if self._fp.closed:
+                    self._fp = open(self._path, "a", buffering=1)
+                    try:
+                        self._size = os.path.getsize(self._path)
+                    except OSError:
+                        self._size = 0
+            except (OSError, ValueError):
+                pass
 
     def flush(self) -> None:
         """Flush through to disk (flush + fsync, best effort) — called on

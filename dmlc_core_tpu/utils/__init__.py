@@ -1,12 +1,25 @@
-"""Utility layer: timing/trace spans and URI-stream checkpointing."""
+"""Utility layer: timing/trace spans, URI-stream checkpointing, and the
+Python half of the local-filesystem fault plane (fs_fault)."""
 
-from dmlc_core_tpu.utils.checkpoint import (fast_forward,  # noqa: F401
-                                            restore_checkpoint,
-                                            save_checkpoint)
 from dmlc_core_tpu.utils.timer import (Timer, get_time,  # noqa: F401
                                        reset_span_totals, span_totals,
                                        trace_span)
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "fast_forward",
-           "Timer", "get_time", "trace_span", "span_totals",
-           "reset_span_totals"]
+__all__ = ["CheckpointError", "save_checkpoint", "restore_checkpoint",
+           "fast_forward", "Timer", "get_time", "trace_span",
+           "span_totals", "reset_span_totals"]
+
+_CHECKPOINT_NAMES = ("CheckpointError", "save_checkpoint",
+                     "restore_checkpoint", "fast_forward")
+
+
+def __getattr__(name):
+    # The checkpoint re-exports resolve LAZILY (PEP 562): checkpoint.py
+    # pulls in io.native (numpy/ctypes), and a minimal tracker venv —
+    # which imports utils.fs_fault for the event-log fault hooks — must
+    # stay importable without the data-plane stack.
+    if name in _CHECKPOINT_NAMES:
+        from dmlc_core_tpu.utils import checkpoint
+        return getattr(checkpoint, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

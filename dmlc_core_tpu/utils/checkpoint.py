@@ -16,6 +16,19 @@ framework completes the story TPU-side:
   mid-epoch resume (the data-side counterpart, built on the iterators'
   deterministic order).
 
+Durability contract (doc/robustness.md "Local durability"): a save that
+fails — full disk, EIO, torn rename, dead endpoint — cleans up its temp
+and raises a structured :class:`CheckpointError`. Local saves are
+ATOMIC (temp+fsync+rename): a truncated body is never visible under the
+target path. Remote saves (s3://, azure://, hdfs://, http(s)://) upload
+a temp OBJECT and size-verify it before touching the real key, verify
+the real key too, and on verify-exhaustion REPAIR the target from the
+in-memory bytes — but object stores overwrite in place, so if even the
+repair fails the raised error says the target may hold a partial body
+(restore from an earlier checkpoint). Failures count
+``ckpt_save_failures_total``, and every local file op is injectable
+through ``DMLC_FS_FAULT_PLAN`` (:mod:`dmlc_core_tpu.utils.fs_fault`).
+
 An orbax path is deliberately not wrapped: orbax already owns the
 local/GCS directory format; this module covers the URI schemes orbax
 doesn't reach and keeps the on-disk format the framework's own
@@ -26,17 +39,48 @@ from __future__ import annotations
 
 import io
 import os
+import time
 from typing import Any, Dict, Iterable, Optional, Tuple  # noqa: F401
 
 import numpy as np
 
 from dmlc_core_tpu.base import DMLCError
-from dmlc_core_tpu.io.native import NativeStream
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.io.native import NativeStream, path_info
 from dmlc_core_tpu.serializer import BinaryReader, BinaryWriter
+from dmlc_core_tpu.utils import fs_fault
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "fast_forward"]
+__all__ = ["CheckpointError", "save_checkpoint", "restore_checkpoint",
+           "fast_forward"]
 
 _MAGIC = b"DCTCKPT1"
+
+
+class CheckpointError(DMLCError):
+    """A checkpoint save/restore that failed WITHOUT corrupting state:
+    the temp was cleaned up (local) or abandoned under its temp name
+    (remote), the target URI still holds whatever complete checkpoint it
+    held before. ``uri`` and ``phase`` ("write", "fsync", "publish",
+    "verify") say where it died; ``__cause__`` carries the original
+    exception."""
+
+    def __init__(self, uri: str, phase: str, detail: str,
+                 guarantee: str = "no truncated checkpoint is left "
+                                  "visible under the target"):
+        super().__init__(
+            f"checkpoint save failed at {phase} for {uri}: {detail} "
+            f"({guarantee})")
+        self.uri = uri
+        self.phase = phase
+        self._detail = detail
+        self._guarantee = guarantee
+
+    def __reduce__(self):
+        # exceptions with required extra __init__ args do not survive
+        # pickle by default (unpickling calls cls(message)) — and this
+        # one crosses multiprocessing boundaries in supervised training
+        return (self.__class__,
+                (self.uri, self.phase, self._detail, self._guarantee))
 
 
 def _flatten(params: Any) -> list:
@@ -55,6 +99,22 @@ def _local_path(uri: str) -> Optional[str]:
     if "://" not in uri:
         return uri
     return None
+
+
+class _InjectedStream:
+    """Routes every write through the Python fault plan (fs_fault
+    checked_write) — how the chaos gauntlet provokes ENOSPC/EIO/short
+    writes inside the body write without a sick disk. A passthrough when
+    no plan is installed."""
+
+    __slots__ = ("_inner", "_path")
+
+    def __init__(self, inner, path: str):
+        self._inner = inner
+        self._path = path
+
+    def write(self, data: bytes):
+        fs_fault.checked_write(self._inner.write, data, self._path)
 
 
 def _write_body(stream, params: Any, step: int,
@@ -76,45 +136,105 @@ def _write_body(stream, params: Any, step: int,
         w.write_bytes(arr.tobytes())
 
 
-def save_checkpoint(uri: str, params: Any, step: int = 0,
-                    extra: Optional[Dict[str, str]] = None) -> None:
-    """Write a pytree checkpoint to any stream URI.
+def _stat_sig(path: str):
+    """(inode, size, mtime_ns) of `path`, or None when absent — the
+    did-the-failed-rename-actually-touch-the-target probe."""
+    try:
+        st = os.stat(path)
+        return (st.st_ino, st.st_size, st.st_mtime_ns)
+    except OSError:
+        return None
 
-    Local URIs (plain paths and ``file://``) are written ATOMICALLY:
-    temp name in the same directory, fsync, then rename over the target —
-    a worker killed mid-checkpoint (exactly what the liveness layer's
-    supervisor does, doc/robustness.md) leaves either the old complete
-    checkpoint or the new complete one, never a truncated file that
-    restore_checkpoint then trusts. Remote object stores (s3://,
-    azure://...) already commit whole objects on close; hdfs:// writers
-    should checkpoint to a temp path and rename via their own tooling."""
-    path = _local_path(uri)
-    if path is None:
-        with NativeStream(uri, "w") as s:
-            _write_body(s, params, step, extra)
-        return
+
+def _is_complete_body(path: str) -> bool:
+    """Structurally walk a local checkpoint file: magic, header, every
+    declared leaf present in full. The post-failed-publish probe that
+    distinguishes 'the previous complete checkpoint' (keep) from 'a torn
+    half-copy' (delete) — a truncated body parses short and returns
+    False, it never raises. Plain built-in I/O on purpose: the probe runs
+    on the failure path and must not draw further injected faults."""
+    try:
+        with open(path, "rb") as f:
+            r = BinaryReader(f)
+            if r.read_bytes() != _MAGIC:
+                return False
+            r.read_scalar("int64")
+            r.read_str_map()
+            n = int(r.read_scalar("int64"))
+            if not 0 <= n < 1 << 32:
+                return False
+            for _ in range(n):
+                r.read_string()
+                r.read_string()
+                ndim = int(r.read_scalar("int32"))
+                if not 0 <= ndim < 256:
+                    return False
+                for _ in range(ndim):
+                    r.read_scalar("int64")
+                r.read_bytes()
+            return True
+    except Exception:
+        return False
+
+
+def _ckpt_fail(uri: str, phase: str, exc: Exception,
+               guarantee: Optional[str] = None) -> CheckpointError:
+    telemetry.counter("ckpt_save_failures_total").inc()
+    if guarantee is None:
+        return CheckpointError(uri, phase, str(exc))
+    return CheckpointError(uri, phase, str(exc), guarantee)
+
+
+def _save_local(uri: str, path: str, params: Any, step: int,
+                extra: Optional[Dict[str, str]]) -> None:
     # same directory (rename() stays within one fs); unique per pid AND
     # per call — a periodic-checkpoint thread racing a shutdown save in
     # the same process must not interleave bodies into one temp file
     import uuid
     tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    phase = "write"
     try:
+        fs_fault.maybe_inject("open", tmp)
         with NativeStream(tmp, "w") as s:
-            _write_body(s, params, step, extra)
+            _write_body(_InjectedStream(s, tmp), params, step, extra)
+        phase = "fsync"
         fd = os.open(tmp, os.O_RDONLY)
         try:
-            os.fsync(fd)
+            fs_fault.checked_fsync(fd, tmp)
         finally:
             os.close(fd)
-        os.replace(tmp, path)
-    except BaseException:
+        phase = "publish"
+        # fingerprint the target BEFORE the rename: a failed-but-ATOMIC
+        # replace (plain EIO) leaves it byte-for-byte untouched, and the
+        # cleanup below must never delete a pre-existing file — whatever
+        # its format — that this save did not modify
+        target_before = _stat_sig(path)
+        fs_fault.checked_replace(tmp, path)
+    except BaseException as e:
         # a failed/interrupted save must not leave temp litter that a
-        # later glob of the checkpoint dir would pick up
+        # later glob of the checkpoint dir would pick up — and must not
+        # leave a torn body visible under the TARGET either (an injected/
+        # real non-atomic rename can land a half-copy there before dying)
         try:
             os.unlink(tmp)
         except OSError:
             pass
-        raise
+        if phase == "publish" and _stat_sig(path) != target_before and \
+                os.path.exists(path) and not _is_complete_body(path):
+            # the target CHANGED during this save's failed rename and is
+            # not a complete body: that is the torn half-copy artifact
+            # (non-atomic filesystem crash shape; injected torn_rename
+            # reproduces it) — a truncated checkpoint must never stay
+            # visible. An UNCHANGED target (previous checkpoint, or any
+            # foreign file an atomic-but-failed rename never touched) is
+            # left strictly alone.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        if isinstance(e, Exception):
+            raise _ckpt_fail(uri, phase, e) from e
+        raise  # KeyboardInterrupt/SystemExit: cleaned up, not rewrapped
     # best-effort directory fsync so the rename itself survives a crash
     try:
         dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
@@ -124,6 +244,118 @@ def save_checkpoint(uri: str, params: Any, step: int = 0,
             os.close(dfd)
     except OSError:
         pass
+
+
+def _put_verified(uri: str, body: bytes) -> None:
+    """Upload one object and verify the stored size matches — a PUT that
+    'succeeded' but landed short (the failure PR 2's resilience layer
+    exists for) must fail the attempt, not become a trusted checkpoint."""
+    with NativeStream(uri, "w") as s:
+        s.write(body)
+    size, _is_dir = path_info(uri)
+    if size != len(body):
+        raise DMLCError(
+            f"checkpoint object size mismatch for {uri}: stored {size} "
+            f"vs written {len(body)}")
+
+
+def _save_remote(uri: str, params: Any, step: int,
+                 extra: Optional[Dict[str, str]]) -> None:
+    # Serialize once; the retry loop re-uploads bytes, never re-flattens
+    # (device arrays may be donated/deleted by the training step).
+    buf = io.BytesIO()
+    _write_body(buf, params, step, extra)
+    body = buf.getvalue()
+    from dmlc_core_tpu.tracker.wire import env_int
+    # object-level retry budget; transport-level retries already happen
+    # inside the native client under the PR 2 policy — this loop covers
+    # whole-object verification failures on top. Clamped (the CheckedEnvInt
+    # lo/hi rule): a negative value must not silently skip the save.
+    max_retry = max(0, min(env_int("DMLC_CKPT_MAX_RETRY", 3), 100))
+    base_ms = max(1, min(env_int("DMLC_IO_BACKOFF_BASE_MS", 100),
+                         24 * 3600 * 1000))
+    import random
+    # temp key stable per WRITER PROCESS, not per call: periodic
+    # checkpointing must not leak one orphan key per save (no DELETE
+    # verb exists to reclaim them — the tombstone only empties the
+    # body), and the single-writer checkpoint pattern makes pid
+    # uniqueness sufficient
+    tmp = f"{uri}.tmp.{os.getpid()}"
+    prev_ms = max(base_ms, 1)
+    last: Optional[Exception] = None
+    touched_target = False
+
+    def tombstone():
+        try:
+            # no DELETE verb in the fs layer: tombstone the temp to
+            # zero bytes so it cannot be mistaken for a checkpoint
+            with NativeStream(tmp, "w") as s:
+                s.write(b"")
+        except (DMLCError, OSError):
+            pass  # cleanup is best-effort; the save is already good
+
+    for attempt in range(max_retry + 1):
+        if attempt:
+            # decorrelated jitter, the retry.h shape
+            sleep_ms = min(10000, random.uniform(base_ms, prev_ms * 3))
+            prev_ms = max(sleep_ms, base_ms)
+            time.sleep(sleep_ms / 1000.0)
+        try:
+            # temp object first: prove the upload path delivers intact
+            # bytes BEFORE touching the real key, so a sick endpoint can
+            # never leave a short object under the trusted name without
+            # first demonstrating it CAN deliver this body intact
+            _put_verified(tmp, body)
+            touched_target = True
+            _put_verified(uri, body)
+            tombstone()
+            return
+        except (DMLCError, OSError) as e:
+            last = e
+    # retries exhausted. A failed target PUT may have left a SHORT object
+    # under the trusted key (object stores overwrite in place — there is
+    # no rename to make this atomic): repair from the in-memory bytes
+    # before raising, and say so honestly when even that fails.
+    if touched_target:
+        try:
+            _put_verified(uri, body)
+            tombstone()
+            return  # the repair IS a verified save — the target is good
+        except (DMLCError, OSError) as e:
+            last = e
+        raise _ckpt_fail(
+            uri, "verify", last,
+            guarantee="WARNING: the target object may hold a partial "
+                      "body — remote stores overwrite in place; restore "
+                      "from an earlier checkpoint or re-save") from last
+    raise _ckpt_fail(uri, "verify", last) from last
+
+
+def save_checkpoint(uri: str, params: Any, step: int = 0,
+                    extra: Optional[Dict[str, str]] = None) -> None:
+    """Write a pytree checkpoint to any stream URI, atomically.
+
+    Local URIs (plain paths and ``file://``): temp name in the same
+    directory, fsync, then rename over the target — a worker killed
+    mid-checkpoint (exactly what the liveness layer's supervisor does,
+    doc/robustness.md) leaves either the old complete checkpoint or the
+    new complete one, never a truncated file that restore_checkpoint then
+    trusts. Remote URIs (s3://, azure://, hdfs://, http(s)://): the body
+    is uploaded to a temp OBJECT and size-verified, then uploaded to the
+    target and size-verified again, with an object-level retry loop
+    (DMLC_CKPT_MAX_RETRY, default 3) over the PR 2 transport retries —
+    a short PUT can never quietly become the trusted checkpoint (on
+    verify-exhaustion the target is repaired from the in-memory body;
+    if even that fails, the error warns the target may hold a partial
+    object — stores overwrite in place, there is no remote rename).
+
+    Any failure cleans up and raises :class:`CheckpointError` (counted in
+    ``ckpt_save_failures_total``)."""
+    path = _local_path(uri)
+    if path is None:
+        _save_remote(uri, params, step, extra)
+        return
+    _save_local(uri, path, params, step, extra)
 
 
 def _read_all(uri: str) -> bytes:

@@ -58,6 +58,7 @@ from srcwalk import REPO, iter_sources  # noqa: E402 (shared walker)
 LOCK_OK_RE = re.compile(r"(?:#|//)\s*lock-ok\s*:?\s*(.*\S)?")
 ENV_OK_RE = re.compile(r"(?:#|//)\s*env-ok\s*:?\s*(.*\S)?")
 ASSERT_OK_RE = re.compile(r"(?:#|//)\s*assert-ok\s*:?\s*(.*\S)?")
+FS_OK_RE = re.compile(r"(?:#|//)\s*fs-ok\s*:?\s*(.*\S)?")
 
 # scopes when walking the real repo (relative-path prefixes)
 LOCK_SCOPE = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/data/")
@@ -67,6 +68,10 @@ ASSERT_SCOPE = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/data/",
                 "dmlc_core_tpu/io/")
 CPP_SCOPE = ("cpp/",)
 CPP_ENV_ALLOW = ("cpp/src/retry.h", "cpp/src/retry.cc")
+# the local-durability helpers themselves: fs_fault.cc owns the wrappers,
+# shard_cache.cc/filesys.cc own the audited quarantine/best-effort sites
+CPP_FS_ALLOW = ("cpp/src/fs_fault.h", "cpp/src/fs_fault.cc",
+                "cpp/src/shard_cache.cc", "cpp/src/filesys.cc")
 
 # calls considered blocking when reachable with a lock held. Attribute
 # names are matched on ANY receiver (conservative: only sites under lock
@@ -837,6 +842,61 @@ class CppEnvPass:
                 "silently become 0)")
 
 
+class CppFsPass:
+    """Local-durability discipline (doc/robustness.md "Local durability"):
+    outside the fs_fault.cc/shard_cache.cc/filesys.cc helpers, C++ code
+    must not call raw ``std::rename``/``rename`` (use ``fsio::Rename`` —
+    injectable, and the caller must handle the failure) and must not
+    discard ``fsync``'s return (an unchecked fsync is how a 'durable'
+    write silently isn't). ``// fs-ok: <reason>`` escapes audited sites;
+    the reason is mandatory."""
+
+    _RENAME_RE = re.compile(r"\b(?:std::)?rename\s*\(")
+    _FSYNC_RE = re.compile(r"\bfsync\s*\(")
+
+    def __init__(self, findings: Findings):
+        self.findings = findings
+
+    def _escaped(self, rel, lines, line) -> bool:
+        found, reason = comment_marker(lines, line, FS_OK_RE)
+        if found and not reason:
+            self.findings.add(rel, line, "fs",
+                              "fs-ok annotation without a reason")
+        return found
+
+    def run(self, rel, text, stripped, lines):
+        for m in self._RENAME_RE.finditer(stripped):
+            line = stripped.count("\n", 0, m.start()) + 1
+            if self._escaped(rel, lines, line):
+                continue
+            self.findings.add(
+                rel, line, "fs",
+                "raw rename() outside the fs_fault.cc helpers — use "
+                "fsio::Rename (injectable; the caller must handle a "
+                "failed/torn publish)")
+        for m in self._FSYNC_RE.finditer(stripped):
+            # statement position = result discarded: walk back over
+            # whitespace (and a leading ::) to the previous code char.
+            # ')' is statement position too — an unbraced `if (ok)
+            # fsync(fd);` body and the `(void)fsync(fd)` cast both
+            # discard the result (the cast spelling should carry an
+            # fs-ok reason like any other audited discard).
+            i = m.start() - 1
+            while i >= 0 and (stripped[i] in " \t\n\r" or
+                              stripped[i] == ':'):
+                i -= 1
+            if i >= 0 and stripped[i] not in ";{})":
+                continue  # checked/assigned/compared — fine
+            line = stripped.count("\n", 0, m.start()) + 1
+            if self._escaped(rel, lines, line):
+                continue
+            self.findings.add(
+                rel, line, "fs",
+                "fsync() return value discarded — a failed fsync means "
+                "the bytes are NOT durable; check it (or use fsio::Fsync "
+                "and handle the failure)")
+
+
 # ===========================================================================
 # driver
 # ===========================================================================
@@ -854,6 +914,7 @@ def analyze(root=None) -> int:
     guard_pass = CppGuardPass(findings)
     py_pass = PyEnvAssertPass(findings)
     cppenv_pass = CppEnvPass(findings)
+    cppfs_pass = CppFsPass(findings)
     base = REPO if root is None else os.path.abspath(root)
     fixture = root is not None
 
@@ -885,6 +946,8 @@ def analyze(root=None) -> int:
     for stem in sorted(cpp_units):
         for rel, text, stripped, lines in guard_pass.run_unit(
                 cpp_units[stem]):
+            if rel not in CPP_FS_ALLOW or fixture:
+                cppfs_pass.run(rel, text, stripped, lines)
             if rel in CPP_ENV_ALLOW and not fixture:
                 continue  # the checked helpers themselves
             cppenv_pass.run(rel, text, stripped, lines)

@@ -70,6 +70,7 @@ MODULE_GROUPS = [
     ]),
     ("Utilities", [
         "dmlc_core_tpu.utils.checkpoint",
+        "dmlc_core_tpu.utils.fs_fault",
         "dmlc_core_tpu.utils.timer",
     ]),
 ]

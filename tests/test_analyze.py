@@ -389,6 +389,65 @@ def test_checked_cpp_parse_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# C++ local-durability discipline (raw rename / unchecked fsync)
+# ---------------------------------------------------------------------------
+
+def test_raw_rename_and_unchecked_fsync_flagged(tmp_path):
+    """Outside the fs_fault.cc helpers, a raw rename() publish and a
+    discarded fsync() result are each a durability hole (doc/robustness.md
+    'Local durability')."""
+    write_fixture(tmp_path, "pub.cc", """\
+        #include <cstdio>
+        #include <unistd.h>
+
+        void Publish(const char* tmp, const char* dst, int fd) {
+          fsync(fd);
+          if (fd >= 0) fsync(fd);
+          std::rename(tmp, dst);
+        }
+        """)
+    out = run_analyze(tmp_path)
+    # both fsync shapes (statement and unbraced-if body) + the rename
+    assert out.returncode == 3, out.stdout + out.stderr
+    assert "rename" in out.stdout
+    assert "fsync" in out.stdout and "discarded" in out.stdout
+
+
+def test_checked_fsync_and_fsio_rename_clean(tmp_path):
+    """The accepted idioms: fsio::Rename with a handled failure, a
+    checked fsync, and an fs-ok escape WITH a reason."""
+    write_fixture(tmp_path, "pub.cc", """\
+        #include <unistd.h>
+
+        namespace fsio { int Rename(const char*, const char*); }
+
+        int Publish(const char* tmp, const char* dst, int fd) {
+          if (fsync(fd) != 0) return -1;
+          if (fsio::Rename(tmp, dst) != 0) return -1;
+          // fs-ok: best-effort directory sync, failure is not data loss
+          fsync(fd);
+          return 0;
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_fs_ok_without_reason_is_itself_flagged(tmp_path):
+    write_fixture(tmp_path, "pub.cc", """\
+        #include <unistd.h>
+
+        void Sync(int fd) {
+          // fs-ok:
+          fsync(fd);
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "without a reason" in out.stdout
+
+
+# ---------------------------------------------------------------------------
 # C++ DMLC_GUARDED_BY structural checker
 # ---------------------------------------------------------------------------
 

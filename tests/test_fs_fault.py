@@ -1,0 +1,551 @@
+"""Local-durability chaos gauntlet (doc/robustness.md "Local durability").
+
+The one invariant everything here pins: under ANY injected local-fs fault
+(eio / enospc / short_write / fsync_fail / torn_rename via
+DMLC_FS_FAULT_PLAN, both halves of the stack) — and under SIGKILL
+mid-transcode/publish — every outcome is exactly one of {clean cache miss
++ re-transcode, validated byte-identical replay, structured loud error}:
+never corrupt bytes served, never a truncated checkpoint visible, never a
+wedged serve loop.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+# starts the shared per-process mock-S3 server and pins the native
+# singleton's endpoint env at import (the test_io_resilience convention)
+from test_s3 import _STATE as S3_STATE  # noqa: F401
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.io import native
+from dmlc_core_tpu.io.native import NativeParser
+from dmlc_core_tpu.utils import fs_fault
+from dmlc_core_tpu.utils.checkpoint import (CheckpointError,
+                                            restore_checkpoint,
+                                            save_checkpoint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plans():
+    """Both fault-plan halves are process-global: every test starts and
+    ends clean (an explicit clear beats DMLC_FS_FAULT_PLAN forever)."""
+    fs_fault.set_fs_fault_plan("")
+    native.set_fs_fault_plan("")
+    yield
+    fs_fault.set_fs_fault_plan("")
+    native.set_fs_fault_plan("")
+
+
+def _counter(name, labels=None):
+    """Merged-snapshot counter value (0 when absent)."""
+    want = tuple(sorted((labels or {}).items()))
+    snap = telemetry.snapshot()
+    return sum(c["value"] for c in snap["counters"]
+               if c["name"] == name
+               and tuple(sorted(c["labels"].items())) == want)
+
+
+def _write_libsvm(path, rows=3000, seed=5):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(rows):
+            feats = " ".join(
+                f"{j + 1}:{rng.uniform(-3, 3):.6f}" for j in range(12))
+            f.write(f"{i % 2} {feats}\n")
+    return str(path)
+
+
+def _drain(uri, **kw):
+    labels = []
+    with NativeParser(uri, **kw) as p:
+        for b in p:
+            labels.append(b.label.copy())
+    return np.concatenate(labels)
+
+
+def _params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.normal(size=(16, 8)).astype(np.float32),
+            "b": rng.normal(size=16).astype(np.float32)}
+
+
+def _assert_params_equal(a, b):
+    # restore without a template returns jax keystr keys ("['w']")
+    na = {k.strip("[]'\""): v for k, v in a.items()}
+    nb = {k.strip("[]'\""): v for k, v in b.items()}
+    assert sorted(na) == sorted(nb)
+    for k in na:
+        assert np.array_equal(np.asarray(na[k]), np.asarray(nb[k])), k
+
+
+# -- plan grammar (both halves) ---------------------------------------------
+BAD_PLANS = [
+    "write",                            # no params
+    "write:every=2",                    # no fault
+    "write:fault=eio",                  # no selector
+    "write:fault=bogus,every=2",        # unknown fault
+    "frobnicate:fault=eio,every=2",     # unknown op
+    "read:fault=torn_rename,every=1",   # impossible combo
+    "mmap:fault=short_write,every=1",   # impossible combo
+    "write:fault=eio,every=0",          # every < 1
+    "write:fault=eio,p=1.5",            # p out of range
+    "write:fault=eio,garbage",          # malformed param
+    "write:fault=eio,every=5,p=1.0",    # both selectors (ambiguous)
+]
+
+GOOD_PLAN = ("write:fault=enospc,every=3;rename:fault=torn_rename,p=0.5;"
+             "fsync:fault=fsync_fail,every=1;open:fault=eio,p=1.0;"
+             "read:fault=eio,every=7;mmap:fault=eio,every=2")
+
+
+@pytest.mark.parametrize("plan", BAD_PLANS)
+def test_plan_grammar_rejected_by_both_halves(plan):
+    """One grammar, two halves: a typo'd plan errors identically in the
+    Python parser and the native setter (the checked-parse rule — a chaos
+    run that silently injects nothing is worse than none)."""
+    with pytest.raises(DMLCError):
+        fs_fault.parse_plan(plan)
+    with pytest.raises(DMLCError):
+        native.set_fs_fault_plan(plan)
+
+
+def test_plan_grammar_accepts_full_matrix():
+    rules = fs_fault.parse_plan(GOOD_PLAN)
+    assert [r.op for r in rules] == ["write", "rename", "fsync", "open",
+                                    "read", "mmap"]
+    native.set_fs_fault_plan(GOOD_PLAN)  # must not raise
+    native.set_fs_fault_plan("")
+
+
+def test_checkpoint_error_survives_pickle():
+    """CheckpointError crosses multiprocessing boundaries in supervised
+    training — a raise that cannot unpickle would mask the real
+    failure with a TypeError."""
+    import pickle
+    e = CheckpointError("s3://b/k", "publish", "boom")
+    e2 = pickle.loads(pickle.dumps(e))
+    assert e2.uri == "s3://b/k" and e2.phase == "publish"
+    assert "boom" in str(e2)
+
+
+def test_injection_counts_per_op_label():
+    fs_fault.set_fs_fault_plan("fsync:fault=fsync_fail,every=1")
+    before = _counter("fs_fault_injected_total", {"op": "fsync"})
+    with pytest.raises(OSError):
+        fs_fault.checked_fsync(0, "probe")
+    assert _counter("fs_fault_injected_total",
+                    {"op": "fsync"}) == before + 1
+
+
+# -- checkpoint: local crash consistency ------------------------------------
+LOCAL_CKPT_PLANS = [
+    "write:fault=enospc,every=2",
+    "write:fault=short_write,every=2",
+    "write:fault=eio,every=3",
+    "fsync:fault=fsync_fail,every=1",
+    "rename:fault=eio,every=1",
+    "rename:fault=torn_rename,every=1",
+]
+
+
+@pytest.mark.parametrize("plan", LOCAL_CKPT_PLANS)
+def test_checkpoint_local_fault_matrix(tmp_path, plan):
+    """Every local fault shape ends in a structured CheckpointError with
+    zero temp litter and NO truncated checkpoint visible: the target
+    either restores completely or is absent."""
+    target = str(tmp_path / "model.ckpt")
+    params = _params(1)
+    save_checkpoint(target, params, step=7)
+    fails0 = _counter("ckpt_save_failures_total")
+    fs_fault.set_fs_fault_plan(plan)
+    with pytest.raises(CheckpointError):
+        save_checkpoint(target, _params(2), step=8)
+    fs_fault.set_fs_fault_plan("")
+    assert _counter("ckpt_save_failures_total") == fails0 + 1
+    litter = [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    assert not litter, litter
+    if os.path.exists(target):
+        # whatever survived must restore COMPLETELY (the step-7 body, or
+        # a rename that actually landed step 8) — never parse short
+        got, step, _ = restore_checkpoint(target)
+        assert step in (7, 8)
+        _assert_params_equal(got, _params(1) if step == 7 else _params(2))
+    # and a clean save afterwards works
+    save_checkpoint(target, _params(3), step=9)
+    got, step, _ = restore_checkpoint(target)
+    assert step == 9
+    _assert_params_equal(got, _params(3))
+
+
+def test_checkpoint_failed_atomic_rename_never_deletes_foreign_target(
+        tmp_path):
+    """A PLAIN rename failure (atomic, destination untouched) must leave
+    a pre-existing target file strictly alone — even one that is not a
+    checkpoint at all. Only the torn half-copy artifact (target CHANGED
+    by the failed publish) may be removed."""
+    target = str(tmp_path / "model.ckpt")
+    with open(target, "wb") as f:
+        f.write(b"foreign bytes the save never touched")
+    fs_fault.set_fs_fault_plan("rename:fault=eio,every=1")
+    with pytest.raises(CheckpointError):
+        save_checkpoint(target, _params(1), step=1)
+    fs_fault.set_fs_fault_plan("")
+    with open(target, "rb") as f:
+        assert f.read() == b"foreign bytes the save never touched"
+
+
+def test_legacy_file_cache_torn_publish_reparses_cleanly(tmp_path):
+    """The legacy single-file `#<path>` cache has no manifest: a torn
+    publish used to leave a magic-valid truncated cache that wedged every
+    later epoch mid-replay. The failed publish now removes the torn
+    destination, so the error is loud ONCE and the next pass is a clean
+    first-pass re-parse."""
+    path = _write_libsvm(tmp_path / "d.libsvm", rows=1200)
+    cfile = str(tmp_path / "legacy.cache")
+    published = cfile + ".rowblock"  # DiskCacheParser's on-disk name
+    text = _drain(path)
+    native.set_fs_fault_plan("rename:fault=torn_rename,every=1")
+    with pytest.raises(DMLCError):
+        _drain(path + "#" + cfile)  # publish at end of pass fails loudly
+    native.set_fs_fault_plan("")
+    assert not os.path.exists(published), \
+        "a torn legacy cache must not stay visible (no manifest guards it)"
+    # clean re-parse, then a replayable published cache
+    assert np.array_equal(text, _drain(path + "#" + cfile))
+    assert os.path.exists(published)
+    assert np.array_equal(text, _drain(path + "#" + cfile))
+
+
+def test_checkpoint_kill_mid_write_leaves_old_complete(tmp_path):
+    """SIGKILL inside the body write (the supervisor's kill shape): the
+    old complete checkpoint stays, the temp is orphaned-but-ignorable —
+    restore never sees partial bytes."""
+    target = str(tmp_path / "model.ckpt")
+    save_checkpoint(target, _params(1), step=7)
+    child = subprocess.Popen(
+        [sys.executable, "-c", f"""
+import sys, os
+sys.path.insert(0, {REPO!r})
+import numpy as np
+from dmlc_core_tpu.utils.checkpoint import save_checkpoint
+import dmlc_core_tpu.utils.checkpoint as ck
+
+orig = ck._write_body
+def parked(stream, params, step, extra):
+    orig(stream, params, step, extra)
+    open({str(tmp_path / 'midwrite')!r}, 'w').close()
+    import time; time.sleep(120)  # park INSIDE the temp write window
+ck._write_body = parked
+rng = np.random.default_rng(9)
+save_checkpoint({target!r},
+                {{'w': rng.normal(size=(512, 64)).astype(np.float32)}},
+                step=8)
+"""],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    marker = str(tmp_path / "midwrite")
+    deadline = time.time() + 60
+    while not os.path.exists(marker) and time.time() < deadline:
+        assert child.poll() is None, child.stderr.read().decode()
+        time.sleep(0.02)
+    assert os.path.exists(marker)
+    child.send_signal(signal.SIGKILL)
+    child.wait()
+    got, step, _ = restore_checkpoint(target)
+    assert step == 7
+    _assert_params_equal(got, _params(1))
+
+
+# -- checkpoint: remote atomic save (mock S3) -------------------------------
+def test_checkpoint_remote_atomic_roundtrip():
+    """Remote saves ride temp object + size verify: the body restores
+    byte-identically and the temp is tombstoned to zero bytes."""
+    uri = "s3://bkt/ckpt/model.ckpt"
+    params = _params(4)
+    save_checkpoint(uri, params, step=11, extra={"lr": "0.1"})
+    got, step, extra = restore_checkpoint(uri)
+    assert step == 11 and extra == {"lr": "0.1"}
+    _assert_params_equal(got, params)
+    tmp_keys = [k for (_b, k) in S3_STATE.objects if ".tmp." in k]
+    assert tmp_keys, "the temp-object probe must have been uploaded"
+    assert all(S3_STATE.objects[("bkt", k)] == b"" for k in tmp_keys), \
+        "temps must be tombstoned to zero bytes"
+
+
+def test_checkpoint_remote_retries_through_transport_faults():
+    """The PR 2 native fault plan (connection resets) under the save: the
+    object-level loop + transport retries converge on an intact object."""
+    native.set_io_fault_plan("reset:every=4")
+    try:
+        uri = "s3://bkt/ckpt/retry.ckpt"
+        params = _params(5)
+        save_checkpoint(uri, params, step=3)
+    finally:
+        native.set_io_fault_plan("")
+    got, step, _ = restore_checkpoint(uri)
+    assert step == 3
+    _assert_params_equal(got, params)
+
+
+def test_checkpoint_remote_size_verify_failure_is_structured(monkeypatch):
+    """A PUT that lands short (verify mismatch) exhausts the retry budget
+    and raises CheckpointError — a short object never quietly becomes
+    the trusted checkpoint. The TEMP verify fails first here, so the
+    real key is never touched."""
+    import dmlc_core_tpu.utils.checkpoint as ck
+    monkeypatch.setenv("DMLC_CKPT_MAX_RETRY", "1")
+    monkeypatch.setattr(ck, "path_info", lambda uri: (1, False))
+    fails0 = _counter("ckpt_save_failures_total")
+    with pytest.raises(CheckpointError, match="size mismatch"):
+        save_checkpoint("s3://bkt/ckpt/short.ckpt", _params(6), step=1)
+    assert _counter("ckpt_save_failures_total") == fails0 + 1
+    assert ("bkt", "ckpt/short.ckpt") not in S3_STATE.objects, \
+        "temp verify failed: the real key must never have been touched"
+
+
+def test_checkpoint_remote_target_verify_failure_warns_partial(monkeypatch):
+    """When the TARGET's verify keeps failing (temp verifies fine), the
+    save attempts a repair and — when that fails too — the error says
+    honestly that the target may hold a partial object (stores overwrite
+    in place; there is no remote rename to hide behind)."""
+    import dmlc_core_tpu.utils.checkpoint as ck
+    monkeypatch.setenv("DMLC_CKPT_MAX_RETRY", "1")
+    real_info = ck.path_info
+
+    def lying(uri):
+        # the temp key carries a .tmp.<pid>.<rand> suffix; only the real
+        # key ends in .ckpt — lie about THAT one
+        if uri.endswith(".ckpt"):
+            return (1, False)
+        return real_info(uri)
+
+    monkeypatch.setattr(ck, "path_info", lying)
+    with pytest.raises(CheckpointError, match="partial"):
+        save_checkpoint("s3://bkt/ckpt/torn.ckpt", _params(7), step=1)
+
+
+# -- tracker event log: drop-and-count containment --------------------------
+def test_event_log_write_faults_contained(tmp_path):
+    from dmlc_core_tpu.tracker.rendezvous import _EventLog
+    path = str(tmp_path / "events.jsonl")
+    log = _EventLog(path, max_bytes=0)
+    dropped0 = _counter("event_log_dropped_total")
+    fs_fault.set_fs_fault_plan("write:fault=eio,every=2")
+    for i in range(10):
+        log.write(f'{{"event": "e{i}"}}\n')  # must NEVER raise
+    fs_fault.set_fs_fault_plan("")
+    log.flush()
+    dropped = _counter("event_log_dropped_total") - dropped0
+    assert dropped == 5
+    with open(path) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == 5  # the non-faulted half landed intact
+    log.close()
+
+
+def test_event_log_rotation_fault_contained(tmp_path):
+    """A torn rotation rename drops one line, reopens the sink, and the
+    log keeps working — one bad rename must not silence the log (or kill
+    the serve loop) forever."""
+    from dmlc_core_tpu.tracker.rendezvous import _EventLog
+    path = str(tmp_path / "events.jsonl")
+    log = _EventLog(path, max_bytes=64)
+    big = '{"event": "' + "x" * 70 + '"}\n'
+    log.write(big)  # over the cap already: next write rotates
+    fs_fault.set_fs_fault_plan("rename:fault=torn_rename,every=1")
+    dropped0 = _counter("event_log_dropped_total")
+    log.write(big)  # rotation fails -> dropped, contained
+    fs_fault.set_fs_fault_plan("")
+    assert _counter("event_log_dropped_total") == dropped0 + 1
+    log.write('{"event": "after"}\n')  # the reopened sink still works
+    log.flush()
+    with open(path) as f:
+        assert "after" in f.read()
+    log.close()
+
+
+def test_event_log_malformed_env_plan_contained(tmp_path, monkeypatch):
+    """A typo'd DMLC_FS_FAULT_PLAN surfaces from the lazy env parse as
+    DMLCError on the first probe — inside the tracker serve loop that
+    must be CONTAINED (warned once, dropped-and-counted), not propagated
+    on every event line."""
+    from dmlc_core_tpu.tracker.rendezvous import _EventLog
+    monkeypatch.setenv("DMLC_FS_FAULT_PLAN", "write:fault=bogus,every=2")
+    # force the lazy env resolution path (explicit sets normally win)
+    monkeypatch.setattr(fs_fault, "_rules", None)
+    monkeypatch.setattr(fs_fault, "_active", False)
+    path = str(tmp_path / "events.jsonl")
+    log = _EventLog(path, max_bytes=0)
+    dropped0 = _counter("event_log_dropped_total")
+    log.write('{"event": "a"}\n')  # must NOT raise
+    log.write('{"event": "b"}\n')  # nor on any later line
+    assert _counter("event_log_dropped_total") == dropped0 + 2
+    log.close()
+    # other surfaces still error loudly on the same bad plan
+    monkeypatch.setattr(fs_fault, "_rules", None)
+    with pytest.raises(DMLCError):
+        fs_fault.maybe_inject("write")
+
+
+# -- shard cache: disk-full degradation (acceptance pin) --------------------
+def test_cache_enospc_env_only_degrades_explicit_errors(tmp_path,
+                                                        monkeypatch):
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    text = _drain(path)
+    monkeypatch.setenv("DMLC_DATA_CACHE_DIR", cdir)
+    errs0 = _counter("cache_write_errors_total")
+    native.set_fs_fault_plan("write:fault=enospc,every=3")
+    got = _drain(path)  # env-only: the epoch completes on the text lane
+    native.set_fs_fault_plan("")
+    assert np.array_equal(text, got)
+    assert _counter("cache_write_errors_total") > errs0
+    names = os.listdir(cdir)
+    assert any(n.endswith(".quarantined") for n in names), names
+    assert not any(n.endswith(".manifest") for n in names), names
+    # the SAME plan under an explicit opt-in errors loudly
+    monkeypatch.delenv("DMLC_DATA_CACHE_DIR")
+    native.set_fs_fault_plan("write:fault=enospc,every=3")
+    with pytest.raises(DMLCError):
+        _drain(path, cache_dir=cdir)
+    native.set_fs_fault_plan("")
+    # plan cleared: transcode + replay both byte-identical
+    assert np.array_equal(text, _drain(path, cache_dir=cdir))
+    assert np.array_equal(text, _drain(path, cache_dir=cdir))
+
+
+def test_cache_replay_read_faults_retranscode_cleanly(tmp_path):
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    text = _drain(path, cache_dir=cdir)  # publish a valid unit
+    misses0 = _counter("cache_misses_total")
+    native.set_fs_fault_plan("mmap:fault=eio,every=1")
+    got = _drain(path, cache_dir=cdir)  # validation MISSES, text serves
+    native.set_fs_fault_plan("")
+    assert np.array_equal(text, got)
+    assert _counter("cache_misses_total") > misses0
+    # and the re-published unit replays once the fault clears
+    assert np.array_equal(text, _drain(path, cache_dir=cdir))
+
+
+def test_cache_publish_torn_rename_is_clean_miss(tmp_path, monkeypatch):
+    """torn_rename at publish = the crash-mid-publish artifact: a
+    truncated .dshard under the real name, no manifest — next open is a
+    clean miss that re-transcodes byte-identically."""
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    text = _drain(path)
+    monkeypatch.setenv("DMLC_DATA_CACHE_DIR", cdir)
+    native.set_fs_fault_plan("rename:fault=torn_rename,every=1")
+    got = _drain(path)  # env-only: degraded, text bytes
+    native.set_fs_fault_plan("")
+    assert np.array_equal(text, got)
+    assert not any(n.endswith(".manifest") for n in os.listdir(cdir))
+    # clean miss -> re-transcode -> replay, all byte-identical
+    assert np.array_equal(text, _drain(path))
+    assert any(n.endswith(".manifest") for n in os.listdir(cdir))
+    assert np.array_equal(text, _drain(path))
+
+
+def test_cache_gc_reaps_stale_keeps_live(tmp_path):
+    """Writer-construction GC: an age-expired orphan temp is reaped, a
+    LIVE concurrent transcoder's fresh temp is not (nor foreign files)."""
+    path = _write_libsvm(tmp_path / "d.libsvm")
+    cdir = str(tmp_path / "cache")
+    os.makedirs(cdir)
+    old_tmp = os.path.join(cdir, "dead.p0.n1.dshard.tmp.1.0")
+    old_q = os.path.join(cdir, "dead.p0.n1.dshard.tmp.2.0.quarantined")
+    fresh_tmp = os.path.join(cdir, "live.p0.n1.dshard.tmp.3.0")
+    foreign = os.path.join(cdir, "notes.txt")
+    for p in (old_tmp, old_q, fresh_tmp, foreign):
+        with open(p, "w") as f:
+            f.write("x")
+    ancient = time.time() - 3 * 86400
+    os.utime(old_tmp, (ancient, ancient))
+    os.utime(old_q, (ancient, ancient))
+    _drain(path, cache_dir=cdir)  # constructs a writer -> sweeps
+    names = set(os.listdir(cdir))
+    assert "dead.p0.n1.dshard.tmp.1.0" not in names
+    assert "dead.p0.n1.dshard.tmp.2.0.quarantined" not in names
+    assert "live.p0.n1.dshard.tmp.3.0" in names
+    assert "notes.txt" in names
+
+
+def test_env_plan_drives_native_half(tmp_path):
+    """DMLC_FS_FAULT_PLAN in the ENVIRONMENT (not the setter) drives a
+    fresh process' native wrappers: the child's env-only transcode under
+    ENOSPC completes on the text lane and leaves the quarantined temp."""
+    path = _write_libsvm(tmp_path / "d.libsvm", rows=1500)
+    cdir = str(tmp_path / "cache")
+    env = dict(os.environ,
+               DMLC_FS_FAULT_PLAN="write:fault=enospc,every=3",
+               DMLC_DATA_CACHE_DIR=cdir)
+    proc = subprocess.run(
+        [sys.executable, "-c", f"""
+import sys
+sys.path.insert(0, {REPO!r})
+from dmlc_core_tpu.io.native import NativeParser
+rows = 0
+with NativeParser({path!r}) as p:
+    for b in p:
+        rows += b.num_rows
+assert rows == 1500, rows
+print("rows", rows)
+"""],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    names = os.listdir(cdir)
+    assert any(n.endswith(".quarantined") for n in names), names
+    assert not any(n.endswith(".manifest") for n in names), names
+
+
+# -- SIGKILL sweep: transcode + publish window ------------------------------
+@pytest.mark.slow
+def test_sigkill_sweep_never_corrupts(tmp_path):
+    """Kill a transcoding process at staged points across the whole
+    transcode→publish window (including right at the finish line): after
+    EVERY kill the cache is either a clean miss (re-transcode serves
+    text-identical bytes) or a valid replay — never corrupt, and the
+    post-kill epoch is wall-clock bounded by this test's lane timeout."""
+    path = _write_libsvm(tmp_path / "big.libsvm", rows=12000, seed=11)
+    text = _drain(path)
+    for i, delay in enumerate([0.0, 0.01, 0.05, 0.2, 1.0]):
+        cdir = str(tmp_path / f"cache{i}")
+        child = subprocess.Popen(
+            [sys.executable, "-c", f"""
+import sys, os, time
+sys.path.insert(0, {REPO!r})
+from dmlc_core_tpu.io.native import NativeParser
+with NativeParser({path!r}, cache_dir={cdir!r}, nthread=1) as p:
+    assert p.next_block() is not None
+    open(os.path.join({cdir!r}, "started"), "w").close()
+    while p.next_block() is not None:
+        pass
+    open(os.path.join({cdir!r}, "published"), "w").close()
+    time.sleep(120)
+"""],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        marker = os.path.join(cdir, "started")
+        deadline = time.time() + 60
+        while not os.path.exists(marker) and time.time() < deadline:
+            assert child.poll() is None, child.stderr.read().decode()
+            time.sleep(0.01)
+        assert os.path.exists(marker), "child never started transcoding"
+        time.sleep(delay)
+        child.send_signal(signal.SIGKILL)
+        child.wait()
+        # invariant: whatever state the kill left — no shard, temp-only,
+        # torn publish window, or fully published — the next epoch serves
+        # byte-identical rows (replay or clean-miss re-transcode)...
+        assert np.array_equal(text, _drain(path, cache_dir=cdir)), \
+            f"kill at +{delay}s corrupted the cache lane"
+        # ...and the epoch after THAT replays the (re)published unit
+        assert np.array_equal(text, _drain(path, cache_dir=cdir))
