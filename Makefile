@@ -3,11 +3,11 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
-        parse-lanes telemetry cache range fsfault pytest liveness elastic \
-        bench-smoke dryrun doc clean
+        parse-lanes telemetry trace cache range fsfault pytest liveness \
+        elastic bench-smoke dryrun doc clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
-    telemetry cache range fsfault pytest liveness elastic dryrun doc
+    telemetry trace cache range fsfault pytest liveness elastic dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -26,6 +26,17 @@ parse-lanes:
 telemetry:
 	$(MAKE) -C cpp tsan-telemetry
 	python3 -m pytest tests/test_telemetry.py -q
+
+# Distributed-tracing lane (doc/observability.md "Distributed tracing"):
+# the C++ span-ring suite under TSan (ring wraparound, concurrent span
+# writers vs snapshot/reset walkers, disabled-gate) plus the Python e2e —
+# a real 2-subprocess-worker job scraped live at /trace and /metrics,
+# SIGKILL flight-recorder dump, stall-attribution verdict flips. Hard
+# timeout: a scrape that can hang the tracker is exactly the regression
+# this lane exists to catch.
+trace:
+	$(MAKE) -C cpp tsan-trace
+	timeout -k 10 300 python3 -m pytest tests/test_tracing.py -q
 
 # Shard-cache lane (doc/caching.md): the C++ suite under BOTH sanitizers
 # (concurrent readers during transcode, crash-recovery/corruption

@@ -755,6 +755,14 @@ def main() -> None:
         if occupancy:
             extras["parse_pipeline_occupancy"] = occupancy
 
+    # zero the plane ONCE, after the thread-scaling table and BEFORE the
+    # device probe: the stall attribution below must read the headline
+    # run's stage spans (not the scaling table's), while the probe's
+    # device_probe_* counters/gauge/events must survive into snapshots
+    # and dumps (their whole point is post-hoc diagnosability)
+    from dmlc_core_tpu import telemetry
+    telemetry.reset()
+
     if args.no_device and not args.parse_only:
         # the explicit fast path: no probe subprocess, no retry backoff —
         # ~90s of fixed backoff per run on a device-less host was pure
@@ -819,11 +827,20 @@ def main() -> None:
             cached_no_device = False
         deadline = time.time() + probe_window
         device_ok = False
+        # device-probe observability (doc/observability.md): the probe's
+        # attempts/timeouts/verdict land in the unified telemetry plane —
+        # a `device_unavailable` round is diagnosable from any snapshot
+        # or scrape instead of grepping stderr `#` comments
+        from dmlc_core_tpu import telemetry
+        probe_attempts = telemetry.counter("device_probe_attempts_total")
+        probe_timeouts = telemetry.counter("device_probe_timeouts_total")
         if cached_no_device:
             probe_retries = 0
             extras["device_probe_cached"] = True
         for attempt in range(probe_retries):
             transient = True
+            timed_out = False
+            probe_attempts.inc()
             try:
                 probe = subprocess.run(
                     [sys.executable, "-c",
@@ -841,6 +858,11 @@ def main() -> None:
                     "ModuleNotFoundError", "ImportError", "SyntaxError"))
             except subprocess.TimeoutExpired:
                 device_ok = False
+                timed_out = True
+                probe_timeouts.inc()
+            telemetry.emit_event("device-probe", attempt=attempt + 1,
+                                 ok=device_ok, timed_out=timed_out,
+                                 transient=transient)
             if device_ok or not transient or time.time() >= deadline:
                 break
             if attempt < probe_retries - 1:
@@ -866,6 +888,19 @@ def main() -> None:
                 os.replace(verdict_path + ".tmp", verdict_path)
             except Exception:  # noqa: BLE001 - cache is best-effort
                 pass
+        # the final verdict as a gauge + event + extras (one code path for
+        # every outcome, cached misses included)
+        verdict = ("ok" if device_ok
+                   else "cached_unavailable" if cached_no_device
+                   else "unavailable")
+        telemetry.gauge("device_probe_state").set(
+            {"ok": 1, "unavailable": 2, "cached_unavailable": 3}[verdict])
+        telemetry.emit_event("device-probe-verdict", verdict=verdict,
+                             attempts=probe_attempts.value,
+                             timeouts=probe_timeouts.value)
+        extras["device_probe"] = {"verdict": verdict,
+                                  "attempts": probe_attempts.value,
+                                  "timeouts": probe_timeouts.value}
         if not device_ok:
             print("# device backend unavailable (probe timed out/failed);"
                   " reporting host parse-only metrics", file=sys.stderr)
@@ -892,21 +927,24 @@ def main() -> None:
                 if k in headline_stats}
             extras["parse_simd_lane"] = headline_stats.get(
                 "simd_lane", "scalar")
+        # stall attribution from the span-backed stage histograms
+        # (telemetry.stall_attribution, doc/observability.md): per-stage
+        # occupancy + a fill/parse/consumer/transfer-bound verdict derived
+        # from the same spans the tracker's /trace serves — replacing the
+        # old reader-vs-consumer-waits guess
+        att = telemetry.stall_attribution()
+        extras["stall_attribution"] = {
+            "verdict": att["verdict"],
+            "occupancy": {k: round(v, 4)
+                          for k, v in att["occupancy"].items()},
+            "stage_ms": {k: round(v / 1e3, 1)
+                         for k, v in att["stage_us"].items()},
+        }
+        extras["bottleneck"] = att["verdict"]
         if (os.cpu_count() or 1) <= 1:
+            # one core serializes every stage: the occupancy split is
+            # still reported, but no verdict can promise overlap
             extras["bottleneck"] = "host_cpu_serialized_single_core"
-        elif headline_stats:
-            # reader_waits: the in-flight queue filled (consumer binds);
-            # consumer_waits: the head-of-line chunk wasn't parsed yet
-            # (parse binds) — doc/pipeline.md stats table
-            extras["bottleneck"] = (
-                "host_parse"
-                if headline_stats.get("consumer_waits", 0) >=
-                   headline_stats.get("reader_waits", 0)
-                else "consumer_drain")
-        else:
-            # no pipeline stats (threaded lane unavailable, e.g. the
-            # zero-parse binary formats): the host lane is copy-bound
-            extras["bottleneck"] = "host_copy"
     else:
         import jax
         import jax.numpy as jnp
@@ -935,20 +973,22 @@ def main() -> None:
             "reps": lane["reps"],
             "ncores": os.cpu_count(),
         })
-        # name the binding stage: with one host core the pipeline stages
-        # (parse workers, batch fill, device_put dispatch) cannot overlap
-        # and serialize on the CPU; with cores to spare, compare e2e against
-        # the host-parse-only rate to tell parse-bound from transfer-bound
+        # name the binding stage from the span-backed stage histograms
+        # (telemetry.stall_attribution, doc/observability.md): the e2e
+        # lane's own fill/parse/transfer occupancy replaces the old
+        # re-measure-the-parse-rate heuristic
+        att = telemetry.stall_attribution()
+        extras["stall_attribution"] = {
+            "verdict": att["verdict"],
+            "occupancy": {k: round(v, 4)
+                          for k, v in att["occupancy"].items()},
+            "stage_ms": {k: round(v / 1e3, 1)
+                         for k, v in att["stage_us"].items()},
+        }
         if lane["hbm_ingest_bw_util"] < 0.9:
-            if (os.cpu_count() or 1) <= 1:
-                extras["bottleneck"] = "host_cpu_serialized_single_core"
-            else:
-                parse_rps, _ = parse_rows_per_sec(
-                    lane_path, rows, args.threads, fmt=lane_fmt,
-                    dense_dtype=args.dense_dtype)
-                extras["bottleneck"] = ("host_parse"
-                                        if rps >= 0.75 * parse_rps
-                                        else "host_to_hbm_transfer")
+            extras["bottleneck"] = (
+                "host_cpu_serialized_single_core"
+                if (os.cpu_count() or 1) <= 1 else att["verdict"])
             print(f"# bw-util {lane['hbm_ingest_bw_util']:.1%}: landed "
                   f"{lane['device_bytes_per_sec'] / 1e6:.0f} MB/s vs "
                   f"pytree-attainable "

@@ -5,6 +5,7 @@
 
 #include "base.h"
 #include "bf16.h"
+#include "telemetry.h"
 
 namespace dct {
 
@@ -90,7 +91,9 @@ bool PaddedBatcher::NextMeta(uint64_t* take, uint64_t* bucket,
                              uint64_t* max_index, int* has_qid,
                              int* has_field) {
   DCT_CHECK(!staged_) << "NextMeta called with an unconsumed staged batch";
+  telemetry::TraceSpan trace("batch.stage");
   Accumulate();
+  trace.set_arg(avail_rows_);
   if (avail_rows_ == 0) return false;
   take_ = std::min<uint64_t>(batch_rows_, avail_rows_);
 
@@ -149,6 +152,8 @@ void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
                             float* label, float* weight, int32_t* nrows,
                             int32_t* qid, int32_t* field) {
   DCT_CHECK(staged_) << "FillCSR without a staged batch (call NextMeta)";
+  telemetry::TraceSpan trace("batch.fill");
+  trace.set_arg(take_);
   const uint64_t R = batch_rows_ / num_shards_;
   for (uint32_t d = 0; d < num_shards_; ++d) {
     int32_t* rowd = row + d * bucket_;

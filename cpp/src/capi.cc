@@ -150,15 +150,47 @@ int dct_telemetry_snapshot(char** out) {
   });
 }
 
-// Zero every registered metric (owned and adopted-external alike).
+// Zero every registered metric (owned and adopted-external alike) and
+// drop the buffered span ring — one reset restores the whole plane.
 int dct_telemetry_reset() {
-  return Guard([&] { dct::telemetry::Reset(); });
+  return Guard([&] {
+    dct::telemetry::Reset();
+    dct::telemetry::TraceReset();
+  });
 }
 
 // Runtime override of the DMLC_TELEMETRY gate for timed spans (counters
 // keep counting either way — they are cheaper than the branch).
 int dct_telemetry_enable(int on) {
   return Guard([&] { dct::telemetry::SetEnabled(on != 0); });
+}
+
+// The native span-ring trace document (telemetry.h TraceJson; schema
+// doc/observability.md "Distributed tracing"). Steady-clock timestamps
+// plus the per-process (wall, steady) anchor pair — the Python half
+// (telemetry.trace_json / the tracker's /trace) merges it onto the
+// wall clock. Caller frees with dct_str_free.
+int dct_trace_snapshot(char** out) {
+  return Guard([&] {
+    const std::string s = dct::telemetry::TraceJson();
+    char* buf = new char[s.size() + 1];
+    std::memcpy(buf, s.c_str(), s.size() + 1);
+    *out = buf;
+  });
+}
+
+// Drop every buffered span and restart the trace sequence.
+int dct_trace_reset() {
+  return Guard([&] { dct::telemetry::TraceReset(); });
+}
+
+// Best-effort native flight-recorder dump (telemetry.h FlightDump):
+// writes trace + metrics to $DMLC_TRACE_DUMP when set. Returns 0 with
+// *written = 1 only when a dump file actually landed.
+int dct_flight_dump(const char* reason, int* written) {
+  return Guard([&] {
+    *written = dct::telemetry::FlightDump(reason) ? 1 : 0;
+  });
 }
 
 // ----------------------------------------------------------- io resilience --

@@ -1239,7 +1239,9 @@ void PipelinedParser<IndexType>::ReaderLoop() {
       try {
         {
           telemetry::ScopedTimerUs fill_span(PipeTel().fill_us);
+          telemetry::TraceSpan trace("parse.fill");
           more = base_->ReadChunk(&t->data);
+          trace.set_arg(t->data.size());
         }
         if (more) {
           const int nslice = base_->SlicesFor(t->data.size());
@@ -1256,6 +1258,7 @@ void PipelinedParser<IndexType>::ReaderLoop() {
           }
           t->errors.assign(nslice, nullptr);
           telemetry::ScopedTimerUs scan_span(PipeTel().scan_us);
+          telemetry::TraceSpan trace("parse.scan");
           base_->TileCuts(t->data.data(), t->data.data() + t->data.size(),
                           nslice, &t->cuts);
         }
@@ -1322,9 +1325,11 @@ void PipelinedParser<IndexType>::WorkerLoop() {
     }
     try {
       telemetry::ScopedTimerUs parse_span(PipeTel().parse_us);
+      telemetry::TraceSpan trace("parse.slice");
       RowBlockContainer<IndexType>* out = &t->blocks[slice];
       base_->ParseBlock(t->cuts[slice], t->cuts[slice + 1], out);
       ValidateBlock(*out);
+      trace.set_arg(out->Size());
     } catch (...) {
       t->errors[slice] = std::current_exception();
     }
@@ -1395,8 +1400,9 @@ RowBlockContainer<IndexType>* PipelinedParser<IndexType>::NextMutable() {
         consumer_waits_.fetch_add(1, std::memory_order_relaxed);
         PipeTel().consumer_waits->Add(1);
         if (wait_from != 0) {
-          PipeTel().reassemble_wait_us->Observe(telemetry::NowUs() -
-                                                wait_from);
+          const uint64_t waited_us = telemetry::NowUs() - wait_from;
+          PipeTel().reassemble_wait_us->Observe(waited_us);
+          telemetry::EmitSpan("parse.wait", wait_from, waited_us);
         }
       }
       if (!inflight_.empty() && inflight_.front()->remaining == 0) {

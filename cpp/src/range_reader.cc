@@ -312,6 +312,9 @@ void RangeReader::WorkerLoop(int id) {
     }
     const uint64_t elapsed_us = telemetry::NowUs() - t0;
     if (retries > 0) RetriedCounter()->Add(static_cast<uint64_t>(retries));
+    if (err == nullptr && !degraded_fetch) {
+      telemetry::EmitSpan("range.fetch", t0, elapsed_us, len);
+    }
 
     lk.lock();
     range_retries_ += static_cast<uint64_t>(retries);

@@ -276,6 +276,7 @@ class ShardCacheWriterImpl {
   void Append(const RowBlockContainer<IndexType>& b) {
     DCT_CHECK(fd_ >= 0) << "shard cache writer used after finalize/abandon";
     telemetry::ScopedTimerUs span(CacheTel().write_us);
+    telemetry::TraceSpan trace("cache.tee");
     const uint64_t nrows = b.Size();
     const uint64_t nnz = b.index.size();
     uint32_t flags = 0;
@@ -421,6 +422,9 @@ class ShardCacheWriterImpl {
     if (std::rename(tmp_.c_str(), q.c_str()) != 0) {
       std::remove(tmp_.c_str());
     }
+    // every fault-plane quarantine ships its own postmortem: the span
+    // ring + metric snapshot land in $DMLC_TRACE_DUMP (no-op when unset)
+    telemetry::FlightDump("cache-quarantine");
   }
 
   uint64_t blocks() const { return blocks_; }
@@ -632,6 +636,7 @@ class MmapShardReaderImpl {
   bool NextView(RowBlockView<IndexType>* out) {
     if (cur_ >= layouts_.size()) return false;
     telemetry::ScopedTimerUs span(CacheTel().read_us);
+    telemetry::TraceSpan trace("cache.replay");
     const BlockLayout& L = layouts_[cur_++];
     const char* p = static_cast<const char*>(map_);
     out->num_rows = L.rows;

@@ -3,6 +3,8 @@
 
 #include "base.h"
 
+#include <unistd.h>
+
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -211,6 +213,26 @@ const RangeHists* RangeHistsFor(const std::string& backend) {
   return &((*cache)[backend] = h);
 }
 
+namespace {
+
+// One (wall, steady) clock pair sampled back to back: the per-process
+// anchor every snapshot/trace/dump carries so steady-clock timelines can
+// be merged across processes (ranks) without drift.
+void AppendAnchor(std::string* out) {
+  const uint64_t wall_us = static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::system_clock::now().time_since_epoch())
+          .count());
+  const uint64_t steady_us = NowUs();
+  *out += "{\"wall_us\":";
+  *out += std::to_string(wall_us);
+  *out += ",\"steady_us\":";
+  *out += std::to_string(steady_us);
+  *out += '}';
+}
+
+}  // namespace
+
 std::string SnapshotJson() {
   Registry& r = Reg();
   std::string out;
@@ -219,6 +241,8 @@ std::string SnapshotJson() {
   out += std::to_string(kSnapshotVersion);
   out += ",\"enabled\":";
   out += Enabled() ? "true" : "false";
+  out += ",\"anchor\":";
+  AppendAnchor(&out);
   out += ",\"counters\":[";
   {
     std::lock_guard<std::mutex> lk(r.mu);
@@ -272,6 +296,190 @@ void Reset() {
   for (auto& e : r.counters) e.Zero();
   for (auto& e : r.gauges) e.gauge.Zero();
   for (auto& e : r.hists) e.hist.Zero();
+}
+
+// ------------------------------------------------------------- span ring --
+namespace {
+
+// Every field is an atomic so a snapshot racing a writer reads a torn
+// RECORD at worst, never undefined behavior; the per-slot seq (published
+// last with release, checked before and after the field reads) rejects
+// torn records. Slots are overwritten in claim order — the ring holds the
+// most recent kSpanRingSize spans.
+struct SpanSlot {
+  std::atomic<uint64_t> seq{0};  // claim index + 1; 0 = never written
+  std::atomic<const char*> name{nullptr};
+  std::atomic<uint64_t> span_id{0};
+  std::atomic<uint64_t> parent{0};
+  std::atomic<uint64_t> start_us{0};
+  std::atomic<uint64_t> dur_us{0};
+  std::atomic<uint64_t> arg{0};
+  std::atomic<uint32_t> tid{0};
+};
+
+struct SpanRing {
+  std::atomic<uint64_t> cursor{0};     // total spans ever claimed
+  std::atomic<uint64_t> next_span{1};  // span-id allocator (0 = no parent)
+  std::atomic<uint32_t> next_tid{0};   // small per-thread lane ids
+  SpanSlot slots[kSpanRingSize];
+};
+
+SpanRing& Ring() {
+  static SpanRing* r = new SpanRing();  // leaked: outlive static dtors
+  return *r;
+}
+
+uint32_t ThreadLane() {
+  thread_local uint32_t lane =
+      Ring().next_tid.fetch_add(1, std::memory_order_relaxed) + 1;
+  return lane;
+}
+
+// the thread's currently open TraceSpan (parent of the next nested one)
+thread_local uint64_t tls_open_span = 0;
+
+void EmitSpanRecord(const char* name, uint64_t start_us, uint64_t dur_us,
+                    uint64_t span_id, uint64_t parent, uint64_t arg) {
+  SpanRing& r = Ring();
+  const uint64_t idx = r.cursor.fetch_add(1, std::memory_order_relaxed);
+  SpanSlot& s = r.slots[idx & (kSpanRingSize - 1)];
+  // Seqlock write protocol (Boehm, "Can seqlocks get along with
+  // programming language memory models"): invalidate, RELEASE FENCE,
+  // field stores, release publish. The fence — not a release store of
+  // seq, which only orders PRIOR writes — is what guarantees a reader
+  // that observed any NEW field value will also observe seq==0 (or the
+  // final publish) at its re-check, so a torn old/new record can never
+  // pass both seq checks even on weakly-ordered hardware.
+  s.seq.store(0, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  s.name.store(name, std::memory_order_relaxed);
+  s.span_id.store(span_id, std::memory_order_relaxed);
+  s.parent.store(parent, std::memory_order_relaxed);
+  s.start_us.store(start_us, std::memory_order_relaxed);
+  s.dur_us.store(dur_us, std::memory_order_relaxed);
+  s.arg.store(arg, std::memory_order_relaxed);
+  s.tid.store(ThreadLane(), std::memory_order_relaxed);
+  s.seq.store(idx + 1, std::memory_order_release);
+}
+
+}  // namespace
+
+void EmitSpan(const char* name, uint64_t start_us, uint64_t dur_us,
+              uint64_t arg) {
+  if (!Enabled()) return;
+  SpanRing& r = Ring();
+  EmitSpanRecord(name, start_us, dur_us,
+                 r.next_span.fetch_add(1, std::memory_order_relaxed),
+                 tls_open_span, arg);
+}
+
+TraceSpan::TraceSpan(const char* name)
+    : name_(name), active_(Enabled()) {
+  if (!active_) return;
+  span_id_ = Ring().next_span.fetch_add(1, std::memory_order_relaxed);
+  parent_ = tls_open_span;
+  tls_open_span = span_id_;
+  start_ = NowUs();
+}
+
+TraceSpan::~TraceSpan() {
+  if (!active_) return;
+  tls_open_span = parent_;
+  EmitSpanRecord(name_, start_, NowUs() - start_, span_id_, parent_, arg_);
+}
+
+std::string TraceJson() {
+  SpanRing& r = Ring();
+  const uint64_t cur = r.cursor.load(std::memory_order_acquire);
+  const uint64_t window = cur < kSpanRingSize ? cur : kSpanRingSize;
+  std::string out;
+  out.reserve(256 + window * 96);
+  out += "{\"version\":1,\"pid\":";
+  out += std::to_string(static_cast<uint64_t>(::getpid()));
+  out += ",\"anchor\":";
+  AppendAnchor(&out);
+  out += ",\"emitted\":";
+  out += std::to_string(cur);
+  out += ",\"dropped\":";
+  out += std::to_string(cur - window);
+  out += ",\"spans\":[";
+  bool first = true;
+  for (uint64_t idx = cur - window; idx < cur; ++idx) {
+    SpanSlot& s = r.slots[idx & (kSpanRingSize - 1)];
+    const uint64_t seq = s.seq.load(std::memory_order_acquire);
+    if (seq != idx + 1) continue;  // torn or already overwritten: skip
+    const char* name = s.name.load(std::memory_order_relaxed);
+    const uint64_t span_id = s.span_id.load(std::memory_order_relaxed);
+    const uint64_t parent = s.parent.load(std::memory_order_relaxed);
+    const uint64_t start_us = s.start_us.load(std::memory_order_relaxed);
+    const uint64_t dur_us = s.dur_us.load(std::memory_order_relaxed);
+    const uint64_t arg = s.arg.load(std::memory_order_relaxed);
+    const uint32_t tid = s.tid.load(std::memory_order_relaxed);
+    // Seqlock read re-check: the acquire FENCE pairs with the writer's
+    // release fence — if any field load above saw a new-record value,
+    // the re-check is guaranteed to see seq==0 or the new publish and
+    // reject; an unchanged seq proves every field read was consistent.
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s.seq.load(std::memory_order_relaxed) != idx + 1 ||
+        name == nullptr) {
+      continue;
+    }
+    if (!first) out += ',';
+    first = false;
+    out += "{\"name\":\"";
+    EscapeJson(name, &out);
+    out += "\",\"id\":";
+    out += std::to_string(span_id);
+    out += ",\"parent\":";
+    out += std::to_string(parent);
+    out += ",\"tid\":";
+    out += std::to_string(tid);
+    out += ",\"ts\":";
+    out += std::to_string(start_us);
+    out += ",\"dur\":";
+    out += std::to_string(dur_us);
+    out += ",\"arg\":";
+    out += std::to_string(arg);
+    out += '}';
+  }
+  out += "]}";
+  return out;
+}
+
+void TraceReset() {
+  SpanRing& r = Ring();
+  // clear the slot seqs FIRST: a stale seq matching a post-reset claim
+  // index would let TraceJson stitch an old record into the new window
+  for (auto& s : r.slots) s.seq.store(0, std::memory_order_relaxed);
+  r.cursor.store(0, std::memory_order_release);
+}
+
+bool FlightDump(const char* reason) {
+  const char* dir = std::getenv("DMLC_TRACE_DUMP");
+  if (dir == nullptr || dir[0] == '\0') return false;
+  static std::atomic<uint32_t> n{0};
+  const uint32_t id = n.fetch_add(1, std::memory_order_relaxed);
+  std::string path = std::string(dir) + "/flight_native_" +
+                     std::to_string(static_cast<uint64_t>(::getpid())) +
+                     "_" + std::to_string(id) + ".json";
+  std::string doc;
+  doc += "{\"reason\":\"";
+  EscapeJson(reason == nullptr ? "" : reason, &doc);
+  doc += "\",\"anchor\":";
+  AppendAnchor(&doc);
+  doc += ",\"trace\":";
+  doc += TraceJson();
+  doc += ",\"metrics\":";
+  doc += SnapshotJson();
+  doc += "}\n";
+  // plain stdio, errors swallowed: the dump is a best-effort postmortem
+  // and must never mask (or re-enter, via the fault plane) the failure
+  // being recorded
+  std::FILE* f = std::fopen(path.c_str(), "w");
+  if (f == nullptr) return false;
+  const bool ok = std::fwrite(doc.data(), 1, doc.size(), f) == doc.size();
+  std::fclose(f);
+  return ok;
 }
 
 }  // namespace telemetry

@@ -122,12 +122,75 @@ void RegisterExternalCounter(const std::string& name,
                              std::atomic<uint64_t>* v);
 
 // The versioned JSON document every surface serves (schema documented in
-// doc/observability.md): {"version","enabled","counters":[{name,labels,
-// value}],"gauges":[...],"histograms":[{name,labels,count,sum,buckets}]}.
+// doc/observability.md): {"version","enabled","anchor":{wall_us,steady_us},
+// "counters":[{name,labels,value}],"gauges":[...],"histograms":[{name,
+// labels,count,sum,buckets}]}. The anchor is one (wall, steady) clock pair
+// sampled back to back at snapshot time, so timelines recorded on the
+// steady clock can be merged across processes without drift.
 std::string SnapshotJson();
 
 // Zero every registered metric (owned and external).
 void Reset();
+
+// ------------------------------------------------------------- span ring --
+// Job-wide distributed tracing (doc/observability.md "Distributed
+// tracing"): a lock-free bounded ring of COMPLETED spans covering the
+// batch path (range fetch, chunk fill, scan, slice parse, cache tee/
+// replay, batch assembly). Each record carries span-id/parent-id (a
+// thread-local chain gives nesting), the steady-clock start, duration,
+// and a small thread lane id. The ring is fixed-size; overwriting old
+// spans is the design (a flight recorder keeps the RECENT past), and the
+// dropped count makes the truncation visible. Writers are wait-free: one
+// fetch_add to claim a slot, relaxed field stores, one release store of
+// the slot's sequence number to publish; a concurrent snapshot detects a
+// torn slot by its sequence and skips it. Disabled
+// (DMLC_TELEMETRY=0 / SetEnabled(false)) cost: ONE relaxed load in the
+// TraceSpan constructor — no clock read, no slot claim.
+constexpr int kSpanRingBits = 13;                 // 8192 slots
+constexpr size_t kSpanRingSize = 1u << kSpanRingBits;
+
+// Emit one completed span (steady-clock start, microseconds). `arg` is a
+// free u64 the site can use for the dominant dimension (bytes fetched,
+// shard id); 0 when unused. No-op when telemetry is disabled.
+void EmitSpan(const char* name, uint64_t start_us, uint64_t dur_us,
+              uint64_t arg = 0);
+
+// RAII span: claims a span id, parents under the thread's currently open
+// span, and emits the completed record at scope exit. `name` must have
+// static storage duration (string literals at the instrumentation sites).
+class TraceSpan {
+ public:
+  explicit TraceSpan(const char* name);
+  ~TraceSpan();
+  void set_arg(uint64_t v) { arg_ = v; }
+  TraceSpan(const TraceSpan&) = delete;
+  TraceSpan& operator=(const TraceSpan&) = delete;
+
+ private:
+  const char* name_;
+  uint64_t start_ = 0;
+  uint64_t span_id_ = 0;
+  uint64_t parent_ = 0;
+  uint64_t arg_ = 0;
+  bool active_;
+};
+
+// The trace document (schema doc/observability.md "Distributed tracing"):
+// {"version","pid","anchor":{"wall_us","steady_us"},"emitted","dropped",
+// "spans":[{"name","id","parent","tid","ts","dur","arg"}]} — spans oldest
+// to newest, `ts` on the steady clock (convert via the anchor pair).
+std::string TraceJson();
+
+// Drop every buffered span and restart the sequence (tests / epoch cuts).
+void TraceReset();
+
+// Flight recorder (doc/observability.md): when DMLC_TRACE_DUMP names a
+// directory, write flight_native_<pid>_<n>.json there — {"reason",
+// "anchor", "trace": <TraceJson doc>, "metrics": <SnapshotJson doc>} —
+// and return true. Failures are swallowed (a postmortem writer must never
+// mask the failure it is recording). Called on fault-plane quarantines;
+// the Python half mirrors it for abort paths.
+bool FlightDump(const char* reason);
 
 // -------------------------------------------------------------- io spans --
 // Per-backend remote-I/O latency histograms (connect / time-to-first-

@@ -2043,6 +2043,189 @@ void RunTelemetrySuite() {
   TestTelemetryConcurrentWritersAndSnapshot();
 }
 
+// ---- span ring / distributed tracing (telemetry.h) -- `--trace` suite ----
+// Run standalone (test_core --trace) by the cpp/Makefile tsan-trace lane:
+// wait-free span writers racing TraceJson/TraceReset walkers is the
+// ring's whole race surface.
+
+// count occurrences of a substring (span records in a trace document)
+int CountOccurrences(const std::string& s, const std::string& needle) {
+  int n = 0;
+  for (size_t at = s.find(needle); at != std::string::npos;
+       at = s.find(needle, at + needle.size())) {
+    ++n;
+  }
+  return n;
+}
+
+void TestTraceSpanBasicsAndParenting() {
+  namespace tl = dct::telemetry;
+  tl::TraceReset();
+  tl::SetEnabled(true);
+  {
+    tl::TraceSpan outer("trace.outer");
+    outer.set_arg(7);
+    { tl::TraceSpan inner("trace.inner"); }
+  }
+  tl::EmitSpan("trace.manual", 100, 50, 9);
+  const std::string s = tl::TraceJson();
+  // the document must parse as JSON (Python consumes it raw)
+  std::istringstream is(s);
+  dct::JSONReader r(&is);
+  r.BeginObject();
+  std::string key;
+  int version = 0;
+  std::map<std::string, int> seen;
+  while (r.NextObjectItem(&key)) {
+    seen[key] = 1;
+    if (key == "version") {
+      r.Read(&version);
+    } else {
+      r.SkipValue();
+    }
+  }
+  EXPECT(version == 1);
+  EXPECT(seen.count("pid") == 1);
+  EXPECT(seen.count("anchor") == 1);
+  EXPECT(seen.count("spans") == 1);
+  EXPECT(s.find("\"wall_us\":") != std::string::npos);
+  EXPECT(s.find("\"steady_us\":") != std::string::npos);
+  EXPECT(s.find("\"trace.outer\"") != std::string::npos);
+  EXPECT(s.find("\"trace.inner\"") != std::string::npos);
+  EXPECT(s.find("\"trace.manual\"") != std::string::npos);
+  EXPECT(s.find("\"arg\":7") != std::string::npos);
+  EXPECT(s.find("\"arg\":9") != std::string::npos);
+  EXPECT(s.find("\"dropped\":0") != std::string::npos);
+  // parenting: the inner span's parent is the outer span's id. Records
+  // land inner-first (completion order); ids allocate outer-first.
+  const size_t inner_at = s.find("\"trace.inner\"");
+  const size_t outer_at = s.find("\"trace.outer\"");
+  EXPECT(inner_at != std::string::npos && outer_at != std::string::npos);
+  auto field_after = [&](size_t at, const char* field) -> long long {
+    const size_t f = s.find(field, at);
+    EXPECT(f != std::string::npos);
+    // env-ok: parsing our own just-serialized test document, not env
+    return std::atoll(s.c_str() + f + std::strlen(field));
+  };
+  const long long outer_id = field_after(outer_at, "\"id\":");
+  EXPECT(field_after(inner_at, "\"parent\":") == outer_id);
+  EXPECT(field_after(outer_at, "\"parent\":") == 0);
+  // the manual emit outside any open TraceSpan carries no parent
+  EXPECT(field_after(s.find("\"trace.manual\""), "\"parent\":") == 0);
+  tl::TraceReset();
+}
+
+void TestTraceDisabledGate() {
+  namespace tl = dct::telemetry;
+  tl::TraceReset();
+  tl::SetEnabled(false);
+  {
+    tl::TraceSpan gated("trace.gated");
+    tl::EmitSpan("trace.gated_manual", 1, 1);
+  }
+  tl::SetEnabled(true);
+  const std::string s = tl::TraceJson();
+  EXPECT(s.find("\"emitted\":0") != std::string::npos);
+  EXPECT(s.find("trace.gated") == std::string::npos);
+  tl::TraceReset();
+}
+
+void TestTraceRingWraparound() {
+  namespace tl = dct::telemetry;
+  tl::TraceReset();
+  tl::SetEnabled(true);
+  const int extra = 100;
+  const int total = static_cast<int>(tl::kSpanRingSize) + extra;
+  for (int i = 0; i < total; ++i) {
+    tl::EmitSpan("trace.wrap", static_cast<uint64_t>(i), 1,
+                 static_cast<uint64_t>(i));
+  }
+  const std::string s = tl::TraceJson();
+  EXPECT(s.find("\"emitted\":" + std::to_string(total)) !=
+         std::string::npos);
+  EXPECT(s.find("\"dropped\":" + std::to_string(extra)) !=
+         std::string::npos);
+  // the ring holds exactly the most recent kSpanRingSize spans: the
+  // first surviving record is span number `extra` (ts == extra), and
+  // the record count matches the capacity
+  EXPECT(CountOccurrences(s, "\"trace.wrap\"") ==
+         static_cast<int>(tl::kSpanRingSize));
+  EXPECT(s.find("\"ts\":" + std::to_string(extra) + ",") !=
+         std::string::npos);
+  EXPECT(s.find("\"ts\":" + std::to_string(extra - 1) + ",") ==
+         std::string::npos);
+  tl::TraceReset();
+}
+
+void TestTraceConcurrentWritersVsSnapshot() {
+  // the TSan target: wait-free writers claiming/publishing slots while
+  // snapshotters walk the ring and a resetter clears it mid-flight
+  namespace tl = dct::telemetry;
+  tl::TraceReset();
+  tl::SetEnabled(true);
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> writers;
+  for (int i = 0; i < 4; ++i) {
+    writers.emplace_back([&, i] {
+      for (int k = 0; k < 20000; ++k) {
+        tl::TraceSpan span(i % 2 == 0 ? "trace.conc_a" : "trace.conc_b");
+        span.set_arg(static_cast<uint64_t>(k));
+      }
+    });
+  }
+  std::vector<std::thread> readers;
+  for (int i = 0; i < 2; ++i) {
+    readers.emplace_back([&] {
+      while (!stop.load(std::memory_order_relaxed)) {
+        const std::string s = tl::TraceJson();
+        EXPECT(!s.empty());
+        // a torn record would corrupt the JSON structure; spot-check
+        // the bracket balance of every concurrent snapshot
+        EXPECT(CountOccurrences(s, "{") == CountOccurrences(s, "}"));
+      }
+    });
+  }
+  std::thread resetter([&] {
+    while (!stop.load(std::memory_order_relaxed)) {
+      tl::TraceReset();
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  for (auto& w : writers) w.join();
+  stop.store(true);
+  for (auto& r : readers) r.join();
+  resetter.join();
+  // quiesced determinism: after a final reset + known emits, the
+  // document holds exactly those spans
+  tl::TraceReset();
+  tl::EmitSpan("trace.final", 1, 2, 3);
+  const std::string s = tl::TraceJson();
+  EXPECT(CountOccurrences(s, "\"trace.final\"") == 1);
+  EXPECT(s.find("\"emitted\":1") != std::string::npos);
+  tl::TraceReset();
+}
+
+void TestTraceAnchorTracksClocks() {
+  namespace tl = dct::telemetry;
+  // the anchor pair must be coherent with the clocks it claims to
+  // anchor: steady_us within a breath of NowUs
+  const std::string s = tl::TraceJson();
+  const size_t at = s.find("\"steady_us\":");
+  EXPECT(at != std::string::npos);
+  // env-ok: parsing our own just-serialized test document, not env
+  const long long steady = std::atoll(s.c_str() + at + 12);
+  const long long now = static_cast<long long>(tl::NowUs());
+  EXPECT(now >= steady && now - steady < 5 * 1000 * 1000);
+}
+
+void RunTraceSuite() {
+  TestTraceSpanBasicsAndParenting();
+  TestTraceDisabledGate();
+  TestTraceRingWraparound();
+  TestTraceConcurrentWritersVsSnapshot();
+  TestTraceAnchorTracksClocks();
+}
+
 // ---- transcoding shard cache (shard_cache.h) -- the `--cache` suite ------
 // Run standalone (test_core --cache) by the cpp/Makefile asan-cache /
 // tsan-cache lanes: concurrent transcoders/readers over one cache unit,
@@ -3303,6 +3486,18 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;
   }
+  if (argc > 1 && std::string(argv[1]) == "--trace") {
+    // the span-ring tracing suite alone — the cpp/Makefile tsan-trace
+    // lane runs exactly this under ThreadSanitizer (wait-free span
+    // writers racing TraceJson/TraceReset walkers)
+    RunTraceSuite();
+    if (g_failures == 0) {
+      std::printf("OK\n");
+      return 0;
+    }
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
   if (argc > 1 && std::string(argv[1]) == "--io") {
     // the remote-I/O resilience suite alone — the cpp/Makefile tsan-io
     // lane runs exactly this under ThreadSanitizer (the fault hook and
@@ -3423,6 +3618,7 @@ int main(int argc, char** argv) {
   RunIoResilienceSuite();
   RunRangeReaderSuite();
   RunTelemetrySuite();
+  RunTraceSuite();
   RunShardCacheSuite();
   RunFsFaultSuite();
   if (g_failures == 0) {

@@ -572,7 +572,14 @@ class RowBlockIter:
                 t0 = time.perf_counter() if telemetry.enabled() else None
                 b = self._parser.next_block()
                 if t0 is not None:
-                    batch_us.observe((time.perf_counter() - t0) * 1e6)
+                    dur_us = (time.perf_counter() - t0) * 1e6
+                    batch_us.observe(dur_us)
+                    # same measurement, second surface: the span ring
+                    # (doc/observability.md "Distributed tracing")
+                    telemetry.emit_span(
+                        "rowblock.next", t0 * 1e6, dur_us,
+                        rows=getattr(b, "num_rows", 0) if b is not None
+                        else 0)
                 if b is not None:
                     batches.inc()
                 return b

@@ -168,6 +168,9 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_telemetry_snapshot": [c.POINTER(c.c_char_p)],
         "dct_telemetry_reset": [],
         "dct_telemetry_enable": [i],
+        "dct_trace_snapshot": [c.POINTER(c.c_char_p)],
+        "dct_trace_reset": [],
+        "dct_flight_dump": [c.c_char_p, c.POINTER(i)],
         "dct_io_retry_stats": [c.POINTER(IoRetryStatsC)],
         "dct_io_stats_reset": [],
         "dct_io_set_fault_plan": [c.c_char_p],
@@ -410,6 +413,41 @@ def native_telemetry_enable(on: bool) -> None:
     (``dct_telemetry_enable``; overrides DMLC_TELEMETRY). Counters keep
     counting either way."""
     _check(lib().dct_telemetry_enable(1 if on else 0))
+
+
+def native_trace_snapshot() -> dict:
+    """The native span-ring trace document (``dct_trace_snapshot``,
+    cpp/src/telemetry.h): ``{"version", "pid", "anchor": {"wall_us",
+    "steady_us"}, "emitted", "dropped", "spans": [{"name", "id",
+    "parent", "tid", "ts", "dur", "arg"}]}`` — steady-clock timestamps,
+    mergeable onto the wall clock via the anchor pair. Prefer
+    :func:`dmlc_core_tpu.telemetry.trace_snapshot`, which merges both
+    halves ([observability.md](observability.md) "Distributed
+    tracing")."""
+    import json
+    out = ctypes.c_char_p()
+    _check(lib().dct_trace_snapshot(ctypes.byref(out)))
+    try:
+        return json.loads(ctypes.string_at(out).decode())
+    finally:
+        lib().dct_str_free(out)
+
+
+def native_trace_reset() -> None:
+    """Drop every buffered native span and restart the trace sequence
+    (``dct_trace_reset``; also implied by ``dct_telemetry_reset``)."""
+    _check(lib().dct_trace_reset())
+
+
+def native_flight_dump(reason: str) -> bool:
+    """Best-effort native flight-recorder dump (``dct_flight_dump``):
+    writes the native span ring + metric snapshot to the
+    ``DMLC_TRACE_DUMP`` directory. Returns True only when a dump file
+    actually landed (False when the env knob is unset or the write
+    failed)."""
+    written = ctypes.c_int(0)
+    _check(lib().dct_flight_dump(reason.encode(), ctypes.byref(written)))
+    return written.value != 0
 
 
 # -- remote-I/O resilience ---------------------------------------------------

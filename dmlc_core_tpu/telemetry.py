@@ -34,14 +34,21 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "HIST_BUCKETS",
-           "SNAPSHOT_VERSION", "counter", "gauge", "histogram",
-           "register_collector", "unregister_collector", "enabled",
-           "enable", "reset", "emit_event", "events", "snapshot",
-           "prometheus_text", "events_jsonl"]
+           "SNAPSHOT_VERSION", "SPANS_MAX", "METRIC_HELP", "counter",
+           "gauge", "histogram", "register_collector",
+           "unregister_collector", "enabled", "enable", "reset",
+           "emit_event", "events", "snapshot", "prometheus_text",
+           "events_jsonl", "span", "emit_span", "spans", "clock_anchor",
+           "trace_snapshot", "trace_json", "rank_export",
+           "cluster_prometheus_text", "cluster_trace_json",
+           "stall_attribution", "VERDICT_CODES", "flight_dump"]
 
 SNAPSHOT_VERSION = 1
 # must match cpp/src/telemetry.h kHistBuckets (le 2^0..2^27, then +Inf)
 HIST_BUCKETS = 28
+# Python half of the span ring: most recent SPANS_MAX completed spans
+# (the native ring is cpp/src/telemetry.h kSpanRingSize)
+SPANS_MAX = 8192
 
 _lock = threading.Lock()
 _counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], "Counter"] = {}
@@ -51,6 +58,15 @@ _collectors: List[Callable[[], None]] = []
 _events: List[dict] = []
 _EVENTS_MAX = 4096
 _enabled: Optional[bool] = None
+
+# span-ring state: completed spans (dicts) in emit order, a monotonically
+# increasing span-id allocator, a small per-thread lane id map, and the
+# per-thread currently-open span (the parent of the next nested one)
+_spans: List[dict] = []
+_spans_dropped = 0
+_span_seq = 0
+_tids: Dict[int, int] = {}
+_tls = threading.local()
 
 
 def _labels_key(labels: Optional[Dict[str, str]]
@@ -234,6 +250,7 @@ def reset(native: bool = True) -> None:
     """Zero every Python-registered metric and drop buffered events; with
     ``native=True`` (default) also zero the native registry when its
     library is loaded (``dct_telemetry_reset``)."""
+    global _spans_dropped
     with _lock:
         for c in _counters.values():
             c.zero()
@@ -242,10 +259,12 @@ def reset(native: bool = True) -> None:
         for h in _hists.values():
             h.zero()
         del _events[:]
+        del _spans[:]
+        _spans_dropped = 0
     if native:
         lib = _native_lib_if_loaded()
         if lib is not None:
-            lib.dct_telemetry_reset()
+            lib.dct_telemetry_reset()  # also drops the native span ring
 
 
 def emit_event(event: str, **fields) -> None:
@@ -267,6 +286,407 @@ def events() -> List[dict]:
     """A copy of the buffered event stream (most recent ``4096``)."""
     with _lock:
         return list(_events)
+
+
+# -- distributed tracing (doc/observability.md "Distributed tracing") --------
+def _thread_lane() -> int:
+    """Small stable lane id for the calling thread (Chrome-trace tid)."""
+    ident = threading.get_ident()
+    with _lock:
+        lane = _tids.get(ident)
+        if lane is None:
+            lane = _tids[ident] = len(_tids) + 1
+        return lane
+
+
+def _perf_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+def clock_anchor() -> Dict[str, float]:
+    """One (wall, monotonic) clock pair sampled back to back — the
+    per-process anchor every snapshot/trace/dump carries, so timelines
+    recorded on the monotonic clock (spans) merge with wall-clock streams
+    (events) and with other processes' spans without drift. Keys:
+    ``wall_us`` (``time.time()`` µs) and ``perf_us``
+    (``time.perf_counter()`` µs)."""
+    return {"wall_us": time.time() * 1e6, "perf_us": _perf_us()}
+
+
+def _append_span(name: str, span_id: int, parent: int, start_us: float,
+                 dur_us: float, args: Optional[dict]) -> None:
+    """Append one completed record to the ring (the one shared writer:
+    :func:`emit_span` and :class:`_Span` both land here)."""
+    global _spans_dropped
+    lane = _thread_lane()
+    with _lock:
+        rec = {"name": name, "id": span_id, "parent": parent, "tid": lane,
+               "ts": int(start_us), "dur": int(dur_us)}
+        if args:
+            rec["args"] = args
+        _spans.append(rec)
+        if len(_spans) > SPANS_MAX:
+            drop = len(_spans) - SPANS_MAX
+            del _spans[:drop]
+            _spans_dropped += drop
+
+
+def emit_span(name: str, start_us: float, dur_us: float,
+              **args) -> None:
+    """Append one COMPLETED span to the process span ring: ``start_us``
+    on the ``time.perf_counter()`` microsecond clock, ``dur_us`` its
+    duration. Parents under the thread's currently open :func:`span`
+    (matching the native ``EmitSpan``). Extra keyword args ride along as
+    the span's ``args`` dict (keep them small — shard ids, byte counts).
+    No-op when telemetry is disabled; the ring keeps the most recent
+    :data:`SPANS_MAX` spans and counts what it overwrote."""
+    if not enabled():
+        return
+    global _span_seq
+    with _lock:
+        _span_seq += 1
+        span_id = _span_seq
+    _append_span(name, span_id, getattr(_tls, "open_span", 0), start_us,
+                 dur_us, args or None)
+
+
+class _Span:
+    """Context manager behind :func:`span`; exposes ``set_arg`` for the
+    dominant dimension of the work (bytes, rows, shard id)."""
+
+    __slots__ = ("name", "args", "_start", "_id", "_parent", "_active")
+
+    def __init__(self, name: str, args: Optional[dict]):
+        self.name = name
+        self.args = args
+
+    def set_arg(self, key: str, value) -> None:
+        """Attach one key/value to the span's args."""
+        if self.args is None:
+            self.args = {}
+        self.args[key] = value
+
+    def __enter__(self) -> "_Span":
+        self._active = enabled()
+        if not self._active:
+            return self
+        global _span_seq
+        with _lock:
+            _span_seq += 1
+            self._id = _span_seq
+        self._parent = getattr(_tls, "open_span", 0)
+        _tls.open_span = self._id
+        self._start = _perf_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if not self._active:
+            return
+        dur = _perf_us() - self._start
+        _tls.open_span = self._parent
+        _append_span(self.name, self._id, self._parent, self._start, dur,
+                     self.args)
+
+
+def span(name: str, **args) -> _Span:
+    """RAII trace span: ``with telemetry.span("rowblock.next"): ...``
+    records one completed span (perf-counter clock, µs) into the process
+    span ring at scope exit, parented under the thread's currently open
+    span. Disabled (:func:`enabled` False) cost: one attribute read.
+    Extra kwargs become the span's ``args``."""
+    return _Span(name, args or None)
+
+
+def spans() -> List[dict]:
+    """A copy of the buffered Python span ring (most recent
+    :data:`SPANS_MAX` completed spans, emit order)."""
+    with _lock:
+        return list(_spans)
+
+
+def _native_trace_doc() -> Optional[dict]:
+    """The native span-ring document (``dct_trace_snapshot``), or None
+    when the library is not loaded. Never triggers a build."""
+    lib = _native_lib_if_loaded()
+    if lib is None:
+        return None
+    import ctypes
+    out = ctypes.c_char_p()
+    if lib.dct_trace_snapshot(ctypes.byref(out)) != 0:
+        return None
+    try:
+        return json.loads(ctypes.string_at(out).decode())
+    finally:
+        lib.dct_str_free(out)
+
+
+def trace_snapshot() -> dict:
+    """The process trace document: Python spans (perf-counter clock) plus
+    the native ring's document (steady clock) when the library is already
+    loaded, each with its own (wall, monotonic) anchor pair. Schema:
+    ``{"version", "pid", "anchor": {"wall_us", "perf_us"}, "spans": [...],
+    "dropped", "native": <dct_trace_snapshot doc>|None}``. Use
+    :func:`trace_json` for the merged wall-clock Chrome-trace render."""
+    return {"version": 1, "pid": os.getpid(), "anchor": clock_anchor(),
+            "spans": spans(), "dropped": _spans_dropped,
+            "native": _native_trace_doc()}
+
+
+def _wall_spans(snap: dict) -> List[dict]:
+    """Flatten a :func:`trace_snapshot` doc into ONE list of spans on the
+    wall-clock µs timeline: each half's spans are shifted by its own
+    (wall, monotonic) anchor pair, so native (steady-clock) and Python
+    (perf-counter) spans land on the same axis — and, across processes,
+    on the same axis as every other rank's."""
+    out = []
+    a = snap.get("anchor") or {}
+    shift = float(a.get("wall_us", 0)) - float(a.get("perf_us", 0))
+    for s in snap.get("spans", ()):
+        rec = dict(s)
+        rec["ts"] = int(s["ts"] + shift)
+        rec["cat"] = "python"
+        out.append(rec)
+    nat = snap.get("native")
+    if nat:
+        na = nat.get("anchor") or {}
+        nshift = float(na.get("wall_us", 0)) - float(na.get("steady_us", 0))
+        for s in nat.get("spans", ()):
+            rec = {"name": s["name"], "id": s["id"], "parent": s["parent"],
+                   # native lanes get their own tid namespace so a native
+                   # worker thread never shares a lane with a Python one
+                   "tid": 1000 + int(s["tid"]),
+                   "ts": int(s["ts"] + nshift), "dur": int(s["dur"]),
+                   "cat": "native"}
+            if s.get("arg"):
+                rec["args"] = {"arg": s["arg"]}
+            out.append(rec)
+    out.sort(key=lambda r: r["ts"])
+    return out
+
+
+def _chrome_events(wall_spans: List[dict], pid, label: str) -> List[dict]:
+    """Chrome-trace/Perfetto events for one process lane: complete ("X")
+    events plus the process_name metadata record."""
+    evs = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": label}}]
+    for s in wall_spans:
+        ev = {"ph": "X", "name": s["name"], "pid": pid, "tid": s["tid"],
+              "ts": s["ts"], "dur": max(int(s["dur"]), 0),
+              "cat": s.get("cat", "python"),
+              "args": dict(s.get("args") or {},
+                           span_id=s["id"], parent=s["parent"])}
+        evs.append(ev)
+    return evs
+
+
+def trace_json(snap: Optional[dict] = None) -> str:
+    """Render the process trace (default: take :func:`trace_snapshot`
+    now) as Chrome-trace JSON — loadable in Perfetto / ``chrome://
+    tracing``. C++ and Python spans are merged onto ONE wall-clock µs
+    timeline via each half's (wall, monotonic) anchor pair; native worker
+    threads get their own ``tid`` lanes. For the job-wide merged view
+    across ranks, scrape a live tracker's ``GET /trace``
+    (:func:`cluster_trace_json`)."""
+    if snap is None:
+        snap = trace_snapshot()
+    pid = snap.get("pid", 0)
+    evs = _chrome_events(_wall_spans(snap), pid, f"pid {pid}")
+    return json.dumps({"traceEvents": evs, "displayTimeUnit": "ms"})
+
+
+# -- stall attribution (doc/observability.md "Stall attribution") ------------
+# verdict -> stall_verdict_code gauge value
+VERDICT_CODES = {"unknown": -1, "fill_bound": 0, "parse_bound": 1,
+                 "consumer_bound": 2, "transfer_bound": 3}
+
+# the consumer counts as the binding stage when it spent less than this
+# fraction of the pipeline's busy time waiting on the head-of-line chunk
+# (the pipeline kept up; whatever is downstream of it did not)
+_STARVED_WAIT_FRACTION = 0.05
+
+
+def stall_attribution(snap: Optional[dict] = None) -> dict:
+    """Per-stage occupancy plus a fill-bound / parse-bound /
+    consumer-bound / transfer-bound verdict, derived from the span-backed
+    stage histograms of one snapshot (default: take one now).
+
+    The decision tree reads the batch path's own instrumentation:
+    ``device_transfer_us`` dominating both fill and parse means the
+    host→HBM hop binds (``transfer_bound``); a small
+    ``parse_stage_reassemble_wait_us`` relative to the pipeline's busy
+    time means the pipeline kept up and the CONSUMER binds
+    (``consumer_bound``); otherwise the consumer was starved by the
+    pipeline, and the larger of the fill (source read + cache replay) and
+    parse (scan + slice decode) sums names the stage. With no stage
+    observations (spans disabled, nothing run) the verdict is
+    ``unknown``. Returns ``{"verdict", "stage_us": {...}, "occupancy":
+    {stage: fraction}}``; the same result rides every snapshot as the
+    ``stall_stage_occupancy{stage=}`` / ``stall_verdict_code`` gauges."""
+    if snap is None:
+        snap = snapshot()
+    sums: Dict[str, float] = {}
+    for h in snap.get("histograms", ()):
+        if not h.get("labels"):
+            sums[h["name"]] = sums.get(h["name"], 0.0) + float(h["sum"])
+    fill = sums.get("parse_stage_fill_us", 0.0) + \
+        sums.get("cache_read_us", 0.0)
+    parse = sums.get("parse_stage_parse_us", 0.0) + \
+        sums.get("parse_stage_scan_us", 0.0)
+    wait = sums.get("parse_stage_reassemble_wait_us", 0.0)
+    transfer = sums.get("device_transfer_us", 0.0)
+    busy = fill + parse
+    stage_us = {"fill": fill, "parse": parse, "pipeline_wait": wait,
+                "transfer": transfer}
+    total = busy + transfer
+    occupancy = {k: (stage_us[k] / total if total > 0 else 0.0)
+                 for k in ("fill", "parse", "transfer")}
+    occupancy["pipeline_wait"] = wait / total if total > 0 else 0.0
+    if busy <= 0 and transfer <= 0:
+        verdict = "unknown"
+    elif transfer > max(fill, parse):
+        verdict = "transfer_bound"
+    elif wait <= _STARVED_WAIT_FRACTION * busy:
+        verdict = "consumer_bound"
+    elif fill > parse:
+        verdict = "fill_bound"
+    else:
+        verdict = "parse_bound"
+    return {"verdict": verdict, "stage_us": stage_us,
+            "occupancy": occupancy}
+
+
+# -- flight recorder (doc/observability.md "Flight recorder") ----------------
+_flight_seq = 0
+
+
+def flight_dump(reason: str, rank: Optional[int] = None) -> Optional[str]:
+    """Write a postmortem — the span ring (both halves), the event ring,
+    and a full metric snapshot, with this process's clock anchors — to
+    ``$DMLC_TRACE_DUMP/flight_<pid>_<n>.json``. No-op (returns None) when
+    ``DMLC_TRACE_DUMP`` is unset; every failure is swallowed, because a
+    postmortem writer must never mask the failure it is recording.
+    Called on abort broadcasts, tracker aborts, and dead-rank write-offs;
+    the native half mirrors it for fault-plane quarantines."""
+    out_dir = os.environ.get("DMLC_TRACE_DUMP")
+    if not out_dir:
+        return None
+    global _flight_seq
+    try:
+        with _lock:
+            _flight_seq += 1
+            seq = _flight_seq
+        doc = {"reason": reason, "rank": rank, "pid": os.getpid(),
+               "wall_ts": time.time(), "anchor": clock_anchor(),
+               "trace": trace_snapshot(), "metrics": snapshot()}
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir,
+                            f"flight_{os.getpid()}_{seq}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        return path
+    except Exception:
+        return None
+
+
+# -- cluster aggregation (the tracker's /metrics + /trace) -------------------
+def rank_export(max_spans: int = 2048) -> dict:
+    """The per-rank telemetry document a worker ships to the tracker in
+    answer to a TELEMETRY_PULL frame (doc/observability.md "Cluster
+    aggregation"): the merged metric snapshot plus the span ring
+    flattened onto the WALL clock (each half shifted by its own anchor
+    pair, so the tracker merges ranks without knowing their monotonic
+    epochs). Spans are capped at the most recent ``max_spans`` to bound
+    the frame."""
+    snap = snapshot()
+    wall = _wall_spans(trace_snapshot())
+    if len(wall) > max_spans:
+        wall = wall[-max_spans:]
+    return {"pid": os.getpid(), "anchor": snap["anchor"],
+            "metrics": {"counters": snap["counters"],
+                        "gauges": snap["gauges"],
+                        "histograms": snap["histograms"]},
+            "spans": wall}
+
+
+def _aggregate_ranks(per_rank: Dict[int, dict]) -> dict:
+    """Element-wise job sums across rank metric docs: counters by (name,
+    labels); histograms by (name, labels) with bucket-wise addition."""
+    counters: Dict[tuple, float] = {}
+    hists: Dict[tuple, dict] = {}
+    for doc in per_rank.values():
+        m = doc.get("metrics", {})
+        for c in m.get("counters", ()):
+            key = (c["name"], _labels_key(c.get("labels")))
+            counters[key] = counters.get(key, 0) + c["value"]
+        for h in m.get("histograms", ()):
+            key = (h["name"], _labels_key(h.get("labels")))
+            agg = hists.get(key)
+            if agg is None:
+                hists[key] = {"count": h["count"], "sum": h["sum"],
+                              "buckets": list(h["buckets"])}
+            else:
+                agg["count"] += h["count"]
+                agg["sum"] += h["sum"]
+                agg["buckets"] = [a + b for a, b in
+                                  zip(agg["buckets"], h["buckets"])]
+    return {"counters": counters, "histograms": hists}
+
+
+def cluster_prometheus_text(per_rank: Dict[int, dict],
+                            local_snap: Optional[dict] = None) -> str:
+    """The job-wide Prometheus exposition a live tracker serves at
+    ``GET /metrics``: the tracker process's own merged snapshot
+    (unchanged — back-compatible with single-process scrapes), every
+    pulled rank's series re-labeled with ``rank="<r>"``, and job-level
+    sums under the ``job:<name>`` aggregate-naming convention (counters
+    summed value-wise, histograms bucket-wise) so job counters equal the
+    per-rank sums counter-for-counter. One ``# HELP``/``# TYPE`` pair per
+    metric name across the whole document."""
+    if local_snap is None:
+        local_snap = snapshot()
+    # family-grouped: the tracker's own series and every rank's
+    # rank="r"-labeled series of one metric land in ONE contiguous group
+    # (the exposition format's grouping rule)
+    fams: Dict[str, dict] = {}
+    _collect_doc(fams, local_snap)
+    for rank in sorted(per_rank):
+        _collect_doc(fams, per_rank[rank].get("metrics", {}),
+                     extra=f'rank="{rank}"')
+    agg = _aggregate_ranks(per_rank)
+    for (name, labels), value in sorted(agg["counters"].items()):
+        f = fams.setdefault("job:" + name, {
+            "kind": "counter",
+            "help": f"job-wide sum of {name} across ranks", "lines": []})
+        f["lines"].append(f"job:{name}{_fmt_labels(dict(labels))} "
+                          f"{_fmt_value(value)}")
+    for (name, labels), h in sorted(agg["histograms"].items()):
+        f = fams.setdefault("job:" + name, {
+            "kind": "histogram",
+            "help": f"job-wide bucket-wise sum of {name} across ranks",
+            "lines": []})
+        _render_hist_series(f["lines"], "job:" + name, dict(labels), h)
+    return _emit_families(fams)
+
+
+def cluster_trace_json(per_rank: Dict[int, dict],
+                       local_trace: Optional[dict] = None) -> str:
+    """The merged job timeline a live tracker serves at ``GET /trace``:
+    one Chrome-trace/Perfetto document with a process lane PER RANK (the
+    event ``pid`` is the rank, the lane is labeled with the rank and its
+    OS pid) plus the tracker's own lane. Every rank's spans arrive
+    already wall-clock-shifted by that rank's anchor pair
+    (:func:`rank_export`), so the lanes share one timeline."""
+    evs: List[dict] = []
+    for rank in sorted(per_rank):
+        doc = per_rank[rank]
+        evs += _chrome_events(doc.get("spans", ()), rank,
+                              f"rank {rank} (pid {doc.get('pid', '?')})")
+    if local_trace is None:
+        local_trace = trace_snapshot()
+    evs += _chrome_events(_wall_spans(local_trace), 999999,
+                          f"tracker (pid {local_trace.get('pid', '?')})")
+    return json.dumps({"traceEvents": evs, "displayTimeUnit": "ms"})
 
 
 def _native_lib_if_loaded():
@@ -307,10 +727,16 @@ def snapshot(native: Optional[bool] = None) -> dict:
     the library is ALREADY loaded (never triggers a build); ``True``
     forces loading/building it; ``False`` excludes it.
 
-    Schema (version 1, append-only): ``{"version", "enabled", "native":
-    bool, "counters": [{"name", "labels", "value"}], "gauges": [...],
+    Schema (version 1, append-only): ``{"version", "enabled", "anchor":
+    {"wall_us", "perf_us"}, "native": bool, "native_anchor": {...}|None,
+    "counters": [{"name", "labels", "value"}], "gauges": [...],
     "histograms": [{"name", "labels", "count", "sum", "buckets":
-    [HIST_BUCKETS+1 counts]}], "events": [...]}``."""
+    [HIST_BUCKETS+1 counts]}], "events": [...]}``. The anchor is this
+    process's (wall, monotonic) clock pair; ``native_anchor`` the native
+    half's (wall, steady) pair from the same snapshot. The gauge list
+    ends with the derived stall-attribution gauges
+    (``stall_stage_occupancy{stage=}`` + ``stall_verdict_code``,
+    :func:`stall_attribution`)."""
     with _lock:
         collectors = list(_collectors)
     for fn in collectors:
@@ -319,12 +745,14 @@ def snapshot(native: Optional[bool] = None) -> dict:
         except Exception:
             pass  # a broken collector must not sink the scrape
     doc = {"version": SNAPSHOT_VERSION, "enabled": enabled(),
-           "native": False, "counters": [], "gauges": [],
+           "anchor": clock_anchor(), "native": False,
+           "native_anchor": None, "counters": [], "gauges": [],
            "histograms": [], "events": []}
     if native is not False:
         nat = _native_snapshot_dict(force=bool(native))
         if nat is not None:
             doc["native"] = True
+            doc["native_anchor"] = nat.get("anchor")
             doc["counters"] += nat.get("counters", [])
             doc["gauges"] += nat.get("gauges", [])
             doc["histograms"] += nat.get("histograms", [])
@@ -340,6 +768,15 @@ def snapshot(native: Optional[bool] = None) -> dict:
                 {"name": h.name, "labels": h.labels, "count": h.count,
                  "sum": h.sum, "buckets": list(h.buckets)})
         doc["events"] = list(_events)
+    # derived stall-attribution gauges ride every snapshot (and therefore
+    # every /metrics scrape) without a collector: they are computed FROM
+    # the snapshot, so a collector would recurse
+    att = stall_attribution(doc)
+    for stage, frac in att["occupancy"].items():
+        doc["gauges"].append({"name": "stall_stage_occupancy",
+                              "labels": {"stage": stage}, "value": frac})
+    doc["gauges"].append({"name": "stall_verdict_code", "labels": {},
+                          "value": VERDICT_CODES[att["verdict"]]})
     return doc
 
 
@@ -362,43 +799,165 @@ def _fmt_value(v) -> str:
     return str(int(v))
 
 
+# One-line HELP text per cataloged metric name, emitted as ``# HELP``
+# exposition lines (doc/observability.md is the long-form catalog).
+# Uncataloged names (tests, ad-hoc metrics) simply carry no HELP line.
+METRIC_HELP: Dict[str, str] = {
+    "io_requests_total": "HTTP requests sent",
+    "io_retries_total": "backoff sleeps taken",
+    "io_backoff_ms_total": "total milliseconds slept in backoff",
+    "io_timeouts_total": "per-attempt socket timeout expiries",
+    "io_faults_injected_total": "DMLC_IO_FAULT_PLAN firings",
+    "io_giveups_total": "retry loops that exhausted their budget",
+    "io_deadline_exhausted_total": "giveups caused by the per-op deadline",
+    "io_connect_us": "TCP connect latency per request (us)",
+    "io_ttfb_us": "request-sent to first response byte (us)",
+    "io_recv_us": "one response-body pull (us)",
+    "io_range_issued_total": "range fetches issued",
+    "io_range_retried_total": "per-range retry attempts",
+    "io_range_degraded_200_total":
+        "streams degraded to the sequential lane (origin ignored Range)",
+    "io_range_bytes": "completed range sizes (bytes)",
+    "io_range_wait_us": "consumer head-of-line wait (us)",
+    "io_range_sched_bytes": "scheduler's current range size",
+    "io_range_sched_concurrency": "scheduler's current worker credit",
+    "parse_chunks_read_total": "chunks admitted by reader stages",
+    "parse_blocks_delivered_total": "row blocks handed to consumers",
+    "parse_reader_waits_total": "reader blocked on the in-flight bound",
+    "parse_worker_waits_total": "worker slept with no claimable slice",
+    "parse_consumer_waits_total":
+        "consumer slept on the head-of-line chunk",
+    "parse_stage_fill_us": "one ReadChunk, source to owned bytes (us)",
+    "parse_stage_scan_us": "one TileCuts slice pre-tiling (us)",
+    "parse_stage_parse_us": "one worker slice decode (us)",
+    "parse_stage_reassemble_wait_us":
+        "one consumer head-of-line wait (us)",
+    "cache_hits_total": "epochs served from a validated binary shard",
+    "cache_misses_total": "epochs served from the text lane",
+    "cache_transcodes_total": "completed atomically-published transcodes",
+    "cache_write_errors_total":
+        "transcode passes lost to local-I/O failure (quarantined)",
+    "cache_read_us": "one replay block hand-out (us)",
+    "cache_write_us": "one transcoded block append (us)",
+    "fs_fault_injected_total": "DMLC_FS_FAULT_PLAN firings per op",
+    "ckpt_save_failures_total": "checkpoint saves that raised",
+    "event_log_dropped_total":
+        "tracker event-log lines dropped by a contained I/O failure",
+    "rowblock_batch_us": "one RowBlockIter native block pull (us)",
+    "rowblock_batches_total": "row blocks served",
+    "rowblock_skipped_batches_total": "on_error=skip skips",
+    "device_transfer_us": "one device_put dispatch (us)",
+    "device_batches_total": "batches dispatched to the device",
+    "device_transfer_bytes_total": "host bytes handed to device_put",
+    "device_probe_attempts_total": "bench device-probe subprocess attempts",
+    "device_probe_timeouts_total": "bench device-probe attempt timeouts",
+    "device_probe_state":
+        "bench device-probe verdict (0 unknown, 1 ok, 2 unavailable, "
+        "3 cached unavailable)",
+    "tracker_num_workers": "workers the tracker expects",
+    "tracker_alive": "1 while the tracker thread is serving",
+    "tracker_finished": "1 once every worker checked out",
+    "tracker_aborted": "1 after the job was aborted",
+    "tracker_rank_phase_code":
+        "0 assigned, 1 alive, 2 dead, 3 shutdown, 4 lost",
+    "tracker_rank_heartbeat_age_seconds":
+        "seconds since the rank's last beat (-1 before the first)",
+    "tracker_rank_restarts": "recover count per rank",
+    "tracker_rank_attempts": "assignment handshakes served per rank",
+    "telemetry_events_total": "events per kind",
+    "tracker_lease_pool": "shards free for acquisition",
+    "tracker_lease_held": "shards currently leased to a rank",
+    "tracker_lease_done": "shards checked out exactly once",
+    "tracker_lease_reassigned": "leases reclaimed this epoch",
+    "tracker_lease_reassigned_total": "reclaim events across the job",
+    "lease_renew_us": "tracker-side implicit lease renewal on a ping (us)",
+    "lease_acquire_us": "worker-side acquire round trip (us)",
+    "stall_stage_occupancy":
+        "fraction of instrumented batch-path time in the stage",
+    "stall_verdict_code":
+        "-1 unknown, 0 fill, 1 parse, 2 consumer, 3 transfer bound",
+}
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition spec: backslash and
+    newline only (label-value escaping additionally covers quotes)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _render_hist_series(lines: List[str], name: str, labels: Dict[str, str],
+                        h: dict) -> None:
+    """One histogram's cumulative ``_bucket{le=}`` / ``_sum`` /
+    ``_count`` sample lines."""
+    cum = 0
+    for i, n in enumerate(h["buckets"]):
+        cum += n
+        le = "+Inf" if i == len(h["buckets"]) - 1 else str(1 << i)
+        le_label = 'le="' + le + '"'
+        lines.append(f"{name}_bucket{_fmt_labels(labels, le_label)} {cum}")
+    lines.append(f"{name}_sum{_fmt_labels(labels)} "
+                 f"{_fmt_value(h['sum'])}")
+    lines.append(f"{name}_count{_fmt_labels(labels)} "
+                 f"{_fmt_value(h['count'])}")
+
+
+def _family(fams: Dict[str, dict], name: str, kind: str) -> List[str]:
+    """The sample-line bucket for one metric family (first-seen order,
+    first-seen kind)."""
+    f = fams.get(name)
+    if f is None:
+        f = fams[name] = {"kind": kind, "lines": []}
+    return f["lines"]
+
+
+def _collect_doc(fams: Dict[str, dict], doc: dict, extra: str = "") -> None:
+    """Bucket one metric document's counters/gauges/histograms by family,
+    with an optional extra label (``rank="0"``) appended to every
+    sample."""
+    for c in doc.get("counters", ()):
+        _family(fams, c["name"], "counter").append(
+            f"{c['name']}{_fmt_labels(c['labels'], extra)} "
+            f"{_fmt_value(c['value'])}")
+    for g in doc.get("gauges", ()):
+        _family(fams, g["name"], "gauge").append(
+            f"{g['name']}{_fmt_labels(g['labels'], extra)} "
+            f"{_fmt_value(g['value'])}")
+    for h in doc.get("histograms", ()):
+        labels = dict(h["labels"])
+        if extra:
+            k, v = extra.split("=", 1)
+            labels[k] = v.strip('"')
+        _render_hist_series(_family(fams, h["name"], "histogram"),
+                            h["name"], labels, h)
+
+
+def _emit_families(fams: Dict[str, dict]) -> str:
+    """Render bucketed families as exposition text: every line of one
+    metric family contiguous (the format's grouping rule — interleaved
+    families are rejected by strict parsers), one ``# HELP`` (from the
+    :data:`METRIC_HELP` catalog, spec escaping) + ``# TYPE`` pair first."""
+    lines: List[str] = []
+    for name, f in fams.items():
+        help_text = f.get("help") or METRIC_HELP.get(name)
+        if help_text:
+            lines.append(f"# HELP {name} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {name} {f['kind']}")
+        lines += f["lines"]
+    return "\n".join(lines) + "\n"
+
+
 def prometheus_text(snap: Optional[dict] = None) -> str:
     """Render a snapshot (default: take one now) in the Prometheus text
-    exposition format (version 0.0.4): one ``# TYPE`` line per metric
-    name, label escaping, histograms as cumulative ``_bucket{le=...}``
-    series ending in ``le="+Inf"`` plus ``_sum``/``_count``."""
+    exposition format (version 0.0.4): samples grouped per metric family
+    behind one ``# HELP`` (from the :data:`METRIC_HELP` catalog) +
+    ``# TYPE`` pair, label escaping per the spec, histograms as
+    cumulative ``_bucket{le=...}`` series ending in ``le="+Inf"`` plus
+    ``_sum``/``_count``."""
     if snap is None:
         snap = snapshot()
-    lines: List[str] = []
-    typed: set = set()
-
-    def type_line(name: str, kind: str) -> None:
-        if name not in typed:
-            typed.add(name)
-            lines.append(f"# TYPE {name} {kind}")
-
-    for c in snap["counters"]:
-        type_line(c["name"], "counter")
-        lines.append(f"{c['name']}{_fmt_labels(c['labels'])} "
-                     f"{_fmt_value(c['value'])}")
-    for g in snap["gauges"]:
-        type_line(g["name"], "gauge")
-        lines.append(f"{g['name']}{_fmt_labels(g['labels'])} "
-                     f"{_fmt_value(g['value'])}")
-    for h in snap["histograms"]:
-        type_line(h["name"], "histogram")
-        cum = 0
-        for i, n in enumerate(h["buckets"]):
-            cum += n
-            le = "+Inf" if i == len(h["buckets"]) - 1 else str(1 << i)
-            le_label = 'le="' + le + '"'
-            labels = _fmt_labels(h["labels"], le_label)
-            lines.append(f"{h['name']}_bucket{labels} {cum}")
-        lines.append(f"{h['name']}_sum{_fmt_labels(h['labels'])} "
-                     f"{_fmt_value(h['sum'])}")
-        lines.append(f"{h['name']}_count{_fmt_labels(h['labels'])} "
-                     f"{_fmt_value(h['count'])}")
-    return "\n".join(lines) + "\n"
+    fams: Dict[str, dict] = {}
+    _collect_doc(fams, snap)
+    return _emit_families(fams)
 
 
 def events_jsonl(snap: Optional[dict] = None) -> str:

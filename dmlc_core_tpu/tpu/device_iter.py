@@ -1114,9 +1114,15 @@ class DeviceRowBlockIter:
             tree = jax.device_put(tree)
         if t0 is not None:
             xfer_us, batches, xfer_bytes = _get_transfer_metrics()
-            xfer_us.observe((time.perf_counter() - t0) * 1e6)
+            dur_us = (time.perf_counter() - t0) * 1e6
+            nbytes = sum(int(v.nbytes) for v in batch.tree().values())
+            xfer_us.observe(dur_us)
             batches.inc()
-            xfer_bytes.inc(sum(int(v.nbytes) for v in batch.tree().values()))
+            xfer_bytes.inc(nbytes)
+            # same measurement, second surface: the span ring
+            # (doc/observability.md "Distributed tracing")
+            telemetry.emit_span("device.put", t0 * 1e6, dur_us,
+                                bytes=nbytes)
         cls = type(batch)
         return cls(total_rows=batch.total_rows, **tree)
 

@@ -20,6 +20,7 @@ worker within DMLC_TRACKER_CLIENT_TIMEOUT seconds, not never.
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import socket
@@ -35,6 +36,7 @@ from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
                                         LEASE_ACQUIRE, LEASE_COMPLETE,
                                         LEASE_DRAINED, LEASE_EMPTY,
                                         LEASE_GRANT, LEASE_RELEASE, MAGIC,
+                                        TELEMETRY_PULL, TELEMETRY_PUSH,
                                         TrackerAbortedError, WireSocket,
                                         env_float, env_int)
 
@@ -308,11 +310,35 @@ class HeartbeatMonitor:
         self.check()
         self._send_words(LEASE_RELEASE, epoch, shard)
 
+    def _answer_telemetry_pull(self) -> None:
+        """Ship this rank's telemetry snapshot back on the channel
+        ([TELEMETRY_PUSH][len][json]; doc/observability.md "Cluster
+        aggregation"). Best effort: a broken export must cost the tracker
+        one timed-out pull, never this channel or this worker."""
+        try:
+            doc = telemetry.rank_export()
+            payload = json.dumps(doc, separators=(",", ":")).encode()
+            # lock-ok: one bounded frame write serialized against pings,
+            # worker-side lock only (the tracker serve loop never waits
+            # on it)
+            with self._send_lock:
+                self._ws.sock.sendall(
+                    struct.pack("@ii", TELEMETRY_PUSH, len(payload)) +
+                    payload)
+        except OSError:
+            raise  # channel-level failures follow the ping error path
+        except Exception:
+            pass  # a snapshot/serialization bug degrades the scrape only
+
     def _trip(self, reason: str) -> None:
         with self._lock:
             if self.aborted is None:
                 self.aborted = reason
             guarded, self._guarded = self._guarded, []
+        # flight recorder (doc/observability.md): the abort broadcast is
+        # the worker's last chance to ship a postmortem — the span ring,
+        # event ring, and metric snapshot land in $DMLC_TRACE_DUMP
+        telemetry.flight_dump(f"abort: {reason}", rank=self.rank)
         # wake a lease waiter parked on the grant queue: its next loop
         # round turns the sentinel into the structured abort via check()
         self._grants.put(LEASE_EMPTY)
@@ -368,6 +394,18 @@ class HeartbeatMonitor:
                     return
                 if val == LEASE_GRANT:
                     grant_pending = True
+                    continue
+                if val == TELEMETRY_PULL:
+                    # the tracker's scrape surface is asking for this
+                    # rank's snapshot (doc/observability.md "Cluster
+                    # aggregation"); channel errors surface like a ping's
+                    try:
+                        self._answer_telemetry_pull()
+                    except OSError:
+                        if not self._closing:
+                            self._trip(
+                                "heartbeat channel to the tracker lost")
+                        return
                     continue
                 # any other tracker->worker frame is unexpected; ignore
             except socket.timeout:

@@ -67,6 +67,8 @@ from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
                                         LEASE_COMPLETE, LEASE_DRAINED,
                                         LEASE_EMPTY, LEASE_GRANT,
                                         LEASE_RELEASE, MAGIC,
+                                        TELEMETRY_PULL, TELEMETRY_PUSH,
+                                        TELEMETRY_PUSH_MAX,
                                         TrackerAbortedError, bind_free_port,
                                         env_float, env_int, guess_host_ip,
                                         resolve_ip)
@@ -472,6 +474,15 @@ class RabitTracker:
         self._pending_ports: Set[int] = set()
         self._port_waiters: List[_Conn] = []
         self._later: List[Callable[[], None]] = []
+        # in-flight cluster-telemetry pulls (serve loop only): one entry
+        # per /metrics-or-/trace scrape awaiting TELEMETRY_PUSH replies,
+        # resolved complete, partial at the deadline, or on conn close
+        self._pulls: Dict[int, dict] = {}
+        self._pull_seq = 0
+        # how long a scrape waits for slow/legacy ranks before serving
+        # what arrived (legacy clients ignore the pull frame entirely)
+        self.scrape_timeout_ms = env_int("DMLC_TRACKER_SCRAPE_TIMEOUT_MS",
+                                         2000)
         self._stop_requested = False
         self._abort_request: Optional[TrackerAbortedError] = None
         self._finished = False
@@ -765,6 +776,10 @@ class RabitTracker:
                                        and isinstance(conn.want, int)):
                 deadline = min(deadline,
                                conn.last_activity + handshake_timeout)
+        for p in self._pulls.values():
+            # a parked scrape must be served its partial view ON the
+            # scrape deadline, not at the next 30 s tick
+            deadline = min(deadline, p["deadline"])
         return max(0.0, deadline - now)
 
     def _run_later(self) -> None:
@@ -788,6 +803,11 @@ class RabitTracker:
                      and now - c.last_activity > handshake_timeout]:
             self._drop(conn, f"handshake timed out after "
                              f"{handshake_timeout:.0f}s")
+        for seq in [s for s, p in self._pulls.items()
+                    if now > p["deadline"]]:
+            # scrape deadline: serve the ranks that replied (a legacy
+            # client never answers the pull frame at all)
+            self._resolve_pull(seq)
         if self._leases is not None:
             # TTL backstop (runs even with liveness disarmed): a holder
             # that stopped renewing — silent channel — forfeits its shards
@@ -868,6 +888,11 @@ class RabitTracker:
         self._emit("lost", rank=rank, reclaimed=len(reclaimed))
         for epoch, shard in reclaimed:
             self._emit("lease-reclaim", rank=rank, epoch=epoch, shard=shard)
+        # flight recorder (doc/observability.md): the write-off ships its
+        # own postmortem — the event ring's lease-grant/lease-reclaim
+        # records name the shards the dead rank held
+        telemetry.flight_dump(f"rank-lost: rank {rank} written off, "
+                              f"{len(reclaimed)} lease(s) reclaimed")
 
     def _check_finished(self) -> None:
         """Elastic finish rule (serve loop only): the job completes once
@@ -907,6 +932,9 @@ class RabitTracker:
         down, and surface the structured error through join()."""
         logger.error("aborting job: %s", err)
         self._emit("abort", reason=err.reason, dead_ranks=err.dead_ranks)
+        # flight recorder: the abort path is exactly when the postmortem
+        # matters; dumped AFTER the abort event so the ring carries it
+        telemetry.flight_dump(f"tracker-abort: {err.reason}")
         with self._lock:
             if self._event_log is not None:
                 # fsync through to disk NOW: the abort path is exactly when
@@ -1076,6 +1104,11 @@ class RabitTracker:
             self._pending.remove(conn)
         if conn in self._port_waiters:
             self._port_waiters.remove(conn)
+        for seq in [s for s, p in self._pulls.items()
+                    if p["conn"] is conn]:
+            # the scrape died while parked: late pushes must not resume a
+            # closed coroutine
+            del self._pulls[seq]
         if conn.rank is not None and conn.kind == "proto":
             # a decision parked on this rank's port must not wait forever
             self._pending_ports.discard(conn.rank)
@@ -1324,6 +1357,32 @@ class RabitTracker:
                 if revived:
                     self._emit("revived", rank=rank)
                 continue
+            if val == TELEMETRY_PUSH:
+                # a rank answering a scrape-time pull with its telemetry
+                # document (doc/observability.md "Cluster aggregation");
+                # the push is a liveness proof like any other frame
+                n = yield from _r_int()
+                if n < 0 or n > TELEMETRY_PUSH_MAX:
+                    raise _Reject(
+                        f"invalid telemetry push length {n} from rank "
+                        f"{rank}")
+                data = yield n
+                revived = self._beat(st, rank)
+                try:
+                    doc = json.loads(data.decode())
+                except (ValueError, UnicodeDecodeError):
+                    doc = None  # a torn export degrades this rank's slice
+                if not isinstance(doc, dict):
+                    # valid-JSON-but-not-an-object must degrade the same
+                    # way: the renderers assume a dict, and an exception
+                    # out of a resumed scrape coroutine would kill the
+                    # serve loop — one bad frame must never cost the job
+                    doc = None
+                if doc is not None:
+                    self._telemetry_reply(rank, doc)
+                if revived:
+                    self._emit("revived", rank=rank)
+                continue
             if val == HEARTBEAT_BYE:
                 # graceful channel close (normal shutdown path): disarm
                 # liveness for this rank — a BYE is teardown, never a
@@ -1439,13 +1498,60 @@ class RabitTracker:
             self._later.append(self._resume_port_waiters)
             return
 
+    # -- cluster telemetry pulls (doc/observability.md) ----------------------
+    def _start_telemetry_pull(self, conn: _Conn) -> Optional[int]:
+        """Ask every live heartbeat channel for its rank's telemetry
+        document and register `conn` (a parked http scrape) as the
+        waiter. Returns the pull id, or None when no channel is live (the
+        caller renders the tracker-only view immediately)."""
+        chans: Dict[int, _Conn] = {}
+        for c in list(self._conns):
+            if c.kind == "heartbeat" and not c.closed \
+                    and c.rank is not None:
+                chans[c.rank] = c  # recover races: the latest channel wins
+        if not chans:
+            return None
+        for c in chans.values():
+            self._send_bytes(c, struct.pack("@i", TELEMETRY_PULL))
+        self._pull_seq += 1
+        self._pulls[self._pull_seq] = {
+            "conn": conn, "want": set(chans), "got": {},
+            "deadline": time.monotonic() + self.scrape_timeout_ms / 1000.0,
+        }
+        return self._pull_seq
+
+    def _telemetry_reply(self, rank: int, doc: dict) -> None:
+        """Route one rank's TELEMETRY_PUSH document to every pull waiting
+        on it; a pull whose last rank replied resolves immediately."""
+        for seq in [s for s, p in self._pulls.items() if rank in p["want"]]:
+            p = self._pulls[seq]
+            p["got"][rank] = doc
+            p["want"].discard(rank)
+            if not p["want"]:
+                self._resolve_pull(seq)
+
+    def _resolve_pull(self, seq: int) -> None:
+        """Resume the parked scrape with whatever arrived (all ranks, or
+        a partial set at the deadline — legacy clients never answer)."""
+        p = self._pulls.pop(seq, None)
+        if p is None or p["conn"].closed:
+            return
+        conn, got = p["conn"], p["got"]
+        self._later.append(
+            lambda: None if conn.closed else self._advance(conn, got))
+
     def _http_get(self, conn: _Conn, head: bytes):
         """Read-only HTTP scrape served from the rendezvous port (content-
-        sniffed ``GET``): ``/metrics`` renders the merged telemetry
-        snapshot in Prometheus text exposition, ``/state`` the thread-safe
-        state() JSON. Runs as a normal connection coroutine — byte-at-a-
-        time header reads through the selectors loop, response buffered
-        through outbuf, socket closed once it drains (drain_close)."""
+        sniffed ``GET``): ``/metrics`` renders the JOB-WIDE telemetry view
+        (tracker's own snapshot + per-rank series labeled ``rank=`` +
+        ``job:`` sums, pulled from every live heartbeat channel at scrape
+        time), ``/trace`` the merged Chrome-trace timeline with one lane
+        per rank, ``/healthz`` a cheap liveness probe, ``/state`` the
+        thread-safe state() JSON. Runs as a normal connection coroutine —
+        byte-at-a-time header reads through the selectors loop, the
+        telemetry pull parks at ``_WAIT`` until the ranks reply (or the
+        scrape deadline serves a partial set), response buffered through
+        outbuf, socket closed once it drains (drain_close)."""
         conn.kind = "http"
         req = bytearray(head)
         while b"\r\n\r\n" not in req:
@@ -1455,17 +1561,44 @@ class RabitTracker:
         line = bytes(req).split(b"\r\n", 1)[0].decode("latin-1", "replace")
         parts = line.split()
         path = (parts[1] if len(parts) >= 2 else "/").split("?", 1)[0]
-        if path == "/metrics":
-            # never triggers a native build: telemetry.snapshot merges the
-            # native registry only when its library is already loaded
-            body = telemetry.prometheus_text().encode()
-            status, ctype = "200 OK", \
-                "text/plain; version=0.0.4; charset=utf-8"
+        if path in ("/metrics", "/trace"):
+            # the job-wide view: pull every live rank's document over the
+            # heartbeat channels, park until they land (or the deadline
+            # degrades to the ranks that replied). Never triggers a
+            # native build: telemetry.snapshot merges the native registry
+            # only when its library is already loaded.
+            replies: Dict[int, dict] = {}
+            if self._start_telemetry_pull(conn) is not None:
+                replies = yield _WAIT
+            if path == "/metrics":
+                body = telemetry.cluster_prometheus_text(replies).encode()
+                status, ctype = "200 OK", \
+                    "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = (telemetry.cluster_trace_json(replies) +
+                        "\n").encode()
+                status, ctype = "200 OK", "application/json"
+        elif path == "/healthz":
+            st = self.state()
+            alive_ranks = sum(1 for r in st["ranks"].values()
+                              if r["phase"] == "alive")
+            healthy = st["alive"] and not st["aborted"]
+            body = (json.dumps({
+                "status": "ok" if healthy else
+                ("aborted" if st["aborted"] else "stopped"),
+                "finished": st["finished"],
+                "num_workers": st["num_workers"],
+                "alive_ranks": alive_ranks,
+                "lost_ranks": st["lost_ranks"],
+            }) + "\n").encode()
+            status = "200 OK" if healthy else "503 Service Unavailable"
+            ctype = "application/json"
         elif path == "/state":
             body = (json.dumps(self.state()) + "\n").encode()
             status, ctype = "200 OK", "application/json"
         else:
-            body = b"not found; scrape /metrics or /state\n"
+            body = b"not found; scrape /metrics, /trace, /state, " \
+                   b"or /healthz\n"
             status, ctype = "404 Not Found", "text/plain"
         resp = (f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n"

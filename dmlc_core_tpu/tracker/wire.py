@@ -49,6 +49,21 @@ LEASE_GRANT = -93
 LEASE_EMPTY = -1
 LEASE_DRAINED = -2
 
+# -- cluster telemetry frames (doc/observability.md "Cluster aggregation") --
+# Piggybacked on the SAME heartbeat channel, same negative-word rule.
+# Tracker -> worker: [TELEMETRY_PULL] (no payload) asks the rank for its
+# telemetry snapshot; sent to every live channel when the tracker's HTTP
+# scrape surface serves /metrics or /trace. Worker -> tracker:
+# [TELEMETRY_PUSH][len][<len> bytes of JSON] — the rank_export() document
+# (metrics + wall-clock spans + the process clock anchor). A push doubles
+# as a liveness proof; a worker that never answers (legacy client) simply
+# times the pull out — the scrape degrades to the ranks that replied.
+TELEMETRY_PULL = -95
+TELEMETRY_PUSH = -96
+# a push beyond this is a corrupt frame, not telemetry (the rank_export
+# span cap keeps real documents far below it)
+TELEMETRY_PUSH_MAX = 8 << 20
+
 
 def env_float(name: str, default: float, env=None) -> float:
     """Checked float env parse (the env_int rule for float-valued knobs

@@ -1,0 +1,47 @@
+"""Worker driven by tests/test_tracing.py.
+
+A real OS process that joins the rendezvous with heartbeats, parses a
+small libsvm dataset through RowBlockIter — filling its OWN process span
+ring with native ``parse.*`` spans and the Python ``rowblock.next`` span —
+writes a ``parsed_<task>`` marker, then parks LIVE (heartbeating and
+answering TELEMETRY_PULL frames) until ``<scratch>/release`` appears, so
+the parent can scrape the tracker's ``/trace`` and ``/metrics`` while both
+ranks hold real telemetry.
+
+Usage: python telemetry_worker.py <repo_root> <scratch_dir> <data_uri>
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    repo, scratch, uri = sys.argv[1], sys.argv[2], sys.argv[3]
+    sys.path.insert(0, repo)
+    from dmlc_core_tpu.data import RowBlockIter
+    from dmlc_core_tpu.tracker.client import RendezvousClient
+
+    task = int(os.environ["DMLC_TASK_ID"])
+    client = RendezvousClient(os.environ["DMLC_TRACKER_URI"],
+                              int(os.environ["DMLC_TRACKER_PORT"]))
+    assign = client.start(heartbeat=True)
+
+    it = RowBlockIter.create(uri, nthread=2)
+    total = sum(b.size for b in it)
+    it.close()
+    with open(os.path.join(scratch, f"parsed_{task}"), "w") as f:
+        f.write(f"{assign.rank} {total}")
+
+    release = os.path.join(scratch, "release")
+    deadline = time.monotonic() + 120
+    while not os.path.exists(release):
+        if time.monotonic() > deadline:
+            sys.exit(5)
+        client.heartbeat.check()  # an abort must not leave a zombie
+        time.sleep(0.05)
+    client.shutdown(assign.rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,13 +94,21 @@ _SAMPLE_RE = re.compile(
     r"(?:\{(?P<labels>.*)\})? (?P<value>\S+)$")
 
 
-def _parse_exposition(text):
+def _parse_exposition(text, helps_out=None):
     """Parse the exposition format back into {(name, labels): value} plus
-    {name: type}. Raises on malformed lines — the property check's teeth."""
+    {name: type} (and, via `helps_out`, {name: help text}). Raises on
+    malformed lines — the property check's teeth."""
     types = {}
     samples = {}
+    helps = {} if helps_out is None else helps_out
     for line in text.splitlines():
         if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, help_text = line.split(" ", 3)
+            assert name not in helps, f"duplicate HELP for {name}"
+            assert name not in types, f"HELP for {name} after its TYPE"
+            helps[name] = help_text
             continue
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split(" ")
@@ -113,6 +121,40 @@ def _parse_exposition(text):
         samples[(m.group("name"), m.group("labels") or "")] = \
             float(m.group("value"))
     return types, samples
+
+
+def test_help_lines_from_catalog_round_trip():
+    """Cataloged metrics carry `# HELP` lines (before their TYPE, once
+    per name, escaped per the exposition spec); uncataloged names carry
+    none — pinned by a round-trip parse of the rendered text."""
+    # Python-side cataloged names only: registering a NATIVE metric's
+    # name in the Python registry would shadow the native value in every
+    # later merged snapshot (entries persist across tests)
+    telemetry.counter("rowblock_batches_total").inc(2)  # cataloged
+    telemetry.counter("not_in_catalog_total").inc(1)
+    telemetry.histogram("lease_acquire_us").observe(4)  # cataloged hist
+    # an entry with the characters the spec escapes (backslash, newline)
+    weird = r"line one" + "\n" + r"with \backslash"
+    telemetry.METRIC_HELP["helpescape_total"] = weird
+    try:
+        telemetry.counter("helpescape_total").inc(1)
+        text = telemetry.prometheus_text(telemetry.snapshot(native=False))
+    finally:
+        del telemetry.METRIC_HELP["helpescape_total"]
+    helps = {}
+    types, samples = _parse_exposition(text, helps_out=helps)
+    assert helps["rowblock_batches_total"] == \
+        telemetry.METRIC_HELP["rowblock_batches_total"]
+    assert helps["lease_acquire_us"] == \
+        telemetry.METRIC_HELP["lease_acquire_us"]
+    assert "not_in_catalog_total" not in helps
+    # escaping round-trips: the rendered help is one line, decodable back
+    assert helps["helpescape_total"] == "line one\\nwith \\\\backslash"
+    assert helps["helpescape_total"].replace("\\\\", "\x00") \
+        .replace("\\n", "\n").replace("\x00", "\\") == weird
+    # HELP never broke sample parsing
+    assert samples[("rowblock_batches_total", "")] == 2
+    assert types["rowblock_batches_total"] == "counter"
 
 
 def test_exposition_property_over_randomized_registries():
