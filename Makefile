@@ -3,11 +3,12 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
-        parse-lanes telemetry trace cache range fsfault pytest liveness \
-        elastic bench-smoke dryrun doc clean
+        parse-lanes telemetry trace cache range fsfault rig pytest \
+        liveness elastic bench-smoke dryrun doc clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
-    telemetry trace cache range fsfault pytest liveness elastic dryrun doc
+    telemetry trace cache range fsfault rig pytest liveness elastic \
+    dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -66,6 +67,17 @@ range:
 fsfault:
 	$(MAKE) -C cpp asan-fsfault
 	timeout -k 10 300 python3 -m pytest tests/test_fs_fault.py -q
+
+# Measurement-rig lane (doc/benchmarking.md): out-of-process origin
+# byte-identity against the in-process mocks for all four backends, a
+# 5 s open-loop smoke at fixed QPS, the coordinated-omission pin
+# (injected origin stall visible in intended-time p99, invisible in the
+# naive service-time capture), and benchdiff against the seeded
+# regression fixture (must exit nonzero) + a self-compare (must exit
+# zero). Hard timeout: a wedged origin or generator is exactly the
+# regression this lane exists to catch.
+rig:
+	timeout -k 10 300 python3 -m pytest tests/test_loadrig.py -q
 
 lint:
 	python3 scripts/lint.py

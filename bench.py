@@ -190,6 +190,81 @@ def _load_baseline():
         return json.load(f)
 
 
+def git_provenance() -> dict:
+    """{"git_sha", "git_dirty"} of the tree this run measures (None/None
+    outside a git checkout — provenance is evidence, never a blocker)."""
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo,
+                             capture_output=True, text=True,
+                             timeout=30).stdout.strip() or None
+        st = subprocess.run(["git", "status", "--porcelain"], cwd=repo,
+                            capture_output=True, text=True, timeout=30)
+        dirty = bool(st.stdout.strip()) if st.returncode == 0 else None
+        return {"git_sha": sha, "git_dirty": dirty}
+    except (OSError, subprocess.TimeoutExpired):
+        return {"git_sha": None, "git_dirty": None}
+
+
+def host_fingerprint() -> dict:
+    """The stable facts a ledger reader needs to know whether two runs
+    are comparable at all: host name, core count, schedulable affinity,
+    memory, platform, python."""
+    import platform
+    import socket
+    try:
+        affinity = len(os.sched_getaffinity(0))
+    except AttributeError:
+        affinity = os.cpu_count()
+    mem_gb = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    mem_gb = round(int(line.split()[1]) / 1e6, 1)
+                    break
+    except OSError:
+        pass
+    return {"host": socket.gethostname(), "cpus": os.cpu_count(),
+            "affinity": affinity, "mem_gb": mem_gb,
+            "platform": platform.platform(),
+            "python": platform.python_version()}
+
+
+def dmlc_env_overrides() -> dict:
+    """Every DMLC_*/DCT_* env var active for this run — the knobs that
+    change what the numbers mean (doc/benchmarking.md)."""
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(("DMLC_", "DCT_"))}
+
+
+def append_ledger(result: dict, provenance: dict, host: dict,
+                  env_overrides: dict, host_resources, smoke: bool,
+                  history_path: str) -> "str | None":
+    """Append this run's normalized record to the bench regression
+    ledger (scripts/benchdiff.py reads it); returns the path written or
+    None. Best-effort by design: a full disk must not sink the already-
+    printed result."""
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        scripts = os.path.join(repo, "scripts")
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        import benchdiff
+        record = benchdiff.make_record(
+            result, git_sha=provenance.get("git_sha"),
+            git_dirty=provenance.get("git_dirty"), host=host,
+            env_overrides=env_overrides, host_resources=host_resources,
+            smoke=smoke, argv=sys.argv[1:])
+        benchdiff.append_record(record, history_path)
+        return history_path
+    except Exception as e:  # noqa: BLE001 - the ledger is evidence,
+        # never the reason a measured run dies
+        print(f"# ledger append failed: {e}", file=sys.stderr)
+        return None
+
+
 def cache_lane_probe(path: str, rows: int, nthread: int) -> dict:
     """Parse-once-serve-many lane (cpp/src/shard_cache.h, doc/caching.md):
     epoch 1 parses text while teeing binary shards into a fresh cache dir,
@@ -226,45 +301,44 @@ def cache_lane_probe(path: str, rows: int, nthread: int) -> dict:
 
 def remote_lane_probe(path: str, nthread: int, latency_ms: int = 20,
                       cap_bytes: int = 8 << 20,
-                      concurrency: int = 12) -> dict:
+                      concurrency: int = 12, sampler=None) -> dict:
     """Parallel ranged remote reads lane (cpp/src/range_reader.h,
-    doc/io-ranged.md): serve the libsvm dataset from the in-process mock
-    S3 server with ``latency_ms`` injected per request AND per 256 KiB
-    body block (a latency-bandwidth-capped origin: one connection tops
-    out at ~256KiB/latency), then parse it sequentially (DMLC_IO_RANGE=0)
-    vs ranged. Reports both rates, the local-file rate for the same
-    bytes, the ratios, and the scheduler's own telemetry (ranges
-    issued/retried, adapted range size/concurrency) — the ROADMAP success
-    metric (remote within ~1.5x of local, ranged >= 2x sequential) as
-    numbers, not prose."""
+    doc/io-ranged.md) against the OUT-OF-PROCESS origin rig
+    (scripts/loadrig.py, doc/benchmarking.md): the libsvm dataset is
+    served by pre-forked mock-S3 worker processes with ``latency_ms``
+    injected per request AND per body block server-side (a
+    latency-bandwidth-capped origin), and every remote pass runs in its
+    own parse-client subprocess — fresh native singleton per endpoint,
+    no GIL shared between the origin and the fetch+parse threads it
+    measures.  Reports sequential vs ranged vs local rates, the
+    zero-latency origin ceiling, the range scheduler's telemetry, and a
+    CPU attribution row (client vs origin seconds, from /proc) so a
+    vs_local gap names its binding side instead of the retired
+    ``mock_ceiling`` guess."""
+    import subprocess
     import tempfile
     repo = os.path.dirname(os.path.abspath(__file__))
-    if repo not in sys.path:
-        sys.path.insert(0, repo)
-    import tests.mock_s3 as mock_s3
-    from dmlc_core_tpu import telemetry
+    for p in (repo, os.path.join(repo, "scripts")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import loadrig
+    from tests.mock_origin import OriginConfig
     from dmlc_core_tpu.io.native import NativeParser
 
     with open(path, "rb") as f:
         blob = f.read(cap_bytes)
     blob = blob[: blob.rfind(b"\n") + 1]  # whole lines only
     lane_rows = blob.count(b"\n")
-
-    state, port, shutdown = mock_s3.serve()
-    # env must be set before the native S3 singleton first initializes;
-    # the bench process touches s3:// only here
-    os.environ["S3_ENDPOINT"] = f"http://127.0.0.1:{port}"
-    os.environ["S3_ACCESS_KEY_ID"] = mock_s3.ACCESS_KEY
-    os.environ["S3_SECRET_ACCESS_KEY"] = mock_s3.SECRET_KEY
-    os.environ["S3_REGION"] = mock_s3.REGION
-    state.objects[("bench", "remote/data.libsvm")] = blob
+    key = "bench/remote/data.libsvm"
+    # at least 2 origin workers so the serving side is never one
+    # process; more when the host has the cores to back them
+    workers = max(2, os.cpu_count() or 2)
     # one connection caps at latency_block/latency_ms — the long-haul-link
     # shape where parallel ranges win; scaled to the payload so a
     # sequential pass always pays ~8 serialized bursts regardless of size
-    state.latency_block = max(len(blob) // 8, 64 << 10)
-    uri = "s3://bench/remote/data.libsvm"
+    latency_block = max(len(blob) // 8, 64 << 10)
 
-    def parse_pass(u):
+    def local_pass(u):
         t0 = time.time()
         got = 0
         with NativeParser(u, nthread=nthread, fmt="libsvm") as p:
@@ -274,71 +348,91 @@ def remote_lane_probe(path: str, nthread: int, latency_ms: int = 20,
         assert got == lane_rows, f"row count mismatch: {got} != {lane_rows}"
         return lane_rows / dt
 
-    def under_env(overrides, fn):
-        old = {k: os.environ.get(k) for k in overrides}
-        os.environ.update({k: str(v) for k, v in overrides.items()})
-        try:
-            return fn()
-        finally:
-            for k, v in old.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
+    def client_pass(origin, env_extra, reps):
+        env = dict(os.environ, **origin.env())
+        env.update({k: str(v) for k, v in env_extra.items()})
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "loadrig.py"), "parse-client",
+             "--uri", origin.uri(key), "--fmt", "libsvm",
+             "--nthread", str(nthread), "--reps", str(reps)],
+            capture_output=True, text=True, timeout=600, env=env)
+        if out.returncode != 0:
+            raise RuntimeError("parse-client failed: "
+                               + (out.stderr or "")[-300:])
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        assert res["rows"] == lane_rows, \
+            f"row count mismatch: {res['rows']} != {lane_rows}"
+        return res
 
-    def snap_counters():
-        out = {}
-        for c in telemetry.snapshot()["counters"]:
-            out[c["name"]] = out.get(c["name"], 0) + c["value"]
-        return out
-
+    ranged_env = {"DMLC_IO_RANGE": "1",
+                  "DMLC_IO_RANGE_CONCURRENCY": str(concurrency)}
     tmp = tempfile.NamedTemporaryFile(suffix=".libsvm", delete=False)
     try:
         tmp.write(blob)
         tmp.close()
+        spec = [f"{key}=@{tmp.name}"]
         # local parse of the SAME bytes: the vs_local denominator
-        local_rps = max(parse_pass(tmp.name) for _ in range(2))
-        # high concurrency: the per-connection cap is the point of this
-        # lane, and real object stores serve far more than 4 streams
-        ranged_env = {"DMLC_IO_RANGE": "1",
-                      "DMLC_IO_RANGE_CONCURRENCY": str(concurrency)}
-        # the mock's own ceiling: ranged ingest with NO injected latency.
-        # The serving side is a Python (GIL-bound) HTTP server sharing this
-        # host's cores with the fetchers AND the parser, so vs_local is
-        # bounded by the harness, not the engine — this row attributes that.
-        state.latency_ms = 0
-        ceiling_rps = max(
-            under_env(ranged_env, lambda: parse_pass(uri))
-            for _ in range(2))
-        state.latency_ms = latency_ms
-        seq_rps = max(
-            under_env({"DMLC_IO_RANGE": "0"}, lambda: parse_pass(uri))
-            for _ in range(2))
-        before = snap_counters()
-        ranged_rps = max(
-            under_env(ranged_env, lambda: parse_pass(uri))
-            for _ in range(3))
-        after = snap_counters()
-        snap = telemetry.snapshot()
-        gauges = {g["name"]: g["value"] for g in snap["gauges"]}
-        hists = {(h["name"], h["labels"].get("backend")): h
-                 for h in snap["histograms"]}
-        hb = hists.get(("io_range_bytes", "s3"), {})
+        local_rps = max(local_pass(tmp.name) for _ in range(2))
+        # the origin's own ceiling: ranged ingest with NO injected
+        # latency against the same worker fleet — how fast this origin
+        # can serve at all, measured instead of guessed
+        with loadrig.spawn_origin(
+                "s3", spec, OriginConfig(workers=workers)) as org:
+            ceiling_rps = client_pass(org, ranged_env, 2)["rows_per_sec"]
+        cfg = OriginConfig(workers=workers, latency_ms=latency_ms,
+                           latency_block=latency_block)
+        with loadrig.spawn_origin("s3", spec, cfg) as org:
+            if sampler is not None:
+                sampler.watch("remote_origin", org.proc.pid, *org.pids)
+            seq_rps = client_pass(
+                org, {"DMLC_IO_RANGE": "0"}, 2)["rows_per_sec"]
+            origin_cpu0 = org.cpu_seconds()
+            if sampler is not None:
+                section = sampler.section("remote_lane_ranged")
+            else:
+                import contextlib
+                section = contextlib.nullcontext()
+            with section:
+                ranged = client_pass(org, ranged_env, 3)
+            origin_cpu = round(org.cpu_seconds() - origin_cpu0, 3)
+        ranged_rps = ranged["rows_per_sec"]
+        counters = ranged.get("counters", {})
+        gauges = ranged.get("gauges", {})
+        hb = ranged.get("range_hists", {}).get("io_range_bytes", {})
         sched = {
-            "ranges_issued": int(after.get("io_range_issued_total", 0)
-                                 - before.get("io_range_issued_total", 0)),
-            "range_retries": int(after.get("io_range_retried_total", 0)
-                                 - before.get("io_range_retried_total", 0)),
+            "ranges_issued": int(counters.get("io_range_issued_total", 0)),
+            "range_retries": int(counters.get("io_range_retried_total",
+                                              0)),
             "degraded_200": int(
-                after.get("io_range_degraded_200_total", 0)
-                - before.get("io_range_degraded_200_total", 0)),
+                counters.get("io_range_degraded_200_total", 0)),
             "sched_range_kb": round(
                 gauges.get("io_range_sched_bytes", 0) / 1024, 1),
             "sched_concurrency": int(
                 gauges.get("io_range_sched_concurrency", 0)),
         }
         if hb.get("count"):
-            sched["mean_range_kb"] = round(hb["sum"] / hb["count"] / 1024, 1)
+            sched["mean_range_kb"] = round(hb["sum"] / hb["count"] / 1024,
+                                           1)
+        # the ranged client's own transport-retry noise (io_* counters
+        # live in ITS process now, not the bench's — extras.io_retry
+        # below only sees in-process traffic)
+        client_io = {k: int(counters.get(f"io_{k}_total", 0))
+                     for k in ("requests", "retries", "timeouts",
+                               "giveups")}
+        # CPU attribution (the evidence the mock_ceiling caveat lacked):
+        # client parse+fetch seconds vs origin serve seconds over the
+        # ranged wall time, against the cores this host has
+        ncores = os.cpu_count() or 1
+        wall = ranged.get("total_dt") or ranged["best_dt"]
+        client_busy = ranged["cpu_s"] / wall if wall else 0.0
+        origin_busy = origin_cpu / wall if wall else 0.0
+        if client_busy + origin_busy >= 0.85 * ncores:
+            verdict = ("client_core_saturated"
+                       if client_busy >= origin_busy
+                       else "origin_core_saturated")
+        else:
+            verdict = "latency_bound"
         return {
             "bytes": len(blob),
             "rows": lane_rows,
@@ -346,20 +440,28 @@ def remote_lane_probe(path: str, nthread: int, latency_ms: int = 20,
             "local_rows_per_sec": round(local_rps, 1),
             "sequential_rows_per_sec": round(seq_rps, 1),
             "ranged_rows_per_sec": round(ranged_rps, 1),
-            "mock_ceiling_rows_per_sec": round(ceiling_rps, 1),
+            "origin_ceiling_rows_per_sec": round(ceiling_rps, 1),
             "ranged_vs_sequential": round(ranged_rps / seq_rps, 2),
             "ranged_vs_local": round(ranged_rps / local_rps, 3),
-            # the GIL mock's best case vs local: how much of the vs_local
-            # gap is harness, not engine (with ZERO latency the remote
-            # path still tops out here)
+            # the out-of-process origin's best case vs local: how much
+            # of any remaining vs_local gap is origin capacity
             "ceiling_vs_local": round(ceiling_rps / local_rps, 3),
             # how much of the injected latency the scheduler hid: ranged
-            # WITH latency vs the same path with NONE (the harness ceiling)
+            # WITH latency vs the same path with NONE (the origin ceiling)
             "latency_hidden": round(ranged_rps / ceiling_rps, 3),
             "range_scheduler": sched,
+            "client_io_retry": client_io,
+            "origin": {
+                "out_of_process": True,
+                "workers": workers,
+                "client_cpu_s": ranged["cpu_s"],
+                "origin_cpu_s": origin_cpu,
+                "ranged_wall_s": round(wall, 3),
+                "ncores": ncores,
+                "cpu_attribution": verdict,
+            },
         }
     finally:
-        shutdown()
         os.unlink(tmp.name)
 
 
@@ -702,6 +804,10 @@ def main() -> None:
                     help="skip the device probe entirely (host-only "
                          "metrics; the fast path on hosts known to have "
                          "no device — no probe subprocess, no backoff)")
+    ap.add_argument("--no-ledger", action="store_true",
+                    help="skip appending this run to bench_history.jsonl"
+                         " (doc/benchmarking.md; DMLC_BENCH_HISTORY "
+                         "overrides the path, =0 disables)")
     ap.add_argument("--pallas-probe", action="store_true",
                     help=argparse.SUPPRESS)  # subprocess child mode
     args = ap.parse_args()
@@ -712,6 +818,28 @@ def main() -> None:
         print(json.dumps(pallas_format_probe()))
         return
     args.dense_dtype = "bfloat16" if args.dense_dtype == "bf16" else "float32"
+
+    # provenance header (doc/benchmarking.md): every run names the tree,
+    # host, and env knobs it measured, first thing — a number without
+    # them is not reproducible
+    provenance = git_provenance()
+    host = host_fingerprint()
+    env_over = dmlc_env_overrides()
+    sha12 = (provenance["git_sha"] or "unknown")[:12]
+    print(f"# provenance: sha={sha12}"
+          f"{'+dirty' if provenance['git_dirty'] else ''} "
+          f"host={host['host']} cpus={host['cpus']} "
+          f"(affinity {host['affinity']}) mem={host['mem_gb']}G "
+          f"python={host['python']}", file=sys.stderr)
+    if env_over:
+        print("# env overrides: "
+              + " ".join(f"{k}={v}" for k, v in env_over.items()),
+              file=sys.stderr)
+    # host resource sampler: every lane's CPU/RSS/page-cache/net
+    # envelope rides extras.host_resources — the evidence side of any
+    # "the host was the bottleneck" verdict
+    from dmlc_core_tpu.telemetry import HostResourceSampler
+    sampler = HostResourceSampler().start()
 
     rows = args.rows or (20000 if args.smoke else 200000)
     path = ensure_dataset(rows)
@@ -741,9 +869,10 @@ def main() -> None:
         occupancy = {}
         for t in (1, 2, 4, 8):
             stats = {}
-            scaling[str(t)] = round(
-                parse_rows_per_sec(lane_path, rows, t, fmt=lane_fmt,
-                                   stats_out=stats)[0], 1)
+            with sampler.section(f"thread_scaling_{t}"):
+                scaling[str(t)] = round(
+                    parse_rows_per_sec(lane_path, rows, t, fmt=lane_fmt,
+                                       stats_out=stats)[0], 1)
             if stats:
                 occupancy[str(t)] = {
                     k: stats[k] for k in
@@ -909,10 +1038,11 @@ def main() -> None:
 
     if args.parse_only:
         headline_stats = {}
-        rps, dt = parse_rows_per_sec(lane_path, rows, args.threads,
-                                     fmt=lane_fmt,
-                                     dense_dtype=args.dense_dtype,
-                                     stats_out=headline_stats)
+        with sampler.section("headline"):
+            rps, dt = parse_rows_per_sec(lane_path, rows, args.threads,
+                                         fmt=lane_fmt,
+                                         dense_dtype=args.dense_dtype,
+                                         stats_out=headline_stats)
         # the host lane must carry the same attribution extras the device
         # lane does (the r05 round lost bottleneck/occupancy on a tunnel
         # outage and blinded two rounds of analysis): name the binding
@@ -958,7 +1088,9 @@ def main() -> None:
             # touch every array so the batch is fully materialized in HBM
             return sum(jnp.sum(v.astype(jnp.float32)) for v in tree.values())
 
-        lane = run_lane(lane_path, rows, lane_fmt, args, mesh, consume)
+        with sampler.section("headline"):
+            lane = run_lane(lane_path, rows, lane_fmt, args, mesh,
+                            consume)
         dt = lane["dt"]
         rps = lane["rows_per_sec"]
         extras.update({
@@ -1013,7 +1145,8 @@ def main() -> None:
                     out = subprocess.run(
                         [sys.executable, os.path.abspath(__file__),
                          f"--format={fmt2}", "--no-scaling-table",
-                         "--no-rec-lane", f"--rows={rows}",
+                         "--no-rec-lane", "--no-ledger",
+                         f"--rows={rows}",
                          f"--batch-rows={args.batch_rows}",
                          f"--threads={args.threads}", f"--reps={args.reps}",
                          "--dense-dtype",
@@ -1115,8 +1248,9 @@ def main() -> None:
         # ROADMAP ratio against the recd binary host lane. Host-only, so
         # it reports even on a degraded (device-less) round.
         try:
-            extras["cache_lane"] = cache_lane_probe(path, rows,
-                                                    args.threads)
+            with sampler.section("cache_lane"):
+                extras["cache_lane"] = cache_lane_probe(path, rows,
+                                                        args.threads)
             recd = (extras.get("host_lane_rates") or {}).get("recd")
             if isinstance(recd, (int, float)) and recd:
                 extras["cache_lane"]["vs_recd_host"] = round(
@@ -1137,29 +1271,38 @@ def main() -> None:
         # vs ranged vs local as ratios, plus what the readahead scheduler
         # chose. Host-only, so it reports even on a degraded round.
         try:
-            extras["remote_lane"] = remote_lane_probe(
-                path, args.threads, latency_ms=20,
-                cap_bytes=(2 << 20) if args.smoke else (8 << 20),
-                concurrency=8 if args.smoke else 12)
+            with sampler.section("remote_lane"):
+                extras["remote_lane"] = remote_lane_probe(
+                    path, args.threads, latency_ms=20,
+                    cap_bytes=(2 << 20) if args.smoke else (8 << 20),
+                    concurrency=8 if args.smoke else 12,
+                    sampler=sampler)
             rl = extras["remote_lane"]
             print(f"# remote lane: local {rl['local_rows_per_sec']:.0f} "
                   f"rows/s, sequential {rl['sequential_rows_per_sec']:.0f}"
                   f", ranged {rl['ranged_rows_per_sec']:.0f} "
                   f"({rl['ranged_vs_sequential']}x seq, "
                   f"{rl['ranged_vs_local']}x local, latency hidden "
-                  f"{rl['latency_hidden']:.0%} of the mock ceiling "
-                  f"{rl['mock_ceiling_rows_per_sec']:.0f}; "
+                  f"{rl['latency_hidden']:.0%} of the origin ceiling "
+                  f"{rl['origin_ceiling_rows_per_sec']:.0f}; "
+                  f"{rl['origin']['workers']}-worker origin "
+                  f"{rl['origin']['origin_cpu_s']}s CPU vs client "
+                  f"{rl['origin']['client_cpu_s']}s -> "
+                  f"{rl['origin']['cpu_attribution']}; "
                   f"scheduler {rl['range_scheduler']})", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 - report, don't die
             extras["remote_lane"] = {"error": str(e)[-300:]}
-        extras["csv_lane"] = text_lane_probe(
-            ensure_csv_dataset(rows), rows, args.threads, "csv",
-            "?format=csv&label_column=0")
-        extras["libfm_lane"] = text_lane_probe(
-            ensure_libfm_dataset(rows), rows, args.threads, "libfm")
-        extras["recordio_roundtrip"] = recordio_roundtrip_probe(
-            records=20000 if args.smoke else 200000,
-            native=not args.smoke)
+        with sampler.section("csv_lane"):
+            extras["csv_lane"] = text_lane_probe(
+                ensure_csv_dataset(rows), rows, args.threads, "csv",
+                "?format=csv&label_column=0")
+        with sampler.section("libfm_lane"):
+            extras["libfm_lane"] = text_lane_probe(
+                ensure_libfm_dataset(rows), rows, args.threads, "libfm")
+        with sampler.section("recordio_roundtrip"):
+            extras["recordio_roundtrip"] = recordio_roundtrip_probe(
+                records=20000 if args.smoke else 200000,
+                native=not args.smoke)
         # parity ratios vs the same-machine reference build
         # (bench_baseline.json parity_rows, measured by
         # scripts/ref_bench.cc; the recordio row is engine-level on both
@@ -1201,9 +1344,11 @@ def main() -> None:
     # observability extras come from ONE unified telemetry snapshot
     # (doc/observability.md) instead of bespoke per-subsystem plumbing:
     # io_retry keeps its legacy key spelling (derived from the io_*_total
-    # counters — local-file runs report zeros, remote runs record the
-    # retry noise behind the throughput number), and the per-stage parse
-    # latency means name where this run's host time went.
+    # counters) but covers THIS process only — since the remote lane
+    # moved to parse-client subprocesses its retry noise rides
+    # extras.remote_lane.client_io_retry instead, and this row is zeros
+    # unless some in-process path touched remote I/O. The per-stage
+    # parse latency means name where this run's host time went.
     try:
         from dmlc_core_tpu import telemetry
         from dmlc_core_tpu.io.native import _LEGACY_IO_STAT_NAMES
@@ -1222,16 +1367,36 @@ def main() -> None:
     except Exception as e:  # never let observability sink the benchmark
         extras["io_retry"] = {"error": str(e)[-200:]}
 
+    # the run-wide resource envelope + per-lane sections (the rig's
+    # evidence plane, doc/benchmarking.md) and this run's provenance
+    extras["host_resources"] = {"overall": sampler.stop(),
+                                "lanes": sampler.sections}
+    extras["provenance"] = {**provenance, "host": host,
+                            "env_overrides": env_over}
+
     print(f"# {rows} rows ({size_mb:.1f} MB {lane_fmt}) in {dt:.3f}s = "
           f"{size_mb / dt:.1f} MB/s (median of "
           f"{extras.get('reps', args.reps)})", file=sys.stderr)
-    print(json.dumps({
+    result = {
         "metric": f"higgs_{lane_fmt}_ingest_rows_per_sec",
         "value": round(rps, 1),
         "unit": "rows/s",
         "vs_baseline": vs,
         "extras": extras,
-    }))
+    }
+    print(json.dumps(result))
+
+    # bench regression ledger (scripts/benchdiff.py): every run appends
+    # one normalized record so the trajectory is diffable from day one
+    history = os.environ.get("DMLC_BENCH_HISTORY") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "bench_history.jsonl")
+    if not args.no_ledger and history not in ("0", "off"):
+        written = append_ledger(result, provenance, host, env_over,
+                                extras["host_resources"], args.smoke,
+                                history)
+        if written:
+            print(f"# ledger: appended to {written}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -278,6 +278,12 @@ def gen_index() -> str:
         "hatches, the UBSan lane and the shard-cache fuzz driver |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
+        "| [benchmarking.md](benchmarking.md) | the honest measurement "
+        "plane: out-of-process origin rig (pre-forked mock backends, "
+        "one config surface), open-loop load generator "
+        "(coordinated-omission-safe intended-time capture, shed "
+        "policy), host resource evidence, the bench provenance + "
+        "regression ledger and benchdiff noise bands |",
         "",
         "Build: `make doc` (part of `make ci`) regenerates api.md and "
         "parameters.md and fails on any undocumented public symbol — the "

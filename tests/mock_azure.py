@@ -15,12 +15,10 @@ import base64
 import hashlib
 import hmac
 import re
-import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler
 
-from tests.mock_s3 import (DeepBacklogHTTPServer, FaultCounterMixin,
-                           reset_connection,
+from tests.mock_s3 import (FaultCounterMixin, reset_connection,
                            send_with_latency, stall_connection,
                            truncate_body)
 
@@ -219,17 +217,12 @@ class MockAzureHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
 
-def serve(ssl_context=None):
+def serve(ssl_context=None, config=None):
     """Start the mock server; returns (state, port, shutdown_fn).
 
     With `ssl_context` the mock speaks TLS — the stand-in for real Azure
-    Blob endpoints, which enforce secure transfer."""
-    state = MockAzureState()
-    handler = type("Handler", (MockAzureHandler,), {"state": state})
-    server = DeepBacklogHTTPServer(("127.0.0.1", 0), handler)
-    if ssl_context is not None:
-        server.socket = ssl_context.wrap_socket(server.socket,
-                                                server_side=True)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
-    return state, server.server_address[1], server.shutdown
+    Blob endpoints, which enforce secure transfer.  ``config``
+    (tests/mock_origin.OriginConfig) applies the shared shaping/fault
+    surface."""
+    from tests.mock_origin import serve_backend
+    return serve_backend("azure", config, ssl_context)

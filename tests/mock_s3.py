@@ -373,17 +373,13 @@ class MockS3Handler(BaseHTTPRequestHandler):
         self._reject(400, "BadRequest")
 
 
-def serve(ssl_context=None):
+def serve(ssl_context=None, config=None):
     """Start the mock server; returns (state, port, shutdown_fn).
 
     With `ssl_context` (an SSLContext loaded with a cert chain) the mock
-    speaks TLS — the S3-over-https lane's stand-in for real AWS."""
-    state = MockS3State()
-    handler = type("Handler", (MockS3Handler,), {"state": state})
-    server = DeepBacklogHTTPServer(("127.0.0.1", 0), handler)
-    if ssl_context is not None:
-        server.socket = ssl_context.wrap_socket(server.socket,
-                                                server_side=True)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
-    return state, server.server_address[1], server.shutdown
+    speaks TLS — the S3-over-https lane's stand-in for real AWS.
+    ``config`` (tests/mock_origin.OriginConfig) applies the shared
+    shaping/fault surface; the out-of-process path is
+    ``scripts/loadrig.py origin --backend s3``."""
+    from tests.mock_origin import serve_backend
+    return serve_backend("s3", config, ssl_context)

@@ -11,12 +11,10 @@ The reference tests HDFS only against a live cluster via libhdfs.
 from __future__ import annotations
 
 import json
-import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler
 
-from tests.mock_s3 import (DeepBacklogHTTPServer, FaultCounterMixin,
-                           reset_connection,
+from tests.mock_s3 import (FaultCounterMixin, reset_connection,
                            send_with_latency, stall_connection,
                            truncate_body)
 
@@ -266,19 +264,12 @@ class MockHdfsHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
 
-def serve(ssl_context=None):
+def serve(ssl_context=None, config=None):
     """Start the mock server; returns (state, port, shutdown_fn).
 
     With `ssl_context` the mock speaks TLS and issues https redirect
-    Locations — the secure-WebHDFS (swebhdfs) stand-in."""
-    state = MockHdfsState()
-    handler = type("Handler", (MockHdfsHandler,), {"state": state})
-    server = DeepBacklogHTTPServer(("127.0.0.1", 0), handler)
-    if ssl_context is not None:
-        server.socket = ssl_context.wrap_socket(server.socket,
-                                                server_side=True)
-        state.scheme = "https"
-    state.port = server.server_address[1]
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
-    return state, state.port, server.shutdown
+    Locations — the secure-WebHDFS (swebhdfs) stand-in.  ``config``
+    (tests/mock_origin.OriginConfig) applies the shared shaping/fault
+    surface."""
+    from tests.mock_origin import serve_backend
+    return serve_backend("webhdfs", config, ssl_context)

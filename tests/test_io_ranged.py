@@ -29,12 +29,10 @@ import pytest
 from test_s3 import _STATE as S3_STATE, put as s3_put  # noqa: E402
 from test_azure import _STATE as AZ_STATE, put as az_put  # noqa: E402
 from test_webhdfs import _STATE as HD_STATE, uri as hdfs_uri  # noqa: E402
-from test_io_resilience import (_HttpHandler, _HttpState,  # noqa: E402
-                                _reset_backend_faults, pseudo_bytes)
+from test_io_resilience import (_reset_backend_faults,  # noqa: E402
+                                pseudo_bytes)
 
-import threading  # noqa: E402
-
-from tests.mock_s3 import DeepBacklogHTTPServer  # noqa: E402
+import tests.mock_origin as mock_origin  # noqa: E402
 
 from dmlc_core_tpu import telemetry  # noqa: E402
 from dmlc_core_tpu.base import DMLCError  # noqa: E402
@@ -85,13 +83,11 @@ def clean_ranged_state():
 
 @pytest.fixture()
 def http_origin():
-    state = _HttpState()
-    handler = type("Handler", (_HttpHandler,), {"state": state})
-    server = DeepBacklogHTTPServer(("127.0.0.1", 0), handler)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
-    yield state, f"http://127.0.0.1:{server.server_address[1]}"
-    server.shutdown()
+    # the shared launcher (tests/mock_origin.py): deep accept backlog by
+    # default — the 12-way connect bursts need it
+    state, port, shutdown = mock_origin.serve_backend("http")
+    yield state, f"http://127.0.0.1:{port}"
+    shutdown()
 
 
 def _read(uri: str) -> bytes:

@@ -22,9 +22,7 @@ fixed 50 x 100 ms loop in the S3 reader). Covered here:
 from __future__ import annotations
 
 import hashlib
-import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 import pytest
@@ -36,7 +34,11 @@ from test_s3 import _STATE as S3_STATE, put as s3_put  # noqa: E402
 from test_azure import _STATE as AZ_STATE, put as az_put  # noqa: E402
 from test_webhdfs import _STATE as HD_STATE, uri as hdfs_uri  # noqa: E402
 
-import tests.mock_s3 as mock_s3  # noqa: E402
+import tests.mock_origin as mock_origin  # noqa: E402
+# the plain-http origin moved to tests/mock_http.py (the rig's fourth
+# backend); these aliases keep this module's old names importable
+from tests.mock_http import (MockHttpHandler as _HttpHandler,  # noqa: E402,F401
+                             MockHttpState as _HttpState)
 
 from dmlc_core_tpu.base import DMLCError  # noqa: E402
 from dmlc_core_tpu.data import (RowBlockContainer, RowBlockIter,  # noqa: E402
@@ -46,20 +48,11 @@ from dmlc_core_tpu.io.native import NativeStream  # noqa: E402
 
 
 def _reset_backend_faults():
+    # the shared knob/counter/request-log reset (tests/mock_origin.py):
+    # request-log assertions must not see other modules' traffic (the
+    # states are process-global) and every fault phase restarts at 0
     for st in (S3_STATE, AZ_STATE, HD_STATE):
-        st.stall_every = 0
-        st.reset_every = 0
-        st.get_500_every = 0
-        st.get_truncate_every = 0
-        st.fail_reads_after = None
-        st.latency_ms = 0
-        st.requests.clear()  # request-log assertions must not see other
-        # modules' traffic (the states are process-global)
-        for k in st._counters:  # fault phase restarts at 0 every test
-            st._counters[k] = 0
-    S3_STATE.ignore_range = False
-    S3_STATE.bad_content_range_every = 0
-    AZ_STATE.ignore_range = False
+        mock_origin.reset_state(st)
     S3_STATE.objects.clear()
     AZ_STATE.blobs.clear()
     HD_STATE.files.clear()
@@ -82,89 +75,12 @@ def pseudo_bytes(n: int, seed: int = 0) -> bytes:
         0, 256, size=n, dtype=np.uint8).tobytes()
 
 
-# -- a plain-http origin with scriptable stalls ------------------------------
-class _HttpState(mock_s3.FaultCounterMixin):
-    def __init__(self):
-        self.objects = {}
-        self.stall_first_n = 0      # the first N GETs sleep past the client
-        self.stall_all = False      # every GET stalls (deadline test)
-        self.stall_seconds = 6.0
-        self.get_500_every = 0
-        self.get_truncate_every = 0
-        self.reset_every = 0
-        self.ignore_range = False   # answer 200 full-body (Range ignored)
-        self.requests = []
-        self._init_fault_counters("get", "get500", "gettrunc", "reset")
-
-
-class _HttpHandler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-    state: _HttpState = None
-
-    def log_message(self, *a):
-        pass
-
-    def do_HEAD(self):
-        body = self.state.objects.get(self.path)
-        self.state.requests.append(("HEAD", self.path))
-        self.send_response(200 if body is not None else 404)
-        self.send_header("Content-Length",
-                         str(len(body)) if body is not None else "0")
-        self.end_headers()
-
-    def do_GET(self):
-        st = self.state
-        st.requests.append(("GET", self.path))
-        with st._fault_lock:
-            st._counters["get"] += 1
-            n = st._counters["get"]
-        if st.stall_all or n <= st.stall_first_n:
-            return mock_s3.stall_connection(self, st.stall_seconds)
-        if st._tick("reset", st.reset_every):
-            return mock_s3.reset_connection(self)
-        body = st.objects.get(self.path)
-        if body is None:
-            self.send_response(404)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
-            return
-        status, lo = 200, 0
-        content_range = None
-        rng = self.headers.get("Range")
-        if rng and not st.ignore_range:
-            import re
-            m = re.match(r"bytes=(\d+)-(\d*)", rng)
-            lo = int(m.group(1))
-            hi = int(m.group(2)) + 1 if m.group(2) else len(body)
-            total = len(body)
-            body = body[lo:min(hi, total)]
-            status = 206
-            content_range = (
-                f"bytes {lo}-{max(lo + len(body) - 1, lo)}/{total}")
-        if st._tick("get500", st.get_500_every):
-            self.send_response(500)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
-            return
-        if st._tick("gettrunc", st.get_truncate_every):
-            return mock_s3.truncate_body(self, status, body)
-        self.send_response(status)
-        if content_range is not None:
-            self.send_header("Content-Range", content_range)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-
+# -- a plain-http origin with scriptable stalls (tests/mock_http.py) ---------
 @pytest.fixture()
 def http_origin():
-    state = _HttpState()
-    handler = type("Handler", (_HttpHandler,), {"state": state})
-    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
-    yield state, f"http://127.0.0.1:{server.server_address[1]}"
-    server.shutdown()
+    state, port, shutdown = mock_origin.serve_backend("http")
+    yield state, f"http://127.0.0.1:{port}"
+    shutdown()
 
 
 # -- hung-server bound (the acceptance criterion) ----------------------------

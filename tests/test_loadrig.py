@@ -1,0 +1,337 @@
+"""Rig lane (doc/benchmarking.md): the out-of-process measurement plane.
+
+Pins the honesty properties the rig exists for:
+
+- out-of-process origins serve byte-identical data to the in-process
+  mocks for all four backends (same corpus function, same handlers,
+  different process) — measured through the real native client in a
+  fresh subprocess, so the endpoint-env singletons never collide with
+  the module-level mocks the rest of the suite pins;
+- the open-loop generator records latency against INTENDED start times:
+  an origin that stalls 200 ms every Nth response is visible in the
+  intended-time p99 and invisible in the naive service-time p99 — the
+  coordinated-omission pin (Tene / HdrHistogram);
+- open-loop and closed-loop measurements diverge under saturation: the
+  closed loop's throughput quietly caps while its latency looks healthy;
+- ``benchdiff`` exits nonzero on the seeded regression fixture and zero
+  on a same-record self-compare, and the backfilled ledger carries the
+  r01..r05 trajectory under their historical shas.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+import loadrig  # noqa: E402
+from tests import mock_origin  # noqa: E402
+
+BENCHDIFF = os.path.join(SCRIPTS, "benchdiff.py")
+FIXTURE = os.path.join(REPO, "tests", "data",
+                       "benchdiff_regression.jsonl")
+LEDGER = os.path.join(REPO, "bench_history.jsonl")
+
+
+def fetch_sha(origin, key) -> dict:
+    """Raw-read a corpus key through the native client in a fresh
+    process (fresh endpoint singletons) and return its JSON report."""
+    env = dict(os.environ, **origin.env())
+    out = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "loadrig.py"),
+         "fetch-client", "--uri", origin.uri(key)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr[-500:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+# ---------------------------------------------------------------------------
+# origin plane
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("backend,key", [
+    ("s3", "bkt/rig/blob.bin"),
+    ("azure", "ctr/rig/blob.bin"),
+    ("webhdfs", "/rig/blob.bin"),
+    ("http", "/rig/blob.bin"),
+])
+def test_out_of_process_byte_identity(backend, key):
+    """Every backend's out-of-process origin serves exactly the bytes
+    the in-process mock stores for the same corpus spec."""
+    import hashlib
+    spec = f"{key}=1048576:97"
+    want = mock_origin.pseudo_bytes(1048576, 97)
+    # the in-process mock's store holds exactly these bytes...
+    state, _, shutdown = mock_origin.serve_backend(backend)
+    try:
+        mock_origin.load_corpus(backend, state,
+                                mock_origin.build_corpus([spec]))
+        store = {"s3": lambda: state.objects[("bkt", "rig/blob.bin")],
+                 "azure": lambda: state.blobs[("ctr", "rig/blob.bin")],
+                 "webhdfs": lambda: state.files["/rig/blob.bin"],
+                 "http": lambda: state.objects["/rig/blob.bin"]}
+        assert store[backend]() == want
+    finally:
+        shutdown()
+    # ...and the out-of-process origin serves them byte-identically
+    # through the real native client (signing/redirects included)
+    with loadrig.spawn_origin(backend, [spec]) as org:
+        got = fetch_sha(org, key)
+    assert got["bytes"] == len(want)
+    assert got["sha256"] == hashlib.sha256(want).hexdigest()
+
+
+def test_preforked_workers_and_teardown():
+    """--workers pre-forks that many processes over one listener, and
+    close() leaves none of them behind."""
+    cfg = mock_origin.OriginConfig(workers=2)
+    org = loadrig.spawn_origin("http", ["/x=4096:1"], cfg)
+    try:
+        assert len(org.pids) == 2
+        assert fetch_sha(org, "/x")["bytes"] == 4096
+    finally:
+        org.close()
+    deadline = time.monotonic() + 10
+    live = set(org.pids)
+    while live and time.monotonic() < deadline:
+        for pid in list(live):
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                live.discard(pid)
+        time.sleep(0.1)
+    assert not live, f"origin workers survived close(): {live}"
+
+
+def test_one_config_surface():
+    """The same OriginConfig drives in-process serving and the
+    out-of-process CLI: knobs land on the state either way, and
+    reset_state returns every knob to its default."""
+    cfg = mock_origin.OriginConfig(latency_ms=7, reset_every=3,
+                                   backlog=64, slow_every=5, slow_ms=40)
+    state, _, shutdown = mock_origin.serve_backend("http", cfg)
+    try:
+        assert (state.latency_ms, state.reset_every,
+                state.slow_every, state.slow_ms) == (7, 3, 5, 40)
+        mock_origin.reset_state(state)
+        assert (state.latency_ms, state.reset_every,
+                state.slow_every, state.slow_ms) == (0, 0, 0, 0)
+    finally:
+        shutdown()
+    args = cfg.cli_args()
+    for flag, val in (("--latency-ms", "7"), ("--reset-every", "3"),
+                      ("--slow-every", "5"), ("--slow-ms", "40"),
+                      ("--backlog", "64")):
+        assert val == args[args.index(flag) + 1]
+    # an unknown knob errors instead of silently no-opping
+    with pytest.raises(AttributeError):
+        mock_origin.apply_config(
+            state, mock_origin.OriginConfig(extra={"no_such_knob": 1}))
+
+
+# ---------------------------------------------------------------------------
+# open-loop load generator
+# ---------------------------------------------------------------------------
+def test_open_loop_smoke_fixed_qps():
+    """5 s at a fixed target QPS against an out-of-process origin: every
+    arrival completes, none shed, achieved tracks offered."""
+    with loadrig.spawn_origin("http", ["/tiny=4096:3"]) as org:
+        fn = loadrig.http_request_fn(org.uri("/tiny"))
+        r = loadrig.open_loop(fn, qps=150, duration_s=5, max_inflight=8)
+    assert r["arrivals"] == 750
+    assert r["completed"] == 750
+    assert r["errors"] == 0 and r["shed"] == 0
+    assert abs(r["achieved_qps"] - r["offered_qps"]) \
+        <= 0.25 * r["offered_qps"]
+    # both clocks populated; intended can never undercut service
+    assert r["service_us"]["count"] == 750
+    assert r["intended_us"]["p99"] >= r["service_us"]["p99"]
+
+
+def test_coordinated_omission_pin():
+    """An origin stalling 200 ms every 160th response: the stall queues
+    arrivals behind the single in-flight slot, so the intended-time p99
+    sees it while the naive service-time p99 — which only times
+    send-to-response — hides it.  The service-time capture only admits
+    the stall at p999 (the stalled requests themselves)."""
+    cfg = mock_origin.OriginConfig(slow_every=160, slow_ms=200)
+    with loadrig.spawn_origin("http", ["/tiny=4096:3"], cfg) as org:
+        fn = loadrig.http_request_fn(org.uri("/tiny"))
+        r = loadrig.open_loop(fn, qps=120, duration_s=4, max_inflight=1)
+    assert r["errors"] == 0 and r["completed"] == r["arrivals"]
+    intended_p99 = r["intended_us"]["p99"]
+    service_p99 = r["service_us"]["p99"]
+    assert intended_p99 >= 131072, \
+        f"intended p99 {intended_p99}us misses the 200ms stall queue"
+    assert service_p99 <= 65536, \
+        f"service p99 {service_p99}us should hide the rare stall"
+    assert intended_p99 >= 4 * service_p99
+    # the stall IS in the service capture — but only at p999
+    assert r["service_us"]["p999"] >= 131072
+
+
+def test_open_vs_closed_loop_divergence_under_saturation():
+    """A 30 ms/request origin saturates 2 closed-loop workers at ~60
+    QPS: the closed loop reports that rate with healthy-looking
+    latency, while the open loop — holding the 200 QPS schedule the
+    closed loop silently abandoned — shows the queueing delay."""
+    cfg = mock_origin.OriginConfig(latency_ms=30)
+    with loadrig.spawn_origin("http", ["/tiny=4096:3"], cfg) as org:
+        fn = loadrig.http_request_fn(org.uri("/tiny"))
+        closed = loadrig.closed_loop(fn, workers=2, duration_s=3)
+        opened = loadrig.open_loop(fn, qps=200, duration_s=3,
+                                   max_inflight=2)
+    assert closed["achieved_qps"] < 0.5 * 200, \
+        "closed loop should cap far below the open-loop target"
+    assert opened["intended_us"]["p99"] >= \
+        4 * closed["service_us"]["p99"], (
+            f"open-loop intended p99 {opened['intended_us']['p99']} "
+            f"should dwarf closed-loop p99 "
+            f"{closed['service_us']['p99']} under saturation")
+
+
+def test_shed_policy_bounds_lateness():
+    """With a lateness budget, an overloaded open loop sheds arrivals
+    instead of queueing unboundedly — and accounts for every arrival."""
+    cfg = mock_origin.OriginConfig(latency_ms=50)
+    with loadrig.spawn_origin("http", ["/tiny=4096:3"], cfg) as org:
+        fn = loadrig.http_request_fn(org.uri("/tiny"))
+        r = loadrig.open_loop(fn, qps=100, duration_s=2, max_inflight=1,
+                              shed_after_ms=100)
+    assert r["shed"] > 50
+    assert r["completed"] + r["shed"] == r["arrivals"]
+
+
+# ---------------------------------------------------------------------------
+# bench ledger + benchdiff
+# ---------------------------------------------------------------------------
+def run_benchdiff(*args):
+    return subprocess.run([sys.executable, BENCHDIFF, *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_benchdiff_seeded_regression_exits_nonzero():
+    out = run_benchdiff("--history", FIXTURE, "--a", "0", "--b", "1")
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "REGRESSION" in out.stdout
+
+
+def test_benchdiff_self_compare_exits_zero():
+    out = run_benchdiff("--history", FIXTURE, "--a", "1", "--b", "1")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "REGRESSION" not in out.stdout
+    assert "0 regression(s)" in out.stdout
+
+
+def test_benchdiff_trailing_and_round_refs():
+    """The backfilled repo ledger: r01..r05 under their historical shas,
+    resolvable by round tag, and a trailing compare runs clean."""
+    import benchdiff
+    records = benchdiff.load_history(LEDGER)
+    rounds = [r.get("round") for r in records[:5]]
+    assert rounds == [1, 2, 3, 4, 5]
+    assert all(len(r.get("git_sha") or "") == 40 for r in records[:5])
+    assert all(r.get("metric") == "higgs_libsvm_ingest_rows_per_sec"
+               for r in records[:5])
+    r3 = benchdiff.resolve(records, "r3")
+    assert r3["round"] == 3
+    by_sha = benchdiff.resolve(records, r3["git_sha"][:10])
+    assert by_sha is r3
+    out = run_benchdiff("--history", LEDGER, "--a", "r4", "--b", "r5")
+    assert out.returncode in (0, 1)  # a verdict, not a crash
+    assert "shared metrics" in out.stdout
+
+
+def test_ledger_append_record_schema(tmp_path):
+    """bench.py's ledger append: a normalized record lands with the
+    provenance, env, and lane slices benchdiff needs."""
+    import benchdiff
+    result = {"metric": "m", "value": 10.0, "unit": "rows/s",
+              "vs_baseline": 1.5,
+              "extras": {"bottleneck": "parse_bound",
+                         "csv_lane": {"rows_per_sec": 5.0,
+                                      "error": "nope"},
+                         "remote_lane": {"ranged_rows_per_sec": 7.0,
+                                         "range_scheduler": {"x": 1}}}}
+    rec = benchdiff.make_record(
+        result, git_sha="f" * 40, git_dirty=False,
+        host={"host": "h", "cpus": 2}, env_overrides={"DMLC_X": "1"},
+        host_resources={"overall": {"cpu_busy_frac": 0.5}},
+        smoke=True, argv=["--smoke"])
+    history = tmp_path / "hist.jsonl"
+    benchdiff.append_record(rec, str(history))
+    benchdiff.append_record(rec, str(history))
+    back = benchdiff.load_history(str(history))
+    assert len(back) == 2
+    got = back[0]
+    assert got["schema"] == benchdiff.SCHEMA
+    assert got["git_sha"] == "f" * 40 and got["smoke"] is True
+    assert got["stall_verdict"] == "parse_bound"
+    # numeric leaves only: error strings and nested dicts are dropped
+    assert got["lanes"]["csv_lane"] == {"rows_per_sec": 5.0}
+    assert got["lanes"]["remote_lane"] == {"ranged_rows_per_sec": 7.0}
+    # a self-compare of the appended record is clean
+    out = run_benchdiff("--history", str(history), "--a", "0", "--b",
+                        "1")
+    assert out.returncode == 0
+
+
+def test_ledger_tolerates_torn_tail(tmp_path):
+    """A half-written last line (crashed run) is skipped, not fatal."""
+    import benchdiff
+    history = tmp_path / "hist.jsonl"
+    rec = benchdiff.make_record({"metric": "m", "value": 1.0,
+                                 "unit": "u", "extras": {}})
+    benchdiff.append_record(rec, str(history))
+    with open(history, "a") as f:
+        f.write('{"schema": 1, "value": 2.0, "metr')
+    assert len(benchdiff.load_history(str(history))) == 1
+
+
+def test_quantile_from_log2_buckets():
+    """The bucket-scheme quantile the generator reports percentiles
+    from: upper bounds, overflow to inf, empty to 0."""
+    from dmlc_core_tpu import telemetry
+    h = telemetry.Histogram("q", {})
+    assert h.quantile(0.5) == 0.0
+    for _ in range(99):
+        h.observe(1000)       # bucket le=1024
+    h.observe(3_000_000)      # bucket le=2^22
+    assert h.quantile(0.5) == 1024.0
+    assert h.quantile(0.99) == 1024.0
+    assert h.quantile(0.999) == float(1 << 22)
+    h2 = telemetry.Histogram("q2", {})
+    h2.observe(float(1 << 40))
+    assert h2.quantile(0.5) == float("inf")
+    with pytest.raises(ValueError):
+        h2.quantile(0.0)
+
+
+def test_host_resource_sampler_sections():
+    """The sampler's per-lane envelope: a watched busy subprocess (the
+    rig's own usage — origins and clients are processes) shows up in
+    the section's CPU attribution while this process idles."""
+    from dmlc_core_tpu import telemetry
+    s = telemetry.HostResourceSampler(0.05).start()
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         "import time\n"
+         "d = time.monotonic() + 0.8\n"
+         "while time.monotonic() < d:\n"
+         "    sum(i * i for i in range(10000))\n"])
+    s.watch("busychild", child.pid)
+    with s.section("busy"):
+        child.wait()
+    out = s.stop()
+    assert out["samples"] >= 2
+    assert out["cpu_source"] in ("stat", "pids")
+    busy = s.sections["busy"]
+    assert busy["proc_cpu_s"]["busychild"] > 0.2
+    assert busy["proc_cpu_s"]["self"] < busy["proc_cpu_s"]["busychild"]
+    assert busy["rss_max_bytes"] > 0
