@@ -84,8 +84,12 @@ lint:
 
 # Concurrency & invariant analysis (doc/analysis.md): the Python
 # lock-discipline pass (blocking calls / re-acquisition under a held
-# lock), the C++ DMLC_GUARDED_BY structural checker, and the
-# checked-env-parse / no-runtime-assert lints. Exit code = finding count.
+# lock), the C++ DMLC_GUARDED_BY structural checker, the
+# checked-env-parse / no-runtime-assert lints, and the cross-boundary
+# contract passes — C-ABI/ctypes parity (builds + runs the compile-time
+# struct layout probe; loud skip when no compiler is present), metric
+# catalog, env-knob registry vs the generated doc/parameters.md table,
+# wire-protocol words. Exit code = finding count.
 analyze:
 	python3 scripts/analyze.py
 

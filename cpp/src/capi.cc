@@ -8,6 +8,16 @@
 //   - handles are opaque pointers; *_free releases
 //   - blob/rowblock pointers remain valid until the next call on the same
 //     handle (matching reference DataIter Value() semantics, data.h:55-66)
+//
+// MACHINE-CHECKED PARITY (scripts/analyze.py Pass 4, doc/analysis.md):
+// every extern-"C" function below is diffed against the ctypes table in
+// dmlc_core_tpu/io/native.py (explicit restype, arity, pointer-ness,
+// scalar widths), and every `typedef struct` is diffed field-by-field
+// against its ctypes Structure mirror AND proven byte-identical by a
+// compile-time sizeof/offsetof probe. Adding a function or struct field
+// here without updating the binding fails `make analyze` — keep
+// declarations in the plain shapes the extractor parses (one `dct_*`
+// definition per `extern "C"` symbol, `typedef struct { ... } name;`).
 #include <cstring>
 #include <string>
 

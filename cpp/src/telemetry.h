@@ -27,6 +27,16 @@
 // registers the IoStats atomics as external counters (same storage, new
 // canonical names), and parser.cc feeds process-wide pipeline counters and
 // per-stage latency histograms alongside its per-handle struct.
+//
+// MACHINE-CHECKED CATALOG (scripts/analyze.py Pass 4, doc/analysis.md):
+// every GetCounter/GetGauge/GetHist/RegisterExternalCounter call site is
+// extracted and diffed against doc/observability.md's metric tables,
+// telemetry.METRIC_HELP, and the Python half's registrations (label-key
+// parity for shared names). Register with the metric NAME as a string
+// literal at the call site (a name built at run time is invisible to the
+// extractor and will surface as a documented-but-gone finding); new
+// metrics need a catalog row and a METRIC_HELP entry before
+// `make analyze` passes.
 #ifndef DCT_TELEMETRY_H_
 #define DCT_TELEMETRY_H_
 

@@ -115,108 +115,123 @@ def lib() -> ctypes.CDLL:
             return _lib
         _build_native()
         cdll = ctypes.CDLL(_LIB_PATH)
-        cdll.dct_last_error.restype = ctypes.c_char_p
         _declare_signatures(cdll)
         _lib = cdll
         return _lib
 
 
 def _declare_signatures(cdll: ctypes.CDLL) -> None:
-    """Pin argtypes so sizes/pointers survive the 64-bit ABI."""
+    """Pin (restype, argtypes) so sizes/pointers survive the 64-bit ABI.
+
+    Every exported ``dct_*`` function carries an EXPLICIT restype — a
+    binding left to ctypes' implicit ``c_int`` default silently truncates
+    any future pointer/size return to 32 bits, so the analyzer's ABI
+    parity pass (``scripts/analyze.py`` Pass 4, doc/analysis.md) diffs
+    this table against the ``cpp/src/capi.cc`` declarations: missing or
+    legacy argtypes-only rows, arity drift, and pointer/scalar width
+    mismatches all fail ``make analyze``."""
     c = ctypes
     vp, sz, i, u = c.c_void_p, c.c_size_t, c.c_int, c.c_uint
     sigs = {
-        "dct_stream_create": [c.c_char_p, c.c_char_p, c.POINTER(vp)],
-        "dct_stream_read": [vp, vp, sz, c.POINTER(sz)],
-        "dct_stream_write": [vp, c.c_char_p, sz],
-        "dct_stream_free": [vp],
-        "dct_fs_list": [c.c_char_p, i, c.POINTER(c.c_char_p)],
-        "dct_fs_path_info": [c.c_char_p, c.POINTER(sz), c.POINTER(i)],
-        "dct_str_free": [c.c_char_p],
-        "dct_split_create": [c.c_char_p, u, u, c.c_char_p, i, c.POINTER(vp)],
-        "dct_split_create_ex": [c.c_char_p, c.c_char_p, u, u, c.c_char_p, i,
-                                i, i, sz, c.c_char_p, u, i, c.POINTER(vp)],
-        "dct_split_next_record": [vp, c.POINTER(vp), c.POINTER(sz),
-                                  c.POINTER(i)],
-        "dct_split_next_chunk": [vp, c.POINTER(vp), c.POINTER(sz),
-                                 c.POINTER(i)],
-        "dct_split_before_first": [vp],
-        "dct_split_reset_partition": [vp, u, u],
-        "dct_split_total_size": [vp, c.POINTER(sz)],
-        "dct_split_hint_chunk_size": [vp, sz],
-        "dct_split_free": [vp],
-        "dct_recordio_writer_create": [c.c_char_p, c.POINTER(vp)],
-        "dct_recordio_write": [vp, c.c_char_p, sz],
-        "dct_recordio_writer_free": [vp],
-        "dct_recordio_reader_create": [c.c_char_p, c.POINTER(vp)],
-        "dct_recordio_read": [vp, c.POINTER(vp), c.POINTER(sz), c.POINTER(i)],
-        "dct_recordio_reader_free": [vp],
-        "dct_parser_create": [c.c_char_p, u, u, c.c_char_p, i, i, i,
-                              c.POINTER(vp)],
-        "dct_parser_create_ex": [c.c_char_p, u, u, c.c_char_p, i, i, i, i,
-                                 c.c_char_p, c.c_char_p, c.POINTER(vp)],
-        "dct_parser_pipeline_stats": [vp, c.POINTER(ParsePipelineStatsC),
-                                      c.POINTER(i)],
-        "dct_parser_next_block": [vp, c.POINTER(RowBlockC), c.POINTER(i)],
-        "dct_parser_before_first": [vp],
-        "dct_parser_set_epoch": [vp, u, c.POINTER(c.c_int32)],
-        "dct_parser_bytes_read": [vp, c.POINTER(sz)],
-        "dct_parser_free": [vp],
-        "dct_webhdfs_set_delegation_token": [c.c_char_p],
-        "dct_webhdfs_set_auth_header": [c.c_char_p],
-        "dct_set_tls_proxy": [c.c_char_p],
-        "dct_telemetry_snapshot": [c.POINTER(c.c_char_p)],
-        "dct_telemetry_reset": [],
-        "dct_telemetry_enable": [i],
-        "dct_trace_snapshot": [c.POINTER(c.c_char_p)],
-        "dct_trace_reset": [],
-        "dct_flight_dump": [c.c_char_p, c.POINTER(i)],
-        "dct_io_retry_stats": [c.POINTER(IoRetryStatsC)],
-        "dct_io_stats_reset": [],
-        "dct_io_set_fault_plan": [c.c_char_p],
-        "dct_io_set_timeout_ms": [i],
-        "dct_fs_set_fault_plan": [c.c_char_p],
-        "dct_parser_formats_doc": [c.POINTER(c.c_char_p)],
-        "dct_batcher_create": [c.c_char_p, u, u, c.c_char_p, i, i,
-                               c.c_uint64, c.c_uint32, c.c_uint64,
-                               c.POINTER(vp)],
-        "dct_batcher_next_meta": [vp, c.POINTER(c.c_uint64),
-                                  c.POINTER(c.c_uint64),
-                                  c.POINTER(c.c_uint64), c.POINTER(i),
-                                  c.POINTER(i), c.POINTER(i)],
-        "dct_batcher_fill_csr": [vp, vp, vp, vp, vp, vp, vp, vp, vp],
-        "dct_batcher_fill_dense": [vp, vp, c.c_int32, c.c_uint64, vp, vp, vp,
-                                   vp],
-        "dct_batcher_before_first": [vp],
-        "dct_batcher_set_epoch": [vp, u, c.POINTER(c.c_int32)],
-        "dct_batcher_bytes_read": [vp, c.POINTER(sz)],
-        "dct_batcher_free": [vp],
-        "dct_denserec_create": [c.c_char_p, u, u, c.c_uint64, c.c_uint32,
-                                c.POINTER(vp)],
-        "dct_denserec_meta": [vp, c.POINTER(c.c_uint64),
-                              c.POINTER(c.c_int32), c.POINTER(c.c_int32)],
-        "dct_denserec_fill": [vp, vp, c.c_int32, c.c_uint64, vp, vp, vp,
-                              c.POINTER(c.c_uint64)],
-        "dct_denserec_before_first": [vp],
-        "dct_denserec_set_epoch": [vp, u, c.POINTER(c.c_int32)],
-        "dct_denserec_bytes_read": [vp, c.POINTER(sz)],
-        "dct_denserec_free": [vp],
-        "dct_csrrec_create": [c.c_char_p, u, u, c.c_uint64, c.c_uint32,
-                              c.c_uint64, c.POINTER(vp)],
-        "dct_csrrec_meta": [vp, c.POINTER(c.c_uint64),
-                            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
-                            c.POINTER(c.c_int32)],
-        "dct_csrrec_fill": [vp, vp, vp, vp, vp, vp, vp, vp, vp,
-                            c.POINTER(c.c_uint64)],
-        "dct_csrrec_before_first": [vp],
-        "dct_csrrec_set_epoch": [vp, u, c.POINTER(c.c_int32)],
-        "dct_csrrec_bytes_read": [vp, c.POINTER(sz)],
-        "dct_csrrec_free": [vp],
+        "dct_last_error": (c.c_char_p, []),
+        "dct_stream_create": (i, [c.c_char_p, c.c_char_p, c.POINTER(vp)]),
+        "dct_stream_read": (i, [vp, vp, sz, c.POINTER(sz)]),
+        "dct_stream_write": (i, [vp, c.c_char_p, sz]),
+        "dct_stream_free": (i, [vp]),
+        "dct_fs_list": (i, [c.c_char_p, i, c.POINTER(c.c_char_p)]),
+        "dct_fs_path_info": (i, [c.c_char_p, c.POINTER(sz), c.POINTER(i)]),
+        "dct_str_free": (i, [c.c_char_p]),
+        "dct_split_create": (i, [c.c_char_p, u, u, c.c_char_p, i,
+                                 c.POINTER(vp)]),
+        "dct_split_create_ex": (i, [c.c_char_p, c.c_char_p, u, u,
+                                    c.c_char_p, i, i, i, sz, c.c_char_p,
+                                    u, i, c.POINTER(vp)]),
+        "dct_split_next_record": (i, [vp, c.POINTER(vp), c.POINTER(sz),
+                                      c.POINTER(i)]),
+        "dct_split_next_chunk": (i, [vp, c.POINTER(vp), c.POINTER(sz),
+                                     c.POINTER(i)]),
+        "dct_split_before_first": (i, [vp]),
+        "dct_split_reset_partition": (i, [vp, u, u]),
+        "dct_split_total_size": (i, [vp, c.POINTER(sz)]),
+        "dct_split_hint_chunk_size": (i, [vp, sz]),
+        "dct_split_free": (i, [vp]),
+        "dct_recordio_writer_create": (i, [c.c_char_p, c.POINTER(vp)]),
+        "dct_recordio_write": (i, [vp, c.c_char_p, sz]),
+        "dct_recordio_writer_free": (i, [vp]),
+        "dct_recordio_reader_create": (i, [c.c_char_p, c.POINTER(vp)]),
+        "dct_recordio_read": (i, [vp, c.POINTER(vp), c.POINTER(sz),
+                                  c.POINTER(i)]),
+        "dct_recordio_reader_free": (i, [vp]),
+        "dct_parser_create": (i, [c.c_char_p, u, u, c.c_char_p, i, i, i,
+                                  c.POINTER(vp)]),
+        "dct_parser_create_ex": (i, [c.c_char_p, u, u, c.c_char_p, i, i,
+                                     i, i, c.c_char_p, c.c_char_p,
+                                     c.POINTER(vp)]),
+        "dct_parser_pipeline_stats": (i, [vp,
+                                          c.POINTER(ParsePipelineStatsC),
+                                          c.POINTER(i)]),
+        "dct_parser_next_block": (i, [vp, c.POINTER(RowBlockC),
+                                      c.POINTER(i)]),
+        "dct_parser_before_first": (i, [vp]),
+        "dct_parser_set_epoch": (i, [vp, u, c.POINTER(c.c_int32)]),
+        "dct_parser_bytes_read": (i, [vp, c.POINTER(sz)]),
+        "dct_parser_free": (i, [vp]),
+        "dct_webhdfs_set_delegation_token": (i, [c.c_char_p]),
+        "dct_webhdfs_set_auth_header": (i, [c.c_char_p]),
+        "dct_set_tls_proxy": (i, [c.c_char_p]),
+        "dct_telemetry_snapshot": (i, [c.POINTER(c.c_char_p)]),
+        "dct_telemetry_reset": (i, []),
+        "dct_telemetry_enable": (i, [i]),
+        "dct_trace_snapshot": (i, [c.POINTER(c.c_char_p)]),
+        "dct_trace_reset": (i, []),
+        "dct_flight_dump": (i, [c.c_char_p, c.POINTER(i)]),
+        "dct_io_retry_stats": (i, [c.POINTER(IoRetryStatsC)]),
+        "dct_io_stats_reset": (i, []),
+        "dct_io_set_fault_plan": (i, [c.c_char_p]),
+        "dct_io_set_timeout_ms": (i, [i]),
+        "dct_fs_set_fault_plan": (i, [c.c_char_p]),
+        "dct_parser_formats_doc": (i, [c.POINTER(c.c_char_p)]),
+        "dct_batcher_create": (i, [c.c_char_p, u, u, c.c_char_p, i, i,
+                                   c.c_uint64, c.c_uint32, c.c_uint64,
+                                   c.POINTER(vp)]),
+        "dct_batcher_next_meta": (i, [vp, c.POINTER(c.c_uint64),
+                                      c.POINTER(c.c_uint64),
+                                      c.POINTER(c.c_uint64), c.POINTER(i),
+                                      c.POINTER(i), c.POINTER(i)]),
+        "dct_batcher_fill_csr": (i, [vp, vp, vp, vp, vp, vp, vp, vp, vp]),
+        "dct_batcher_fill_dense": (i, [vp, vp, c.c_int32, c.c_uint64, vp,
+                                       vp, vp, vp]),
+        "dct_batcher_before_first": (i, [vp]),
+        "dct_batcher_set_epoch": (i, [vp, u, c.POINTER(c.c_int32)]),
+        "dct_batcher_bytes_read": (i, [vp, c.POINTER(sz)]),
+        "dct_batcher_free": (i, [vp]),
+        "dct_denserec_create": (i, [c.c_char_p, u, u, c.c_uint64,
+                                    c.c_uint32, c.POINTER(vp)]),
+        "dct_denserec_meta": (i, [vp, c.POINTER(c.c_uint64),
+                                  c.POINTER(c.c_int32),
+                                  c.POINTER(c.c_int32)]),
+        "dct_denserec_fill": (i, [vp, vp, c.c_int32, c.c_uint64, vp, vp,
+                                  vp, c.POINTER(c.c_uint64)]),
+        "dct_denserec_before_first": (i, [vp]),
+        "dct_denserec_set_epoch": (i, [vp, u, c.POINTER(c.c_int32)]),
+        "dct_denserec_bytes_read": (i, [vp, c.POINTER(sz)]),
+        "dct_denserec_free": (i, [vp]),
+        "dct_csrrec_create": (i, [c.c_char_p, u, u, c.c_uint64, c.c_uint32,
+                                  c.c_uint64, c.POINTER(vp)]),
+        "dct_csrrec_meta": (i, [vp, c.POINTER(c.c_uint64),
+                                c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                                c.POINTER(c.c_int32)]),
+        "dct_csrrec_fill": (i, [vp, vp, vp, vp, vp, vp, vp, vp, vp,
+                                c.POINTER(c.c_uint64)]),
+        "dct_csrrec_before_first": (i, [vp]),
+        "dct_csrrec_set_epoch": (i, [vp, u, c.POINTER(c.c_int32)]),
+        "dct_csrrec_bytes_read": (i, [vp, c.POINTER(sz)]),
+        "dct_csrrec_free": (i, [vp]),
     }
-    for name, argtypes in sigs.items():
+    for name, (restype, argtypes) in sigs.items():
         fn = getattr(cdll, name)
         fn.argtypes = argtypes
-        fn.restype = c.c_int
+        fn.restype = restype
 
 
 def _check(status: int) -> None:

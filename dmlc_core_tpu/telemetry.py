@@ -832,6 +832,10 @@ def _fmt_value(v) -> str:
 # One-line HELP text per cataloged metric name, emitted as ``# HELP``
 # exposition lines (doc/observability.md is the long-form catalog).
 # Uncataloged names (tests, ad-hoc metrics) simply carry no HELP line.
+# MACHINE-CHECKED (scripts/analyze.py Pass 4, doc/analysis.md): every
+# metric registered in shipped code — either half — must have an entry
+# here AND a doc/observability.md catalog row, and every entry here must
+# match a live registration; `make analyze` fails on drift either way.
 METRIC_HELP: Dict[str, str] = {
     "io_requests_total": "HTTP requests sent",
     "io_retries_total": "backoff sleeps taken",

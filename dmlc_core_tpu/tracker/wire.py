@@ -64,6 +64,36 @@ TELEMETRY_PUSH = -96
 # span cap keeps real documents far below it)
 TELEMETRY_PUSH_MAX = 8 << 20
 
+# -- the machine-checked channel word registry --------------------------------
+# Every COMMAND word the heartbeat channel can carry must be negative (a
+# ping is ANY non-negative int32, so a non-negative command word would be
+# indistinguishable from a ping), and no two words — command or sentinel —
+# may share a value. Nothing used to enforce that invariant; now
+# `scripts/analyze.py` Pass 4 (doc/analysis.md) does, against this
+# registry: it checks every entry names its constant, every registered
+# word is negative and collision-free, and every negative module constant
+# IS registered (a new word added without a registry entry is a finding —
+# unregistered words would dodge the collision check).
+#
+# HEARTBEAT_PING / HEARTBEAT_BYE are deliberately absent: they live in the
+# ping space (non-negative) by design and are classified by value range,
+# not by word. Sentinels are answer-frame values in the shard-id position
+# (shard ids are >= 0), so they share the negative space with commands
+# and must not collide with them either.
+CHANNEL_COMMAND_WORDS = {
+    "HEARTBEAT_ABORT": HEARTBEAT_ABORT,
+    "LEASE_ACQUIRE": LEASE_ACQUIRE,
+    "LEASE_RELEASE": LEASE_RELEASE,
+    "LEASE_COMPLETE": LEASE_COMPLETE,
+    "LEASE_GRANT": LEASE_GRANT,
+    "TELEMETRY_PULL": TELEMETRY_PULL,
+    "TELEMETRY_PUSH": TELEMETRY_PUSH,
+}
+CHANNEL_SENTINELS = {
+    "LEASE_EMPTY": LEASE_EMPTY,
+    "LEASE_DRAINED": LEASE_DRAINED,
+}
+
 
 def env_float(name: str, default: float, env=None) -> float:
     """Checked float env parse (the env_int rule for float-valued knobs

@@ -41,6 +41,30 @@ Passes:
      `python -O` strips asserts (`# assert-ok: <reason>` escapes, e.g.
      for test-only helpers).
 
+4. **Cross-boundary contracts** (scripts/contracts.py is the shared
+   extraction; doc/analysis.md "Pass 4"):
+   - **ABI parity**: the `dct_*` C surface (cpp/src/capi.cc) diffed
+     against the ctypes table in dmlc_core_tpu/io/native.py — missing or
+     legacy-form bindings (implicit `c_int` restype: the 64-bit
+     truncation bug class), arity and pointer-ness drift, struct-mirror
+     field drift — plus a compile-time layout probe proving
+     sizeof/offsetof byte-identical to `ctypes.sizeof`/field offsets
+     (loud skip when no compiler is present). Escape: `# abi-ok:
+     <reason>`.
+   - **metric contract**: every telemetry registration (both halves)
+     must appear in doc/observability.md's catalog AND in
+     telemetry.METRIC_HELP; documented-but-gone rows, label-set drift
+     (doc vs code, and C++ vs Python for shared names), and kind
+     conflicts are findings. Escape: `# contract-ok: <reason>`.
+   - **env-knob registry**: every DMLC_*/DCT_* env read must appear in
+     doc/parameters.md's GENERATED knob table (scripts/gendoc.py renders
+     it from the same extraction) with a matching default; two code
+     sites reading one knob with different literal defaults is a
+     finding. Escape: `# contract-ok: <reason>`.
+   - **wire-protocol words**: tracker/wire.py's channel words must be
+     registered (CHANNEL_COMMAND_WORDS / CHANNEL_SENTINELS), negative
+     (the ping space is every non-negative int32), and collision-free.
+
 Exit code is the finding count (capped at 125 so it never wraps mod 256;
 0 = clean). `--root DIR` analyzes a fixture tree instead of the repo, with
 every file in scope for every pass (tests/test_analyze.py drives this).
@@ -54,11 +78,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from srcwalk import REPO, iter_sources  # noqa: E402 (shared walker)
+import contracts  # noqa: E402 (shared contract extraction, Pass 4)
 
 LOCK_OK_RE = re.compile(r"(?:#|//)\s*lock-ok\s*:?\s*(.*\S)?")
 ENV_OK_RE = re.compile(r"(?:#|//)\s*env-ok\s*:?\s*(.*\S)?")
 ASSERT_OK_RE = re.compile(r"(?:#|//)\s*assert-ok\s*:?\s*(.*\S)?")
 FS_OK_RE = re.compile(r"(?:#|//)\s*fs-ok\s*:?\s*(.*\S)?")
+ABI_OK_RE = re.compile(r"(?:#|//)\s*abi-ok\s*:?\s*(.*\S)?")
+CONTRACT_OK_RE = re.compile(r"(?:#|//)\s*contract-ok\s*:?\s*(.*\S)?")
 
 # scopes when walking the real repo (relative-path prefixes)
 LOCK_SCOPE = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/data/")
@@ -898,6 +925,466 @@ class CppFsPass:
 
 
 # ===========================================================================
+# Pass 4: cross-boundary contracts (ABI / metrics / env knobs / wire words)
+# ===========================================================================
+
+class ContractPass:
+    """Diffs the three hand-maintained contracts against their extracted
+    ground truth (scripts/contracts.py): C ABI vs ctypes, metric
+    registrations vs catalog/METRIC_HELP, env-knob reads vs the generated
+    doc/parameters.md table, and the tracker wire words. In repo mode the
+    participating files are pinned; in fixture mode roles are detected
+    (a .cc exporting `dct_*`, a .py with a dct signature table / mirrors /
+    METRIC_HELP / a wire registry, .md pages with metric tables or the
+    knob-table markers)."""
+
+    # repo-mode code scope for metric + knob extraction (tests and
+    # examples configure knobs, they do not define the contract); shared
+    # with gendoc.py's table generator through contracts.py
+    CODE_SCOPE = contracts.CODE_SCOPE
+
+    def __init__(self, findings: Findings, base: str, fixture: bool):
+        self.findings = findings
+        self.base = base
+        self.fixture = fixture
+        self.py = {}       # rel -> (tree, lines)
+        self.cpp = {}      # rel -> (stripped, lines)
+        self.cpp_code = {}  # rel -> comments-only-stripped text
+        self.md = {}       # rel -> text
+        self.probe_notes = []
+
+    # -- loading ------------------------------------------------------------
+    def load(self, cpp_files):
+        """`cpp_files`: {rel: (text, stripped, lines)} already loaded by
+        the guard pass — re-used so capi.cc is read and stripped once.
+        The metric/knob extractors need string literals, so they run on a
+        comments-only strip of the raw text."""
+        for rel, (text, stripped, lines) in cpp_files.items():
+            if self.fixture or _in_scope(rel, self.CODE_SCOPE):
+                self.cpp[rel] = (stripped, lines)
+                self.cpp_code[rel] = contracts.strip_cpp_comments(text)
+        for path in iter_sources(self.base, suffixes=(".py",)):
+            rel = os.path.relpath(path, self.base).replace(os.sep, "/")
+            if not (self.fixture or _in_scope(rel, self.CODE_SCOPE)):
+                continue
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+            try:
+                tree = ast.parse(text, filename=rel)
+            except SyntaxError:
+                continue
+            self.py[rel] = (tree, text.split("\n"))
+        if self.fixture:
+            for dirpath, dirs, files in os.walk(self.base):
+                dirs[:] = sorted(d for d in dirs if not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".md"):
+                        p = os.path.join(dirpath, f)
+                        rel = os.path.relpath(p, self.base).replace(
+                            os.sep, "/")
+                        with open(p, encoding="utf-8",
+                                  errors="replace") as fh:
+                            self.md[rel] = fh.read()
+        else:
+            for rel in ("doc/observability.md", "doc/parameters.md"):
+                p = os.path.join(self.base, rel)
+                if os.path.exists(p):
+                    with open(p, encoding="utf-8", errors="replace") as fh:
+                        self.md[rel] = fh.read()
+
+    # -- shared escape handling ---------------------------------------------
+    def _escaped(self, rel, lineno, rx, label) -> bool:
+        lines = None
+        if rel in self.py:
+            lines = self.py[rel][1]
+        elif rel in self.cpp:
+            lines = self.cpp[rel][1]
+        if lines is None:
+            return False
+        found, reason = comment_marker(lines, lineno, rx)
+        if found and not reason:
+            self.findings.add(rel, lineno, label,
+                              f"{label}-ok annotation without a reason")
+        return found
+
+    def run(self):
+        self._abi()
+        self._metrics()
+        self._knobs()
+        self._wire()
+
+    # -- 4a: ABI parity + layout probe ---------------------------------------
+    def _abi(self):
+        funcs, structs, abi_rels = {}, {}, {}
+        for rel, (stripped, lines) in sorted(self.cpp.items()):
+            if not self.fixture and rel != "cpp/src/capi.cc":
+                continue
+            f, s, _h = contracts.parse_c_abi("\n".join(lines), stripped)
+            for name, fn in f.items():
+                funcs[name] = fn
+                abi_rels[name] = rel
+            for name, st in s.items():
+                structs[name] = st
+                abi_rels[name] = rel
+        bindings, mirrors, bind_rel = {}, {}, None
+        for rel, (tree, _lines) in sorted(self.py.items()):
+            if not self.fixture and rel != "dmlc_core_tpu/io/native.py":
+                continue
+            b = contracts.extract_bindings(tree)
+            m = contracts.extract_mirrors(tree)
+            if b or m:
+                bind_rel = rel
+                bindings.update(b)
+                mirrors.update(m)
+        if not funcs and not bindings and not structs:
+            return
+        for name, fn in sorted(funcs.items()):
+            rel = abi_rels[name]
+            if name not in bindings:
+                if not self._escaped(rel, fn.lineno, ABI_OK_RE, "abi"):
+                    self.findings.add(
+                        rel, fn.lineno, "abi",
+                        f"`{name}` is exported but has no ctypes binding "
+                        f"row — an undeclared call defaults restype to "
+                        f"c_int (64-bit returns truncate) with unchecked "
+                        f"argtypes")
+                continue
+            b = bindings[name]
+            if self._escaped(bind_rel, b.lineno, ABI_OK_RE, "abi"):
+                continue
+            want_ret = contracts.expected_restype(fn.ret)
+            if b.restype is None:
+                self.findings.add(
+                    bind_rel, b.lineno, "abi",
+                    f"`{name}` binding declares argtypes only — restype "
+                    f"silently defaults to c_int; declare "
+                    f"({want_ret or fn.ret}, [argtypes])")
+            elif want_ret is not None and b.restype != want_ret:
+                self.findings.add(
+                    bind_rel, b.lineno, "abi",
+                    f"`{name}` restype is {b.restype} but the C ABI "
+                    f"returns `{fn.ret}` ({want_ret})")
+            if len(b.argtypes) != len(fn.params):
+                self.findings.add(
+                    bind_rel, b.lineno, "abi",
+                    f"`{name}` binding declares {len(b.argtypes)} "
+                    f"argtypes but the C ABI takes {len(fn.params)} "
+                    f"parameters")
+                continue
+            for i, (ct, pt) in enumerate(zip(fn.params, b.argtypes)):
+                err = contracts.ctype_mismatch(ct, pt, mirrors)
+                if err is not None:
+                    self.findings.add(
+                        bind_rel, b.lineno, "abi",
+                        f"`{name}` argument {i + 1}: {err}")
+        for name, b in sorted(bindings.items()):
+            if funcs and name not in funcs and \
+                    not self._escaped(bind_rel, b.lineno, ABI_OK_RE,
+                                      "abi"):
+                self.findings.add(
+                    bind_rel, b.lineno, "abi",
+                    f"binding declares `{name}` but the C ABI exports no "
+                    f"such function")
+        self._abi_structs(structs, mirrors, abi_rels, bind_rel)
+
+    def _abi_structs(self, structs, mirrors, abi_rels, bind_rel):
+        probe_structs = {}
+        for name, st in sorted(structs.items()):
+            rel = abi_rels[name]
+            if name not in mirrors:
+                if not self._escaped(rel, st.lineno, ABI_OK_RE, "abi"):
+                    self.findings.add(
+                        rel, st.lineno, "abi",
+                        f"ABI struct `{name}` has no ctypes Structure "
+                        f"mirror (docstring convention: 'Mirror of "
+                        f"{name}')")
+                continue
+            m = mirrors[name]
+            clean = True
+            if len(m.fields) != len(st.fields):
+                self.findings.add(
+                    bind_rel, m.lineno, "abi",
+                    f"`{m.pyname}` mirrors `{name}` with "
+                    f"{len(m.fields)} fields, C declares "
+                    f"{len(st.fields)} — struct drift corrupts memory")
+                clean = False
+            for (ct, cn, _cl), (pn, pt, pl) in zip(st.fields, m.fields):
+                if cn != pn:
+                    self.findings.add(
+                        bind_rel, pl, "abi",
+                        f"`{m.pyname}` field `{pn}` vs C `{name}.{cn}` "
+                        f"— field order/name drift")
+                    clean = False
+                    continue
+                err = contracts.ctype_mismatch(ct, pt, mirrors)
+                if err is not None:
+                    self.findings.add(bind_rel, pl, "abi",
+                                      f"`{m.pyname}.{pn}`: {err}")
+                    clean = False
+            if clean:
+                probe_structs[name] = st
+        for cname, m in sorted(mirrors.items()):
+            if structs and cname not in structs:
+                self.findings.add(
+                    bind_rel, m.lineno, "abi",
+                    f"`{m.pyname}` claims to mirror `{cname}` but the C "
+                    f"ABI declares no such struct")
+        if probe_structs:
+            self._layout_probe(probe_structs, mirrors, abi_rels, bind_rel)
+
+    def _layout_probe(self, structs, mirrors, abi_rels, bind_rel):
+        layout, note = contracts.run_layout_probe(structs)
+        if layout is None:
+            self.probe_notes.append(note)
+            return
+        for name, st in sorted(structs.items()):
+            m = mirrors[name]
+            cls = contracts.build_mirror_class(m)
+            got = layout.get(name)
+            if cls is None or got is None:
+                continue
+            import ctypes as _ct
+            if _ct.sizeof(cls) != got["size"]:
+                self.findings.add(
+                    bind_rel, m.lineno, "abi",
+                    f"layout probe: sizeof({name}) is {got['size']} in C "
+                    f"but ctypes.sizeof({m.pyname}) is "
+                    f"{_ct.sizeof(cls)} — byte layout diverged")
+                continue
+            for fname, _canon, pl in m.fields:
+                coff = got["fields"].get(fname)
+                poff = getattr(cls, fname).offset
+                if coff is not None and coff != poff:
+                    self.findings.add(
+                        bind_rel, pl, "abi",
+                        f"layout probe: offsetof({name}, {fname}) is "
+                        f"{coff} in C but {poff} in {m.pyname}")
+
+    # -- 4b: metric contract -------------------------------------------------
+    def _metrics(self):
+        registry = {}
+        for rel, code in sorted(self.cpp_code.items()):
+            contracts.extract_metrics_cpp(rel, code, registry)
+        help_map, help_rel = None, None
+        for rel, (tree, _lines) in sorted(self.py.items()):
+            contracts.extract_metrics_py(rel, tree, registry)
+            h = contracts.extract_metric_help(tree)
+            if h is not None:
+                help_map, help_rel = h, rel
+        catalog, cat_rel = {}, None
+        for rel, text in sorted(self.md.items()):
+            if not self.fixture and rel != "doc/observability.md":
+                continue
+            c = contracts.extract_doc_catalog(text)
+            if c:
+                cat_rel = rel
+                catalog.update(c)
+        if not registry:
+            return
+        for name, reg in sorted(registry.items()):
+            rel, line = reg.sites[0]
+            # an audited annotation on ANY registration site of the
+            # metric suppresses every code-side finding for it (the
+            # doc-side documented-but-gone check below is unaffected —
+            # an escaped metric is still registered)
+            esc = any(self._escaped(r, ln, CONTRACT_OK_RE, "contract")
+                      for r, ln in reg.sites)
+            if esc:
+                continue
+            if catalog and name not in catalog:
+                self.findings.add(
+                    rel, line, "metric",
+                    f"metric `{name}` is registered but missing from the "
+                    f"{cat_rel} catalog (undocumented metric)")
+            if help_map is not None and name not in help_map:
+                self.findings.add(
+                    rel, line, "metric",
+                    f"metric `{name}` has no METRIC_HELP entry "
+                    f"({help_rel}) — /metrics serves it without # HELP")
+            if len(reg.kinds) > 1:
+                self.findings.add(
+                    rel, line, "metric",
+                    f"metric `{name}` is registered with conflicting "
+                    f"kinds: {', '.join(sorted(reg.kinds))}")
+            if len(reg.halves) == 2:
+                cu = set().union(*reg.labels.get("cpp", [frozenset()]))
+                pu = set().union(*reg.labels.get("py", [frozenset()]))
+                if reg.labels.get("cpp") and reg.labels.get("py") and \
+                        cu != pu:
+                    self.findings.add(
+                        rel, line, "metric",
+                        f"metric `{name}` label keys diverge across "
+                        f"halves: C++ {{{','.join(sorted(cu)) or ''}}} "
+                        f"vs Python {{{','.join(sorted(pu)) or ''}}}")
+            if name in catalog:
+                doc = catalog[name]
+                known = [ks for ks in
+                         (k for half in reg.labels.values()
+                          for k in half)]
+                if known:
+                    union = set().union(*known)
+                    if union != doc["labels"]:
+                        self.findings.add(
+                            rel, line, "metric",
+                            f"metric `{name}` label keys "
+                            f"{{{','.join(sorted(union))}}} disagree "
+                            f"with the {cat_rel} catalog "
+                            f"{{{','.join(sorted(doc['labels']))}}}")
+                if doc["kind"] and doc["kind"] not in reg.kinds:
+                    self.findings.add(
+                        rel, line, "metric",
+                        f"metric `{name}` is documented as "
+                        f"{doc['kind']} but registered as "
+                        f"{', '.join(sorted(reg.kinds))}")
+        for name, doc in sorted(catalog.items()):
+            if name not in registry:
+                self.findings.add(
+                    cat_rel, doc["line"], "metric",
+                    f"`{name}` is documented in the catalog but no code "
+                    f"registers it (documented-but-gone)")
+        if help_map is not None:
+            for name, line in sorted(help_map.items()):
+                if name not in registry:
+                    self.findings.add(
+                        help_rel, line, "metric",
+                        f"METRIC_HELP entry `{name}` matches no "
+                        f"registered metric (stale help)")
+
+    # -- 4c: env-knob registry ----------------------------------------------
+    def _knobs(self):
+        registry = {}
+        for rel, (tree, _lines) in sorted(self.py.items()):
+            contracts.extract_knobs_py(rel, tree, registry)
+        for rel, code in sorted(self.cpp_code.items()):
+            contracts.extract_knobs_cpp(rel, code, registry)
+        if not registry:
+            return
+        for name, sites in sorted(registry.items()):
+            lits = contracts.knob_conflicts(sites)
+            if len(lits) > 1:
+                by_default = {}
+                for s in sites:
+                    by_default.setdefault(s.default, s)
+                keep = [by_default[d] for d in lits]
+                first = keep[0]
+                for s in keep[1:]:
+                    if not self._escaped(s.rel, s.lineno, CONTRACT_OK_RE,
+                                         "contract"):
+                        self.findings.add(
+                            s.rel, s.lineno, "knob",
+                            f"`{name}` read with default "
+                            f"`{s.default}` here but `{first.default}` "
+                            f"at {first.rel}:{first.lineno} (knob-"
+                            f"default drift)")
+        doc_rel, rows, found = None, {}, False
+        for rel, text in sorted(self.md.items()):
+            if not self.fixture and rel != "doc/parameters.md":
+                continue
+            r, ok = contracts.parse_knob_table(text)
+            if ok:
+                doc_rel, rows, found = rel, r, True
+        if not found:
+            if not self.fixture:
+                self.findings.add(
+                    "doc/parameters.md", 1, "knob",
+                    "no generated env-knob table (markers missing) — "
+                    "run `make doc` to render it from the code registry")
+            return
+        for name, sites in sorted(registry.items()):
+            s = sites[0]
+            if name not in rows:
+                if not self._escaped(s.rel, s.lineno, CONTRACT_OK_RE,
+                                     "contract"):
+                    self.findings.add(
+                        s.rel, s.lineno, "knob",
+                        f"env knob `{name}` is read here but absent "
+                        f"from the {doc_rel} table (run `make doc`)")
+            elif rows[name] != contracts.knob_display_default(sites):
+                self.findings.add(
+                    s.rel, s.lineno, "knob",
+                    f"env knob `{name}` default drift: {doc_rel} says "
+                    f"`{rows[name]}`, code says "
+                    f"`{contracts.knob_display_default(sites)}` (run "
+                    f"`make doc`)")
+        for name in sorted(rows):
+            if name not in registry:
+                self.findings.add(
+                    doc_rel, 1, "knob",
+                    f"documented env knob `{name}` is read nowhere in "
+                    f"the code (stale row — run `make doc`)")
+
+    # -- 4d: wire-protocol words ---------------------------------------------
+    def _wire(self):
+        target = None
+        for rel, (tree, lines) in sorted(self.py.items()):
+            if self.fixture:
+                ww = contracts.extract_wire_words(tree)
+                if ww.has_registry or os.path.basename(rel) == "wire.py":
+                    target = (rel, ww)
+                    break
+            elif rel == "dmlc_core_tpu/tracker/wire.py":
+                target = (rel, contracts.extract_wire_words(tree))
+        if target is None:
+            return
+        rel, ww = target
+        if not ww.has_registry:
+            self.findings.add(
+                rel, 1, "wire",
+                "no CHANNEL_COMMAND_WORDS/CHANNEL_SENTINELS registry — "
+                "the channel word contract is unenforceable")
+            return
+        resolved = {}
+        for kind, table in (("command", ww.commands),
+                            ("sentinel", ww.sentinels)):
+            for key, (val, line) in sorted(table.items()):
+                if isinstance(val, str):
+                    if val != key:
+                        self.findings.add(
+                            rel, line, "wire",
+                            f"registry entry \"{key}\" binds constant "
+                            f"`{val}` — the key must name the constant "
+                            f"it registers")
+                    if val not in ww.constants:
+                        self.findings.add(
+                            rel, line, "wire",
+                            f"registry entry \"{key}\" references "
+                            f"`{val}` which is not a module int "
+                            f"constant")
+                        continue
+                    value = ww.constants[val][0]
+                elif val is None:
+                    self.findings.add(
+                        rel, line, "wire",
+                        f"registry entry \"{key}\" has a non-constant "
+                        f"value")
+                    continue
+                else:
+                    value = val
+                if value >= 0:
+                    self.findings.add(
+                        rel, line, "wire",
+                        f"{kind} word {key} = {value} is non-negative — "
+                        f"it collides with the ping space (any "
+                        f"non-negative int32 is a ping / shard id)")
+                if value in resolved:
+                    self.findings.add(
+                        rel, line, "wire",
+                        f"{kind} word {key} = {value} collides with "
+                        f"{resolved[value]} — two frames become "
+                        f"indistinguishable on the wire")
+                else:
+                    resolved[value] = key
+        registered = set(ww.commands) | set(ww.sentinels)
+        for name, (value, line) in sorted(ww.constants.items()):
+            if value < 0 and name not in registered:
+                self.findings.add(
+                    rel, line, "wire",
+                    f"negative channel word {name} = {value} is not in "
+                    f"CHANNEL_COMMAND_WORDS/CHANNEL_SENTINELS — "
+                    f"unregistered words dodge the collision check")
+
+
+# ===========================================================================
 # driver
 # ===========================================================================
 
@@ -943,14 +1430,22 @@ def analyze(root=None) -> int:
             cpp_units.setdefault(stem, []).append((path, rel))
 
     lock_pass.run()
+    cpp_loaded = {}
     for stem in sorted(cpp_units):
         for rel, text, stripped, lines in guard_pass.run_unit(
                 cpp_units[stem]):
+            cpp_loaded[rel] = (text, stripped, lines)
             if rel not in CPP_FS_ALLOW or fixture:
                 cppfs_pass.run(rel, text, stripped, lines)
             if rel in CPP_ENV_ALLOW and not fixture:
                 continue  # the checked helpers themselves
             cppenv_pass.run(rel, text, stripped, lines)
+
+    contract_pass = ContractPass(findings, base, fixture)
+    contract_pass.load(cpp_loaded)
+    contract_pass.run()
+    for note in contract_pass.probe_notes:
+        print(f"analyze: NOTE: {note}")
 
     count = findings.report()
     return count

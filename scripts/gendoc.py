@@ -16,6 +16,10 @@ import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import contracts  # noqa: E402 (shared contract extraction, doc/analysis.md)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DOC_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "doc")
@@ -232,8 +236,51 @@ def gen_parameters() -> str:
         "epoch (the coarse-grained training shuffle, reference "
         "input_split_shuffle.h).",
         "",
-        parser_formats_doc(),
+        parser_formats_doc().rstrip(),
+        "",
+        "# Environment knobs",
+        "",
+        "Every `DMLC_*`/`DCT_*` environment variable the shipped code "
+        "reads, extracted from the live tree by `scripts/contracts.py` — "
+        "the SAME extraction `make analyze` (Pass 4, "
+        "[analysis.md](analysis.md)) diffs this table against, so a knob "
+        "added, removed, or re-defaulted without regenerating this page "
+        "fails CI. Defaults: a literal is the in-code fallback; `unset` "
+        "means the raw value is read with behavior-defined fallback; "
+        "`computed` means the default derives from other knobs at run "
+        "time; `required` means the process exports it before the read. "
+        "Long-form semantics live with each subsystem "
+        "([robustness.md](robustness.md), [caching.md](caching.md), "
+        "[io-ranged.md](io-ranged.md), [parsing.md](parsing.md), "
+        "[observability.md](observability.md), "
+        "[benchmarking.md](benchmarking.md)).",
+        "",
+        contracts.render_knob_table(contracts.collect_repo_knobs(REPO)),
     ])
+
+
+_LINK_RE = re.compile(r"\]\(([^)#\s]+)(?:#[^)\s]*)?\)")
+
+
+def check_doc_links() -> None:
+    """Cross-reference check: every relative link between doc/*.md pages
+    must resolve to an existing file (warnings-as-errors like the rest of
+    the lane) — stale links are exactly the doc drift this lane exists to
+    stop."""
+    for fname in sorted(os.listdir(DOC_DIR)):
+        if not fname.endswith(".md"):
+            continue
+        with open(os.path.join(DOC_DIR, fname), encoding="utf-8") as f:
+            text = f.read()
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in _LINK_RE.finditer(line):
+                target = m.group(1)
+                if "://" in target or target.startswith("mailto:"):
+                    continue
+                resolved = os.path.normpath(os.path.join(DOC_DIR, target))
+                if not os.path.exists(resolved):
+                    warn(f"doc/{fname}:{i}: broken relative link "
+                         f"({target})")
 
 
 def gen_index() -> str:
@@ -246,7 +293,8 @@ def gen_index() -> str:
         "API mapping |",
         "| [api.md](api.md) | generated Python API reference |",
         "| [parameters.md](parameters.md) | parameter system + native "
-        "data-format registry |",
+        "data-format registry + the generated DMLC_*/DCT_* env-knob "
+        "table |",
         "| [parallelism.md](parallelism.md) | the five sharding "
         "strategies (DP/SP/TP/EP/PP) and their oracles |",
         "| [pipeline.md](pipeline.md) | the multi-chunk parse pipeline: "
@@ -274,8 +322,11 @@ def gen_index() -> str:
         "| [analysis.md](analysis.md) | project-native concurrency & "
         "invariant analyzer: the Python lock-discipline pass, "
         "DMLC_GUARDED_BY capability annotations + structural checker, "
-        "checked-env-parse / no-assert lints, the lock-ok/env-ok escape "
-        "hatches, the UBSan lane and the shard-cache fuzz driver |",
+        "checked-env-parse / no-assert lints, the cross-boundary "
+        "contract passes (C-ABI/ctypes parity + layout probe, metric "
+        "catalog, env-knob registry, wire words), the "
+        "lock-ok/env-ok/abi-ok/contract-ok escape hatches, the UBSan "
+        "lane and the shard-cache fuzz driver |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
         "| [benchmarking.md](benchmarking.md) | the honest measurement "
@@ -302,6 +353,7 @@ def main() -> int:
         with open(os.path.join(DOC_DIR, name), "w") as f:
             f.write(text.rstrip() + "\n")
         print(f"doc: wrote doc/{name} ({len(text)} bytes)")
+    check_doc_links()
     if warnings:
         print(f"doc: {len(warnings)} warning(s) — failing (warnings are "
               f"errors in the doc lane)", file=sys.stderr)

@@ -586,6 +586,535 @@ def test_mentions_in_comments_and_strings_ignored(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Pass 4a: C-ABI / ctypes parity + the compile-time layout probe
+# ---------------------------------------------------------------------------
+
+ABI_CC = """\
+    #include <cstdint>
+
+    typedef void* dct_thing_t;
+
+    typedef struct {
+      uint64_t n;
+      const uint64_t* p;
+    } dct_pair_t;
+
+    extern "C" {
+
+    int dct_pair_get(dct_thing_t h, dct_pair_t* out) { return 0; }
+
+    int dct_thing_size(dct_thing_t h, uint64_t* out) { return 0; }
+
+    }
+    """
+
+ABI_PY_CLEAN = """\
+    import ctypes
+
+    class PairC(ctypes.Structure):
+        \"\"\"Mirror of dct_pair_t in capi.cc.\"\"\"
+        _fields_ = [("n", ctypes.c_uint64),
+                    ("p", ctypes.POINTER(ctypes.c_uint64))]
+
+    def declare(cdll):
+        c = ctypes
+        vp = c.c_void_p
+        sigs = {
+            "dct_pair_get": (c.c_int, [vp, c.POINTER(PairC)]),
+            "dct_thing_size": (c.c_int, [vp, c.POINTER(c.c_uint64)]),
+        }
+        for name, (restype, argtypes) in sigs.items():
+            fn = getattr(cdll, name)
+            fn.restype = restype
+            fn.argtypes = argtypes
+    """
+
+
+def test_abi_clean_parity(tmp_path):
+    write_fixture(tmp_path, "capi.cc", ABI_CC)
+    write_fixture(tmp_path, "native.py", ABI_PY_CLEAN)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_abi_legacy_restype_and_missing_binding_flagged(tmp_path):
+    """The 64-bit truncation bug class: an argtypes-only row leaves
+    restype at the implicit c_int default, and an unbound export has
+    neither restype nor argtypes."""
+    write_fixture(tmp_path, "capi.cc", ABI_CC)
+    write_fixture(tmp_path, "native.py", """\
+        import ctypes
+
+        class PairC(ctypes.Structure):
+            \"\"\"Mirror of dct_pair_t in capi.cc.\"\"\"
+            _fields_ = [("n", ctypes.c_uint64),
+                        ("p", ctypes.POINTER(ctypes.c_uint64))]
+
+        def declare(cdll):
+            c = ctypes
+            sigs = {
+                "dct_pair_get": [c.c_void_p, c.POINTER(PairC)],
+            }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "restype silently defaults to c_int" in out.stdout
+    assert "dct_thing_size" in out.stdout  # the unbound export
+
+
+def test_abi_wrong_restype_arity_and_width_flagged(tmp_path):
+    write_fixture(tmp_path, "capi.cc", """\
+        #include <cstdint>
+
+        extern "C" {
+
+        const char* dct_msg() { return ""; }
+
+        int dct_put(uint64_t v, int flag) { return 0; }
+
+        }
+        """)
+    write_fixture(tmp_path, "native.py", """\
+        import ctypes
+
+        def declare(cdll):
+            c = ctypes
+            sigs = {
+                "dct_msg": (c.c_int, []),
+                "dct_put": (c.c_int, [c.c_int]),
+            }
+        """)
+    out = run_analyze(tmp_path)
+    # wrong restype (char* as c_int = pointer truncation) + arity drift
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "c_char_p" in out.stdout
+    assert "argtypes but the C ABI takes 2" in out.stdout
+
+
+def test_abi_scalar_width_mismatch_flagged(tmp_path):
+    write_fixture(tmp_path, "capi.cc", """\
+        #include <cstdint>
+        extern "C" {
+        int dct_put(uint64_t v) { return 0; }
+        }
+        """)
+    write_fixture(tmp_path, "native.py", """\
+        import ctypes
+
+        def declare(cdll):
+            c = ctypes
+            sigs = {
+                "dct_put": (c.c_int, [c.c_int]),
+            }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "needs c_uint64" in out.stdout
+
+
+def test_abi_struct_field_drift_flagged(tmp_path):
+    """A mirror field narrower than the C field shifts every later
+    offset — the memory-corruption shape the struct diff exists for."""
+    write_fixture(tmp_path, "capi.cc", ABI_CC)
+    write_fixture(tmp_path, "native.py", """\
+        import ctypes
+
+        class PairC(ctypes.Structure):
+            \"\"\"Mirror of dct_pair_t in capi.cc.\"\"\"
+            _fields_ = [("n", ctypes.c_uint32),
+                        ("p", ctypes.POINTER(ctypes.c_uint64))]
+
+        def declare(cdll):
+            c = ctypes
+            vp = c.c_void_p
+            sigs = {
+                "dct_pair_get": (c.c_int, [vp, c.POINTER(PairC)]),
+                "dct_thing_size": (c.c_int, [vp, c.POINTER(c.c_uint64)]),
+            }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "needs c_uint64" in out.stdout
+
+
+@pytest.mark.skipif(__import__("shutil").which("g++") is None
+                    and __import__("shutil").which("cc") is None,
+                    reason="no C/C++ compiler for the layout probe")
+def test_abi_layout_probe_catches_packing_drift(tmp_path):
+    """Field-by-field types agree, but the C side is packed: only the
+    compiled sizeof/offsetof probe can see the byte-layout divergence."""
+    write_fixture(tmp_path, "capi.cc", """\
+        #include <cstdint>
+
+        typedef struct {
+          uint32_t a;
+          uint64_t b;
+        } __attribute__((packed)) dct_packed_t;
+
+        extern "C" {
+        int dct_packed_get(dct_packed_t* out) { return 0; }
+        }
+        """)
+    write_fixture(tmp_path, "native.py", """\
+        import ctypes
+
+        class PackedC(ctypes.Structure):
+            \"\"\"Mirror of dct_packed_t in capi.cc.\"\"\"
+            _fields_ = [("a", ctypes.c_uint32),
+                        ("b", ctypes.c_uint64)]
+
+        def declare(cdll):
+            c = ctypes
+            sigs = {
+                "dct_packed_get": (c.c_int, [c.POINTER(PackedC)]),
+            }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "layout probe" in out.stdout and "diverged" in out.stdout
+
+
+def test_abi_layout_probe_skips_loudly_without_compiler(tmp_path):
+    write_fixture(tmp_path, "capi.cc", ABI_CC)
+    write_fixture(tmp_path, "native.py", ABI_PY_CLEAN)
+    env = dict(os.environ, PATH="/nonexistent")
+    out = subprocess.run(
+        [sys.executable, ANALYZE, "--root", str(tmp_path)],
+        capture_output=True, text=True, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "layout probe SKIPPED" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Pass 4b: metric contract (code vs METRIC_HELP vs the doc catalog)
+# ---------------------------------------------------------------------------
+
+METRIC_MD = """\
+    # Metrics
+
+    | metric | type | meaning |
+    |---|---|---|
+    | `good_total` | counter | the documented one |
+    """
+
+
+def test_undocumented_metric_flagged(tmp_path):
+    write_fixture(tmp_path, "obs.md", METRIC_MD)
+    write_fixture(tmp_path, "help.py", """\
+        METRIC_HELP = {
+            "good_total": "the documented one",
+            "rogue_total": "registered but never cataloged",
+        }
+        """)
+    write_fixture(tmp_path, "code.py", """\
+        def run():
+            telemetry.counter("good_total").inc()
+            telemetry.counter("rogue_total").inc()
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "rogue_total" in out.stdout and "undocumented" in out.stdout
+
+
+def test_metric_missing_help_flagged(tmp_path):
+    write_fixture(tmp_path, "obs.md", """\
+        | metric | type | meaning |
+        |---|---|---|
+        | `good_total` | counter | ok |
+        | `quiet_total` | counter | ok |
+        """)
+    write_fixture(tmp_path, "help.py", """\
+        METRIC_HELP = {
+            "good_total": "ok",
+        }
+        """)
+    write_fixture(tmp_path, "code.py", """\
+        def run():
+            telemetry.counter("good_total").inc()
+            telemetry.counter("quiet_total").inc()
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "quiet_total" in out.stdout and "METRIC_HELP" in out.stdout
+
+
+def test_documented_but_gone_metric_flagged(tmp_path):
+    write_fixture(tmp_path, "obs.md", """\
+        | metric | type | meaning |
+        |---|---|---|
+        | `good_total` | counter | ok |
+        | `ghost_total` | counter | removed from code long ago |
+        """)
+    write_fixture(tmp_path, "help.py", """\
+        METRIC_HELP = {
+            "good_total": "ok",
+        }
+        """)
+    write_fixture(tmp_path, "code.py", """\
+        def run():
+            telemetry.counter("good_total").inc()
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "ghost_total" in out.stdout and "documented-but-gone" \
+        in out.stdout
+
+
+def test_cross_half_label_mismatch_flagged(tmp_path):
+    """The fs_fault_injected_total{op=} shape: both halves register one
+    name, but with different label keys — the merged exposition would
+    silently fork the series."""
+    write_fixture(tmp_path, "obs.md", """\
+        | metric | type | meaning |
+        |---|---|---|
+        | `dual_total{op=}` | counter | shared |
+        """)
+    write_fixture(tmp_path, "help.py", """\
+        METRIC_HELP = {
+            "dual_total": "shared",
+        }
+        """)
+    write_fixture(tmp_path, "half.cc", """\
+        #include "telemetry.h"
+        void Bump() {
+          telemetry::GetCounter("dual_total", {{"op", "read"}})->inc();
+        }
+        """)
+    write_fixture(tmp_path, "code.py", """\
+        def run():
+            telemetry.counter("dual_total", {"kind": "w"}).inc()
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "diverge" in out.stdout  # C++ {op} vs Python {kind}
+    assert "disagree" in out.stdout  # union {kind,op} vs documented {op}
+
+
+def test_metric_contract_clean_twin(tmp_path):
+    write_fixture(tmp_path, "obs.md", """\
+        | metric | type | meaning |
+        |---|---|---|
+        | `dual_total{op=}` | counter | shared |
+        | `plain_us` | histogram | unlabeled |
+        """)
+    write_fixture(tmp_path, "help.py", """\
+        METRIC_HELP = {
+            "dual_total": "shared",
+            "plain_us": "unlabeled",
+        }
+        """)
+    write_fixture(tmp_path, "half.cc", """\
+        #include "telemetry.h"
+        void Bump() {
+          telemetry::GetCounter("dual_total", {{"op", "read"}})->inc();
+          telemetry::GetHist("plain_us")->observe(3);
+        }
+        """)
+    write_fixture(tmp_path, "code.py", """\
+        def run():
+            telemetry.counter("dual_total", {"op": "write"}).inc()
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_multi_label_metric_documentable(tmp_path):
+    """A metric with two label keys must be expressible in the catalog
+    (`name{a=,b=}`) — otherwise the first multi-label metric could never
+    satisfy the pass."""
+    write_fixture(tmp_path, "obs.md", """\
+        | metric | type | meaning |
+        |---|---|---|
+        | `multi_total{fs=,op=}` | counter | two label keys |
+        """)
+    write_fixture(tmp_path, "help.py", """\
+        METRIC_HELP = {
+            "multi_total": "two label keys",
+        }
+        """)
+    write_fixture(tmp_path, "half.cc", """\
+        #include "telemetry.h"
+        void Bump() {
+          telemetry::GetCounter("multi_total",
+                                {{"op", "read"}, {"fs", "loc"}})->inc();
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_contract_ok_escapes_label_mismatch(tmp_path):
+    """`# contract-ok: <reason>` on any registration site suppresses ALL
+    code-side findings for that metric — including cross-half label
+    divergence, not just the undocumented/missing-help pair."""
+    write_fixture(tmp_path, "obs.md", """\
+        | metric | type | meaning |
+        |---|---|---|
+        | `dual_total{op=}` | counter | shared |
+        """)
+    write_fixture(tmp_path, "help.py", """\
+        METRIC_HELP = {
+            "dual_total": "shared",
+        }
+        """)
+    write_fixture(tmp_path, "half.cc", """\
+        #include "telemetry.h"
+        void Bump() {
+          telemetry::GetCounter("dual_total", {{"op", "read"}})->inc();
+        }
+        """)
+    write_fixture(tmp_path, "code.py", """\
+        def run():
+            # contract-ok: python half is migrating to op= next release
+            telemetry.counter("dual_total", {"kind": "w"}).inc()
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# Pass 4c: env-knob registry (defaults + the generated doc table)
+# ---------------------------------------------------------------------------
+
+def test_knob_default_drift_flagged(tmp_path):
+    """One knob, two sites, two literal defaults: whichever site reads
+    first silently wins — exactly the drift class this pass pins."""
+    write_fixture(tmp_path, "a.py", """\
+        def one():
+            return env_int("DMLC_X_TIMEOUT", 5)
+        """)
+    write_fixture(tmp_path, "b.py", """\
+        def other():
+            return env_int("DMLC_X_TIMEOUT", 7)
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "knob-default drift" in out.stdout
+
+
+def test_knob_doc_table_drift_flagged(tmp_path):
+    knob_md = (
+        "# Parameters\n\n"
+        "<!-- BEGIN GENERATED: env-knobs (scripts/contracts.py) -->\n\n"
+        "| knob | default | referenced in |\n"
+        "|---|---|---|\n"
+        "| `DMLC_A` | `9` | `knobs.py` |\n"
+        "| `DMLC_C` | `1` | `gone.py` |\n\n"
+        "<!-- END GENERATED: env-knobs -->\n")
+    (tmp_path / "params.md").write_text(knob_md)
+    write_fixture(tmp_path, "knobs.py", """\
+        def read():
+            return (env_int("DMLC_A", 5), env_int("DMLC_B", 6))
+        """)
+    out = run_analyze(tmp_path)
+    # DMLC_A default drift (doc 9 vs code 5), DMLC_B missing from the
+    # table, DMLC_C documented but read nowhere
+    assert out.returncode == 3, out.stdout + out.stderr
+    assert "default drift" in out.stdout
+    assert "DMLC_B" in out.stdout and "absent" in out.stdout
+    assert "DMLC_C" in out.stdout and "stale row" in out.stdout
+
+
+def test_knob_doc_table_clean_twin(tmp_path):
+    knob_md = (
+        "# Parameters\n\n"
+        "<!-- BEGIN GENERATED: env-knobs (scripts/contracts.py) -->\n\n"
+        "| knob | default | referenced in |\n"
+        "|---|---|---|\n"
+        "| `DMLC_A` | `5` | `knobs.py` |\n"
+        "| `DMLC_B` | `unset` | `knobs.py` |\n\n"
+        "<!-- END GENERATED: env-knobs -->\n")
+    (tmp_path / "params.md").write_text(knob_md)
+    write_fixture(tmp_path, "knobs.py", """\
+        import os
+
+        def read():
+            # env-ok: fixture exercises the knob REGISTRY, not parsing
+            raw = os.environ.get("DMLC_B")
+            return (env_int("DMLC_A", 5), raw)
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# Pass 4d: wire-protocol channel words
+# ---------------------------------------------------------------------------
+
+def test_wire_word_collision_flagged(tmp_path):
+    write_fixture(tmp_path, "wire.py", """\
+        LEASE_ACQUIRE = -90
+        LEASE_RELEASE = -90
+
+        CHANNEL_COMMAND_WORDS = {
+            "LEASE_ACQUIRE": LEASE_ACQUIRE,
+            "LEASE_RELEASE": LEASE_RELEASE,
+        }
+        CHANNEL_SENTINELS = {}
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "collides with" in out.stdout
+
+
+def test_wire_nonnegative_command_word_flagged(tmp_path):
+    write_fixture(tmp_path, "wire.py", """\
+        NEW_CMD = 7
+
+        CHANNEL_COMMAND_WORDS = {
+            "NEW_CMD": NEW_CMD,
+        }
+        CHANNEL_SENTINELS = {}
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "ping space" in out.stdout
+
+
+def test_wire_unregistered_negative_word_flagged(tmp_path):
+    write_fixture(tmp_path, "wire.py", """\
+        LEASE_ACQUIRE = -90
+        SNEAKY_WORD = -97
+
+        CHANNEL_COMMAND_WORDS = {
+            "LEASE_ACQUIRE": LEASE_ACQUIRE,
+        }
+        CHANNEL_SENTINELS = {}
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "SNEAKY_WORD" in out.stdout and "not in" in out.stdout
+
+
+def test_wire_registry_clean_twin(tmp_path):
+    write_fixture(tmp_path, "wire.py", """\
+        HEARTBEAT_PING = 1
+        HEARTBEAT_ABORT = -86
+        LEASE_ACQUIRE = -90
+        LEASE_EMPTY = -1
+
+        CHANNEL_COMMAND_WORDS = {
+            "HEARTBEAT_ABORT": HEARTBEAT_ABORT,
+            "LEASE_ACQUIRE": LEASE_ACQUIRE,
+        }
+        CHANNEL_SENTINELS = {
+            "LEASE_EMPTY": LEASE_EMPTY,
+        }
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_wire_missing_registry_flagged(tmp_path):
+    write_fixture(tmp_path, "wire.py", """\
+        LEASE_ACQUIRE = -90
+        """)
+    out = run_analyze(tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "registry" in out.stdout
+
+
+# ---------------------------------------------------------------------------
 # the repo itself
 # ---------------------------------------------------------------------------
 
