@@ -3,11 +3,11 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
-        parse-lanes telemetry trace cache range fsfault rig pytest \
+        parse-lanes telemetry trace cache range fsfault rig device pytest \
         liveness elastic bench-smoke dryrun doc clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
-    telemetry trace cache range fsfault rig pytest liveness elastic \
+    telemetry trace cache range fsfault rig device pytest liveness elastic \
     dryrun doc
 	@echo "== all CI lanes green =="
 
@@ -67,6 +67,18 @@ range:
 fsfault:
 	$(MAKE) -C cpp asan-fsfault
 	timeout -k 10 300 python3 -m pytest tests/test_fs_fault.py -q
+
+# Device-lane observability (doc/observability.md "Device lane"): the
+# CPU-backend floor of the always-measured device pipeline — span
+# nesting on one clock, overlap ratio bounds, the extended stall-verdict
+# matrix (stage/compile/transfer flips, injected e2e), compile-churn
+# bucket census + clean replay, device_put failure flight dumps, and the
+# bench device lane emitting numbers (device_unavailable is retired).
+# Hard timeout: a hung backend session is exactly the regression this
+# lane exists to catch. JAX_PLATFORMS=cpu pins the deterministic floor.
+device:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	  python3 -m pytest tests/test_device_observability.py -q
 
 # Measurement-rig lane (doc/benchmarking.md): out-of-process origin
 # byte-identity against the in-process mocks for all four backends, a

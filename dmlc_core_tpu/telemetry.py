@@ -44,7 +44,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "HIST_BUCKETS",
            "trace_snapshot", "trace_json", "rank_export",
            "cluster_prometheus_text", "cluster_trace_json",
            "stall_attribution", "VERDICT_CODES", "flight_dump",
-           "quantile_from_buckets", "HostResourceSampler"]
+           "device_overlap_ratio", "quantile_from_buckets",
+           "HostResourceSampler"]
 
 SNAPSHOT_VERSION = 1
 # must match cpp/src/telemetry.h kHistBuckets (le 2^0..2^27, then +Inf)
@@ -527,7 +528,8 @@ def trace_json(snap: Optional[dict] = None) -> str:
 # -- stall attribution (doc/observability.md "Stall attribution") ------------
 # verdict -> stall_verdict_code gauge value
 VERDICT_CODES = {"unknown": -1, "fill_bound": 0, "parse_bound": 1,
-                 "consumer_bound": 2, "transfer_bound": 3}
+                 "consumer_bound": 2, "transfer_bound": 3,
+                 "stage_bound": 4, "compile_bound": 5}
 
 # the consumer counts as the binding stage when it spent less than this
 # fraction of the pipeline's busy time waiting on the head-of-line chunk
@@ -537,12 +539,19 @@ _STARVED_WAIT_FRACTION = 0.05
 
 def stall_attribution(snap: Optional[dict] = None) -> dict:
     """Per-stage occupancy plus a fill-bound / parse-bound /
-    consumer-bound / transfer-bound verdict, derived from the span-backed
-    stage histograms of one snapshot (default: take one now).
+    consumer-bound / transfer-bound / stage-bound / compile-bound
+    verdict, derived from the span-backed stage histograms of one
+    snapshot (default: take one now).
 
-    The decision tree reads the batch path's own instrumentation:
-    ``device_transfer_us`` dominating both fill and parse means the
-    host→HBM hop binds (``transfer_bound``); a small
+    The decision tree reads the batch path's own instrumentation, device
+    lane first (doc/observability.md "Device lane"): XLA compilation time
+    (``device_compile_us``, the jax.monitoring hook) dominating every
+    other stage means shapes are churning (``compile_bound``); the NET
+    host batch-assembly time — ``device_stage_us`` minus the fill/parse/
+    pipeline-wait time nested inside it — dominating means the pad+bucket
+    +pack stage binds (``stage_bound``); ``device_transfer_us``
+    dominating both fill and parse means the host→HBM hop binds
+    (``transfer_bound``). Host side, a small
     ``parse_stage_reassemble_wait_us`` relative to the pipeline's busy
     time means the pipeline kept up and the CONSUMER binds
     (``consumer_bound``); otherwise the consumer was starved by the
@@ -564,15 +573,28 @@ def stall_attribution(snap: Optional[dict] = None) -> dict:
         sums.get("parse_stage_scan_us", 0.0)
     wait = sums.get("parse_stage_reassemble_wait_us", 0.0)
     transfer = sums.get("device_transfer_us", 0.0)
+    # NET batch assembly: device_stage_us wraps batcher.next_batch(),
+    # which nests the parse pipeline's fill/parse/head-of-line time —
+    # subtracting those leaves the pad+bucket+pack cost this stage adds
+    stage = max(sums.get("device_stage_us", 0.0) - fill - parse - wait,
+                0.0)
+    compile_t = sums.get("device_compile_us", 0.0)
+    dev_wait = sums.get("device_wait_us", 0.0)
     busy = fill + parse
     stage_us = {"fill": fill, "parse": parse, "pipeline_wait": wait,
-                "transfer": transfer}
-    total = busy + transfer
+                "transfer": transfer, "stage": stage,
+                "compile": compile_t, "device_wait": dev_wait}
+    total = busy + transfer + stage + compile_t
     occupancy = {k: (stage_us[k] / total if total > 0 else 0.0)
-                 for k in ("fill", "parse", "transfer")}
+                 for k in ("fill", "parse", "transfer", "stage",
+                           "compile")}
     occupancy["pipeline_wait"] = wait / total if total > 0 else 0.0
-    if busy <= 0 and transfer <= 0:
+    if total <= 0:
         verdict = "unknown"
+    elif compile_t > max(transfer, stage, fill, parse):
+        verdict = "compile_bound"
+    elif stage > max(transfer, fill, parse):
+        verdict = "stage_bound"
     elif transfer > max(fill, parse):
         verdict = "transfer_bound"
     elif wait <= _STARVED_WAIT_FRACTION * busy:
@@ -583,6 +605,45 @@ def stall_attribution(snap: Optional[dict] = None) -> dict:
         verdict = "parse_bound"
     return {"verdict": verdict, "stage_us": stage_us,
             "occupancy": occupancy}
+
+
+def device_overlap_ratio(span_list: Optional[List[dict]] = None
+                         ) -> Optional[float]:
+    """Fraction of host→device transfer time hidden behind consumer
+    compute, derived from the Python span ring (default: read it now):
+    each ``device.put`` span's interval is intersected with the merged
+    ``device.wait`` intervals — transfer time the consumer spent WAITING
+    through is exposed, the rest ran while the consumer computed and is
+    hidden. All spans share one ``perf_counter`` clock across threads, so
+    the interval math needs no anchor shifting. Returns a value in
+    [0, 1], or ``None`` when the ring holds no ``device.put`` span (the
+    device lane never ran, or spans are disabled)."""
+    if span_list is None:
+        span_list = spans()
+    xfer = [(s["ts"], s["ts"] + s["dur"]) for s in span_list
+            if s["name"] == "device.put"]
+    if not xfer:
+        return None
+    waits = sorted((s["ts"], s["ts"] + s["dur"]) for s in span_list
+                   if s["name"] == "device.wait")
+    merged: List[List[float]] = []
+    for a, b in waits:
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    total = exposed = 0.0
+    for a, b in xfer:
+        total += b - a
+        for wa, wb in merged:
+            if wa >= b:
+                break
+            lo, hi = max(a, wa), min(b, wb)
+            if hi > lo:
+                exposed += hi - lo
+    if total <= 0:
+        return None
+    return min(max((total - exposed) / total, 0.0), 1.0)
 
 
 # -- flight recorder (doc/observability.md "Flight recorder") ----------------
@@ -807,6 +868,13 @@ def snapshot(native: Optional[bool] = None) -> dict:
                               "labels": {"stage": stage}, "value": frac})
     doc["gauges"].append({"name": "stall_verdict_code", "labels": {},
                           "value": VERDICT_CODES[att["verdict"]]})
+    # same derivation rule for the device lane's overlap ratio: computed
+    # FROM the span ring at snapshot time (doc/observability.md "Device
+    # lane"); -1 marks "no transfer observed yet", keeping 0 meaningful
+    # (a lane that ran fully exposed)
+    ratio = device_overlap_ratio()
+    doc["gauges"].append({"name": "device_overlap_ratio", "labels": {},
+                          "value": -1.0 if ratio is None else ratio})
     return doc
 
 
@@ -880,9 +948,29 @@ METRIC_HELP: Dict[str, str] = {
     "rowblock_batch_us": "one RowBlockIter native block pull (us)",
     "rowblock_batches_total": "row blocks served",
     "rowblock_skipped_batches_total": "on_error=skip skips",
-    "device_transfer_us": "one device_put dispatch (us)",
+    "device_transfer_us": "one device_put, submit to arrays ready (us)",
+    "device_put_submit_us": "the device_put dispatch alone (us)",
+    "device_put_block_us": "dispatch-to-ready DMA wait (us)",
     "device_batches_total": "batches dispatched to the device",
     "device_transfer_bytes_total": "host bytes handed to device_put",
+    "device_stage_us":
+        "one host batch assembly (parse+pad+bucket+pack) on the staging "
+        "thread (us)",
+    "device_wait_us":
+        "consumer head-of-line wait for the next device batch (us)",
+    "device_put_failures_total": "device_put calls that raised",
+    "device_host_q_depth": "staged host batches queued for transfer",
+    "device_ready_q_depth": "device batches queued for the consumer",
+    "device_compile_events_total":
+        "first sight of a device batch shape (one XLA re-trace per "
+        "jitted consumer)",
+    "device_distinct_shapes": "distinct device batch shapes this process",
+    "device_jit_compiles_total":
+        "XLA compilations observed via the jax.monitoring hook",
+    "device_compile_us": "one XLA compilation (us, jax.monitoring)",
+    "device_overlap_ratio":
+        "fraction of transfer time hidden behind consumer compute "
+        "(-1 before any transfer)",
     "device_probe_attempts_total": "bench device-probe subprocess attempts",
     "device_probe_timeouts_total": "bench device-probe attempt timeouts",
     "device_probe_state":
@@ -909,7 +997,8 @@ METRIC_HELP: Dict[str, str] = {
     "stall_stage_occupancy":
         "fraction of instrumented batch-path time in the stage",
     "stall_verdict_code":
-        "-1 unknown, 0 fill, 1 parse, 2 consumer, 3 transfer bound",
+        "-1 unknown, 0 fill, 1 parse, 2 consumer, 3 transfer, 4 stage, "
+        "5 compile bound",
     # measurement rig (scripts/loadrig.py, doc/benchmarking.md)
     "rig_requests_total": "open/closed-loop requests completed",
     "rig_errors_total": "load-generator requests that failed",

@@ -30,6 +30,9 @@ staging thread overlaps parse+pad with XLA compute on the main thread.
 
 from __future__ import annotations
 
+import contextlib
+import json
+import os
 import queue
 import threading
 import time
@@ -48,21 +51,159 @@ from dmlc_core_tpu.io.native import (NativeBatcher, NativeCsrRecBatcher,
                                      _bf16_dtype)
 from dmlc_core_tpu.tpu.sharding import (batch_sharding, packed_batch_sharding)
 
-# transfer-path metric objects resolved ONCE (the registry contract:
+# device-lane metric objects resolved ONCE (the registry contract:
 # resolve, keep the pointer — per-batch re-resolution would take the
-# registry lock on the transfer thread); lazy so importing this module
-# registers nothing
-_transfer_metrics = None
+# registry lock on the staging/transfer threads); lazy so importing this
+# module registers nothing
+_lane_metrics = None
 
 
-def _get_transfer_metrics():
-    global _transfer_metrics
-    if _transfer_metrics is None:
-        _transfer_metrics = (
-            telemetry.histogram("device_transfer_us"),
-            telemetry.counter("device_batches_total"),
-            telemetry.counter("device_transfer_bytes_total"))
-    return _transfer_metrics
+def _get_lane_metrics():
+    global _lane_metrics
+    if _lane_metrics is None:
+        _lane_metrics = {
+            "transfer_us": telemetry.histogram("device_transfer_us"),
+            "submit_us": telemetry.histogram("device_put_submit_us"),
+            "block_us": telemetry.histogram("device_put_block_us"),
+            "stage_us": telemetry.histogram("device_stage_us"),
+            "wait_us": telemetry.histogram("device_wait_us"),
+            "batches": telemetry.counter("device_batches_total"),
+            "bytes": telemetry.counter("device_transfer_bytes_total"),
+            "failures": telemetry.counter("device_put_failures_total"),
+            "host_q": telemetry.gauge("device_host_q_depth"),
+            "ready_q": telemetry.gauge("device_ready_q_depth"),
+            "shapes": telemetry.gauge("device_distinct_shapes"),
+        }
+    return _lane_metrics
+
+
+# -- compile-churn telemetry -------------------------------------------------
+# Process-wide shape census: the jit cache is keyed by the batch tree's
+# structure + leaf shapes/dtypes, so the FIRST sight of a key here is the
+# batch that makes every jitted consumer re-trace. Bucket-policy
+# regressions (min_nnz_bucket too small, a layout flip mid-run) surface
+# as a growing device_compile_events_total{shape=} trail instead of
+# silent re-tracing.
+_shape_lock = threading.Lock()
+_shapes_seen: set = set()
+
+
+def _batch_shape_key(batch) -> str:
+    """Deterministic census key for one host/device batch: every leaf's
+    name + shape (+ the dense dtype, which changes the compiled program).
+    Matches jit-cache granularity for the batch input."""
+    parts = [f"{k}{tuple(v.shape)}" for k, v in sorted(batch.tree().items())]
+    if isinstance(batch, DenseBatch):
+        parts.append(f"x:{np.dtype(batch.x.dtype).name}")
+    return ",".join(parts)
+
+
+# the labeled compile-event trail stops growing the registry past this
+# many distinct shapes (further firsts fold into shape="other"): the
+# pathological churn this metric exists to DETECT would otherwise mint a
+# full leaf-names+shapes label per batch forever, bloating every
+# snapshot, rank_export frame, and /metrics scrape. The
+# device_distinct_shapes gauge stays exact regardless.
+_SHAPE_LABEL_CAP = 64
+
+
+def _note_shape(batch) -> None:
+    key = _batch_shape_key(batch)
+    with _shape_lock:
+        new = key not in _shapes_seen
+        if new:
+            _shapes_seen.add(key)
+        n = len(_shapes_seen)
+    if new:
+        label = key if n <= _SHAPE_LABEL_CAP else "other"
+        telemetry.counter("device_compile_events_total",
+                          {"shape": label}).inc()
+        telemetry.emit_event("device-shape", shape=label, distinct=n)
+    _get_lane_metrics()["shapes"].set(n)
+
+
+def _reset_shape_census() -> None:
+    """Forget every seen shape (tests; the real census is process-wide
+    like the jit cache it mirrors)."""
+    with _shape_lock:
+        _shapes_seen.clear()
+
+
+_monitor_installed = False
+
+
+def _install_compile_monitor() -> None:
+    """Best-effort jax.monitoring hook: XLA compilation events land in
+    the telemetry plane (device_jit_compiles_total / device_compile_us)
+    when this jax exposes duration listeners; the shape census above is
+    the portable fallback either way. Installed once per process, never
+    raises — observability must not sink the lane."""
+    global _monitor_installed
+    if _monitor_installed:
+        return
+    _monitor_installed = True
+    try:
+        from jax import monitoring as _mon
+        compiles = telemetry.counter("device_jit_compiles_total")
+        compile_us = telemetry.histogram("device_compile_us")
+
+        def _on_duration(event, duration, **_kw):
+            # jax emits several phases per compilation (jaxpr trace,
+            # mlir lower, backend compile) — every phase's duration
+            # lands in the histogram, but only the backend_compile
+            # event counts as ONE compilation
+            if "compil" in event:
+                compile_us.observe(duration * 1e6)
+                if "backend_compile" in event:
+                    compiles.inc()
+
+        _mon.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def jax_profiler_capture():
+    """Optional XLA-timeline capture, wall-clock-anchored to our
+    Chrome-trace export: with ``DMLC_JAX_PROFILE=<dir>`` set, wraps the
+    body in ``jax.profiler.start_trace/stop_trace`` and writes
+    ``<dir>/dmlc_anchor_<pid>.json`` holding this process's (wall,
+    monotonic) clock-anchor pairs at start and stop — the same anchors
+    ``telemetry.trace_json()`` shifts by, so the XLA timeline and the
+    ``/trace`` span timeline line up on one wall clock. Yields True when
+    a capture is running, False otherwise (env unset, or the profiler
+    refused — profiling must never sink the lane; every failure is
+    swallowed)."""
+    out_dir = os.environ.get("DMLC_JAX_PROFILE")
+    if not out_dir:
+        yield False
+        return
+    anchors = {"pid": os.getpid(), "start": telemetry.clock_anchor()}
+    started = False
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield started
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        anchors["stop"] = telemetry.clock_anchor()
+        try:
+            path = os.path.join(out_dir,
+                                f"dmlc_anchor_{os.getpid()}.json")
+            with open(path, "w") as f:
+                json.dump(anchors, f)
+            telemetry.emit_event("jax-profile", dir=out_dir,
+                                 started=started)
+        except Exception:
+            pass
 
 
 def _dense_dtype_of(d) -> np.dtype:
@@ -78,7 +219,7 @@ def _dense_dtype_of(d) -> np.dtype:
 
 __all__ = ["PaddedBatch", "DenseBatch", "DeviceRowBlockIter", "HostBatcher",
            "NativeHostBatcher", "DenseRecHostBatcher", "CsrRecHostBatcher",
-           "unpack_tree", "unpack_shard"]
+           "unpack_tree", "unpack_shard", "jax_profiler_capture"]
 
 
 @dataclass
@@ -995,6 +1136,10 @@ class DeviceRowBlockIter:
         # records it so restore() can replay the exact visit order — a
         # batch prefix under a different permutation is different data.
         self._epoch = 0
+        # compile-churn observability: best-effort jax.monitoring
+        # listener (the shape census in _note_shape is the portable
+        # fallback); once per process, never raises
+        _install_compile_monitor()
 
     # -- staging threads -----------------------------------------------------
     # Queue ops are stop-aware: a blocking put/get could otherwise race the
@@ -1041,10 +1186,30 @@ class DeviceRowBlockIter:
                     # discarded host batches never touched the device, so
                     # immediate recycling is safe on any backend
                     self.batcher.recycle(batch)
+            m = _get_lane_metrics()
             while not self._stop.is_set():
-                batch = self.batcher.next_batch()
+                # device.stage: one host batch assembly (parse+pad+bucket
+                # +pinned pack) on the staging thread — perf_counter like
+                # every span clock; gated so DMLC_TELEMETRY=0 costs one
+                # branch here
+                if telemetry.enabled():
+                    t0 = time.perf_counter()
+                    batch = self.batcher.next_batch()
+                    dur_us = (time.perf_counter() - t0) * 1e6
+                    if batch is not None:
+                        m["stage_us"].observe(dur_us)
+                        telemetry.emit_span("device.stage", t0 * 1e6,
+                                            dur_us,
+                                            rows=batch.total_rows)
+                else:
+                    batch = self.batcher.next_batch()
+                if batch is not None:
+                    # compile-churn census: a new shape key here is the
+                    # batch that re-traces every jitted consumer
+                    _note_shape(batch)
                 if not self._put_stop(self._host_q, batch):  # None terminates
                     return
+                m["host_q"].set(self._host_q.qsize())
                 if batch is None:
                     return
         except BaseException as e:  # propagate through the transfer stage
@@ -1058,7 +1223,7 @@ class DeviceRowBlockIter:
             recycle_ok = (self.to_device
                           and hasattr(self.batcher, "recycle")
                           and jax.default_backend() != "cpu")
-            pending = None  # (host, dev) whose DMA may still be in flight
+            m = _get_lane_metrics()
             while not self._stop.is_set():
                 item = self._get_stop(self._host_q)
                 if item is self._SHUTDOWN:
@@ -1070,19 +1235,16 @@ class DeviceRowBlockIter:
                 item = self._device_put(host)
                 if not self._put_stop(self._queue, item):
                     return
+                # double-buffer occupancy, both stages (scrape-time view
+                # of where batches pile up)
+                m["ready_q"].set(self._queue.qsize())
+                m["host_q"].set(self._host_q.qsize())
                 if recycle_ok and item is not host:
-                    # recycle lags one batch so successive device_puts stay
-                    # back-to-back: dispatch batch k, then — only if batch
-                    # k-1's DMA has ALREADY landed (non-blocking check; a
-                    # blocking wait here would stall the pipeline for a
-                    # device round-trip per batch on high-latency links) —
-                    # hand its host buffers back; otherwise the buffers
-                    # just fall to the allocator
-                    if pending is not None and all(
-                            v.is_ready()
-                            for v in pending[1].tree().values()):
-                        self.batcher.recycle(pending[0])
-                    pending = (host, item)
+                    # _device_put blocked until the DMA landed, so the
+                    # host buffers are free the moment the device batch
+                    # is queued — recycling is deterministic, not the old
+                    # opportunistic is_ready() sampling
+                    self.batcher.recycle(host)
         except BaseException as e:
             self._put_stop(self._queue, e)
 
@@ -1100,36 +1262,82 @@ class DeviceRowBlockIter:
         if not self.to_device:
             return batch
         tree = batch.tree()
-        # host->HBM dispatch span for the unified telemetry plane
-        # (doc/observability.md): batch granularity, gated so
-        # DMLC_TELEMETRY=0 costs nothing on the transfer thread
-        t0 = time.perf_counter() if telemetry.enabled() else None
-        if self._leading_sharding is not None:
-            if self.sharding is None or set(self.sharding) != set(tree):
-                self.sharding = {
-                    k: (self._packed_sharding if k in ("aux", "big")
-                        else self._leading_sharding) for k in tree}
-            tree = jax.device_put(tree, self.sharding)
-        else:
-            tree = jax.device_put(tree)
-        if t0 is not None:
-            xfer_us, batches, xfer_bytes = _get_transfer_metrics()
-            dur_us = (time.perf_counter() - t0) * 1e6
-            nbytes = sum(int(v.nbytes) for v in batch.tree().values())
-            xfer_us.observe(dur_us)
-            batches.inc()
-            xfer_bytes.inc(nbytes)
-            # same measurement, second surface: the span ring
-            # (doc/observability.md "Distributed tracing")
-            telemetry.emit_span("device.put", t0 * 1e6, dur_us,
-                                bytes=nbytes)
+        m = _get_lane_metrics()
+        nbytes = sum(int(v.nbytes) for v in tree.values())
+        # host->HBM transfer, measured in its two halves for the unified
+        # telemetry plane (doc/observability.md "Device lane"): SUBMIT
+        # (the device_put dispatch) then BLOCK (dispatch to arrays
+        # ready). Blocking here — not in the consumer — means the queue
+        # hands over READY batches, so device.wait cleanly reads
+        # "staging/transfer behind" and host-buffer recycling is
+        # deterministic; the DMA for batch k still overlaps the
+        # consumer's compute of batch k-1 (the double buffer), and
+        # back-to-back dispatches bought nothing — transfers serialize
+        # on the one host->device stream anyway. Timed spans are gated;
+        # the block itself is unconditional (semantics must not depend
+        # on DMLC_TELEMETRY).
+        tel = telemetry.enabled()
+        try:
+            # the parent span is OPENED (telemetry.span), not emitted
+            # post-hoc, so the submit/block children below genuinely
+            # parent under its id in the ring — offline consumers of the
+            # `parent` field see the nesting, not just Perfetto's
+            # timestamp containment
+            with telemetry.span("device.put", bytes=nbytes):
+                t0 = time.perf_counter() if tel else None
+                if self._leading_sharding is not None:
+                    if self.sharding is None or \
+                            set(self.sharding) != set(tree):
+                        self.sharding = {
+                            k: (self._packed_sharding if k in ("aux", "big")
+                                else self._leading_sharding) for k in tree}
+                    tree = jax.device_put(tree, self.sharding)
+                else:
+                    tree = jax.device_put(tree)
+                t1 = time.perf_counter() if tel else None
+                jax.block_until_ready(list(tree.values()))
+                if t0 is not None:
+                    t2 = time.perf_counter()
+                    m["transfer_us"].observe((t2 - t0) * 1e6)
+                    m["submit_us"].observe((t1 - t0) * 1e6)
+                    m["block_us"].observe((t2 - t1) * 1e6)
+                    # same measurement, second surface: the span ring
+                    # (doc/observability.md "Distributed tracing")
+                    telemetry.emit_span("device.put.submit", t0 * 1e6,
+                                        (t1 - t0) * 1e6)
+                    telemetry.emit_span("device.put.block", t1 * 1e6,
+                                        (t2 - t1) * 1e6)
+        except BaseException:
+            # counted + flight-dumped like host-side aborts (the
+            # postmortem carries the span ring that shows which batch,
+            # how far through the stream, and on what shape it died)
+            m["failures"].inc()
+            telemetry.flight_dump("device-put-failure")
+            raise
+        m["batches"].inc()
+        m["bytes"].inc(nbytes)
         cls = type(batch)
         return cls(total_rows=batch.total_rows, **tree)
 
     def __iter__(self) -> Iterator[PaddedBatch]:
         self._ensure_started()
+        m = _get_lane_metrics()
         while True:
-            item = self._queue.get()
+            # device.wait: consumer head-of-line — the time this thread
+            # stood idle because staging/transfer had not delivered the
+            # next READY batch. The complement of these intervals is the
+            # consumer's compute time, which is what the overlap ratio
+            # (telemetry.device_overlap_ratio) intersects device.put
+            # spans against.
+            if telemetry.enabled():
+                t0 = time.perf_counter()
+                item = self._queue.get()
+                dur_us = (time.perf_counter() - t0) * 1e6
+                m["wait_us"].observe(dur_us)
+                telemetry.emit_span("device.wait", t0 * 1e6, dur_us)
+            else:
+                item = self._queue.get()
+            m["ready_q"].set(self._queue.qsize())
             if item is None:
                 self._thread = None
                 self._xfer_thread = None

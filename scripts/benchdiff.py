@@ -50,12 +50,14 @@ GOOD_LEAVES = {
     "ranged_rows_per_sec", "origin_ceiling_rows_per_sec",
     "mock_ceiling_rows_per_sec", "ranged_vs_sequential",
     "ranged_vs_local", "achieved_qps",
+    "hbm_ingest_rows_per_sec", "overlap_ratio",
 }
 
 # extras entries that are lanes worth carrying into the ledger
 LANE_KEYS = ("cache_lane", "remote_lane", "csv_lane", "libfm_lane",
              "recordio_roundtrip", "rec_lane", "crec_lane", "recd_lane",
-             "host_lane_rates", "thread_scaling", "serving_lane")
+             "host_lane_rates", "thread_scaling", "serving_lane",
+             "device_lane")
 
 
 def lanes_from_extras(extras: dict) -> dict:

@@ -1,0 +1,403 @@
+"""Device-lane flight instruments (doc/observability.md "Device lane").
+
+Covers the ISSUE 15 acceptance surface on the deterministic CPU backend:
+
+- The device spans: a real `DeviceRowBlockIter` run leaves
+  `device.stage` / `device.put` (+ submit/block children) /
+  `device.wait` spans that render nested-or-disjoint per lane on ONE
+  wall clock alongside the native `parse.*` spans.
+- The overlap ratio: in [0, 1] after a run, −1 (sentinel gauge) before
+  any transfer, and exact on hand-built span sets.
+- Stall attribution: the synthetic verdict matrix extended with the
+  device-lane verdicts (`stage_bound`, `compile_bound`, a forced
+  `transfer_bound` with tiny compute), plus BOTH injected e2e flips — a
+  throttled batcher must read `stage_bound`, an injected `device_put`
+  stall `transfer_bound`.
+- Compile-churn telemetry: a growing-nnz corpus crosses exactly the
+  expected power-of-two buckets; replaying the same corpus reports zero
+  new shapes.
+- `_device_put` failures: counted and flight-dumped like host aborts.
+- The bench device lane: emits numbers on this (device-less) host, and
+  two of its ledger records diff cleanly through `benchdiff`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.tpu import device_iter
+from dmlc_core_tpu.tpu.device_iter import (DeviceRowBlockIter,
+                                           jax_profiler_capture)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.reset()
+    telemetry.enable(True)
+    device_iter._reset_shape_census()
+    yield
+    telemetry.reset()
+    telemetry.enable(True)
+    device_iter._reset_shape_census()
+
+
+def write_libsvm(path, rows, features=8, seed=0):
+    rng = random.Random(seed)
+    lines = []
+    for i in range(rows):
+        feats = " ".join(
+            f"{j}:{rng.uniform(-1, 1):.4f}" for j in range(features))
+        lines.append(f"{i % 2} {feats}")
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _run_iter(path, **kw):
+    kw.setdefault("batch_rows", 256)
+    kw.setdefault("min_nnz_bucket", 128)
+    kw.setdefault("layout", "csr")
+    with DeviceRowBlockIter(path, **kw) as it:
+        return sum(b.total_rows for b in it)
+
+
+# -- device spans on one clock ------------------------------------------------
+def test_device_spans_nested_disjoint_with_parse_on_one_clock(tmp_path):
+    path = write_libsvm(tmp_path / "a.libsvm", rows=1500)
+    assert _run_iter(path, nthread=2) == 1500
+    doc = json.loads(telemetry.trace_json())
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in evs}
+    # the full device-lane span catalog, plus the host parse spans, in
+    # ONE merged document
+    assert {"device.stage", "device.put", "device.put.submit",
+            "device.put.block", "device.wait"} <= names, names
+    assert "parse.fill" in names or "batch.fill" in names, names
+    # one wall clock: every merged span within a sane window
+    now_us = time.time() * 1e6
+    for e in evs:
+        assert abs(e["ts"] - now_us) < 300e6, (e["name"], e["ts"])
+        assert e["dur"] >= 0
+    # per-lane ordering (the Perfetto render contract, same check as the
+    # tracing suite): consecutive spans per (pid, tid) lane either nest
+    # inside their predecessor or begin after it ends; 1 ms slack
+    lanes = {}
+    for e in evs:
+        lanes.setdefault(e["tid"], []).append(e)
+    for lane_evs in lanes.values():
+        lane_evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        for a, b in zip(lane_evs, lane_evs[1:]):
+            nested = b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1000
+            disjoint = b["ts"] >= a["ts"] + a["dur"] - 1000
+            assert nested or disjoint, (a, b)
+    # submit/block partition their parent put (within rounding) and
+    # genuinely parent under it in the ring (the `parent` field, not
+    # just timestamp containment)
+    puts = [e for e in evs if e["name"] == "device.put"]
+    subs = [e for e in evs if e["name"] == "device.put.submit"]
+    blocks = [e for e in evs if e["name"] == "device.put.block"]
+    assert len(puts) == len(subs) == len(blocks) >= 2
+    assert all("bytes" in p["args"] for p in puts)
+    put_ids = {p["args"]["span_id"] for p in puts}
+    for child in subs + blocks:
+        assert child["args"]["parent"] in put_ids, child
+
+
+def test_device_stage_spans_carry_rows_and_histograms_fill(tmp_path):
+    path = write_libsvm(tmp_path / "b.libsvm", rows=700)
+    assert _run_iter(path) == 700
+    stages = [s for s in telemetry.spans() if s["name"] == "device.stage"]
+    assert sum(s["args"]["rows"] for s in stages) == 700
+    snap = telemetry.snapshot(native=False)
+    hists = {h["name"]: h for h in snap["histograms"] if not h["labels"]}
+    for name in ("device_stage_us", "device_transfer_us",
+                 "device_put_submit_us", "device_put_block_us",
+                 "device_wait_us"):
+        assert hists[name]["count"] >= 3, name  # 700 rows / 256 batches
+    gauges = {g["name"]: g["value"] for g in snap["gauges"]}
+    assert gauges["device_host_q_depth"] >= 0
+    assert gauges["device_ready_q_depth"] >= 0
+    counters = {c["name"]: c["value"] for c in snap["counters"]
+                if not c["labels"]}
+    assert counters["device_batches_total"] == 3
+    assert counters["device_transfer_bytes_total"] > 0
+
+
+# -- overlap ratio ------------------------------------------------------------
+def test_overlap_ratio_in_unit_interval_after_run(tmp_path):
+    path = write_libsvm(tmp_path / "c.libsvm", rows=2000)
+    assert _run_iter(path) == 2000
+    ratio = telemetry.device_overlap_ratio()
+    assert ratio is not None and 0.0 <= ratio <= 1.0
+    snap = telemetry.snapshot(native=False)
+    gauge = [g["value"] for g in snap["gauges"]
+             if g["name"] == "device_overlap_ratio"]
+    assert gauge and 0.0 <= gauge[0] <= 1.0
+
+
+def test_overlap_ratio_sentinel_and_exact_math():
+    # no device.put spans at all -> None, and the snapshot gauge is -1
+    assert telemetry.device_overlap_ratio() is None
+    snap = telemetry.snapshot(native=False)
+    gauge = [g["value"] for g in snap["gauges"]
+             if g["name"] == "device_overlap_ratio"]
+    assert gauge == [-1.0]
+    # hand-built rings: a transfer fully inside a consumer wait is fully
+    # exposed (ratio 0); fully outside every wait is fully hidden (1);
+    # half-covered is 0.5
+    def ring(xfers, waits):
+        return ([{"name": "device.put", "ts": a, "dur": b - a}
+                 for a, b in xfers]
+                + [{"name": "device.wait", "ts": a, "dur": b - a}
+                   for a, b in waits])
+    assert telemetry.device_overlap_ratio(
+        ring([(10, 20)], [(0, 30)])) == 0.0
+    assert telemetry.device_overlap_ratio(
+        ring([(10, 20)], [(40, 50)])) == 1.0
+    assert telemetry.device_overlap_ratio(
+        ring([(10, 20)], [(15, 25)])) == pytest.approx(0.5)
+    # overlapping wait intervals merge instead of double-subtracting
+    assert telemetry.device_overlap_ratio(
+        ring([(10, 20)], [(8, 15), (12, 18)])) == pytest.approx(0.2)
+
+
+# -- stall attribution: the extended synthetic matrix -------------------------
+def _scenario(fill=0, parse=0, wait=0, transfer=0, stage=0, compile_us=0):
+    hists = [
+        {"name": name, "labels": {}, "count": 1, "sum": s,
+         "buckets": [0] * (telemetry.HIST_BUCKETS + 1)}
+        for name, s in (("parse_stage_fill_us", fill),
+                        ("parse_stage_parse_us", parse),
+                        ("parse_stage_reassemble_wait_us", wait),
+                        ("device_transfer_us", transfer),
+                        ("device_stage_us", stage),
+                        ("device_compile_us", compile_us)) if s]
+    return telemetry.stall_attribution(
+        {"counters": [], "gauges": [], "histograms": hists})
+
+
+def test_stall_verdict_synthetic_matrix_extended():
+    # the four legacy verdicts are untouched (stage/compile both zero)
+    assert _scenario()["verdict"] == "unknown"
+    assert _scenario(9000, 1000, 5000)["verdict"] == "fill_bound"
+    assert _scenario(1000, 9000, 5000)["verdict"] == "parse_bound"
+    assert _scenario(5000, 5000, 100)["verdict"] == "consumer_bound"
+    # forced transfer_bound, tiny compute: the host->HBM hop dominates
+    # even against a busy staging thread (its NET assembly time —
+    # stage minus the nested fill/parse/wait — stays small)
+    att = _scenario(fill=1000, parse=500, wait=800, transfer=9000,
+                    stage=3000)
+    assert att["verdict"] == "transfer_bound"
+    assert att["stage_us"]["stage"] == pytest.approx(700)  # net of nested
+    # forced stage_bound, throttled batcher: assembly dwarfs everything
+    att = _scenario(fill=500, parse=500, wait=0, transfer=1000, stage=9000)
+    assert att["verdict"] == "stage_bound"
+    assert att["occupancy"]["stage"] == pytest.approx(8000 / 10000)
+    # compile_bound: XLA re-tracing dominates every stage
+    att = _scenario(fill=500, parse=500, transfer=1000, stage=2000,
+                    compile_us=20000)
+    assert att["verdict"] == "compile_bound"
+    # every verdict has a stable gauge code
+    for v in ("stage_bound", "compile_bound"):
+        assert v in telemetry.VERDICT_CODES
+    assert telemetry.VERDICT_CODES["stage_bound"] == 4
+    assert telemetry.VERDICT_CODES["compile_bound"] == 5
+
+
+# -- stall attribution: injected e2e flips ------------------------------------
+def test_stall_verdict_stage_bound_under_throttled_batcher(tmp_path):
+    """An injected batcher stall (sleep per staged batch) must flip the
+    verdict to stage_bound: assembly dominates while fill/parse/transfer
+    stay slivers."""
+    path = write_libsvm(tmp_path / "d.libsvm", rows=1200)
+    it = DeviceRowBlockIter(path, batch_rows=128, min_nnz_bucket=64,
+                            layout="csr")
+    orig = it.batcher.next_batch
+
+    def throttled():
+        time.sleep(0.02)  # the pad/bucket/pack stage is the slow one
+        return orig()
+
+    it.batcher.next_batch = throttled
+    try:
+        telemetry.reset()
+        assert sum(b.total_rows for b in it) == 1200
+    finally:
+        it.close()
+    att = telemetry.stall_attribution()
+    assert att["verdict"] == "stage_bound", att
+
+
+def test_stall_verdict_transfer_bound_under_injected_stall(tmp_path,
+                                                           monkeypatch):
+    """An injected device_put stall with tiny (zero) compute must flip
+    the verdict to transfer_bound."""
+    path = write_libsvm(tmp_path / "e.libsvm", rows=1200)
+    real_put = jax.device_put
+
+    def slow_put(tree, *a, **kw):
+        time.sleep(0.02)  # the host->HBM hop is the slow one
+        return real_put(tree, *a, **kw)
+
+    monkeypatch.setattr(jax, "device_put", slow_put)
+    telemetry.reset()
+    assert _run_iter(path, batch_rows=128, min_nnz_bucket=64) == 1200
+    att = telemetry.stall_attribution()
+    assert att["verdict"] == "transfer_bound", att
+
+
+# -- compile-churn telemetry --------------------------------------------------
+def _bucket_of_key(key: str) -> int:
+    # key format: "aux(K, D, R),big(Kb, D, NNZ)" — the big leaf's last
+    # dim is the nnz bucket
+    big = key.split("big(")[1]
+    return int(big.rstrip(")").split(",")[-1])
+
+
+def test_compile_churn_crosses_expected_buckets_and_replays_clean(tmp_path):
+    """A growing-nnz corpus crosses exactly the expected power-of-two
+    buckets; replaying the same corpus reports zero new shapes."""
+    # 64-row batches whose per-batch nnz grows: 1, 2, 4, 8 features per
+    # row -> batch nnz 64, 128, 256, 512 -> buckets (floor 16, pow2)
+    # exactly {64, 128, 256, 512}
+    lines = []
+    for nfeat in (1, 2, 4, 8):
+        for i in range(64):
+            feats = " ".join(f"{j}:1.0" for j in range(nfeat))
+            lines.append(f"{i % 2} {feats}")
+    path = tmp_path / "grow.libsvm"
+    path.write_text("\n".join(lines) + "\n")
+
+    def census():
+        snap = telemetry.snapshot(native=False)
+        # value-filtered: registered-but-zeroed series from earlier
+        # census epochs (telemetry.reset keeps registrations) are not
+        # compile events of THIS corpus
+        events = {c["labels"]["shape"]: c["value"]
+                  for c in snap["counters"]
+                  if c["name"] == "device_compile_events_total"
+                  and c["value"]}
+        shapes = [g["value"] for g in snap["gauges"]
+                  if g["name"] == "device_distinct_shapes"]
+        return events, (shapes[0] if shapes else 0)
+
+    assert _run_iter(str(path), batch_rows=64, min_nnz_bucket=16) == 256
+    events, distinct = census()
+    assert {_bucket_of_key(k) for k in events} == {64, 128, 256, 512}
+    assert len(events) == 4 and distinct == 4
+    assert all(v == 1 for v in events.values())
+    # replay the SAME corpus through a fresh iterator: the census is
+    # process-wide (jit-cache semantics) — zero new shapes, zero new
+    # compile events
+    assert _run_iter(str(path), batch_rows=64, min_nnz_bucket=16) == 256
+    events2, distinct2 = census()
+    assert events2 == events and distinct2 == 4
+
+
+# -- device_put failures ------------------------------------------------------
+def test_device_put_failure_counted_and_flight_dumped(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("DMLC_TRACE_DUMP", str(tmp_path / "dumps"))
+    path = write_libsvm(tmp_path / "f.libsvm", rows=300)
+
+    def exploding_put(tree, *a, **kw):
+        raise RuntimeError("injected transfer failure")
+
+    monkeypatch.setattr(jax, "device_put", exploding_put)
+    with pytest.raises(RuntimeError, match="injected transfer failure"):
+        _run_iter(path)
+    assert telemetry.counter("device_put_failures_total").value >= 1
+    dumps = [f for f in os.listdir(tmp_path / "dumps")
+             if f.startswith("flight_")]
+    assert dumps
+    docs = [json.load(open(tmp_path / "dumps" / f)) for f in dumps]
+    assert any(d["reason"] == "device-put-failure" for d in docs)
+
+
+# -- jax profiler anchoring ---------------------------------------------------
+def test_jax_profiler_capture_writes_clock_anchors(tmp_path, monkeypatch):
+    out = tmp_path / "xprof"
+    monkeypatch.setenv("DMLC_JAX_PROFILE", str(out))
+    with jax_profiler_capture():
+        jax.jit(lambda x: x + 1)(np.ones(4, np.float32)).block_until_ready()
+    anchor_files = [f for f in os.listdir(out)
+                    if f.startswith("dmlc_anchor_")]
+    assert len(anchor_files) == 1
+    doc = json.load(open(out / anchor_files[0]))
+    # both anchor pairs, each the (wall, monotonic) convention /trace
+    # shifts by — what lines the XLA timeline up with our export
+    for k in ("start", "stop"):
+        assert set(doc[k]) == {"wall_us", "perf_us"}
+    assert doc["stop"]["wall_us"] >= doc["start"]["wall_us"]
+
+
+def test_jax_profiler_capture_noop_without_env(monkeypatch):
+    monkeypatch.delenv("DMLC_JAX_PROFILE", raising=False)
+    with jax_profiler_capture() as started:
+        assert started is False
+
+
+# -- the bench device lane ----------------------------------------------------
+@pytest.mark.slow
+def test_bench_device_lane_emits_numbers_on_cpu_floor(tmp_path):
+    """The acceptance pin: the device lane reports populated numbers on
+    a device-less host (CPU floor), never `device_unavailable`."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DMLC_BENCH_HISTORY="0")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--device-lane",
+         "--rows", "4000"],
+        capture_output=True, text=True, timeout=280, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-800:]
+    lane = json.loads(out.stdout.strip().splitlines()[-1])
+    assert lane["backend"] == "cpu"
+    assert lane["hbm_ingest_rows_per_sec"] > 0
+    assert lane["device_transfer_p50_us"] > 0
+    assert lane["device_transfer_p99_us"] >= lane["device_transfer_p50_us"]
+    assert 0.0 <= lane["overlap_ratio"] <= 1.0
+    assert lane["distinct_shapes"] >= 1
+    assert lane["compile_events_total"] >= 1
+    assert lane["steady_new_shapes"] == 0
+    assert "device_unavailable" not in lane
+
+
+def test_benchdiff_compares_two_device_lane_runs(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import benchdiff
+
+    def record(rps, overlap, sha):
+        result = {"metric": "higgs_libsvm_ingest_rows_per_sec",
+                  "value": 100000.0, "unit": "rows/s",
+                  "extras": {"device_lane": {
+                      "hbm_ingest_rows_per_sec": rps,
+                      "overlap_ratio": overlap,
+                      "device_transfer_p50_us": 1024,
+                      "stall_verdict": "stage_bound"}}}
+        return benchdiff.make_record(result, git_sha=sha, ts=1.0)
+
+    history = str(tmp_path / "hist.jsonl")
+    benchdiff.append_record(record(200000.0, 0.8, "a" * 40), history)
+    benchdiff.append_record(record(195000.0, 0.78, "b" * 40), history)
+    # inside the band -> exit 0, and the lane's metrics are compared
+    assert benchdiff.main(["--history", history, "--a", "-2",
+                           "--b", "-1"]) == 0
+    rec = benchdiff.load_history(history)[0]
+    flat = benchdiff.flat_metrics(rec)
+    assert flat["device_lane.hbm_ingest_rows_per_sec"] == 200000.0
+    assert flat["device_lane.overlap_ratio"] == 0.8
+    # a real regression in the lane -> exit 1
+    benchdiff.append_record(record(40000.0, 0.1, "c" * 40), history)
+    assert benchdiff.main(["--history", history, "--a", "-2",
+                           "--b", "-1"]) == 1
