@@ -3,12 +3,12 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
-        parse-lanes telemetry trace cache range fsfault rig device pytest \
-        liveness elastic bench-smoke dryrun doc clean
+        parse-lanes telemetry trace cache range fsfault rig device zerocopy \
+        pytest liveness elastic bench-smoke dryrun doc clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
-    telemetry trace cache range fsfault rig device pytest liveness elastic \
-    dryrun doc
+    telemetry trace cache range fsfault rig device zerocopy pytest liveness \
+    elastic dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -79,6 +79,17 @@ fsfault:
 device:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 	  python3 -m pytest tests/test_device_observability.py -q
+
+# Zero-copy ingest lane (doc/benchmarking.md "Zero-copy ingest"): staging
+# buffers 64-byte aligned (pool reuse included), byte-identity of the
+# zero-copy vs copying device paths for csr/dense x f32/bf16, fallback
+# counter + recycle-skip gauge semantics, sharded placement on a forced
+# multi-device CPU mesh, and the bf16.h <-> ml_dtypes parity fuzz (RNE
+# ties, NaN quieting, subnormals, infinities) across the C/Python
+# boundary. JAX_PLATFORMS=cpu pins the deterministic floor.
+zerocopy:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	  python3 -m pytest tests/test_zero_copy.py -q
 
 # Measurement-rig lane (doc/benchmarking.md): out-of-process origin
 # byte-identity against the in-process mocks for all four backends, a

@@ -723,6 +723,124 @@ def device_lane_probe(rows: int, batch_rows: int = 8192,
         "steady_new_shapes": new_shapes,
         "stall_verdict": att["verdict"],
     }
+
+    # zero-copy ingest bw-util (doc/benchmarking.md "Zero-copy ingest"):
+    # replay the SAME rows from a warm transcoding shard cache
+    # (#cachefile= sugar — epoch 2+ is mmap + one fused shard-major fill
+    # per batch, no text parse) under a light full-touch consumer, so the
+    # measured quantity is the ingest path the zero-copy device_put
+    # serves rather than the text parser or the learner's compute. The
+    # denominator is the best COPYING device_put of the SAME batch
+    # sequence (misaligned_copy pins the probe off the aliasing fast
+    # path), floored by the lane's own best epoch.
+    import shutil
+    import tempfile
+    import numpy as np
+    import jax.numpy as jnp
+    cdir = tempfile.mkdtemp(prefix="dct_bench_zc_")
+    curi = f"{path}#cachefile={cdir}"
+    try:
+        def misaligned_copy(v):
+            # pin the probe tree at 32 (mod 64): np.empty-grade alignment
+            # that can NEVER hit the 64-byte aliasing fast path, so the
+            # denominator deterministically measures the copying transfer
+            # (a luckily-64-aligned np.array copy would alias and report
+            # impossible tens-of-GB/s "copy" bandwidth)
+            raw = np.empty(v.nbytes + 64, np.uint8)
+            off = (32 - raw.ctypes.data) % 64
+            out = raw[off:off + v.nbytes].view(v.dtype).reshape(v.shape)
+            out[...] = v
+            return out
+
+        host_trees = []
+        with DeviceRowBlockIter(curi, batch_rows=batch_rows, mesh=None,
+                                layout="csr", to_device=False) as hit:
+            for b in hit:  # this first pass parses text AND tees the cache
+                host_trees.append({k: misaligned_copy(np.asarray(v))
+                                   for k, v in b.tree().items()})
+        probe_bytes = sum(int(v.nbytes) for t in host_trees
+                          for v in t.values())
+
+        def put_sequence_sample(salt: int) -> float:
+            # one timed COPYING device_put per batch of the epoch — the
+            # denominator moves the SAME batch sequence at the SAME
+            # granularity as the numerator, so the per-dispatch fixed cost
+            # (jax Python dispatch is ~0.2 ms/call on this host, on the
+            # order of the per-batch copy itself) appears on both sides of
+            # the ratio instead of only taxing the numerator. Leaves are
+            # salted before timing so no transfer-dedup layer can serve a
+            # repeat from cache.
+            for t in host_trees:
+                for v in t.values():
+                    flat = v.reshape(-1)
+                    flat[:: max(1, 4096 // max(v.itemsize, 1))] = \
+                        np.asarray(salt, dtype=v.dtype)
+            t0 = time.perf_counter()
+            landed = [jax.device_put(t) for t in host_trees]
+            jax.block_until_ready(
+                [v for t in landed for v in t.values()])
+            return probe_bytes / (time.perf_counter() - t0)
+
+        @jax.jit
+        def consume(tree):
+            # touch every array so the batch is fully materialized
+            return sum(jnp.sum(v.astype(jnp.float32))
+                       for v in tree.values())
+
+        # prefetch=0: the synchronous ingest mode — on this measurement
+        # there is nothing to overlap with (the consumer is the bench
+        # itself), so double-buffer thread wakeups would only add
+        # scheduler noise to the number
+        with DeviceRowBlockIter(curi, batch_rows=batch_rows, mesh=None,
+                                layout="csr", prefetch=0) as it:
+            zc_bytes = 0
+            for b in it:  # warm replay epoch: proves device consumability
+                zc_bytes += sum(int(v.nbytes) for v in b.tree().values())
+                consume(b.tree()).block_until_ready()
+            # timed reps measure the INGEST path only — replay + fused
+            # fill + device_put — mirrored by the denominator probe, a
+            # bare copying device_put of the same batch sequence with no
+            # consumer. Batches leave the pipeline READY (_device_put
+            # blocks before queueing), so draining the iterator IS
+            # bytes-landed-on-device. One epoch is a few milliseconds
+            # here, far below this host's noise floor, so: sample MANY
+            # whole epochs, INTERLEAVED A/B with the denominator's
+            # copying samples (the idiom the telemetry overhead guard
+            # pins) so host drift hits both sides of the ratio alike.
+            # The headline util is MEDIAN/MEDIAN — the sustained ratio;
+            # max-of-N on each side picks extreme order statistics that
+            # need not come from the same machine state, so best/best is
+            # reported alongside as the min-time-estimator view, not as
+            # the headline.
+            zbws, abws = [], []
+            t_start = time.perf_counter()
+            while len(zbws) < 3 * reps or \
+                    time.perf_counter() - t_start < 0.6:
+                it.before_first()
+                t0 = time.perf_counter()
+                for b in it:
+                    pass
+                zbws.append(zc_bytes / (time.perf_counter() - t0))
+                abws.append(put_sequence_sample(len(abws)))
+        landed_bw = statistics.median(zbws)
+        best_bw = max(zbws)
+        attain = max(abws)
+        attain_med = statistics.median(abws)
+        out["hbm_ingest_bw_util"] = round(
+            landed_bw / max(attain_med, landed_bw, 1.0), 4)
+        out["hbm_ingest_bw_util_best"] = round(
+            best_bw / max(attain, best_bw, 1.0), 4)
+        out["zero_copy_bytes_per_sec"] = round(landed_bw, 1)
+        out["attainable_pytree_bytes_per_sec"] = round(attain, 1)
+        snap = telemetry.snapshot(native=False)
+        out["zero_copy_batches_total"] = sum(
+            int(c["value"]) for c in snap["counters"]
+            if c["name"] == "device_zero_copy_batches_total")
+        out["zero_copy_fallbacks_total"] = sum(
+            int(c["value"]) for c in snap["counters"]
+            if c["name"] == "device_zero_copy_fallbacks_total")
+    finally:
+        shutil.rmtree(cdir, ignore_errors=True)
     if profiled:
         out["jax_profile_dir"] = os.environ.get("DMLC_JAX_PROFILE")
     return out
@@ -785,28 +903,31 @@ def attainable_contiguous_bw(sharding, nbytes: int) -> float:
     return best
 
 
-def attainable_pytree_bw(host_tree, sharding) -> float:
-    """Best host->device bandwidth (B/s) for the SAME pytree of arrays the
-    pipeline lands per batch — the honest denominator for bw-util (the
-    per-array dispatch overhead is part of what a real batch pays). Arrays
-    are mutated between reps to defeat transfer caching."""
+def pytree_put_sample(host_tree, sharding, salt: int) -> float:
+    """One timed host->device transfer of the whole pytree: bandwidth in
+    B/s for a single device_put + block_until_ready. Arrays are mutated
+    (`salt`) before the put to defeat transfer caching."""
     import numpy as np
     import jax
     nbytes = sum(int(v.nbytes) for v in host_tree.values())
-    best = 0.0
-    for i in range(3):
-        for v in host_tree.values():
-            flat = v.reshape(-1)
-            flat[:: max(1, 4096 // max(v.itemsize, 1))] = \
-                np.asarray(i, dtype=v.dtype)
-        t0 = time.time()
-        tree = (jax.device_put(host_tree, sharding) if sharding is not None
-                else jax.device_put(host_tree))
-        jax.block_until_ready(list(tree.values()))
-        dt = time.time() - t0
-        best = max(best, nbytes / dt)
-        del tree
-    return best
+    for v in host_tree.values():
+        flat = v.reshape(-1)
+        flat[:: max(1, 4096 // max(v.itemsize, 1))] = \
+            np.asarray(salt, dtype=v.dtype)
+    t0 = time.time()
+    tree = (jax.device_put(host_tree, sharding) if sharding is not None
+            else jax.device_put(host_tree))
+    jax.block_until_ready(list(tree.values()))
+    dt = time.time() - t0
+    del tree
+    return nbytes / dt
+
+
+def attainable_pytree_bw(host_tree, sharding) -> float:
+    """Best host->device bandwidth (B/s) for the SAME pytree of arrays the
+    pipeline lands per batch — the honest denominator for bw-util (the
+    per-array dispatch overhead is part of what a real batch pays)."""
+    return max(pytree_put_sample(host_tree, sharding, i) for i in range(3))
 
 
 def tree_nbytes(batch) -> int:

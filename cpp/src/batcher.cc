@@ -148,73 +148,199 @@ void PaddedBatcher::FillRowArrays(float* label, float* weight,
   }
 }
 
+template <typename CopyVals, typename PadVals>
+void PaddedBatcher::FillShardNnz(uint32_t d, int32_t* rowd, int32_t* cold,
+                                 int32_t* fieldd, CopyVals&& copy_vals,
+                                 PadVals&& pad_vals) {
+  const uint64_t R = batch_rows_ / num_shards_;
+  uint64_t written = 0;
+  const uint64_t lo = d * R;
+  const uint64_t hi = std::min<uint64_t>((d + 1) * R, take_);
+  if (lo < hi) {
+    ForEachRowRange(lo, hi - lo, [&](const Block& b, uint64_t r0,
+                                     uint64_t r1, uint64_t out) {
+      const uint64_t p0 = b.offset[r0];
+      const uint64_t range_nnz = b.offset[r1] - p0;
+      if (range_nnz == 0) return;  // feature-less rows; data() may be
+      // null for empty vectors and memcpy is nonnull-UB
+      // per-nonzero local row segment ids; `out` already walks the
+      // shard-local row space (the walk starts at shard row lo == d*R)
+      for (uint64_t r = r0; r < r1; ++r) {
+        const int32_t local = static_cast<int32_t>(out + (r - r0));
+        const uint64_t l = b.offset[r + 1] - b.offset[r];
+        for (uint64_t k = 0; k < l; ++k) rowd[written + k] = local;
+        written += l;
+      }
+      written -= range_nnz;  // rewind; bulk copies advance it once below
+      // uint32 -> int32 is bit-identical for ids < 2^31 (guarded on
+      // arrival in Accumulate): bulk copy straight from the block
+      std::memcpy(cold + written, b.index.data() + p0,
+                  range_nnz * sizeof(int32_t));
+      copy_vals(b, p0, written, range_nnz);
+      if (fieldd != nullptr) {
+        if (b.field.empty()) {
+          std::memset(fieldd + written, 0, range_nnz * sizeof(int32_t));
+        } else {
+          std::memcpy(fieldd + written, b.field.data() + p0,
+                      range_nnz * sizeof(int32_t));
+        }
+      }
+      written += range_nnz;
+    });
+  }
+  // padding nonzeros land in the sacrificial segment id R, sliced off by
+  // the segment ops (dmlc_core_tpu/ops/sparse.py)
+  for (uint64_t k = written; k < bucket_; ++k) rowd[k] = R;
+  std::memset(cold + written, 0, (bucket_ - written) * sizeof(int32_t));
+  pad_vals(written);
+  if (fieldd != nullptr) {
+    std::memset(fieldd + written, 0, (bucket_ - written) * sizeof(int32_t));
+  }
+}
+
 void PaddedBatcher::FillCSR(int32_t* row, int32_t* col, float* val,
                             float* label, float* weight, int32_t* nrows,
                             int32_t* qid, int32_t* field) {
   DCT_CHECK(staged_) << "FillCSR without a staged batch (call NextMeta)";
   telemetry::TraceSpan trace("batch.fill");
   trace.set_arg(take_);
-  const uint64_t R = batch_rows_ / num_shards_;
   for (uint32_t d = 0; d < num_shards_; ++d) {
     int32_t* rowd = row + d * bucket_;
     int32_t* cold = col + d * bucket_;
     float* vald = val + d * bucket_;
     int32_t* fieldd = field == nullptr ? nullptr : field + d * bucket_;
-    uint64_t written = 0;
-    const uint64_t lo = d * R;
-    const uint64_t hi = std::min<uint64_t>((d + 1) * R, take_);
-    if (lo < hi) {
-      ForEachRowRange(lo, hi - lo, [&](const Block& b, uint64_t r0,
-                                       uint64_t r1, uint64_t out) {
-        const uint64_t p0 = b.offset[r0];
-        const uint64_t range_nnz = b.offset[r1] - p0;
-        if (range_nnz == 0) return;  // feature-less rows; data() may be
-        // null for empty vectors and memcpy is nonnull-UB
-        // per-nonzero local row segment ids; `out` already walks the
-        // shard-local row space (the walk starts at shard row lo == d*R)
-        for (uint64_t r = r0; r < r1; ++r) {
-          const int32_t local = static_cast<int32_t>(out + (r - r0));
-          const uint64_t l = b.offset[r + 1] - b.offset[r];
-          for (uint64_t k = 0; k < l; ++k) rowd[written + k] = local;
-          written += l;
-        }
-        written -= range_nnz;  // rewind; bulk copies advance it once below
-        // uint32 -> int32 is bit-identical for ids < 2^31 (guarded on
-        // arrival in Accumulate): bulk copy straight from the block
-        std::memcpy(cold + written, b.index.data() + p0,
-                    range_nnz * sizeof(int32_t));
-        if (b.value_dtype == 0 && !b.value.empty()) {
-          std::memcpy(vald + written, b.value.data() + p0,
-                      range_nnz * sizeof(float));
-        } else {
-          for (uint64_t k = 0; k < range_nnz; ++k) {
-            vald[written + k] = ValueAt(b, p0 + k);
-          }
-        }
-        if (fieldd != nullptr) {
-          if (b.field.empty()) {
-            std::memset(fieldd + written, 0, range_nnz * sizeof(int32_t));
+    FillShardNnz(
+        d, rowd, cold, fieldd,
+        [&](const Block& b, uint64_t p0, uint64_t w, uint64_t n) {
+          if (b.value_dtype == 0 && !b.value.empty()) {
+            std::memcpy(vald + w, b.value.data() + p0, n * sizeof(float));
           } else {
-            std::memcpy(fieldd + written, b.field.data() + p0,
-                        range_nnz * sizeof(int32_t));
+            for (uint64_t k = 0; k < n; ++k) vald[w + k] = ValueAt(b, p0 + k);
           }
-        }
-        written += range_nnz;
-      });
-    }
-    // padding nonzeros land in the sacrificial segment id R, sliced off by
-    // the segment ops (dmlc_core_tpu/ops/sparse.py)
-    for (uint64_t k = written; k < bucket_; ++k) rowd[k] = R;
-    std::memset(cold + written, 0, (bucket_ - written) * sizeof(int32_t));
-    std::memset(vald + written, 0, (bucket_ - written) * sizeof(float));
-    if (fieldd != nullptr) {
-      std::memset(fieldd + written, 0, (bucket_ - written) * sizeof(int32_t));
-    }
+        },
+        [&](uint64_t w) {
+          std::memset(vald + w, 0, (bucket_ - w) * sizeof(float));
+        });
   }
   if (qid != nullptr) {
     FillQid(qid);
   }
   FillRowArrays(label, weight, nrows);
+  Consume();
+}
+
+void PaddedBatcher::FillRowWisePacked(int32_t* aux, int32_t ka,
+                                      int32_t* nrows) {
+  const uint64_t R = batch_rows_ / num_shards_;
+  for (uint32_t d = 0; d < num_shards_; ++d) {
+    int32_t* auxd = aux + static_cast<uint64_t>(d) * ka * R;
+    float* labeld = reinterpret_cast<float*>(auxd);
+    float* weightd = reinterpret_cast<float*>(auxd + R);
+    int32_t* qidd = ka == 4 ? auxd + 2 * R : nullptr;
+    int32_t* nplane = auxd + static_cast<uint64_t>(ka - 1) * R;
+    const uint64_t lo = d * R;
+    const uint64_t hi =
+        std::max<uint64_t>(lo, std::min<uint64_t>((d + 1) * R, take_));
+    const uint64_t count = hi - lo;
+    if (count > 0) {
+      ForEachRowRange(lo, count, [&](const Block& b, uint64_t r0,
+                                     uint64_t r1, uint64_t out) {
+        std::memcpy(labeld + out, b.label.data() + r0,
+                    (r1 - r0) * sizeof(float));
+        if (b.weight.empty()) {
+          std::fill(weightd + out, weightd + out + (r1 - r0), 1.0f);
+        } else {
+          std::memcpy(weightd + out, b.weight.data() + r0,
+                      (r1 - r0) * sizeof(float));
+        }
+        if (qidd != nullptr) {
+          if (b.qid.empty()) {
+            std::fill(qidd + out, qidd + out + (r1 - r0), -1);
+          } else {
+            for (uint64_t r = r0; r < r1; ++r) {
+              qidd[out + (r - r0)] = static_cast<int32_t>(b.qid[r]);
+            }
+          }
+        }
+      });
+    }
+    // padding rows: weight 0 drops them from the loss, qid -1 keeps them
+    // out of any real group
+    std::memset(labeld + count, 0, (R - count) * sizeof(float));
+    std::memset(weightd + count, 0, (R - count) * sizeof(float));
+    if (qidd != nullptr) std::fill(qidd + count, qidd + R, -1);
+    std::memset(nplane, 0, R * sizeof(int32_t));
+    nplane[0] = static_cast<int32_t>(count);
+    nrows[d] = static_cast<int32_t>(count);
+  }
+}
+
+void PaddedBatcher::FillPacked(int32_t* big, int32_t kb, void* val,
+                               int32_t val_dtype, int32_t* aux, int32_t ka,
+                               int32_t* nrows) {
+  DCT_CHECK(staged_) << "FillPacked without a staged batch (call NextMeta)";
+  DCT_CHECK(val_dtype == 0 || val_dtype == 1)
+      << "packed val dtype must be 0 (float32) or 1 (bfloat16), got "
+      << val_dtype;
+  const int32_t want_kb =
+      2 + (val_dtype == 0 ? 1 : 0) + (have_field_ ? 1 : 0);
+  DCT_CHECK(kb == want_kb)
+      << "packed big has " << kb << " planes but the batch needs " << want_kb;
+  const int32_t want_ka = 3 + (have_qid_ ? 1 : 0);
+  DCT_CHECK(ka == want_ka)
+      << "packed aux has " << ka << " planes but the batch needs " << want_ka;
+  DCT_CHECK(val_dtype == 0 || val != nullptr)
+      << "bf16 packed fill needs a separate val buffer";
+  telemetry::TraceSpan trace("batch.fill");
+  trace.set_arg(take_);
+  for (uint32_t d = 0; d < num_shards_; ++d) {
+    int32_t* based = big + static_cast<uint64_t>(d) * kb * bucket_;
+    int32_t* rowd = based;
+    int32_t* cold = based + bucket_;
+    int32_t* fieldd =
+        have_field_ ? based + static_cast<uint64_t>(kb - 1) * bucket_
+                    : nullptr;
+    if (val_dtype == 0) {
+      float* vald = reinterpret_cast<float*>(based + 2 * bucket_);
+      FillShardNnz(
+          d, rowd, cold, fieldd,
+          [&](const Block& b, uint64_t p0, uint64_t w, uint64_t n) {
+            if (b.value_dtype == 0 && !b.value.empty()) {
+              std::memcpy(vald + w, b.value.data() + p0, n * sizeof(float));
+            } else {
+              for (uint64_t k = 0; k < n; ++k) {
+                vald[w + k] = ValueAt(b, p0 + k);
+              }
+            }
+          },
+          [&](uint64_t w) {
+            std::memset(vald + w, 0, (bucket_ - w) * sizeof(float));
+          });
+    } else {
+      uint16_t* vald =
+          static_cast<uint16_t*>(val) + static_cast<uint64_t>(d) * bucket_;
+      FillShardNnz(
+          d, rowd, cold, fieldd,
+          [&](const Block& b, uint64_t p0, uint64_t w, uint64_t n) {
+            if (b.value_dtype == 0 && !b.value.empty()) {
+              const float* src = b.value.data() + p0;
+              for (uint64_t k = 0; k < n; ++k) {
+                vald[w + k] = Bf16FromFloat(src[k]);
+              }
+            } else {
+              for (uint64_t k = 0; k < n; ++k) {
+                vald[w + k] = Bf16FromFloat(ValueAt(b, p0 + k));
+              }
+            }
+          },
+          [&](uint64_t w) {
+            // bf16 0x0000 is +0.0f, so the zero pad stays byte-identical
+            // with the f32 plane's zero pad after upcast
+            std::memset(vald + w, 0, (bucket_ - w) * sizeof(uint16_t));
+          });
+    }
+  }
+  FillRowWisePacked(aux, ka, nrows);
   Consume();
 }
 
@@ -278,6 +404,27 @@ void PaddedBatcher::FillDense(void* x, int x_dtype, uint64_t num_features,
     FillDenseT(static_cast<float*>(x), num_features);
   }
   FillRowArrays(label, weight, nrows);
+  Consume();
+}
+
+void PaddedBatcher::FillDensePacked(void* x, int x_dtype,
+                                    uint64_t num_features, int32_t* aux,
+                                    int32_t ka, int32_t* nrows) {
+  DCT_CHECK(staged_)
+      << "FillDensePacked without a staged batch (call NextMeta)";
+  DCT_CHECK(x_dtype == 0 || x_dtype == 1)
+      << "dense x dtype must be 0 (float32) or 1 (bfloat16), got " << x_dtype;
+  const int32_t want_ka = 3 + (have_qid_ ? 1 : 0);
+  DCT_CHECK(ka == want_ka)
+      << "packed aux has " << ka << " planes but the batch needs " << want_ka;
+  telemetry::TraceSpan trace("batch.fill");
+  trace.set_arg(take_);
+  if (x_dtype == 1) {
+    FillDenseT(static_cast<uint16_t*>(x), num_features);
+  } else {
+    FillDenseT(static_cast<float*>(x), num_features);
+  }
+  FillRowWisePacked(aux, ka, nrows);
   Consume();
 }
 

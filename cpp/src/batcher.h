@@ -69,6 +69,27 @@ class PaddedBatcher {
   void FillDense(void* x, int x_dtype, uint64_t num_features, float* label,
                  float* weight, int32_t* nrows, int32_t* qid = nullptr);
 
+  // Fused packed-batch fill: ONE pass writes the shard-major transfer
+  // packs the device lane ships as-is, so Python never touches a plane.
+  //   big [D, kb, bucket] int32  per shard: row, col, [val f32 bits when
+  //                              val_dtype==0], [field]
+  //   val [D, bucket] uint16     bf16 values, only when val_dtype==1 (the
+  //                              separate leaf keeps the pack int32-pure)
+  //   aux [D, ka, R] int32       per shard: label bits, weight bits,
+  //                              [qid], nrows plane ([d, last, 0] = shard
+  //                              d's true row count)
+  // kb/ka pin the caller's plane layout (kb = 2 + (val_dtype==0)
+  // + has_field, ka = 3 + has_qid — validated here); nrows [D] is the
+  // host-side copy of the per-shard counts. Writing straight into the
+  // caller's recyclable 64-byte-aligned staging buffers is what makes the
+  // downstream device_put zero-copy (device_iter.py `_device_put`).
+  void FillPacked(int32_t* big, int32_t kb, void* val, int32_t val_dtype,
+                  int32_t* aux, int32_t ka, int32_t* nrows);
+  // Dense twin: x as FillDense, label/weight/qid/nrows fused into the
+  // shard-major aux pack.
+  void FillDensePacked(void* x, int x_dtype, uint64_t num_features,
+                       int32_t* aux, int32_t ka, int32_t* nrows);
+
   void BeforeFirst();
   size_t BytesRead() const { return parser_->BytesRead(); }
   // Pin the shuffle permutation the next BeforeFirst samples (mid-epoch
@@ -92,6 +113,17 @@ class PaddedBatcher {
   void FillDenseT(T* x, uint64_t num_features);  // zero + scatter, typed
   void FillQid(int32_t* qid);  // staged qid column (or the -1 sentinel)
   void FillRowArrays(float* label, float* weight, int32_t* nrows);
+  // One shard's nonzero planes (row segment ids, cols, fields) with the
+  // value store abstracted out: copy_vals(block, p0, written, n) writes n
+  // normalized values, pad_vals(written) zeroes [written, bucket_). Shared
+  // by FillCSR (f32 planes) and FillPacked (f32-in-big or separate bf16).
+  template <typename CopyVals, typename PadVals>
+  void FillShardNnz(uint32_t d, int32_t* rowd, int32_t* cold,
+                    int32_t* fieldd, CopyVals&& copy_vals,
+                    PadVals&& pad_vals);
+  // Shard-major row-wise planes of the packed layout: label/weight bits,
+  // optional qid, and the nrows plane, plus the host-side nrows[D] copy.
+  void FillRowWisePacked(int32_t* aux, int32_t ka, int32_t* nrows);
   void Consume();              // pop the staged rows off the deque
   // nnz of block-local rows [r0, r1)
   static uint64_t RowRangeNnz(const Block& b, uint64_t r0, uint64_t r1) {

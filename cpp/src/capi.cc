@@ -22,6 +22,7 @@
 #include <string>
 
 #include "batcher.h"
+#include "bf16.h"
 #include "csr_rec.h"
 #include "dense_rec.h"
 #include "filesys.h"
@@ -683,6 +684,28 @@ int dct_batcher_fill_dense(dct_batcher_t h, void* x, int32_t x_dtype,
   });
 }
 
+// Fused shard-major fill (batcher.h FillPacked): big [D, kb, bucket] int32,
+// aux [D, ka, R] int32, optional separate bf16 val plane [D, bucket] when
+// val_dtype == 1 (val may be NULL for val_dtype == 0). One pass writes the
+// transfer packs the device lane ships as-is.
+int dct_batcher_fill_packed(dct_batcher_t h, int32_t* big, int32_t kb,
+                            void* val, int32_t val_dtype, int32_t* aux,
+                            int32_t ka, int32_t* nrows) {
+  return Guard([&] {
+    static_cast<dct::PaddedBatcher*>(h)->FillPacked(big, kb, val, val_dtype,
+                                                    aux, ka, nrows);
+  });
+}
+
+int dct_batcher_fill_dense_packed(dct_batcher_t h, void* x, int32_t x_dtype,
+                                  uint64_t num_features, int32_t* aux,
+                                  int32_t ka, int32_t* nrows) {
+  return Guard([&] {
+    static_cast<dct::PaddedBatcher*>(h)->FillDensePacked(
+        x, x_dtype, num_features, aux, ka, nrows);
+  });
+}
+
 int dct_batcher_before_first(dct_batcher_t h) {
   return Guard([&] { static_cast<dct::PaddedBatcher*>(h)->BeforeFirst(); });
 }
@@ -733,6 +756,15 @@ int dct_denserec_fill(dct_denserec_t h, void* x, int32_t out_dtype,
   return Guard([&] {
     *take = static_cast<dct::DenseRecBatcher*>(h)->Fill(
         x, out_dtype, x_features, label, weight, nrows);
+  });
+}
+
+int dct_denserec_fill_packed(dct_denserec_t h, void* x, int32_t out_dtype,
+                             uint64_t x_features, int32_t* aux, int32_t ka,
+                             int32_t* nrows, uint64_t* take) {
+  return Guard([&] {
+    *take = static_cast<dct::DenseRecBatcher*>(h)->FillPacked(
+        x, out_dtype, x_features, aux, ka, nrows);
   });
 }
 
@@ -791,6 +823,15 @@ int dct_csrrec_fill(dct_csrrec_t h, int32_t* row, int32_t* col, float* val,
   });
 }
 
+int dct_csrrec_fill_packed(dct_csrrec_t h, int32_t* big, int32_t kb,
+                           int32_t* aux, int32_t ka, int32_t* nrows,
+                           uint64_t* take) {
+  return Guard([&] {
+    *take = static_cast<dct::CsrRecBatcher*>(h)->FillPacked(big, kb, aux, ka,
+                                                            nrows);
+  });
+}
+
 int dct_csrrec_before_first(dct_csrrec_t h) {
   return Guard([&] { static_cast<dct::CsrRecBatcher*>(h)->BeforeFirst(); });
 }
@@ -810,6 +851,23 @@ int dct_csrrec_bytes_read(dct_csrrec_t h, size_t* out) {
 
 int dct_csrrec_free(dct_csrrec_t h) {
   return Guard([&] { delete static_cast<dct::CsrRecBatcher*>(h); });
+}
+
+// ------------------------------------------------------------------- bf16 --
+// Bulk bf16 conversion hooks (bf16.h): the parity surface the Python tests
+// fuzz against ml_dtypes.bfloat16 — the SAME inlines the batch fills use,
+// so a rounding drift there fails the parity test here.
+
+int dct_bf16_convert(const float* src, uint16_t* dst, uint64_t n) {
+  return Guard([&] {
+    for (uint64_t i = 0; i < n; ++i) dst[i] = dct::Bf16FromFloat(src[i]);
+  });
+}
+
+int dct_bf16_upcast(const uint16_t* src, float* dst, uint64_t n) {
+  return Guard([&] {
+    for (uint64_t i = 0; i < n; ++i) dst[i] = dct::Bf16ToFloat(src[i]);
+  });
 }
 
 }  // extern "C"

@@ -154,6 +154,49 @@ uint64_t CsrRecBatcher::Fill(int32_t* row, int32_t* col, float* val,
   DCT_CHECK(has_qid_ <= 0 || qid != nullptr)
       << "csr rec file carries qid but no qid plane was passed";
   const uint64_t R = batch_rows_ / num_shards_;
+  Targets t;
+  t.row = row;
+  t.col = col;
+  t.val = val;
+  t.field = field;
+  t.nnz_stride = bucket_;
+  t.label = label;
+  t.weight = weight;
+  t.qid = qid;
+  t.nrows_plane = nullptr;
+  t.row_stride = R;
+  return FillImpl(t, nrows);
+}
+
+uint64_t CsrRecBatcher::FillPacked(int32_t* big, int32_t kb, int32_t* aux,
+                                   int32_t ka, int32_t* nrows) {
+  Peek();
+  DCT_CHECK(has_weight_ >= 0)
+      << "csr rec source is empty; cannot determine the batch shape";
+  const int32_t want_kb = 3 + (has_field_ == 1 ? 1 : 0);
+  DCT_CHECK(kb == want_kb)
+      << "packed big has " << kb << " planes but the file needs " << want_kb;
+  const int32_t want_ka = 3 + (has_qid_ == 1 ? 1 : 0);
+  DCT_CHECK(ka == want_ka)
+      << "packed aux has " << ka << " planes but the file needs " << want_ka;
+  const uint64_t R = batch_rows_ / num_shards_;
+  const uint64_t B = bucket_;
+  Targets t;
+  t.row = big;
+  t.col = big + B;
+  t.val = reinterpret_cast<float*>(big + 2 * B);
+  t.field = has_field_ == 1 ? big + 3 * B : nullptr;
+  t.nnz_stride = static_cast<uint64_t>(kb) * B;
+  t.label = reinterpret_cast<float*>(aux);
+  t.weight = reinterpret_cast<float*>(aux + R);
+  t.qid = has_qid_ == 1 ? aux + 2 * R : nullptr;
+  t.nrows_plane = aux + static_cast<uint64_t>(ka - 1) * R;
+  t.row_stride = static_cast<uint64_t>(ka) * R;
+  return FillImpl(t, nrows);
+}
+
+uint64_t CsrRecBatcher::FillImpl(const Targets& t, int32_t* nrows) {
+  const uint64_t R = batch_rows_ / num_shards_;
   const uint64_t B = bucket_;
   uint64_t filled = 0;                   // rows placed into this batch
   uint64_t shard_written = 0;            // nnz in the current shard's plane
@@ -170,7 +213,7 @@ uint64_t CsrRecBatcher::Fill(int32_t* row, int32_t* col, float* val,
                                  rec_rows_ - row_in_rec_});
     // single pass over the span's row lengths: expand local segment ids
     // and count the span's nnz
-    int32_t* rowd = row + static_cast<uint64_t>(d) * B;
+    int32_t* rowd = t.row + static_cast<uint64_t>(d) * t.nnz_stride;
     uint64_t span_nnz = 0;
     const uint64_t local0 = filled - static_cast<uint64_t>(d) * R;
     for (uint64_t i = 0; i < n; ++i) {
@@ -187,30 +230,33 @@ uint64_t CsrRecBatcher::Fill(int32_t* row, int32_t* col, float* val,
     DCT_CHECK(nnz_in_rec_ + span_nnz <= rec_nnz_)
         << "csr rec row lengths overrun the record's nnz";
     // bulk copies: the span's col/val[/field] are contiguous on disk
-    CopyWords32LE(col + static_cast<uint64_t>(d) * B + shard_written,
-             cols_ + nnz_in_rec_ * 4, span_nnz);
-    CopyWords32LE(val + static_cast<uint64_t>(d) * B + shard_written,
-             vals_ + nnz_in_rec_ * 4, span_nnz);
-    if (field != nullptr) {
+    CopyWords32LE(t.col + static_cast<uint64_t>(d) * t.nnz_stride +
+                      shard_written,
+                  cols_ + nnz_in_rec_ * 4, span_nnz);
+    CopyWords32LE(t.val + static_cast<uint64_t>(d) * t.nnz_stride +
+                      shard_written,
+                  vals_ + nnz_in_rec_ * 4, span_nnz);
+    if (t.field != nullptr) {
+      int32_t* fieldw = t.field + static_cast<uint64_t>(d) * t.nnz_stride +
+                        shard_written;
       if (fields_ != nullptr) {
-        CopyWords32LE(field + static_cast<uint64_t>(d) * B + shard_written,
-                 fields_ + nnz_in_rec_ * 4, span_nnz);
+        CopyWords32LE(fieldw, fields_ + nnz_in_rec_ * 4, span_nnz);
       } else {
-        std::memset(field + static_cast<uint64_t>(d) * B + shard_written, 0,
-                    span_nnz * 4);
+        std::memset(fieldw, 0, span_nnz * 4);
       }
     }
-    CopyWords32LE(label + filled, labels_ + row_in_rec_ * 4, n);
+    const uint64_t roff = static_cast<uint64_t>(d) * t.row_stride + local0;
+    CopyWords32LE(t.label + roff, labels_ + row_in_rec_ * 4, n);
     if (weights_ != nullptr) {
-      CopyWords32LE(weight + filled, weights_ + row_in_rec_ * 4, n);
+      CopyWords32LE(t.weight + roff, weights_ + row_in_rec_ * 4, n);
     } else {
-      for (uint64_t i = 0; i < n; ++i) weight[filled + i] = 1.0f;
+      for (uint64_t i = 0; i < n; ++i) t.weight[roff + i] = 1.0f;
     }
-    if (qid != nullptr) {
+    if (t.qid != nullptr) {
       if (qids_ != nullptr) {
-        CopyWords32LE(qid + filled, qids_ + row_in_rec_ * 4, n);
+        CopyWords32LE(t.qid + roff, qids_ + row_in_rec_ * 4, n);
       } else {
-        for (uint64_t i = 0; i < n; ++i) qid[filled + i] = -1;
+        for (uint64_t i = 0; i < n; ++i) t.qid[roff + i] = -1;
       }
     }
     shard_written += span_nnz;
@@ -222,13 +268,12 @@ uint64_t CsrRecBatcher::Fill(int32_t* row, int32_t* col, float* val,
       for (uint64_t k = shard_written; k < B; ++k) {
         rowd[k] = static_cast<int32_t>(R);  // sacrificial segment
       }
-      std::memset(col + static_cast<uint64_t>(d) * B + shard_written, 0,
-                  (B - shard_written) * 4);
-      std::memset(val + static_cast<uint64_t>(d) * B + shard_written, 0,
-                  (B - shard_written) * 4);
-      if (field != nullptr) {
-        std::memset(field + static_cast<uint64_t>(d) * B + shard_written, 0,
-                    (B - shard_written) * 4);
+      const uint64_t off = static_cast<uint64_t>(d) * t.nnz_stride +
+                           shard_written;
+      std::memset(t.col + off, 0, (B - shard_written) * 4);
+      std::memset(t.val + off, 0, (B - shard_written) * 4);
+      if (t.field != nullptr) {
+        std::memset(t.field + off, 0, (B - shard_written) * 4);
       }
     }
   }
@@ -236,42 +281,49 @@ uint64_t CsrRecBatcher::Fill(int32_t* row, int32_t* col, float* val,
   // data ended mid-shard: the loop's pad-on-complete never ran for it
   if (filled % R != 0) {
     const uint32_t d = static_cast<uint32_t>(filled / R);
-    int32_t* rowd = row + static_cast<uint64_t>(d) * B;
+    int32_t* rowd = t.row + static_cast<uint64_t>(d) * t.nnz_stride;
     for (uint64_t k = shard_written; k < B; ++k) {
       rowd[k] = static_cast<int32_t>(R);
     }
-    std::memset(col + static_cast<uint64_t>(d) * B + shard_written, 0,
-                (B - shard_written) * 4);
-    std::memset(val + static_cast<uint64_t>(d) * B + shard_written, 0,
-                (B - shard_written) * 4);
-    if (field != nullptr) {
-      std::memset(field + static_cast<uint64_t>(d) * B + shard_written, 0,
-                  (B - shard_written) * 4);
+    const uint64_t off = static_cast<uint64_t>(d) * t.nnz_stride +
+                         shard_written;
+    std::memset(t.col + off, 0, (B - shard_written) * 4);
+    std::memset(t.val + off, 0, (B - shard_written) * 4);
+    if (t.field != nullptr) {
+      std::memset(t.field + off, 0, (B - shard_written) * 4);
     }
   }
   // pad wholly-empty shards and the row-wise tails
   const uint32_t first_empty =
       static_cast<uint32_t>((filled + R - 1) / R);
   for (uint32_t d = first_empty; d < num_shards_; ++d) {
-    int32_t* rowd = row + static_cast<uint64_t>(d) * B;
+    int32_t* rowd = t.row + static_cast<uint64_t>(d) * t.nnz_stride;
     for (uint64_t k = 0; k < B; ++k) rowd[k] = static_cast<int32_t>(R);
-    std::memset(col + static_cast<uint64_t>(d) * B, 0, B * 4);
-    std::memset(val + static_cast<uint64_t>(d) * B, 0, B * 4);
-    if (field != nullptr) {
-      std::memset(field + static_cast<uint64_t>(d) * B, 0, B * 4);
-    }
-  }
-  if (filled < batch_rows_) {
-    std::memset(label + filled, 0, (batch_rows_ - filled) * 4);
-    std::memset(weight + filled, 0, (batch_rows_ - filled) * 4);
-    if (qid != nullptr) {
-      for (uint64_t i = filled; i < batch_rows_; ++i) qid[i] = -1;
+    const uint64_t off = static_cast<uint64_t>(d) * t.nnz_stride;
+    std::memset(t.col + off, 0, B * 4);
+    std::memset(t.val + off, 0, B * 4);
+    if (t.field != nullptr) {
+      std::memset(t.field + off, 0, B * 4);
     }
   }
   for (uint32_t d = 0; d < num_shards_; ++d) {
     const int64_t left = static_cast<int64_t>(filled) - d * R;
-    nrows[d] = static_cast<int32_t>(
+    const uint64_t count = static_cast<uint64_t>(
         std::max<int64_t>(0, std::min<int64_t>(left, R)));
+    const uint64_t roff = static_cast<uint64_t>(d) * t.row_stride;
+    if (count < R) {  // padding rows: weight 0 drops them from the loss
+      std::memset(t.label + roff + count, 0, (R - count) * 4);
+      std::memset(t.weight + roff + count, 0, (R - count) * 4);
+      if (t.qid != nullptr) {
+        for (uint64_t i = count; i < R; ++i) t.qid[roff + i] = -1;
+      }
+    }
+    if (t.nrows_plane != nullptr) {
+      int32_t* nplane = t.nrows_plane + roff;
+      std::memset(nplane, 0, R * 4);
+      nplane[0] = static_cast<int32_t>(count);
+    }
+    nrows[d] = static_cast<int32_t>(count);
   }
   return filled;
 }

@@ -57,6 +57,15 @@ class CsrRecBatcher {
   uint64_t Fill(int32_t* row, int32_t* col, float* val, int32_t* field,
                 float* label, float* weight, int32_t* qid, int32_t* nrows);
 
+  // Fused shard-major fill (PaddedBatcher::FillPacked layout, f32 values
+  // in-pack since the record stores f32): big is [num_shards, kb, bucket]
+  // int32 (row, col, val bits, [field]), aux is [num_shards, ka, R] int32
+  // (label bits, weight bits, [qid], nrows plane). kb must be
+  // 3 + has_field, ka must be 3 + has_qid. Returns the true row count;
+  // 0 at end.
+  uint64_t FillPacked(int32_t* big, int32_t kb, int32_t* aux, int32_t ka,
+                      int32_t* nrows);
+
   void BeforeFirst();
   size_t BytesRead() const { return bytes_read_; }
   bool SetShuffleEpoch(unsigned epoch) {
@@ -64,6 +73,24 @@ class CsrRecBatcher {
   }
 
  private:
+  // Shard-0 plane bases + per-shard element strides; Fill (stride = one
+  // plane) and FillPacked (stride = all of a shard's planes) are the same
+  // walk over different addressing. Spans never cross shard boundaries
+  // (the fill loop clamps to R*(d+1)), so `base + d*stride + local` is
+  // safe for both.
+  struct Targets {
+    int32_t* row;
+    int32_t* col;
+    float* val;
+    int32_t* field;        // null to skip
+    uint64_t nnz_stride;   // per-shard stride of the nnz planes (elements)
+    float* label;
+    float* weight;
+    int32_t* qid;          // null to skip
+    int32_t* nrows_plane;  // null for the legacy split-plane layout
+    uint64_t row_stride;   // per-shard stride of the row-wise planes
+  };
+  uint64_t FillImpl(const Targets& t, int32_t* nrows);
   bool AdvanceRecord();  // load + validate the next record; false at end
   void Peek();           // ensure the first record's header is parsed
 

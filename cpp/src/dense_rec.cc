@@ -197,6 +197,70 @@ uint64_t DenseRecBatcher::Fill(void* x, int out_dtype, uint64_t x_features,
   return filled;
 }
 
+uint64_t DenseRecBatcher::FillPacked(void* x, int out_dtype,
+                                     uint64_t x_features, int32_t* aux,
+                                     int32_t ka, int32_t* nrows) {
+  DCT_CHECK(out_dtype == 0 || out_dtype == 1)
+      << "dense x dtype must be 0 (float32) or 1 (bfloat16), got "
+      << out_dtype;
+  DCT_CHECK(ka == 3) << "packed aux has " << ka
+                     << " planes but the dense rec layout needs 3";
+  Peek();
+  DCT_CHECK(x_dtype_ < 0 || x_features == num_features_)
+      << "x buffer is " << x_features << " features wide but the dense rec "
+      << "file carries " << num_features_ << " (allocate via meta())";
+  const uint64_t F = num_features_;
+  const uint64_t out_esz = out_dtype == 1 ? 2 : 4;
+  const uint64_t disk_esz = x_dtype_ == 1 ? 2 : 4;
+  const uint64_t R = batch_rows_ / num_shards_;
+  uint64_t filled = 0;
+  char* xb = static_cast<char*>(x);
+  while (filled < batch_rows_) {
+    if (!have_record_ || row_in_rec_ >= rec_rows_) {
+      if (eof_ || !AdvanceRecord()) break;
+      if (rec_rows_ == 0) continue;  // empty record: skip
+    }
+    const uint32_t d = static_cast<uint32_t>(filled / R);
+    // rows until the shard boundary, batch end, or record end: row-wise
+    // writes land in per-shard aux planes, so a span must not cross shards
+    const uint64_t n = std::min({R * (d + 1) - filled, batch_rows_ - filled,
+                                 rec_rows_ - row_in_rec_});
+    int32_t* auxd = aux + static_cast<uint64_t>(d) * ka * R;
+    const uint64_t local0 = filled - static_cast<uint64_t>(d) * R;
+    CopyWords32LE(auxd + local0, labels_ + row_in_rec_ * 4, n);
+    if (weights_ != nullptr) {
+      CopyWords32LE(auxd + R + local0, weights_ + row_in_rec_ * 4, n);
+    } else {
+      float* wd = reinterpret_cast<float*>(auxd + R);
+      for (uint64_t i = 0; i < n; ++i) wd[local0 + i] = 1.0f;
+    }
+    CopyX(xb + filled * F * out_esz, out_dtype,
+          x_ + row_in_rec_ * F * disk_esz, x_dtype_, n * F);
+    filled += n;
+    row_in_rec_ += n;
+  }
+  if (filled == 0) return 0;
+  if (filled < batch_rows_) {
+    std::memset(xb + filled * F * out_esz, 0,
+                (batch_rows_ - filled) * F * out_esz);
+  }
+  for (uint32_t d = 0; d < num_shards_; ++d) {
+    const int64_t left = static_cast<int64_t>(filled) - d * R;
+    const uint64_t count = static_cast<uint64_t>(
+        std::max<int64_t>(0, std::min<int64_t>(left, R)));
+    int32_t* auxd = aux + static_cast<uint64_t>(d) * ka * R;
+    if (count < R) {  // weight 0 drops padding rows out of any loss
+      std::memset(auxd + count, 0, (R - count) * 4);
+      std::memset(auxd + R + count, 0, (R - count) * 4);
+    }
+    int32_t* nplane = auxd + 2 * R;
+    std::memset(nplane, 0, R * 4);
+    nplane[0] = static_cast<int32_t>(count);
+    nrows[d] = static_cast<int32_t>(count);
+  }
+  return filled;
+}
+
 void DenseRecBatcher::BeforeFirst() {
   split_->BeforeFirst();
   eof_ = false;

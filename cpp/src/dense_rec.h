@@ -67,6 +67,14 @@ class DenseRecBatcher {
   uint64_t Fill(void* x, int out_dtype, uint64_t x_features, float* label,
                 float* weight, int32_t* nrows);
 
+  // Fused shard-major fill: x exactly as Fill ([batch_rows, F] row-major
+  // IS [num_shards, R, F], already shard-major); label/weight/nrows fused
+  // into aux [num_shards, ka, R] int32 (label bits, weight bits, nrows
+  // plane — ka must be 3, the dense rec format carries no qid). Returns
+  // the true row count; 0 at end.
+  uint64_t FillPacked(void* x, int out_dtype, uint64_t x_features,
+                      int32_t* aux, int32_t ka, int32_t* nrows);
+
   void BeforeFirst();
   size_t BytesRead() const { return bytes_read_; }
   // Pin the shuffle permutation the next BeforeFirst samples (mid-epoch

@@ -201,6 +201,11 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
         "dct_batcher_fill_csr": (i, [vp, vp, vp, vp, vp, vp, vp, vp, vp]),
         "dct_batcher_fill_dense": (i, [vp, vp, c.c_int32, c.c_uint64, vp,
                                        vp, vp, vp]),
+        "dct_batcher_fill_packed": (i, [vp, vp, c.c_int32, vp, c.c_int32,
+                                        vp, c.c_int32, vp]),
+        "dct_batcher_fill_dense_packed": (i, [vp, vp, c.c_int32,
+                                              c.c_uint64, vp, c.c_int32,
+                                              vp]),
         "dct_batcher_before_first": (i, [vp]),
         "dct_batcher_set_epoch": (i, [vp, u, c.POINTER(c.c_int32)]),
         "dct_batcher_bytes_read": (i, [vp, c.POINTER(sz)]),
@@ -212,6 +217,9 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
                                   c.POINTER(c.c_int32)]),
         "dct_denserec_fill": (i, [vp, vp, c.c_int32, c.c_uint64, vp, vp,
                                   vp, c.POINTER(c.c_uint64)]),
+        "dct_denserec_fill_packed": (i, [vp, vp, c.c_int32, c.c_uint64, vp,
+                                         c.c_int32, vp,
+                                         c.POINTER(c.c_uint64)]),
         "dct_denserec_before_first": (i, [vp]),
         "dct_denserec_set_epoch": (i, [vp, u, c.POINTER(c.c_int32)]),
         "dct_denserec_bytes_read": (i, [vp, c.POINTER(sz)]),
@@ -223,10 +231,14 @@ def _declare_signatures(cdll: ctypes.CDLL) -> None:
                                 c.POINTER(c.c_int32)]),
         "dct_csrrec_fill": (i, [vp, vp, vp, vp, vp, vp, vp, vp, vp,
                                 c.POINTER(c.c_uint64)]),
+        "dct_csrrec_fill_packed": (i, [vp, vp, c.c_int32, vp, c.c_int32,
+                                       vp, c.POINTER(c.c_uint64)]),
         "dct_csrrec_before_first": (i, [vp]),
         "dct_csrrec_set_epoch": (i, [vp, u, c.POINTER(c.c_int32)]),
         "dct_csrrec_bytes_read": (i, [vp, c.POINTER(sz)]),
         "dct_csrrec_free": (i, [vp]),
+        "dct_bf16_convert": (i, [vp, vp, c.c_uint64]),
+        "dct_bf16_upcast": (i, [vp, vp, c.c_uint64]),
     }
     for name, (restype, argtypes) in sigs.items():
         fn = getattr(cdll, name)
@@ -1050,6 +1062,52 @@ class NativeBatcher:
             else self._ptr(qid, np.int32, self._batch_rows),
             None if field is None else self._ptr(field, np.int32, nz)))
 
+    def fill_packed(self, big: np.ndarray, aux: np.ndarray,
+                    nrows: np.ndarray,
+                    val: Optional[np.ndarray] = None) -> None:
+        """Fused shard-major fill (batcher.h FillPacked): ``big`` is
+        [D, kb, bucket] int32 (row, col, [val f32 bits], [field]), ``aux``
+        is [D, ka, R] int32 (label bits, weight bits, [qid], nrows plane).
+        Passing a separate bfloat16 ``val`` plane [D, bucket] converts
+        values natively and drops big's f32 val plane. One GIL-free pass
+        writes the transfer pack the device lane ships as-is."""
+        D = self._num_shards
+        R = self._batch_rows // D
+        kb = big.shape[1]
+        ka = aux.shape[1]
+        if val is not None and val.dtype != _bf16_dtype():
+            raise DMLCError(
+                f"packed val plane must be bfloat16, got {val.dtype}")
+        _check(lib().dct_batcher_fill_packed(
+            self._h, self._ptr(big, np.int32, D * kb * self._bucket), kb,
+            None if val is None
+            else self._ptr(val, val.dtype, D * self._bucket),
+            0 if val is None else 1,
+            self._ptr(aux, np.int32, D * ka * R), ka,
+            self._ptr(nrows, np.int32, D)))
+
+    def fill_dense_packed(self, x: np.ndarray, aux: np.ndarray,
+                          nrows: np.ndarray) -> None:
+        """Fused dense fill (batcher.h FillDensePacked): x as fill_dense
+        ([rows, F] float32 or bfloat16 — already shard-major); label/
+        weight/[qid]/nrows fused into the shard-major aux pack."""
+        if x.dtype == np.float32:
+            x_dtype = 0
+        elif x.dtype == _bf16_dtype():
+            x_dtype = 1
+        else:
+            raise DMLCError(
+                f"dense fill dtype must be float32 or bfloat16, "
+                f"got {x.dtype}")
+        F = x.shape[-1]
+        D = self._num_shards
+        R = self._batch_rows // D
+        ka = aux.shape[1]
+        _check(lib().dct_batcher_fill_dense_packed(
+            self._h, self._ptr(x, x.dtype, self._batch_rows * F), x_dtype,
+            F, self._ptr(aux, np.int32, D * ka * R), ka,
+            self._ptr(nrows, np.int32, D)))
+
     def fill_dense(self, x: np.ndarray, label: np.ndarray,
                    weight: np.ndarray, nrows: np.ndarray,
                    qid: Optional[np.ndarray] = None) -> None:
@@ -1167,6 +1225,26 @@ class NativeCsrRecBatcher:
             ptr(nrows, np.int32, self._num_shards), ctypes.byref(take)))
         return int(take.value)
 
+    def fill_packed(self, big: np.ndarray, aux: np.ndarray,
+                    nrows: np.ndarray) -> int:
+        """Fused shard-major fill (csr_rec.h FillPacked): big is
+        [D, kb, bucket] int32 (row, col, val f32 bits, [field]), aux is
+        [D, ka, R] int32 (label bits, weight bits, [qid], nrows plane).
+        Returns the true row count (0 = end)."""
+        if self._bucket == 0:
+            self.meta()  # plane sizing needs the static bucket
+        D = self._num_shards
+        R = self._batch_rows // D
+        kb = big.shape[1]
+        ka = aux.shape[1]
+        take = ctypes.c_uint64()
+        ptr = NativeBatcher._ptr
+        _check(lib().dct_csrrec_fill_packed(
+            self._h, ptr(big, np.int32, D * kb * self._bucket), kb,
+            ptr(aux, np.int32, D * ka * R), ka,
+            ptr(nrows, np.int32, D), ctypes.byref(take)))
+        return int(take.value)
+
     def before_first(self) -> None:
         """Restart from the first record (new epoch)."""
         _check(lib().dct_csrrec_before_first(self._h))
@@ -1254,6 +1332,31 @@ class NativeDenseRecBatcher:
             ctypes.byref(take)))
         return int(take.value)
 
+    def fill_packed(self, x: np.ndarray, aux: np.ndarray,
+                    nrows: np.ndarray) -> int:
+        """Fused shard-major fill (dense_rec.h FillPacked): x as fill;
+        label/weight/nrows fused into aux [D, 3, R] int32. Returns the
+        true row count (0 = end)."""
+        if x.dtype == np.float32:
+            out_dtype = 0
+        elif x.dtype == _bf16_dtype():
+            out_dtype = 1
+        else:
+            raise DMLCError(
+                f"dense fill dtype must be float32 or bfloat16, "
+                f"got {x.dtype}")
+        F = x.shape[-1]
+        D = self._num_shards
+        R = self._batch_rows // D
+        ka = aux.shape[1]
+        take = ctypes.c_uint64()
+        ptr = NativeBatcher._ptr
+        _check(lib().dct_denserec_fill_packed(
+            self._h, ptr(x, x.dtype, self._batch_rows * F), out_dtype, F,
+            ptr(aux, np.int32, D * ka * R), ka,
+            ptr(nrows, np.int32, D), ctypes.byref(take)))
+        return int(take.value)
+
     def before_first(self) -> None:
         """Restart from the first record (new epoch)."""
         _check(lib().dct_denserec_before_first(self._h))
@@ -1289,3 +1392,26 @@ class NativeDenseRecBatcher:
             self.close()
         except Exception:
             pass
+
+
+# -- bf16 ---------------------------------------------------------------------
+def bf16_convert(src: np.ndarray, dst: np.ndarray) -> None:
+    """Native float32 -> bfloat16 bulk conversion (cpp/src/bf16.h).
+
+    ``dst`` must be a C-contiguous bfloat16 array of ``src.size`` elements.
+    This is the SAME round-to-nearest-even inline the packed batch fills
+    use, exported so the Python parity tests can fuzz it directly against
+    ``ml_dtypes.bfloat16``."""
+    ptr = NativeBatcher._ptr
+    _check(lib().dct_bf16_convert(ptr(src, np.float32, src.size),
+                                  ptr(dst, _bf16_dtype(), src.size),
+                                  src.size))
+
+
+def bf16_upcast(src: np.ndarray, dst: np.ndarray) -> None:
+    """Native bfloat16 -> float32 bulk upcast (cpp/src/bf16.h), the exact
+    widening the device-side bitcast performs."""
+    ptr = NativeBatcher._ptr
+    _check(lib().dct_bf16_upcast(ptr(src, _bf16_dtype(), src.size),
+                                 ptr(dst, np.float32, src.size),
+                                 src.size))

@@ -41,16 +41,14 @@ class DataParallelModel:
 
     def _build_step(self, rows_per_shard: int, keys: tuple):
         axis = self.axis_name
-        # packed leaves (aux/big — device_iter packing) carry the device
-        # axis at position 1; named leaves lead with it
-        tree_keys = [(k, P(None, axis) if k in ("aux", "big") else P(axis))
-                     for k in keys]
+        # every batch leaf is shard-major (device axis leads) since the
+        # device_iter packing migration — packed and named alike
+        tree_keys = [(k, P(axis)) for k in keys]
 
         def shard_view(tree):
             """Drop the device axis and unpack aux/big into named arrays
             (a bitcast+slice — free inside the jitted step)."""
-            local = {k: v[:, 0] if k in ("aux", "big") else v[0]
-                     for k, v in tree.items()}
+            local = {k: v[0] for k, v in tree.items()}
             return unpack_shard(local)
 
         def local_grads(params, shard):
@@ -68,7 +66,10 @@ class DataParallelModel:
                 return self._apply(params, grads, denom), loss_sum / denom
             return jax.jit(step)
 
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.5 jax spells it experimental
+            from jax.experimental.shard_map import shard_map
         mesh = self.mesh
 
         @functools.partial(shard_map, mesh=mesh,
@@ -93,7 +94,7 @@ class DataParallelModel:
         if getattr(self, "_step_fn", None) is None:
             self._step_fn = {}
         tree = batch.tree()
-        D = (tree["aux"].shape[1] if "aux" in tree
+        D = (tree["aux"].shape[0] if "aux" in tree
              else tree["label"].shape[0])
         n_dev = 1 if self.mesh is None else int(self.mesh.devices.size)
         if D != n_dev:

@@ -965,6 +965,15 @@ METRIC_HELP: Dict[str, str] = {
         "first sight of a device batch shape (one XLA re-trace per "
         "jitted consumer)",
     "device_distinct_shapes": "distinct device batch shapes this process",
+    "device_zero_copy_batches_total":
+        "batches transferred by the zero-copy device_put path (staging "
+        "buffers aliased/DMA'd in place, no host copy)",
+    "device_zero_copy_fallbacks_total":
+        "batches that fell back to the copying device_put path, by reason",
+    "device_recycle_skipped":
+        "aliased host staging buffers dropped from the deferred-recycle "
+        "parking lot because the consumer held more batches than its "
+        "depth (zero-copy backends)",
     "device_jit_compiles_total":
         "XLA compilations observed via the jax.monitoring hook",
     "device_compile_us": "one XLA compilation (us, jax.monitoring)",

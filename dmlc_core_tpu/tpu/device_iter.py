@@ -34,8 +34,10 @@ import contextlib
 import json
 import os
 import queue
+import re
 import threading
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, Optional
 
@@ -49,7 +51,8 @@ from dmlc_core_tpu.base import DMLCError
 from dmlc_core_tpu.io.native import (NativeBatcher, NativeCsrRecBatcher,
                                      NativeDenseRecBatcher, NativeParser,
                                      _bf16_dtype)
-from dmlc_core_tpu.tpu.sharding import (batch_sharding, packed_batch_sharding)
+from dmlc_core_tpu.tpu.sharding import batch_sharding
+from dmlc_core_tpu.tracker.wire import env_int
 
 # device-lane metric objects resolved ONCE (the registry contract:
 # resolve, keep the pointer — per-batch re-resolution would take the
@@ -73,6 +76,8 @@ def _get_lane_metrics():
             "host_q": telemetry.gauge("device_host_q_depth"),
             "ready_q": telemetry.gauge("device_ready_q_depth"),
             "shapes": telemetry.gauge("device_distinct_shapes"),
+            "zc_batches": telemetry.counter("device_zero_copy_batches_total"),
+            "recycle_skip": telemetry.gauge("device_recycle_skipped"),
         }
     return _lane_metrics
 
@@ -219,7 +224,8 @@ def _dense_dtype_of(d) -> np.dtype:
 
 __all__ = ["PaddedBatch", "DenseBatch", "DeviceRowBlockIter", "HostBatcher",
            "NativeHostBatcher", "DenseRecHostBatcher", "CsrRecHostBatcher",
-           "unpack_tree", "unpack_shard", "jax_profiler_capture"]
+           "unpack_tree", "unpack_shard", "match_placement_rules",
+           "jax_profiler_capture"]
 
 
 @dataclass
@@ -237,12 +243,18 @@ class PaddedBatch:
     qid/field continue the reference RowBlock's optional columns
     (data.h:174-236) into the device layout.
 
-    Packed transfer layout (native batchers): `big` [Kb, D, NNZ] int32
-    stacks row/col/val(f32 bits)[/field] and `aux` [K, D, R] int32 stacks
-    label(f32 bits)/weight(f32 bits)[/qid]/nrows-plane, so a batch crosses
-    host->HBM in TWO transfers instead of one RPC per leaf — on
-    high-latency links the per-transfer dispatch, not bandwidth, was the
-    recd/rec-lane ceiling (BENCH_r03). Host-side the named fields are
+    Packed transfer layout (native batchers): `big` [D, Kb, NNZ] int32
+    stacks row/col/val(f32 bits)[/field] per shard and `aux` [D, K, R]
+    int32 stacks label(f32 bits)/weight(f32 bits)[/qid]/nrows-plane per
+    shard, so a batch crosses host->HBM in TWO transfers instead of one
+    RPC per leaf — on high-latency links the per-transfer dispatch, not
+    bandwidth, was the recd/rec-lane ceiling (BENCH_r03). The packs are
+    SHARD-MAJOR (device axis leads): under a NamedSharding every shard's
+    bytes are one contiguous leading-axis slice of the host buffer, which
+    is what lets the zero-copy device_put path hand each device its slab
+    without a host gather. With ``csr_val_dtype="bf16"`` values travel as
+    a separate bfloat16 ``val16`` leaf [D, NNZ] (half the value bytes;
+    the int32 pack drops its val plane). Host-side the named fields are
     zero-copy views into the packs; device-side batches carry only the
     packs and consumers unpack INSIDE jit (unpack_shard/unpack_tree)."""
     row: Any = None
@@ -256,8 +268,9 @@ class PaddedBatch:
     total_rows: int = 0
     qid: Any = None
     field: Any = None
-    big: Any = None  # [Kb, D, NNZ] packed row/col/val[/field]
-    aux: Any = None  # [K, D, R] packed label/weight[/qid]/nrows
+    big: Any = None  # [D, Kb, NNZ] packed row/col[/val][/field]
+    aux: Any = None  # [D, K, R] packed label/weight[/qid]/nrows
+    val16: Any = None  # [D, NNZ] bfloat16 values (csr_val_dtype="bf16")
 
     @property
     def rows_per_shard(self) -> int:
@@ -270,9 +283,12 @@ class PaddedBatch:
 
     def tree(self) -> Dict[str, Any]:
         """The batch as a flat dict pytree (the device_put / jit input):
-        the two packed leaves when packed, the named leaves otherwise."""
+        the packed leaves when packed, the named leaves otherwise."""
         if self.aux is not None:
-            return {"big": self.big, "aux": self.aux}
+            t = {"big": self.big, "aux": self.aux}
+            if self.val16 is not None:
+                t["val"] = self.val16
+            return t
         t = {"row": self.row, "col": self.col, "val": self.val,
              "label": self.label, "weight": self.weight,
              "nrows": self.nrows}
@@ -299,7 +315,7 @@ class DenseBatch:
     nrows: Any = None
     total_rows: int = 0
     qid: Any = None  # [D, R] int32 group ids (field has no dense layout)
-    aux: Any = None  # [K, D, R] packed label/weight[/qid]/nrows
+    aux: Any = None  # [D, K, R] packed label/weight[/qid]/nrows
 
     @property
     def rows_per_shard(self) -> int:
@@ -323,42 +339,60 @@ class DenseBatch:
 
 
 # -- packed-batch helpers ----------------------------------------------------
-# aux block order: 0=label (f32 bits), 1=weight (f32 bits), [2=qid],
-# last=nrows plane (entry [d, 0] holds shard d's true row count).
-# big block order: 0=row, 1=col, 2=val (f32 bits), [3=field].
-# Both are int32 containers; float planes travel as raw bits and are
-# bitcast back on device (a dtype-preserving reinterpretation, not a cast).
+# Shard-major packs (device axis LEADS): aux [D, K, R], big [D, Kb, NNZ].
+# Per-shard plane order, aux: 0=label (f32 bits), 1=weight (f32 bits),
+# [2=qid], last=nrows plane (entry [d, -1, 0] holds shard d's true row
+# count). big: 0=row, 1=col, [2=val (f32 bits) unless a separate bf16
+# `val` leaf travels], [last=field]. Both are int32 containers; float
+# planes travel as raw bits and are bitcast back on device (a
+# dtype-preserving reinterpretation, not a cast). Shard-major means shard
+# d's bytes are the contiguous slice pack[d] — the layout the zero-copy
+# sharded device_put path requires.
+
+def _aligned_empty(shape, dtype, align: int = 64) -> np.ndarray:
+    """C-contiguous uninitialised array whose base address is `align`-byte
+    aligned. np.empty only guarantees 16; XLA:CPU aliases (rather than
+    copies) a host buffer on device_put only at 64-byte alignment, so
+    every staging buffer the zero-copy path may hand to device_put is
+    allocated through here."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    raw = np.empty(nbytes + align, np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + nbytes].view(dtype).reshape(shape)
+
 
 def _view_aux(aux: np.ndarray):
-    """Contiguous flat views over an [K, D, R] aux pack that the native
-    fills write directly (label/weight/qid blocks are contiguous D*R runs
-    of the flat buffer — no repacking copy on the staging thread)."""
-    K, D, R = aux.shape
-    flat = aux.reshape(-1)
-    n = D * R
-    label = flat[0:n].view(np.float32)
-    weight = flat[n:2 * n].view(np.float32)
-    qid = flat[2 * n:3 * n] if K == 4 else None
+    """Named [D, R] views over a shard-major [D, K, R] aux pack (the
+    native fills write the pack directly; these are zero-copy strided
+    float32/int32 reinterpretations for host-side consumers)."""
+    D, K, R = aux.shape
+    label = aux[:, 0].view(np.float32)
+    weight = aux[:, 1].view(np.float32)
+    qid = aux[:, 2] if K == 4 else None
     return aux, label, weight, qid
 
 
-def _view_big(big: np.ndarray):
-    """Contiguous row/col/val[/field] planes over a [Kb, D, NNZ] pack
-    (val viewed float32)."""
-    Kb, D, bucket = big.shape
-    flat = big.reshape(-1)
-    n = D * bucket
-    row = flat[0:n].reshape(D, bucket)
-    col = flat[n:2 * n].reshape(D, bucket)
-    val = flat[2 * n:3 * n].view(np.float32).reshape(D, bucket)
-    field = flat[3 * n:4 * n].reshape(D, bucket) if Kb == 4 else None
+def _view_big(big: np.ndarray, has_val: bool = True):
+    """Named [D, NNZ] row/col[/val][/field] views over a shard-major
+    [D, Kb, NNZ] pack (val viewed float32; pass has_val=False when values
+    travel as a separate bf16 leaf and the pack carries no val plane)."""
+    D, Kb, bucket = big.shape
+    row = big[:, 0]
+    col = big[:, 1]
+    if has_val:
+        val = big[:, 2].view(np.float32)
+        field = big[:, 3] if Kb == 4 else None
+    else:
+        val = None
+        field = big[:, 2] if Kb == 3 else None
     return row, col, val, field
 
 
 def _finish_aux(aux, nrows) -> None:
-    """Mirror the [D] nrows vector into the aux nrows plane ([d, 0])."""
-    aux[-1].fill(0)
-    aux[-1, :, 0] = nrows
+    """Mirror the [D] nrows vector into the aux nrows plane ([d, -1, 0])."""
+    aux[:, -1] = 0
+    aux[:, -1, 0] = nrows
 
 
 def _pack_aux(label, weight, qid, nrows, D: int, R: int, emit_qid: bool,
@@ -368,22 +402,21 @@ def _pack_aux(label, weight, qid, nrows, D: int, R: int, emit_qid: bool,
     in-place instead). Reuses `aux` when its shape fits. Returns
     (aux, label_view, weight_view, qid_view) with views shaped [D, R]."""
     K = 4 if emit_qid else 3
-    if aux is None or aux.shape != (K, D, R):
-        aux = np.empty((K, D, R), np.int32)
+    if aux is None or aux.shape != (D, K, R):
+        aux = _aligned_empty((D, K, R), np.int32)
     _, label_v, weight_v, qid_v = _view_aux(aux)
-    label_v[:] = label
-    weight_v[:] = weight
+    label_v[:] = np.asarray(label).reshape(D, R)
+    weight_v[:] = np.asarray(weight).reshape(D, R)
     if qid_v is not None:
-        qid_v[:] = qid
+        qid_v[:] = np.asarray(qid).reshape(D, R)
     _finish_aux(aux, nrows)
-    return (aux, label_v.reshape(D, R), weight_v.reshape(D, R),
-            None if qid_v is None else qid_v.reshape(D, R))
+    return aux, label_v, weight_v, qid_v
 
 
-def _unpack(tree: Dict[str, Any], nrows_of) -> Dict[str, Any]:
-    """Shared aux/big plane decoding; `nrows_of` extracts the nrows vector
-    from the last aux plane (the only shape that differs between the
-    device-axis-ful and per-shard views)."""
+def _unpack(tree: Dict[str, Any], sel, nrows_of) -> Dict[str, Any]:
+    """Shared aux/big plane decoding; `sel(pack, i)` slices plane i of a
+    shard-major pack ([:, i] on full trees, [i] inside a shard_map body)
+    and `nrows_of` extracts the nrows vector from the last aux plane."""
     if "aux" not in tree:
         return tree
     aux = tree["aux"]
@@ -392,16 +425,22 @@ def _unpack(tree: Dict[str, Any], nrows_of) -> Dict[str, Any]:
         out["x"] = tree["x"]
     if "big" in tree:
         big = tree["big"]
-        out["row"] = big[0]
-        out["col"] = big[1]
-        out["val"] = _bitcast_f32(big[2])
-        if big.shape[0] == 4:
-            out["field"] = big[3]
-    out["label"] = _bitcast_f32(aux[0])
-    out["weight"] = _bitcast_f32(aux[1])
-    if aux.shape[0] == 4:
-        out["qid"] = aux[2]
-    out["nrows"] = nrows_of(aux[-1])
+        kb = big.shape[-2]
+        out["row"] = sel(big, 0)
+        out["col"] = sel(big, 1)
+        if "val" in tree:  # separate bf16 value leaf; pack has no val plane
+            out["val"] = tree["val"]
+            if kb == 3:
+                out["field"] = sel(big, 2)
+        else:
+            out["val"] = _bitcast_f32(sel(big, 2))
+            if kb == 4:
+                out["field"] = sel(big, 3)
+    out["label"] = _bitcast_f32(sel(aux, 0))
+    out["weight"] = _bitcast_f32(sel(aux, 1))
+    if aux.shape[-2] == 4:
+        out["qid"] = sel(aux, 2)
+    out["nrows"] = nrows_of(sel(aux, aux.shape[-2] - 1))
     return out
 
 
@@ -410,7 +449,7 @@ def unpack_tree(tree: Dict[str, Any]) -> Dict[str, Any]:
     label/weight/qid [D, R], row/col/val/field [D, NNZ], nrows [D]).
     Identity for already-named trees. Usable under jit (bitcasts and
     slices only) and on host numpy."""
-    return _unpack(tree, lambda plane: plane[:, 0])
+    return _unpack(tree, lambda a, i: a[:, i], lambda plane: plane[:, 0])
 
 
 def unpack_shard(tree: Dict[str, Any]) -> Dict[str, Any]:
@@ -420,7 +459,7 @@ def unpack_shard(tree: Dict[str, Any]) -> Dict[str, Any]:
     device-axis slice, so _shard_loss implementations see one shape
     regardless of how the batch arrived). Identity for already-named
     trees. For use inside shard_map bodies."""
-    return _unpack(tree, lambda plane: plane[0])
+    return _unpack(tree, lambda a, i: a[i], lambda plane: plane[0])
 
 
 def _bitcast_f32(a):
@@ -434,6 +473,64 @@ def _next_pow2(n: int, floor: int) -> int:
     while b < n:
         b <<= 1
     return b
+
+
+# -- spec-driven placement ---------------------------------------------------
+# Leaf-name -> PartitionSpec rules, first match wins (the
+# match_partition_rules idiom from t5x-style partitioning): the transfer
+# thread derives every leaf's NamedSharding from this table instead of
+# hard-coding per-leaf cases. Every batch leaf today is shard-major
+# (device axis leads), so one catch-all leading-axis rule suffices; the
+# table is the extension point for future non-leading layouts (add the
+# specific rule ABOVE the catch-all).
+_PLACEMENT_RULES = (
+    (r".*", lambda axis: jax.sharding.PartitionSpec(axis)),
+)
+
+
+def match_placement_rules(mesh, keys, axis_name: str = "data"):
+    """Per-leaf NamedSharding dict for batch-tree `keys`: each key takes
+    the spec of the first _PLACEMENT_RULES regex that fully matches it."""
+    out = {}
+    for k in keys:
+        for pat, spec_fn in _PLACEMENT_RULES:
+            if re.fullmatch(pat, k):
+                out[k] = jax.sharding.NamedSharding(mesh, spec_fn(axis_name))
+                break
+    return out
+
+
+class _ZeroCopyIneligible(Exception):
+    """A batch (or backend state) the zero-copy device_put path cannot
+    serve; carries the fallback-counter reason label."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _tree_aliases_host(host_tree: Dict[str, Any],
+                       dev_tree: Dict[str, Any]) -> bool:
+    """Whether any device leaf's buffer lives inside its host leaf's
+    memory span — i.e. device_put aliased instead of copied, so recycling
+    the host buffer would corrupt live device data. Probes the actual
+    buffer addresses (unsafe_buffer_pointer) instead of trusting backend
+    names; anything unprobeable is treated as aliasing (recycling is an
+    optimization, correctness must not depend on it)."""
+    try:
+        for k, h in host_tree.items():
+            if not isinstance(h, np.ndarray):
+                continue
+            lo = h.ctypes.data
+            hi = lo + h.nbytes
+            d = dev_tree.get(k)
+            for s in getattr(d, "addressable_shards", ()):
+                p = s.data.unsafe_buffer_pointer()
+                if lo <= p < hi:
+                    return True
+        return False
+    except Exception:
+        return True
 
 
 class _HostBufferPool:
@@ -659,10 +756,10 @@ class HostBatcher:
         pooled = self._pool.pop(("csr", bucket))
         if pooled is not None:
             big, aux_buf = pooled
-            if big.shape[0] != Kb:
+            if big.shape[1] != Kb:
                 big = None
         if big is None:
-            big = np.empty((Kb, D, bucket), np.int32)
+            big = _aligned_empty((D, Kb, bucket), np.int32)
         row, colp, valp, fldp = _view_big(big)
         row[:] = R  # R = padding segment
         colp[:] = 0
@@ -705,7 +802,8 @@ class HostBatcher:
             x, aux_buf = pooled
             x.fill(0)  # the scatter below only touches present entries
         if x is None:
-            x = np.zeros((self.batch_rows, F), dtype=self.dense_dtype)
+            x = _aligned_empty((self.batch_rows, F), self.dense_dtype)
+            x.fill(0)
         row_of = np.repeat(np.arange(self.batch_rows, dtype=np.int64), lens)
         x[row_of, col] = val
         nrows = np.minimum(
@@ -744,12 +842,16 @@ class NativeHostBatcher:
                  fmt: str = "auto", nthread: int = 0,
                  batch_rows: int = 65536, num_shards: int = 1,
                  min_nnz_bucket: int = 4096, layout: str = "auto",
-                 dense_max_features: int = 512, dense_dtype=np.float32):
+                 dense_max_features: int = 512, dense_dtype=np.float32,
+                 csr_val_dtype: str = "f32"):
         if batch_rows % num_shards != 0:
             raise DMLCError(
                 f"batch_rows={batch_rows} must divide by shards={num_shards}")
         if layout not in ("auto", "csr", "dense"):
             raise DMLCError(f"unknown layout {layout!r}")
+        if csr_val_dtype not in ("f32", "bf16"):
+            raise DMLCError(f"unknown csr_val_dtype {csr_val_dtype!r} "
+                            f"(expected 'f32' or 'bf16')")
         self._b = NativeBatcher(uri, part=part, npart=npart, fmt=fmt,
                                 nthread=nthread, batch_rows=batch_rows,
                                 num_shards=num_shards,
@@ -760,6 +862,10 @@ class NativeHostBatcher:
         self.dense_max_features = dense_max_features
         self.dense_dtype = _dense_dtype_of(dense_dtype)
         self._num_features: Optional[int] = None
+        # bf16 CSR values travel as a separate [D, NNZ] bfloat16 leaf and
+        # the int32 pack drops its val plane (the native fill converts
+        # f32->bf16 round-to-nearest-even in the same pass — cpp/src/bf16.h)
+        self._csr_bf16 = csr_val_dtype == "bf16"
         # plane presence pins on the first batch so the emitted pytree
         # structure (and therefore jitted consumers' traces) stays static
         self._emit_qid: Optional[bool] = None
@@ -807,40 +913,43 @@ class NativeHostBatcher:
             else:
                 # the native fill writes float32 or bf16 storage directly
                 # (batcher.h x_dtype) — no astype copy on this thread
-                x = np.empty((self.batch_rows, F), self.dense_dtype)
+                x = _aligned_empty((self.batch_rows, F), self.dense_dtype)
                 aux = None
                 nrows = np.empty(D, np.int32)
-            if aux is None or aux.shape[0] != (4 if has_qid else 3):
-                aux = np.empty((4 if has_qid else 3, D, R), np.int32)
-            _, label, weight, qid = _view_aux(aux)  # float32 flat views
-            self._b.fill_dense(x, label, weight, nrows, qid=qid)
-            _finish_aux(aux, nrows)
+            if aux is None or aux.shape[1] != (4 if has_qid else 3):
+                aux = _aligned_empty((D, 4 if has_qid else 3, R), np.int32)
+            # one fused native pass writes x AND the aux pack (label/weight
+            # [/qid]/nrows planes) — no per-plane fills or _finish_aux here
+            self._b.fill_dense_packed(x, aux, nrows)
+            _, label, weight, qid = _view_aux(aux)
             return DenseBatch(x=x.reshape(D, R, F),
-                              label=label.reshape(D, R),
-                              weight=weight.reshape(D, R),
+                              label=label, weight=weight,
                               nrows=nrows, total_rows=int(take),
-                              qid=None if qid is None
-                              else qid.reshape(D, R), aux=aux)
+                              qid=qid, aux=aux)
+        sep_val = self._csr_bf16
+        Kb = 2 + (0 if sep_val else 1) + (1 if has_field else 0)
         pooled = self._pool_pop(("csr", bucket))
         if pooled is not None:
-            big, aux, nrows = pooled
+            big, val16, aux, nrows = pooled
         else:
-            big, aux, nrows = None, None, np.empty(D, np.int32)
-        if big is None or big.shape[0] != (4 if has_field else 3):
-            big = np.empty((4 if has_field else 3, D, bucket), np.int32)
-        if aux is None or aux.shape[0] != (4 if has_qid else 3):
-            aux = np.empty((4 if has_qid else 3, D, R), np.int32)
-        row, col, val, field = _view_big(big)
-        _, label, weight, qid = _view_aux(aux)  # float32 flat views
-        self._b.fill_csr(row, col, val, label, weight, nrows, qid=qid,
-                         field=field)
-        _finish_aux(aux, nrows)
-        return PaddedBatch(row=row, col=col, val=val,
-                           label=label.reshape(D, R),
-                           weight=weight.reshape(D, R),
+            big, val16, aux = None, None, None
+            nrows = np.empty(D, np.int32)
+        if big is None or big.shape[1] != Kb:
+            big = _aligned_empty((D, Kb, bucket), np.int32)
+        if sep_val and (val16 is None or val16.shape != (D, bucket)):
+            val16 = _aligned_empty((D, bucket), _bf16_dtype())
+        if aux is None or aux.shape[1] != (4 if has_qid else 3):
+            aux = _aligned_empty((D, 4 if has_qid else 3, R), np.int32)
+        # one fused native pass assembles the whole shard-major batch
+        self._b.fill_packed(big, aux, nrows, val=val16 if sep_val else None)
+        row, col, val, field = _view_big(big, has_val=not sep_val)
+        _, label, weight, qid = _view_aux(aux)
+        return PaddedBatch(row=row, col=col,
+                           val=val16 if sep_val else val,
+                           label=label, weight=weight,
                            nrows=nrows, total_rows=int(take),
-                           qid=None if qid is None else qid.reshape(D, R),
-                           field=field, big=big, aux=aux)
+                           qid=qid, field=field, big=big, aux=aux,
+                           val16=val16)
 
     # -- host-buffer recycling ---------------------------------------------
     def _pool_pop(self, key):
@@ -851,8 +960,13 @@ class NativeHostBatcher:
 
         Callers must guarantee the host->device copy has finished (e.g.
         block_until_ready on the device arrays) and that the device arrays
-        do not alias host memory (true on TPU; NOT on the CPU backend,
-        where the caller must skip recycling)."""
+        no longer alias host memory. DeviceRowBlockIter enforces the
+        latter by probing the first transferred batch's device buffer
+        addresses against the host buffers (it no longer assumes which
+        backends alias) and, when they overlap, DEFERRING the recycle
+        behind weakrefs until the consumer drops the device batch
+        (parking-lot overflow drops are counted in
+        device_recycle_skipped)."""
         if getattr(batch, "aux", None) is None or \
                 not isinstance(batch.aux, np.ndarray):
             return  # foreign/device batch; nothing to pool
@@ -864,7 +978,7 @@ class NativeHostBatcher:
                     batch.nrows)
         else:
             key = ("csr", batch.big.shape[-1])
-            arrs = (batch.big, batch.aux, batch.nrows)
+            arrs = (batch.big, batch.val16, batch.aux, batch.nrows)
         self._pool.put(key, arrs)
 
     def reset(self) -> None:
@@ -931,22 +1045,20 @@ class CsrRecHostBatcher:
         if pooled is not None:
             big, aux, nrows = pooled
         else:
-            big = np.empty((4 if has_field else 3, D, bucket), np.int32)
-            aux = np.empty((4 if has_qid else 3, D, R), np.int32)
+            big = _aligned_empty((D, 4 if has_field else 3, bucket),
+                                 np.int32)
+            aux = _aligned_empty((D, 4 if has_qid else 3, R), np.int32)
             nrows = np.empty(D, np.int32)
-        row, col, val, field = _view_big(big)
-        _, label, weight, qid = _view_aux(aux)
-        take = self._b.fill(row, col, val, label, weight, nrows, qid=qid,
-                            field=field)
+        # one fused native pass writes both shard-major packs
+        take = self._b.fill_packed(big, aux, nrows)
         if take == 0:
             return None
-        _finish_aux(aux, nrows)
+        row, col, val, field = _view_big(big)
+        _, label, weight, qid = _view_aux(aux)
         return PaddedBatch(row=row, col=col, val=val,
-                           label=label.reshape(D, R),
-                           weight=weight.reshape(D, R),
+                           label=label, weight=weight,
                            nrows=nrows, total_rows=int(take),
-                           qid=None if qid is None else qid.reshape(D, R),
-                           field=field, big=big, aux=aux)
+                           qid=qid, field=field, big=big, aux=aux)
 
     def reset(self) -> None:
         """Restart from the first record (new epoch); the pool survives."""
@@ -1013,17 +1125,16 @@ class DenseRecHostBatcher:
         if pooled is not None:
             x, aux, nrows = pooled
         else:
-            x = np.empty((self.batch_rows, F), self.dense_dtype)
-            aux = np.empty((3, D, R), np.int32)
+            x = _aligned_empty((self.batch_rows, F), self.dense_dtype)
+            aux = _aligned_empty((D, 3, R), np.int32)
             nrows = np.empty(D, np.int32)
-        _, label, weight, _ = _view_aux(aux)  # float32 flat views
-        take = self._b.fill(x, label, weight, nrows)
+        # one fused native pass writes x and the aux pack
+        take = self._b.fill_packed(x, aux, nrows)
         if take == 0:
             return None
-        _finish_aux(aux, nrows)
+        _, label, weight, _ = _view_aux(aux)
         return DenseBatch(x=x.reshape(D, R, F),
-                          label=label.reshape(D, R),
-                          weight=weight.reshape(D, R),
+                          label=label, weight=weight,
                           nrows=nrows, total_rows=int(take), aux=aux)
 
     def reset(self) -> None:
@@ -1052,6 +1163,13 @@ class DeviceRowBlockIter:
     thread runs parse+pad (double buffer, capacity `prefetch`); the consumer
     thread issues device_put — by the time XLA finishes step k, batch k+1 is
     staged or already on device.
+
+    ``prefetch=0`` runs the whole path synchronously on the caller's
+    thread — no pipeline threads, no queues. The right mode when there is
+    nothing to overlap with (single-core hosts, or calibration benches
+    measuring the ingest path itself): each double-buffer handoff is a
+    thread wakeup that buys nothing there and can cost more than the
+    fused fill it hands over.
     """
 
     def __init__(self, uri: str, part: int = 0, npart: int = 1,
@@ -1060,7 +1178,7 @@ class DeviceRowBlockIter:
                  index64: bool = False, nthread: int = 0,
                  prefetch: int = 2, to_device: bool = True,
                  layout: str = "auto", dense_max_features: int = 512,
-                 dense_dtype=np.float32):
+                 dense_dtype=np.float32, csr_val_dtype: str = "f32"):
         self.mesh = mesh
         self.to_device = to_device
         self.batch_rows = batch_rows
@@ -1078,6 +1196,11 @@ class DeviceRowBlockIter:
         # restores into an iterator built with the explicit format.
         self._identity = {"uri": uri, "part": part, "npart": npart,
                           "fmt": fmt, "batch_rows": batch_rows}
+        if csr_val_dtype != "f32" and (fmt in ("recd", "crec") or index64):
+            raise DMLCError(
+                "csr_val_dtype='bf16' is a native text/rec-lane feature "
+                "(the fused fill converts values in-pass); the crec/drec "
+                "binary lanes and the index64 python batcher keep f32")
         if fmt == "recd":
             # zero-parse dense lane: records already hold device-layout
             # matrices (dense_rec.h); CSR options don't apply
@@ -1109,15 +1232,34 @@ class DeviceRowBlockIter:
                 batch_rows=batch_rows, num_shards=num_shards,
                 min_nnz_bucket=min_nnz_bucket, layout=layout,
                 dense_max_features=dense_max_features,
-                dense_dtype=dense_dtype)
-        # per-leaf sharding (packed leaves carry the device axis at
-        # position 1); materialized lazily from the first batch's tree
-        # structure — exposed for bench probes
+                dense_dtype=dense_dtype, csr_val_dtype=csr_val_dtype)
+        # per-leaf sharding derived from _PLACEMENT_RULES (every leaf is
+        # shard-major, so all take the leading device axis); materialized
+        # lazily from the first batch's tree structure — exposed for
+        # bench probes
         self.sharding = None
         self._leading_sharding = (None if mesh is None
                                   else batch_sharding(mesh))
-        self._packed_sharding = (None if mesh is None
-                                 else packed_batch_sharding(mesh))
+        # zero-copy transfer state: DMLC_DEVICE_ZERO_COPY=0 forces the
+        # copying device_put path. _placements caches, per (leaf, shape),
+        # each device's contiguous leading-axis slice of the host buffer
+        # (None when the derived sharding is not leading-axis slicing).
+        # _recycle_aliases latches whether this backend's device arrays
+        # alias the staging buffers (probed on the first transfer — see
+        # _tree_aliases_host).
+        self._zero_copy = to_device and env_int(
+            "DMLC_DEVICE_ZERO_COPY", 1) != 0
+        self._placements: Dict[Any, Any] = {}
+        self._recycle_aliases: Optional[bool] = None
+        self._recycle_skipped = 0
+        # deferred recycling under aliasing (zero-copy backends): host
+        # buffers whose device arrays read them in place are parked here
+        # behind weakrefs and recycled once the consumer drops the device
+        # batch; overflow drops the oldest entry for real (counted in
+        # device_recycle_skipped). Touched only by the transfer thread OR
+        # the prefetch=0 sync generator, never both.
+        self._deferred: list = []
+        self._deferred_cap = max(4, prefetch * 2)
         self._prefetch = prefetch
         # two-stage pipeline: parse+pad thread -> _host_q -> transfer thread
         # -> _queue -> consumer. Parsing of batch k+1 overlaps the host->HBM
@@ -1217,12 +1359,7 @@ class DeviceRowBlockIter:
 
     def _transfer_loop(self) -> None:
         try:
-            # host buffers may be recycled only when device arrays cannot
-            # alias host memory: on the CPU backend jax.device_put can be
-            # zero-copy, so recycling there would corrupt consumer data
-            recycle_ok = (self.to_device
-                          and hasattr(self.batcher, "recycle")
-                          and jax.default_backend() != "cpu")
+            recycle_ok = self.to_device and hasattr(self.batcher, "recycle")
             m = _get_lane_metrics()
             while not self._stop.is_set():
                 item = self._get_stop(self._host_q)
@@ -1242,11 +1379,52 @@ class DeviceRowBlockIter:
                 if recycle_ok and item is not host:
                     # _device_put blocked until the DMA landed, so the
                     # host buffers are free the moment the device batch
-                    # is queued — recycling is deterministic, not the old
-                    # opportunistic is_ready() sampling
-                    self.batcher.recycle(host)
+                    # is queued — UNLESS the device arrays alias the
+                    # staging memory (zero-copy device_put, any backend
+                    # where host and device share an address space). That
+                    # is probed from the actual buffer addresses of the
+                    # first transferred batch, not assumed from the
+                    # backend name; aliased batches defer recycling
+                    # until the consumer drops the device arrays.
+                    self._recycle_or_defer(host, item, m)
         except BaseException as e:
             self._put_stop(self._queue, e)
+
+    def _recycle_or_defer(self, host, item, m) -> None:
+        """Return `host`'s staging buffers to the batcher pool — directly
+        when the device arrays are independent copies, or DEFERRED when
+        they alias the staging memory (zero-copy backends): the buffers
+        are parked behind weakrefs to the device arrays and recycled on a
+        later sweep, once the consumer has dropped the device batch.
+        Without this, aliasing backends would allocate fresh staging for
+        every batch forever — page-fault and allocator churn that can
+        cost more than the fill itself. Overflowing the parking lot
+        drops the oldest entry for real, counted in
+        device_recycle_skipped."""
+        if self._recycle_aliases is None:
+            self._recycle_aliases = _tree_aliases_host(
+                host.tree(), item.tree())
+        if not self._recycle_aliases:
+            self.batcher.recycle(host)
+            return
+        self._sweep_deferred()
+        refs = tuple(weakref.ref(v) for v in item.tree().values())
+        self._deferred.append((host, refs))
+        if len(self._deferred) > self._deferred_cap:
+            self._deferred.pop(0)
+            self._recycle_skipped += 1
+            m["recycle_skip"].set(self._recycle_skipped)
+
+    def _sweep_deferred(self) -> None:
+        """Recycle parked host batches whose aliasing device arrays have
+        all been dropped by the consumer."""
+        keep = []
+        for host, refs in self._deferred:
+            if all(r() is None for r in refs):
+                self.batcher.recycle(host)
+            else:
+                keep.append((host, refs))
+        self._deferred = keep
 
     def _ensure_started(self) -> None:
         if self._thread is None:
@@ -1285,15 +1463,18 @@ class DeviceRowBlockIter:
             # timestamp containment
             with telemetry.span("device.put", bytes=nbytes):
                 t0 = time.perf_counter() if tel else None
-                if self._leading_sharding is not None:
-                    if self.sharding is None or \
-                            set(self.sharding) != set(tree):
-                        self.sharding = {
-                            k: (self._packed_sharding if k in ("aux", "big")
-                                else self._leading_sharding) for k in tree}
-                    tree = jax.device_put(tree, self.sharding)
+                self._ensure_sharding(tree)
+                if self._zero_copy:
+                    try:
+                        tree = self._zero_copy_put(tree)
+                        m["zc_batches"].inc()
+                    except _ZeroCopyIneligible as e:
+                        telemetry.counter(
+                            "device_zero_copy_fallbacks_total",
+                            {"reason": e.reason}).inc()
+                        tree = self._copy_put(tree)
                 else:
-                    tree = jax.device_put(tree)
+                    tree = self._copy_put(tree)
                 t1 = time.perf_counter() if tel else None
                 jax.block_until_ready(list(tree.values()))
                 if t0 is not None:
@@ -1317,9 +1498,141 @@ class DeviceRowBlockIter:
         m["batches"].inc()
         m["bytes"].inc(nbytes)
         cls = type(batch)
-        return cls(total_rows=batch.total_rows, **tree)
+        kwargs = dict(tree)
+        if "val" in kwargs and "aux" in kwargs:
+            # the packed tree's separate bf16 value leaf rides the val16
+            # field so the device batch's tree() re-emits it
+            kwargs["val16"] = kwargs.pop("val")
+        return cls(total_rows=batch.total_rows, **kwargs)
+
+    # -- zero-copy transfer --------------------------------------------------
+    def _ensure_sharding(self, tree) -> None:
+        if self._leading_sharding is None:
+            return
+        if self.sharding is None or set(self.sharding) != set(tree):
+            self.sharding = match_placement_rules(self.mesh, tree)
+
+    def _copy_put(self, tree):
+        """The plain (copying) transfer: one device_put over the tree."""
+        if self.sharding is not None:
+            return jax.device_put(tree, self.sharding)
+        return jax.device_put(tree)
+
+    def _placement_table(self, key, shape, ns):
+        """Per-device (device, lo, hi) leading-axis slices of leaf `key`
+        under NamedSharding `ns`, derived from devices_indices_map and
+        cached per (key, shape). None when the sharding does not slice
+        the leading axis contiguously (zero-copy ineligible)."""
+        ck = (key, shape)
+        if ck in self._placements:
+            return self._placements[ck]
+        entries, ok = [], True
+        try:
+            imap = ns.devices_indices_map(shape)
+        except Exception:
+            imap, ok = None, False
+        if ok:
+            for dev, idx in imap.items():
+                lead = idx[0] if idx else slice(None)
+                rest = idx[1:] if idx else ()
+                full_rest = all(
+                    s.start in (None, 0) and s.step in (None, 1)
+                    and s.stop in (None, shape[j + 1])
+                    for j, s in enumerate(rest))
+                if not idx or lead.step not in (None, 1) or not full_rest:
+                    ok = False
+                    break
+                lo = 0 if lead.start is None else int(lead.start)
+                hi = shape[0] if lead.stop is None else int(lead.stop)
+                entries.append((dev, lo, hi))
+        table = tuple(entries) if ok else None
+        self._placements[ck] = table
+        return table
+
+    def _zero_copy_put(self, tree):
+        """Transfer the batch without copying host memory: device_put of a
+        64-byte-aligned C-contiguous numpy buffer lets the runtime alias
+        it (DMA reads the staging memory in place), and under a mesh each
+        device gets its own contiguous shard-major slab via the placement
+        table + make_array_from_single_device_arrays — no host gather, no
+        repack. Raises _ZeroCopyIneligible (counted, then the copying
+        path runs) rather than silently degrading."""
+        for v in tree.values():
+            if not isinstance(v, np.ndarray) or \
+                    not v.flags["C_CONTIGUOUS"]:
+                raise _ZeroCopyIneligible("non_contiguous_host")
+        if self.sharding is None:
+            for v in tree.values():
+                if v.ctypes.data % 64:
+                    raise _ZeroCopyIneligible("unaligned")
+            # one dispatch for the whole tree: each aligned leaf is
+            # aliased individually; the single call just saves the
+            # per-leaf Python round trip
+            return jax.device_put(tree)
+        out = {}
+        for k, v in tree.items():
+            ns = self.sharding[k]
+            table = self._placement_table(k, v.shape, ns)
+            if table is None:
+                raise _ZeroCopyIneligible("non_leading_partition")
+            shards = []
+            for dev, lo, hi in table:
+                piece = v[lo:hi]
+                if piece.ctypes.data % 64:
+                    # shard slab sizes that are not 64-byte multiples
+                    # misalign every shard after the first
+                    raise _ZeroCopyIneligible("unaligned")
+                shards.append(jax.device_put(piece, dev))
+            out[k] = jax.make_array_from_single_device_arrays(
+                v.shape, ns, shards)
+        return out
+
+    def _iter_sync(self) -> Iterator[PaddedBatch]:
+        """prefetch=0: parse+fill, device_put, and consumption inline on
+        the caller's thread (see the class docstring). Same semantics as
+        the threaded path — stage spans, shape census, resume-prefix
+        burning, alias-probed recycling — minus the queues."""
+        m = _get_lane_metrics()
+        recycle_ok = self.to_device and hasattr(self.batcher, "recycle")
+        skip, self._skip_batches = self._skip_batches, 0
+        for i in range(skip):
+            batch = self.batcher.next_batch()
+            if batch is None:
+                raise DMLCError(
+                    f"restore: resume point ({skip} batches) is past "
+                    f"end-of-data (got {i}); the checkpoint and the "
+                    f"data stream disagree")
+            if hasattr(self.batcher, "recycle"):
+                self.batcher.recycle(batch)
+        while True:
+            if telemetry.enabled():
+                t0 = time.perf_counter()
+                host = self.batcher.next_batch()
+                dur_us = (time.perf_counter() - t0) * 1e6
+                if host is not None:
+                    m["stage_us"].observe(dur_us)
+                    telemetry.emit_span("device.stage", t0 * 1e6, dur_us,
+                                        rows=host.total_rows)
+            else:
+                host = self.batcher.next_batch()
+            if host is None:
+                return
+            _note_shape(host)
+            item = self._device_put(host)
+            self.batches_consumed += 1
+            if recycle_ok and item is not host:
+                # same alias-probed direct-or-deferred recycling as the
+                # transfer thread: _device_put blocked until the DMA
+                # landed, so the host buffers are refillable unless the
+                # device arrays alias them — in which case they are
+                # parked and reclaimed once the consumer drops `item`
+                self._recycle_or_defer(host, item, m)
+            yield item
 
     def __iter__(self) -> Iterator[PaddedBatch]:
+        if self._prefetch == 0:
+            yield from self._iter_sync()
+            return
         self._ensure_started()
         m = _get_lane_metrics()
         while True:
@@ -1405,6 +1718,10 @@ class DeviceRowBlockIter:
                     q.get_nowait()
                 except queue.Empty:
                     break
+        # reclaim what the consumer has released; drop the rest (their
+        # device arrays may still alias the staging memory)
+        self._sweep_deferred()
+        self._deferred = []
         self._stop.clear()
 
     def before_first(self) -> None:

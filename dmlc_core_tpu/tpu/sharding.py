@@ -46,11 +46,14 @@ def batch_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
 
 def packed_batch_sharding(mesh: Mesh, axis_name: str = "data"
                           ) -> NamedSharding:
-    """Shard the SECOND axis across the mesh: the packed batch leaves
-    (`aux` [K, D, R], `big` [Kb, D, NNZ] — device_iter packing) carry the
-    device axis at position 1 so each plane stays a contiguous native
-    fill target."""
-    return NamedSharding(mesh, P(None, axis_name))
+    """Sharding for the packed batch leaves (`aux` [D, K, R], `big`
+    [D, Kb, NNZ] — device_iter packing). The packs are SHARD-MAJOR: the
+    device axis LEADS, so each device's slice is one contiguous run of
+    the host staging buffer — the precondition for the zero-copy
+    device_put path. (Equal to batch_sharding since the shard-major
+    migration; kept as a named concept and for callers that predate
+    it.)"""
+    return NamedSharding(mesh, P(axis_name))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

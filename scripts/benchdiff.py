@@ -51,6 +51,7 @@ GOOD_LEAVES = {
     "mock_ceiling_rows_per_sec", "ranged_vs_sequential",
     "ranged_vs_local", "achieved_qps",
     "hbm_ingest_rows_per_sec", "overlap_ratio",
+    "hbm_ingest_bw_util", "hbm_ingest_bw_util_best",
 }
 
 # extras entries that are lanes worth carrying into the ledger

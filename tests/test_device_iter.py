@@ -110,14 +110,16 @@ def test_device_iter_sharding(tmp_path):
         batches = list(it)
     assert len(batches) == 2
     b = batches[0]
-    # a batch crosses host->device as exactly TWO packed transfers whose
-    # device axis (position 1) is sharded over the mesh
+    # a batch crosses host->device as exactly TWO packed shard-major
+    # transfers whose LEADING device axis is sharded over the mesh (each
+    # shard's bytes are one contiguous slab — the zero-copy placement
+    # contract)
     assert set(b.tree()) == {"big", "aux"}
     assert isinstance(b.big, jax.Array) and isinstance(b.aux, jax.Array)
-    none_data = jax.sharding.PartitionSpec(None, "data")
-    assert b.big.sharding.spec == none_data
-    assert b.aux.sharding.spec == none_data
-    assert b.big.shape[1] == 8 and b.aux.shape[1] == 8
+    leading_data = jax.sharding.PartitionSpec("data")
+    assert b.big.sharding.spec == leading_data
+    assert b.aux.sharding.spec == leading_data
+    assert b.big.shape[0] == 8 and b.aux.shape[0] == 8
     # unpack recovers the named planes bit-exactly vs the host staging
     from dmlc_core_tpu.tpu.device_iter import unpack_tree
     with DeviceRowBlockIter(str(p), batch_rows=1024, mesh=mesh,
@@ -409,7 +411,7 @@ def test_index64_path_emits_packed_batches(tmp_path):
         hb = next(iter(hit))
     assert np.array_equal(
         np.asarray(hb.label),
-        np.asarray(hb.aux[0]).view(np.float32))
+        np.asarray(hb.aux[:, 0]).view(np.float32))
 
 
 def test_linear_predict_matches_oracle_and_caches(tmp_path):

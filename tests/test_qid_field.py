@@ -194,9 +194,9 @@ def test_dense_layout_carries_qid(tmp_path):
     b.close()
     assert batch.qid is not None
     assert int(batch.qid[0, 0]) == 1  # first query id
-    # the packed tree carries qid inside aux (K == 4 planes)
+    # the packed tree carries qid inside aux (K == 4 planes, shard-major)
     tree = batch.tree()
-    assert set(tree) == {"x", "aux"} and tree["aux"].shape[0] == 4
+    assert set(tree) == {"x", "aux"} and tree["aux"].shape[1] == 4
 
 
 def test_no_qid_no_field_stays_none(tmp_path):
