@@ -4,11 +4,11 @@
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
         parse-lanes telemetry trace cache range fsfault rig device zerocopy \
-        pytest liveness elastic bench-smoke dryrun doc clean
+        pytest liveness elastic mesh bench-smoke dryrun doc clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
     telemetry trace cache range fsfault rig device zerocopy pytest liveness \
-    elastic dryrun doc
+    elastic mesh dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -154,6 +154,18 @@ liveness:
 # same reason as the liveness lane.
 elastic:
 	timeout -k 10 300 python3 -m pytest tests/test_elastic_data_plane.py -q
+
+# elastic MESH chaos suite (doc/robustness.md "Elastic mesh training"):
+# SIGKILL one rank of a real jax.distributed world mid-step. Supervised:
+# the whole world relaunches from the last COMMITTED job checkpoint and
+# every resumed loss matches the uninterrupted run. Unsupervised: every
+# survivor exits with the structured abort code within 2x dead-after,
+# wall-clock-asserted. Plus torn-commit refusal and the N-process vs
+# single-process loss parity pin. JAX_PLATFORMS=cpu pins the
+# deterministic floor; hard timeout for the same reason as liveness.
+mesh:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	  python3 -m pytest tests/test_elastic_mesh.py -q
 
 dryrun:
 	python3 -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

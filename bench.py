@@ -869,6 +869,207 @@ def run_device_lane(args, rows: int, device_ok: bool) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def mesh_lane_probe(smoke: bool = False) -> dict:
+    """Elastic mesh training lane (doc/robustness.md "Elastic mesh
+    training"): a real 2-process ``jax.distributed`` world under the
+    in-process tracker, stepped by tests/mesh_worker.py — lease acquire,
+    cross-process KV allgather, lease complete, every step.
+
+    Two numbers ride the regression ledger (scripts/benchdiff.py
+    ``mesh_lane`` — the MULTICHIP_r* dryrun series promoted from
+    pass/fail droppings to measured metrics):
+
+    - ``steps_per_sec``: steady-state collective steps/s of an
+      uninterrupted world, measured between the first and last progress
+      beat of rank 0 so world bring-up (jax.distributed init, tracker
+      link dance) is excluded;
+    - ``recovery_s``: SIGKILL one rank mid-step of a supervised world
+      and measure wall clock from the kill to the FIRST step the
+      relaunched world writes — recovery-time-to-first-resumed-step
+      (failure detection + world teardown + fresh coordinator + rejoin).
+      Lower is better; benchdiff inverts the ratio for it.
+    """
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from dmlc_core_tpu.tracker import rendezvous
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "mesh_worker.py")
+    nworkers = 2
+    root = tempfile.mkdtemp(prefix="meshlane_", dir=CACHE_DIR)
+    # the tracker runs in-process: its liveness knobs come from OUR env
+    os.environ.setdefault("DMLC_TRACKER_RECOVER_GRACE_MS", "300")
+
+    def read_progress(pdir, rank):
+        try:
+            with open(os.path.join(pdir, f"rank{rank}.progress")) as f:
+                step, pid = f.read().split()
+            return int(step), int(pid)
+        except (OSError, ValueError):
+            return None
+
+    def run_world(tag, steps_by_attempt, step_sleep, dead_after_ms,
+                  world_attempts, driver):
+        """One tracked world; `driver(pdir_of, procs_by_attempt)` runs on
+        the monitor side while run_job owns the tracker thread."""
+        procs_by_attempt = []
+
+        def pdir_of(att):
+            d = os.path.join(root, f"{tag}{att}")
+            os.makedirs(d, exist_ok=True)
+            return d
+
+        def launch(nw, ns, envs, tracker=None):
+            att = int(envs.get("DMLC_WORLD_ATTEMPT", "0"))
+            n = steps_by_attempt[min(att, len(steps_by_attempt) - 1)]
+            env = dict(os.environ)
+            env.update({k: str(v) for k, v in envs.items()})
+            env.update({
+                "DMLC_ROLE": "worker", "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "PYTHONPATH": repo,
+                "DMLC_STEP_DEADLINE_MS": str(dead_after_ms)})
+            ps = []
+            for i in range(nw):
+                ps.append(subprocess.Popen(
+                    [sys.executable, worker, pdir_of(att), str(n),
+                     str(step_sleep)],
+                    env=dict(env, DMLC_TASK_ID=str(i)),
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            procs_by_attempt.append(ps)
+
+            def stop():
+                for p in ps:
+                    if p.poll() is None:
+                        p.kill()
+            return stop
+
+        errs = []
+
+        def run():
+            try:
+                rendezvous.run_job(
+                    nworkers, 0, launch, host_ip="127.0.0.1",
+                    heartbeat_ms=150, dead_after_ms=dead_after_ms,
+                    num_shards=2 * nworkers, mesh=True,
+                    world_attempts=world_attempts)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        ok = False
+        try:
+            out = driver(pdir_of, procs_by_attempt)
+            ok = True
+        finally:
+            # after a successful drive, let the world finish CLEANLY
+            # (killing a worker mid-shutdown reads as a lost rank and
+            # aborts the very run just measured); on a failed drive,
+            # kill immediately
+            grace = time.monotonic() + (90 if ok else 0)
+            for ps in procs_by_attempt:
+                for p in ps:
+                    if p.poll() is None:
+                        try:
+                            p.wait(timeout=max(0.0,
+                                               grace - time.monotonic()))
+                        except subprocess.TimeoutExpired:
+                            pass
+                    if p.poll() is None:
+                        p.kill()
+            th.join(timeout=60)
+        if errs:
+            raise errs[0]
+        if th.is_alive():
+            raise RuntimeError(f"mesh lane: {tag} tracker never finished")
+        return out
+
+    try:
+        # -- phase 1: uninterrupted steps/s -------------------------------
+        steps = 20 if smoke else 60
+
+        def timed(pdir_of, procs):
+            pdir = pdir_of(0)
+            beats = []  # (monotonic, step) — one entry per step change
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                got = read_progress(pdir, 0)
+                if got is not None and (not beats
+                                        or got[0] != beats[-1][1]):
+                    beats.append((time.monotonic(), got[0]))
+                    if got[0] >= steps - 1:
+                        break
+                time.sleep(0.002)
+            (t1, s1), (t2, s2) = beats[0], beats[-1]
+            if s2 <= s1 or t2 <= t1:
+                raise RuntimeError(f"mesh lane: no steady window "
+                                   f"({beats[:3]}...)")
+            return (s2 - s1) / (t2 - t1)
+
+        steps_per_sec = run_world("steady", [steps], 0.0, 2000, 0, timed)
+
+        # -- phase 2: SIGKILL -> relaunch -> first resumed step -----------
+        dead_after_ms = 1000
+
+        def chaos(pdir_of, procs):
+            p0 = pdir_of(0)
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                got = [read_progress(p0, r) for r in range(nworkers)]
+                if all(g is not None and g[0] >= 1 for g in got):
+                    break
+                time.sleep(0.005)
+            else:
+                raise RuntimeError("mesh lane: attempt 0 never progressed")
+            t_kill = time.monotonic()
+            os.kill(got[0][1], signal.SIGKILL)
+            p1 = pdir_of(1)
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                if any(read_progress(p1, r) is not None
+                       for r in range(nworkers)):
+                    return time.monotonic() - t_kill
+                time.sleep(0.005)
+            raise RuntimeError("mesh lane: world never resumed")
+
+        recovery_s = run_world("chaos", [100000, 3], 0.05, dead_after_ms,
+                               2, chaos)
+
+        return {"steps_per_sec": round(steps_per_sec, 1),
+                "recovery_s": round(recovery_s, 3),
+                "nworkers": nworkers, "steps": steps,
+                "dead_after_ms": dead_after_ms}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def run_mesh_lane(args) -> dict:
+    """Run the elastic-mesh lane in its own subprocess (fresh tracker +
+    coordination-service state per run; a wedged world costs the lane's
+    timeout, never the headline). CPU-pinned: the lane measures the
+    control plane — detection, relaunch, collective cadence — not
+    device math."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DCT_SKIP_DEVICE_PROBE="1")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-lane"]
+            + (["--smoke"] if args.smoke else []),
+            capture_output=True, text=True,
+            timeout=300 if args.smoke else 600, env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": "mesh lane timed out"}
+    if out.returncode != 0:
+        return {"error": (out.stderr or "")[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def attainable_contiguous_bw(sharding, nbytes: int) -> float:
     """Best host->device bandwidth (B/s) for one large contiguous buffer
     under the pipeline's sharding: the optimistic ceiling. The buffer is
@@ -1057,6 +1258,8 @@ def main() -> None:
                     help=argparse.SUPPRESS)  # subprocess child mode
     ap.add_argument("--device-lane", action="store_true",
                     help=argparse.SUPPRESS)  # subprocess child mode
+    ap.add_argument("--mesh-lane", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess child mode
     args = ap.parse_args()
     if args.pallas_probe:
         # child mode for the device-gated kernel probe: the parent runs it
@@ -1069,6 +1272,11 @@ def main() -> None:
         # JAX_PLATFORMS=cpu when no real device passed the probe
         print(json.dumps(device_lane_probe(
             args.rows or (20000 if args.smoke else 200000))))
+        return
+    if args.mesh_lane:
+        # child mode for the elastic-mesh lane: real 2-process
+        # jax.distributed worlds under an in-process tracker
+        print(json.dumps(mesh_lane_probe(smoke=args.smoke)))
         return
     args.dense_dtype = "bfloat16" if args.dense_dtype == "bf16" else "float32"
 
@@ -1507,6 +1715,28 @@ def main() -> None:
             # host must emit device-lane numbers, never a degraded hole
             raise SystemExit(
                 f"--smoke: device lane emitted no numbers: {dl}")
+
+    # elastic mesh training lane (doc/robustness.md "Elastic mesh
+    # training"): collective steps/s of a real 2-process jax.distributed
+    # world under the tracker, and recovery-time-to-first-resumed-step
+    # after a SIGKILL world relaunch. Subprocess for the same reason as
+    # the device lane; CPU-pinned always (it measures the control plane,
+    # not device math). This ledgered mesh_lane record is the promotion
+    # of the MULTICHIP_r* dryrun series (pass/fail droppings) into
+    # trended robustness metrics (scripts/benchdiff.py LANE_KEYS).
+    if args.format == "libsvm" and not user_host_only:
+        with sampler.section("mesh_lane"):
+            extras["mesh_lane"] = run_mesh_lane(args)
+        ml = extras["mesh_lane"]
+        if "error" in ml:
+            print(f"# mesh lane FAILED: {ml['error']}", file=sys.stderr)
+        else:
+            print(f"# mesh lane: {ml['steps_per_sec']:.1f} collective "
+                  f"steps/s ({ml['nworkers']} procs, {ml['steps']} "
+                  f"steps), SIGKILL recovery to first resumed step "
+                  f"{ml['recovery_s']:.2f}s "
+                  f"(dead-after {ml['dead_after_ms']}ms)",
+                  file=sys.stderr)
 
     baseline = _load_baseline()  # one read serves the parity ratios + vs
 

@@ -72,9 +72,14 @@ class DataParallelModel:
             from jax.experimental.shard_map import shard_map
         mesh = self.mesh
 
+        from dmlc_core_tpu.parallel.varying import shard_map_compat_kwargs
+
+        # the shard loss may reach the Pallas CSR->dense kernel, which the
+        # pre-varying-type replication checker cannot type
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(P(), dict(tree_keys)),
-                           out_specs=(P(), P()))
+                           out_specs=(P(), P()),
+                           **shard_map_compat_kwargs())
         def sharded_step(params, tree):
             shard = shard_view(tree)  # drop device axis + unpack
             loss_sum, wsum, grads = local_grads(params, shard)

@@ -29,6 +29,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.5 jax spells it experimental
+    from jax.experimental.shard_map import shard_map
+
 from dmlc_core_tpu.parallel.ring import ring_attention
 
 __all__ = ["TransformerConfig", "TransformerLM"]
@@ -70,7 +75,7 @@ class TransformerLM:
             f"need ('data', 'seq') mesh axes, got {axes}")
         tok_spec = P("data", "seq")
         rep_spec = P()
-        self._step = jax.jit(jax.shard_map(
+        self._step = jax.jit(shard_map(
             self._shard_step, mesh=mesh,
             in_specs=(rep_spec, tok_spec, tok_spec),
             out_specs=(rep_spec, rep_spec)))

@@ -43,10 +43,15 @@ def _padded_shape(num_rows: int, num_features: int) -> "tuple[int, int]":
 
 def _vma_of(*operands) -> frozenset:
     """Union of the operands' varying-manual-axes sets (empty outside
-    shard_map) — the one place that touches the jax vma probing API."""
+    shard_map) — the one place that touches the jax vma probing API.
+    A jax without ``jax.typeof`` (pre-0.5) has no varying types at all,
+    so the set is empty by construction."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
     vma = set()
     for op in operands:
-        vma |= set(getattr(jax.typeof(op), "vma", ()) or ())
+        vma |= set(getattr(typeof(op), "vma", ()) or ())
     return frozenset(vma)
 
 

@@ -1,10 +1,15 @@
 """Parallelism layer: mesh/sharding helpers + multi-host init/collectives
-+ the ring (SP) and GPipe (PP) schedules."""
++ the ring (SP) and GPipe (PP) schedules + the elastic-mesh step watchdog."""
 
-from dmlc_core_tpu.parallel.distributed import (allreduce, broadcast,
-                                                init_from_env, rank,
-                                                world_size)
+from dmlc_core_tpu.parallel.distributed import (allgather_bytes, allreduce,
+                                                allreduce_tree, barrier,
+                                                broadcast, init_from_env,
+                                                rank, world_size)
+from dmlc_core_tpu.parallel.elastic import (STEP_ABORT_EXIT, StepWatchdog,
+                                            structured_abort)
 from dmlc_core_tpu.parallel.pipeline_parallel import pipeline_apply
 
-__all__ = ["allreduce", "broadcast", "init_from_env", "rank", "world_size",
+__all__ = ["allgather_bytes", "allreduce", "allreduce_tree", "barrier",
+           "broadcast", "init_from_env", "rank", "world_size",
+           "STEP_ABORT_EXIT", "StepWatchdog", "structured_abort",
            "pipeline_apply"]

@@ -4,26 +4,55 @@ The TPU-native communication backend (SURVEY §2.5, §5): where the reference
 brokers TCP links for Rabit's tree/ring allreduce, here multi-host jobs call
 :func:`init_from_env` once — JAX's coordination service (seeded by the
 `tpu-pod` launcher's JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/
-JAX_PROCESS_ID env trio) replaces the socket tracker, and the collectives
+JAX_PROCESS_ID env trio, or the elastic-mesh launcher's
+DMLC_COORDINATOR_ADDRESS) replaces the socket tracker, and the collectives
 are XLA's, hardware-routed over ICI/DCN.
 
 The `allreduce`/`broadcast` helpers mirror the Rabit worker API surface that
-downstream DMLC learners (XGBoost) call between batches, implemented as
-jitted psum/identity over the "data" mesh axis.
+downstream DMLC learners (XGBoost) call between batches. Two transports
+back them:
+
+- **XLA** (TPU/GPU): `multihost_utils` all-gathers over ICI/DCN.
+- **Coordination-service KV store** (the CPU floor): XLA's CPU backend
+  cannot run ANY multiprocess computation (`device_put` to a global
+  sharding, jit over a >1-process mesh, `process_allgather` all raise
+  "Multiprocess computations aren't implemented on the CPU backend"), but
+  the coordination service itself — the KV store and barriers — works on
+  every backend. :func:`allgather_bytes` rides it with rank-keyed,
+  sequence-numbered entries, and the host-side reduction runs in RANK
+  ORDER, so the result is bit-deterministic across runs — what the
+  elastic-mesh resume pin (doc/robustness.md "Elastic mesh training")
+  needs from a collective.
+
+Every process must issue the same collective calls in the same program
+order (the Rabit contract); the internal sequence counter turns that
+order into unique KV keys, so no epoch/step tag needs threading through.
 """
 
 from __future__ import annotations
 
+import base64
+import itertools
 import os
+from typing import Any, List
+
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
-from dmlc_core_tpu.base import log_info
-from dmlc_core_tpu.tracker.wire import env_int_opt
+from dmlc_core_tpu.base import DMLCError, log_info
+from dmlc_core_tpu.tracker.wire import env_int, env_int_opt
 
-__all__ = ["init_from_env", "allreduce", "broadcast", "rank", "world_size"]
+__all__ = ["init_from_env", "allreduce", "allreduce_tree", "allgather_bytes",
+           "barrier", "broadcast", "rank", "world_size"]
 
 _OPS = ("sum", "max", "min", "mean")
+
+# collective sequence counter: every process calls the collectives in the
+# same program order, so the counter values agree across ranks and each
+# call gets a fresh, never-reused KV key / barrier name
+_kv_seq = itertools.count()
 
 
 def init_from_env() -> None:
@@ -31,8 +60,10 @@ def init_from_env() -> None:
 
     Reads JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID
     (exported by cluster=tpu-pod; see tracker/launchers.py
-    build_tpu_pod_env), falling back to DMLC_TRACKER_URI +
-    DMLC_NUM_WORKER + DMLC_TASK_ID for legacy launch environments."""
+    build_tpu_pod_env), falling back to DMLC_COORDINATOR_ADDRESS +
+    DMLC_NUM_WORKER + DMLC_TASK_ID (exported by the elastic-mesh local
+    launcher, rendezvous.run_job mesh=True) for tracker-launched
+    environments."""
     if os.getenv("JAX_COORDINATOR_ADDRESS"):
         # pass the trio explicitly: bare initialize() only auto-detects
         # managed clusters (Slurm/GKE/TPU metadata), not this env protocol
@@ -78,20 +109,103 @@ def world_size() -> int:
     return jax.process_count()
 
 
+# -- coordination-service transport ------------------------------------------
+def _kv_client():
+    """The jax.distributed coordination-service client, or None before
+    init_from_env/initialize. Internal API by necessity: jax exposes the
+    KV store to libraries (orbax uses it the same way) but not publicly."""
+    from jax._src import distributed
+    return getattr(distributed.global_state, "client", None)
+
+
+def _collective_timeout_ms() -> int:
+    # generous on purpose: death detection belongs to the tracker
+    # heartbeat + step watchdog (parallel/elastic.py), not to this
+    # timeout — a peer that dies mid-collective trips the watchdog long
+    # before this fires, so this only backstops a lost coordinator
+    return env_int("DMLC_COLLECTIVE_TIMEOUT_MS", 600000)
+
+
+def allgather_bytes(payload: bytes, name: str = "ag") -> List[bytes]:
+    """All-gather one bytes payload per process over the coordination
+    service KV store; returns the rank-ordered list on every process.
+
+    Works on every backend (the CPU floor included — no XLA computation
+    is involved). Each call consumes one sequence number, so every
+    process must call the collectives in the same program order."""
+    n = jax.process_count()
+    if n == 1:
+        return [payload]
+    client = _kv_client()
+    if client is None:
+        raise DMLCError(
+            "allgather_bytes: jax.distributed is not initialized — call "
+            "parallel.init_from_env() (or jax.distributed.initialize) "
+            "before any collective")
+    timeout_ms = _collective_timeout_ms()
+    key = f"dmlc/{name}/{next(_kv_seq)}"
+    client.key_value_set(f"{key}/{jax.process_index()}",
+                         base64.b64encode(payload).decode())
+    out = []
+    for r in range(n):
+        out.append(base64.b64decode(
+            client.blocking_key_value_get(f"{key}/{r}", timeout_ms)))
+    return out
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process arrives (coordination-service barrier;
+    no XLA computation, so it works on the CPU floor). Sequence-numbered
+    like the KV collectives: call in the same program order everywhere."""
+    if jax.process_count() == 1:
+        return
+    client = _kv_client()
+    if client is None:
+        raise DMLCError(
+            "barrier: jax.distributed is not initialized — call "
+            "parallel.init_from_env() first")
+    client.wait_at_barrier(f"dmlc_{name}_{next(_kv_seq)}",
+                           _collective_timeout_ms())
+
+
+def _reduce_stack(stack: np.ndarray, op: str) -> np.ndarray:
+    if op == "sum":
+        return np.sum(stack, axis=0)
+    if op == "mean":
+        return np.mean(stack, axis=0)
+    if op == "max":
+        return np.max(stack, axis=0)
+    return np.min(stack, axis=0)
+
+
+def _use_host_transport() -> bool:
+    # the XLA CPU backend cannot run multiprocess computations at all
+    # (see module docstring); TPU/GPU take the ICI/DCN-routed XLA path
+    return jax.default_backend() == "cpu"
+
+
 def allreduce(x, op: str = "sum"):
     """Rabit-equivalent Allreduce: each process contributes one value; the
     elementwise reduction is returned on every process.
 
-    Single-process jobs return the input unchanged. Multi-process jobs
-    all-gather across processes through the coordination service and reduce
-    — XLA routes the gather over ICI/DCN. (In-step gradient reductions
-    belong inside jit as lax.psum, see models/linear.py; this helper is for
-    the between-batches host-side values the Rabit API serves.)"""
+    Single-process jobs return the input unchanged. On TPU/GPU the
+    all-gather is XLA's, routed over ICI/DCN; on the CPU floor it rides
+    the coordination-service KV store with a rank-ordered host-side
+    reduction (bit-deterministic across runs). In-step gradient
+    reductions belong inside jit as lax.psum (models/linear.py); this
+    helper is for the between-batches host-side values the Rabit API
+    serves."""
     if op not in _OPS:
         raise ValueError(f"op must be one of {_OPS}")
     x = jnp.asarray(x)
     if jax.process_count() == 1:
         return x
+    if _use_host_transport():
+        arr = np.asarray(x)
+        blobs = allgather_bytes(arr.tobytes(), name="ar")
+        stack = np.stack([np.frombuffer(b, dtype=arr.dtype)
+                          .reshape(arr.shape) for b in blobs])
+        return jnp.asarray(_reduce_stack(stack, op))
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x)  # [nproc, ...]
     if op == "sum":
@@ -103,13 +217,60 @@ def allreduce(x, op: str = "sum"):
     return jnp.min(gathered, axis=0)
 
 
+def allreduce_tree(tree: Any, op: str = "mean") -> Any:
+    """Elementwise cross-process reduction of a whole pytree in ONE
+    collective round trip (the leaves ride a single concatenated payload).
+
+    The host-side elastic-mesh data-parallel step uses this to keep
+    per-host parameter replicas identical: every host updates with its
+    local gradient, then `allreduce_tree(params, "mean")` — equal local
+    batch sizes make the mean of the per-host updates the global-batch
+    update (doc/robustness.md "Elastic mesh training"). Leaves that are
+    jax Arrays come back placed through their own sharding; numpy leaves
+    come back as numpy."""
+    if op not in _OPS:
+        raise ValueError(f"op must be one of {_OPS}")
+    if jax.process_count() == 1:
+        return tree
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    np_leaves = [np.asarray(leaf) for leaf in leaves]
+    payload = b"".join(leaf.tobytes() for leaf in np_leaves)
+    if _use_host_transport():
+        blobs = allgather_bytes(payload, name="art")
+    else:
+        # one fused XLA all-gather of the packed byte buffer
+        from jax.experimental import multihost_utils
+        packed = np.frombuffer(payload, dtype=np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(packed))
+        blobs = [gathered[r].tobytes() for r in range(gathered.shape[0])]
+    out, offset = [], 0
+    for leaf, arr in zip(leaves, np_leaves):
+        nb = arr.nbytes
+        stack = np.stack([np.frombuffer(b[offset:offset + nb],
+                                        dtype=arr.dtype).reshape(arr.shape)
+                          for b in blobs])
+        offset += nb
+        red = _reduce_stack(stack, op).astype(arr.dtype, copy=False)
+        sharding = getattr(leaf, "sharding", None)
+        out.append(jax.device_put(red, sharding)
+                   if isinstance(leaf, jax.Array) and sharding is not None
+                   else red)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def broadcast(x, root: int = 0):
     """Replicate root's value to all processes (Rabit Broadcast).
 
-    Single-process: identity. Multi-process: uses the coordination service
-    via a tiny all-gather of the root shard."""
+    Single-process: identity. All ranks must pass a same-shape/dtype
+    value (the XLA path requires it too); on the CPU floor the root's
+    payload rides the KV store."""
     if jax.process_count() == 1:
         return jnp.asarray(x)
+    if _use_host_transport():
+        arr = np.asarray(x)
+        blobs = allgather_bytes(arr.tobytes(), name="bc")
+        return jnp.asarray(np.frombuffer(blobs[root], dtype=arr.dtype)
+                           .reshape(arr.shape).copy())
     from jax.experimental import multihost_utils
     return multihost_utils.broadcast_one_to_all(
         jnp.asarray(x), is_source=jax.process_index() == root)

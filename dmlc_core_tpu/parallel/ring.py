@@ -37,6 +37,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.5 jax spells it experimental
+    from jax.experimental.shard_map import shard_map
+
 __all__ = ["ring_allreduce", "ring_attention", "ring_attention_zigzag",
            "sequence_parallel_attention", "zigzag_permutation"]
 
@@ -339,8 +344,8 @@ def sequence_parallel_attention(q: jnp.ndarray, k: jnp.ndarray,
                                causal=causal)
     else:
         raise ValueError(f"unknown layout {layout!r}")
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
     q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
     out = mapped(q, k, v)
     if layout == "zigzag":

@@ -14,7 +14,15 @@ from __future__ import annotations
 import jax
 from jax import lax
 
-__all__ = ["mark_varying"]
+__all__ = ["mark_varying", "shard_map_compat_kwargs"]
+
+# Does THIS jax enforce the varying-type discipline at all? A native
+# ``jax.shard_map`` (the post-experimental graduation) implies typed
+# values; a jax that only ships ``jax.experimental.shard_map`` tracks
+# replication via check_rep and its transpose rule needs no explicit
+# cast. Probed once at import; tests monkeypatch it to pin the
+# renamed-again failure mode below.
+_VARYING_TYPED = hasattr(jax, "shard_map")
 
 
 def mark_varying(tree, axes):
@@ -25,7 +33,29 @@ def mark_varying(tree, axes):
                             tree)
     if hasattr(lax, "pvary"):
         return jax.tree.map(lambda t: lax.pvary(t, axes), tree)
-    raise RuntimeError(
-        "this JAX version has neither lax.pcast nor lax.pvary; an untyped "
-        "replicated value inside shard_map would make explicit psums "
-        "double-count by the mesh axis size")
+    if _VARYING_TYPED:
+        # a varying-typed jax with BOTH cast APIs missing means the API
+        # moved again: silently skipping the cast would let autodiff's
+        # transpose rule insert implicit psums that double-count by the
+        # axis size (ADVICE r1) — refuse loudly, here, the one probe point
+        raise RuntimeError(
+            "mark_varying: this jax has neither lax.pcast nor lax.pvary; "
+            "the varying-type cast API was renamed again — update "
+            "dmlc_core_tpu.parallel.varying")
+    # pre-varying-type jax (experimental shard_map, untyped values):
+    # replication is tracked by check_rep and the transpose rule needs no
+    # explicit cast, so the identity is the CORRECT behavior here, not a
+    # silent degrade
+    return tree
+
+
+def shard_map_compat_kwargs():
+    """Extra shard_map kwargs for bodies that lower a ``pallas_call``.
+
+    The pre-varying-type replication checker has no rule for pallas_call,
+    so shard_maps whose body may reach a Pallas kernel must disable it
+    (``check_rep=False`` — jax's own documented workaround). Outputs stay
+    genuinely replicated — every reduced output crosses a psum — only the
+    static checker is off. A varying-typed jax needs nothing (and no
+    longer accepts ``check_rep``)."""
+    return {} if _VARYING_TYPED else {"check_rep": False}

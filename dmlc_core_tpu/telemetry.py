@@ -1003,6 +1003,15 @@ METRIC_HELP: Dict[str, str] = {
     "tracker_lease_reassigned_total": "reclaim events across the job",
     "lease_renew_us": "tracker-side implicit lease renewal on a ping (us)",
     "lease_acquire_us": "worker-side acquire round trip (us)",
+    # elastic mesh training (doc/robustness.md "Elastic mesh training")
+    "tracker_world_relaunches_total":
+        "whole-world relaunches after a mesh abort (run_job mesh mode)",
+    "mesh_step_aborts_total":
+        "structured step aborts on this rank (between-steps raise or "
+        "step-deadline watchdog)",
+    "device_abort_drains_total":
+        "device-pipeline abort drains (staging/transfer stopped, parked "
+        "buffers dropped)",
     "stall_stage_occupancy":
         "fraction of instrumented batch-path time in the stage",
     "stall_verdict_code":

@@ -2,8 +2,11 @@
 
 from dmlc_core_tpu.tpu.device_iter import (DenseBatch,  # noqa: F401
                                            DenseRecHostBatcher,
-                                           DeviceRowBlockIter, HostBatcher,
+                                           DeviceRowBlockIter,
+                                           ElasticDeviceRowBlockIter,
+                                           HostBatcher,
                                            NativeHostBatcher, PaddedBatch)
 from dmlc_core_tpu.tpu.sharding import (batch_sharding,  # noqa: F401
-                                        data_mesh, local_device_count,
+                                        data_mesh, host_data_mesh,
+                                        local_device_count,
                                         process_part, replicated_sharding)

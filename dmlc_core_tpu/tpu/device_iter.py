@@ -52,7 +52,7 @@ from dmlc_core_tpu.io.native import (NativeBatcher, NativeCsrRecBatcher,
                                      NativeDenseRecBatcher, NativeParser,
                                      _bf16_dtype)
 from dmlc_core_tpu.tpu.sharding import batch_sharding
-from dmlc_core_tpu.tracker.wire import env_int
+from dmlc_core_tpu.tracker.wire import TrackerAbortedError, env_int
 
 # device-lane metric objects resolved ONCE (the registry contract:
 # resolve, keep the pointer — per-batch re-resolution would take the
@@ -1756,8 +1756,143 @@ class DeviceRowBlockIter:
         else:
             self.batcher.close()
 
+    def abort_drain(self, reason: str = "tracker-abort") -> None:
+        """Abort-path teardown with a BOUNDED wall clock
+        (``DMLC_DEVICE_ABORT_DRAIN_MS``, default 2000 ms), for the
+        TrackerAbortedError path (doc/robustness.md "Elastic mesh
+        training"): a survivor of a dead mesh peer must drain this
+        pipeline and exit promptly, even if a staging/transfer thread is
+        parked inside a device_put it cannot finish.
+
+        Differs from the cooperative :meth:`_join_threads` in two ways —
+        thread joins give up at the deadline (daemon threads; the
+        process is about to exit anyway), and the zero-copy parking lot
+        is force-dropped: parked staging buffers whose device arrays are
+        still live are LEAKED to the allocator rather than recycled,
+        because recycling memory a device array still aliases would
+        corrupt whatever the abort handler reads from it. Counted in
+        ``device_abort_drains_total``; idempotent, and close() stays
+        safe to call after."""
+        deadline = time.monotonic() + max(
+            1, env_int("DMLC_DEVICE_ABORT_DRAIN_MS", 2000)) / 1000.0
+        self._stop.set()
+        joined = True
+        for th, q in ((self._thread, self._host_q),
+                      (self._xfer_thread, self._queue)):
+            if th is None:
+                continue
+            while th.is_alive():
+                if time.monotonic() > deadline:
+                    joined = False
+                    break
+                try:  # drain so a blocked put can finish
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                th.join(timeout=0.02)
+        self._thread = None
+        self._xfer_thread = None
+        for q in (self._host_q, self._queue):
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        # reclaim what the consumer released; FORCE-DROP the rest — their
+        # device arrays may still alias the staging memory, so the
+        # buffers leak to the allocator instead of returning to the pool
+        self._sweep_deferred()
+        dropped = len(self._deferred)
+        self._deferred = []
+        if joined:
+            # only a fully-stopped pipeline may rearm; a straggler thread
+            # still sees _stop and exits on its own
+            self._stop.clear()
+        telemetry.counter("device_abort_drains_total").inc()
+        telemetry.flight_dump(
+            f"device-abort-drain: {reason} (threads "
+            f"{'joined' if joined else 'abandoned at deadline'}, "
+            f"{dropped} parked buffer(s) dropped)")
+
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         self.close()
+
+
+class ElasticDeviceRowBlockIter:
+    """Lease data-plane × device pipeline: the elastic-mesh input glue
+    (doc/robustness.md "Elastic mesh training").
+
+    Where :class:`~dmlc_core_tpu.data.ElasticRowBlockIter` feeds HOST
+    consumers from tracker shard leases, this feeds the DEVICE: each
+    granted shard becomes a :class:`DeviceRowBlockIter` over
+    ``part=shard, npart=num_shards`` with the PR 16 spec-driven sharded
+    placement, so per-mesh-axis data shards flow lease → batcher →
+    device with no host gather. Yields ``(shard, device_batch)`` pairs;
+    a shard's lease completes only after its last batch was yielded
+    (the exactly-once checkout survives a consumer death mid-shard —
+    the tracker reclaims and re-grants the shard).
+
+    On TrackerAbortedError — from acquire, or surfaced by the monitor
+    mid-shard — the live device pipeline is torn down through
+    :meth:`DeviceRowBlockIter.abort_drain` (bounded wall clock, parking
+    lot force-dropped) and the error propagates. ``abort_drain`` on this
+    iterator is safe from another thread, so it slots directly into a
+    :class:`~dmlc_core_tpu.parallel.elastic.StepWatchdog` drain list."""
+
+    def __init__(self, uri: str, num_shards: Optional[int] = None,
+                 monitor=None, epoch: int = 0,
+                 acquire_timeout: Optional[float] = None,
+                 **device_kwargs):
+        from dmlc_core_tpu.tracker.client import current_monitor
+        self.uri = uri
+        self._monitor = monitor if monitor is not None else current_monitor()
+        if self._monitor is None:
+            raise DMLCError(
+                "ElasticDeviceRowBlockIter needs a heartbeat channel "
+                "(rendezvous with heartbeat=True under an elastic "
+                "tracker) — without leases there is no shard source")
+        self.num_shards = num_shards if num_shards is not None \
+            else env_int("DMLC_TRACKER_NUM_SHARDS", 0)
+        if self.num_shards <= 0:
+            raise DMLCError(
+                "ElasticDeviceRowBlockIter: num_shards must be > 0 (set "
+                "DMLC_TRACKER_NUM_SHARDS or pass num_shards=)")
+        self.epoch = epoch
+        self._acquire_timeout = acquire_timeout
+        self._device_kwargs = device_kwargs
+        self._current: Optional[DeviceRowBlockIter] = None
+        self._aborting = False
+
+    def __iter__(self):
+        while True:
+            shard = self._monitor.acquire_lease(
+                self.epoch, timeout=self._acquire_timeout)
+            if shard is None:
+                return  # epoch drained: every shard checked out
+            it = DeviceRowBlockIter(self.uri, part=shard,
+                                    npart=self.num_shards,
+                                    **self._device_kwargs)
+            self._current = it
+            try:
+                for batch in it:
+                    yield shard, batch
+                self._monitor.complete_lease(self.epoch, shard)
+            except TrackerAbortedError:
+                it.abort_drain("tracker-abort mid-shard")
+                raise
+            finally:
+                self._current = None
+                it.close()
+
+    def abort_drain(self, reason: str = "tracker-abort") -> None:
+        """Tear down the in-flight shard's device pipeline (bounded wall
+        clock; see DeviceRowBlockIter.abort_drain). Thread-safe enough
+        for a watchdog drain: _stop/queue ops are atomic, and a racing
+        consumer raises out of its queue wait."""
+        self._aborting = True
+        it = self._current
+        if it is not None:
+            it.abort_drain(reason)

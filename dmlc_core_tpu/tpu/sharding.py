@@ -26,14 +26,33 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dmlc_core_tpu.tracker.wire import env_int_opt
 
-__all__ = ["data_mesh", "batch_sharding", "packed_batch_sharding",
-           "replicated_sharding", "process_part", "local_device_count"]
+__all__ = ["data_mesh", "host_data_mesh", "batch_sharding",
+           "packed_batch_sharding", "replicated_sharding", "process_part",
+           "local_device_count"]
 
 
 def data_mesh(num_devices: Optional[int] = None,
               axis_name: str = "data") -> Mesh:
     """A 1-D mesh over (up to) all addressable devices for data parallelism."""
     devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def host_data_mesh(num_devices: Optional[int] = None,
+                   axis_name: str = "data") -> Mesh:
+    """A 1-D mesh over this PROCESS's devices only.
+
+    The compute mesh of the elastic-mesh CPU floor (doc/robustness.md
+    "Elastic mesh training"): XLA's CPU backend cannot run multiprocess
+    computations, so each host steps over its local mesh and the
+    cross-host reduction rides the coordination-service collectives
+    (parallel.allreduce_tree). On TPU, jit over the global
+    :func:`data_mesh` is the native path; this helper keeps the CPU
+    floor honest rather than silently global-meshing into a backend
+    error."""
+    devs = jax.local_devices()
     if num_devices is not None:
         devs = devs[:num_devices]
     return Mesh(np.array(devs), (axis_name,))

@@ -87,25 +87,43 @@ def submit_local(args) -> None:
     tracker with cmd=recover) — AppMaster-style supervision instead of the
     reference's in-line retry loop (local.py:12-49). With liveness enabled
     the supervisor is wired to the tracker both ways: dead ranks trigger a
-    proactive relaunch, exhausted attempts abort the job."""
+    proactive relaunch, exhausted attempts abort the job.
+
+    ``--mesh`` switches supervision from per-task to per-WORLD
+    (doc/robustness.md "Elastic mesh training"): a jax.distributed mesh
+    cannot admit a single relaunched rank mid-flight, so any worker death
+    aborts the tracker (max_attempts=0, no proactive relaunch) and
+    run_job relaunches the whole world — fresh tracker + coordinator
+    ports, every rank restarted together — resuming from the last
+    committed job checkpoint."""
     from dmlc_core_tpu.tracker.supervisor import (WorkerSupervisor,
                                                   popen_start_fn)
+    mesh = bool(getattr(args, "mesh", False))
 
     def launch(nworker: int, nserver: int, envs: Dict[str, object],
-               tracker=None) -> None:
-        sup = WorkerSupervisor(max_attempts=args.num_attempt)
+               tracker=None):
+        sup = WorkerSupervisor(
+            max_attempts=0 if mesh else args.num_attempt)
         for i in range(nworker + nserver):
             role = "worker" if i < nworker else "server"
             sup.add(i, role, popen_start_fn(args.command, role, i,
                                             dict(envs)))
         if tracker is not None:
-            sup.attach_tracker(tracker)
+            # mesh worlds never relaunch a single rank in place — the
+            # supervisor's only job is fail-fast world teardown
+            sup.attach_tracker(tracker,
+                               proactive_relaunch=False if mesh else None)
         sup.launch()  # spawn errors raise here, in the submitting caller
         sup.watch_in_thread()
+        # run_job invokes this stopper before a world relaunch so the old
+        # attempt's surviving processes die before the new world binds
+        return sup.stop
 
     rendezvous.run_job(args.num_workers, args.num_servers, launch,
                        host_ip=args.host_ip or "auto",
-                       ps_cmd=" ".join(args.command))
+                       ps_cmd=" ".join(args.command),
+                       mesh=mesh,
+                       world_attempts=getattr(args, "world_attempts", None))
 
 
 # -- ssh ---------------------------------------------------------------------

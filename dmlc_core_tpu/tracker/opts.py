@@ -67,6 +67,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(DMLC_TRACKER_LEASE_TTL_MS; renewal piggybacks "
                         "on every heartbeat; default --dead-after-ms + "
                         "--recover-grace-ms)")
+    p.add_argument("--mesh", action="store_true",
+                   help="elastic-mesh world (local backend): workers get a "
+                        "DMLC_COORDINATOR_ADDRESS for "
+                        "jax.distributed.initialize, any rank death aborts "
+                        "the world (no single-rank relaunch into a live "
+                        "mesh), and the whole world is relaunched — fresh "
+                        "tracker + coordinator ports — resuming from the "
+                        "last committed job checkpoint")
+    p.add_argument("--world-attempts", default=None, type=int,
+                   help="whole-world relaunches after a mesh abort "
+                        "(DMLC_TRACKER_WORLD_ATTEMPTS; default 2 with "
+                        "--mesh, 0 otherwise)")
     p.add_argument("--archives", default=[], action="append",
                    help="archive (.zip/.tar*) the in-container bootstrap "
                         "unpacks before exec (reference opts.py archives); "

@@ -69,9 +69,9 @@ from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
                                         LEASE_RELEASE, MAGIC,
                                         TELEMETRY_PULL, TELEMETRY_PUSH,
                                         TELEMETRY_PUSH_MAX,
-                                        TrackerAbortedError, bind_free_port,
-                                        env_float, env_int, guess_host_ip,
-                                        resolve_ip)
+                                        TrackerAbortedError, addr_family,
+                                        bind_free_port, env_float, env_int,
+                                        guess_host_ip, resolve_ip)
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
 
@@ -391,7 +391,8 @@ class RabitTracker:
                  recover_grace_ms: Optional[int] = None,
                  event_log: Optional[str] = None,
                  num_shards: Optional[int] = None,
-                 lease_ttl_ms: Optional[int] = None):
+                 lease_ttl_ms: Optional[int] = None,
+                 abort_on_lost: Optional[bool] = None):
         self.host_ip = host_ip
         self.num_workers = num_workers
         self.listener = bind_free_port(host_ip, port, port_end)
@@ -450,6 +451,15 @@ class RabitTracker:
         self._leases: Optional[_LeaseManager] = \
             _LeaseManager(self.num_shards, self.lease_ttl_ms, self._lock) \
             if self.num_shards > 0 else None
+        # mesh mode: a written-off rank still reclaims its leases (so the
+        # flight dump names what it held) but then ABORTS the world instead
+        # of degrading — survivors of a SIGKILL'd mesh peer hold live
+        # jax.distributed state that cannot absorb the dead rank's model
+        # shards, so the only sound recovery is a supervised world relaunch
+        # from the last committed job checkpoint (doc/robustness.md
+        # "Elastic mesh training")
+        self.abort_on_lost = abort_on_lost if abort_on_lost is not None \
+            else env_int("DMLC_TRACKER_ABORT_ON_LOST", 0) != 0
         self._lost_ranks: Set[int] = set()
         self._dead_callbacks: List[Callable[[int, Dict[str, object]], None]] \
             = []
@@ -837,9 +847,20 @@ class RabitTracker:
             if every_dance_done:
                 # elastic: degrade gracefully instead of failing loudly —
                 # the rank is written off, its leases migrate to the
-                # survivors, and the epoch completes without a relaunch
+                # survivors, and the epoch completes without a relaunch.
+                # _mark_lost FIRST even in mesh mode: the reclaim emits the
+                # lease-reclaim events + flight dump that name exactly which
+                # shards the dead rank held when it died
                 for rank in expired:
                     self._mark_lost(rank)
+                if self.abort_on_lost:
+                    with self._lock:
+                        lost = sorted(self._lost_ranks)
+                    self._do_abort(TrackerAbortedError(
+                        f"mesh rank(s) {sorted(expired)} lost mid-step: the "
+                        f"surviving mesh cannot absorb their model shards; "
+                        f"aborting the world for a supervised relaunch from "
+                        f"the last committed checkpoint", lost))
                 self._check_finished()
                 return
             # a rank died before the rendezvous completed: survivors may
@@ -889,10 +910,13 @@ class RabitTracker:
         for epoch, shard in reclaimed:
             self._emit("lease-reclaim", rank=rank, epoch=epoch, shard=shard)
         # flight recorder (doc/observability.md): the write-off ships its
-        # own postmortem — the event ring's lease-grant/lease-reclaim
-        # records name the shards the dead rank held
+        # own postmortem, and the dump reason itself names the shards the
+        # dead rank held (the event ring carries the same facts, but the
+        # reason line is what a human greps first)
+        held = ", ".join(f"{e}:{s}" for e, s in reclaimed) or "none"
         telemetry.flight_dump(f"rank-lost: rank {rank} written off, "
-                              f"{len(reclaimed)} lease(s) reclaimed")
+                              f"{len(reclaimed)} lease(s) reclaimed "
+                              f"(epoch:shard {held})")
 
     def _check_finished(self) -> None:
         """Elastic finish rule (serve loop only): the job completes once
@@ -1660,42 +1684,115 @@ class PSTracker:
         return self.thread is not None and self.thread.is_alive()
 
 
+def _free_coordinator_port(host_ip: str) -> int:
+    """A fresh ephemeral port for the jax.distributed coordination service.
+
+    Derived per world attempt — NEVER reused across a relaunch: a
+    SIGKILL'd coordinator can leave its old port in TIME_WAIT (or held by
+    an undead worker mid-teardown), and `jax.distributed.initialize` on a
+    stale address is an EADDRINUSE or a silent cross-talk with the dead
+    world. The kernel picks the port; the tiny bind-then-close race is
+    acceptable for a coordinator that binds within milliseconds."""
+    s = socket.socket(addr_family(host_ip), socket.SOCK_STREAM)
+    try:
+        s.bind((host_ip, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
 def run_job(num_workers: int, num_servers: int, launch_fn, host_ip="auto",
             ps_cmd: Optional[str] = None,
             heartbeat_ms: Optional[int] = None,
             dead_after_ms: Optional[int] = None,
-            num_shards: Optional[int] = None) -> None:
+            num_shards: Optional[int] = None,
+            mesh: bool = False,
+            world_attempts: Optional[int] = None,
+            abort_on_lost: Optional[bool] = None) -> None:
     """Start the right tracker and hand worker envs to a cluster launcher
     (reference tracker.submit, tracker.py:410-433). A launch_fn accepting
     a 4th argument receives the RabitTracker so supervising backends can
-    wire dead-rank notifications both ways (supervisor.attach_tracker)."""
+    wire dead-rank notifications both ways (supervisor.attach_tracker).
+
+    ``mesh=True`` runs an elastic-mesh world (doc/robustness.md "Elastic
+    mesh training"): workers get a ``DMLC_COORDINATOR_ADDRESS`` for
+    `jax.distributed.initialize` (parallel.distributed.init_from_env), a
+    lost rank aborts the world instead of degrading, and a
+    TrackerAbortedError triggers a WHOLE-WORLD relaunch — fresh tracker,
+    fresh coordinator port (the dead one may sit in TIME_WAIT), fresh
+    worker processes resuming from the last committed job checkpoint — up
+    to ``world_attempts`` times (env ``DMLC_TRACKER_WORLD_ATTEMPTS``).
+    The launch_fn's return value, when callable, is invoked before each
+    relaunch to stop the previous attempt's surviving processes
+    (submit_local returns its supervisor's ``stop``)."""
     host_ip = guess_host_ip(host_ip)
-    envs = {"DMLC_NUM_WORKER": num_workers,
-            "DMLC_NUM_SERVER": num_servers}
     if num_servers == 0:
-        tracker = RabitTracker(host_ip, num_workers,
-                               heartbeat_ms=heartbeat_ms,
-                               dead_after_ms=dead_after_ms,
-                               num_shards=num_shards)
-        envs.update(tracker.worker_envs())
-        tracker.start()
-        if tracker.alive():
-            import inspect
-            # pass the tracker only if launch_fn can BIND a 4th positional
-            # arg — counting raw parameters would miscount keyword-only /
-            # **kwargs signatures and crash previously-working callbacks
+        attempts = world_attempts if world_attempts is not None \
+            else env_int("DMLC_TRACKER_WORLD_ATTEMPTS", 2 if mesh else 0)
+        attempt = 0
+        while True:
+            envs = {"DMLC_NUM_WORKER": num_workers,
+                    "DMLC_NUM_SERVER": num_servers}
+            tracker = RabitTracker(
+                host_ip, num_workers,
+                heartbeat_ms=heartbeat_ms,
+                dead_after_ms=dead_after_ms,
+                num_shards=num_shards,
+                abort_on_lost=abort_on_lost if abort_on_lost is not None
+                else (True if mesh else None))
+            envs.update(tracker.worker_envs())
+            if mesh:
+                # the coordination service address is re-derived EVERY
+                # attempt through the same ephemeral-bind path that
+                # releases tracker ports (stop() -> _close_all): reusing
+                # the dead world's port is the EADDRINUSE trap the
+                # relaunch test pins
+                envs["DMLC_COORDINATOR_ADDRESS"] = \
+                    f"{host_ip}:{_free_coordinator_port(host_ip)}"
+                envs["DMLC_WORLD_ATTEMPT"] = attempt
+            tracker.start()
+            stopper = None
+            if tracker.alive():
+                import inspect
+                # pass the tracker only if launch_fn can BIND a 4th
+                # positional arg — counting raw parameters would miscount
+                # keyword-only / **kwargs signatures and crash
+                # previously-working callbacks
+                try:
+                    inspect.signature(launch_fn).bind(
+                        num_workers, num_servers, envs, tracker)
+                    takes_tracker = True
+                except (TypeError, ValueError):
+                    takes_tracker = False
+                if takes_tracker:
+                    ret = launch_fn(num_workers, num_servers, envs, tracker)
+                else:
+                    ret = launch_fn(num_workers, num_servers, envs)
+                stopper = ret if callable(ret) else None
             try:
-                inspect.signature(launch_fn).bind(
-                    num_workers, num_servers, envs, tracker)
-                takes_tracker = True
-            except (TypeError, ValueError):
-                takes_tracker = False
-            if takes_tracker:
-                launch_fn(num_workers, num_servers, envs, tracker)
-            else:
-                launch_fn(num_workers, num_servers, envs)
-        tracker.join()
+                tracker.join()
+                return
+            except TrackerAbortedError:
+                attempt += 1
+                if attempt > attempts:
+                    raise
+                telemetry.counter("tracker_world_relaunches_total").inc()
+                logger.warning(
+                    "world attempt %d aborted; relaunching (%d attempt(s) "
+                    "left)", attempt - 1, attempts - attempt + 1)
+                # stop the dead world completely before binding the next:
+                # surviving worker processes are torn down first (they
+                # hold mesh state for a world that no longer exists), then
+                # the tracker port is released through stop()
+                if stopper is not None:
+                    try:
+                        stopper()
+                    except Exception:
+                        logger.exception("world stop callback failed")
+                tracker.stop()
     else:
+        envs = {"DMLC_NUM_WORKER": num_workers,
+                "DMLC_NUM_SERVER": num_servers}
         ps = PSTracker(host_ip, ps_cmd, envs=envs)
         envs.update(ps.worker_envs())
         if ps.alive() or ps.cmd is None:

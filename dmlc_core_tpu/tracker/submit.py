@@ -29,7 +29,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                       ("dead_after_ms", "DMLC_TRACKER_DEAD_AFTER_MS"),
                       ("recover_grace_ms", "DMLC_TRACKER_RECOVER_GRACE_MS"),
                       ("num_shards", "DMLC_TRACKER_NUM_SHARDS"),
-                      ("lease_ttl_ms", "DMLC_TRACKER_LEASE_TTL_MS")):
+                      ("lease_ttl_ms", "DMLC_TRACKER_LEASE_TTL_MS"),
+                      ("world_attempts", "DMLC_TRACKER_WORLD_ATTEMPTS")):
         v = getattr(args, flag, None)
         if v is not None:
             os.environ[env] = str(v)

@@ -6,11 +6,16 @@ from dmlc_core_tpu.utils.timer import (Timer, get_time,  # noqa: F401
                                        trace_span)
 
 __all__ = ["CheckpointError", "save_checkpoint", "restore_checkpoint",
-           "fast_forward", "Timer", "get_time", "trace_span",
+           "fast_forward", "job_part_uri", "job_commit_uri",
+           "save_job_checkpoint", "commit_job_checkpoint",
+           "restore_job_checkpoint", "Timer", "get_time", "trace_span",
            "span_totals", "reset_span_totals"]
 
 _CHECKPOINT_NAMES = ("CheckpointError", "save_checkpoint",
-                     "restore_checkpoint", "fast_forward")
+                     "restore_checkpoint", "fast_forward",
+                     "job_part_uri", "job_commit_uri",
+                     "save_job_checkpoint", "commit_job_checkpoint",
+                     "restore_job_checkpoint")
 
 
 def __getattr__(name):

@@ -51,7 +51,9 @@ from dmlc_core_tpu.serializer import BinaryReader, BinaryWriter
 from dmlc_core_tpu.utils import fs_fault
 
 __all__ = ["CheckpointError", "save_checkpoint", "restore_checkpoint",
-           "fast_forward"]
+           "fast_forward", "job_part_uri", "job_commit_uri",
+           "save_job_checkpoint", "commit_job_checkpoint",
+           "restore_job_checkpoint"]
 
 _MAGIC = b"DCTCKPT1"
 
@@ -418,6 +420,168 @@ def restore_checkpoint(uri: str, like: Any = None
         leaves.append(jax.device_put(arr, sharding) if sharding is not None
                       else arr)
     params = jax.tree_util.tree_unflatten(like_flat[1], leaves)
+    return params, step, extra
+
+
+# -- job-level two-phase checkpoints ----------------------------------------
+# A multi-host world (doc/robustness.md "Elastic mesh training") cannot
+# trust per-host checkpoints alone: a kill BETWEEN per-host saves leaves
+# host 0 at step N+1 and host 1 at step N, and a restore that reads
+# whatever file each host finds resumes a mixed-step world that silently
+# diverges. The two-phase protocol makes the job checkpoint atomic:
+#
+#   phase 1  every host publishes `<base>.step<N>.part<k>of<n>` through
+#            the atomic per-host path above (save_job_checkpoint);
+#   phase 2  rank 0 verifies every part of step N is complete, then
+#            atomically publishes `<base>.commit` — a tiny JSON marker
+#            naming the step and the full part set (commit_job_checkpoint).
+#
+# restore_job_checkpoint trusts ONLY the marker: parts newer than the
+# committed step are invisible (the torn-set fallback), a part named by
+# the marker but missing or truncated is a loud error, and a missing
+# marker means "fresh start". The marker itself is overwritten in place
+# atomically, so it always names exactly one fully-published step.
+
+_JOB_SCHEMA = 1
+
+
+def job_part_uri(base: str, step: int, part: int, npart: int) -> str:
+    """The per-host part URI for job step ``step``: step-qualified so a
+    later step's save can never overwrite a committed step's bytes."""
+    return f"{base}.step{int(step)}.part{int(part)}of{int(npart)}"
+
+
+def job_commit_uri(base: str) -> str:
+    """The job commit-marker URI (one per job; overwritten atomically)."""
+    return f"{base}.commit"
+
+
+def save_job_checkpoint(base: str, params: Any, step: int, part: int,
+                        npart: int,
+                        extra: Optional[Dict[str, str]] = None) -> str:
+    """Phase 1: publish this host's part of job step ``step`` atomically.
+    Returns the part URI. The step is NOT resumable until rank 0 runs
+    :func:`commit_job_checkpoint`."""
+    uri = job_part_uri(base, step, part, npart)
+    save_checkpoint(uri, params, step=step, extra=extra)
+    return uri
+
+
+def _part_is_complete(uri: str) -> bool:
+    """True when the part URI holds a structurally complete checkpoint.
+    Local parts are walked byte-for-byte (_is_complete_body); remote
+    parts were size-verified by their own save, so presence with a
+    plausible size is the check."""
+    path = _local_path(uri)
+    if path is not None:
+        return _is_complete_body(path)
+    try:
+        size, is_dir = path_info(uri)
+        return not is_dir and size > len(_MAGIC)
+    except (DMLCError, OSError):
+        return False
+
+
+def commit_job_checkpoint(base: str, step: int, npart: int) -> str:
+    """Phase 2 (rank 0 only): verify every part of ``step`` is complete,
+    then atomically publish the commit marker naming the full set.
+
+    Raises :class:`CheckpointError` — previous marker untouched — when
+    any part is missing or truncated: committing a torn set would be
+    exactly the mixed-step resume this protocol exists to prevent."""
+    import json
+    parts = [job_part_uri(base, step, p, npart) for p in range(npart)]
+    for uri in parts:
+        if not _part_is_complete(uri):
+            raise _ckpt_fail(
+                job_commit_uri(base), "commit",
+                DMLCError(f"part {uri} is missing or incomplete; refusing "
+                          f"to commit a torn step-{step} set"),
+                guarantee="the previous commit marker is untouched — "
+                          "restore still resumes the last committed step")
+    body = json.dumps({"schema": _JOB_SCHEMA, "step": int(step),
+                       "npart": int(npart), "parts": parts},
+                      sort_keys=True).encode()
+    marker = job_commit_uri(base)
+    path = _local_path(marker)
+    if path is None:
+        try:
+            _put_verified(marker, body)
+        except (DMLCError, OSError) as e:
+            raise _ckpt_fail(marker, "commit", e) from e
+        return marker
+    # local marker: same temp+fsync+rename shape as _save_local, minus the
+    # checkpoint body format (the marker is JSON, not a pytree)
+    import uuid
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    try:
+        fs_fault.maybe_inject("open", tmp)
+        with open(tmp, "wb") as f:
+            fs_fault.checked_write(f.write, body, tmp)
+            f.flush()
+            fs_fault.checked_fsync(f.fileno(), tmp)
+        fs_fault.checked_replace(tmp, path)
+    except BaseException as e:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        if isinstance(e, Exception):
+            raise _ckpt_fail(marker, "commit", e) from e
+        raise
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    return marker
+
+
+def restore_job_checkpoint(base: str, part: int, npart: int,
+                           like: Any = None
+                           ) -> Optional[Tuple[Any, int, Dict[str, str]]]:
+    """Restore this host's part of the last COMMITTED job step.
+
+    Returns None when no commit marker exists (fresh start). Parts
+    published after the committed step are ignored — a kill between
+    phase-1 saves falls back to the marker's step, never a mixed-step
+    world. Raises when the marker disagrees with this world's ``npart``
+    (resuming 2 hosts' parts on 3 hosts slices the stream differently),
+    when a committed part is missing/corrupt, or when the part's recorded
+    step disagrees with the marker."""
+    import json
+    marker = job_commit_uri(base)
+    try:
+        raw = _read_all(marker)
+    except (DMLCError, OSError):
+        return None
+    try:
+        meta = json.loads(raw.decode())
+        step = int(meta["step"])
+        m_npart = int(meta["npart"])
+        parts = list(meta["parts"])
+    except (ValueError, KeyError, TypeError) as e:
+        raise DMLCError(
+            f"corrupt job commit marker {marker}: {e}") from e
+    if m_npart != int(npart) or len(parts) != m_npart:
+        raise DMLCError(
+            f"job checkpoint {marker} was committed by {m_npart} host(s) "
+            f"but this world has {npart}: the per-part streams do not "
+            f"line up; start fresh or restore with the original world "
+            f"size")
+    if not 0 <= int(part) < m_npart:
+        raise DMLCError(f"part {part} out of range for {marker} "
+                        f"({m_npart} parts)")
+    params, got_step, extra = restore_checkpoint(parts[int(part)],
+                                                 like=like)
+    if got_step != step:
+        raise DMLCError(
+            f"job commit marker {marker} names step {step} but part "
+            f"{parts[int(part)]} holds step {got_step}: the marker and "
+            f"the part set disagree — refusing a mixed-step resume")
     return params, step, extra
 
 

@@ -97,7 +97,10 @@ def main() -> int:
     ap.add_argument("--checkpoint", default="",
                     help="URI to write params + step each --ckpt-every "
                          "steps (any stream scheme: file/s3/hdfs/azure). "
-                         "Multi-host runs write one file per host "
+                         "jax.distributed worlds write a TWO-PHASE job "
+                         "checkpoint (per-host parts + rank-0 commit "
+                         "marker; torn step sets are unresumable); other "
+                         "multi-host runs write one file per host "
                          "(.partK suffix appended). Saving params whose "
                          "model axis spans HOSTS is out of this "
                          "example's scope (shards must be addressable)")
@@ -115,9 +118,29 @@ def main() -> int:
     from dmlc_core_tpu.tpu.sharding import process_part
 
     init_from_env()  # multi-host: jax.distributed under dmlc-submit
+
+    # elastic-mesh check-in (doc/robustness.md "Elastic mesh training"):
+    # under dmlc-submit the worker joins the tracker rendezvous, which
+    # opens the heartbeat channel (env-gated) — the abort broadcast and
+    # the step watchdog below are what turn a SIGKILL'd peer into a
+    # structured between-steps abort instead of a hung collective
+    client = assign = None
+    if os.environ.get("DMLC_TRACKER_URI"):
+        from dmlc_core_tpu.tracker.client import RendezvousClient
+        from dmlc_core_tpu.tracker.wire import env_int
+        client = RendezvousClient(os.environ["DMLC_TRACKER_URI"],
+                                  env_int("DMLC_TRACKER_PORT", 9091))
+        assign = client.start(heartbeat=None)
+
+    nproc = jax.process_count()
     axes = parse_mesh(args.mesh)
     need = int(np.prod([n for _, n in axes]))
-    devs = jax.devices()
+    # multi-process worlds step over this HOST's mesh and keep replicas
+    # identical with a cross-host parameter mean (allreduce_tree below):
+    # works on every backend — XLA's CPU floor cannot run multiprocess
+    # computations at all (tpu/sharding.host_data_mesh), and on TPU the
+    # reduction rides ICI/DCN through the same helper
+    devs = jax.local_devices() if nproc > 1 else jax.devices()
     if len(devs) < need:
         raise SystemExit(f"mesh {args.mesh} needs {need} devices, "
                          f"have {len(devs)}")
@@ -167,6 +190,7 @@ def main() -> int:
 
     params = model.init(seed=args.seed)
     part, npart = process_part()
+    mesh_world = nproc > 1
     # one checkpoint file per host: concurrent writers to a shared URI
     # would clobber each other
     suffix = f".part{part}of{npart}" if npart > 1 else ""
@@ -176,7 +200,31 @@ def main() -> int:
                 "seq": str(args.seq), "batch": str(batch),
                 "seed": str(args.seed), "part": f"{part}/{npart}"}
     start = 0
-    if args.resume:
+    if args.resume and mesh_world:
+        # two-phase job checkpoint: ONLY a committed marker is
+        # resumable — a torn step set (some hosts saved step N, others
+        # died first) is invisible, and restore falls back to whatever
+        # the marker last named. A missing marker (relaunch before the
+        # first commit) means a fresh start, which is exactly what a
+        # supervised world-relaunch with the original command line
+        # needs.
+        from dmlc_core_tpu.utils import restore_job_checkpoint
+        got = restore_job_checkpoint(args.resume, part, npart,
+                                     like=params)
+        if got is None:
+            print("no committed job checkpoint yet; starting fresh",
+                  flush=True)
+        else:
+            params, start, extra = got
+            mismatch = {k: (extra.get(k), v) for k, v in identity.items()
+                        if extra.get(k) != v}
+            if mismatch:
+                raise SystemExit(
+                    f"checkpoint was written under a different run "
+                    f"identity (stored vs now): {mismatch}")
+            print(f"resumed from committed job checkpoint {args.resume} "
+                  f"at step {start}", flush=True)
+    elif args.resume:
         # restore onto the template's shardings (preemption recovery)
         params, start, extra = restore_checkpoint(args.resume + suffix,
                                                   like=params)
@@ -187,25 +235,78 @@ def main() -> int:
                 f"checkpoint was written under a different run identity "
                 f"(stored vs now): {mismatch}")
         print(f"resumed from {args.resume}{suffix} at step {start}")
-    data = load_corpus(args.corpus, args.seq)
-    first = last = None
-    for step in range(start, args.steps):
-        # per-step seeding: no sampler replay needed on resume — step s
-        # draws the same global windows whether or not the run restarted
-        w = byte_windows(data, args.seq, batch, args.seed, step,
-                         part, npart)
-        params, loss = model.step(params, w[:, :-1], w[:, 1:])
-        last = float(loss)
-        if first is None:
-            first = last
-        print(f"step {step}: loss {last:.4f}", flush=True)
-        if args.checkpoint and (step + 1) % args.ckpt_every == 0:
+
+    def save_ckpt(at_step):
+        if mesh_world:
+            from dmlc_core_tpu.parallel import barrier
+            from dmlc_core_tpu.utils import (commit_job_checkpoint,
+                                             save_job_checkpoint)
+            save_job_checkpoint(args.checkpoint, params, at_step,
+                                part, npart, extra=identity)
+            # every host must have PUBLISHED its part before rank 0
+            # names the set in the commit marker; a host that dies
+            # before the barrier leaves step at_step torn and therefore
+            # unresumable — by design
+            barrier(f"ckpt-{at_step}")
+            if part == 0:
+                commit_job_checkpoint(args.checkpoint, at_step, npart)
+        else:
             save_checkpoint(args.checkpoint + suffix, params,
-                            step=step + 1, extra=identity)
-    if (args.checkpoint and last is not None
-            and args.steps % args.ckpt_every != 0):  # not already saved
-        save_checkpoint(args.checkpoint + suffix, params,
-                        step=args.steps, extra=identity)
+                            step=at_step, extra=identity)
+
+    data = load_corpus(args.corpus, args.seq)
+    from dmlc_core_tpu.parallel import (STEP_ABORT_EXIT, StepWatchdog,
+                                        allreduce, allreduce_tree,
+                                        structured_abort)
+    from dmlc_core_tpu.tracker.wire import TrackerAbortedError
+    rank = assign.rank if assign is not None else part
+    wd = step = None
+    first = last = None
+    try:
+        if mesh_world or os.environ.get("DMLC_TRACKER_URI"):
+            wd = StepWatchdog(rank=rank).start()
+        for step in range(start, args.steps):
+            if wd is not None:
+                wd.step_begin(step)
+            # per-step seeding: no sampler replay needed on resume —
+            # step s draws the same global windows whether or not the
+            # run restarted
+            w = byte_windows(data, args.seq, batch, args.seed, step,
+                             part, npart)
+            params, loss = model.step(params, w[:, :-1], w[:, 1:])
+            if mesh_world:
+                # host-local step + cross-host parameter mean == the
+                # global-batch update (equal per-host batches), and the
+                # rank-ordered reduction makes every replica (and every
+                # rerun of the same schedule) bit-identical
+                params = allreduce_tree(params, "mean")
+                loss = allreduce(np.asarray(loss, np.float32), "mean")
+            if wd is not None:
+                wd.step_end()
+            last = float(loss)
+            if first is None:
+                first = last
+            print(f"step {step}: loss {last:.4f}", flush=True)
+            if args.checkpoint and (step + 1) % args.ckpt_every == 0:
+                save_ckpt(step + 1)
+        if (args.checkpoint and last is not None
+                and args.steps % args.ckpt_every != 0):  # not saved yet
+            save_ckpt(args.steps)
+    except TrackerAbortedError as e:
+        # a peer died: the tracker broadcast the abort and check()
+        # surfaced it BETWEEN steps — drain, leave the postmortem
+        # record, and exit with the structured code the supervisor maps
+        # to "relaunch the world from the last committed checkpoint"
+        if wd is not None:
+            wd.drain()
+        at = f" at step {step}" if step is not None else ""
+        structured_abort(f"train_lm{at}: {e}", rank=rank)
+        return STEP_ABORT_EXIT
+    finally:
+        if wd is not None:
+            wd.stop()
+    if client is not None:
+        client.shutdown(rank)
     if last is None:
         print(f"nothing to do: resume step {start} >= --steps {args.steps}")
         return 0

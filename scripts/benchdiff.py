@@ -52,13 +52,21 @@ GOOD_LEAVES = {
     "ranged_vs_local", "achieved_qps",
     "hbm_ingest_rows_per_sec", "overlap_ratio",
     "hbm_ingest_bw_util", "hbm_ingest_bw_util_best",
+    "steps_per_sec",
+}
+
+# lane leaves that are comparable but LOWER-is-better (latencies,
+# recovery times): flat_metrics carries them and compare() inverts the
+# ratio so "REGRESSION" still means "got worse"
+LOW_LEAVES = {
+    "recovery_s",
 }
 
 # extras entries that are lanes worth carrying into the ledger
 LANE_KEYS = ("cache_lane", "remote_lane", "csv_lane", "libfm_lane",
              "recordio_roundtrip", "rec_lane", "crec_lane", "recd_lane",
              "host_lane_rates", "thread_scaling", "serving_lane",
-             "device_lane")
+             "device_lane", "mesh_lane")
 
 
 def lanes_from_extras(extras: dict) -> dict:
@@ -165,7 +173,7 @@ def flat_metrics(record: dict) -> dict:
     for lane, leaves in (record.get("lanes") or {}).items():
         for leaf, v in leaves.items():
             if lane == "thread_scaling" or leaf in GOOD_LEAVES or \
-                    lane == "host_lane_rates":
+                    leaf in LOW_LEAVES or lane == "host_lane_rates":
                 out[f"{lane}.{leaf}"] = float(v)
     return out
 
@@ -202,6 +210,10 @@ def compare(a: dict, b: dict, band: float, trail: list) -> int:
         if va == 0:
             continue
         ratio = vb / va
+        if m.rpartition(".")[2] in LOW_LEAVES:
+            # lower-is-better leaf (recovery time): invert so ratio<1
+            # still reads "got worse"
+            ratio = va / vb if vb else 0.0
         eff_band = max(band, 2.0 * trailing_cv(trail, m))
         verdict = "ok"
         if ratio < 1.0 - eff_band:

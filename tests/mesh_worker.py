@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Chaos-suite mesh worker: lease data-plane × real jax.distributed mesh.
+
+The purpose-built worker for tests/test_elastic_mesh.py — the smallest
+program that exercises the WHOLE elastic-mesh stack at once
+(doc/robustness.md "Elastic mesh training"):
+
+- ``init_from_env`` joins the coordination service the tracker's mesh
+  mode exported (``DMLC_COORDINATOR_ADDRESS``), so every collective below
+  is a REAL cross-process operation, not a mock;
+- the tracker rendezvous opens the heartbeat channel and the lease
+  data-plane (``RendezvousClient.start``);
+- every step acquires a shard lease, touches a progress file (the chaos
+  test's kill trigger), crosses a KV-store allgather — the collective a
+  survivor is parked in when a peer is SIGKILL'd — and completes the
+  lease;
+- a :class:`StepWatchdog` turns a mid-step death into a bounded
+  structured abort: between steps via check()'s raise, mid-collective
+  via the poll thread's drain + ``os._exit(STEP_ABORT_EXIT)``.
+
+Usage: mesh_worker.py <progress_dir> [steps] [step_sleep_s]
+
+Exit codes: 0 = ran every step; STEP_ABORT_EXIT (41) = structured abort.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    progress_dir = sys.argv[1]
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    step_sleep = float(sys.argv[3]) if len(sys.argv) > 3 else 0.05
+
+    from dmlc_core_tpu.parallel import (STEP_ABORT_EXIT, StepWatchdog,
+                                        allgather_bytes, init_from_env,
+                                        structured_abort)
+    from dmlc_core_tpu.tracker.client import RendezvousClient
+    from dmlc_core_tpu.tracker.wire import TrackerAbortedError, env_int
+
+    init_from_env()
+    client = RendezvousClient(os.environ["DMLC_TRACKER_URI"],
+                              env_int("DMLC_TRACKER_PORT", 9091))
+    assign = client.start(heartbeat=None)
+    rank = assign.rank
+    from dmlc_core_tpu.tracker.client import current_monitor
+    mon = current_monitor()
+    num_shards = env_int("DMLC_TRACKER_NUM_SHARDS", 0)
+
+    wd = StepWatchdog(rank=rank).start()
+    held = None  # (epoch, shard) while this rank holds a lease
+
+    def release_held():
+        # park the lease back in the pool so a survivor can pick it up
+        # (best-effort: on a tracker abort the pool is gone anyway)
+        if held is not None and mon is not None:
+            mon.release_lease(*held)
+
+    wd.add_drain(release_held)
+    step = None
+    try:
+        for step in range(steps):
+            wd.step_begin(step)
+            if mon is not None and num_shards > 0:
+                # complete the PREVIOUS step's lease only after the next
+                # one is granted: past its first acquire this rank holds
+                # a lease at every instant, so a SIGKILL provably lands
+                # while shards are held (the flight-dump pin)
+                shard = mon.acquire_lease(step, timeout=30.0)
+                if shard is not None:
+                    if held is not None:
+                        mon.complete_lease(*held)
+                    held = (step, shard)
+            # the kill trigger: the chaos test waits until every rank has
+            # progressed past step 0 before choosing its victim, so the
+            # SIGKILL provably lands MID-RUN (often mid-lease, mid-step)
+            with open(os.path.join(progress_dir, f"rank{rank}.progress"),
+                      "w") as f:
+                f.write(f"{step} {os.getpid()}\n")
+            time.sleep(step_sleep)
+            # the collective survivors park in when a peer dies: every
+            # rank must contribute its blob before anyone proceeds
+            blobs = allgather_bytes(f"{rank}:{step}".encode(),
+                                    name=f"step{step}")
+            assert len(blobs) == int(os.environ["DMLC_NUM_WORKER"])
+            if held is not None:
+                mon.complete_lease(*held)
+                held = None
+            wd.step_end()
+    except TrackerAbortedError as e:
+        wd.drain()
+        structured_abort(f"mesh_worker rank {rank} at step {step}: {e}",
+                         rank=rank)
+        return STEP_ABORT_EXIT
+    finally:
+        wd.stop()
+    if held is not None:
+        mon.complete_lease(*held)
+    client.shutdown(rank)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

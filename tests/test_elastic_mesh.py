@@ -1,0 +1,496 @@
+"""Elastic mesh chaos suite (doc/robustness.md "Elastic mesh training").
+
+SIGKILLs real worker processes of real jax.distributed worlds mid-step
+and pins the recovery contract:
+
+- unsupervised world: every survivor surfaces a STRUCTURED abort (exit
+  STEP_ABORT_EXIT, abort record written, flight dump naming the dead
+  rank's held shards) within 2x DMLC_TRACKER_DEAD_AFTER_MS of the kill —
+  wall-clock-asserted, never a hung collective;
+- supervised world (dmlc-submit --cluster local --mesh): the whole world
+  relaunches on a FRESH coordinator address and resumes from the last
+  committed job checkpoint, with every resumed step's loss identical to
+  the uninterrupted run's;
+- torn job checkpoints (some hosts published step N, others died first)
+  are uncommittable and invisible to restore;
+- a no-chaos N-process mesh run prints the same per-step losses as the
+  single-process run over the same global batch (the mean-of-host-updates
+  == global-update identity).
+
+The multi-process tests are @pytest.mark.slow: tier-1 runs the
+in-process pins, `make mesh` runs the whole file.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.base import DMLCError
+from dmlc_core_tpu.parallel import STEP_ABORT_EXIT
+from dmlc_core_tpu.tracker import rendezvous
+from dmlc_core_tpu.tracker.wire import TrackerAbortedError
+from dmlc_core_tpu.utils import (commit_job_checkpoint, job_commit_uri,
+                                 job_part_uri, restore_job_checkpoint,
+                                 save_job_checkpoint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MESH_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "mesh_worker.py")
+TRAIN_LM = os.path.join(REPO, "examples", "train_lm.py")
+
+
+def _worker_env(envs, task_id, **extra):
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in envs.items()})
+    env["DMLC_TASK_ID"] = str(task_id)
+    env["DMLC_ROLE"] = "worker"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = REPO
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _wait_progress(progress_dir, nworkers, timeout=90.0):
+    """Block until every rank's progress file reports step >= 1; returns
+    {rank: pid}."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pids = {}
+        for rank in range(nworkers):
+            path = os.path.join(progress_dir, f"rank{rank}.progress")
+            try:
+                with open(path) as f:
+                    step, pid = f.read().split()
+                if int(step) >= 1:
+                    pids[rank] = int(pid)
+            except (OSError, ValueError):
+                pass
+        if len(pids) == nworkers:
+            return pids
+        time.sleep(0.05)
+    raise AssertionError(f"world never progressed past step 0 "
+                         f"(got {sorted(pids)})")
+
+
+# -- unsupervised: SIGKILL -> bounded structured abort on every survivor ----
+@pytest.mark.slow
+def test_sigkill_unsupervised_survivors_abort_bounded(tmp_path,
+                                                      monkeypatch):
+    nworkers = 3
+    dead_after_ms = 1200
+    progress = tmp_path / "progress"
+    progress.mkdir()
+    dumps = tmp_path / "dumps"
+    records = tmp_path / "aborts.jsonl"
+    # the tracker runs IN-PROCESS (run_job below), so its flight dumps
+    # honor this process's DMLC_TRACE_DUMP; workers inherit it too
+    monkeypatch.setenv("DMLC_TRACE_DUMP", str(dumps))
+    monkeypatch.setenv("DMLC_TRACKER_RECOVER_GRACE_MS", "300")
+    procs = []
+
+    def launch(nw, ns, envs, tracker=None):
+        for i in range(nw):
+            procs.append(subprocess.Popen(
+                [sys.executable, MESH_WORKER, str(progress), "500", "0.05"],
+                env=_worker_env(envs, i,
+                                DMLC_STEP_DEADLINE_MS=600,
+                                DMLC_ABORT_RECORD=str(records))))
+
+        def stop():
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return stop
+
+    errs = []
+
+    def run():
+        try:
+            rendezvous.run_job(nworkers, 0, launch, host_ip="127.0.0.1",
+                               heartbeat_ms=150,
+                               dead_after_ms=dead_after_ms,
+                               num_shards=2 * nworkers, mesh=True,
+                               world_attempts=0)
+        except Exception as e:
+            errs.append(e)
+
+    th = threading.Thread(target=run)
+    th.start()
+    try:
+        pids = _wait_progress(str(progress), nworkers)
+        # victim: any rank EXCEPT jax process 0. Process 0 hosts the
+        # coordination service; killing it makes XLA's error poller
+        # fatally terminate every survivor in C++ (client.h abort,
+        # SIGABRT) before any Python-level abort can run — so the
+        # structured-abort contract is pinned for non-leader death
+        # (leader death is covered by the supervised relaunch tests:
+        # the whole world dies fast either way and relaunches).
+        leader_pid = procs[0].pid  # DMLC_TASK_ID=0 -> jax process 0
+        victim_rank = next(r for r in sorted(pids)
+                           if pids[r] != leader_pid)
+        t_kill = time.monotonic()
+        os.kill(pids[victim_rank], signal.SIGKILL)
+        # the pin: every survivor must EXIT with the structured code
+        # within 2x dead-after of the kill — no hung collectives
+        bound = 2 * dead_after_ms / 1000.0
+        survivors = [p for p in procs if p.pid != pids[victim_rank]]
+        assert len(survivors) == nworkers - 1
+        for p in survivors:
+            left = (t_kill + bound) - time.monotonic()
+            rc = p.wait(timeout=max(left, 0.05))
+            took = time.monotonic() - t_kill
+            assert rc == STEP_ABORT_EXIT, (rc, took)
+            assert took <= bound, took
+        th.join(timeout=20)
+        assert not th.is_alive()
+        # world_attempts=0: the abort surfaces out of run_job unrelaunched
+        assert len(errs) == 1 and isinstance(errs[0], TrackerAbortedError)
+        assert "lost mid-step" in errs[0].reason
+        # every survivor left an abort record naming itself
+        lines = [json.loads(l) for l in
+                 records.read_text().strip().splitlines()]
+        got_ranks = {r["rank"] for r in lines}
+        assert got_ranks == set(range(nworkers)) - {victim_rank}, lines
+        # the tracker's write-off flight dump names the dead rank's held
+        # shards (epoch:shard pairs, not just a count)
+        dump_reasons = []
+        for name in os.listdir(dumps):
+            with open(dumps / name) as f:
+                dump_reasons.append(json.load(f)["reason"])
+        lost = [r for r in dump_reasons
+                if r.startswith(f"rank-lost: rank {victim_rank}")]
+        assert lost, dump_reasons
+        assert "epoch:shard" in lost[0] and "none" not in lost[0], lost[0]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        th.join(timeout=20)
+
+
+# -- world relaunch: fresh coordinator address, no EADDRINUSE ---------------
+@pytest.mark.slow
+def test_world_relaunch_rebinds_coordinator_address(tmp_path, monkeypatch):
+    """SIGKILL aborts attempt 0; run_job relaunches the WHOLE world and
+    attempt 1 completes. The coordinator address is re-derived through
+    the ephemeral-bind path on EVERY attempt — pinned by recording the
+    derivation calls, because reusing the dead world's port is the
+    EADDRINUSE trap (the dead attempt's coordination service may linger
+    in the kernel past the kill)."""
+    nworkers = 2
+    monkeypatch.setenv("DMLC_TRACKER_RECOVER_GRACE_MS", "300")
+    derived = []
+    real_free = rendezvous._free_coordinator_port
+
+    def recording_free(host_ip):
+        port = real_free(host_ip)
+        derived.append(port)
+        return port
+
+    monkeypatch.setattr(rendezvous, "_free_coordinator_port",
+                        recording_free)
+    attempts = []
+    procs_by_attempt = []
+
+    def launch(nw, ns, envs, tracker=None):
+        att = int(envs["DMLC_WORLD_ATTEMPT"])
+        attempts.append(dict(envs))
+        pdir = tmp_path / f"progress{att}"
+        pdir.mkdir(exist_ok=True)
+        # attempt 0 runs long (the test kills it); the relaunched world
+        # runs 3 steps to a clean finish
+        steps = "500" if att == 0 else "3"
+        ps = [subprocess.Popen(
+            [sys.executable, MESH_WORKER, str(pdir), steps, "0.05"],
+            env=_worker_env(envs, i, DMLC_STEP_DEADLINE_MS=500))
+            for i in range(nw)]
+        procs_by_attempt.append(ps)
+
+        def stop():
+            for p in ps:
+                if p.poll() is None:
+                    p.kill()
+        return stop
+
+    errs = []
+
+    def run():
+        try:
+            rendezvous.run_job(nworkers, 0, launch, host_ip="127.0.0.1",
+                               heartbeat_ms=150, dead_after_ms=1000,
+                               num_shards=2 * nworkers, mesh=True,
+                               world_attempts=2)
+        except Exception as e:
+            errs.append(e)
+
+    relaunches0 = telemetry.counter("tracker_world_relaunches_total").value
+    th = threading.Thread(target=run)
+    th.start()
+    try:
+        pids = _wait_progress(str(tmp_path / "progress0"), nworkers)
+        os.kill(pids[0], signal.SIGKILL)
+        th.join(timeout=120)
+        assert not th.is_alive()
+        assert errs == [], errs  # attempt 1 finished the job
+        assert len(attempts) == 2
+        assert [int(a["DMLC_WORLD_ATTEMPT"]) for a in attempts] == [0, 1]
+        # one fresh ephemeral derivation per attempt, and each attempt's
+        # env carries ITS derivation — never the previous attempt's
+        assert len(derived) == 2
+        assert [a["DMLC_COORDINATOR_ADDRESS"].rsplit(":", 1)[1]
+                for a in attempts] == [str(p) for p in derived]
+        assert telemetry.counter(
+            "tracker_world_relaunches_total").value == relaunches0 + 1
+        # the relaunched world ran to completion
+        for p in procs_by_attempt[1]:
+            assert p.wait(timeout=10) == 0
+    finally:
+        for ps in procs_by_attempt:
+            for p in ps:
+                if p.poll() is None:
+                    p.kill()
+        th.join(timeout=20)
+
+
+# -- two-phase job checkpoint: torn sets are unresumable --------------------
+def test_torn_job_checkpoint_refused(tmp_path):
+    base = str(tmp_path / "job.ckpt")
+    like = {"w": np.zeros(4, np.float32)}
+    p2 = {"w": np.arange(4, dtype=np.float32)}
+    for part in range(2):
+        save_job_checkpoint(base, p2, 2, part, 2, extra={"tag": "a"})
+    commit_job_checkpoint(base, 2, 2)
+
+    # torn step 4: only host 0 published before the (simulated) crash
+    save_job_checkpoint(base, {"w": p2["w"] + 1}, 4, 0, 2)
+    with pytest.raises(DMLCError):
+        commit_job_checkpoint(base, 4, 2)
+
+    # restore on BOTH hosts falls back to the committed step, never the
+    # torn one
+    for part in range(2):
+        params, step, extra = restore_job_checkpoint(base, part, 2,
+                                                     like=like)
+        assert step == 2
+        assert extra["tag"] == "a"
+        np.testing.assert_array_equal(params["w"], p2["w"])
+
+    # no marker at all -> fresh start (None), not an error
+    assert restore_job_checkpoint(str(tmp_path / "never"), 0, 2,
+                                  like=like) is None
+
+    # world-size mismatch: 2-host commit refused on a 3-host world
+    with pytest.raises(DMLCError):
+        restore_job_checkpoint(base, 0, 3, like=like)
+
+    # a marker that lies about the step (names part files holding a
+    # different step) is a mixed-step resume: refused
+    marker = job_commit_uri(base)
+    with open(marker) as f:
+        meta = json.load(f)
+    meta["step"] = 4
+    meta["parts"] = [job_part_uri(base, 2, p, 2) for p in range(2)]
+    with open(marker, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(DMLCError):
+        restore_job_checkpoint(base, 0, 2, like=like)
+
+
+# -- device pipeline: abort drains within bounded wall clock ----------------
+def _write_libsvm(path, rows, features=8):
+    rng = np.random.default_rng(3)
+    with open(path, "w") as f:
+        for i in range(rows):
+            feats = " ".join(f"{j}:{rng.uniform(-1, 1):.4f}"
+                             for j in range(features))
+            f.write(f"{i % 2} {feats}\n")
+    return path
+
+
+def test_device_abort_drain_bounded(tmp_path):
+    from dmlc_core_tpu.tpu.device_iter import DeviceRowBlockIter
+    p = _write_libsvm(tmp_path / "a.libsvm", rows=4096)
+    drains0 = telemetry.counter("device_abort_drains_total").value
+    it = DeviceRowBlockIter(str(p), batch_rows=64, prefetch=2)
+    got = iter(it)
+    next(got)  # pipeline live: staging + transfer threads hold buffers
+    budget_ms = 2000  # DMLC_DEVICE_ABORT_DRAIN_MS default
+    t0 = time.monotonic()
+    it.abort_drain("test-abort")
+    took_ms = (time.monotonic() - t0) * 1000.0
+    assert took_ms < budget_ms + 500, took_ms
+    assert telemetry.counter(
+        "device_abort_drains_total").value == drains0 + 1
+    it.close()  # idempotent after a drain
+
+    # a second drain on a closed iterator is safe (watchdog drains race
+    # the between-steps raise path by design)
+    it.abort_drain("double")
+
+
+def test_elastic_device_iter_requires_monitor(tmp_path):
+    from dmlc_core_tpu.tpu.device_iter import ElasticDeviceRowBlockIter
+    p = _write_libsvm(tmp_path / "b.libsvm", rows=64)
+    with pytest.raises(DMLCError):
+        ElasticDeviceRowBlockIter(str(p), num_shards=4, monitor=None)
+
+
+# -- supervised: SIGKILL -> world relaunch -> resumed losses identical ------
+def _loss_lines(text):
+    """{step: loss_string} from train_lm output; asserts every duplicate
+    print of a step (one per rank, plus relaunched reruns) agrees.
+    Regex, not splitlines: the ranks' interleaved stdout can land two
+    prints on one line."""
+    out = {}
+    for step, loss in re.findall(r"step (\d+): loss (\d+\.\d{4})", text):
+        step = int(step)
+        assert out.setdefault(step, loss) == loss, (
+            f"step {step} printed two different losses: "
+            f"{out[step]} vs {loss}")
+    return out
+
+
+def _submit_lm(tmp_path, corpus, nworkers, steps, ckpt, extra_args=(),
+               extra_env=None, background=False):
+    cmd = [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+           "--cluster", "local", "--num-workers", str(nworkers),
+           "--mesh", "--heartbeat-ms", "200", "--dead-after-ms", "1500",
+           "--", sys.executable, TRAIN_LM, str(corpus),
+           "--mesh", "data=1,seq=1", "--seq", "64", "--embed", "16",
+           "--heads", "2", "--layers", "1", "--batch", "2",
+           "--steps", str(steps),
+           "--checkpoint", str(ckpt), "--resume", str(ckpt),
+           "--ckpt-every", "2"] + list(extra_args)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    env.update(extra_env or {})
+    if background:
+        out = open(tmp_path / "chaos.out", "w")
+        return subprocess.Popen(cmd, cwd=str(tmp_path), env=env,
+                                stdout=out, stderr=subprocess.STDOUT), out
+    r = subprocess.run(cmd, cwd=str(tmp_path), env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    return r.stdout + r.stderr
+
+
+def _find_lm_pids(corpus, expect):
+    """The train_lm WORKER pids, found by /proc cmdline scan. Matching
+    argv[1] (the script) keeps the dmlc-submit wrapper — whose own argv
+    also contains train_lm.py and the corpus after `--` — out of the
+    result; the corpus path keeps other tests' worlds out."""
+    deadline = time.monotonic() + 90
+    pids = []
+    while time.monotonic() < deadline:
+        pids = []
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    argv = f.read().decode(errors="replace").split("\0")
+            except OSError:
+                continue
+            if len(argv) > 2 and argv[1].endswith("train_lm.py") \
+                    and str(corpus) in argv:
+                pids.append(int(pid))
+        if len(pids) == expect:
+            return pids
+        time.sleep(0.05)
+    raise AssertionError(f"never saw {expect} train_lm workers "
+                         f"(got {pids})")
+
+
+@pytest.mark.slow
+def test_sigkill_supervised_relaunch_resumes_uninterrupted_losses(tmp_path):
+    corpus_ref = tmp_path / "ref.txt"
+    corpus_chaos = tmp_path / "chaos.txt"
+    body = b"the quick brown fox jumps over the lazy dog. " * 300
+    corpus_ref.write_bytes(body)
+    corpus_chaos.write_bytes(body)
+    steps = 10
+
+    # reference: the SAME 2-process mesh regime, uninterrupted
+    ref = _submit_lm(tmp_path, corpus_ref, 2, steps, tmp_path / "ck_ref")
+    ref_losses = _loss_lines(ref)
+    assert sorted(ref_losses) == list(range(steps))
+
+    # chaos: same regime; SIGKILL one worker once a commit marker exists
+    proc, outf = _submit_lm(tmp_path, corpus_chaos, 2, steps,
+                            tmp_path / "ck_chaos", background=True)
+    try:
+        pids = _find_lm_pids(corpus_chaos, expect=2)
+        marker = job_commit_uri(str(tmp_path / "ck_chaos"))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.exists(marker):
+                break
+            if proc.poll() is not None:
+                raise AssertionError("world finished before the kill")
+            time.sleep(0.01)
+        else:
+            raise AssertionError("no commit marker ever appeared")
+        os.kill(pids[1], signal.SIGKILL)
+        assert proc.wait(timeout=180) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        outf.close()
+    chaos = (tmp_path / "chaos.out").read_text()
+
+    # the world relaunched and resumed from a COMMITTED step
+    assert "resumed from committed job checkpoint" in chaos
+    # every loss the chaos run printed — before the kill, and every
+    # resumed step after the relaunch — is bit-identical (at print
+    # precision) to the uninterrupted run's loss for that step
+    chaos_losses = _loss_lines(chaos)
+    assert max(chaos_losses) == steps - 1
+    for step, loss in chaos_losses.items():
+        assert loss == ref_losses[step], (
+            f"step {step}: chaos {loss} != uninterrupted "
+            f"{ref_losses[step]}")
+
+
+# -- no-chaos parity: N-process mesh == single-process, same global batch ---
+@pytest.mark.slow
+def test_mesh_world_losses_match_single_process(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"sphinx of black quartz judge my vow. " * 400)
+    steps = 4
+
+    # single process, global batch 4 (= 2 hosts x 2 rows)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    r = subprocess.run(
+        [sys.executable, TRAIN_LM, str(corpus), "--mesh", "data=1,seq=1",
+         "--seq", "64", "--embed", "16", "--heads", "2", "--layers", "1",
+         "--batch", "4", "--steps", str(steps)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    single = _loss_lines(r.stdout)
+
+    # 2-process mesh world, 2 rows per host over the same global stream
+    cmd = [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+           "--cluster", "local", "--num-workers", "2", "--mesh", "--",
+           sys.executable, TRAIN_LM, str(corpus),
+           "--mesh", "data=1,seq=1", "--seq", "64", "--embed", "16",
+           "--heads", "2", "--layers", "1", "--batch", "2",
+           "--steps", str(steps)]
+    r = subprocess.run(cmd, cwd=str(tmp_path), env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    mesh = _loss_lines(r.stdout + r.stderr)
+
+    assert sorted(mesh) == list(range(steps))
+    assert mesh == single, (mesh, single)

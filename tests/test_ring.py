@@ -13,6 +13,10 @@ import pytest
 
 import jax
 import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.5 jax spells it experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dmlc_core_tpu.ops.attention import blockwise_attention, mha_reference
@@ -32,7 +36,7 @@ def test_ring_allreduce_matches_psum(n, size):
     rng = np.random.default_rng(size * n)
     x = rng.normal(size=(n, size)).astype(np.float32)
 
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         functools.partial(ring_allreduce, axis_name="r"), mesh=mesh,
         in_specs=P("r"), out_specs=P("r")))
     # shard_map splits the leading axis: each device sums its row slice
@@ -45,7 +49,7 @@ def test_ring_allreduce_nd_payload():
     mesh = mesh1d(8, "r")
     rng = np.random.default_rng(3)
     x = rng.normal(size=(8, 3, 5)).astype(np.float32)
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         functools.partial(ring_allreduce, axis_name="r"), mesh=mesh,
         in_specs=P("r"), out_specs=P("r")))
     got = np.asarray(ring(x))
@@ -116,7 +120,9 @@ def test_ring_attention_output_stays_sequence_sharded():
                for _ in range(3))
     mesh = mesh1d(8, "seq")
     out = sequence_parallel_attention(q, k, v, mesh)
-    assert out.sharding.spec == P(None, "seq", None, None)
+    # compare normalized: older jax drops trailing Nones from the spec
+    spec = tuple(out.sharding.spec)
+    assert spec[:2] == (None, "seq") and all(s is None for s in spec[2:])
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -147,7 +153,7 @@ def test_ring_attention_long_sequence_jits_once():
     q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
                for _ in range(3))
     spec = P(None, "seq", None, None)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         functools.partial(ring_attention, axis_name="seq", causal=True),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
     out = fn(q, k, v)
