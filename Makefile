@@ -3,12 +3,12 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
-        parse-lanes telemetry trace cache range fsfault rig device zerocopy \
-        pytest liveness elastic mesh bench-smoke dryrun doc clean
+        parse-lanes telemetry trace cache range fsfault rig serving device \
+        zerocopy pytest liveness elastic mesh bench-smoke dryrun doc clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
-    telemetry trace cache range fsfault rig device zerocopy pytest liveness \
-    elastic mesh dryrun doc
+    telemetry trace cache range fsfault rig serving device zerocopy pytest \
+    liveness elastic mesh dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -101,6 +101,21 @@ zerocopy:
 # regression this lane exists to catch.
 rig:
 	timeout -k 10 300 python3 -m pytest tests/test_loadrig.py -q
+
+# Online-scoring lane (doc/serving.md): the batched scoring server's
+# correctness + robustness plane — forward math vs the trainers,
+# keep-alive front end 4xx edges (431/405/411/413), bounded-queue /
+# lateness-shed / breaker / draining degradation pins, bucket-padding
+# compile census, payload-boundary fuzz (malformed/truncated/binary
+# payloads, co-batch isolation), and the chaos gauntlet (fs faults on
+# reload -> last-good, SIGKILL mid-traffic -> only clean outcomes,
+# 2x-overload shed + admitted-p99 pin). JAX_PLATFORMS=cpu pins the
+# deterministic floor; hard timeout because a wedged scorer or a
+# never-draining shutdown is exactly the regression this lane catches.
+serving:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	  python3 -m pytest tests/test_serving.py tests/test_serving_fuzz.py \
+	  tests/test_serving_chaos.py -q
 
 lint:
 	python3 scripts/lint.py

@@ -44,6 +44,7 @@ Flags: --smoke (tiny dataset, CI), --rows N, --parse-only, --threads N,
 import argparse
 import json
 import os
+import signal
 import statistics
 import sys
 import time
@@ -867,6 +868,123 @@ def run_device_lane(args, rows: int, device_ok: bool) -> dict:
     if out.returncode != 0:
         return {"error": (out.stderr or "")[-400:]}
     return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _serve_scrape_metric(port: int, name: str) -> float:
+    """Read one metric off the scoring server's ``/metrics`` endpoint
+    (label series summed; 0.0 when absent)."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            total += float(line.split()[-1])
+    return total
+
+
+def run_serving_lane(args, sampler=None) -> dict:
+    """Online scoring lane (doc/serving.md): the scoring server runs
+    OUT of process (``python -m dmlc_core_tpu.serving``) and a
+    loadrig client drives ``POST /score`` with generated libsvm
+    payloads of ragged sizes. Reported: sustained QPS (closed-loop),
+    coordinated-omission-safe open-loop p50/p99/p999 on the
+    intended-time clock at ~70% of sustained, the shed/error counts,
+    and the compile-census pin (``steady_new_shapes`` must stay 0 once
+    the bucket ladder is warm). The host-resource sampler watches the
+    server pid so the report attributes client vs server CPU."""
+    import shutil
+    import subprocess
+    import tempfile
+    import numpy as np
+    repo = os.path.dirname(os.path.abspath(__file__))
+    for p in (repo, os.path.join(repo, "scripts")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import loadrig
+    from dmlc_core_tpu.serving.model import save_model
+
+    features = 1 << 14
+    rng = np.random.default_rng(7)
+    tmp = tempfile.mkdtemp(prefix="bench-serving-")
+    server = None
+    try:
+        uri = os.path.join(tmp, "model.ckpt")
+        save_model(uri, "linear",
+                   {"w": rng.normal(size=features).astype(np.float32),
+                    "b": np.float32(0.0)}, features)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DCT_SKIP_DEVICE_PROBE="1")
+        server = subprocess.Popen(
+            [sys.executable, "-m", "dmlc_core_tpu.serving",
+             "--model-uri", uri, "--rows-buckets", "16,64,256",
+             "--batch-delay-ms", "2", "--shed-lateness-ms", "500"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=repo)
+        line = ""
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = server.stdout.readline()
+            if line.startswith("SERVE_READY") or not line:
+                break
+        if not line.startswith("SERVE_READY"):
+            return {"error": "serving server never came ready"}
+        port = int(line.split("port=")[1].split()[0])
+        if sampler is not None:
+            sampler.watch("serving_server", server.pid)
+
+        spec = (f"libsvm:rows=2,rows_max=8,features={features},"
+                "nnz=16,seed=7")
+        payload_fn, ctype = loadrig.score_payload_fn(spec)
+        fn = loadrig.http_request_fn(
+            f"http://127.0.0.1:{port}/score", method="POST",
+            headers={"Content-Type": ctype}, payload_fn=payload_fn)
+        # warm the bucket ladder (every shape compiles here, not in the
+        # measured phases)
+        loadrig.closed_loop(fn, workers=2,
+                            duration_s=1.0 if args.smoke else 3.0)
+        sustained = loadrig.closed_loop(
+            fn, workers=8, duration_s=2.0 if args.smoke else 6.0)
+        sustained_qps = sustained["achieved_qps"]
+        shapes_warm = _serve_scrape_metric(port, "serve_distinct_shapes")
+        open_out = loadrig.open_loop(
+            fn, qps=max(1.0, 0.7 * sustained_qps),
+            duration_s=2.0 if args.smoke else 8.0, max_inflight=64)
+        shapes_steady = _serve_scrape_metric(port,
+                                             "serve_distinct_shapes")
+        shed_total = (
+            _serve_scrape_metric(port, "serve_shed_total") or
+            open_out["shed"])
+        server.send_signal(signal.SIGTERM)
+        try:
+            server.wait(30)
+        except subprocess.TimeoutExpired:
+            server.kill()
+        ii = open_out["intended_us"]
+        return {
+            "sustained_qps": round(sustained_qps, 1),
+            "open_loop_qps": open_out["achieved_qps"],
+            "open_loop_p50_ms": round(ii["p50"] / 1e3, 2),
+            "open_loop_p99_ms": round(ii["p99"] / 1e3, 2),
+            "open_loop_p999_ms": round(ii["p999"] / 1e3, 2),
+            "service_p99_ms": round(
+                open_out["service_us"]["p99"] / 1e3, 2),
+            "completed": open_out["completed"],
+            "errors": open_out["errors"],
+            "client_shed": open_out["shed"],
+            "server_shed": shed_total,
+            "distinct_shapes": int(shapes_steady),
+            "steady_new_shapes": int(shapes_steady - shapes_warm),
+        }
+    finally:
+        if server is not None and server.poll() is None:
+            server.kill()
+            server.wait(10)
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def mesh_lane_probe(smoke: bool = False) -> dict:
@@ -1736,6 +1854,31 @@ def main() -> None:
                   f"steps), SIGKILL recovery to first resumed step "
                   f"{ml['recovery_s']:.2f}s "
                   f"(dead-after {ml['dead_after_ms']}ms)",
+                  file=sys.stderr)
+
+    # online scoring lane (doc/serving.md): out-of-process scoring
+    # server driven by a loadrig POST client — sustained QPS plus
+    # coordinated-omission-safe open-loop percentiles ride the ledger
+    # (scripts/benchdiff.py serving_lane; sustained_qps GOOD,
+    # open_loop_p99_ms LOW)
+    if args.format == "libsvm" and not user_host_only:
+        try:
+            with sampler.section("serving_lane"):
+                extras["serving_lane"] = run_serving_lane(args, sampler)
+        except Exception as e:  # noqa: BLE001 - lane must not sink run
+            extras["serving_lane"] = {"error": str(e)[-300:]}
+        sl = extras["serving_lane"]
+        if "error" in sl:
+            print(f"# serving lane FAILED: {sl['error']}",
+                  file=sys.stderr)
+        else:
+            print(f"# serving lane: {sl['sustained_qps']:.0f} sustained "
+                  f"qps; open-loop @{sl['open_loop_qps']:.0f} qps "
+                  f"p50/p99/p999 {sl['open_loop_p50_ms']:.1f}/"
+                  f"{sl['open_loop_p99_ms']:.1f}/"
+                  f"{sl['open_loop_p999_ms']:.1f} ms (intended-time), "
+                  f"{sl['errors']} errors, "
+                  f"{sl['steady_new_shapes']} steady-state new shapes",
                   file=sys.stderr)
 
     baseline = _load_baseline()  # one read serves the parity ratios + vs

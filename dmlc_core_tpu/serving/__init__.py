@@ -1,0 +1,21 @@
+"""Online scoring plane: batched, admission-controlled model serving.
+
+The first traffic-serving workload in the repo (ROADMAP
+``[scale/serving]``): an HTTP front end on the tracker's content-
+sniffing selectors-loop pattern accepts libsvm/csv payloads on
+``POST /score``, micro-batches them through the native parser into
+RowBlocks, pads into fixed batch-size buckets (so the PR 15 compile
+census stays at ``steady_new_shapes=0`` under ragged traffic), and
+answers per-request scores from a pre-jitted linear/FM forward.
+
+Robustness is the headline (doc/serving.md): a bounded admission queue
+with intended-time lateness shedding, backpressure to 429/503 instead
+of unbounded queue growth, a circuit breaker on model-forward failures
+with last-good-model fallback on failed reloads, draining shutdown that
+answers every admitted request, and ``/readyz`` split from ``/healthz``.
+"""
+
+from dmlc_core_tpu.serving.model import ScoringModel, save_model
+from dmlc_core_tpu.serving.server import ScoringServer, ServingConfig
+
+__all__ = ["ScoringModel", "ScoringServer", "ServingConfig", "save_model"]

@@ -1031,6 +1031,35 @@ METRIC_HELP: Dict[str, str] = {
     # host resource sampler (HostResourceSampler, doc/benchmarking.md)
     "host_cpu_busy_frac": "whole-host CPU busy fraction, last interval",
     "host_rss_bytes": "sampling process RSS, last sample",
+    # online scoring plane (dmlc_core_tpu/serving/, doc/serving.md)
+    "serve_requests_total": "HTTP requests parsed by the front end",
+    "serve_admitted_total": "score requests admitted to the queue",
+    "serve_scored_total": "score requests answered 200 with scores",
+    "serve_shed_total":
+        "requests shed by reason: queue_full, late (intended-time "
+        "lateness budget), draining, breaker",
+    "serve_rejects_total":
+        "error responses by HTTP status code (sheds are additionally "
+        "counted by reason in serve_shed_total)",
+    "serve_errors_total": "5xx server-side failures (forward/internal)",
+    "serve_queue_depth": "admission queue occupancy (bounded)",
+    "serve_inflight": "admitted requests awaiting their response",
+    "serve_batches_total": "micro-batches run through the forward",
+    "serve_batch_rows": "real (pre-padding) rows per micro-batch",
+    "serve_batch_fill":
+        "percent of the padded rows bucket holding real rows",
+    "serve_parse_us": "micro-batch native parse time (us)",
+    "serve_forward_us": "padded-batch jitted forward time (us)",
+    "serve_request_us":
+        "admit-to-reply latency on the INTENDED-time clock (us; queue "
+        "wait included, coordinated-omission-safe)",
+    "serve_model_reloads_total": "model reloads that swapped params in",
+    "serve_model_reload_failures_total":
+        "failed reloads (last-good model kept serving)",
+    "serve_breaker_state": "0 closed, 1 open, 2 half-open",
+    "serve_draining": "1 while draining shutdown runs",
+    "serve_distinct_shapes":
+        "distinct padded (kind, rows, nnz) forward shapes this process",
 }
 
 

@@ -60,7 +60,7 @@ from typing import Callable, Dict, List, Optional, Set
 
 from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.base import DMLCError as _DMLCError
-from dmlc_core_tpu.tracker import topology
+from dmlc_core_tpu.tracker import minihttp, topology
 from dmlc_core_tpu.utils import fs_fault as _fs_fault
 from dmlc_core_tpu.tracker.wire import (CMD_HEARTBEAT, HEARTBEAT_ABORT,
                                         HEARTBEAT_BYE, LEASE_ACQUIRE,
@@ -1184,6 +1184,16 @@ class RabitTracker:
             # never block the rendezvous.
             yield from self._http_get(conn, head)
             return
+        method = minihttp.sniff_method(head)
+        if method is not None:
+            # a real HTTP client speaking a method this read-only surface
+            # doesn't serve (POST, PUT, ...): answer a loud 405 instead of
+            # misreading ASCII as a worker frame and dropping the socket
+            # with "invalid magic"
+            yield from self._http_reject(conn, minihttp.HttpError(
+                405, f"method {method} not allowed; "
+                     "this surface serves GET only"))
+            return
         magic = struct.unpack("@i", head)[0]
         if magic != MAGIC:
             raise _Reject(f"invalid magic {magic:#x}")
@@ -1579,8 +1589,17 @@ class RabitTracker:
         conn.kind = "http"
         req = bytearray(head)
         while b"\r\n\r\n" not in req:
-            if len(req) > 8192:
-                raise _Reject("oversized http request")
+            if len(req) > minihttp.MAX_REQUEST_HEAD:
+                # loud 431 instead of a silent drop: the scraper sees WHY
+                # its request was refused (doc/serving.md's mini-HTTP
+                # discipline, shared with the scoring front end)
+                logger.warning("oversized http request head from %s "
+                               "(> %d bytes)", conn.host,
+                               minihttp.MAX_REQUEST_HEAD)
+                yield from self._http_reject(conn, minihttp.HttpError(
+                    431, "request head exceeds "
+                         f"{minihttp.MAX_REQUEST_HEAD} bytes"))
+                return
             req += yield 1
         line = bytes(req).split(b"\r\n", 1)[0].decode("latin-1", "replace")
         parts = line.split()
@@ -1596,12 +1615,12 @@ class RabitTracker:
                 replies = yield _WAIT
             if path == "/metrics":
                 body = telemetry.cluster_prometheus_text(replies).encode()
-                status, ctype = "200 OK", \
+                status, ctype = 200, \
                     "text/plain; version=0.0.4; charset=utf-8"
             else:
                 body = (telemetry.cluster_trace_json(replies) +
                         "\n").encode()
-                status, ctype = "200 OK", "application/json"
+                status, ctype = 200, "application/json"
         elif path == "/healthz":
             st = self.state()
             alive_ranks = sum(1 for r in st["ranks"].values()
@@ -1615,22 +1634,30 @@ class RabitTracker:
                 "alive_ranks": alive_ranks,
                 "lost_ranks": st["lost_ranks"],
             }) + "\n").encode()
-            status = "200 OK" if healthy else "503 Service Unavailable"
+            status = 200 if healthy else 503
             ctype = "application/json"
         elif path == "/state":
             body = (json.dumps(self.state()) + "\n").encode()
-            status, ctype = "200 OK", "application/json"
+            status, ctype = 200, "application/json"
         else:
             body = b"not found; scrape /metrics, /trace, /state, " \
                    b"or /healthz\n"
-            status, ctype = "404 Not Found", "text/plain"
-        resp = (f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
-                f"Content-Length: {len(body)}\r\n"
-                f"Connection: close\r\n\r\n").encode("latin-1") + body
+            status, ctype = 404, "text/plain"
+        resp = minihttp.render(status, body, ctype)
         conn.drain_close = True
         self._send_bytes(conn, resp)
         # park (never returns): _flush closes the socket once the response
         # drains — returning here would close it with bytes still buffered
+        yield _WAIT
+
+    def _http_reject(self, conn: _Conn, err: "minihttp.HttpError"):
+        """Answer one HTTP error on a sniffed connection and park until
+        the response drains (405 for non-GET methods, 431 for oversized
+        request heads) — the client gets a structured refusal instead of
+        a bare socket close."""
+        conn.kind = "http"
+        conn.drain_close = True
+        self._send_bytes(conn, minihttp.render_error(err))
         yield _WAIT
 
     def _resume_port_waiters(self) -> None:

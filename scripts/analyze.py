@@ -88,11 +88,12 @@ ABI_OK_RE = re.compile(r"(?:#|//)\s*abi-ok\s*:?\s*(.*\S)?")
 CONTRACT_OK_RE = re.compile(r"(?:#|//)\s*contract-ok\s*:?\s*(.*\S)?")
 
 # scopes when walking the real repo (relative-path prefixes)
-LOCK_SCOPE = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/data/")
+LOCK_SCOPE = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/data/",
+              "dmlc_core_tpu/serving/")
 PY_ENV_SCOPE = ("dmlc_core_tpu/",)
 PY_ENV_ALLOW = ("dmlc_core_tpu/tracker/wire.py",)
 ASSERT_SCOPE = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/data/",
-                "dmlc_core_tpu/io/")
+                "dmlc_core_tpu/io/", "dmlc_core_tpu/serving/")
 CPP_SCOPE = ("cpp/",)
 CPP_ENV_ALLOW = ("cpp/src/retry.h", "cpp/src/retry.cc")
 # the local-durability helpers themselves: fs_fault.cc owns the wrappers,

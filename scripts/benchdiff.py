@@ -52,14 +52,14 @@ GOOD_LEAVES = {
     "ranged_vs_local", "achieved_qps",
     "hbm_ingest_rows_per_sec", "overlap_ratio",
     "hbm_ingest_bw_util", "hbm_ingest_bw_util_best",
-    "steps_per_sec",
+    "steps_per_sec", "sustained_qps",
 }
 
 # lane leaves that are comparable but LOWER-is-better (latencies,
 # recovery times): flat_metrics carries them and compare() inverts the
 # ratio so "REGRESSION" still means "got worse"
 LOW_LEAVES = {
-    "recovery_s",
+    "recovery_s", "open_loop_p99_ms",
 }
 
 # extras entries that are lanes worth carrying into the ledger

@@ -71,6 +71,13 @@ MODULE_GROUPS = [
         "dmlc_core_tpu.tracker.supervisor",
         "dmlc_core_tpu.tracker.client",
         "dmlc_core_tpu.tracker.mesos_status",
+        "dmlc_core_tpu.tracker.minihttp",
+    ]),
+    ("Online scoring", [
+        "dmlc_core_tpu.serving.server",
+        "dmlc_core_tpu.serving.model",
+        "dmlc_core_tpu.serving.batching",
+        "dmlc_core_tpu.serving.frontend",
     ]),
     ("Utilities", [
         "dmlc_core_tpu.utils.checkpoint",
@@ -327,6 +334,11 @@ def gen_index() -> str:
         "catalog, env-knob registry, wire words), the "
         "lock-ok/env-ok/abi-ok/contract-ok escape hatches, the UBSan "
         "lane and the shard-cache fuzz driver |",
+        "| [serving.md](serving.md) | batched online scoring: the "
+        "admission model (bounded queue, intended-time lateness shed, "
+        "circuit breaker), last-good model reloads, draining shutdown, "
+        "bucket padding + compile census, endpoint/knob tables, the "
+        "bench serving lane |",
         "| [bench.md](bench.md) | benchmark methodology and bottleneck "
         "analysis |",
         "| [benchmarking.md](benchmarking.md) | the honest measurement "

@@ -476,13 +476,26 @@ def closed_loop(request_fn, workers: int, duration_s: float) -> dict:
     }
 
 
-def http_request_fn(url: str, timeout_s: float = 10.0):
+def http_request_fn(url: str, timeout_s: float = 10.0, *,
+                    method: str = "GET", body: bytes | None = None,
+                    headers: dict | None = None, payload_fn=None,
+                    on_status=None):
     """A request function for :func:`open_loop`/:func:`closed_loop`:
-    GET ``url`` over a per-thread persistent connection (reconnects on
-    error), True on a fully-read 2xx."""
+    issue ``method`` against ``url`` over a per-thread persistent
+    connection (reconnects on error), True on a fully-read 2xx.
+
+    POST bodies come from ``body`` (fixed) or ``payload_fn`` (called
+    per request for generated traffic — see :func:`score_payload_fn`);
+    ``payload_fn`` wins when both are given. ``on_status(status)``, if
+    provided, observes every completed response's status code (the
+    serving overload tests count sheds vs scores with it; transport
+    errors never reach it)."""
     import http.client
     import urllib.parse
     parsed = urllib.parse.urlsplit(url)
+    path = parsed.path or "/"
+    if parsed.query:
+        path += "?" + parsed.query
     tls = threading.local()
 
     def request() -> bool:
@@ -493,9 +506,12 @@ def http_request_fn(url: str, timeout_s: float = 10.0):
                                               timeout=timeout_s)
             tls.conn = conn
         try:
-            conn.request("GET", parsed.path or "/")
+            payload = payload_fn() if payload_fn is not None else body
+            conn.request(method, path, payload, headers or {})
             resp = conn.getresponse()
             resp.read()
+            if on_status is not None:
+                on_status(resp.status)
             return 200 <= resp.status < 300
         except Exception:
             try:
@@ -507,10 +523,96 @@ def http_request_fn(url: str, timeout_s: float = 10.0):
     return request
 
 
+def parse_corpus_spec(spec: str) -> dict:
+    """``"libsvm:rows=4,features=64,nnz=8,seed=3"`` -> option dict.
+
+    The payload-corpus grammar for generated score traffic:
+    ``<fmt>[:k=v,...]`` with ``fmt`` libsvm|csv, ``rows`` per payload
+    (``rows_max`` > ``rows`` makes sizes ragged across requests),
+    ``features`` the id space, ``nnz`` per row, ``seed`` the corpus
+    seed. Unknown keys are an error — specs travel through CLIs and a
+    typo must not silently change the traffic."""
+    fmt, _, tail = spec.partition(":")
+    fmt = fmt.strip().lower()
+    if fmt not in ("libsvm", "csv"):
+        raise ValueError(f"corpus spec {spec!r}: fmt must be libsvm|csv")
+    out = {"fmt": fmt, "rows": 4, "rows_max": 0, "features": 64,
+           "nnz": 8, "seed": 0}
+    for tok in tail.split(","):
+        if not tok.strip():
+            continue
+        key, sep, val = tok.partition("=")
+        key = key.strip()
+        if not sep or key not in ("rows", "rows_max", "features",
+                                  "nnz", "seed"):
+            raise ValueError(f"corpus spec {spec!r}: bad token {tok!r}")
+        out[key] = int(val)
+    if out["rows"] <= 0 or out["features"] <= 0 or out["nnz"] <= 0:
+        raise ValueError(f"corpus spec {spec!r}: rows/features/nnz "
+                         "must be positive")
+    return out
+
+
+def score_payload_fn(spec: str):
+    """Per-request payload generator from a corpus spec (see
+    :func:`parse_corpus_spec`): returns ``(payload_fn, content_type)``
+    for :func:`http_request_fn`.
+
+    Deterministic and thread-safe: request *i* (a process-wide counter)
+    always produces the same payload for the same spec, so a rerun
+    offers byte-identical traffic. With ``rows_max`` set, payload sizes
+    cycle raggedly between ``rows`` and ``rows_max`` — the traffic
+    shape the serving bucket-padding census pin drives."""
+    import random
+    opts = parse_corpus_spec(spec)
+    counter = [0]
+    counter_lock = threading.Lock()
+    ctype = ("application/x-libsvm" if opts["fmt"] == "libsvm"
+             else "text/csv")
+
+    def payload() -> bytes:
+        with counter_lock:
+            i = counter[0]
+            counter[0] += 1
+        rng = random.Random((opts["seed"] << 20) ^ i)
+        rows = opts["rows"]
+        if opts["rows_max"] > rows:
+            rows += i % (opts["rows_max"] - rows + 1)
+        lines = []
+        for _ in range(rows):
+            if opts["fmt"] == "libsvm":
+                ids = rng.sample(range(opts["features"]),
+                                 min(opts["nnz"], opts["features"]))
+                feats = " ".join(f"{j}:{rng.uniform(-1, 1):.4f}"
+                                 for j in sorted(ids))
+                lines.append(f"{rng.randint(0, 1)} {feats}")
+            else:
+                lines.append(",".join(f"{rng.uniform(-1, 1):.4f}"
+                                      for _ in range(opts["features"])))
+        return ("\n".join(lines) + "\n").encode()
+
+    return payload, ctype
+
+
 def run_loadgen(args) -> int:
     """The ``loadgen`` subcommand: open- (default) or closed-loop HTTP
-    load against --url; prints the result JSON."""
-    fn = http_request_fn(args.url, args.timeout_s)
+    load against --url; prints the result JSON. ``--score-corpus``
+    switches to POST with per-request generated payloads."""
+    if args.score_corpus:
+        payload_fn, ctype = score_payload_fn(args.score_corpus)
+        fn = http_request_fn(args.url, args.timeout_s, method="POST",
+                             headers={"Content-Type": ctype},
+                             payload_fn=payload_fn)
+    elif args.body_file:
+        with open(args.body_file, "rb") as f:
+            body = f.read()
+        fn = http_request_fn(
+            args.url, args.timeout_s, method=args.method, body=body,
+            headers={"Content-Type": args.content_type}
+            if args.content_type else None)
+    else:
+        fn = http_request_fn(args.url, args.timeout_s,
+                             method=args.method)
     if args.closed_loop:
         out = closed_loop(fn, args.workers, args.duration_s)
     else:
@@ -573,6 +675,16 @@ def main(argv=None) -> int:
     lg.add_argument("--shed-after-ms", type=float, default=0.0)
     lg.add_argument("--timeout-s", type=float, default=10.0)
     lg.add_argument("--closed-loop", action="store_true")
+    lg.add_argument("--method", default="GET",
+                    help="HTTP method (POST needs --body-file or "
+                         "--score-corpus)")
+    lg.add_argument("--body-file", default="",
+                    help="fixed request body read from this file")
+    lg.add_argument("--content-type", default="",
+                    help="Content-Type for --body-file requests")
+    lg.add_argument("--score-corpus", default="",
+                    help="generate POST payloads from a corpus spec, "
+                         "e.g. libsvm:rows=4,features=64,nnz=8,seed=3")
     lg.set_defaults(fn=run_loadgen)
 
     args = ap.parse_args(argv)

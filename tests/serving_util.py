@@ -1,0 +1,161 @@
+"""Shared helpers for the serving test suites (test_serving*.py):
+a saved linear artifact, a tiny keep-alive HTTP client, an in-process
+server context manager, and a gate that blocks the model forward so
+tests can deterministically build queues and co-batches."""
+
+import contextlib
+import http.client
+import socket
+import threading
+
+import numpy as np
+
+from dmlc_core_tpu.serving.model import ScoringModel, save_model
+from dmlc_core_tpu.serving.server import ScoringServer, ServingConfig
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-np.asarray(x, dtype=np.float64)))
+
+
+def save_linear(tmp_path, features=32, step=1, seed=5, name=None):
+    """Write a linear serving artifact; returns ``(uri, w, b)``."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(scale=0.5, size=features).astype(np.float32)
+    b = np.array(rng.normal(scale=0.5), dtype=np.float32)
+    uri = str(tmp_path / (name or f"model-step{step}.ckpt"))
+    save_model(uri, "linear", {"w": w, "b": b}, features, step=step)
+    return uri, w, b
+
+
+def expect_scores(lines, w, b):
+    """Manual sigmoid(w.x + b) for libsvm text lines."""
+    out = []
+    for ln in lines:
+        margin = float(b)
+        for tok in ln.split()[1:]:
+            j, _, v = tok.partition(":")
+            margin += float(w[int(j)]) * float(v)
+        out.append(sigmoid(margin))
+    return np.asarray(out)
+
+
+class Client:
+    """One keep-alive HTTP connection to a serving port."""
+
+    def __init__(self, port, timeout=30.0):
+        self.conn = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=timeout)
+
+    def request(self, method, path, body=None, headers=None):
+        self.conn.request(method, path, body, headers or {})
+        resp = self.conn.getresponse()
+        return resp.status, resp.read()
+
+    def score(self, lines, ctype="application/x-libsvm", headers=None):
+        body = ("\n".join(lines) + "\n").encode()
+        h = {"Content-Type": ctype}
+        h.update(headers or {})
+        return self.request("POST", "/score", body, h)
+
+    def close(self):
+        self.conn.close()
+
+
+class AsyncReq(threading.Thread):
+    """A request issued on its own thread (exceptions captured, per the
+    repo's unhandled-thread-exception discipline)."""
+
+    def __init__(self, port, method, path, body=None, headers=None,
+                 timeout=30.0):
+        super().__init__(daemon=True)
+        self.args = (method, path, body, headers)
+        self.port = port
+        self.timeout = timeout
+        self.status = None
+        self.body = None
+        self.error = None
+        self.start()
+
+    def run(self):
+        try:
+            cli = Client(self.port, timeout=self.timeout)
+            try:
+                self.status, self.body = cli.request(*self.args)
+            finally:
+                cli.close()
+        except Exception as e:  # joined + asserted by the test thread
+            self.error = e
+
+    def result(self, timeout=30.0):
+        self.join(timeout)
+        assert not self.is_alive(), "async request did not finish"
+        if self.error is not None:
+            raise self.error
+        return self.status, self.body
+
+
+class ForwardGate:
+    """Wraps a :class:`ScoringModel`'s ``scores`` so a test can hold the
+    scorer inside the forward (building a deterministic queue) and then
+    let it go. When ``armed``, the next forward blocks until
+    :meth:`release`."""
+
+    def __init__(self, model: ScoringModel):
+        self._real = model.scores
+        self.entered = threading.Event()
+        self._release = threading.Event()
+        self._armed = threading.Event()
+        model.scores = self._gated
+
+    def _gated(self, row, col, val, num_rows):
+        if self._armed.is_set():
+            self._armed.clear()
+            self.entered.set()
+            if not self._release.wait(30.0):
+                raise RuntimeError("ForwardGate never released")
+        return self._real(row, col, val, num_rows)
+
+    def arm(self):
+        self.entered.clear()
+        self._release.clear()
+        self._armed.set()
+
+    def wait_entered(self, timeout=15.0):
+        assert self.entered.wait(timeout), \
+            "scorer never reached the gated forward"
+
+    def release(self):
+        self._release.set()
+
+
+@contextlib.contextmanager
+def serving_server(uri, **cfg):
+    """A started in-process :class:`ScoringServer` on an ephemeral port;
+    always stopped (non-draining) on exit."""
+    srv = ScoringServer(model_uri=uri, config=ServingConfig(**cfg))
+    srv.start()
+    try:
+        yield srv
+    finally:
+        srv.stop(drain=False, grace_s=3.0)
+
+
+def raw_http(port, data, timeout=10.0):
+    """Send raw bytes, read to close; returns everything the server
+    wrote (the 4xx-edge tests that http.client cannot express)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        s.sendall(data)
+        buf = b""
+        while True:
+            try:
+                chunk = s.recv(65536)
+            except socket.timeout:
+                break
+            if not chunk:
+                break
+            buf += chunk
+        return buf
+    finally:
+        s.close()

@@ -1,0 +1,253 @@
+"""Serving chaos lane (doc/serving.md "Degradation matrix"): the
+fault-plane gauntlet the CI ``serving`` target runs.
+
+- **fs faults on model reload** (PR 10 plane, injected below the
+  checkpoint layer's native reads): a reload that faults keeps the
+  last-good parameters serving — counted, evented, and visible to the
+  client as a 503 with the fallback's describe();
+- **SIGKILL mid-traffic** on a real out-of-process server: the client
+  observes only clean transport errors or complete, well-formed scores
+  (every response carries Content-Length, so a torn body can never
+  parse as success);
+- **overload pin**: driven open-loop at 2x its measured sustained
+  rate, the server sheds (visible in ``serve_shed_total``) while the
+  ANSWERED requests' intended-time p99 holds the configured target and
+  the queue gauge stays bounded at ``queue_max``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.io import native
+from tests.serving_util import Client, save_linear, serving_server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+import loadrig  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# fs-fault plane: model reload
+# ---------------------------------------------------------------------------
+def test_reload_under_fs_fault_keeps_last_good(tmp_path):
+    """An EIO-faulting reload is a 503 + counter + event; scoring keeps
+    answering from the last-good model. The fault plan is scoped
+    STRICTLY around the reload (no concurrent scores): the native plan
+    sits below every local read, including the parser's scratch
+    files."""
+    uri1, w1, b1 = save_linear(tmp_path, step=1, seed=5)
+    uri2, _, _ = save_linear(tmp_path, step=2, seed=9)
+    with serving_server(uri1, rows_buckets="4") as srv:
+        cli = Client(srv.port)
+        try:
+            status, body = cli.score(["1 0:1.0"])
+            assert status == 200
+            step_before = json.loads(body)["model_step"]
+            fails_before = telemetry.counter(
+                "serve_model_reload_failures_total").value
+            native.set_fs_fault_plan("read:fault=eio,every=1")
+            try:
+                status, body = cli.request(
+                    "POST", "/reload",
+                    json.dumps({"uri": uri2}).encode())
+            finally:
+                native.set_fs_fault_plan("")
+            assert status == 503, body
+            doc = json.loads(body)
+            assert "reload failed" in doc["error"]
+            assert doc["fallback"]["step"] == step_before
+            assert telemetry.counter(
+                "serve_model_reload_failures_total").value \
+                == fails_before + 1
+            assert any(e.get("event") == "serve-reload-failed"
+                       for e in telemetry.events())
+            # last-good still scores, and a clean retry then swaps
+            status, body = cli.score(["1 0:1.0"])
+            assert status == 200
+            assert json.loads(body)["model_step"] == step_before
+            status, body = cli.request(
+                "POST", "/reload", json.dumps({"uri": uri2}).encode())
+            assert status == 200 and json.loads(body)["step"] == 2
+        finally:
+            cli.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL plane: out-of-process server, real client
+# ---------------------------------------------------------------------------
+def _spawn_server(tmp_path, uri):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO, PYTHONUNBUFFERED="1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dmlc_core_tpu.serving",
+         "--model-uri", uri, "--rows-buckets", "4,16",
+         "--batch-delay-ms", "0"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 120
+    port = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("SERVE_READY"):
+            port = int(line.split("port=")[1].split()[0])
+            break
+    assert port is not None, "server never printed SERVE_READY"
+    return proc, port
+
+
+def test_sigkill_mid_traffic_only_clean_outcomes(tmp_path):
+    """SIGKILL the server while a client streams scores: every 200 the
+    client ever sees is a complete, well-formed response with the right
+    number of scores; everything else is a clean transport error —
+    never a truncated body that parses as success."""
+    import http.client
+    uri, w, b = save_linear(tmp_path, features=32)
+    proc, port = _spawn_server(tmp_path, uri)
+    killed = threading.Event()
+    outcomes = {"ok": 0, "clean_error": 0, "malformed": 0}
+    payload = b"1 0:0.5 3:-1.0\n0 1:0.25\n"
+    try:
+        def one_request():
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            try:
+                conn.request("POST", "/score", payload,
+                             {"Content-Type": "application/x-libsvm"})
+                resp = conn.getresponse()
+                body = resp.read()      # raises on torn Content-Length
+                if resp.status == 200:
+                    doc = json.loads(body)
+                    if len(doc.get("scores", [])) == 2 \
+                            and doc.get("rows") == 2:
+                        outcomes["ok"] += 1
+                    else:
+                        outcomes["malformed"] += 1
+                else:
+                    json.loads(body)    # errors are structured too
+                    outcomes["clean_error"] += 1
+            finally:
+                conn.close()
+
+        for i in range(200):
+            if i == 25:
+                assert outcomes["ok"] > 0, \
+                    "no successful scores before the kill"
+                proc.kill()             # SIGKILL: no drain, no goodbye
+                killed.set()
+            try:
+                one_request()
+            except (OSError, http.client.HTTPException,
+                    ValueError) as e:
+                assert killed.is_set(), \
+                    f"clean traffic failed before the kill: {e!r}"
+                outcomes["clean_error"] += 1
+            if killed.is_set() and outcomes["clean_error"] >= 5:
+                break
+        assert outcomes["malformed"] == 0, outcomes
+        assert outcomes["ok"] >= 1 and outcomes["clean_error"] >= 1, \
+            outcomes
+    finally:
+        proc.kill()
+        proc.wait(30)
+        proc.stdout.close()
+
+
+def test_sigterm_drains_every_admitted_request(tmp_path):
+    """SIGTERM (the orderly sibling of the SIGKILL case): the __main__
+    entry drains — every request admitted before the signal is
+    answered, and the process exits 0."""
+    uri, _, _ = save_linear(tmp_path, features=32)
+    proc, port = _spawn_server(tmp_path, uri)
+    try:
+        cli = Client(port)
+        try:
+            assert cli.score(["1 0:1.0"])[0] == 200
+        finally:
+            cli.close()
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(60) == 0
+    finally:
+        proc.kill()
+        proc.stdout.close()
+
+
+# ---------------------------------------------------------------------------
+# overload pin: shed rate is the honest signal, admitted p99 holds
+# ---------------------------------------------------------------------------
+def test_overload_sheds_and_holds_admitted_p99(tmp_path):
+    uri, _, _ = save_linear(tmp_path, features=64)
+    p99_target_ms = 400.0
+    queue_max = 16
+    with serving_server(uri, rows_buckets="8", min_nnz_bucket=64,
+                        queue_max=queue_max,
+                        shed_lateness_ms=100.0,
+                        p99_target_ms=p99_target_ms,
+                        batch_delay_ms=0.0) as srv:
+        real = srv._model.scores
+
+        def slowed(row, col, val, num_rows):
+            time.sleep(0.004)   # pin service cost so overload is cheap
+            return real(row, col, val, num_rows)
+
+        srv._model.scores = slowed
+        payload_fn, ctype = loadrig.score_payload_fn(
+            "libsvm:rows=1,rows_max=4,features=64,nnz=4,seed=3")
+        url = f"http://127.0.0.1:{srv.port}/score"
+        fn = loadrig.http_request_fn(url, method="POST",
+                                     headers={"Content-Type": ctype},
+                                     payload_fn=payload_fn)
+        fn()                            # jit warmup for both buckets
+        sustained = loadrig.closed_loop(
+            fn, workers=4, duration_s=1.0)["achieved_qps"]
+        assert sustained > 0
+
+        def _sheds():
+            return sum(telemetry.counter("serve_shed_total",
+                                         {"reason": r}).value
+                       for r in ("late", "queue_full"))
+
+        sheds_before = _sheds()
+        telemetry.histogram("serve_request_us").zero()
+        depth_max = [0.0]
+        sampling = threading.Event()
+        sampling.set()
+
+        def sample_depth():
+            g = telemetry.gauge("serve_queue_depth")
+            while sampling.is_set():
+                depth_max[0] = max(depth_max[0], g.value)
+                time.sleep(0.003)
+
+        sampler = threading.Thread(target=sample_depth, daemon=True)
+        sampler.start()
+        out = loadrig.open_loop(fn, qps=2.0 * sustained,
+                                duration_s=2.0, max_inflight=64)
+        sampling.clear()
+        sampler.join(10)
+        shed = _sheds() - sheds_before
+        assert out["completed"] > 0
+        assert shed > 0, \
+            f"2x sustained ({sustained:.0f} qps) never shed: {out}"
+        # the queue gauge never exceeded its bound
+        assert depth_max[0] <= queue_max, depth_max
+        # ANSWERED requests held the p99 target on the intended-time
+        # clock (arrival -> reply): the shed budget (100ms) plus
+        # service leaves headroom under the 400ms target
+        answered_p99_us = telemetry.histogram(
+            "serve_request_us").quantile(0.99)
+        assert answered_p99_us <= p99_target_ms * 1000.0, \
+            (answered_p99_us, out)
