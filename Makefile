@@ -3,12 +3,13 @@
 # `make ci` runs every lane; each lane is also callable alone.
 
 .PHONY: ci lint analyze native-test tsan-test asan-test ubsan-test \
-        parse-lanes telemetry trace cache range fsfault rig serving device \
-        zerocopy pytest liveness elastic mesh bench-smoke dryrun doc clean
+        parse-lanes telemetry trace cache range fsfault rig serving slo \
+        device zerocopy pytest liveness elastic mesh bench-smoke dryrun doc \
+        clean
 
 ci: lint analyze native-test tsan-test asan-test ubsan-test parse-lanes \
-    telemetry trace cache range fsfault rig serving device zerocopy pytest \
-    liveness elastic mesh dryrun doc
+    telemetry trace cache range fsfault rig serving slo device zerocopy \
+    pytest liveness elastic mesh dryrun doc
 	@echo "== all CI lanes green =="
 
 asan-test:
@@ -116,6 +117,16 @@ serving:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 	  python3 -m pytest tests/test_serving.py tests/test_serving_fuzz.py \
 	  tests/test_serving_chaos.py -q
+
+# SLO-plane lane (doc/observability.md "SLO plane"): rolling-window
+# rates/quantiles, multi-window burn-rate paging with hysteresis, and
+# the burn e2e — an injected forward stall trips the fast burn within
+# its knob-scaled window, flips /readyz, flight-dumps, and recovers.
+# Hard timeout because a page that never clears (or a tick thread that
+# never stops) is exactly the regression this lane exists to catch.
+slo:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	  python3 -m pytest tests/test_slo.py -q
 
 lint:
 	python3 scripts/lint.py

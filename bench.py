@@ -959,6 +959,15 @@ def run_serving_lane(args, sampler=None) -> dict:
         shed_total = (
             _serve_scrape_metric(port, "serve_shed_total") or
             open_out["shed"])
+        # SLO hygiene pin: a healthy server at 0.7x sustained open-loop
+        # must never page — any fast-burn trip here is a regression
+        # (scripts/benchdiff.py carries slo_burn_clean LOWER-is-better;
+        # good runs report 0, and a non-zero count fails the lane loudly)
+        burn_trips = _serve_scrape_metric(port, "slo_page_trips_total")
+        if burn_trips:
+            raise RuntimeError(
+                f"SLO page tripped {int(burn_trips)}x during the 0.7x "
+                "open-loop phase — a healthy server must not burn")
         server.send_signal(signal.SIGTERM)
         try:
             server.wait(30)
@@ -979,6 +988,7 @@ def run_serving_lane(args, sampler=None) -> dict:
             "server_shed": shed_total,
             "distinct_shapes": int(shapes_steady),
             "steady_new_shapes": int(shapes_steady - shapes_warm),
+            "slo_burn_clean": int(burn_trips),
         }
     finally:
         if server is not None and server.poll() is None:

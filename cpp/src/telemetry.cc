@@ -342,6 +342,14 @@ void EmitSpanRecord(const char* name, uint64_t start_us, uint64_t dur_us,
                     uint64_t span_id, uint64_t parent, uint64_t arg) {
   SpanRing& r = Ring();
   const uint64_t idx = r.cursor.fetch_add(1, std::memory_order_relaxed);
+  if (idx >= kSpanRingSize) {
+    // this claim overwrites the record kSpanRingSize behind it — a wrap
+    // must be countable, not silent (labeled per half: the Python ring
+    // publishes its own spans_dropped_total{half="python"})
+    static Counter* dropped =
+        GetCounter("spans_dropped_total", {{"half", "native"}});
+    dropped->Add(1);
+  }
   SpanSlot& s = r.slots[idx & (kSpanRingSize - 1)];
   // Seqlock write protocol (Boehm, "Can seqlocks get along with
   // programming language memory models"): invalidate, RELEASE FENCE,

@@ -122,6 +122,7 @@ class StepWatchdog:
         self._lock = threading.Lock()
         self._step: Optional[int] = None
         self._step_started: Optional[float] = None
+        self._step_begin_us: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -161,6 +162,7 @@ class StepWatchdog:
         with self._lock:
             self._step = step
             self._step_started = time.monotonic()
+            self._step_begin_us = time.time() * 1e6
 
     def step_end(self) -> None:
         """Call right after the step's results land: disarms the deadline
@@ -168,6 +170,15 @@ class StepWatchdog:
         the NEXT step_begin, which may never come)."""
         with self._lock:
             self._step_started = None
+            step, begin_us = self._step, self._step_begin_us
+            self._step_begin_us = None
+        if begin_us is not None and step is not None:
+            # a `mesh.step` span per completed step: rides TELEMETRY_PULL
+            # to the tracker, which derives per-rank step durations and
+            # the straggler_bound verdict from it (doc/observability.md
+            # "Step timelines")
+            telemetry.emit_span("mesh.step", begin_us,
+                                time.time() * 1e6 - begin_us, step=step)
         mon = self._mon()
         if mon is not None:
             mon.check()

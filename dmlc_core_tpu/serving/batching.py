@@ -18,7 +18,7 @@ under ragged traffic (doc/serving.md).
 import os
 import tempfile
 import uuid
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 

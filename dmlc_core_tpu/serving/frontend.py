@@ -22,7 +22,7 @@ import selectors
 import socket
 import threading
 import time
-from typing import Callable, Dict, Optional, Set, Tuple
+from typing import Callable, Dict, Optional, Set
 
 from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.tracker import minihttp
@@ -75,16 +75,20 @@ class Request:
     """One parsed HTTP request handed to the handler (loop thread)."""
 
     __slots__ = ("method", "path", "query", "headers", "body",
-                 "arrival_us", "slot")
+                 "arrival_us", "request_id", "slot")
 
     def __init__(self, method: str, path: str, query: str,
-                 headers: Dict[str, str], body: bytes, arrival_us: float):
+                 headers: Dict[str, str], body: bytes, arrival_us: float,
+                 request_id: str = ""):
         self.method = method
         self.path = path
         self.query = query
         self.headers = headers
         self.body = body
         self.arrival_us = arrival_us    # perf-counter clock, µs
+        # sanitized inbound X-Request-Id, or minted (minihttp.request_id);
+        # echoed on every handler-level response
+        self.request_id = request_id
         self.slot: Optional["ReplySlot"] = None
 
 
@@ -96,13 +100,16 @@ class ReplySlot:
     thread — the response is rendered here but written by the loop.
     """
 
-    __slots__ = ("_fe", "_conn", "_keep", "_done")
+    __slots__ = ("_fe", "_conn", "_keep", "_done", "request_id")
 
-    def __init__(self, fe: "HttpFrontend", conn: _Conn, keep: bool):
+    def __init__(self, fe: "HttpFrontend", conn: _Conn, keep: bool,
+                 request_id: str = ""):
         self._fe = fe
         self._conn = conn
         self._keep = keep
         self._done = False
+        #: the request's id, echoed as X-Request-Id on the completion
+        self.request_id = request_id
 
     def send(self, status: int, body: bytes,
              ctype: str = "application/json",
@@ -111,6 +118,9 @@ class ReplySlot:
         if self._done:
             return
         self._done = True
+        if self.request_id:
+            extra_headers = dict(extra_headers or {},
+                                 **{"X-Request-Id": self.request_id})
         self._fe._complete(self._conn, minihttp.render(
             status, body, ctype, keep_alive=self._keep,
             extra_headers=extra_headers))
@@ -121,6 +131,9 @@ class ReplySlot:
             return
         self._done = True
         _count_reject(err.status)
+        if self.request_id:
+            err.headers = dict(err.headers or {},
+                               **{"X-Request-Id": self.request_id})
         self._fe._complete(self._conn, minihttp.render_error(
             err, keep_alive=self._keep))
 
@@ -376,8 +389,10 @@ class HttpFrontend:
             keep = headers.get("connection", "keep-alive").lower() \
                 != "close"
             self._m_requests.inc()
-            req = Request(method, path, query, headers, body, arrival_us)
-            slot = ReplySlot(self, conn, keep)
+            rid = minihttp.request_id(headers.get("x-request-id"))
+            req = Request(method, path, query, headers, body, arrival_us,
+                          rid)
+            slot = ReplySlot(self, conn, keep, rid)
             req.slot = slot
             try:
                 result = self._handler(req)
@@ -393,10 +408,13 @@ class HttpFrontend:
                 resp = yield _WAIT      # rendered bytes from ReplySlot
             elif isinstance(result, minihttp.HttpError):
                 _count_reject(result.status)
+                result.headers = dict(result.headers or {},
+                                      **{"X-Request-Id": rid})
                 resp = minihttp.render_error(result, keep_alive=keep)
             else:
                 status, rbody, ctype = result[:3]
-                extra = result[3] if len(result) > 3 else None
+                extra = dict(result[3] if len(result) > 3 else {},
+                             **{"X-Request-Id": rid})
                 resp = minihttp.render(status, rbody, ctype,
                                        keep_alive=keep,
                                        extra_headers=extra)

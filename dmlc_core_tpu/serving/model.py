@@ -17,7 +17,7 @@ forward has seen: with bucket padding upstream the set is finite and
 """
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp

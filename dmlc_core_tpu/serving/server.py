@@ -27,6 +27,7 @@ plane (doc/serving.md):
 
 import collections
 import json
+import random
 import threading
 import time
 from typing import Deque, List, Optional, Union
@@ -36,7 +37,8 @@ from dmlc_core_tpu.serving import batching
 from dmlc_core_tpu.serving.frontend import HttpFrontend, PENDING, Request
 from dmlc_core_tpu.serving.model import ScoringModel
 from dmlc_core_tpu.tracker.minihttp import HttpError
-from dmlc_core_tpu.tracker.wire import env_float, env_int
+from dmlc_core_tpu.tracker.rendezvous import _EventLog
+from dmlc_core_tpu.tracker.wire import env_float, env_int, env_str
 
 import logging
 
@@ -66,6 +68,10 @@ class ServingConfig:
                  min_nnz_bucket: Optional[int] = None,
                  drain_grace_s: Optional[float] = None,
                  idle_timeout_s: Optional[float] = None,
+                 trace_sample: Optional[float] = None,
+                 access_log: Optional[str] = None,
+                 access_log_sample: Optional[float] = None,
+                 access_log_max_bytes: Optional[int] = None,
                  rows_buckets: str = "16,64,256,1024",
                  tmp_dir: Optional[str] = None):
         def pick(value, fallback):
@@ -98,6 +104,20 @@ class ServingConfig:
             drain_grace_s, env_float("DMLC_SERVE_DRAIN_GRACE_S", 5.0))
         self.idle_timeout_s = pick(
             idle_timeout_s, env_float("DMLC_SERVE_IDLE_TIMEOUT_S", 120.0))
+        #: fraction of admitted requests that record a full
+        #: admit->queue->parse->forward->reply span chain (with an
+        #: exemplar on serve_request_us); 0 disables request tracing
+        self.trace_sample = pick(
+            trace_sample, env_float("DMLC_SERVE_TRACE_SAMPLE", 0.01))
+        #: structured JSONL access-log path ("" / unset = off)
+        self.access_log = pick(
+            access_log, env_str("DMLC_SERVE_ACCESS_LOG"))
+        self.access_log_sample = pick(
+            access_log_sample,
+            env_float("DMLC_SERVE_ACCESS_LOG_SAMPLE", 1.0))
+        self.access_log_max_bytes = pick(
+            access_log_max_bytes,
+            env_int("DMLC_SERVE_ACCESS_LOG_MAX_BYTES", 16 << 20))
         self.rows_buckets = batching.parse_buckets(rows_buckets)
         self.tmp_dir = tmp_dir or batching.scratch_dir()
         if self.batch_max_rows > self.rows_buckets[-1]:
@@ -108,16 +128,22 @@ class _ScoreReq:
     """One admitted score request awaiting the scorer."""
 
     __slots__ = ("slot", "payload", "fmt", "rows", "arrival_us",
-                 "deadline_ms")
+                 "deadline_ms", "request_id", "trace_id")
 
     def __init__(self, slot, payload: bytes, fmt: str, rows: int,
-                 arrival_us: float, deadline_ms: float):
+                 arrival_us: float, deadline_ms: float,
+                 request_id: str = "", trace_id: int = 0):
         self.slot = slot
         self.payload = payload
         self.fmt = fmt
         self.rows = rows
         self.arrival_us = arrival_us
         self.deadline_ms = deadline_ms
+        self.request_id = request_id
+        # root span id of the sampled trace chain (0 = unsampled); the
+        # explicit cross-thread parent handle — the ring's thread-local
+        # chain does not follow the request onto the scorer thread
+        self.trace_id = trace_id
 
 
 class _ReloadReq:
@@ -166,6 +192,15 @@ class ScoringServer:
         self._m_parse_us = telemetry.histogram("serve_parse_us")
         self._m_forward_us = telemetry.histogram("serve_forward_us")
         self._m_request_us = telemetry.histogram("serve_request_us")
+        self._m_access_dropped = telemetry.counter(
+            "serve_access_log_dropped_total")
+        # structured access log: the tracker event log's contained JSONL
+        # sink (rotation + drop-and-count), pointed at its own counter
+        self._access_log: Optional[_EventLog] = None
+        if self.config.access_log:
+            self._access_log = _EventLog(
+                self.config.access_log, self.config.access_log_max_bytes,
+                dropped=self._m_access_dropped)
         telemetry.gauge("serve_draining").set(0)
         telemetry.gauge("serve_breaker_state").set(BREAKER_CLOSED)
 
@@ -180,6 +215,9 @@ class ScoringServer:
         """Load the model if needed, then start the scorer and loop."""
         if self._model is None:
             self._model = ScoringModel.load(self._model_uri)
+        # rolling windows + SLO burn monitors over this process's
+        # registry (doc/observability.md "SLO plane")
+        telemetry.start_windowed_view(slo=True)
         self._scorer = threading.Thread(target=self._scorer_loop,
                                         name="serve-scorer", daemon=True)
         self._scorer.start()
@@ -208,6 +246,9 @@ class ScoringServer:
         while self.frontend.inflight() and time.monotonic() < deadline:
             time.sleep(0.01)
         self.frontend.stop(grace)
+        telemetry.stop_windowed_view()
+        if self._access_log is not None:
+            self._access_log.close()
 
     def _shed_queue_locked(self, reason: str) -> None:
         while self._queue:
@@ -231,9 +272,11 @@ class ScoringServer:
             if req.path == "/statz":
                 return 200, (json.dumps(self.statz()) + "\n").encode(), \
                     "application/json"
+            if req.path == "/trace":
+                return self._trace(req)
             raise HttpError(404, f"no such path {req.path}; serve "
                                  "endpoints: /score /reload /healthz "
-                                 "/readyz /metrics /statz")
+                                 "/readyz /metrics /statz /trace")
         if req.method == "POST":
             if req.path == "/score":
                 return self._admit_score(req)
@@ -243,14 +286,58 @@ class ScoringServer:
         raise HttpError(405, f"method {req.method} not allowed")
 
     def _readyz(self):
-        ready = self._model is not None and not self._draining
+        # a paging SLO burn flips readiness exactly like the breaker: the
+        # load balancer drains this replica until the burn clears (the
+        # monitor's hysteresis is what un-flips it)
+        slo_page = telemetry.slo_page_active()
+        ready = self._model is not None and not self._draining \
+            and not slo_page
         body = (json.dumps({
             "ready": ready,
             "draining": self._draining,
             "breaker": self._breaker,
+            "slo_page": slo_page,
             "model_loaded": self._model is not None,
         }) + "\n").encode()
         return (200 if ready else 503), body, "application/json"
+
+    def _trace(self, req: Request):
+        # GET /trace: whole-process Chrome-trace doc; ?request_id= (the
+        # echoed X-Request-Id) or ?span_id= (a histogram exemplar) pulls
+        # one sampled request's span chain instead
+        params = {}
+        for part in req.query.split("&"):
+            k, sep, v = part.partition("=")
+            if sep:
+                params[k] = v
+        rid = params.get("request_id")
+        sid = params.get("span_id")
+        if not rid and not sid:
+            return (200, telemetry.trace_json().encode(),
+                    "application/json")
+        span_list = telemetry.spans()
+        root: Optional[int] = None
+        if sid:
+            try:
+                root = int(sid)
+            except ValueError:
+                raise HttpError(400, f"bad span_id {sid!r}")
+        else:
+            for s in reversed(span_list):
+                if s["name"] == "serve.request" and \
+                        (s.get("args") or {}).get("request_id") == rid:
+                    root = s["id"]
+                    break
+        chain = [s for s in span_list
+                 if root is not None and
+                 (s["id"] == root or s["parent"] == root)]
+        if not chain:
+            raise HttpError(404, "no sampled span chain for "
+                                 f"{rid or sid!r} (tracing samples "
+                                 "DMLC_SERVE_TRACE_SAMPLE of requests)")
+        chain.sort(key=lambda s: s["ts"])
+        body = (json.dumps({"root": root, "spans": chain}) + "\n").encode()
+        return 200, body, "application/json"
 
     def _admit_score(self, req: Request):
         with telemetry.span("serve.admit", bytes=len(req.body)):
@@ -271,25 +358,44 @@ class ScoringServer:
                 except ValueError:
                     raise HttpError(400,
                                     f"bad X-Deadline-Ms {raw_deadline!r}")
+            trace_id = 0
+            if self.config.trace_sample > 0 and \
+                    random.random() < self.config.trace_sample:
+                trace_id = telemetry.new_span_id()
             shed: Optional[str] = None
             with self._cond:
                 if self._draining:
                     shed = "draining"
                 elif self._breaker_blocks_locked():
                     shed = "breaker"
+                elif telemetry.slo_page_active():
+                    # the burn signal as an admission input: while the
+                    # SLO monitor pages, shed instead of queueing more
+                    # work behind a blown budget (these sheds are
+                    # excluded from the burn's bad count — see
+                    # SloMonitor — so the page can clear)
+                    shed = "slo_burn"
                 elif len(self._queue) >= self.config.queue_max:
                     shed = "queue_full"
                 else:
                     self._queue.append(_ScoreReq(
                         req.slot, req.body, fmt, rows, req.arrival_us,
-                        deadline_ms))
+                        deadline_ms, req.request_id, trace_id))
                     self._m_depth.set(len(self._queue))
                     self._cond.notify()
             if shed is not None:
                 telemetry.counter("serve_shed_total",
                                   {"reason": shed}).inc()
+                self._access(req.request_id, 503,
+                             time.perf_counter() * 1e6 - req.arrival_us,
+                             shed)
                 raise HttpError(503, f"shedding: {shed}",
                                 headers={"Retry-After": "1"})
+            if trace_id:
+                telemetry.emit_span(
+                    "serve.admit", req.arrival_us,
+                    time.perf_counter() * 1e6 - req.arrival_us,
+                    parent=trace_id, bytes=len(req.body))
             self._m_admitted.inc()
             return PENDING
 
@@ -402,14 +508,26 @@ class ScoringServer:
         batch = self._shed_late(batch)
         if not batch:
             return
+        # sampled requests get explicit-parent child spans: this thread's
+        # local chain belongs to serve.batch, the request's chain roots
+        # at its trace_id minted on the frontend thread
+        sampled = [r for r in batch if r.trace_id]
+        dequeue_us = time.perf_counter() * 1e6
+        for r in sampled:
+            telemetry.emit_span("serve.queue", r.arrival_us,
+                                dequeue_us - r.arrival_us,
+                                parent=r.trace_id)
         with telemetry.span("serve.batch", requests=len(batch)) as sp:
             with telemetry.span("serve.parse"):
                 t0 = time.perf_counter()
                 group = batching.parse_group(
                     [r.payload for r in batch], batch[0].fmt,
                     self.config.tmp_dir)
-                self._m_parse_us.observe(
-                    (time.perf_counter() - t0) * 1e6)
+                parse_us = (time.perf_counter() - t0) * 1e6
+                self._m_parse_us.observe(parse_us)
+            for r in sampled:
+                telemetry.emit_span("serve.parse", t0 * 1e6, parse_us,
+                                    parent=r.trace_id)
             scores = None
             fwd_err: Optional[HttpError] = None
             if group.num_rows > 0:
@@ -421,8 +539,12 @@ class ScoringServer:
                             group, self.config.rows_buckets,
                             self.config.min_nnz_bucket)
                         scores = self._model.scores(row, col, val, rb)
-                        self._m_forward_us.observe(
-                            (time.perf_counter() - t0) * 1e6)
+                        forward_us = (time.perf_counter() - t0) * 1e6
+                        self._m_forward_us.observe(forward_us)
+                    for r in sampled:
+                        telemetry.emit_span("serve.forward", t0 * 1e6,
+                                            forward_us,
+                                            parent=r.trace_id)
                     self._m_batches.inc()
                     self._m_batch_rows.observe(group.num_rows)
                     self._m_batch_fill.observe(
@@ -442,17 +564,18 @@ class ScoringServer:
 
     def _reply(self, batch, group, scores, fwd_err) -> None:
         step = self._model.step if self._model else -1
+        reply_us = time.perf_counter() * 1e6
         for i, r in enumerate(batch):
             err = group.errors[i]
             if err is not None:
                 r.slot.send_error(err)
-                self._finish_request(r, err.status)
+                self._finish_request(r, err.status, reply_us)
                 continue
             if fwd_err is not None:
                 if fwd_err.status >= 500:
                     self._m_errors.inc()
                 r.slot.send_error(fwd_err)
-                self._finish_request(r, fwd_err.status)
+                self._finish_request(r, fwd_err.status, reply_us)
                 continue
             lo, hi = group.slices[i]
             body = (json.dumps({
@@ -462,14 +585,55 @@ class ScoringServer:
             }) + "\n").encode()
             r.slot.send(200, body)
             self._m_scored.inc()
-            self._finish_request(r, 200)
+            self._finish_request(r, 200, reply_us)
 
-    def _finish_request(self, r: _ScoreReq, status: int) -> None:
-        """Account one answered request on the intended-time clock."""
-        dur_us = time.perf_counter() * 1e6 - r.arrival_us
-        self._m_request_us.observe(dur_us)
-        telemetry.emit_span("serve.request", r.arrival_us, dur_us,
-                            status=status, rows=r.rows)
+    def _finish_request(self, r: _ScoreReq, status: int,
+                        reply_start_us: Optional[float] = None) -> None:
+        """Account one answered request on the intended-time clock; a
+        sampled request also closes out its span chain (reply child +
+        explicit root carrying the request id) and stamps the latency
+        histogram's bucket exemplar."""
+        now_us = time.perf_counter() * 1e6
+        dur_us = now_us - r.arrival_us
+        if r.trace_id:
+            if reply_start_us is not None:
+                telemetry.emit_span("serve.reply", reply_start_us,
+                                    now_us - reply_start_us,
+                                    parent=r.trace_id)
+            self._m_request_us.observe(dur_us, trace_id=r.trace_id)
+            telemetry.emit_span("serve.request", r.arrival_us, dur_us,
+                                parent=0, span_id=r.trace_id,
+                                status=status, rows=r.rows,
+                                request_id=r.request_id)
+        else:
+            self._m_request_us.observe(dur_us)
+            telemetry.emit_span("serve.request", r.arrival_us, dur_us,
+                                status=status, rows=r.rows)
+        if status == 200:
+            cause = "scored"
+        elif status == 429:
+            cause = "late"
+        elif status >= 500:
+            cause = "error"
+        else:
+            cause = "reject"
+        self._access(r.request_id, status, dur_us, cause)
+
+    def _access(self, request_id: str, status: int, dur_us: float,
+                cause: str) -> None:
+        """Write one sampled structured access-log line (request id,
+        status, intended-time latency, shed/breaker/error cause); the
+        contained sink drops-and-counts on I/O failure."""
+        log = self._access_log
+        if log is None:
+            return
+        if self.config.access_log_sample < 1.0 and \
+                random.random() >= self.config.access_log_sample:
+            return
+        log.write(json.dumps({
+            "ts": time.time(), "request_id": request_id,
+            "status": status, "latency_ms": round(dur_us / 1e3, 3),
+            "cause": cause}) + "\n")
 
     def _breaker_report(self, ok: bool) -> None:
         with self._cond:
@@ -488,12 +652,21 @@ class ScoringServer:
                     self._breaker = BREAKER_OPEN
                     self._breaker_opened_at = time.monotonic()
             state = self._breaker
+            failures = self._breaker_failures
         if changed:
             telemetry.gauge("serve_breaker_state").set(state)
             telemetry.emit_event(
                 "serve-breaker",
                 state={BREAKER_CLOSED: "closed", BREAKER_OPEN: "open",
                        BREAKER_HALF_OPEN: "half-open"}[state])
+            if state == BREAKER_OPEN:
+                # a breaker trip is a postmortem moment: land the span
+                # ring + metrics naming what tripped it (flight-recorder
+                # trigger table, doc/observability.md)
+                telemetry.flight_dump(
+                    f"serve-breaker-open: {failures} consecutive "
+                    f"forward failures >= threshold "
+                    f"{self.config.breaker_threshold}")
 
     # -- reload ------------------------------------------------------------
 
@@ -536,6 +709,8 @@ class ScoringServer:
             "queue_max": self.config.queue_max,
             "draining": draining,
             "breaker": breaker,
+            "slo_page": telemetry.slo_page_active(),
+            "trace_sample": self.config.trace_sample,
             "p99_target_ms": self.config.p99_target_ms,
             "shed_lateness_ms": self.config.shed_lateness_ms,
             "rows_buckets": list(self.config.rows_buckets),

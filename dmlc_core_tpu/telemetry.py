@@ -40,11 +40,13 @@ __all__ = ["Counter", "Gauge", "Histogram", "HIST_BUCKETS",
            "gauge", "histogram", "register_collector",
            "unregister_collector", "enabled", "enable", "reset",
            "emit_event", "events", "snapshot", "prometheus_text",
-           "events_jsonl", "span", "emit_span", "spans", "clock_anchor",
-           "trace_snapshot", "trace_json", "rank_export",
+           "events_jsonl", "span", "emit_span", "new_span_id", "spans",
+           "clock_anchor", "trace_snapshot", "trace_json", "rank_export",
            "cluster_prometheus_text", "cluster_trace_json",
-           "stall_attribution", "VERDICT_CODES", "flight_dump",
-           "device_overlap_ratio", "quantile_from_buckets",
+           "stall_attribution", "straggler_attribution", "VERDICT_CODES",
+           "flight_dump", "device_overlap_ratio", "quantile_from_buckets",
+           "WindowedView", "SloMonitor", "start_windowed_view",
+           "stop_windowed_view", "windowed_view", "slo_page_active",
            "HostResourceSampler"]
 
 SNAPSHOT_VERSION = 1
@@ -137,7 +139,8 @@ class Histogram:
     observations ``v <= 2**i`` for ``i < HIST_BUCKETS``, the last bucket is
     +Inf overflow. Observe integer microseconds for ``*_us`` metrics."""
 
-    __slots__ = ("name", "labels", "count", "sum", "buckets", "_mu")
+    __slots__ = ("name", "labels", "count", "sum", "buckets", "exemplars",
+                 "_mu")
 
     def __init__(self, name: str, labels: Dict[str, str]):
         self.name = name
@@ -145,6 +148,12 @@ class Histogram:
         self.count = 0
         self.sum = 0
         self.buckets = [0] * (HIST_BUCKETS + 1)
+        # bucket index -> trace id of the LAST sampled observation that
+        # landed there (doc/observability.md "Per-request tracing"): the
+        # breadcrumb from a latency bucket back to the span chain that
+        # produced it. Lazy — stays None until the first exemplar, so
+        # unsampled histograms pay nothing
+        self.exemplars: Optional[Dict[int, int]] = None
         self._mu = threading.Lock()
 
     @staticmethod
@@ -156,16 +165,24 @@ class Histogram:
         w = int(v - 1).bit_length()  # ceil(log2(v))
         return w if w < HIST_BUCKETS else HIST_BUCKETS
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[int] = None) -> None:
         """Record one observation (non-negative; fractions are truncated
         for the bucket choice, summed exactly — sub-unit observations must
-        not read as zero-cost in sum/count means)."""
+        not read as zero-cost in sum/count means). ``trace_id`` (a span
+        id from a sampled request chain) is kept as the bucket's exemplar
+        — last writer wins, exported in the JSON snapshot only (the text
+        exposition stays plain 0.0.4)."""
         if v < 0:
             v = 0
         with self._mu:
             self.count += 1
             self.sum += v
-            self.buckets[self.bucket_of(int(v))] += 1
+            b = self.bucket_of(int(v))
+            self.buckets[b] += 1
+            if trace_id:
+                if self.exemplars is None:
+                    self.exemplars = {}
+                self.exemplars[b] = trace_id
 
     def zero(self) -> None:
         """Reset all counts (registry-wide :func:`reset` calls this)."""
@@ -173,6 +190,7 @@ class Histogram:
             self.count = 0
             self.sum = 0
             self.buckets = [0] * (HIST_BUCKETS + 1)
+            self.exemplars = None
 
     def quantile(self, q: float) -> float:
         """Upper-bound estimate of the ``q``-quantile (0 < q <= 1) from
@@ -280,8 +298,11 @@ def enable(on: bool) -> None:
 def reset(native: bool = True) -> None:
     """Zero every Python-registered metric and drop buffered events; with
     ``native=True`` (default) also zero the native registry when its
-    library is loaded (``dct_telemetry_reset``)."""
+    library is loaded (``dct_telemetry_reset``). Also force-stops the
+    process :class:`WindowedView` (test isolation: a leaked ticker thread
+    from one test must not publish windows into the next)."""
     global _spans_dropped
+    stop_windowed_view(force=True)
     with _lock:
         for c in _counters.values():
             c.zero()
@@ -362,23 +383,38 @@ def _append_span(name: str, span_id: int, parent: int, start_us: float,
             _spans_dropped += drop
 
 
+def new_span_id() -> int:
+    """Allocate one span id from the process allocator WITHOUT emitting a
+    span — the handle a sampled request carries across the worker-thread
+    boundary so its child spans can name an explicit ``parent=`` and the
+    root can be emitted later under ``span_id=`` (the ring's thread-local
+    parent chain does not cross threads)."""
+    global _span_seq
+    with _lock:
+        _span_seq += 1
+        return _span_seq
+
+
 def emit_span(name: str, start_us: float, dur_us: float,
+              parent: Optional[int] = None, span_id: Optional[int] = None,
               **args) -> None:
     """Append one COMPLETED span to the process span ring: ``start_us``
     on the ``time.perf_counter()`` microsecond clock, ``dur_us`` its
     duration. Parents under the thread's currently open :func:`span`
-    (matching the native ``EmitSpan``). Extra keyword args ride along as
-    the span's ``args`` dict (keep them small — shard ids, byte counts).
-    No-op when telemetry is disabled; the ring keeps the most recent
-    :data:`SPANS_MAX` spans and counts what it overwrote."""
+    (matching the native ``EmitSpan``) unless an explicit ``parent=`` is
+    given — the cross-thread handoff used by sampled request chains
+    (pass ``parent=0`` for an explicit root). ``span_id=`` reuses an id
+    from :func:`new_span_id` instead of allocating. Extra keyword args
+    ride along as the span's ``args`` dict (keep them small — shard ids,
+    byte counts). No-op when telemetry is disabled; the ring keeps the
+    most recent :data:`SPANS_MAX` spans and counts what it overwrote."""
     if not enabled():
         return
-    global _span_seq
-    with _lock:
-        _span_seq += 1
-        span_id = _span_seq
-    _append_span(name, span_id, getattr(_tls, "open_span", 0), start_us,
-                 dur_us, args or None)
+    if span_id is None:
+        span_id = new_span_id()
+    if parent is None:
+        parent = getattr(_tls, "open_span", 0)
+    _append_span(name, span_id, parent, start_us, dur_us, args or None)
 
 
 class _Span:
@@ -529,7 +565,8 @@ def trace_json(snap: Optional[dict] = None) -> str:
 # verdict -> stall_verdict_code gauge value
 VERDICT_CODES = {"unknown": -1, "fill_bound": 0, "parse_bound": 1,
                  "consumer_bound": 2, "transfer_bound": 3,
-                 "stage_bound": 4, "compile_bound": 5}
+                 "stage_bound": 4, "compile_bound": 5,
+                 "straggler_bound": 6}
 
 # the consumer counts as the binding stage when it spent less than this
 # fraction of the pipeline's busy time waiting on the head-of-line chunk
@@ -644,6 +681,43 @@ def device_overlap_ratio(span_list: Optional[List[dict]] = None
     if total <= 0:
         return None
     return min(max((total - exposed) / total, 0.0), 1.0)
+
+
+def straggler_attribution(step_durs_by_rank: Dict[int, List[float]],
+                          factor: float = 2.0,
+                          min_steps: int = 3) -> dict:
+    """Name the mesh straggler from per-rank recent step durations
+    (doc/observability.md "Step timelines"): a rank is ``straggler_bound``
+    when its median step over the window sustains above ``factor`` times
+    the median of the OTHER ranks' medians — a sustained-ratio test, so
+    one GC pause or one slow step cannot page. Ranks with fewer than
+    ``min_steps`` observations abstain; fewer than two voting ranks (no
+    peer baseline) is ``unknown``. Returns ``{"verdict", "rank",
+    "ratio", "median_us": {rank: median}}`` — ``rank``/``ratio`` are
+    ``None``/``0.0`` when no straggler is bound."""
+    medians: Dict[int, float] = {}
+    for rank, durs in step_durs_by_rank.items():
+        if len(durs) >= max(1, int(min_steps)):
+            s = sorted(durs)
+            medians[rank] = float(s[len(s) // 2])
+    out = {"verdict": "unknown", "rank": None, "ratio": 0.0,
+           "median_us": medians}
+    if len(medians) < 2:
+        return out
+    worst_rank, worst_ratio = None, 0.0
+    for rank, med in medians.items():
+        peers = sorted(m for r, m in medians.items() if r != rank)
+        peer_med = peers[len(peers) // 2]
+        if peer_med <= 0:
+            continue
+        ratio = med / peer_med
+        if ratio > worst_ratio:
+            worst_rank, worst_ratio = rank, ratio
+    if worst_rank is not None and worst_ratio > factor:
+        out["verdict"] = "straggler_bound"
+        out["rank"] = worst_rank
+        out["ratio"] = worst_ratio
+    return out
 
 
 # -- flight recorder (doc/observability.md "Flight recorder") ----------------
@@ -761,13 +835,16 @@ def cluster_prometheus_text(per_rank: Dict[int, dict],
 
 
 def cluster_trace_json(per_rank: Dict[int, dict],
-                       local_trace: Optional[dict] = None) -> str:
+                       local_trace: Optional[dict] = None,
+                       meta: Optional[dict] = None) -> str:
     """The merged job timeline a live tracker serves at ``GET /trace``:
     one Chrome-trace/Perfetto document with a process lane PER RANK (the
     event ``pid`` is the rank, the lane is labeled with the rank and its
     OS pid) plus the tracker's own lane. Every rank's spans arrive
     already wall-clock-shifted by that rank's anchor pair
-    (:func:`rank_export`), so the lanes share one timeline."""
+    (:func:`rank_export`), so the lanes share one timeline. ``meta``
+    (e.g. the tracker's :func:`straggler_attribution` verdict) rides as
+    one metadata ("M") event on the tracker lane."""
     evs: List[dict] = []
     for rank in sorted(per_rank):
         doc = per_rank[rank]
@@ -777,6 +854,9 @@ def cluster_trace_json(per_rank: Dict[int, dict],
         local_trace = trace_snapshot()
     evs += _chrome_events(_wall_spans(local_trace), 999999,
                           f"tracker (pid {local_trace.get('pid', '?')})")
+    if meta:
+        evs.append({"ph": "M", "name": "job_meta", "pid": 999999,
+                    "tid": 0, "args": dict(meta)})
     return json.dumps({"traceEvents": evs, "displayTimeUnit": "ms"})
 
 
@@ -855,10 +935,19 @@ def snapshot(native: Optional[bool] = None) -> dict:
             doc["gauges"].append({"name": g.name, "labels": g.labels,
                                   "value": g.value})
         for h in _hists.values():
-            doc["histograms"].append(
-                {"name": h.name, "labels": h.labels, "count": h.count,
-                 "sum": h.sum, "buckets": list(h.buckets)})
+            rec = {"name": h.name, "labels": h.labels, "count": h.count,
+                   "sum": h.sum, "buckets": list(h.buckets)}
+            if h.exemplars:
+                # JSON-snapshot only (never the text exposition): the
+                # bucket -> last-sampled-trace-id breadcrumbs
+                rec["exemplars"] = dict(h.exemplars)
+            doc["histograms"].append(rec)
         doc["events"] = list(_events)
+        # the Python ring's overflow count, labeled so it can never
+        # collide with the native half's spans_dropped_total sample
+        doc["counters"].append({"name": "spans_dropped_total",
+                                "labels": {"half": "python"},
+                                "value": _spans_dropped})
     # derived stall-attribution gauges ride every snapshot (and therefore
     # every /metrics scrape) without a collector: they are computed FROM
     # the snapshot, so a collector would recurse
@@ -1016,7 +1105,22 @@ METRIC_HELP: Dict[str, str] = {
         "fraction of instrumented batch-path time in the stage",
     "stall_verdict_code":
         "-1 unknown, 0 fill, 1 parse, 2 consumer, 3 transfer, 4 stage, "
-        "5 compile bound",
+        "5 compile, 6 straggler bound",
+    "spans_dropped_total":
+        "span-ring records overwritten by wrap, per half",
+    # SLO plane (WindowedView/SloMonitor, doc/observability.md "SLO plane")
+    "window_rate":
+        "per-second counter rate over the rolling window, summed across "
+        "label sets",
+    "window_quantile":
+        "delta-histogram quantile over the rolling window (overflow "
+        "clamped to the top bucket bound)",
+    "slo_burn_rate":
+        "error-budget burn multiple per objective and window",
+    "slo_page": "1 while any SLO objective is paging (latched)",
+    "slo_page_trips_total": "SLO page activations per objective",
+    "tracker_straggler_rank":
+        "rank bound as the mesh straggler (-1 when none)",
     # measurement rig (scripts/loadrig.py, doc/benchmarking.md)
     "rig_requests_total": "open/closed-loop requests completed",
     "rig_errors_total": "load-generator requests that failed",
@@ -1037,7 +1141,7 @@ METRIC_HELP: Dict[str, str] = {
     "serve_scored_total": "score requests answered 200 with scores",
     "serve_shed_total":
         "requests shed by reason: queue_full, late (intended-time "
-        "lateness budget), draining, breaker",
+        "lateness budget), draining, breaker, slo_burn",
     "serve_rejects_total":
         "error responses by HTTP status code (sheds are additionally "
         "counted by reason in serve_shed_total)",
@@ -1060,6 +1164,8 @@ METRIC_HELP: Dict[str, str] = {
     "serve_draining": "1 while draining shutdown runs",
     "serve_distinct_shapes":
         "distinct padded (kind, rows, nnz) forward shapes this process",
+    "serve_access_log_dropped_total":
+        "access-log lines dropped by a contained I/O failure",
 }
 
 
@@ -1151,6 +1257,366 @@ def events_jsonl(snap: Optional[dict] = None) -> str:
     if snap is None:
         snap = snapshot()
     return "".join(json.dumps(rec) + "\n" for rec in snap.get("events", []))
+
+
+# ---------------------------------------------------------------------------
+# Rolling windows + SLO plane (doc/observability.md "SLO plane"): every
+# registry series is process-lifetime cumulative, which is the right
+# substrate (resets are visible, sums are exact) but the wrong operator
+# surface — "is NOW bad" needs rates and quantiles over the last minutes,
+# not since boot.  The WindowedView snapshots the merged registry (native
+# + Python — deltas over snapshots, so the C++ half needs zero hot-path
+# changes) on a cadence and publishes per-window rate/quantile gauges;
+# the SloMonitor turns two of those windows into multi-window burn rates
+# against declared objectives and latches a page with hysteresis.
+# ---------------------------------------------------------------------------
+
+# cardinality ceiling on the compact per-(name, labels) state one tick
+# keeps: a test registering thousands of ad-hoc series must degrade the
+# window view (silently-partial windows over the FIRST _MAX_SERIES keys),
+# never the process
+_MAX_SERIES = 4096
+
+
+def _compact_snapshot(snap: dict) -> Tuple[Dict[tuple, float],
+                                           Dict[tuple, tuple]]:
+    """Reduce one merged snapshot to the per-(name, labels) counter
+    values and histogram (count, sum, buckets) tuples the window math
+    needs — gauges are point-in-time and carry no delta meaning, so they
+    are dropped (which is also what makes :meth:`WindowedView.tick` safe
+    to run off :func:`snapshot`: the derived gauges it appends are
+    ignored here)."""
+    counters: Dict[tuple, float] = {}
+    hists: Dict[tuple, tuple] = {}
+    for c in snap.get("counters", ()):
+        if len(counters) >= _MAX_SERIES:
+            break
+        key = (c["name"], _labels_key(c.get("labels")))
+        counters[key] = counters.get(key, 0.0) + float(c["value"])
+    for h in snap.get("histograms", ()):
+        if len(hists) >= _MAX_SERIES:
+            break
+        key = (h["name"], _labels_key(h.get("labels")))
+        prev = hists.get(key)
+        if prev is None:
+            hists[key] = (int(h["count"]), float(h["sum"]),
+                          tuple(h["buckets"]))
+        else:
+            hists[key] = (prev[0] + int(h["count"]),
+                          prev[1] + float(h["sum"]),
+                          tuple(a + b for a, b in
+                                zip(prev[2], h["buckets"])))
+    return counters, hists
+
+
+class SloMonitor:
+    """Multi-window burn-rate monitor over a :class:`WindowedView`
+    (doc/observability.md "SLO plane").
+
+    Two declared objectives, both on the serving plane's own series:
+    **availability** (fraction of non-error, non-shed answers,
+    ``DMLC_SLO_AVAILABILITY_TARGET``) and **latency** (fraction of
+    answers under ``DMLC_SLO_LATENCY_TARGET_MS`` on the intended-time
+    clock, ``DMLC_SLO_LATENCY_TARGET``). Each objective's burn rate —
+    (bad fraction over the window) / (error budget) — is published per
+    window as ``slo_burn_rate{slo=,window=}``; a page latches when EVERY
+    window burns at ``DMLC_SLO_FAST_BURN`` or above (the multi-window
+    rule: the fast window proves it is happening NOW, the slow window
+    proves it is not a blip) and clears with hysteresis when the fastest
+    window drops under ``DMLC_SLO_CLEAR_BURN``. A page flips
+    ``slo_page``, bumps ``slo_page_trips_total{slo=}``, and lands a
+    flight dump naming the tripping windows and burn values.
+
+    Sheds the admission gate took BECAUSE of the page (``reason=
+    "slo_burn"``) are excluded from the bad count — otherwise the
+    monitor's own load-shedding would hold the burn high forever and the
+    page could never clear once the underlying fault lifted."""
+
+    def __init__(self):
+        from dmlc_core_tpu.tracker.wire import env_float, env_int
+        self.availability_target = env_float(
+            "DMLC_SLO_AVAILABILITY_TARGET", 0.999)
+        self.latency_target_ms = env_int("DMLC_SLO_LATENCY_TARGET_MS", 250)
+        self.latency_target = env_float("DMLC_SLO_LATENCY_TARGET", 0.99)
+        self.fast_burn = env_float("DMLC_SLO_FAST_BURN", 14.4)
+        self.slow_burn = env_float("DMLC_SLO_SLOW_BURN", 6.0)
+        self.clear_burn = env_float("DMLC_SLO_CLEAR_BURN", 1.0)
+        self._paging: set = set()
+        self._page_gauge = gauge("slo_page")
+
+    @property
+    def paging(self) -> bool:
+        """Whether any objective is currently paging (latched)."""
+        return bool(self._paging)
+
+    @staticmethod
+    def _availability_burn(dcounters: Dict[tuple, float],
+                           budget: float) -> float:
+        good = bad = 0.0
+        for (name, labels), v in dcounters.items():
+            v = max(v, 0.0)
+            if name == "serve_scored_total":
+                good += v
+            elif name == "serve_errors_total":
+                bad += v
+            elif name == "serve_shed_total":
+                if dict(labels).get("reason") != "slo_burn":
+                    bad += v
+        total = good + bad
+        if total <= 0:
+            return 0.0
+        return (bad / total) / budget
+
+    def _latency_burn(self, dhists: Dict[tuple, tuple],
+                      budget: float) -> float:
+        count = 0
+        buckets = [0] * (HIST_BUCKETS + 1)
+        for (name, _labels), (dc, _ds, db) in dhists.items():
+            if name != "serve_request_us":
+                continue
+            count += max(dc, 0)
+            for i, n in enumerate(db):
+                buckets[i] += max(n, 0)
+        if count <= 0:
+            return 0.0
+        target_us = self.latency_target_ms * 1000
+        good = sum(n for i, n in enumerate(buckets)
+                   if i < HIST_BUCKETS and (1 << i) <= target_us)
+        bad = max(count - good, 0)
+        return (bad / count) / budget
+
+    def evaluate(self, deltas: Dict[str, tuple]) -> None:
+        """Evaluate both objectives over one tick's per-window deltas
+        (``{window_label: (elapsed_s, dcounters, dhists)}`` from
+        :meth:`WindowedView.deltas`), publish the burn gauges, and run
+        the page/clear latch."""
+        if not deltas:
+            return
+        burns: Dict[str, Dict[str, float]] = {"availability": {},
+                                              "latency": {}}
+        avail_budget = max(1.0 - self.availability_target, 1e-9)
+        lat_budget = max(1.0 - self.latency_target, 1e-9)
+        for label, (_elapsed, dcounters, dhists) in deltas.items():
+            burns["availability"][label] = self._availability_burn(
+                dcounters, avail_budget)
+            burns["latency"][label] = self._latency_burn(
+                dhists, lat_budget)
+        # the hysteresis clear reads the most responsive window — the
+        # one whose delta spans the least elapsed time
+        fastest = min(deltas, key=lambda lb: deltas[lb][0])
+        for slo, per_window in burns.items():
+            for label, burn in per_window.items():
+                labels = {"slo": slo, "window": label}
+                gauge("slo_burn_rate", labels).set(round(burn, 4))
+            if slo not in self._paging:
+                if per_window and min(per_window.values()) >= \
+                        self.fast_burn:
+                    self._paging.add(slo)
+                    counter("slo_page_trips_total", {"slo": slo}).inc()
+                    detail = ", ".join(
+                        f"{lb}={b:.1f}x" for lb, b in
+                        sorted(per_window.items()))
+                    emit_event("slo-page", slo=slo, burns=detail)
+                    flight_dump(f"slo-page: {slo} burn [{detail}] >= "
+                                f"{self.fast_burn}x budget")
+            elif per_window.get(fastest, 0.0) < self.clear_burn:
+                self._paging.discard(slo)
+                emit_event("slo-page-clear", slo=slo)
+        self._page_gauge.set(1.0 if self._paging else 0.0)
+
+
+class WindowedView:
+    """Rolling-window view over the cumulative registry
+    (doc/observability.md "SLO plane").
+
+    A daemon ticker (cadence ``DMLC_SLO_TICK_MS``) takes compact
+    registry snapshots and keeps just enough of them to serve deltas for
+    each configured window (default ``fast`` = ``DMLC_SLO_WINDOW_FAST_S``
+    and ``slow`` = ``DMLC_SLO_WINDOW_SLOW_S``; knob-scaled down to
+    sub-second in tests). Every tick publishes, per window:
+    ``window_rate{name=,window=}`` (counter delta per second, summed
+    across label sets) and ``window_quantile{name=,window=,q=}``
+    (p50/p99 from the window's DELTA histogram buckets via
+    :func:`quantile_from_buckets`, overflow clamped to the top bucket
+    bound) — ordinary gauges, so every ``/metrics`` surface serves them
+    with zero extra plumbing. An attached :class:`SloMonitor` (serving
+    processes) is fed the same deltas.
+
+    Use the module helpers :func:`start_windowed_view` /
+    :func:`stop_windowed_view` (refcounted process singleton);
+    :meth:`tick` is public so tests can drive the clock
+    deterministically with ``now=``."""
+
+    def __init__(self, windows: Optional[Dict[str, float]] = None):
+        from dmlc_core_tpu.tracker.wire import env_int
+        self.tick_s = max(env_int("DMLC_SLO_TICK_MS", 5000), 10) / 1000.0
+        if windows is None:
+            windows = {"fast": float(env_int("DMLC_SLO_WINDOW_FAST_S",
+                                             300)),
+                       "slow": float(env_int("DMLC_SLO_WINDOW_SLOW_S",
+                                             3600))}
+        self.windows = dict(windows)
+        self.slo: Optional[SloMonitor] = None
+        self._snaps: List[tuple] = []   # (t, counters, hists)
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- window math --------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        """Take one compact snapshot at ``now`` (default: the monotonic
+        clock), prune history past the longest window, publish the
+        window gauges, and feed the SLO monitor."""
+        if now is None:
+            now = time.monotonic()
+        counters, hists = _compact_snapshot(snapshot())
+        horizon = max(self.windows.values()) + 2 * self.tick_s
+        with self._mu:
+            self._snaps.append((now, counters, hists))
+            while len(self._snaps) > 2 and self._snaps[1][0] < \
+                    now - horizon:
+                self._snaps.pop(0)
+            deltas = self._deltas_locked(now)
+        self._publish(deltas)
+        if self.slo is not None:
+            self.slo.evaluate(deltas)
+
+    def _baseline_locked(self, now: float, seconds: float):
+        base = None
+        for rec in self._snaps:
+            if rec[0] <= now - seconds:
+                base = rec           # newest snap at/before window start
+            else:
+                break
+        return base or self._snaps[0]
+
+    def _deltas_locked(self, now: float) -> Dict[str, tuple]:
+        out: Dict[str, tuple] = {}
+        if len(self._snaps) < 2:
+            return out
+        cur_t, cur_c, cur_h = self._snaps[-1]
+        for label, seconds in self.windows.items():
+            base_t, base_c, base_h = self._baseline_locked(now, seconds)
+            elapsed = cur_t - base_t
+            if elapsed <= 0:
+                continue
+            dcounters = {k: v - base_c.get(k, 0.0)
+                         for k, v in cur_c.items()}
+            dhists = {}
+            for k, (c, s, b) in cur_h.items():
+                bc, bs, bb = base_h.get(k, (0, 0.0, (0,) * len(b)))
+                dhists[k] = (c - bc, s - bs,
+                             tuple(x - y for x, y in zip(b, bb)))
+            out[label] = (elapsed, dcounters, dhists)
+        return out
+
+    def deltas(self) -> Dict[str, tuple]:
+        """This instant's per-window ``(elapsed_s, dcounters, dhists)``
+        map (the same structure :meth:`tick` publishes from) — the raw
+        material for tests and ad-hoc window math."""
+        with self._mu:
+            return self._deltas_locked(time.monotonic())
+
+    def _publish(self, deltas: Dict[str, tuple]) -> None:
+        top = float(1 << HIST_BUCKETS)  # overflow clamp: top bucket bound
+        for label, (elapsed, dcounters, dhists) in deltas.items():
+            rates: Dict[str, float] = {}
+            for (name, _labels), v in dcounters.items():
+                rates[name] = rates.get(name, 0.0) + max(v, 0.0)
+            for name, total in rates.items():
+                gauge("window_rate",
+                      {"name": name, "window": label}).set(
+                          round(total / elapsed, 4))
+            per_name: Dict[str, tuple] = {}
+            for (name, _labels), (dc, _ds, db) in dhists.items():
+                pc, pb = per_name.get(
+                    name, (0, (0,) * (HIST_BUCKETS + 1)))
+                per_name[name] = (pc + max(dc, 0),
+                                  tuple(x + max(y, 0)
+                                        for x, y in zip(pb, db)))
+            for name, (dc, db) in per_name.items():
+                if dc <= 0:
+                    continue
+                for q in (0.5, 0.99):
+                    val = quantile_from_buckets(list(db), dc, q)
+                    gauge("window_quantile",
+                          {"name": name, "window": label,
+                           "q": str(q)}).set(min(val, top))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "WindowedView":
+        """Start the ticker thread (idempotent)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="windowed-view")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the ticker thread (idempotent, joins briefly)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.tick()
+            except Exception:
+                pass  # a broken tick must not kill the view
+
+
+_view_lock = threading.Lock()
+_view: Optional[WindowedView] = None
+_view_refs = 0
+
+
+def start_windowed_view(slo: bool = False) -> WindowedView:
+    """Start (or ref) the process :class:`WindowedView` singleton; with
+    ``slo=True`` also attach the :class:`SloMonitor` (serving processes
+    want the burn monitors, a tracker just wants the window series).
+    Pair every call with :func:`stop_windowed_view`."""
+    global _view, _view_refs
+    with _view_lock:
+        if _view is None:
+            _view = WindowedView().start()
+        if slo and _view.slo is None:
+            _view.slo = SloMonitor()
+        _view_refs += 1
+        return _view
+
+
+def stop_windowed_view(force: bool = False) -> None:
+    """Drop one reference on the process view; the last drop (or
+    ``force=True``, used by :func:`reset` for test isolation) stops the
+    ticker and clears the singleton."""
+    global _view, _view_refs
+    with _view_lock:
+        if _view is None:
+            _view_refs = 0
+            return
+        _view_refs = 0 if force else max(_view_refs - 1, 0)
+        if _view_refs == 0:
+            v, _view = _view, None
+        else:
+            return
+    v.stop()
+
+
+def windowed_view() -> Optional[WindowedView]:
+    """The live process :class:`WindowedView`, or None when no component
+    has started one."""
+    return _view
+
+
+def slo_page_active() -> bool:
+    """Whether the process SLO monitor is currently paging — the burn
+    signal the serving admission gate and ``/readyz`` read."""
+    v = _view
+    return v is not None and v.slo is not None and v.slo.paging
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,8 @@ and both render the same minimal HTTP/1.1 responses. This module is
 that shared plumbing — pure byte-level helpers, no sockets, no loop.
 """
 
+import os
+import re
 from typing import Dict, Optional, Tuple
 
 # Hard ceiling on request line + headers (the terminating CRLFCRLF
@@ -67,6 +69,22 @@ class HttpError(Exception):
         #: extra response headers (e.g. ``Retry-After`` on a shed 429/503)
         self.headers = headers
         self.message = message
+
+
+# accepted inbound X-Request-Id shape: anything else is replaced with a
+# minted id (a request id lands in logs, traces, and response headers —
+# it must never be a header-injection or log-forgery vector)
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+def request_id(incoming: Optional[str] = None) -> str:
+    """The request id for one inbound request: the client's
+    ``X-Request-Id`` when it is well-formed (``[A-Za-z0-9._-]{1,64}`` —
+    propagation across hops), else a freshly minted 16-hex-char id.
+    Pure sanitize-or-mint; the caller owns echoing it on the reply."""
+    if incoming and _REQUEST_ID_RE.match(incoming):
+        return incoming
+    return os.urandom(8).hex()
 
 
 def sniff_method(head: bytes) -> Optional[str]:

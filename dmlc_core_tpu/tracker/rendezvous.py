@@ -49,6 +49,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+from collections import deque
 import queue
 import selectors
 import socket
@@ -152,10 +153,13 @@ class _EventLog:
     Python fault plan (utils.fs_fault), which the containment tests
     drive."""
 
-    def __init__(self, path: str, max_bytes: int):
+    def __init__(self, path: str, max_bytes: int, dropped=None):
         self._path = path
         self._max_bytes = max_bytes  # 0 = rotation off
-        self._dropped = telemetry.counter("event_log_dropped_total")
+        # `dropped`: the drop counter to charge (the serving access log
+        # reuses this sink with its own serve_access_log_dropped_total)
+        self._dropped = dropped if dropped is not None else \
+            telemetry.counter("event_log_dropped_total")
         self._warned_bad_plan = False
         self._fp = open(path, "a", buffering=1)
         try:
@@ -435,6 +439,17 @@ class RabitTracker:
         telemetry.register_collector(self._publish_telemetry)
         self._ranks: Dict[int, _RankState] = {}
 
+        # mesh step timelines (doc/observability.md "Step timelines"):
+        # per-rank step durations harvested from the `mesh.step` spans
+        # riding TELEMETRY_PUSH replies feed the straggler verdict
+        self.straggler_factor = env_float("DMLC_TRACKER_STRAGGLER_FACTOR",
+                                          2.0)
+        self.straggler_min_steps = env_int(
+            "DMLC_TRACKER_STRAGGLER_MIN_STEPS", 3)
+        self._step_durs: Dict[int, "deque"] = {}
+        self._step_hi: Dict[int, int] = {}
+        self._wv_started = False
+
         # elastic data-plane: num_shards > 0 pre-splits the dataset into S
         # logical shard leases served over the heartbeat channel; ctor
         # beats env, 0 keeps the legacy static num_parts/part_index plane
@@ -549,6 +564,70 @@ class RabitTracker:
                 info["restarts"])
             telemetry.gauge("tracker_rank_attempts", labels).set(
                 info["attempts"])
+        verdict = self._straggler()
+        telemetry.gauge("tracker_straggler_rank").set(
+            verdict["rank"] if verdict["verdict"] == "straggler_bound"
+            else -1)
+
+    # how many recent step durations each rank's straggler vote sees; a
+    # bounded window makes the verdict track the CURRENT regime (a rank
+    # that was slow an hour ago and recovered must stop being named)
+    STEP_WINDOW = 64
+
+    def _harvest_steps(self, rank: int, doc: dict) -> None:
+        """Fold one rank's ``mesh.step`` spans (riding its TELEMETRY_PUSH
+        document) into the bounded per-rank step-duration window the
+        straggler verdict reads. Span ids are monotonic per process, so a
+        high-water mark dedupes spans re-exported across scrapes; a max
+        id BELOW the mark means the worker restarted with a fresh span
+        counter, and the mark resets so the new incarnation counts."""
+        spans = doc.get("spans")
+        if not isinstance(spans, list):
+            return
+        steps = [s for s in spans if isinstance(s, dict)
+                 and s.get("name") == "mesh.step"]
+        if not steps:
+            return
+        with self._lock:
+            hi = self._step_hi.get(rank, 0)
+            ids = []
+            for s in steps:
+                try:
+                    ids.append(int(s.get("id", 0)))
+                except (TypeError, ValueError):
+                    ids.append(0)
+            if max(ids) < hi:
+                hi = 0
+            durs = self._step_durs.setdefault(
+                rank, deque(maxlen=self.STEP_WINDOW))
+            for s, sid in zip(steps, ids):
+                if sid <= hi:
+                    continue
+                try:
+                    durs.append(float(s.get("dur", 0.0)))
+                except (TypeError, ValueError):
+                    continue
+            self._step_hi[rank] = max([hi] + ids)
+
+    def _straggler(self) -> dict:
+        """The current straggler verdict over the harvested per-rank step
+        windows (``unknown`` until at least two ranks have reported
+        ``straggler_min_steps`` steps each)."""
+        with self._lock:
+            durs = {r: list(d) for r, d in self._step_durs.items()}
+        return telemetry.straggler_attribution(
+            durs, factor=self.straggler_factor,
+            min_steps=self.straggler_min_steps)
+
+    def _straggler_tail(self) -> str:
+        """A ``; straggler ...`` suffix for flight-dump reasons when a
+        straggler is currently bound, else empty — dead-rank and abort
+        postmortems name the rank that was dragging the mesh."""
+        strag = self._straggler()
+        if strag["verdict"] != "straggler_bound":
+            return ""
+        return (f"; straggler rank {strag['rank']} at "
+                f"{strag['ratio']:.1f}x the peer median step")
 
     @property
     def elastic(self) -> bool:
@@ -653,6 +732,12 @@ class RabitTracker:
 
     def start(self) -> None:
         """Begin serving worker connections on the tracker thread."""
+        # rolling windows over the tracker's own registry: every scrape
+        # surface gains window_* rates/quantiles (doc/observability.md
+        # "SLO plane"); refcounted, released in _close_all
+        telemetry.start_windowed_view()
+        self._wv_started = True
+
         def guarded():
             try:
                 self._serve(self.num_workers)
@@ -916,7 +1001,8 @@ class RabitTracker:
         held = ", ".join(f"{e}:{s}" for e, s in reclaimed) or "none"
         telemetry.flight_dump(f"rank-lost: rank {rank} written off, "
                               f"{len(reclaimed)} lease(s) reclaimed "
-                              f"(epoch:shard {held})")
+                              f"(epoch:shard {held})"
+                              f"{self._straggler_tail()}")
 
     def _check_finished(self) -> None:
         """Elastic finish rule (serve loop only): the job completes once
@@ -958,7 +1044,8 @@ class RabitTracker:
         self._emit("abort", reason=err.reason, dead_ranks=err.dead_ranks)
         # flight recorder: the abort path is exactly when the postmortem
         # matters; dumped AFTER the abort event so the ring carries it
-        telemetry.flight_dump(f"tracker-abort: {err.reason}")
+        telemetry.flight_dump(
+            f"tracker-abort: {err.reason}{self._straggler_tail()}")
         with self._lock:
             if self._event_log is not None:
                 # fsync through to disk NOW: the abort path is exactly when
@@ -1172,6 +1259,9 @@ class RabitTracker:
                 self._event_log = None
         # a closed tracker must stop publishing gauges into scrapes
         telemetry.unregister_collector(self._publish_telemetry)
+        if self._wv_started:
+            self._wv_started = False
+            telemetry.stop_windowed_view()
 
     # -- the tracker protocol, as one coroutine per connection ---------------
     def _proto(self, conn: _Conn):
@@ -1413,6 +1503,7 @@ class RabitTracker:
                     # serve loop — one bad frame must never cost the job
                     doc = None
                 if doc is not None:
+                    self._harvest_steps(rank, doc)
                     self._telemetry_reply(rank, doc)
                 if revived:
                     self._emit("revived", rank=rank)
@@ -1618,7 +1709,11 @@ class RabitTracker:
                 status, ctype = 200, \
                     "text/plain; version=0.0.4; charset=utf-8"
             else:
-                body = (telemetry.cluster_trace_json(replies) +
+                # the straggler verdict rides the merged timeline as a
+                # job_meta record, so the one-timeline view names the
+                # dragging rank next to its visibly-longer step spans
+                body = (telemetry.cluster_trace_json(
+                            replies, meta=self._straggler()) +
                         "\n").encode()
                 status, ctype = 200, "application/json"
         elif path == "/healthz":

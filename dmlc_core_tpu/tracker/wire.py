@@ -140,6 +140,19 @@ def env_int(name: str, default: int, env=None) -> int:
         raise RuntimeError(f"{name}={raw!r} is not an integer")
 
 
+def env_str(name: str, default: Optional[str] = None,
+            env=None) -> Optional[str]:
+    """String env knob read through the same checked gate as the numeric
+    parsers (one registry, one doc table): unset or empty returns the
+    default — path-valued knobs like DMLC_SERVE_ACCESS_LOG treat "" as
+    "off", matching the event-log convention."""
+    import os
+    raw = (os.environ if env is None else env).get(name)
+    if raw is None or raw == "":
+        return default
+    return raw
+
+
 def env_int_opt(name: str, env=None):
     """Presence-gated checked parse for launcher rank/count variables:
     None when the variable is UNSET (the caller falls through to its next

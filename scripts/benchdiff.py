@@ -59,7 +59,7 @@ GOOD_LEAVES = {
 # recovery times): flat_metrics carries them and compare() inverts the
 # ratio so "REGRESSION" still means "got worse"
 LOW_LEAVES = {
-    "recovery_s", "open_loop_p99_ms",
+    "recovery_s", "open_loop_p99_ms", "slo_burn_clean",
 }
 
 # extras entries that are lanes worth carrying into the ledger

@@ -687,7 +687,8 @@ def extract_doc_catalog(md_text):
 # ===========================================================================
 
 _KNOB_NAME_RE = re.compile(r"^(?:DMLC|DCT)_[A-Z0-9_]+$")
-_PY_ENV_HELPERS = {"env_int", "env_float", "env_enum", "env_int_opt"}
+_PY_ENV_HELPERS = {"env_int", "env_float", "env_enum", "env_int_opt",
+                   "env_str"}
 
 
 class KnobSite:

@@ -1,0 +1,53 @@
+"""Worker driven by tests/test_tracing.py (step-timeline e2e).
+
+A real OS process that joins the rendezvous with heartbeats, runs a
+short :class:`StepWatchdog`-clocked step loop — each ``step_begin`` /
+``step_end`` pair emits one ``mesh.step`` span into this process's span
+ring — with a per-step sleep taken from ``DMLC_TEST_STEP_SLEEP_MS`` (the
+parent slows ONE rank to manufacture a straggler), writes a
+``stepped_<task>`` marker, then parks LIVE (heartbeating and answering
+TELEMETRY_PULL frames) until ``<scratch>/release`` appears, so the
+parent can scrape the tracker's ``/trace`` and straggler gauge while
+both ranks hold real step telemetry.
+
+Usage: python step_worker.py <repo_root> <scratch_dir>
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    repo, scratch = sys.argv[1], sys.argv[2]
+    sys.path.insert(0, repo)
+    from dmlc_core_tpu.parallel.elastic import StepWatchdog
+    from dmlc_core_tpu.tracker.client import RendezvousClient
+
+    task = int(os.environ["DMLC_TASK_ID"])
+    sleep_s = float(os.environ.get("DMLC_TEST_STEP_SLEEP_MS", "10")) / 1e3
+    steps = int(os.environ.get("DMLC_TEST_STEPS", "6"))
+    client = RendezvousClient(os.environ["DMLC_TRACKER_URI"],
+                              int(os.environ["DMLC_TRACKER_PORT"]))
+    assign = client.start(heartbeat=True)
+
+    wd = StepWatchdog(rank=assign.rank)
+    for step in range(steps):
+        wd.step_begin(step)
+        time.sleep(sleep_s)  # the "training step"
+        wd.step_end()
+    with open(os.path.join(scratch, f"stepped_{task}"), "w") as f:
+        f.write(f"{assign.rank} {steps}")
+
+    release = os.path.join(scratch, "release")
+    deadline = time.monotonic() + 120
+    while not os.path.exists(release):
+        if time.monotonic() > deadline:
+            sys.exit(5)
+        client.heartbeat.check()  # an abort must not leave a zombie
+        time.sleep(0.05)
+    client.shutdown(assign.rank)
+
+
+if __name__ == "__main__":
+    main()

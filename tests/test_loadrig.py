@@ -155,15 +155,19 @@ def test_open_loop_smoke_fixed_qps():
 
 
 def test_coordinated_omission_pin():
-    """An origin stalling 200 ms every 160th response: the stall queues
+    """An origin stalling 200 ms every 240th response: the stall queues
     arrivals behind the single in-flight slot, so the intended-time p99
     sees it while the naive service-time p99 — which only times
     send-to-response — hides it.  The service-time capture only admits
-    the stall at p999 (the stalled requests themselves)."""
-    cfg = mock_origin.OriginConfig(slow_every=160, slow_ms=200)
+    the stall at p999 (the stalled requests themselves).
+
+    720 arrivals with ~3 stalls keeps the stall fraction (0.4%) well
+    under the p99 index (8th-worst sample) — the service-p99 bound must
+    not flip on a couple of host-jitter outliers on a 1-core box."""
+    cfg = mock_origin.OriginConfig(slow_every=240, slow_ms=200)
     with loadrig.spawn_origin("http", ["/tiny=4096:3"], cfg) as org:
         fn = loadrig.http_request_fn(org.uri("/tiny"))
-        r = loadrig.open_loop(fn, qps=120, duration_s=4, max_inflight=1)
+        r = loadrig.open_loop(fn, qps=120, duration_s=6, max_inflight=1)
     assert r["errors"] == 0 and r["completed"] == r["arrivals"]
     intended_p99 = r["intended_us"]["p99"]
     service_p99 = r["service_us"]["p99"]

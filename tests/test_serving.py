@@ -33,8 +33,7 @@ import pytest
 from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.serving import batching
 from dmlc_core_tpu.serving import model as serving_model
-from dmlc_core_tpu.serving.server import (BREAKER_CLOSED, BREAKER_OPEN,
-                                          ServingConfig)
+from dmlc_core_tpu.serving.server import BREAKER_CLOSED, BREAKER_OPEN
 from dmlc_core_tpu.tracker import minihttp
 from tests.serving_util import (AsyncReq, Client, ForwardGate,
                                 expect_scores, raw_http, save_linear,
@@ -577,3 +576,54 @@ def test_serving_lane_compare_direction(capsys):
     assert benchdiff.compare(base, better, 0.1, []) == 0
     out = capsys.readouterr().out
     assert "serving_lane.open_loop_p99_ms" in out
+
+
+# ---------------------------------------------------------------------------
+def test_access_log_and_breaker_flight_dump(tmp_path, monkeypatch):
+    """Observability satellites: every answered/shed request lands one
+    structured JSONL access-log line (request id, status, intended-time
+    latency, cause), and a breaker trip is a flight-recorder trigger —
+    the dump reason names the consecutive-failure count vs the
+    threshold (doc/observability.md flight-recorder table)."""
+    dump_dir = tmp_path / "dumps"
+    monkeypatch.setenv("DMLC_TRACE_DUMP", str(dump_dir))
+    alog = tmp_path / "access.jsonl"
+    uri, _, _ = save_linear(tmp_path)
+    with serving_server(uri, access_log=str(alog), batch_delay_ms=0.0,
+                        breaker_threshold=2,
+                        breaker_cooldown_ms=60000.0) as srv:
+        cli = Client(srv.port)
+        try:
+            status, _ = cli.score(["1 0:1.0"],
+                                  headers={"X-Request-Id": "acc-1"})
+            assert status == 200
+
+            def boom(row, col, val, num_rows):
+                raise RuntimeError("injected forward fault")
+
+            srv._model.scores = boom
+            for _ in range(2):
+                status, _ = cli.score(["1 0:1.0"])
+                assert status == 500
+            status, body = cli.score(["1 0:1.0"])  # open: admission shed
+            assert status == 503 and b"breaker" in body
+        finally:
+            cli.close()
+
+    dumps = [json.load(open(dump_dir / f)) for f in os.listdir(dump_dir)]
+    trips = [d for d in dumps
+             if d["reason"].startswith("serve-breaker-open")]
+    assert trips, [d["reason"] for d in dumps]
+    assert "2 consecutive" in trips[0]["reason"]
+
+    lines = [json.loads(ln) for ln in alog.read_text().splitlines()
+             if ln]
+    by_cause = {}
+    for rec in lines:
+        assert {"ts", "request_id", "status", "latency_ms",
+                "cause"} <= set(rec), rec
+        by_cause.setdefault(rec["cause"], []).append(rec)
+    assert by_cause["scored"][0]["request_id"] == "acc-1"
+    assert by_cause["scored"][0]["status"] == 200
+    assert [r["status"] for r in by_cause["error"]] == [500, 500]
+    assert by_cause["breaker"][0]["status"] == 503

@@ -23,9 +23,6 @@ import sys
 import threading
 import time
 
-import numpy as np
-import pytest
-
 from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.io import native
 from tests.serving_util import Client, save_linear, serving_server

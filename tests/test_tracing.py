@@ -491,3 +491,119 @@ def test_sigkill_rank_leaves_flight_recorder_dump(tmp_path, monkeypatch):
     # the dump carries the anchor pair and the span/event rings
     assert set(doc["anchor"]) == {"wall_us", "perf_us"}
     assert "spans" in doc["trace"]
+
+
+# -- per-request tracing primitives (doc/observability.md) -------------------
+def test_new_span_id_and_explicit_parent_handoff():
+    """The cross-thread handoff contract: `new_span_id` reserves an id
+    without emitting, children on OTHER threads parent under it
+    explicitly, the root is emitted later under `span_id=`, and
+    `parent=0` marks an explicit root (the thread-local chain never
+    crosses threads)."""
+    rid = telemetry.new_span_id()
+
+    def worker():
+        telemetry.emit_span("child", 1000.0, 50.0, parent=rid)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    telemetry.emit_span("root", 900.0, 200.0, parent=0, span_id=rid,
+                        request_id="r-1")
+    got = {s["name"]: s for s in telemetry.spans()}
+    assert got["child"]["parent"] == rid
+    assert got["root"]["id"] == rid and got["root"]["parent"] == 0
+    assert got["root"]["args"]["request_id"] == "r-1"
+    # the reserved id came off the one process allocator: no collision
+    assert telemetry.new_span_id() > rid
+
+
+def test_request_id_sanitize_or_mint():
+    from dmlc_core_tpu.tracker import minihttp
+    assert minihttp.request_id("abc-DEF_1.2") == "abc-DEF_1.2"
+    minted = minihttp.request_id(None)
+    assert re.fullmatch(r"[0-9a-f]{16}", minted)
+    # injection/oversize/garbage all mint instead of echoing
+    for bad in ("x" * 65, "a b", "a\r\nSet-Cookie: x", ""):
+        out = minihttp.request_id(bad)
+        assert re.fullmatch(r"[0-9a-f]{16}", out), (bad, out)
+
+
+# -- step timelines: straggler attribution on a REAL 2-process job -----------
+def test_step_timeline_straggler_e2e(tmp_path):
+    """Acceptance pin (doc/observability.md "Step timelines"): a real
+    2-process job whose slowed rank steps ~8x slower yields the
+    `straggler_bound` verdict with the correct rank as the /trace
+    `job_meta` record, the slow rank's visibly-longer `mesh.step` spans
+    on its lane, and the `tracker_straggler_rank` gauge on /metrics."""
+    tracker = RabitTracker("127.0.0.1", 2, heartbeat_ms=100)
+    tracker.start()
+    step_worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "step_worker.py")
+
+    def spawn(task, sleep_ms):
+        env = dict(os.environ)
+        env.update({str(k): str(v)
+                    for k, v in tracker.worker_envs().items()})
+        env.update({"DMLC_TASK_ID": str(task),
+                    "DMLC_TRACKER_CLIENT_TIMEOUT": "60",
+                    "DMLC_TEST_STEP_SLEEP_MS": str(sleep_ms),
+                    "DMLC_TEST_STEPS": "6"})
+        return subprocess.Popen(
+            [sys.executable, step_worker, REPO, str(tmp_path)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+    workers = [spawn(0, 10), spawn(1, 80)]  # task 1 is the straggler
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if all(os.path.exists(tmp_path / f"stepped_{t}")
+                   for t in (0, 1)):
+                break
+            for w in workers:
+                assert w.poll() is None, w.stderr.read().decode()
+            time.sleep(0.05)
+        else:
+            pytest.fail("workers never finished stepping")
+        slow_rank = int((tmp_path / "stepped_1").read_text().split()[0])
+
+        base = f"http://127.0.0.1:{tracker.port}"
+        trace = json.loads(urllib.request.urlopen(
+            base + "/trace", timeout=30).read())
+        scrape = urllib.request.urlopen(
+            base + "/metrics", timeout=30).read().decode()
+    finally:
+        open(tmp_path / "release", "w").close()
+        for w in workers:
+            try:
+                w.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                w.kill()
+    assert all(w.returncode == 0 for w in workers), \
+        [w.stderr.read().decode() for w in workers]
+    tracker.join(timeout=30)
+
+    # the merged timeline: mesh.step spans per rank lane, slow lane slower
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"
+           and e["name"] == "mesh.step"]
+    by_rank = {}
+    for e in evs:
+        by_rank.setdefault(e["pid"], []).append(e)
+    assert set(by_rank) == {0, 1}, sorted(by_rank)
+    fast_rank = 1 - slow_rank
+    med = {r: sorted(x["dur"] for x in v)[len(v) // 2]
+           for r, v in by_rank.items()}
+    assert med[slow_rank] > 2.0 * med[fast_rank], med
+    assert {e["args"]["step"] for e in by_rank[slow_rank]} == set(range(6))
+
+    # the verdict rides the trace as job_meta, naming the slow rank
+    meta = [e for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "job_meta"]
+    assert meta, "no job_meta record on /trace"
+    verdict = meta[0]["args"]
+    assert verdict["verdict"] == "straggler_bound", verdict
+    assert verdict["rank"] == slow_rank and verdict["ratio"] > 2.0
+
+    # ... and the gauge on /metrics
+    samples = _parse_exposition(scrape)
+    assert samples[("tracker_straggler_rank", "")] == slow_rank
